@@ -1,0 +1,20 @@
+"""nomad_tpu — a TPU-native distributed workload orchestrator.
+
+A ground-up rebuild of the capability set of HashiCorp Nomad (reference at
+/root/reference) with one deliberate architectural departure: placement is
+solved on TPU. The per-evaluation iterator scheduler is replaced by a batched
+JAX solver over dense (alloc x node x resource) tensors; everything around it
+(Raft-style replicated state, eval broker, optimistic plan apply, client
+agents, drivers) keeps the reference's semantics.
+
+Layer map (mirrors SURVEY.md §1):
+  structs/    shared vocabulary (Job, Node, Allocation, Evaluation, Plan)
+  state/      MVCC state store with watch channels
+  scheduler/  host oracle scheduler + the TPU batch solver (scheduler/tpu)
+  server/     eval broker, workers, plan queue/applier, FSM, leadership
+  client/     node agent, alloc/task runners
+  drivers/    task execution drivers (mock, rawexec, exec)
+  api/ cli/   HTTP API + SDK + command line surface
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,875 @@
+"""In-memory MVCC state store with watch support.
+
+Reference: nomad/state/state_store.go (6,445 LoC, go-memdb immutable radix)
+and nomad/state/schema.go:39-60 for the table set. The TPU-native redesign
+keeps the same contract the schedulers and plan applier rely on:
+
+  * copy-on-write discipline — structs are immutable once stored; writers
+    insert fresh copies, never mutate in place;
+  * O(1) snapshots — `snapshot()` marks tables shared and the next write to
+    a shared table forks the dict (table-granular COW instead of the
+    reference's radix-node-granular COW);
+  * every write stamps a monotonically increasing index, and blocking reads
+    (`wait_for_index`, the analog of memdb watch channels +
+    SnapshotMinIndex, reference nomad/state/state_store.go SnapshotMinIndex)
+    park on a condition variable.
+
+The schedulers only read snapshots; the plan applier and FSM write through
+the live store.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional
+
+from ..structs import (
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    Node,
+    PlanResult,
+)
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_LOST,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_STOP,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    DEPLOYMENT_STATUSES_TERMINAL,
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    JOB_STATUS_DEAD,
+    JOB_STATUS_PENDING,
+    JOB_STATUS_RUNNING,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSTEM,
+    NODE_SCHEDULING_ELIGIBLE,
+    NODE_SCHEDULING_INELIGIBLE,
+    NODE_STATUS_DOWN,
+    DrainStrategy,
+    now_ns,
+)
+
+# Table names (reference: nomad/state/schema.go:39-60)
+TABLE_NODES = "nodes"
+TABLE_JOBS = "jobs"
+TABLE_JOB_VERSIONS = "job_version"
+TABLE_JOB_SUMMARIES = "job_summary"
+TABLE_EVALS = "evals"
+TABLE_ALLOCS = "allocs"
+TABLE_DEPLOYMENTS = "deployment"
+ALL_TABLES = (
+    TABLE_NODES,
+    TABLE_JOBS,
+    TABLE_JOB_VERSIONS,
+    TABLE_JOB_SUMMARIES,
+    TABLE_EVALS,
+    TABLE_ALLOCS,
+    TABLE_DEPLOYMENTS,
+)
+
+JOB_TRACKED_VERSIONS = 6
+
+
+class JobSummary:
+    """Queued/running counts per task group (reference structs.go JobSummary)."""
+
+    def __init__(self, job_id: str, namespace: str) -> None:
+        self.job_id = job_id
+        self.namespace = namespace
+        # group -> {queued, complete, failed, running, starting, lost}
+        self.summary: dict[str, dict[str, int]] = {}
+        self.children_pending = 0
+        self.children_running = 0
+        self.children_dead = 0
+        self.create_index = 0
+        self.modify_index = 0
+
+    def copy(self) -> "JobSummary":
+        c = JobSummary(self.job_id, self.namespace)
+        c.summary = {g: dict(v) for g, v in self.summary.items()}
+        c.children_pending = self.children_pending
+        c.children_running = self.children_running
+        c.children_dead = self.children_dead
+        c.create_index = self.create_index
+        c.modify_index = self.modify_index
+        return c
+
+
+class StateSnapshot:
+    """A consistent read-only view at one index."""
+
+    def __init__(self, tables: dict[str, dict], indexes: dict[str, int], index: int):
+        self._tables = tables
+        self._indexes = indexes
+        self.index = index
+
+    # -- reads shared with the live store (mixin below) --
+
+
+class _ReadMixin:
+    _tables: dict[str, dict]
+
+    # nodes ------------------------------------------------------------
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self._tables[TABLE_NODES].get(node_id)
+
+    def nodes(self) -> list[Node]:
+        return list(self._tables[TABLE_NODES].values())
+
+    def nodes_by_prefix(self, prefix: str) -> list[Node]:
+        return [n for i, n in self._tables[TABLE_NODES].items() if i.startswith(prefix)]
+
+    # jobs -------------------------------------------------------------
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self._tables[TABLE_JOBS].get((namespace, job_id))
+
+    def jobs(self, namespace: Optional[str] = None) -> list[Job]:
+        if namespace is None:
+            return list(self._tables[TABLE_JOBS].values())
+        return [j for (ns, _), j in self._tables[TABLE_JOBS].items() if ns == namespace]
+
+    def jobs_by_prefix(self, namespace: str, prefix: str) -> list[Job]:
+        return [
+            j
+            for (ns, jid), j in self._tables[TABLE_JOBS].items()
+            if ns == namespace and jid.startswith(prefix)
+        ]
+
+    def job_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
+        return self._tables[TABLE_JOB_VERSIONS].get((namespace, job_id, version))
+
+    def job_versions(self, namespace: str, job_id: str) -> list[Job]:
+        out = [
+            j
+            for (ns, jid, _), j in self._tables[TABLE_JOB_VERSIONS].items()
+            if ns == namespace and jid == job_id
+        ]
+        out.sort(key=lambda j: j.version, reverse=True)
+        return out
+
+    def jobs_by_periodic(self) -> list[Job]:
+        return [j for j in self._tables[TABLE_JOBS].values() if j.is_periodic()]
+
+    def jobs_by_parent(self, namespace: str, parent_id: str) -> list[Job]:
+        return [
+            j
+            for (ns, _), j in self._tables[TABLE_JOBS].items()
+            if ns == namespace and j.parent_id == parent_id
+        ]
+
+    def job_summary_by_id(self, namespace: str, job_id: str) -> Optional[JobSummary]:
+        return self._tables[TABLE_JOB_SUMMARIES].get((namespace, job_id))
+
+    # evals ------------------------------------------------------------
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self._tables[TABLE_EVALS].get(eval_id)
+
+    def evals(self) -> list[Evaluation]:
+        return list(self._tables[TABLE_EVALS].values())
+
+    def evals_by_job(self, namespace: str, job_id: str) -> list[Evaluation]:
+        return [
+            e
+            for e in self._tables[TABLE_EVALS].values()
+            if e.namespace == namespace and e.job_id == job_id
+        ]
+
+    # allocs -----------------------------------------------------------
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        return self._tables[TABLE_ALLOCS].get(alloc_id)
+
+    def allocs(self) -> list[Allocation]:
+        return list(self._tables[TABLE_ALLOCS].values())
+
+    def allocs_by_node(self, node_id: str) -> list[Allocation]:
+        return [a for a in self._tables[TABLE_ALLOCS].values() if a.node_id == node_id]
+
+    def allocs_by_node_terminal(
+        self, node_id: str, terminal: bool
+    ) -> list[Allocation]:
+        return [
+            a
+            for a in self._tables[TABLE_ALLOCS].values()
+            if a.node_id == node_id and a.terminal_status() == terminal
+        ]
+
+    def allocs_by_job(
+        self, namespace: str, job_id: str, anyCreateIndex: bool = True
+    ) -> list[Allocation]:
+        return [
+            a
+            for a in self._tables[TABLE_ALLOCS].values()
+            if a.namespace == namespace and a.job_id == job_id
+        ]
+
+    def allocs_by_eval(self, eval_id: str) -> list[Allocation]:
+        return [a for a in self._tables[TABLE_ALLOCS].values() if a.eval_id == eval_id]
+
+    def allocs_by_deployment(self, deployment_id: str) -> list[Allocation]:
+        return [
+            a
+            for a in self._tables[TABLE_ALLOCS].values()
+            if a.deployment_id == deployment_id
+        ]
+
+    # deployments ------------------------------------------------------
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self._tables[TABLE_DEPLOYMENTS].get(deployment_id)
+
+    def deployments(self) -> list[Deployment]:
+        return list(self._tables[TABLE_DEPLOYMENTS].values())
+
+    def deployments_by_job(self, namespace: str, job_id: str) -> list[Deployment]:
+        return [
+            d
+            for d in self._tables[TABLE_DEPLOYMENTS].values()
+            if d.namespace == namespace and d.job_id == job_id
+        ]
+
+    def latest_deployment_by_job(
+        self, namespace: str, job_id: str
+    ) -> Optional[Deployment]:
+        best = None
+        for d in self._tables[TABLE_DEPLOYMENTS].values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+
+class StateSnapshotImpl(StateSnapshot, _ReadMixin):
+    pass
+
+
+class StateStore(_ReadMixin):
+    def __init__(self) -> None:
+        self._tables: dict[str, dict] = {t: {} for t in ALL_TABLES}
+        self._indexes: dict[str, int] = {t: 0 for t in ALL_TABLES}
+        self._latest_index = 0
+        self._shared: set[str] = set()
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        # Event hooks: called under lock with (index, table, list-of-objects).
+        self._subscribers: list[Callable[[int, str, list], None]] = []
+
+    # -- snapshot / watch ----------------------------------------------
+
+    def snapshot(self) -> StateSnapshotImpl:
+        with self._lock:
+            self._shared.update(ALL_TABLES)
+            return StateSnapshotImpl(
+                dict(self._tables), dict(self._indexes), self._latest_index
+            )
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._latest_index
+
+    def table_index(self, *tables: str) -> int:
+        with self._lock:
+            return max(self._indexes[t] for t in tables)
+
+    def snapshot_min_index(
+        self, index: int, timeout_s: float = 5.0
+    ) -> StateSnapshotImpl:
+        """Block until the store has applied `index`, then snapshot.
+
+        Reference: nomad/worker.go:228 snapshotMinIndex /
+        state_store.go SnapshotMinIndex.
+        """
+        deadline = now_ns() + int(timeout_s * 1e9)
+        with self._cv:
+            while self._latest_index < index:
+                remaining = (deadline - now_ns()) / 1e9
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out waiting for index {index} (at {self._latest_index})"
+                    )
+                self._cv.wait(remaining)
+        return self.snapshot()
+
+    def wait_for_index(
+        self, tables: Iterable[str], min_index: int, timeout_s: float = 30.0
+    ) -> int:
+        """Block until any of `tables` reaches min_index (blocking query)."""
+        tables = list(tables)
+        deadline = now_ns() + int(timeout_s * 1e9)
+        with self._cv:
+            while True:
+                cur = max(self._indexes[t] for t in tables)
+                if cur >= min_index:
+                    return cur
+                remaining = (deadline - now_ns()) / 1e9
+                if remaining <= 0:
+                    return cur
+                self._cv.wait(remaining)
+
+    def subscribe(self, fn: Callable[[int, str, list], None]) -> None:
+        self._subscribers.append(fn)
+
+    # -- write plumbing ------------------------------------------------
+
+    def _wtable(self, table: str) -> dict:
+        """Copy-on-write fork of a table that a live snapshot may share."""
+        if table in self._shared:
+            self._tables[table] = dict(self._tables[table])
+            self._shared.discard(table)
+        return self._tables[table]
+
+    def _stamp(self, index: int, *tables: str) -> None:
+        for t in tables:
+            self._indexes[t] = index
+        if index > self._latest_index:
+            self._latest_index = index
+        self._cv.notify_all()
+
+    def _publish(self, index: int, table: str, objs: list) -> None:
+        for fn in self._subscribers:
+            fn(index, table, objs)
+
+    # -- nodes ---------------------------------------------------------
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            existing = t.get(node.id)
+            node = node.copy()
+            if existing is not None:
+                node.create_index = existing.create_index
+            else:
+                node.create_index = index
+            node.modify_index = index
+            node.canonicalize()
+            t[node.id] = node
+            self._stamp(index, TABLE_NODES)
+            self._publish(index, TABLE_NODES, [node])
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            if node_id in t:
+                del t[node_id]
+                self._stamp(index, TABLE_NODES)
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            existing = t.get(node_id)
+            if existing is None:
+                raise KeyError(f"node {node_id} not found")
+            node = existing.copy()
+            node.status = status
+            node.status_updated_at = now_ns()
+            node.modify_index = index
+            t[node_id] = node
+            self._stamp(index, TABLE_NODES)
+            self._publish(index, TABLE_NODES, [node])
+
+    def update_node_drain(
+        self,
+        index: int,
+        node_id: str,
+        drain: Optional[DrainStrategy],
+        mark_eligible: bool = False,
+    ) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            existing = t.get(node_id)
+            if existing is None:
+                raise KeyError(f"node {node_id} not found")
+            node = existing.copy()
+            node.drain_strategy = drain
+            if drain is not None:
+                node.scheduling_eligibility = NODE_SCHEDULING_INELIGIBLE
+            elif mark_eligible:
+                node.scheduling_eligibility = NODE_SCHEDULING_ELIGIBLE
+            node.modify_index = index
+            t[node_id] = node
+            self._stamp(index, TABLE_NODES)
+            self._publish(index, TABLE_NODES, [node])
+
+    def update_node_eligibility(
+        self, index: int, node_id: str, eligibility: str
+    ) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            existing = t.get(node_id)
+            if existing is None:
+                raise KeyError(f"node {node_id} not found")
+            if existing.drain_strategy is not None and (
+                eligibility == NODE_SCHEDULING_ELIGIBLE
+            ):
+                raise ValueError("can't make draining node eligible")
+            node = existing.copy()
+            node.scheduling_eligibility = eligibility
+            node.modify_index = index
+            t[node_id] = node
+            self._stamp(index, TABLE_NODES)
+
+    # -- jobs ----------------------------------------------------------
+
+    def upsert_job(self, index: int, job: Job, keep_version: bool = False) -> None:
+        with self._lock:
+            self._upsert_job_txn(index, job, keep_version)
+            self._stamp(index, TABLE_JOBS, TABLE_JOB_VERSIONS, TABLE_JOB_SUMMARIES)
+            self._publish(index, TABLE_JOBS, [self._tables[TABLE_JOBS][job.ns_id()]])
+
+    def _upsert_job_txn(self, index: int, job: Job, keep_version: bool = False) -> None:
+        t = self._wtable(TABLE_JOBS)
+        job = job.copy()
+        existing = t.get(job.ns_id())
+        if existing is not None:
+            job.create_index = existing.create_index
+            job.job_modify_index = index
+            if keep_version:
+                job.version = existing.version
+            elif job.specification_changed(existing):
+                job.version = existing.version + 1
+            else:
+                job.version = existing.version
+        else:
+            job.create_index = index
+            job.job_modify_index = index
+            job.version = 0
+        job.modify_index = index
+        if job.status not in (JOB_STATUS_PENDING, JOB_STATUS_RUNNING, JOB_STATUS_DEAD):
+            job.status = JOB_STATUS_PENDING
+        if job.stop:
+            job.status = JOB_STATUS_DEAD
+        t[job.ns_id()] = job
+        # version history
+        vt = self._wtable(TABLE_JOB_VERSIONS)
+        vt[(job.namespace, job.id, job.version)] = job
+        versions = sorted(
+            (k for k in vt if k[0] == job.namespace and k[1] == job.id),
+            key=lambda k: k[2],
+            reverse=True,
+        )
+        for stale in versions[JOB_TRACKED_VERSIONS:]:
+            del vt[stale]
+        # summary
+        st = self._wtable(TABLE_JOB_SUMMARIES)
+        summary = st.get(job.ns_id())
+        summary = summary.copy() if summary else JobSummary(job.id, job.namespace)
+        if summary.create_index == 0:
+            summary.create_index = index
+        for tg in job.task_groups:
+            summary.summary.setdefault(
+                tg.name,
+                {
+                    "queued": 0,
+                    "complete": 0,
+                    "failed": 0,
+                    "running": 0,
+                    "starting": 0,
+                    "lost": 0,
+                },
+            )
+        summary.modify_index = index
+        st[job.ns_id()] = summary
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_JOBS)
+            if (namespace, job_id) in t:
+                del t[(namespace, job_id)]
+            vt = self._wtable(TABLE_JOB_VERSIONS)
+            for k in [k for k in vt if k[0] == namespace and k[1] == job_id]:
+                del vt[k]
+            st = self._wtable(TABLE_JOB_SUMMARIES)
+            st.pop((namespace, job_id), None)
+            self._stamp(index, TABLE_JOBS, TABLE_JOB_VERSIONS, TABLE_JOB_SUMMARIES)
+
+    # -- evals ---------------------------------------------------------
+
+    def upsert_evals(self, index: int, evals: list[Evaluation]) -> None:
+        with self._lock:
+            stored = self._upsert_evals_txn(index, evals)
+            self._stamp(index, TABLE_EVALS)
+            self._publish(index, TABLE_EVALS, stored)
+
+    def _upsert_evals_txn(self, index: int, evals: list[Evaluation]) -> list[Evaluation]:
+        t = self._wtable(TABLE_EVALS)
+        jobs_touched: set[tuple[str, str]] = set()
+        stored: list[Evaluation] = []
+        for ev in evals:
+            ev = ev.copy()
+            existing = t.get(ev.id)
+            ev.create_index = existing.create_index if existing else index
+            ev.modify_index = index
+            t[ev.id] = ev
+            stored.append(ev)
+            jobs_touched.add((ev.namespace, ev.job_id))
+            # Blocked-eval dedup: cancel older blocked evals for the same job.
+            if ev.status == EVAL_STATUS_BLOCKED:
+                for other in list(t.values()):
+                    if (
+                        other.id != ev.id
+                        and other.job_id == ev.job_id
+                        and other.namespace == ev.namespace
+                        and other.status == EVAL_STATUS_BLOCKED
+                        and other.modify_index < index
+                    ):
+                        c = other.copy()
+                        c.status = "canceled"
+                        c.status_description = (
+                            f"evaluation {ev.id} successfully blocked"
+                        )
+                        c.modify_index = index
+                        t[other.id] = c
+                        stored.append(c)
+        for ns, job_id in jobs_touched:
+            self._update_job_status_txn(index, ns, job_id)
+        return stored
+
+    def delete_evals(self, index: int, eval_ids: list[str], alloc_ids: list[str]) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_EVALS)
+            for eid in eval_ids:
+                t.pop(eid, None)
+            at = self._wtable(TABLE_ALLOCS)
+            for aid in alloc_ids:
+                at.pop(aid, None)
+            self._stamp(index, TABLE_EVALS, TABLE_ALLOCS)
+
+    # -- allocs --------------------------------------------------------
+
+    def upsert_allocs(self, index: int, allocs: list[Allocation]) -> None:
+        with self._lock:
+            stored = self._upsert_allocs_txn(index, allocs)
+            self._stamp(index, TABLE_ALLOCS, TABLE_JOB_SUMMARIES)
+            self._publish(index, TABLE_ALLOCS, stored)
+
+    def _upsert_allocs_txn(self, index: int, allocs: list[Allocation]) -> list[Allocation]:
+        t = self._wtable(TABLE_ALLOCS)
+        jobs_touched: set[tuple[str, str]] = set()
+        stored: list[Allocation] = []
+        for alloc in allocs:
+            alloc = alloc.copy()
+            existing = t.get(alloc.id)
+            if existing is not None:
+                alloc.create_index = existing.create_index
+                alloc.create_time = existing.create_time
+                if alloc.job is None:
+                    alloc.job = existing.job
+                # Client-reported state survives server-side updates.
+                if not alloc.task_states and existing.task_states:
+                    alloc.task_states = {
+                        k: v.copy() for k, v in existing.task_states.items()
+                    }
+                if alloc.client_status == "pending" and existing.client_status not in (
+                    "",
+                    "pending",
+                ):
+                    alloc.client_status = existing.client_status
+                    alloc.client_description = existing.client_description
+            else:
+                alloc.create_index = index
+                if not alloc.create_time:
+                    alloc.create_time = now_ns()
+            alloc.modify_index = index
+            alloc.modify_time = now_ns()
+            if alloc.job is None:
+                alloc.job = self._tables[TABLE_JOBS].get(
+                    (alloc.namespace, alloc.job_id)
+                )
+            t[alloc.id] = alloc
+            stored.append(alloc)
+            jobs_touched.add((alloc.namespace, alloc.job_id))
+        self._reconcile_summaries_txn(index, jobs_touched)
+        for ns, job_id in jobs_touched:
+            self._update_job_status_txn(index, ns, job_id)
+        return stored
+
+    def update_allocs_from_client(self, index: int, allocs: list[Allocation]) -> None:
+        """Merge client-reported status into stored allocs.
+
+        Reference: state_store.go UpdateAllocsFromClient / nested
+        updateClientAllocUpdateIndex.
+        """
+        with self._lock:
+            t = self._wtable(TABLE_ALLOCS)
+            jobs_touched: set[tuple[str, str]] = set()
+            stored: list[Allocation] = []
+            for update in allocs:
+                existing = t.get(update.id)
+                if existing is None:
+                    continue
+                alloc = existing.copy()
+                alloc.client_status = update.client_status
+                alloc.client_description = update.client_description
+                alloc.task_states = {
+                    k: v.copy() for k, v in update.task_states.items()
+                }
+                if update.deployment_status is not None:
+                    alloc.deployment_status = update.deployment_status.copy()
+                if update.network_status is not None:
+                    alloc.network_status = update.network_status
+                alloc.modify_index = index
+                alloc.modify_time = now_ns()
+                t[alloc.id] = alloc
+                stored.append(alloc)
+                jobs_touched.add((alloc.namespace, alloc.job_id))
+            self._reconcile_summaries_txn(index, jobs_touched)
+            for ns, job_id in jobs_touched:
+                self._update_job_status_txn(index, ns, job_id)
+            self._stamp(index, TABLE_ALLOCS, TABLE_JOB_SUMMARIES)
+            self._publish(index, TABLE_ALLOCS, stored)
+
+    def update_alloc_desired_transition(
+        self, index: int, transitions: dict[str, "DesiredTransition"], evals: list[Evaluation]
+    ) -> None:
+        from ..structs.structs import DesiredTransition  # local to avoid cycle
+
+        with self._lock:
+            t = self._wtable(TABLE_ALLOCS)
+            for alloc_id, transition in transitions.items():
+                existing = t.get(alloc_id)
+                if existing is None:
+                    continue
+                alloc = existing.copy()
+                dt = alloc.desired_transition
+                if transition.migrate is not None:
+                    dt.migrate = transition.migrate
+                if transition.reschedule is not None:
+                    dt.reschedule = transition.reschedule
+                if transition.force_reschedule is not None:
+                    dt.force_reschedule = transition.force_reschedule
+                alloc.modify_index = index
+                t[alloc_id] = alloc
+            if evals:
+                self._upsert_evals_txn(index, evals)
+                self._stamp(index, TABLE_EVALS)
+            self._stamp(index, TABLE_ALLOCS)
+
+    # -- plan results (the serialization point) ------------------------
+
+    def upsert_plan_results(self, index: int, result: PlanResult) -> None:
+        """Apply a committed plan atomically (reference state_store.go:318)."""
+        with self._lock:
+            allocs_to_upsert: list[Allocation] = []
+            for allocs in result.node_allocation.values():
+                allocs_to_upsert.extend(allocs)
+            stopped: list[Allocation] = []
+            for allocs in result.node_update.values():
+                stopped.extend(allocs)
+            preempted: list[Allocation] = []
+            for allocs in result.node_preemptions.values():
+                preempted.extend(allocs)
+
+            if result.deployment is not None:
+                self._upsert_deployment_txn(index, result.deployment)
+            for du in result.deployment_updates:
+                self._update_deployment_status_txn(index, du)
+
+            t = self._wtable(TABLE_ALLOCS)
+            # Stops and preemptions merge desired-status changes onto the
+            # existing alloc rather than replacing client state.
+            committed: list[Allocation] = []
+            for alloc in stopped + preempted:
+                existing = t.get(alloc.id)
+                merged = alloc.copy()
+                if existing is not None:
+                    merged = existing.copy()
+                    merged.desired_status = alloc.desired_status
+                    merged.desired_description = alloc.desired_description
+                    merged.preempted_by_allocation = alloc.preempted_by_allocation
+                    if alloc.client_status:
+                        merged.client_status = alloc.client_status
+                else:
+                    # Plan raced a GC: recreate a fully-stamped tombstone row.
+                    merged.create_index = index
+                    merged.job = self._tables[TABLE_JOBS].get(
+                        (merged.namespace, merged.job_id)
+                    )
+                merged.modify_index = index
+                merged.modify_time = now_ns()
+                t[merged.id] = merged
+                committed.append(merged)
+            committed.extend(self._upsert_allocs_txn(index, allocs_to_upsert))
+            tables = [TABLE_ALLOCS, TABLE_JOB_SUMMARIES]
+            if result.deployment is not None or result.deployment_updates:
+                tables.append(TABLE_DEPLOYMENTS)
+            self._stamp(index, *tables)
+            jobs_touched = {
+                (a.namespace, a.job_id) for a in stopped + preempted
+            }
+            self._reconcile_summaries_txn(index, jobs_touched)
+            for ns, job_id in jobs_touched:
+                self._update_job_status_txn(index, ns, job_id)
+            self._publish(index, TABLE_ALLOCS, committed)
+
+    # -- deployments ---------------------------------------------------
+
+    def upsert_deployment(self, index: int, deployment: Deployment) -> None:
+        with self._lock:
+            self._upsert_deployment_txn(index, deployment)
+            self._stamp(index, TABLE_DEPLOYMENTS)
+            self._publish(index, TABLE_DEPLOYMENTS, [deployment])
+
+    def _upsert_deployment_txn(self, index: int, deployment: Deployment) -> None:
+        t = self._wtable(TABLE_DEPLOYMENTS)
+        deployment = deployment.copy()
+        existing = t.get(deployment.id)
+        deployment.create_index = existing.create_index if existing else index
+        deployment.modify_index = index
+        t[deployment.id] = deployment
+
+    def _update_deployment_status_txn(self, index: int, update) -> None:
+        t = self._wtable(TABLE_DEPLOYMENTS)
+        existing = t.get(update.deployment_id)
+        if existing is None:
+            return
+        d = existing.copy()
+        d.status = update.status
+        d.status_description = update.status_description
+        d.modify_index = index
+        t[d.id] = d
+
+    def update_deployment_status(self, index: int, update) -> None:
+        with self._lock:
+            self._update_deployment_status_txn(index, update)
+            self._stamp(index, TABLE_DEPLOYMENTS)
+
+    def delete_deployment(self, index: int, deployment_ids: list[str]) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_DEPLOYMENTS)
+            for did in deployment_ids:
+                t.pop(did, None)
+            self._stamp(index, TABLE_DEPLOYMENTS)
+
+    # -- derived state -------------------------------------------------
+
+    def _reconcile_summaries_txn(
+        self, index: int, jobs_touched: set[tuple[str, str]]
+    ) -> None:
+        if not jobs_touched:
+            return
+        st = self._wtable(TABLE_JOB_SUMMARIES)
+        at = self._tables[TABLE_ALLOCS]
+        for ns, job_id in jobs_touched:
+            job = self._tables[TABLE_JOBS].get((ns, job_id))
+            summary = st.get((ns, job_id))
+            summary = summary.copy() if summary else JobSummary(job_id, ns)
+            groups = (
+                {tg.name for tg in job.task_groups}
+                if job
+                else set(summary.summary.keys())
+            )
+            counts = {
+                g: {
+                    "queued": summary.summary.get(g, {}).get("queued", 0),
+                    "complete": 0,
+                    "failed": 0,
+                    "running": 0,
+                    "starting": 0,
+                    "lost": 0,
+                }
+                for g in groups
+            }
+            for a in at.values():
+                if a.namespace != ns or a.job_id != job_id:
+                    continue
+                c = counts.setdefault(
+                    a.task_group,
+                    {
+                        "queued": 0,
+                        "complete": 0,
+                        "failed": 0,
+                        "running": 0,
+                        "starting": 0,
+                        "lost": 0,
+                    },
+                )
+                if a.client_status == ALLOC_CLIENT_STATUS_RUNNING:
+                    c["running"] += 1
+                elif a.client_status == ALLOC_CLIENT_STATUS_COMPLETE:
+                    c["complete"] += 1
+                elif a.client_status == ALLOC_CLIENT_STATUS_FAILED:
+                    c["failed"] += 1
+                elif a.client_status == ALLOC_CLIENT_STATUS_LOST:
+                    c["lost"] += 1
+                elif not a.terminal_status():
+                    c["starting"] += 1
+            summary.summary = counts
+            summary.modify_index = index
+            st[(ns, job_id)] = summary
+
+    def update_job_queued_allocs(
+        self, index: int, namespace: str, job_id: str, queued: dict[str, int]
+    ) -> None:
+        with self._lock:
+            st = self._wtable(TABLE_JOB_SUMMARIES)
+            summary = st.get((namespace, job_id))
+            if summary is None:
+                return
+            summary = summary.copy()
+            for group, count in queued.items():
+                summary.summary.setdefault(
+                    group,
+                    {
+                        "queued": 0,
+                        "complete": 0,
+                        "failed": 0,
+                        "running": 0,
+                        "starting": 0,
+                        "lost": 0,
+                    },
+                )["queued"] = count
+            summary.modify_index = index
+            st[(namespace, job_id)] = summary
+            self._stamp(index, TABLE_JOB_SUMMARIES)
+
+    def _update_job_status_txn(self, index: int, namespace: str, job_id: str) -> None:
+        """Derive job status from its allocs and evals (reference
+        state_store.go getJobStatus/setJobStatus)."""
+        jt = self._tables[TABLE_JOBS]
+        job = jt.get((namespace, job_id))
+        if job is None:
+            return
+        if job.stop:
+            new_status = JOB_STATUS_DEAD
+        else:
+            has_live_alloc = False
+            for a in self._tables[TABLE_ALLOCS].values():
+                if a.namespace == namespace and a.job_id == job_id and not a.terminal_status():
+                    has_live_alloc = True
+                    break
+            has_open_eval = False
+            for e in self._tables[TABLE_EVALS].values():
+                if (
+                    e.namespace == namespace
+                    and e.job_id == job_id
+                    and e.status in (EVAL_STATUS_PENDING, EVAL_STATUS_BLOCKED)
+                ):
+                    has_open_eval = True
+                    break
+            if has_live_alloc or has_open_eval:
+                new_status = JOB_STATUS_RUNNING if has_live_alloc else JOB_STATUS_PENDING
+            else:
+                # Periodic/parameterized parents idle at running.
+                if job.is_periodic() or job.is_parameterized():
+                    new_status = JOB_STATUS_RUNNING
+                elif job.type in (JOB_TYPE_SERVICE, JOB_TYPE_SYSTEM):
+                    # Service/system jobs with no allocs yet are pending.
+                    new_status = (
+                        JOB_STATUS_PENDING if job.status == JOB_STATUS_PENDING else JOB_STATUS_DEAD
+                    )
+                else:
+                    any_alloc = any(
+                        a.namespace == namespace and a.job_id == job_id
+                        for a in self._tables[TABLE_ALLOCS].values()
+                    )
+                    new_status = JOB_STATUS_DEAD if any_alloc else job.status
+        if new_status != job.status:
+            jt2 = self._wtable(TABLE_JOBS)
+            j = job.copy()
+            j.status = new_status
+            j.modify_index = index
+            jt2[(namespace, job_id)] = j
+            self._stamp(index, TABLE_JOBS)

@@ -1,0 +1,61 @@
+"""Device instance accounting (reference: nomad/structs/devices.go).
+
+Tracks which device instances on a node are in use by which allocs, used by
+AllocsFit's oversubscription check and the scheduler's device allocator.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .structs import Allocation, Node, NodeDeviceResource
+
+
+class DeviceAccounterInstance:
+    def __init__(self, device: NodeDeviceResource) -> None:
+        self.device = device
+        # instance id -> number of users (healthy instances only are usable)
+        self.instances: dict[str, int] = {i.id: 0 for i in device.instances}
+
+    def free_count(self) -> int:
+        healthy = {i.id for i in self.device.instances if i.healthy}
+        return sum(1 for iid, users in self.instances.items() if users == 0 and iid in healthy)
+
+
+class DeviceAccounter:
+    def __init__(self, node: Node) -> None:
+        self.devices: dict[str, DeviceAccounterInstance] = {
+            d.id_string(): DeviceAccounterInstance(d) for d in node.resources.devices
+        }
+
+    def add_allocs(self, allocs: Iterable[Allocation]) -> bool:
+        """Track device use by allocs; True if an instance is oversubscribed."""
+        collision = False
+        for alloc in allocs:
+            if alloc.terminal_status() or alloc.resources is None:
+                continue
+            for tr in alloc.resources.tasks.values():
+                for dev in tr.devices:
+                    key = dev.get("id", "")
+                    ids = dev.get("device_ids", [])
+                    acc = self.devices.get(key)
+                    if acc is None:
+                        continue
+                    for iid in ids:
+                        if iid in acc.instances:
+                            acc.instances[iid] += 1
+                            if acc.instances[iid] > 1:
+                                collision = True
+        return collision
+
+    def add_reserved(self, key: str, instance_ids: list[str]) -> bool:
+        acc = self.devices.get(key)
+        if acc is None:
+            return False
+        collision = False
+        for iid in instance_ids:
+            if iid in acc.instances:
+                acc.instances[iid] += 1
+                if acc.instances[iid] > 1:
+                    collision = True
+        return collision

@@ -1,0 +1,113 @@
+"""Scheduling math shared by the host oracle and the TPU solver.
+
+Reference: nomad/structs/funcs.go — AllocsFit :148, ScoreFitBinPack :237,
+ScoreFitSpread :264. The scoring formulas here are the scalar versions; the
+TPU solver re-expresses them as vectorized JAX ops over the full
+(alloc x node) tensor in nomad_tpu/scheduler/tpu/kernels.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .network import NetworkIndex
+from .structs import Allocation, Node, Resources
+
+# ScoreFit constants: Best-Fit v3 — at perfect fit score is 18, empty node 0.
+MAX_FIT_SCORE = 18.0
+
+
+def compute_free_percentage(node: Node, util: Resources) -> tuple[float, float]:
+    node_cpu = float(node.resources.cpu - node.reserved.cpu)
+    node_mem = float(node.resources.memory_mb - node.reserved.memory_mb)
+    free_cpu = 1.0 - (float(util.cpu) / node_cpu) if node_cpu > 0 else 0.0
+    free_mem = 1.0 - (float(util.memory_mb) / node_mem) if node_mem > 0 else 0.0
+    return free_cpu, free_mem
+
+
+def score_fit_binpack(node: Node, util: Resources) -> float:
+    """Best-fit score in [0, 18]; higher is fuller (reference funcs.go:237)."""
+    free_cpu, free_mem = compute_free_percentage(node, util)
+    total = 10.0**free_cpu + 10.0**free_mem
+    score = 20.0 - total
+    return max(0.0, min(MAX_FIT_SCORE, score))
+
+
+def score_fit_spread(node: Node, util: Resources) -> float:
+    """Worst-fit score in [0, 18]; higher is emptier (reference funcs.go:264)."""
+    free_cpu, free_mem = compute_free_percentage(node, util)
+    total = 10.0**free_cpu + 10.0**free_mem
+    score = total - 2.0
+    return max(0.0, min(MAX_FIT_SCORE, score))
+
+
+def allocs_fit(
+    node: Node,
+    allocs: list[Allocation],
+    net_idx: Optional[NetworkIndex] = None,
+    check_devices: bool = False,
+) -> tuple[bool, str, Resources]:
+    """Would this set of allocs fit on the node? (reference funcs.go:148)
+
+    Returns (fit, exhausted-dimension, used-resources). Terminal allocs are
+    free. If a NetworkIndex is supplied the caller has already checked port
+    collisions; otherwise one is built here.
+    """
+    used = Resources(cpu=0, memory_mb=0, disk_mb=0)
+    for alloc in allocs:
+        if alloc.terminal_status():
+            continue
+        r = alloc.comparable_resources()
+        used.cpu += r.cpu
+        used.memory_mb += r.memory_mb
+        used.disk_mb += r.disk_mb
+
+    available = node.available_resources()
+    ok, dim = available.superset(used)
+    if not ok:
+        return False, dim, used
+
+    if net_idx is None:
+        net_idx = NetworkIndex()
+        if net_idx.set_node(node):
+            return False, "reserved port collision", used
+        if net_idx.add_allocs(allocs):
+            return False, "reserved port collision", used
+
+    if net_idx.overcommitted():
+        return False, "bandwidth exceeded", used
+
+    if check_devices:
+        from .devices import DeviceAccounter
+
+        accounter = DeviceAccounter(node)
+        if accounter.add_allocs(allocs):
+            return False, "device oversubscribed", used
+
+    return True, "", used
+
+
+def filter_terminal_allocs(
+    allocs: list[Allocation],
+) -> tuple[list[Allocation], list[Allocation]]:
+    """Split into (live, terminal), keeping the newest terminal per name.
+
+    Reference: structs/funcs.go FilterTerminalAllocs :53.
+    """
+    terminal: dict[str, Allocation] = {}
+    live: list[Allocation] = []
+    for alloc in allocs:
+        if alloc.terminal_status():
+            prev = terminal.get(alloc.name)
+            if prev is None or prev.create_index < alloc.create_index:
+                terminal[alloc.name] = alloc
+        else:
+            live.append(alloc)
+    return live, list(terminal.values())
+
+
+def allocs_by_node(allocs: list[Allocation]) -> dict[str, list[Allocation]]:
+    out: dict[str, list[Allocation]] = {}
+    for a in allocs:
+        out.setdefault(a.node_id, []).append(a)
+    return out

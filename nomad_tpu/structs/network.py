@@ -1,0 +1,138 @@
+"""Port/bandwidth accounting per node — bin-packing within bin-packing.
+
+Reference: nomad/structs/network.go NetworkIndex. Kept host-side (SURVEY.md §7
+hard part 5): the TPU solver sees network only as a scalar capacity column;
+exact port selection happens here during plan construction and verification.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+from .structs import Allocation, NetworkResource, Node, Port
+
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 32000
+MAX_RAND_PORT_ATTEMPTS = 20
+
+
+class NetworkIndex:
+    """Tracks used ports and bandwidth on one node."""
+
+    def __init__(self) -> None:
+        self.avail_networks: list[NetworkResource] = []
+        self.avail_bandwidth: dict[str, int] = {}  # device -> mbits
+        self.used_ports: dict[str, set[int]] = {}  # ip -> ports
+        self.used_bandwidth: dict[str, int] = {}  # device -> mbits
+
+    def set_node(self, node: Node) -> bool:
+        """Index the node's networks; True on reserved-port collision."""
+        collide = False
+        for n in node.resources.networks:
+            if n.device:
+                self.avail_networks.append(n)
+                self.avail_bandwidth[n.device] = n.mbits
+        for port in node.reserved.reserved_ports:
+            for n in self.avail_networks:
+                if self._add_reserved_port(n.ip, port):
+                    collide = True
+        return collide
+
+    def add_allocs(self, allocs: Iterable[Allocation]) -> bool:
+        """Track the port/bandwidth usage of existing allocs; True on collision."""
+        collide = False
+        for alloc in allocs:
+            if alloc.terminal_status():
+                continue
+            if alloc.resources is not None:
+                for net in alloc.resources.shared_networks:
+                    if self.add_reserved(net):
+                        collide = True
+                for tr in alloc.resources.tasks.values():
+                    for net in tr.networks:
+                        if self.add_reserved(net):
+                            collide = True
+        return collide
+
+    def add_reserved(self, net: NetworkResource) -> bool:
+        collide = False
+        for port in list(net.reserved_ports) + list(net.dynamic_ports):
+            if port.value and self._add_reserved_port(net.ip, port.value):
+                collide = True
+        if net.device:
+            self.used_bandwidth[net.device] = (
+                self.used_bandwidth.get(net.device, 0) + net.mbits
+            )
+        return collide
+
+    def _add_reserved_port(self, ip: str, port: int) -> bool:
+        used = self.used_ports.setdefault(ip, set())
+        if port in used:
+            return True
+        used.add(port)
+        return False
+
+    def overcommitted(self) -> bool:
+        for device, used in self.used_bandwidth.items():
+            if used > self.avail_bandwidth.get(device, 0):
+                return True
+        return False
+
+    def yield_ip(self) -> Optional[NetworkResource]:
+        for n in self.avail_networks:
+            return n
+        return None
+
+    def assign_network(self, ask: NetworkResource) -> Optional[NetworkResource]:
+        """Satisfy a network ask: pick a device/IP, reserve static ports,
+        allocate dynamic ports. Returns the granted offer or None."""
+        if not self.avail_networks:
+            # Node advertises no networks: only satisfiable with no port asks.
+            if not ask.reserved_ports and not ask.dynamic_ports and ask.mbits == 0:
+                return NetworkResource(mode=ask.mode)
+            return None
+
+        for n in self.avail_networks:
+            if ask.mbits + self.used_bandwidth.get(n.device, 0) > self.avail_bandwidth.get(
+                n.device, 0
+            ):
+                continue
+            used = self.used_ports.get(n.ip, set())
+            # Static ports must be free.
+            if any(p.value in used for p in ask.reserved_ports):
+                continue
+            offer = NetworkResource(
+                mode=ask.mode,
+                device=n.device,
+                ip=n.ip,
+                cidr=n.cidr,
+                mbits=ask.mbits,
+                reserved_ports=[
+                    Port(p.label, p.value, p.to, p.host_network)
+                    for p in ask.reserved_ports
+                ],
+            )
+            taken = set(used) | {p.value for p in ask.reserved_ports}
+            ok = True
+            for p in ask.dynamic_ports:
+                got = self._pick_dynamic_port(taken)
+                if got is None:
+                    ok = False
+                    break
+                taken.add(got)
+                offer.dynamic_ports.append(Port(p.label, got, p.to, p.host_network))
+            if ok:
+                return offer
+        return None
+
+    def _pick_dynamic_port(self, taken: set[int]) -> Optional[int]:
+        for _ in range(MAX_RAND_PORT_ATTEMPTS):
+            port = random.randint(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
+            if port not in taken:
+                return port
+        # Linear fallback scan
+        for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1):
+            if port not in taken:
+                return port
+        return None

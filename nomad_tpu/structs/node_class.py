@@ -1,0 +1,59 @@
+"""Computed node classes — feasibility memoization key.
+
+Reference: nomad/structs/node_class.go ComputeClass :31. Nodes with identical
+non-unique attributes/resources hash to the same class; the scheduler then
+checks feasibility once per class instead of once per node. The TPU solver
+uses the same classes to deduplicate rows of the feasibility-mask tensor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .structs import Node
+
+# Attribute/meta keys that are unique per node and must not enter the hash.
+_UNIQUE_PREFIX = "unique."
+
+
+def _escaped(key: str) -> bool:
+    return key.startswith(_UNIQUE_PREFIX) or f".{_UNIQUE_PREFIX}" in key
+
+
+def compute_node_class(node: Node) -> str:
+    """Deterministic hash over the scheduling-relevant, non-unique fields."""
+    h = hashlib.blake2b(digest_size=8)
+
+    def put(*parts: object) -> None:
+        for p in parts:
+            h.update(str(p).encode())
+            h.update(b"\x00")
+
+    put("dc", node.datacenter)
+    put("class", node.node_class)
+    r = node.resources
+    put("res", r.cpu, r.memory_mb, r.disk_mb)
+    for net in sorted(r.networks, key=lambda n: n.device):
+        put("net", net.device, net.mbits)
+    for dev in sorted(r.devices, key=lambda d: d.id_string()):
+        put("dev", dev.id_string(), len(dev.instances))
+        for k in sorted(dev.attributes):
+            put("devattr", k, dev.attributes[k])
+    rv = node.reserved
+    put("reserved", rv.cpu, rv.memory_mb, rv.disk_mb)
+    for k in sorted(node.attributes):
+        if not _escaped(k):
+            put("attr", k, node.attributes[k])
+    for k in sorted(node.meta):
+        if not _escaped(k):
+            put("meta", k, node.meta[k])
+    for name in sorted(node.drivers):
+        d = node.drivers[name]
+        put("driver", name, d.detected, d.healthy)
+    return "v1:" + h.hexdigest()
+
+
+def escaped_constraint_target(target: str) -> bool:
+    """Does a constraint target reference node-unique state? Such constraints
+    escape class-level memoization (reference: EscapedConstraints)."""
+    return _escaped(target)
