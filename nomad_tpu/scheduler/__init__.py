@@ -1,0 +1,35 @@
+"""Scheduler registry and interfaces.
+
+Reference: scheduler/scheduler.go — BuiltinSchedulers :23, NewScheduler :32,
+and the Scheduler/State/Planner interface trio :55-132.
+
+The Planner contract (implemented by the worker against the plan queue, and
+by the test Harness directly):
+    submit_plan(plan) -> (PlanResult, new_state | None)
+    update_eval(eval) -> None
+    create_eval(eval) -> None
+    refresh_state(min_index) -> StateSnapshot
+
+The `tpu` entry is the deliberate architectural departure: a batched JAX
+backend registered through the same factory seam (see scheduler/tpu/).
+"""
+
+from __future__ import annotations
+
+from .context import EvalContext, SchedulerConfig
+from .generic import BatchScheduler, GenericScheduler
+from .system import SysBatchScheduler, SystemScheduler
+
+BUILTIN_SCHEDULERS = {
+    "service": GenericScheduler,
+    "batch": BatchScheduler,
+    "system": SystemScheduler,
+    "sysbatch": SysBatchScheduler,
+}
+
+
+def new_scheduler(name: str, logger, state, planner, config=None):
+    factory = BUILTIN_SCHEDULERS.get(name)
+    if factory is None:
+        raise ValueError(f"unknown scheduler '{name}'")
+    return factory(logger, state, planner, config)

@@ -1,0 +1,74 @@
+"""Device instance assignment with affinity scoring.
+
+Reference: scheduler/device.go — deviceAllocator :13, AssignDevice :32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..structs import Node
+from ..structs.structs import RequestedDevice
+from .context import EvalContext
+
+
+class DeviceAllocator:
+    """Tracks free device instances on one node during ranking."""
+
+    def __init__(self, ctx: EvalContext, node: Node) -> None:
+        self.ctx = ctx
+        self.node = node
+        # device-group id -> set of free healthy instance ids
+        self.free: dict[str, set[str]] = {
+            d.id_string(): {i.id for i in d.instances if i.healthy}
+            for d in node.resources.devices
+        }
+        self.groups = {d.id_string(): d for d in node.resources.devices}
+
+    def add_allocs(self, allocs) -> None:
+        for alloc in allocs:
+            if alloc.terminal_status() or alloc.resources is None:
+                continue
+            for tr in alloc.resources.tasks.values():
+                for dev in tr.devices:
+                    free = self.free.get(dev.get("id", ""))
+                    if free is not None:
+                        free.difference_update(dev.get("device_ids", []))
+
+    def assign(self, ask: RequestedDevice) -> Optional[dict[str, Any]]:
+        """Pick instances for the ask; prefer groups scoring best on
+        affinities. Returns {'id', 'device_ids'} or None."""
+        from .feasible import _resolve_device_target, check_constraint
+
+        best: Optional[tuple[float, str, list[str]]] = None
+        for gid, group in self.groups.items():
+            if not group.matches(ask):
+                continue
+            free = self.free.get(gid, set())
+            if len(free) < ask.count:
+                continue
+            if ask.constraints:
+                ok = True
+                for c in ask.constraints:
+                    lval, lf = _resolve_device_target(group, c.ltarget)
+                    rval, rf = _resolve_device_target(group, c.rtarget)
+                    if not check_constraint(self.ctx, c.operand, lval, rval, lf, rf):
+                        ok = False
+                        break
+                if not ok:
+                    continue
+            score = 0.0
+            if ask.affinities:
+                total_weight = sum(abs(a.weight) for a in ask.affinities) or 1
+                for a in ask.affinities:
+                    lval, lf = _resolve_device_target(group, a.ltarget)
+                    rval, rf = _resolve_device_target(group, a.rtarget)
+                    if check_constraint(self.ctx, a.operand, lval, rval, lf, rf):
+                        score += a.weight / total_weight
+            if best is None or score > best[0]:
+                best = (score, gid, sorted(free)[: ask.count])
+        if best is None:
+            return None
+        _, gid, ids = best
+        self.free[gid].difference_update(ids)
+        return {"id": gid, "device_ids": ids}

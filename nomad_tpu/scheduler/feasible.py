@@ -1,0 +1,492 @@
+"""Hard-constraint feasibility checking.
+
+Reference: scheduler/feasible.go — ConstraintChecker :709, checkConstraint
+:785, DriverChecker :433, HostVolumeChecker :132, NetworkChecker :341,
+DeviceChecker :1173, DistinctHosts/DistinctProperty :505/:604,
+FeasibilityWrapper :1029 (computed-class memoization).
+
+Redesign note: the reference chains lazy Go iterators; here each checker is a
+predicate object and the stack composes them lazily with generators. The same
+predicate set is what the TPU backend compiles into the dense feasibility-mask
+tensor (nomad_tpu/scheduler/tpu/lower.py) — comparison/set predicates lower to
+vectorized ops over interned attribute codes, regex/version predicates are
+evaluated host-side per (class, constraint) and broadcast.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Iterator, Optional
+
+from ..structs import Constraint, Node
+from ..structs.structs import (
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+    CONSTRAINT_IS_NOT_SET,
+    CONSTRAINT_IS_SET,
+    CONSTRAINT_REGEX,
+    CONSTRAINT_SEMVER,
+    CONSTRAINT_SET_CONTAINS,
+    CONSTRAINT_SET_CONTAINS_ALL,
+    CONSTRAINT_SET_CONTAINS_ANY,
+    CONSTRAINT_VERSION,
+    RequestedDevice,
+    Task,
+    TaskGroup,
+    VolumeRequest,
+)
+from .context import (
+    ELIGIBILITY_ELIGIBLE,
+    ELIGIBILITY_ESCAPED,
+    ELIGIBILITY_INELIGIBLE,
+    ELIGIBILITY_UNKNOWN,
+    EvalContext,
+)
+
+FILTER_CONSTRAINT_HOST_VOLUMES = "missing compatible host volumes"
+FILTER_CONSTRAINT_DRIVERS = "missing drivers"
+FILTER_CONSTRAINT_DEVICES = "missing devices"
+FILTER_CONSTRAINT_NETWORK = "missing network"
+
+
+# ---------------------------------------------------------------------------
+# Attribute resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_target(node: Node, target: str) -> tuple[str, bool]:
+    """Resolve a constraint LTarget against a node.
+
+    Accepts '${node.datacenter}', '${attr.kernel.name}', '${meta.rack}',
+    '${node.unique.id}' etc.; a bare string resolves to itself (literal).
+    Reference: scheduler/feasible.go resolveTarget :745.
+    """
+    if not (target.startswith("${") and target.endswith("}")):
+        return target, True
+    inner = target[2:-1]
+    if inner == "node.unique.id":
+        return node.id, True
+    if inner == "node.unique.name":
+        return node.name, True
+    if inner == "node.datacenter":
+        return node.datacenter, True
+    if inner == "node.class":
+        return node.node_class, True
+    if inner.startswith("attr.unique."):
+        val = node.attributes.get(inner[len("attr.") :])
+        if val is None:
+            val = node.attributes.get(inner[len("attr.unique.") :])
+        return (val or "", val is not None)
+    if inner.startswith("attr."):
+        val = node.attributes.get(inner[len("attr.") :])
+        return (val or "", val is not None)
+    if inner.startswith("meta.unique."):
+        val = node.meta.get(inner[len("meta.") :])
+        if val is None:
+            val = node.meta.get(inner[len("meta.unique.") :])
+        return (val or "", val is not None)
+    if inner.startswith("meta."):
+        val = node.meta.get(inner[len("meta.") :])
+        return (val or "", val is not None)
+    if inner.startswith("driver."):
+        val = node.attributes.get(inner)
+        return (val or "", val is not None)
+    return "", False
+
+
+# ---------------------------------------------------------------------------
+# Version comparison (lightweight semver-compatible)
+# ---------------------------------------------------------------------------
+
+_VERSION_RE = re.compile(r"^\s*v?(\d+(?:\.\d+)*)(?:[-.]?(.*))?$")
+
+
+def parse_version(s: str) -> Optional[tuple[tuple[int, ...], str]]:
+    m = _VERSION_RE.match(s)
+    if not m:
+        return None
+    nums = tuple(int(p) for p in m.group(1).split("."))
+    pre = m.group(2) or ""
+    return nums, pre
+
+
+def _cmp_version(a: tuple[tuple[int, ...], str], b: tuple[tuple[int, ...], str]) -> int:
+    an, ap = a
+    bn, bp = b
+    # pad numeric parts
+    ln = max(len(an), len(bn))
+    an = an + (0,) * (ln - len(an))
+    bn = bn + (0,) * (ln - len(bn))
+    if an != bn:
+        return -1 if an < bn else 1
+    # a pre-release sorts before its release
+    if ap == bp:
+        return 0
+    if ap == "":
+        return 1
+    if bp == "":
+        return -1
+    return -1 if ap < bp else 1
+
+
+def check_version_constraint(
+    ver_str: str, constraint_str: str, strict_semver: bool = False
+) -> bool:
+    """Evaluate a version constraint like '>= 1.2, < 2.0' or '~> 1.2'."""
+    ver = parse_version(ver_str)
+    if ver is None:
+        return False
+    if strict_semver and ver[1]:
+        # semver operand: a pre-release only satisfies a range when the
+        # constraint itself names a pre-release with the same numeric core.
+        core_matched = False
+        for part in constraint_str.split(","):
+            m = re.match(r"^(>=|<=|!=|~>|=|>|<)?\s*(.+)$", part.strip())
+            if m:
+                target = parse_version(m.group(2))
+                if target is not None and target[1] and target[0] == ver[0]:
+                    core_matched = True
+                    break
+        if not core_matched:
+            return False
+    for part in constraint_str.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.match(r"^(>=|<=|!=|~>|=|>|<)?\s*(.+)$", part)
+        if not m:
+            return False
+        op = m.group(1) or "="
+        target = parse_version(m.group(2))
+        if target is None:
+            return False
+        c = _cmp_version(ver, target)
+        if op == "=" and c != 0:
+            return False
+        if op == "!=" and c == 0:
+            return False
+        if op == ">" and c <= 0:
+            return False
+        if op == ">=" and c < 0:
+            return False
+        if op == "<" and c >= 0:
+            return False
+        if op == "<=" and c > 0:
+            return False
+        if op == "~>":
+            # pessimistic: >= target and < bump of second-to-last component
+            if c < 0:
+                return False
+            tn = list(target[0])
+            if len(tn) > 1:
+                upper = tn[:-1]
+                upper[-1] += 1
+            else:
+                upper = [tn[0] + 1]
+            if _cmp_version(ver, (tuple(upper), "")) >= 0:
+                return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Scalar constraint evaluation
+# ---------------------------------------------------------------------------
+
+
+def _try_numeric(lval: str, rval: str) -> Optional[tuple[float, float]]:
+    try:
+        return float(lval), float(rval)
+    except (TypeError, ValueError):
+        return None
+
+
+def check_constraint(
+    ctx: EvalContext,
+    operand: str,
+    lval: str,
+    rval: str,
+    l_found: bool,
+    r_found: bool,
+) -> bool:
+    """Evaluate one constraint (reference: feasible.go checkConstraint :785)."""
+    if operand in ("=", "==", "is"):
+        return l_found and r_found and lval == rval
+    if operand in ("!=", "not"):
+        return lval != rval
+    if operand in ("<", "<=", ">", ">="):
+        if not (l_found and r_found):
+            return False
+        nums = _try_numeric(lval, rval)
+        if nums is not None:
+            a, b = nums
+        else:
+            a, b = lval, rval  # lexical
+        return {
+            "<": a < b,
+            "<=": a <= b,
+            ">": a > b,
+            ">=": a >= b,
+        }[operand]
+    if operand == CONSTRAINT_IS_SET:
+        return l_found
+    if operand == CONSTRAINT_IS_NOT_SET:
+        return not l_found
+    if not (l_found and r_found):
+        return False
+    if operand == CONSTRAINT_REGEX:
+        pat = ctx.regex(rval)
+        return pat is not None and pat.search(lval) is not None
+    if operand == CONSTRAINT_VERSION:
+        return check_version_constraint(lval, rval)
+    if operand == CONSTRAINT_SEMVER:
+        return check_version_constraint(lval, rval, strict_semver=True)
+    if operand in (CONSTRAINT_SET_CONTAINS, CONSTRAINT_SET_CONTAINS_ALL):
+        have = {p.strip() for p in lval.split(",")}
+        want = [p.strip() for p in rval.split(",")]
+        return all(w in have for w in want)
+    if operand == CONSTRAINT_SET_CONTAINS_ANY:
+        have = {p.strip() for p in lval.split(",")}
+        want = [p.strip() for p in rval.split(",")]
+        return any(w in have for w in want)
+    return False
+
+
+def node_matches_constraint(ctx: EvalContext, node: Node, c: Constraint) -> bool:
+    lval, l_found = resolve_target(node, c.ltarget)
+    rval, r_found = resolve_target(node, c.rtarget)
+    return check_constraint(ctx, c.operand, lval, rval, l_found, r_found)
+
+
+# ---------------------------------------------------------------------------
+# Checkers
+# ---------------------------------------------------------------------------
+
+
+class FeasibilityChecker:
+    """A named hard-constraint predicate over nodes."""
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        raise NotImplementedError
+
+
+class ConstraintChecker(FeasibilityChecker):
+    def __init__(self, ctx: EvalContext, constraints: list[Constraint]) -> None:
+        self.ctx = ctx
+        self.constraints = constraints
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        for c in self.constraints:
+            if c.operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+                continue  # handled by dedicated iterators
+            if not node_matches_constraint(self.ctx, node, c):
+                return False, str(c)
+        return True, ""
+
+
+class DriverChecker(FeasibilityChecker):
+    """Every task's driver must be detected and healthy on the node
+    (reference: feasible.go:433)."""
+
+    def __init__(self, ctx: EvalContext, drivers: set[str]) -> None:
+        self.ctx = ctx
+        self.drivers = drivers
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        for driver in self.drivers:
+            info = node.drivers.get(driver)
+            if info is not None:
+                if not (info.detected and info.healthy):
+                    return False, FILTER_CONSTRAINT_DRIVERS
+                continue
+            # Fall back to fingerprint attribute driver.<name> = "1"/"true"
+            raw = node.attributes.get(f"driver.{driver}", "")
+            if raw not in ("1", "true"):
+                return False, FILTER_CONSTRAINT_DRIVERS
+        return True, ""
+
+
+class HostVolumeChecker(FeasibilityChecker):
+    """Node must expose every requested host volume (reference :132)."""
+
+    def __init__(self, ctx: EvalContext, volumes: dict[str, VolumeRequest]) -> None:
+        self.ctx = ctx
+        self.asks = [
+            v for v in volumes.values() if v.type in ("", "host")
+        ]
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        for ask in self.asks:
+            vol = node.host_volumes.get(ask.source)
+            if vol is None:
+                return False, FILTER_CONSTRAINT_HOST_VOLUMES
+            if vol.read_only and not ask.read_only:
+                return False, FILTER_CONSTRAINT_HOST_VOLUMES
+        return True, ""
+
+
+class NetworkChecker(FeasibilityChecker):
+    """Node must be able to satisfy static port + bandwidth asks
+    (reference: feasible.go NetworkChecker :341)."""
+
+    def __init__(self, ctx: EvalContext, tg: TaskGroup) -> None:
+        self.ctx = ctx
+        self.asks = list(tg.networks)
+        for t in tg.tasks:
+            self.asks.extend(t.resources.networks)
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        if not self.asks:
+            return True, ""
+        total_mbits = sum(a.mbits for a in self.asks)
+        static_ports = [p.value for a in self.asks for p in a.reserved_ports]
+        if not node.resources.networks:
+            if total_mbits > 0 or static_ports:
+                return False, FILTER_CONSTRAINT_NETWORK
+            return True, ""
+        cap = max(n.mbits for n in node.resources.networks)
+        if total_mbits > cap:
+            return False, FILTER_CONSTRAINT_NETWORK
+        reserved = set(node.reserved.reserved_ports)
+        if any(p in reserved for p in static_ports):
+            return False, FILTER_CONSTRAINT_NETWORK
+        return True, ""
+
+
+class DeviceChecker(FeasibilityChecker):
+    """Node must have enough healthy matching device instances
+    (reference: feasible.go DeviceChecker :1173)."""
+
+    def __init__(self, ctx: EvalContext, tg: TaskGroup) -> None:
+        self.ctx = ctx
+        self.asks: list[RequestedDevice] = []
+        for t in tg.tasks:
+            self.asks.extend(t.resources.devices)
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        if not self.asks:
+            return True, ""
+        for ask in self.asks:
+            satisfied = False
+            for dev in node.resources.devices:
+                if not dev.matches(ask):
+                    continue
+                healthy = sum(1 for i in dev.instances if i.healthy)
+                if healthy < ask.count:
+                    continue
+                if ask.constraints:
+                    ok = True
+                    for c in ask.constraints:
+                        lval, lf = _resolve_device_target(dev, c.ltarget)
+                        rval, rf = _resolve_device_target(dev, c.rtarget)
+                        if not check_constraint(self.ctx, c.operand, lval, rval, lf, rf):
+                            ok = False
+                            break
+                    if not ok:
+                        continue
+                satisfied = True
+                break
+            if not satisfied:
+                return False, FILTER_CONSTRAINT_DEVICES
+        return True, ""
+
+
+def _resolve_device_target(dev, target: str) -> tuple[str, bool]:
+    if not (target.startswith("${") and target.endswith("}")):
+        return target, True
+    inner = target[2:-1]
+    if inner.startswith("device.attr."):
+        val = dev.attributes.get(inner[len("device.attr.") :])
+        return (str(val) if val is not None else "", val is not None)
+    if inner == "device.model":
+        return dev.name, True
+    if inner == "device.vendor":
+        return dev.vendor, True
+    if inner == "device.type":
+        return dev.type, True
+    return "", False
+
+
+class DistinctHostsChecker(FeasibilityChecker):
+    """distinct_hosts (reference :505). Job-level: no two allocs of the job
+    on one node. Group-level: no two allocs of that group on one node."""
+
+    def __init__(
+        self, ctx: EvalContext, job_id: str, tg_name: str, job_level: bool
+    ) -> None:
+        self.ctx = ctx
+        self.job_id = job_id
+        self.tg_name = tg_name
+        self.job_level = job_level
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        for alloc in self.ctx.proposed_allocs(node.id):
+            if alloc.job_id != self.job_id:
+                continue
+            if self.job_level or alloc.task_group == self.tg_name:
+                return False, f"{CONSTRAINT_DISTINCT_HOSTS} constraint"
+        return True, ""
+
+
+# ---------------------------------------------------------------------------
+# Source iterators + memoizing wrapper
+# ---------------------------------------------------------------------------
+
+
+def feasibility_pipeline(
+    ctx: EvalContext,
+    nodes: Iterable[Node],
+    job_checkers: list[FeasibilityChecker],
+    tg_checkers: list[FeasibilityChecker],
+    tg_name: str,
+    metrics=None,
+) -> Iterator[Node]:
+    """Lazily yield feasible nodes, memoizing per computed class.
+
+    Reference: feasible.go FeasibilityWrapper :1029 — job-level and
+    tg-level checkers are skipped for classes already proven (in)eligible;
+    escaped constraints disable the memo.
+    """
+    elig = ctx.eligibility
+    for node in nodes:
+        ctx.metrics_nodes_evaluated += 1
+        klass = node.computed_class
+
+        j_status = elig.job_status(klass)
+        if j_status == ELIGIBILITY_INELIGIBLE:
+            if metrics is not None:
+                metrics.filter_node(node, "")
+            continue
+        if j_status in (ELIGIBILITY_UNKNOWN, ELIGIBILITY_ESCAPED):
+            ok = True
+            for checker in job_checkers:
+                feasible, reason = checker.feasible(node)
+                if not feasible:
+                    ok = False
+                    if metrics is not None:
+                        metrics.filter_node(node, reason)
+                    break
+            if j_status == ELIGIBILITY_UNKNOWN:
+                elig.set_job_eligibility(ok, klass)
+            if not ok:
+                continue
+
+        t_status = elig.task_group_status(tg_name, klass)
+        if t_status == ELIGIBILITY_INELIGIBLE:
+            if metrics is not None:
+                metrics.filter_node(node, "")
+            continue
+        if t_status in (ELIGIBILITY_UNKNOWN, ELIGIBILITY_ESCAPED):
+            ok = True
+            for checker in tg_checkers:
+                feasible, reason = checker.feasible(node)
+                if not feasible:
+                    ok = False
+                    if metrics is not None:
+                        metrics.filter_node(node, reason)
+                    break
+            if t_status == ELIGIBILITY_UNKNOWN:
+                elig.set_task_group_eligibility(ok, tg_name, klass)
+            if not ok:
+                continue
+
+        yield node

@@ -1,0 +1,354 @@
+"""Service/batch scheduler.
+
+Reference: scheduler/generic_sched.go — Process :125, process :216,
+computeJobAllocs :332, computePlacements :472, selectNextOption :773.
+Processes one evaluation: snapshot → reconcile → place each missing alloc via
+the stack → submit plan → retry on partial commit → blocked eval on failure.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..structs import (
+    AllocMetric,
+    Allocation,
+    Evaluation,
+    Plan,
+    generate_uuid,
+    now_ns,
+)
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_DESIRED_STATUS_STOP,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+    EVAL_TRIGGER_ALLOC_STOP,
+    EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+    EVAL_TRIGGER_FAILED_FOLLOWUP,
+    EVAL_TRIGGER_JOB_DEREGISTER,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_MAX_PLANS,
+    EVAL_TRIGGER_NODE_DRAIN,
+    EVAL_TRIGGER_NODE_UPDATE,
+    EVAL_TRIGGER_PERIODIC_JOB,
+    EVAL_TRIGGER_PREEMPTION,
+    EVAL_TRIGGER_QUEUED_ALLOCS,
+    EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+    EVAL_TRIGGER_ROLLING_UPDATE,
+    EVAL_TRIGGER_SCALING,
+    EVAL_TRIGGER_SCHEDULED,
+    JOB_TYPE_BATCH,
+    RescheduleEvent,
+    RescheduleTracker,
+)
+from .context import EvalContext, SchedulerConfig
+from .reconcile import AllocReconciler, PlacementRequest
+from .stack import GenericStack
+from .util import (
+    SchedulerRetryError,
+    ready_nodes_in_dcs,
+    retry_max,
+    tainted_nodes,
+    update_non_terminal_allocs_to_lost,
+)
+
+MAX_SERVICE_SCHEDULE_ATTEMPTS = 5
+MAX_BATCH_SCHEDULE_ATTEMPTS = 2
+
+BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENTS = "created to place remaining allocations"
+
+
+class GenericScheduler:
+    """One instance processes one evaluation (stateless between evals)."""
+
+    scheduler_type = "service"
+
+    def __init__(self, logger, state, planner, config: Optional[SchedulerConfig] = None):
+        self.logger = logger
+        self.state = state  # snapshot; refreshed on partial commit
+        self.planner = planner
+        self.config = config or SchedulerConfig()
+        self.batch = self.scheduler_type == JOB_TYPE_BATCH
+        self.eval: Optional[Evaluation] = None
+        self.plan: Optional[Plan] = None
+        self.ctx: Optional[EvalContext] = None
+        self.stack: Optional[GenericStack] = None
+        self.failed_tg_allocs: dict[str, AllocMetric] = {}
+        self.queued_allocs: dict[str, int] = {}
+        self.followup_evals: list[Evaluation] = []
+        self.blocked: Optional[Evaluation] = None
+        self.plan_result = None
+
+    # ------------------------------------------------------------------
+
+    def process(self, eval_obj: Evaluation) -> None:
+        self.eval = eval_obj
+        trigger = eval_obj.triggered_by
+        if trigger not in (
+            EVAL_TRIGGER_JOB_REGISTER,
+            EVAL_TRIGGER_JOB_DEREGISTER,
+            EVAL_TRIGGER_NODE_DRAIN,
+            EVAL_TRIGGER_NODE_UPDATE,
+            EVAL_TRIGGER_ALLOC_STOP,
+            EVAL_TRIGGER_ROLLING_UPDATE,
+            EVAL_TRIGGER_QUEUED_ALLOCS,
+            EVAL_TRIGGER_PERIODIC_JOB,
+            EVAL_TRIGGER_MAX_PLANS,
+            EVAL_TRIGGER_DEPLOYMENT_WATCHER,
+            EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+            EVAL_TRIGGER_FAILED_FOLLOWUP,
+            EVAL_TRIGGER_PREEMPTION,
+            EVAL_TRIGGER_SCALING,
+            EVAL_TRIGGER_SCHEDULED,
+        ):
+            self._set_status(
+                EVAL_STATUS_FAILED, f"scheduler cannot handle '{trigger}' evaluation"
+            )
+            return
+
+        limit = (
+            MAX_BATCH_SCHEDULE_ATTEMPTS if self.batch else MAX_SERVICE_SCHEDULE_ATTEMPTS
+        )
+        try:
+            retry_max(limit, self._process_attempt, self._progress_made)
+        except SchedulerRetryError as e:
+            # Exhausted plan attempts: mark failed and roll a new blocked eval
+            # so the job eventually retries (reference: generic_sched.go:161).
+            if self.eval.status != "blocked":
+                follow = self.eval.create_blocked_eval({}, True, "", self.failed_tg_allocs)
+                follow.triggered_by = EVAL_TRIGGER_MAX_PLANS
+                follow.status_description = BLOCKED_EVAL_MAX_PLAN_DESC
+                self.planner.create_eval(follow)
+            self._set_status(EVAL_STATUS_FAILED, str(e))
+            return
+        self._set_status(EVAL_STATUS_COMPLETE, "")
+
+    def _progress_made(self) -> bool:
+        result = self.plan_result
+        made = result is not None and not result.is_no_op()
+        if result is not None and result.refresh_index > 0:
+            self.state = self.planner.refresh_state(result.refresh_index)
+        return made
+
+    # ------------------------------------------------------------------
+
+    def _process_attempt(self) -> tuple[bool, object]:
+        eval_obj = self.eval
+        job = self.state.job_by_id(eval_obj.namespace, eval_obj.job_id)
+        self.plan = eval_obj.make_plan(job)
+        self.plan.snapshot_index = self.state.index
+        self.failed_tg_allocs = {}
+        self.queued_allocs = {}
+        self.followup_evals = []
+        self.plan_result = None
+        self.ctx = EvalContext(self.state, self.plan, self.logger, self.config)
+        self.stack = GenericStack(self.batch, self.ctx)
+
+        if job is not None and not job.stopped():
+            nodes, dc_counts = ready_nodes_in_dcs(self.state, job.datacenters)
+            self.stack.set_nodes(nodes)
+            self.stack.set_job(job)
+            self._dc_counts = dc_counts
+        else:
+            self._dc_counts = {}
+
+        if not self._compute_job_allocs(job):
+            return False, None
+
+        # No-op plan: done.
+        if self.plan.is_no_op() and not self.followup_evals:
+            if self.queued_allocs and any(self.queued_allocs.values()):
+                self._ensure_blocked_eval()
+            return True, None
+
+        # Follow-up evals must exist before allocs reference them.
+        for fe in self.followup_evals:
+            self.planner.create_eval(fe)
+
+        result, new_state = self.planner.submit_plan(self.plan)
+        self.plan_result = result
+        if new_state is not None:
+            self.state = new_state
+
+        full, expected, actual = result.full_commit(self.plan)
+        if not full:
+            # Partial commit: stay in the retry loop (progress_made refreshes
+            # the snapshot; the next attempt recomputes queued counts fresh).
+            return False, None
+        if self.queued_allocs and any(self.queued_allocs.values()):
+            self._ensure_blocked_eval()
+        return True, None
+
+    # ------------------------------------------------------------------
+
+    def _compute_job_allocs(self, job) -> bool:
+        eval_obj = self.eval
+        allocs = self.state.allocs_by_job(eval_obj.namespace, eval_obj.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+
+        deployment = None
+        if job is not None:
+            deployment = self.state.latest_deployment_by_job(
+                eval_obj.namespace, eval_obj.job_id
+            )
+            if deployment is not None and not deployment.active():
+                deployment = None
+
+        reconciler = AllocReconciler(
+            job if job is not None else _tombstone_job(eval_obj),
+            eval_obj.job_id,
+            allocs,
+            tainted,
+            eval_obj,
+            deployment=deployment,
+            batch=self.batch,
+        )
+        results = reconciler.compute()
+
+        self.followup_evals = results.followup_evals
+        if results.deployment is not None:
+            self.plan.deployment = results.deployment
+        self.plan.deployment_updates = results.deployment_updates
+
+        for alloc, desc, client_status in results.stop:
+            self.plan.append_stopped_alloc(alloc, desc, client_status)
+
+        for updated in results.inplace_update:
+            self.plan.append_alloc(updated, updated.job)
+
+        # Annotate delayed-reschedule allocs with their follow-up eval.
+        for alloc_id, eval_id in results.attr_updates.items():
+            existing = self.state.alloc_by_id(alloc_id)
+            if existing is not None:
+                annotated = existing.copy()
+                annotated.followup_eval_id = eval_id
+                self.plan.append_alloc(annotated, annotated.job)
+
+        # Destructive updates: stop old, then place replacement.
+        place_requests: list[PlacementRequest] = []
+        for old, req in results.destructive_update:
+            self.plan.append_stopped_alloc(
+                old, "alloc not needed due to job update", ""
+            )
+            place_requests.append(req)
+        place_requests.extend(results.place)
+
+        if job is None or job.stopped():
+            return True
+
+        queued: dict[str, int] = {
+            tg: s.place + s.destructive for tg, s in results.desired_tg_updates.items()
+        }
+        active_deployment = self.state.latest_deployment_by_job(job.namespace, job.id)
+        if active_deployment is not None and (
+            not active_deployment.active()
+            or active_deployment.job_version != job.version
+        ):
+            active_deployment = None
+
+        # --- placements (reference: computePlacements :472) ---
+        for req in place_requests:
+            tg = req.task_group
+            metric = AllocMetric(nodes_available=dict(self._dc_counts))
+            start = now_ns()
+            penalty = {req.penalty_node} if req.penalty_node else None
+            option = self.stack.select(tg, penalty_nodes=penalty, metrics=metric)
+            metric.allocation_time_ns = now_ns() - start
+            metric.nodes_evaluated = self.ctx.metrics_nodes_evaluated
+
+            if option is None:
+                # Failed placement: coalesce metrics per task group.
+                existing = self.failed_tg_allocs.get(tg.name)
+                if existing is not None:
+                    existing.coalesced_failures += 1
+                else:
+                    self.failed_tg_allocs[tg.name] = metric
+                continue
+
+            alloc = Allocation(
+                id=generate_uuid(),
+                namespace=self.eval.namespace,
+                eval_id=self.eval.id,
+                name=req.name,
+                node_id=option.node.id,
+                node_name=option.node.name,
+                job_id=job.id,
+                job=job,
+                task_group=tg.name,
+                resources=option.alloc_resources,
+                metrics=metric,
+                desired_status="run",
+                client_status="pending",
+            )
+            if self.plan.deployment is not None and tg.update is not None:
+                alloc.deployment_id = self.plan.deployment.id
+                dstate = self.plan.deployment.task_groups.get(tg.name)
+                if dstate is not None:
+                    dstate.placed_allocs += 1
+            elif job.type == "service" and active_deployment is not None:
+                alloc.deployment_id = active_deployment.id
+
+            prev = req.previous_alloc
+            if prev is not None:
+                alloc.previous_allocation = prev.id
+                if req.reschedule:
+                    tracker = (
+                        prev.reschedule_tracker.copy()
+                        if prev.reschedule_tracker
+                        else RescheduleTracker()
+                    )
+                    tracker.events.append(
+                        RescheduleEvent(
+                            reschedule_time_ns=now_ns(),
+                            prev_alloc_id=prev.id,
+                            prev_node_id=prev.node_id,
+                        )
+                    )
+                    alloc.reschedule_tracker = tracker
+            self.plan.append_alloc(alloc, job)
+            queued[tg.name] = max(0, queued.get(tg.name, 0) - 1)
+
+        self.queued_allocs = queued
+        self.eval.queued_allocations = queued
+        return True
+
+    # ------------------------------------------------------------------
+
+    def _ensure_blocked_eval(self) -> None:
+        if self.blocked is not None or not self.failed_tg_allocs:
+            return
+        e = self.eval.create_blocked_eval(
+            self.ctx.eligibility.get_classes(),
+            self.ctx.eligibility.has_escaped(),
+            self.ctx.eligibility.quota_reached,
+            self.failed_tg_allocs,
+        )
+        e.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS
+        self.planner.create_eval(e)
+        self.blocked = e
+
+    def _set_status(self, status: str, desc: str) -> None:
+        updated = self.eval.copy()
+        updated.status = status
+        updated.status_description = desc
+        updated.failed_tg_allocs = self.failed_tg_allocs
+        updated.queued_allocations = self.queued_allocs
+        if self.blocked is not None:
+            updated.blocked_eval = self.blocked.id
+        self.planner.update_eval(updated)
+
+
+class BatchScheduler(GenericScheduler):
+    scheduler_type = "batch"
+
+
+def _tombstone_job(eval_obj: Evaluation):
+    """A stand-in for a deregistered job so the reconciler stops everything."""
+    from ..structs import Job
+
+    j = Job(id=eval_obj.job_id, namespace=eval_obj.namespace, stop=True)
+    j.task_groups = []
+    return j

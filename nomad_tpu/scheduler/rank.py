@@ -1,0 +1,242 @@
+"""Node scoring pipeline.
+
+Reference: scheduler/rank.go — RankedNode :21, BinPackIterator.Next :193-527
+(the reference's hot loop), JobAntiAffinityIterator :536,
+NodeReschedulingPenaltyIterator :606, NodeAffinityIterator :650,
+ScoreNormalizationIterator :740.
+
+The host pipeline below is the correctness oracle; the TPU backend computes
+the same scores for all (alloc, node) pairs at once in
+nomad_tpu/scheduler/tpu/kernels.py. Keep formula changes mirrored there.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from ..structs import (
+    AllocatedResources,
+    AllocatedTaskResources,
+    NetworkIndex,
+    Node,
+    Resources,
+    TaskGroup,
+)
+from ..structs.funcs import score_fit_binpack, score_fit_spread
+from .context import EvalContext
+from .device import DeviceAllocator
+
+BINPACK_SCORER = "binpack"
+JOB_ANTI_AFFINITY_SCORER = "job-anti-affinity"
+NODE_RESCHED_PENALTY_SCORER = "node-reschedule-penalty"
+NODE_AFFINITY_SCORER = "node-affinity"
+SPREAD_SCORER = "allocation-spread"
+
+
+@dataclass
+class RankedNode:
+    node: Node
+    scores: dict[str, float] = field(default_factory=dict)
+    final_score: float = 0.0
+    task_resources: dict[str, AllocatedTaskResources] = field(default_factory=dict)
+    alloc_resources: Optional[AllocatedResources] = None
+    proposed_allocs: Optional[list] = None
+
+    def add_score(self, name: str, value: float) -> None:
+        self.scores[name] = value
+
+
+def binpack_rank(
+    ctx: EvalContext,
+    candidates: Iterator[Node],
+    tg: TaskGroup,
+    metrics=None,
+    algorithm: Optional[str] = None,
+) -> Iterator[RankedNode]:
+    """Fit-check + score each candidate node for the task group.
+
+    Per node: proposed utilization (existing − stops + placements), per-task
+    network/device assignment, cumulative fit, ScoreFit. Infeasible nodes are
+    recorded as exhausted and skipped. Reference: rank.go BinPackIterator.
+    """
+    algo = algorithm or ctx.scheduler_config.algorithm
+    for node in candidates:
+        proposed = ctx.proposed_allocs(node.id)
+        available = node.available_resources()
+
+        util = Resources(cpu=0, memory_mb=0, disk_mb=0)
+        for alloc in proposed:
+            r = alloc.comparable_resources()
+            util.cpu += r.cpu
+            util.memory_mb += r.memory_mb
+            util.disk_mb += r.disk_mb
+
+        net_idx = NetworkIndex()
+        net_idx.set_node(node)
+        net_idx.add_allocs(proposed)
+
+        dev_alloc = DeviceAllocator(ctx, node)
+        dev_alloc.add_allocs(proposed)
+
+        total_ask = tg.combined_resources()
+        util.cpu += total_ask.cpu
+        util.memory_mb += total_ask.memory_mb
+        util.disk_mb += total_ask.disk_mb
+
+        ok, dim = available.superset(util)
+        if not ok:
+            if metrics is not None:
+                metrics.exhausted_node(node, dim)
+            continue
+
+        # Per-task port/bandwidth + device assignment.
+        task_resources: dict[str, AllocatedTaskResources] = {}
+        feasible = True
+        for task in tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu=task.resources.cpu, memory_mb=task.resources.memory_mb
+            )
+            for ask in task.resources.networks:
+                offer = net_idx.assign_network(ask)
+                if offer is None:
+                    if metrics is not None:
+                        metrics.exhausted_node(node, "network")
+                    feasible = False
+                    break
+                net_idx.add_reserved(offer)
+                tr.networks.append(offer)
+            if not feasible:
+                break
+            for dev_ask in task.resources.devices:
+                got = dev_alloc.assign(dev_ask)
+                if got is None:
+                    if metrics is not None:
+                        metrics.exhausted_node(node, "devices")
+                    feasible = False
+                    break
+                tr.devices.append(got)
+            if not feasible:
+                break
+            task_resources[task.name] = tr
+        if not feasible:
+            continue
+
+        # Group-level networks (bridge/port asks at the group level).
+        shared_networks = []
+        for ask in tg.networks:
+            offer = net_idx.assign_network(ask)
+            if offer is None:
+                if metrics is not None:
+                    metrics.exhausted_node(node, "network")
+                feasible = False
+                break
+            net_idx.add_reserved(offer)
+            shared_networks.append(offer)
+        if not feasible:
+            continue
+
+        if algo == "spread":
+            fit_score = score_fit_spread(node, util)
+        else:
+            fit_score = score_fit_binpack(node, util)
+        # Normalize [0,18] → [0,1] like the reference (rank.go:504).
+        normalized = fit_score / 18.0
+
+        ranked = RankedNode(
+            node=node,
+            task_resources=task_resources,
+            alloc_resources=AllocatedResources(
+                tasks=task_resources,
+                shared_disk_mb=tg.ephemeral_disk.size_mb,
+                shared_networks=shared_networks,
+            ),
+            proposed_allocs=proposed,
+        )
+        ranked.add_score(BINPACK_SCORER, normalized)
+        if metrics is not None:
+            metrics.score_node(node.id, BINPACK_SCORER, normalized)
+        yield ranked
+
+
+def job_anti_affinity_rank(
+    ctx: EvalContext,
+    options: Iterator[RankedNode],
+    job_id: str,
+    tg_name: str,
+    desired_count: int,
+    metrics=None,
+) -> Iterator[RankedNode]:
+    """Penalize placing multiple allocs of one task group on a node
+    (reference: rank.go:536)."""
+    for option in options:
+        proposed = (
+            option.proposed_allocs
+            if option.proposed_allocs is not None
+            else ctx.proposed_allocs(option.node.id)
+        )
+        collisions = sum(
+            1
+            for a in proposed
+            if a.job_id == job_id and a.task_group == tg_name
+        )
+        if collisions > 0 and desired_count > 0:
+            penalty = -1.0 * float(collisions + 1) / float(desired_count)
+            option.add_score(JOB_ANTI_AFFINITY_SCORER, penalty)
+            if metrics is not None:
+                metrics.score_node(option.node.id, JOB_ANTI_AFFINITY_SCORER, penalty)
+        yield option
+
+
+def node_resched_penalty_rank(
+    options: Iterator[RankedNode],
+    penalty_nodes: set[str],
+    metrics=None,
+) -> Iterator[RankedNode]:
+    """Penalize the node a failed alloc is being rescheduled away from
+    (reference: rank.go:606)."""
+    for option in options:
+        if option.node.id in penalty_nodes:
+            option.add_score(NODE_RESCHED_PENALTY_SCORER, -1.0)
+            if metrics is not None:
+                metrics.score_node(option.node.id, NODE_RESCHED_PENALTY_SCORER, -1.0)
+        yield option
+
+
+def node_affinity_rank(
+    ctx: EvalContext,
+    options: Iterator[RankedNode],
+    affinities: list,
+    metrics=None,
+) -> Iterator[RankedNode]:
+    """Soft-preference scoring, normalized by total |weight|
+    (reference: rank.go:650)."""
+    from .feasible import node_matches_constraint
+
+    if not affinities:
+        yield from options
+        return
+    total_weight = sum(abs(a.weight) for a in affinities) or 1
+    for option in options:
+        total = 0.0
+        for aff in affinities:
+            if node_matches_constraint(ctx, option.node, aff):
+                total += float(aff.weight)
+        if total != 0.0:
+            norm = total / float(total_weight)
+            option.add_score(NODE_AFFINITY_SCORER, norm)
+            if metrics is not None:
+                metrics.score_node(option.node.id, NODE_AFFINITY_SCORER, norm)
+        yield option
+
+
+def score_normalization(
+    options: Iterator[RankedNode], metrics=None
+) -> Iterator[RankedNode]:
+    """final = mean of component scores (reference: rank.go:740)."""
+    for option in options:
+        if option.scores:
+            option.final_score = sum(option.scores.values()) / len(option.scores)
+        if metrics is not None:
+            metrics.score_node(option.node.id, "normalized", option.final_score)
+        yield option

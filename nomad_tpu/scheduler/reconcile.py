@@ -1,0 +1,468 @@
+"""Declarative reconciliation: desired job state vs existing allocations.
+
+Reference: scheduler/reconcile.go (983 LoC) + reconcile_util.go (598 —
+allocSet/allocNameIndex). Computes, per task group: placements, stops,
+in-place updates, destructive updates, migrations, delayed reschedules
+(follow-up evals), and deployment bookkeeping.
+
+Round-1 scope note: rolling deployments (max_parallel batching, auto-revert
+bookkeeping, progress deadlines) are implemented; canary placement is tracked
+through DeploymentState but canary-specific placement naming is simplified.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..structs import (
+    Allocation,
+    Evaluation,
+    Job,
+    Node,
+    TaskGroup,
+    alloc_name,
+    new_deployment,
+    now_ns,
+)
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_LOST,
+    ALLOC_DESIRED_STATUS_RUN,
+    ALLOC_DESIRED_STATUS_STOP,
+    DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    JOB_TYPE_BATCH,
+    NODE_STATUS_DOWN,
+    DeploymentState,
+    DeploymentStatusUpdate,
+    RescheduleEvent,
+    RescheduleTracker,
+)
+from .util import tasks_updated
+
+ALLOC_NOT_NEEDED = "alloc not needed due to job update"
+ALLOC_MIGRATING = "alloc is being migrated"
+ALLOC_RESCHEDULED = "alloc was rescheduled because it failed"
+ALLOC_LOST = "alloc is lost since its node is down"
+ALLOC_IN_PLACE = "alloc updating in-place"
+ALLOC_NODE_TAINTED = "alloc not needed as node is tainted"
+
+
+@dataclass
+class PlacementRequest:
+    """One alloc to place."""
+
+    name: str
+    task_group: TaskGroup
+    previous_alloc: Optional[Allocation] = None
+    reschedule: bool = False
+    canary: bool = False
+    # When rescheduling, penalize the previous node in ranking.
+    penalty_node: str = ""
+    min_job_version: int = 0
+    lost: bool = False
+
+
+@dataclass
+class GroupSummary:
+    place: int = 0
+    stop: int = 0
+    migrate: int = 0
+    in_place: int = 0
+    destructive: int = 0
+    canary: int = 0
+    ignore: int = 0
+
+
+@dataclass
+class ReconcileResults:
+    place: list[PlacementRequest] = field(default_factory=list)
+    destructive_update: list[tuple[Allocation, PlacementRequest]] = field(
+        default_factory=list
+    )
+    inplace_update: list[Allocation] = field(default_factory=list)
+    stop: list[tuple[Allocation, str, str]] = field(default_factory=list)
+    # alloc_id -> followup eval id (delayed reschedule annotation)
+    attr_updates: dict[str, str] = field(default_factory=dict)
+    followup_evals: list[Evaluation] = field(default_factory=list)
+    deployment: Optional[object] = None
+    deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
+    desired_tg_updates: dict[str, GroupSummary] = field(default_factory=dict)
+
+    def total_changes(self) -> int:
+        return (
+            len(self.place) + len(self.destructive_update) + len(self.inplace_update)
+            + len(self.stop)
+        )
+
+
+class AllocReconciler:
+    """Reference: reconcile.go allocReconciler.Compute :184."""
+
+    def __init__(
+        self,
+        job: Job,
+        job_id: str,
+        existing_allocs: list[Allocation],
+        tainted: dict[str, Optional[Node]],
+        eval_obj: Evaluation,
+        deployment=None,
+        batch: bool = False,
+        now_fn=now_ns,
+    ) -> None:
+        self.job = job
+        self.job_id = job_id
+        self.allocs = existing_allocs
+        self.tainted = tainted
+        self.eval = eval_obj
+        self.deployment = deployment.copy() if deployment is not None else None
+        self.batch = batch
+        self.now_ns = now_fn()
+        self.results = ReconcileResults()
+
+    # ------------------------------------------------------------------
+
+    def compute(self) -> ReconcileResults:
+        stopped = self.job.stopped()
+
+        # Cancel deployments for stopped jobs or version mismatch.
+        self._cancel_stale_deployments(stopped)
+
+        groups = {tg.name: tg for tg in self.job.task_groups} if not stopped else {}
+        by_group: dict[str, list[Allocation]] = {}
+        for a in self.allocs:
+            by_group.setdefault(a.task_group, []).append(a)
+
+        deployment_complete = True
+        for name in set(by_group) | set(groups):
+            tg = groups.get(name)
+            complete = self._compute_group(name, tg, by_group.get(name, []))
+            deployment_complete = deployment_complete and complete
+
+        # Mark a running deployment successful when every group is done.
+        if (
+            self.deployment is not None
+            and deployment_complete
+            and self.deployment.status == DEPLOYMENT_STATUS_RUNNING
+            and not self.deployment.requires_promotion()
+        ):
+            self.results.deployment_updates.append(
+                DeploymentStatusUpdate(
+                    deployment_id=self.deployment.id,
+                    status=DEPLOYMENT_STATUS_SUCCESSFUL,
+                    status_description="Deployment completed successfully",
+                )
+            )
+        return self.results
+
+    def _cancel_stale_deployments(self, stopped: bool) -> None:
+        d = self.deployment
+        if d is None:
+            return
+        if stopped:
+            self.results.deployment_updates.append(
+                DeploymentStatusUpdate(
+                    deployment_id=d.id,
+                    status=DEPLOYMENT_STATUS_CANCELLED,
+                    status_description="Cancelled because job is stopped",
+                )
+            )
+            self.deployment = None
+            return
+        if d.job_version != self.job.version:
+            self.results.deployment_updates.append(
+                DeploymentStatusUpdate(
+                    deployment_id=d.id,
+                    status=DEPLOYMENT_STATUS_CANCELLED,
+                    status_description="Cancelled due to newer version of job",
+                )
+            )
+            self.deployment = None
+            return
+        if not d.active():
+            self.deployment = None
+
+    # ------------------------------------------------------------------
+
+    def _compute_group(
+        self, name: str, tg: Optional[TaskGroup], allocs: list[Allocation]
+    ) -> bool:
+        summary = self.results.desired_tg_updates.setdefault(name, GroupSummary())
+
+        # Group removed or job stopped/dead: stop everything live.
+        if tg is None:
+            for a in allocs:
+                if not a.terminal_status():
+                    self.results.stop.append((a, ALLOC_NOT_NEEDED, ""))
+                    summary.stop += 1
+            return True
+
+        # Partition by node taint and client status (reference:
+        # reconcile_util.go filterByTainted + filterByRescheduleable).
+        migrate: list[Allocation] = []
+        lost: list[Allocation] = []
+        resched_now: list[Allocation] = []
+        resched_later: list[tuple[Allocation, int]] = []
+        stable: list[Allocation] = []
+        completed: list[Allocation] = []  # batch-only: ran to completion
+        for a in allocs:
+            if a.server_terminal_status():
+                continue  # already stopping
+            node = self.tainted.get(a.node_id, "ok")
+            if node != "ok" and not a.client_terminal_status():
+                if node is None or node.status == NODE_STATUS_DOWN:
+                    lost.append(a)
+                else:
+                    # Draining node. The reference waits for the drainer to
+                    # set desired_transition.migrate; until the drainer
+                    # subsystem rate-limits migrations, allocs on a draining
+                    # node migrate immediately.
+                    migrate.append(a)
+                continue
+            if a.client_status == ALLOC_CLIENT_STATUS_FAILED:
+                if a.desired_transition.should_force_reschedule():
+                    resched_now.append(a)
+                    continue
+                when, eligible = a.next_reschedule_time()
+                if eligible:
+                    if when <= self.now_ns:
+                        resched_now.append(a)
+                    else:
+                        resched_later.append((a, when))
+                        stable.append(a)  # keeps its name until replaced
+                else:
+                    stable.append(a)  # attempts exhausted: leave it failed
+            elif a.client_status == ALLOC_CLIENT_STATUS_COMPLETE:
+                if self.batch:
+                    completed.append(a)  # done; keeps name, never replaced
+                # service: name is released and the count refilled below
+            elif a.client_status == ALLOC_CLIENT_STATUS_LOST:
+                pass  # replaced via missing-count placement
+            else:
+                stable.append(a)
+
+        desired = tg.count
+
+        # Name index over allocs that keep their names.
+        used_names = (
+            {a.name for a in stable}
+            | {a.name for a in migrate}
+            | {a.name for a in completed}
+        )
+        name_index = _NameIndex(self.job_id, name, desired, used_names)
+
+        # --- stops: scale down ---
+        keep = [a for a in stable]
+        n_live = len(keep) + len(migrate)
+        if n_live > desired:
+            excess = n_live - desired
+            # prefer stopping migrating allocs? reference stops highest indexes
+            removable = sorted(
+                keep, key=lambda a: (a.index() < desired, -a.index())
+            )
+            for a in removable[:excess]:
+                self.results.stop.append((a, ALLOC_NOT_NEEDED, ""))
+                summary.stop += 1
+                keep.remove(a)
+                name_index.release(a.name)
+            n_live = len(keep) + len(migrate)
+
+        # --- deployment handling ---
+        dstate: Optional[DeploymentState] = None
+        if self.deployment is not None:
+            dstate = self.deployment.task_groups.get(name)
+
+        # Updates among the kept allocs (job version drift).
+        inplace: list[Allocation] = []
+        destructive: list[Allocation] = []
+        for a in keep:
+            if a.job is None or a.job.version == self.job.version:
+                summary.ignore += 1
+                continue
+            if tasks_updated(self.job, a.job, name):
+                destructive.append(a)
+            else:
+                inplace.append(a)
+
+        # Should we create a deployment? Service jobs with an update strategy
+        # and pending destructive/new placements get one.
+        requires_deploy = (
+            tg.update is not None
+            and not self.batch
+            and self.job.type == "service"
+            and not self.job.stopped()
+            and (destructive or len(keep) + len(migrate) < desired or inplace)
+        )
+        if requires_deploy and self.deployment is None:
+            self.deployment = new_deployment(self.job)
+            self.results.deployment = self.deployment
+        if self.deployment is not None and tg.update is not None:
+            if name not in self.deployment.task_groups:
+                dstate = DeploymentState(
+                    auto_revert=tg.update.auto_revert,
+                    auto_promote=tg.update.auto_promote,
+                    desired_total=desired,
+                    desired_canaries=tg.update.canary,
+                    progress_deadline_s=tg.update.progress_deadline_s,
+                )
+                self.deployment.task_groups[name] = dstate
+            else:
+                dstate = self.deployment.task_groups[name]
+
+        # In-place updates pass straight through.
+        for a in inplace:
+            updated = a.copy()
+            updated.job = self.job
+            self.results.inplace_update.append(updated)
+            summary.in_place += 1
+
+        # Destructive updates are limited by max_parallel of healthy slack.
+        limit = self._update_limit(tg, dstate, len(destructive))
+        for a in destructive[:limit]:
+            req = PlacementRequest(
+                name=a.name,
+                task_group=tg,
+                previous_alloc=a,
+                min_job_version=self.job.version,
+            )
+            self.results.destructive_update.append((a, req))
+            summary.destructive += 1
+        for a in destructive[limit:]:
+            summary.ignore += 1
+
+        # Migrations: stop + replacement carrying the same name.
+        for a in migrate:
+            self.results.stop.append((a, ALLOC_MIGRATING, ""))
+            summary.migrate += 1
+            summary.place += 1  # queued accounting counts every placement
+            self.results.place.append(
+                PlacementRequest(
+                    name=a.name,
+                    task_group=tg,
+                    previous_alloc=a,
+                )
+            )
+
+        # Lost: mark lost (client status) + replacement.
+        for a in lost:
+            self.results.stop.append((a, ALLOC_LOST, ALLOC_CLIENT_STATUS_LOST))
+            summary.stop += 1
+            if not self.batch or a.client_status != ALLOC_CLIENT_STATUS_COMPLETE:
+                self.results.place.append(
+                    PlacementRequest(
+                        name=a.name,
+                        task_group=tg,
+                        previous_alloc=a,
+                        lost=True,
+                    )
+                )
+                summary.place += 1
+
+        # Reschedule now: replacement with penalty on previous node.
+        for a in resched_now:
+            self.results.place.append(
+                PlacementRequest(
+                    name=a.name,
+                    task_group=tg,
+                    previous_alloc=a,
+                    reschedule=True,
+                    penalty_node=a.node_id,
+                )
+            )
+            summary.place += 1
+
+        # Reschedule later: follow-up eval at the earliest eligible time.
+        if resched_later:
+            earliest = min(when for _, when in resched_later)
+            followup = self.eval.create_failed_followup_eval(0)
+            followup.wait_until_ns = earliest
+            followup.triggered_by = "alloc-failure"
+            self.results.followup_evals.append(followup)
+            for a, _ in resched_later:
+                self.results.attr_updates[a.id] = followup.id
+
+        # New placements to reach the desired count.
+        have = len(keep) + len(migrate) + len(resched_now) + len(completed)
+        have += sum(1 for _ in lost)  # lost replacements already queued
+        missing = max(0, desired - have)
+        for _ in range(missing):
+            idx = name_index.next()
+            self.results.place.append(
+                PlacementRequest(name=alloc_name(self.job_id, name, idx), task_group=tg)
+            )
+            summary.place += 1
+
+        if dstate is not None:
+            dstate.desired_total = desired
+
+        # Group is deployment-complete if no pending work remains.
+        complete = not (
+            destructive
+            or missing
+            or migrate
+            or lost
+            or resched_now
+            or resched_later
+        )
+        if dstate is not None and complete:
+            complete = (
+                dstate.desired_total <= dstate.healthy_allocs
+            )
+        return complete
+
+    def _update_limit(
+        self, tg: TaskGroup, dstate: Optional[DeploymentState], want: int
+    ) -> int:
+        """How many destructive updates may proceed this pass
+        (reference: reconcile.go computeLimit :666)."""
+        if tg.update is None or tg.update.max_parallel <= 0:
+            return want
+        limit = tg.update.max_parallel
+        if dstate is not None:
+            # Only as many as have proven healthy so far plus max_parallel,
+            # minus those already placed and unhealthy.
+            pending = dstate.placed_allocs - dstate.healthy_allocs
+            limit = max(0, tg.update.max_parallel - pending)
+        return min(want, limit)
+
+
+class _NameIndex:
+    """Bitmap-style name allocator (reference: reconcile_util.go
+    allocNameIndex)."""
+
+    def __init__(self, job_id: str, group: str, count: int, in_use: set[str]) -> None:
+        self.job_id = job_id
+        self.group = group
+        self.count = count
+        self.used_idx: set[int] = set()
+        for name in in_use:
+            idx = _index_of(name)
+            if idx >= 0:
+                self.used_idx.add(idx)
+        self._cursor = 0
+
+    def release(self, name: str) -> None:
+        idx = _index_of(name)
+        self.used_idx.discard(idx)
+
+    def next(self) -> int:
+        # lowest unused index first
+        i = 0
+        while True:
+            if i not in self.used_idx:
+                self.used_idx.add(i)
+                return i
+            i += 1
+
+
+def _index_of(name: str) -> int:
+    l, r = name.rfind("["), name.rfind("]")
+    if l == -1 or r == -1:
+        return -1
+    try:
+        return int(name[l + 1 : r])
+    except ValueError:
+        return -1

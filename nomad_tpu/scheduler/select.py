@@ -1,0 +1,37 @@
+"""Candidate limiting and final selection.
+
+Reference: scheduler/select.go — LimitIterator :5 (visit `limit` nodes,
+skipping up to 3 with negative scores), MaxScoreIterator :79.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from .rank import RankedNode
+
+MAX_SKIP = 3
+
+
+def limit_select(options: Iterator[RankedNode], limit: int) -> list[RankedNode]:
+    """Take `limit` candidates, passing over up to MAX_SKIP negative-scored
+    ones (they are kept as fallback if nothing better shows up)."""
+    out: list[RankedNode] = []
+    skipped: list[RankedNode] = []
+    for option in options:
+        if option.final_score < 0 and len(skipped) < MAX_SKIP:
+            skipped.append(option)
+            continue
+        out.append(option)
+        if len(out) >= limit:
+            return out
+    out.extend(skipped[: limit - len(out)])
+    return out
+
+
+def max_score_select(options: list[RankedNode]) -> Optional[RankedNode]:
+    best: Optional[RankedNode] = None
+    for option in options:
+        if best is None or option.final_score > best.final_score:
+            best = option
+    return best

@@ -1,0 +1,97 @@
+"""Spread-stanza scoring boost.
+
+Reference: scheduler/spread.go — SpreadIterator :15, evenSpreadScoreBoost
+:178. Targeted spreads score nodes by how far each attribute value is below
+its desired share; even spreads boost the least-used value.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator
+
+from .context import EvalContext
+from .feasible import resolve_target
+from .propertyset import PropertySet
+from .rank import SPREAD_SCORER, RankedNode
+
+
+class SpreadScorer:
+    def __init__(self, ctx: EvalContext, job, tg, metrics=None) -> None:
+        self.ctx = ctx
+        self.job = job
+        self.tg = tg
+        self.metrics = metrics
+        # spread stanzas: task group's take priority over job-level
+        self.spreads = list(tg.spreads) + [
+            s for s in job.spreads if s.attribute not in {t.attribute for t in tg.spreads}
+        ]
+        self.psets: dict[str, PropertySet] = {}
+        for s in self.spreads:
+            pset = PropertySet(ctx, job)
+            pset.set_target_attribute(s.attribute, tg.name)
+            self.psets[s.attribute] = pset
+        self.sum_weights = sum(abs(s.weight) for s in self.spreads) or 1
+        self.desired_count = tg.count
+
+    def boost_for(self, node) -> float:
+        if not self.spreads:
+            return 0.0
+        total = 0.0
+        for s in self.spreads:
+            pset = self.psets[s.attribute]
+            val, ok = resolve_target(node, s.attribute)
+            if not ok:
+                continue
+            counts = pset.used_counts()
+            if s.targets:
+                boost = self._target_boost(s, val, counts)
+            else:
+                boost = self._even_boost(val, counts)
+            total += boost * (s.weight / self.sum_weights)
+        return total
+
+    def _target_boost(self, s, val: str, counts: dict[str, int]) -> float:
+        """(desired − used)/desired for this value's target share
+        (reference: spread.go scoreBoost)."""
+        percent = 0
+        explicit = {t.value: t.percent for t in s.targets}
+        if val in explicit:
+            percent = explicit[val]
+        else:
+            remaining = 100 - sum(explicit.values())
+            # implicit targets share the remainder evenly over unseen values
+            others = {v for v in counts if v not in explicit} | {val}
+            percent = remaining // max(1, len(others))
+        desired = math.ceil(percent / 100.0 * self.desired_count)
+        if desired <= 0:
+            return -1.0
+        used = counts.get(val, 0)
+        return (desired - used) / desired
+
+    def _even_boost(self, val: str, counts: dict[str, int]) -> float:
+        """Boost least-used values (reference: spread.go:178)."""
+        if not counts:
+            return 0.0
+        used = counts.get(val, 0)
+        min_count = min(list(counts.values()) + [used])
+        max_count = max(list(counts.values()) + [used])
+        if max_count == min_count:
+            return 0.0
+        # below-average values get a positive boost, above-average negative
+        return (min_count - used) / max(1, max_count)
+
+
+def spread_rank(
+    ctx: EvalContext,
+    options: Iterator[RankedNode],
+    scorer: SpreadScorer,
+    metrics=None,
+) -> Iterator[RankedNode]:
+    for option in options:
+        boost = scorer.boost_for(option.node)
+        if boost != 0.0:
+            option.add_score(SPREAD_SCORER, boost)
+            if metrics is not None:
+                metrics.score_node(option.node.id, SPREAD_SCORER, boost)
+        yield option

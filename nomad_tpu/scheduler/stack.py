@@ -1,0 +1,220 @@
+"""Placement stacks: the composed feasibility → rank → select pipeline.
+
+Reference: scheduler/stack.go — GenericStack :43 (shuffled source, log₂(n)
+candidate limit :83-90), Select :117, SystemStack :183.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Iterable, Optional
+
+from ..structs import Constraint, Job, Node, TaskGroup
+from ..structs.structs import (
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+    JOB_TYPE_BATCH,
+)
+from .context import EvalContext
+from .feasible import (
+    ConstraintChecker,
+    DeviceChecker,
+    DistinctHostsChecker,
+    DriverChecker,
+    FeasibilityChecker,
+    HostVolumeChecker,
+    NetworkChecker,
+    feasibility_pipeline,
+)
+from .propertyset import PropertySet
+from .rank import (
+    RankedNode,
+    binpack_rank,
+    job_anti_affinity_rank,
+    node_affinity_rank,
+    node_resched_penalty_rank,
+    score_normalization,
+)
+from .select import limit_select, max_score_select
+from .spread import SpreadScorer, spread_rank
+
+
+def _tg_drivers(tg: TaskGroup) -> set[str]:
+    return {t.driver for t in tg.tasks}
+
+
+def _distinct_property_constraints(
+    constraints: list[Constraint],
+) -> list[Constraint]:
+    return [c for c in constraints if c.operand == CONSTRAINT_DISTINCT_PROPERTY]
+
+
+def _has_distinct_hosts(constraints: list[Constraint]) -> bool:
+    return any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in constraints)
+
+
+class _DistinctPropertyChecker(FeasibilityChecker):
+    def __init__(self, pset: PropertySet) -> None:
+        self.pset = pset
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        return self.pset.satisfies_distinct_property(node)
+
+
+class GenericStack:
+    """Service/batch placement stack (reference: stack.go:43)."""
+
+    def __init__(self, batch: bool, ctx: EvalContext) -> None:
+        self.batch = batch
+        self.ctx = ctx
+        self.nodes: list[Node] = []
+        self.limit = 2
+        self.job: Optional[Job] = None
+        # Per-eval caches: PropertySets scan all existing allocs once; the
+        # plan delta is merged per call (reference caches these on Context).
+        self._post_checkers: dict[str, list[FeasibilityChecker]] = {}
+        self._spread_scorers: dict[str, SpreadScorer] = {}
+
+    def set_nodes(self, nodes: list[Node]) -> None:
+        """Shuffle for scheduler-worker decorrelation and set the candidate
+        limit: log₂(n) for service (power-of-N-choices), 2 for batch
+        (reference: stack.go:71-90)."""
+        self.nodes = list(nodes)
+        random.shuffle(self.nodes)
+        n = len(self.nodes)
+        if self.batch:
+            self.limit = 2
+        else:
+            self.limit = max(2, int(math.ceil(math.log2(n)))) if n > 0 else 2
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        self.ctx.eligibility.set_job(job)
+        self._post_checkers.clear()
+        self._spread_scorers.clear()
+
+    def select(
+        self,
+        tg: TaskGroup,
+        penalty_nodes: Optional[set[str]] = None,
+        metrics=None,
+        selected_nodes: Optional[list[Node]] = None,
+    ) -> Optional[RankedNode]:
+        """Pick the best node for one instance of the task group."""
+        job = self.job
+        assert job is not None, "set_job must be called first"
+        source: Iterable[Node] = (
+            selected_nodes if selected_nodes is not None else self.nodes
+        )
+
+        job_checkers: list[FeasibilityChecker] = [
+            ConstraintChecker(self.ctx, job.constraints),
+        ]
+        all_constraints = list(tg.constraints)
+        for t in tg.tasks:
+            all_constraints.extend(t.constraints)
+        tg_checkers: list[FeasibilityChecker] = [
+            DriverChecker(self.ctx, _tg_drivers(tg)),
+            ConstraintChecker(self.ctx, all_constraints),
+            HostVolumeChecker(self.ctx, tg.volumes),
+            NetworkChecker(self.ctx, tg),
+            DeviceChecker(self.ctx, tg),
+        ]
+
+        feasible = feasibility_pipeline(
+            self.ctx, source, job_checkers, tg_checkers, tg.name, metrics
+        )
+
+        # Stateful per-plan checkers sit outside the class memoization.
+        post = self._post_checkers.get(tg.name)
+        if post is None:
+            post = []
+            if _has_distinct_hosts(job.constraints):
+                post.append(DistinctHostsChecker(self.ctx, job.id, tg.name, True))
+            elif _has_distinct_hosts(tg.constraints):
+                post.append(DistinctHostsChecker(self.ctx, job.id, tg.name, False))
+            for c in _distinct_property_constraints(job.constraints):
+                pset = PropertySet(self.ctx, job)
+                pset.set_job_constraint(c)
+                post.append(_DistinctPropertyChecker(pset))
+            for c in _distinct_property_constraints(tg.constraints):
+                pset = PropertySet(self.ctx, job)
+                pset.set_tg_constraint(c, tg.name)
+                post.append(_DistinctPropertyChecker(pset))
+            self._post_checkers[tg.name] = post
+        if post:
+            def _post_filter(nodes):
+                for node in nodes:
+                    ok = True
+                    for checker in post:
+                        good, reason = checker.feasible(node)
+                        if not good:
+                            if metrics is not None:
+                                metrics.filter_node(node, reason)
+                            ok = False
+                            break
+                    if ok:
+                        yield node
+
+            feasible = _post_filter(feasible)
+
+        options = binpack_rank(self.ctx, feasible, tg, metrics)
+        options = job_anti_affinity_rank(
+            self.ctx, options, job.id, tg.name, tg.count, metrics
+        )
+        if penalty_nodes:
+            options = node_resched_penalty_rank(options, penalty_nodes, metrics)
+        affinities = list(job.affinities) + list(tg.affinities)
+        for t in tg.tasks:
+            affinities.extend(t.affinities)
+        options = node_affinity_rank(self.ctx, options, affinities, metrics)
+        if tg.spreads or job.spreads:
+            scorer = self._spread_scorers.get(tg.name)
+            if scorer is None:
+                scorer = SpreadScorer(self.ctx, job, tg, metrics)
+                self._spread_scorers[tg.name] = scorer
+            options = spread_rank(self.ctx, options, scorer, metrics)
+        options = score_normalization(options, metrics)
+        shortlist = limit_select(options, self.limit)
+        return max_score_select(shortlist)
+
+
+class SystemStack:
+    """System/sysbatch stack: every feasible node, no shuffle/limit
+    (reference: stack.go:183)."""
+
+    def __init__(self, ctx: EvalContext) -> None:
+        self.ctx = ctx
+        self.nodes: list[Node] = []
+        self.job: Optional[Job] = None
+
+    def set_nodes(self, nodes: list[Node]) -> None:
+        self.nodes = list(nodes)
+
+    def set_job(self, job: Job) -> None:
+        self.job = job
+        self.ctx.eligibility.set_job(job)
+
+    def select(self, tg: TaskGroup, node: Node, metrics=None) -> Optional[RankedNode]:
+        """Fit one instance of tg on one specific node."""
+        job = self.job
+        assert job is not None
+        job_checkers = [ConstraintChecker(self.ctx, job.constraints)]
+        all_constraints = list(tg.constraints)
+        for t in tg.tasks:
+            all_constraints.extend(t.constraints)
+        tg_checkers = [
+            DriverChecker(self.ctx, _tg_drivers(tg)),
+            ConstraintChecker(self.ctx, all_constraints),
+            HostVolumeChecker(self.ctx, tg.volumes),
+            NetworkChecker(self.ctx, tg),
+            DeviceChecker(self.ctx, tg),
+        ]
+        feasible = feasibility_pipeline(
+            self.ctx, [node], job_checkers, tg_checkers, tg.name, metrics
+        )
+        options = binpack_rank(self.ctx, feasible, tg, metrics)
+        options = score_normalization(options, metrics)
+        got = list(options)
+        return got[0] if got else None

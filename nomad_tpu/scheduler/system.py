@@ -1,0 +1,173 @@
+"""System / sysbatch scheduler: place on every feasible node.
+
+Reference: scheduler/scheduler_system.go — Process :71, computeJobAllocs,
+computePlacements; uses diffSystemAllocs (util.go:230).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..structs import (
+    AllocMetric,
+    Allocation,
+    Evaluation,
+    generate_uuid,
+    now_ns,
+)
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_LOST,
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+)
+from .context import EvalContext, SchedulerConfig
+from .stack import SystemStack
+from .util import (
+    SchedulerRetryError,
+    ready_nodes_in_dcs,
+    retry_max,
+    tainted_nodes,
+    diff_system_allocs,
+)
+
+MAX_SYSTEM_SCHEDULE_ATTEMPTS = 5
+
+
+class SystemScheduler:
+    scheduler_type = "system"
+
+    def __init__(self, logger, state, planner, config: Optional[SchedulerConfig] = None):
+        self.logger = logger
+        self.state = state
+        self.planner = planner
+        self.config = config or SchedulerConfig()
+        self.sysbatch = self.scheduler_type == "sysbatch"
+        self.eval = None
+        self.plan = None
+        self.plan_result = None
+        self.failed_tg_allocs: dict[str, AllocMetric] = {}
+        self.queued_allocs: dict[str, int] = {}
+
+    def process(self, eval_obj: Evaluation) -> None:
+        self.eval = eval_obj
+        try:
+            retry_max(MAX_SYSTEM_SCHEDULE_ATTEMPTS, self._attempt, self._progress)
+        except SchedulerRetryError as e:
+            self._set_status(EVAL_STATUS_FAILED, str(e))
+            return
+        self._set_status(EVAL_STATUS_COMPLETE, "")
+
+    def _progress(self) -> bool:
+        result = self.plan_result
+        made = result is not None and not result.is_no_op()
+        if result is not None and result.refresh_index > 0:
+            self.state = self.planner.refresh_state(result.refresh_index)
+        return made
+
+    def _attempt(self) -> tuple[bool, object]:
+        eval_obj = self.eval
+        job = self.state.job_by_id(eval_obj.namespace, eval_obj.job_id)
+        self.plan = eval_obj.make_plan(job)
+        self.failed_tg_allocs = {}
+        self.plan_result = None
+        ctx = EvalContext(self.state, self.plan, self.logger, self.config)
+        stack = SystemStack(ctx)
+
+        allocs = self.state.allocs_by_job(eval_obj.namespace, eval_obj.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+
+        if job is None or job.stopped():
+            for a in allocs:
+                if not a.terminal_status():
+                    self.plan.append_stopped_alloc(a, "alloc not needed", "")
+            return self._finish()
+
+        nodes, dc_counts = ready_nodes_in_dcs(self.state, job.datacenters)
+        self._dc_counts = dc_counts
+        stack.set_nodes(nodes)
+        stack.set_job(job)
+
+        # terminal allocs per node/tg (sysbatch: completed stays completed)
+        terminal_by_node: dict[str, dict[str, Allocation]] = {}
+        for a in allocs:
+            if a.terminal_status():
+                terminal_by_node.setdefault(a.node_id, {})[a.task_group] = a
+
+        diff = diff_system_allocs(job, nodes, tainted, allocs, terminal_by_node)
+
+        for alloc, reason in diff.stop:
+            self.plan.append_stopped_alloc(alloc, reason, "")
+        for alloc in diff.lost:
+            self.plan.append_stopped_alloc(
+                alloc, "alloc is lost since its node is down", ALLOC_CLIENT_STATUS_LOST
+            )
+        for alloc, tg in diff.update:
+            self.plan.append_stopped_alloc(alloc, "alloc not needed due to job update", "")
+            diff.place.append((tg, self.state.node_by_id(alloc.node_id), None))
+
+        queued: dict[str, int] = {tg.name: 0 for tg in job.task_groups}
+        for tg, node, terminal in diff.place:
+            if node is None:
+                continue
+            if (
+                self.sysbatch
+                and terminal is not None
+                and terminal.client_status == ALLOC_CLIENT_STATUS_COMPLETE
+                and terminal.job is not None
+                and terminal.job.version == job.version
+            ):
+                continue  # already ran to completion on this node
+            metric = AllocMetric(nodes_available=dict(self._dc_counts))
+            start = now_ns()
+            option = stack.select(tg, node, metrics=metric)
+            metric.allocation_time_ns = now_ns() - start
+            if option is None:
+                existing = self.failed_tg_allocs.get(tg.name)
+                if existing is not None:
+                    existing.coalesced_failures += 1
+                else:
+                    self.failed_tg_allocs[tg.name] = metric
+                queued[tg.name] = queued.get(tg.name, 0) + 1
+                continue
+            alloc = Allocation(
+                id=generate_uuid(),
+                namespace=eval_obj.namespace,
+                eval_id=eval_obj.id,
+                name=f"{job.id}.{tg.name}[0]",
+                node_id=node.id,
+                node_name=node.name,
+                job_id=job.id,
+                job=job,
+                task_group=tg.name,
+                resources=option.alloc_resources,
+                metrics=metric,
+            )
+            self.plan.append_alloc(alloc, job)
+        self.queued_allocs = queued
+        eval_obj.queued_allocations = queued
+        return self._finish()
+
+    def _finish(self) -> tuple[bool, object]:
+        if self.plan.is_no_op():
+            return True, None
+        result, new_state = self.planner.submit_plan(self.plan)
+        self.plan_result = result
+        if new_state is not None:
+            self.state = new_state
+        full, _, _ = result.full_commit(self.plan)
+        if not full:
+            return False, None
+        return True, None
+
+    def _set_status(self, status: str, desc: str) -> None:
+        updated = self.eval.copy()
+        updated.status = status
+        updated.status_description = desc
+        updated.failed_tg_allocs = self.failed_tg_allocs
+        updated.queued_allocations = self.queued_allocs
+        self.planner.update_eval(updated)
+
+
+class SysBatchScheduler(SystemScheduler):
+    scheduler_type = "sysbatch"

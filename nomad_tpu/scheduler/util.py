@@ -1,0 +1,222 @@
+"""Scheduler helpers.
+
+Reference: scheduler/util.go — diffSystemAllocs :230, readyNodesInDCs :267,
+retryMax :305, progressMade :331, taintedNodes :340, shuffleNodes :366,
+tasksUpdated (in-place-update check) :993.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..structs import Allocation, Job, Node, TaskGroup
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_LOST,
+    NODE_STATUS_DOWN,
+)
+
+
+def ready_nodes_in_dcs(state, datacenters: list[str]) -> tuple[list[Node], dict[str, int]]:
+    """All ready nodes whose datacenter matches any of the job's DC globs.
+
+    Returns (nodes, per-DC available counts). Reference: util.go:267.
+    """
+    out: list[Node] = []
+    dc_counts: dict[str, int] = {}
+    for node in state.nodes():
+        if not node.ready():
+            continue
+        if not any(fnmatch.fnmatchcase(node.datacenter, dc) for dc in datacenters):
+            continue
+        out.append(node)
+        dc_counts[node.datacenter] = dc_counts.get(node.datacenter, 0) + 1
+    return out, dc_counts
+
+
+def tainted_nodes(state, allocs: list[Allocation]) -> dict[str, Node]:
+    """Nodes referenced by allocs that are down or draining (reference :340).
+    A node id mapping to None means the node no longer exists."""
+    out: dict[str, Optional[Node]] = {}
+    for alloc in allocs:
+        if alloc.node_id in out:
+            continue
+        node = state.node_by_id(alloc.node_id)
+        if node is None:
+            out[alloc.node_id] = None
+            continue
+        if node.status == NODE_STATUS_DOWN or node.drain:
+            out[alloc.node_id] = node
+    return out
+
+
+def shuffle_nodes(nodes: list[Node]) -> None:
+    random.shuffle(nodes)
+
+
+def retry_max(max_attempts: int, fn: Callable[[], tuple[bool, object]],
+              reset_fn: Optional[Callable[[], bool]] = None) -> object:
+    """Run fn until done, up to max_attempts, resetting the budget whenever
+    reset_fn reports progress (reference: util.go retryMax :305)."""
+    attempts = 0
+    while attempts < max_attempts:
+        done, result = fn()
+        if done:
+            return result
+        if reset_fn is not None and reset_fn():
+            attempts = 0
+            continue
+        attempts += 1
+    raise SchedulerRetryError(f"maximum attempts reached ({max_attempts})")
+
+
+class SchedulerRetryError(Exception):
+    pass
+
+
+def update_non_terminal_allocs_to_lost(
+    plan, tainted: dict[str, Optional[Node]], allocs: list[Allocation]
+) -> None:
+    """Mark non-terminal allocs on down nodes as lost (reference:
+    generic_sched.go:350 / util.go updateNonTerminalAllocsToLost)."""
+    for alloc in allocs:
+        node = tainted.get(alloc.node_id, "missing")
+        if node == "missing":
+            continue
+        if node is not None and node.status != NODE_STATUS_DOWN:
+            continue
+        if alloc.desired_status in ("stop", "evict") and alloc.client_status in (
+            "running",
+            "pending",
+        ):
+            plan.append_stopped_alloc(alloc, "alloc is lost since its node is down",
+                                      ALLOC_CLIENT_STATUS_LOST)
+
+
+def tasks_updated(job_a: Job, job_b: Job, tg_name: str) -> bool:
+    """Do two job versions differ such that the group's allocs must be
+    destructively replaced? (reference: util.go tasksUpdated :993).
+    In-place-safe changes: count, metadata-only, reschedule/restart policy.
+    """
+    a = job_a.lookup_task_group(tg_name)
+    b = job_b.lookup_task_group(tg_name)
+    if a is None or b is None:
+        return True
+    if len(a.tasks) != len(b.tasks):
+        return True
+    if [n.copy() for n in a.networks] != [n.copy() for n in b.networks]:
+        return True
+    if {k: v.copy() for k, v in a.volumes.items()} != {
+        k: v.copy() for k, v in b.volumes.items()
+    }:
+        return True
+    if a.ephemeral_disk.copy() != b.ephemeral_disk.copy():
+        return True
+    for ta in a.tasks:
+        tb = b.lookup_task(ta.name)
+        if tb is None:
+            return True
+        if (
+            ta.driver != tb.driver
+            or ta.user != tb.user
+            or ta.config != tb.config
+            or ta.env != tb.env
+            or ta.meta != tb.meta
+            or [str(c) for c in ta.constraints] != [str(c) for c in tb.constraints]
+            or [a_.copy() for a_ in ta.artifacts] != [b_.copy() for b_ in tb.artifacts]
+            or [t_.copy() for t_ in ta.templates] != [t_.copy() for t_ in tb.templates]
+            or ta.resources.cpu != tb.resources.cpu
+            or ta.resources.memory_mb != tb.resources.memory_mb
+            or [n.copy() for n in ta.resources.networks]
+            != [n.copy() for n in tb.resources.networks]
+            or [d.copy() for d in ta.resources.devices]
+            != [d.copy() for d in tb.resources.devices]
+            or [s.copy() for s in ta.services] != [s.copy() for s in tb.services]
+            or ta.kill_timeout_s != tb.kill_timeout_s
+            or (ta.lifecycle is None) != (tb.lifecycle is None)
+        ):
+            return True
+    # group-level constraints/affinities/spreads
+    if [str(c) for c in a.constraints] != [str(c) for c in b.constraints]:
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# System-scheduler diff
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DiffResult:
+    place: list = field(default_factory=list)  # (tg, node, existing-terminal alloc|None)
+    update: list = field(default_factory=list)  # (alloc, tg) destructive
+    ignore: list = field(default_factory=list)
+    stop: list = field(default_factory=list)  # (alloc, reason)
+    lost: list = field(default_factory=list)
+
+
+def diff_system_allocs(
+    job: Job,
+    nodes: list[Node],
+    tainted: dict[str, Optional[Node]],
+    allocs: list[Allocation],
+    terminal_by_node: dict[str, dict[str, Allocation]],
+) -> DiffResult:
+    """Per-node diff for system jobs: every eligible node should run every
+    group exactly once (reference: util.go diffSystemAllocs :230)."""
+    result = DiffResult()
+    eligible = {n.id: n for n in nodes}
+    by_node: dict[str, list[Allocation]] = {}
+    for a in allocs:
+        by_node.setdefault(a.node_id, []).append(a)
+
+    required = {tg.name: tg for tg in job.task_groups}
+
+    for node_id, node_allocs in by_node.items():
+        for alloc in node_allocs:
+            if alloc.terminal_status():
+                continue
+            tg = required.get(alloc.task_group)
+            if tg is None or job.stopped():
+                result.stop.append((alloc, "alloc not required"))
+                continue
+            node = tainted.get(alloc.node_id, "ok")
+            if node != "ok":
+                if node is None or node.status == NODE_STATUS_DOWN:
+                    result.lost.append(alloc)
+                elif node.drain and (
+                    not node.drain_strategy.ignore_system_jobs
+                ):
+                    result.stop.append((alloc, "node is draining"))
+                else:
+                    result.ignore.append(alloc)
+                continue
+            if node_id not in eligible:
+                result.stop.append((alloc, "node is ineligible"))
+                continue
+            if alloc.job is not None and alloc.job.version != job.version:
+                if tasks_updated(job, alloc.job, tg.name):
+                    result.update.append((alloc, tg))
+                else:
+                    result.ignore.append(alloc)
+            else:
+                result.ignore.append(alloc)
+
+    if not job.stopped():
+        for node_id, node in eligible.items():
+            live_groups = {
+                a.task_group
+                for a in by_node.get(node_id, [])
+                if not a.terminal_status()
+            }
+            for tg_name, tg in required.items():
+                if tg_name in live_groups:
+                    continue
+                terminal = terminal_by_node.get(node_id, {}).get(tg_name)
+                result.place.append((tg, node, terminal))
+    return result

@@ -20,6 +20,7 @@ the live store.
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Callable, Iterable, Optional
 
@@ -71,6 +72,13 @@ ALL_TABLES = (
     TABLE_ALLOCS,
     TABLE_DEPLOYMENTS,
 )
+
+# Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
+# table-granular COW regime so snapshots see consistent index views.
+IDX_ALLOCS_NODE = "_idx_allocs_node"
+IDX_ALLOCS_JOB = "_idx_allocs_job"
+IDX_ALLOCS_EVAL = "_idx_allocs_eval"
+INDEX_TABLES = (IDX_ALLOCS_NODE, IDX_ALLOCS_JOB, IDX_ALLOCS_EVAL)
 
 JOB_TRACKED_VERSIONS = 6
 
@@ -187,28 +195,24 @@ class _ReadMixin:
         return list(self._tables[TABLE_ALLOCS].values())
 
     def allocs_by_node(self, node_id: str) -> list[Allocation]:
-        return [a for a in self._tables[TABLE_ALLOCS].values() if a.node_id == node_id]
+        return list(self._tables[IDX_ALLOCS_NODE].get(node_id, {}).values())
 
     def allocs_by_node_terminal(
         self, node_id: str, terminal: bool
     ) -> list[Allocation]:
         return [
             a
-            for a in self._tables[TABLE_ALLOCS].values()
-            if a.node_id == node_id and a.terminal_status() == terminal
+            for a in self._tables[IDX_ALLOCS_NODE].get(node_id, {}).values()
+            if a.terminal_status() == terminal
         ]
 
-    def allocs_by_job(
-        self, namespace: str, job_id: str, anyCreateIndex: bool = True
-    ) -> list[Allocation]:
-        return [
-            a
-            for a in self._tables[TABLE_ALLOCS].values()
-            if a.namespace == namespace and a.job_id == job_id
-        ]
+    def allocs_by_job(self, namespace: str, job_id: str) -> list[Allocation]:
+        return list(
+            self._tables[IDX_ALLOCS_JOB].get((namespace, job_id), {}).values()
+        )
 
     def allocs_by_eval(self, eval_id: str) -> list[Allocation]:
-        return [a for a in self._tables[TABLE_ALLOCS].values() if a.eval_id == eval_id]
+        return list(self._tables[IDX_ALLOCS_EVAL].get(eval_id, {}).values())
 
     def allocs_by_deployment(self, deployment_id: str) -> list[Allocation]:
         return [
@@ -248,7 +252,7 @@ class StateSnapshotImpl(StateSnapshot, _ReadMixin):
 
 class StateStore(_ReadMixin):
     def __init__(self) -> None:
-        self._tables: dict[str, dict] = {t: {} for t in ALL_TABLES}
+        self._tables: dict[str, dict] = {t: {} for t in ALL_TABLES + INDEX_TABLES}
         self._indexes: dict[str, int] = {t: 0 for t in ALL_TABLES}
         self._latest_index = 0
         self._shared: set[str] = set()
@@ -261,7 +265,7 @@ class StateStore(_ReadMixin):
 
     def snapshot(self) -> StateSnapshotImpl:
         with self._lock:
-            self._shared.update(ALL_TABLES)
+            self._shared.update(ALL_TABLES + INDEX_TABLES)
             return StateSnapshotImpl(
                 dict(self._tables), dict(self._indexes), self._latest_index
             )
@@ -332,6 +336,48 @@ class StateStore(_ReadMixin):
         for fn in self._subscribers:
             fn(index, table, objs)
 
+    def _idx_put(self, table: str, key, alloc: Allocation) -> None:
+        t = self._wtable(table)
+        inner = t.get(key)
+        inner = dict(inner) if inner is not None else {}
+        inner[alloc.id] = alloc
+        t[key] = inner
+
+    def _idx_del(self, table: str, key, alloc_id: str) -> None:
+        t = self._wtable(table)
+        inner = t.get(key)
+        if inner and alloc_id in inner:
+            inner = dict(inner)
+            del inner[alloc_id]
+            if inner:
+                t[key] = inner
+            else:
+                del t[key]
+
+    def _put_alloc(self, alloc: Allocation, existing: Optional[Allocation]) -> None:
+        """Insert an alloc into the main table and every secondary index."""
+        self._wtable(TABLE_ALLOCS)[alloc.id] = alloc
+        if existing is not None:
+            if existing.node_id != alloc.node_id:
+                self._idx_del(IDX_ALLOCS_NODE, existing.node_id, alloc.id)
+            if (existing.namespace, existing.job_id) != (alloc.namespace, alloc.job_id):
+                self._idx_del(
+                    IDX_ALLOCS_JOB, (existing.namespace, existing.job_id), alloc.id
+                )
+            if existing.eval_id != alloc.eval_id:
+                self._idx_del(IDX_ALLOCS_EVAL, existing.eval_id, alloc.id)
+        self._idx_put(IDX_ALLOCS_NODE, alloc.node_id, alloc)
+        self._idx_put(IDX_ALLOCS_JOB, (alloc.namespace, alloc.job_id), alloc)
+        self._idx_put(IDX_ALLOCS_EVAL, alloc.eval_id, alloc)
+
+    def _del_alloc(self, alloc_id: str) -> None:
+        t = self._wtable(TABLE_ALLOCS)
+        alloc = t.pop(alloc_id, None)
+        if alloc is not None:
+            self._idx_del(IDX_ALLOCS_NODE, alloc.node_id, alloc_id)
+            self._idx_del(IDX_ALLOCS_JOB, (alloc.namespace, alloc.job_id), alloc_id)
+            self._idx_del(IDX_ALLOCS_EVAL, alloc.eval_id, alloc_id)
+
     # -- nodes ---------------------------------------------------------
 
     def upsert_node(self, index: int, node: Node) -> None:
@@ -383,7 +429,7 @@ class StateStore(_ReadMixin):
             if existing is None:
                 raise KeyError(f"node {node_id} not found")
             node = existing.copy()
-            node.drain_strategy = drain
+            node.drain_strategy = drain.copy() if drain is not None else None
             if drain is not None:
                 node.scheduling_eligibility = NODE_SCHEDULING_INELIGIBLE
             elif mark_eligible:
@@ -532,9 +578,8 @@ class StateStore(_ReadMixin):
             t = self._wtable(TABLE_EVALS)
             for eid in eval_ids:
                 t.pop(eid, None)
-            at = self._wtable(TABLE_ALLOCS)
             for aid in alloc_ids:
-                at.pop(aid, None)
+                self._del_alloc(aid)
             self._stamp(index, TABLE_EVALS, TABLE_ALLOCS)
 
     # -- allocs --------------------------------------------------------
@@ -578,7 +623,7 @@ class StateStore(_ReadMixin):
                 alloc.job = self._tables[TABLE_JOBS].get(
                     (alloc.namespace, alloc.job_id)
                 )
-            t[alloc.id] = alloc
+            self._put_alloc(alloc, existing)
             stored.append(alloc)
             jobs_touched.add((alloc.namespace, alloc.job_id))
         self._reconcile_summaries_txn(index, jobs_touched)
@@ -609,10 +654,11 @@ class StateStore(_ReadMixin):
                 if update.deployment_status is not None:
                     alloc.deployment_status = update.deployment_status.copy()
                 if update.network_status is not None:
-                    alloc.network_status = update.network_status
+                    alloc.network_status = dataclasses.replace(update.network_status)
+                    alloc.network_status.dns = dict(update.network_status.dns)
                 alloc.modify_index = index
                 alloc.modify_time = now_ns()
-                t[alloc.id] = alloc
+                self._put_alloc(alloc, existing)
                 stored.append(alloc)
                 jobs_touched.add((alloc.namespace, alloc.job_id))
             self._reconcile_summaries_txn(index, jobs_touched)
@@ -641,7 +687,7 @@ class StateStore(_ReadMixin):
                 if transition.force_reschedule is not None:
                     dt.force_reschedule = transition.force_reschedule
                 alloc.modify_index = index
-                t[alloc_id] = alloc
+                self._put_alloc(alloc, existing)
             if evals:
                 self._upsert_evals_txn(index, evals)
                 self._stamp(index, TABLE_EVALS)
@@ -689,7 +735,7 @@ class StateStore(_ReadMixin):
                     )
                 merged.modify_index = index
                 merged.modify_time = now_ns()
-                t[merged.id] = merged
+                self._put_alloc(merged, existing)
                 committed.append(merged)
             committed.extend(self._upsert_allocs_txn(index, allocs_to_upsert))
             tables = [TABLE_ALLOCS, TABLE_JOB_SUMMARIES]
@@ -751,7 +797,6 @@ class StateStore(_ReadMixin):
         if not jobs_touched:
             return
         st = self._wtable(TABLE_JOB_SUMMARIES)
-        at = self._tables[TABLE_ALLOCS]
         for ns, job_id in jobs_touched:
             job = self._tables[TABLE_JOBS].get((ns, job_id))
             summary = st.get((ns, job_id))
@@ -772,9 +817,7 @@ class StateStore(_ReadMixin):
                 }
                 for g in groups
             }
-            for a in at.values():
-                if a.namespace != ns or a.job_id != job_id:
-                    continue
+            for a in self.allocs_by_job(ns, job_id):
                 c = counts.setdefault(
                     a.task_group,
                     {
@@ -835,11 +878,8 @@ class StateStore(_ReadMixin):
         if job.stop:
             new_status = JOB_STATUS_DEAD
         else:
-            has_live_alloc = False
-            for a in self._tables[TABLE_ALLOCS].values():
-                if a.namespace == namespace and a.job_id == job_id and not a.terminal_status():
-                    has_live_alloc = True
-                    break
+            job_allocs = self.allocs_by_job(namespace, job_id)
+            has_live_alloc = any(not a.terminal_status() for a in job_allocs)
             has_open_eval = False
             for e in self._tables[TABLE_EVALS].values():
                 if (
@@ -861,11 +901,7 @@ class StateStore(_ReadMixin):
                         JOB_STATUS_PENDING if job.status == JOB_STATUS_PENDING else JOB_STATUS_DEAD
                     )
                 else:
-                    any_alloc = any(
-                        a.namespace == namespace and a.job_id == job_id
-                        for a in self._tables[TABLE_ALLOCS].values()
-                    )
-                    new_status = JOB_STATUS_DEAD if any_alloc else job.status
+                    new_status = JOB_STATUS_DEAD if job_allocs else job.status
         if new_status != job.status:
             jt2 = self._wtable(TABLE_JOBS)
             j = job.copy()

@@ -41,6 +41,9 @@ def compute_node_class(node: Node) -> str:
             put("devattr", k, dev.attributes[k])
     rv = node.reserved
     put("reserved", rv.cpu, rv.memory_mb, rv.disk_mb)
+    for name in sorted(node.host_volumes):
+        hv = node.host_volumes[name]
+        put("hostvol", name, hv.read_only)
     for k in sorted(node.attributes):
         if not _escaped(k):
             put("attr", k, node.attributes[k])

@@ -278,7 +278,6 @@ class NodeDeviceResource:
 
     def matches(self, ask: RequestedDevice) -> bool:
         parts = ask.id_tuple()
-        mine = (self.type, self.vendor, self.name)
         if len(parts) == 1:
             return parts[0] == self.type
         if len(parts) == 2:
@@ -980,6 +979,13 @@ class NodeEvent:
 
 
 @dataclass
+class HostVolumeConfig:
+    name: str = ""
+    path: str = ""
+    read_only: bool = False
+
+
+@dataclass
 class Node:
     """A fingerprinted machine (reference: structs.go Node :1812)."""
 
@@ -991,6 +997,7 @@ class Node:
     meta: dict[str, str] = field(default_factory=dict)
     resources: NodeResources = field(default_factory=NodeResources)
     reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
+    host_volumes: dict[str, HostVolumeConfig] = field(default_factory=dict)
     links: dict[str, str] = field(default_factory=dict)
     drivers: dict[str, "DriverInfo"] = field(default_factory=dict)
     status: str = NODE_STATUS_INIT
@@ -1015,6 +1022,7 @@ class Node:
             meta=dict(self.meta),
             resources=self.resources.copy(),
             reserved=self.reserved.copy(),
+            host_volumes={k: dataclasses.replace(v) for k, v in self.host_volumes.items()},
             links=dict(self.links),
             drivers={k: v.copy() for k, v in self.drivers.items()},
             status=self.status,
@@ -1022,7 +1030,7 @@ class Node:
             scheduling_eligibility=self.scheduling_eligibility,
             drain_strategy=self.drain_strategy.copy() if self.drain_strategy else None,
             computed_class=self.computed_class,
-            events=[dataclasses.replace(e) for e in self.events],
+            events=[dataclasses.replace(e, details=dict(e.details)) for e in self.events],
             http_addr=self.http_addr,
             secret_id=self.secret_id,
             status_updated_at=self.status_updated_at,
@@ -1324,7 +1332,11 @@ class Allocation:
             reschedule_tracker=(
                 self.reschedule_tracker.copy() if self.reschedule_tracker else None
             ),
-            network_status=self.network_status,
+            network_status=(
+                dataclasses.replace(self.network_status, dns=dict(self.network_status.dns))
+                if self.network_status
+                else None
+            ),
             followup_eval_id=self.followup_eval_id,
             previous_allocation=self.previous_allocation,
             next_allocation=self.next_allocation,

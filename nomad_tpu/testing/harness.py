@@ -1,0 +1,82 @@
+"""Scheduler test harness.
+
+Reference: scheduler/testing.go — Harness :43 wraps a real state store with a
+fake Planner whose SubmitPlan applies the plan directly (:83), bypassing the
+plan queue/applier; RejectPlan :18 forces the refresh path. This is the
+primary TDD loop for both the host oracle and the TPU solver (differential
+testing runs both against identical states).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Optional
+
+from ..state import StateStore
+from ..structs import Evaluation, Plan, PlanResult
+from ..scheduler import new_scheduler
+
+logger = logging.getLogger("nomad_tpu.harness")
+
+
+class Harness:
+    def __init__(self, state: Optional[StateStore] = None) -> None:
+        self.state = state or StateStore()
+        self._index = itertools.count(1000)
+        self.plans: list[Plan] = []
+        self.evals: list[Evaluation] = []  # evals created by the scheduler
+        self.updates: list[Evaluation] = []  # eval status updates
+        self.optimize_plan = False
+
+    # -- Planner interface --------------------------------------------
+
+    def next_index(self) -> int:
+        return next(self._index)
+
+    def submit_plan(self, plan: Plan):
+        self.plans.append(plan)
+        index = self.next_index()
+        result = PlanResult(
+            node_update=plan.node_update,
+            node_allocation=plan.node_allocation,
+            node_preemptions=plan.node_preemptions,
+            deployment=plan.deployment,
+            deployment_updates=plan.deployment_updates,
+            alloc_index=index,
+        )
+        self.state.upsert_plan_results(index, result)
+        return result, None
+
+    def update_eval(self, eval_obj: Evaluation) -> None:
+        self.updates.append(eval_obj)
+
+    def create_eval(self, eval_obj: Evaluation) -> None:
+        self.evals.append(eval_obj)
+        self.state.upsert_evals(self.next_index(), [eval_obj])
+
+    def refresh_state(self, min_index: int):
+        return self.state.snapshot()
+
+    # -- driving ------------------------------------------------------
+
+    def snapshot(self):
+        return self.state.snapshot()
+
+    def process(self, scheduler_name: str, eval_obj: Evaluation, config=None):
+        """Run one scheduler pass for the eval against current state."""
+        sched = new_scheduler(
+            scheduler_name, logger, self.state.snapshot(), self, config
+        )
+        sched.process(eval_obj)
+        return sched
+
+
+class RejectPlanHarness(Harness):
+    """Planner that rejects every plan, forcing state refresh + retry
+    (reference: scheduler/testing.go RejectPlan :18)."""
+
+    def submit_plan(self, plan: Plan):
+        self.plans.append(plan)
+        result = PlanResult(refresh_index=self.state.latest_index())
+        return result, self.state.snapshot()

@@ -1,0 +1,119 @@
+"""SystemScheduler tests (reference analog: scheduler/scheduler_system_test.go)."""
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import DrainStrategy, NODE_STATUS_DOWN
+from nomad_tpu.testing import Harness
+
+
+def test_system_job_on_every_node():
+    h = Harness()
+    nodes = [mock.node() for _ in range(5)]
+    for n in nodes:
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", mock.eval_for_job(job))
+    allocs = h.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 5
+    assert {a.node_id for a in allocs} == {n.id for n in nodes}
+
+
+def test_system_new_node_gets_alloc():
+    h = Harness()
+    for _ in range(2):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", mock.eval_for_job(job))
+    assert len(h.state.allocs_by_job(job.namespace, job.id)) == 2
+
+    new_node = mock.node()
+    h.state.upsert_node(h.next_index(), new_node)
+    h.process("system", mock.eval_for_job(job, triggered_by="node-update", node_id=new_node.id))
+    allocs = [a for a in h.state.allocs_by_job(job.namespace, job.id) if not a.terminal_status()]
+    assert len(allocs) == 3
+    assert any(a.node_id == new_node.id for a in allocs)
+
+
+def test_system_drain_stops():
+    h = Harness()
+    n1, n2 = mock.node(), mock.node()
+    h.state.upsert_node(h.next_index(), n1)
+    h.state.upsert_node(h.next_index(), n2)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", mock.eval_for_job(job))
+    h.state.update_node_drain(h.next_index(), n1.id, DrainStrategy(deadline_s=60))
+    h.process("system", mock.eval_for_job(job, triggered_by="node-drain"))
+    live = [a for a in h.state.allocs_by_job(job.namespace, job.id) if not a.terminal_status()]
+    assert len(live) == 1
+    assert live[0].node_id == n2.id
+
+
+def test_system_node_down_lost():
+    h = Harness()
+    n1, n2 = mock.node(), mock.node()
+    h.state.upsert_node(h.next_index(), n1)
+    h.state.upsert_node(h.next_index(), n2)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", mock.eval_for_job(job))
+    h.state.update_node_status(h.next_index(), n1.id, NODE_STATUS_DOWN)
+    h.process("system", mock.eval_for_job(job, triggered_by="node-update", node_id=n1.id))
+    allocs = h.state.allocs_by_job(job.namespace, job.id)
+    lost = [a for a in allocs if a.client_status == "lost"]
+    assert len(lost) == 1 and lost[0].node_id == n1.id
+    live = [a for a in allocs if not a.terminal_status()]
+    assert len(live) == 1 and live[0].node_id == n2.id
+
+
+def test_sysbatch_completed_not_rerun():
+    h = Harness()
+    n = mock.node()
+    h.state.upsert_node(h.next_index(), n)
+    job = mock.sysbatch_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("sysbatch", mock.eval_for_job(job))
+    allocs = h.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 1
+    done = allocs[0].copy()
+    done.client_status = "complete"
+    h.state.update_allocs_from_client(h.next_index(), [done])
+    h.process("sysbatch", mock.eval_for_job(job))
+    assert len(h.state.allocs_by_job(job.namespace, job.id)) == 1
+
+
+def test_system_job_deregister():
+    h = Harness()
+    for _ in range(3):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", mock.eval_for_job(job))
+    stopped = h.state.job_by_id(job.namespace, job.id).copy()
+    stopped.stop = True
+    h.state.upsert_job(h.next_index(), stopped)
+    h.process("system", mock.eval_for_job(stopped, triggered_by="job-deregister"))
+    live = [a for a in h.state.allocs_by_job(job.namespace, job.id) if not a.terminal_status()]
+    assert live == []
+
+
+def test_system_infeasible_node_skipped():
+    h = Harness()
+    good = mock.node()
+    bad = mock.node()
+    del bad.drivers["mock"]
+    bad.attributes.pop("driver.mock", None)
+    from nomad_tpu.structs.node_class import compute_node_class
+    bad.computed_class = compute_node_class(bad)
+    h.state.upsert_node(h.next_index(), good)
+    h.state.upsert_node(h.next_index(), bad)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process("system", mock.eval_for_job(job))
+    allocs = h.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 1
+    assert allocs[0].node_id == good.id
+    # failed placement recorded for the bad node
+    assert h.updates[-1].queued_allocations.get("web") == 1
