@@ -28,8 +28,27 @@ BUILTIN_SCHEDULERS = {
 }
 
 
+def _tpu_factories():
+    # Imported lazily so the control plane never pays the jax import unless
+    # the TPU backend is actually selected.
+    from .tpu import TPUBatchScheduler, TPUGenericScheduler
+
+    return {
+        "service": TPUGenericScheduler,
+        "batch": TPUBatchScheduler,
+        # system/sysbatch place per node, not per count — the host path is
+        # already O(nodes); they keep the host implementation under the TPU
+        # backend (same decision as the reference's per-type scheduler split).
+        "system": SystemScheduler,
+        "sysbatch": SysBatchScheduler,
+    }
+
+
 def new_scheduler(name: str, logger, state, planner, config=None):
-    factory = BUILTIN_SCHEDULERS.get(name)
+    if config is not None and getattr(config, "backend", "host") == "tpu":
+        factory = _tpu_factories().get(name)
+    else:
+        factory = BUILTIN_SCHEDULERS.get(name)
     if factory is None:
         raise ValueError(f"unknown scheduler '{name}'")
     return factory(logger, state, planner, config)

@@ -447,15 +447,17 @@ class _NameIndex:
     def release(self, name: str) -> None:
         idx = _index_of(name)
         self.used_idx.discard(idx)
+        if idx >= 0 and idx < self._cursor:
+            self._cursor = idx
 
     def next(self) -> int:
-        # lowest unused index first
-        i = 0
-        while True:
-            if i not in self.used_idx:
-                self.used_idx.add(i)
-                return i
+        # lowest unused index first; cursor never rescans claimed ground
+        i = self._cursor
+        while i in self.used_idx:
             i += 1
+        self.used_idx.add(i)
+        self._cursor = i + 1
+        return i
 
 
 def _index_of(name: str) -> int:

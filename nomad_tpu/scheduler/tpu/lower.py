@@ -1,0 +1,378 @@
+"""Lowering orchestrator state to dense tensors for the TPU placement solver.
+
+This is the bridge between the string-typed, ragged control-plane world
+(reference: scheduler/feasible.go's per-node predicate walk) and the dense
+[group x node] tensor world the solver kernels operate on
+(SURVEY.md §7 hard part 2: attribute vocabulary interning + fixed
+constraint-kernel set; regex/version predicates stay host-side as
+per-distinct-value mask precomputation).
+
+Key trick: every hard constraint is a predicate over ONE node attribute.
+We intern each referenced attribute's values into integer codes (V distinct
+values << N nodes), evaluate the predicate once per distinct value with the
+exact host-oracle implementation (`check_constraint` — including regex and
+version operands), and broadcast to all N nodes with a single vectorized
+gather. Feasibility semantics are therefore *identical* to the host oracle
+by construction, not by reimplementation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ...structs import Job, Node, TaskGroup
+from ...structs.structs import (
+    CONSTRAINT_DISTINCT_HOSTS,
+    CONSTRAINT_DISTINCT_PROPERTY,
+)
+from ..context import EvalContext
+from ..feasible import check_constraint, resolve_target
+
+NUM_RES = 3  # cpu MHz, memory MB, disk MB — must match structs.Resources.vector
+BIG_UNITS = np.int32(1 << 30)
+
+
+@dataclass
+class NodeTable:
+    """Interned view of all ready nodes in a snapshot."""
+
+    nodes: list[Node]
+    index_of: dict[str, int]
+    cap: np.ndarray  # [N, NUM_RES] int64 — available (total - reserved)
+    used: np.ndarray  # [N, NUM_RES] int64 — live alloc utilization
+    datacenters: np.ndarray  # [N] int32 codes
+    dc_values: list[str]
+    # lazily built per-attribute interning: ltarget -> (codes [N] int32, values)
+    _attr_cache: dict[str, tuple[np.ndarray, list[str], np.ndarray]] = field(
+        default_factory=dict
+    )
+    # lazily built driver health masks: driver -> bool [N]
+    _driver_cache: dict[str, np.ndarray] = field(default_factory=dict)
+    # static-port occupancy masks, lazy: port -> bool [N]
+    _port_masks: Optional[dict[int, np.ndarray]] = None
+    # snapshot accessor for live allocs per node (set by build_node_table)
+    _allocs_by_node: Optional[object] = None
+
+    @property
+    def n(self) -> int:
+        return len(self.nodes)
+
+    def attr_codes(self, target: str) -> tuple[np.ndarray, list[str], np.ndarray]:
+        """(codes [N] i32, distinct values, exists-mask [N] bool) for a
+        constraint ltarget, interning on first use."""
+        cached = self._attr_cache.get(target)
+        if cached is not None:
+            return cached
+        values: list[str] = []
+        code_of: dict[str, int] = {}
+        codes = np.zeros(self.n, dtype=np.int32)
+        exists = np.zeros(self.n, dtype=bool)
+        for i, node in enumerate(self.nodes):
+            val, found = resolve_target(node, target)
+            exists[i] = found
+            key = val if found else "\x00missing"
+            code = code_of.get(key)
+            if code is None:
+                code = len(values)
+                code_of[key] = code
+                values.append(val if found else "")
+            codes[i] = code
+        out = (codes, values, exists)
+        self._attr_cache[target] = out
+        return out
+
+    def used_port_mask(self, port: int) -> np.ndarray:
+        """bool [N]: does any live alloc (or node reservation) already hold
+        this static port on the node?"""
+        if self._port_masks is None:
+            self._port_masks = {}
+        m = self._port_masks.get(port)
+        if m is not None:
+            return m
+        m = np.zeros(self.n, dtype=bool)
+        for i, node in enumerate(self.nodes):
+            if port in node.reserved.reserved_ports:
+                m[i] = True
+                continue
+            for alloc in self._allocs_by_node(node.id):
+                if alloc.resources is None:
+                    continue
+                nets = list(alloc.resources.shared_networks)
+                for tr in alloc.resources.tasks.values():
+                    nets.extend(tr.networks)
+                if any(
+                    p.value == port
+                    for net in nets
+                    for p in list(net.reserved_ports) + list(net.dynamic_ports)
+                ):
+                    m[i] = True
+                    break
+        self._port_masks[port] = m
+        return m
+
+    def driver_mask(self, driver: str) -> np.ndarray:
+        m = self._driver_cache.get(driver)
+        if m is not None:
+            return m
+        m = np.zeros(self.n, dtype=bool)
+        for i, node in enumerate(self.nodes):
+            info = node.drivers.get(driver)
+            if info is not None:
+                m[i] = info.detected and info.healthy
+            else:
+                m[i] = node.attributes.get(f"driver.{driver}", "") in ("1", "true")
+        self._driver_cache[driver] = m
+        return m
+
+
+def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
+    """Lower ready nodes + live utilization to tensors.
+
+    allocs_by_node: callable node_id -> live allocs (snapshot accessor).
+    """
+    n = len(nodes)
+    cap = np.zeros((n, NUM_RES), dtype=np.int64)
+    used = np.zeros((n, NUM_RES), dtype=np.int64)
+    dc_values: list[str] = []
+    dc_code: dict[str, int] = {}
+    dcs = np.zeros(n, dtype=np.int32)
+    index_of: dict[str, int] = {}
+    for i, node in enumerate(nodes):
+        index_of[node.id] = i
+        avail = node.available_resources()
+        cap[i] = (avail.cpu, avail.memory_mb, avail.disk_mb)
+        code = dc_code.get(node.datacenter)
+        if code is None:
+            code = len(dc_values)
+            dc_code[node.datacenter] = code
+            dc_values.append(node.datacenter)
+        dcs[i] = code
+        for alloc in allocs_by_node(node.id):
+            r = alloc.comparable_resources()
+            used[i] += (r.cpu, r.memory_mb, r.disk_mb)
+    table = NodeTable(
+        nodes=nodes,
+        index_of=index_of,
+        cap=cap,
+        used=used,
+        datacenters=dcs,
+        dc_values=dc_values,
+    )
+    table._allocs_by_node = allocs_by_node
+    return table
+
+
+@dataclass
+class LoweredGroup:
+    """One task group's asks, lowered. All instances of a group are
+    interchangeable — the solver places `count` of them at once."""
+
+    key: tuple  # (eval_id, tg_name)
+    job: Job
+    tg: TaskGroup
+    count: int
+    ask: np.ndarray  # [NUM_RES] int64
+    feasible: np.ndarray  # [N] bool
+    bias: np.ndarray  # [N] f32 — affinity/spread score offsets
+    units_cap: np.ndarray  # [N] int32 — distinct_hosts/property caps
+    priority: int
+    names: list[str] = field(default_factory=list)  # instance names to assign
+    requests: list = field(default_factory=list)  # original PlacementRequests
+    restricted: bool = False  # spread-value-restricted sub-group (retryable)
+
+
+def lower_group(
+    ctx: EvalContext,
+    table: NodeTable,
+    job: Job,
+    tg: TaskGroup,
+    requests: list,
+    eval_id: str,
+) -> LoweredGroup:
+    """Build the group's feasibility mask, score bias, and unit caps."""
+    n = table.n
+    feas = np.ones(n, dtype=bool)
+
+    # Datacenter membership (the GenericStack's node source filter).
+    import fnmatch
+
+    dc_ok = np.zeros(len(table.dc_values), dtype=bool)
+    for vi, dc in enumerate(table.dc_values):
+        dc_ok[vi] = any(fnmatch.fnmatchcase(dc, pat) for pat in job.datacenters)
+    feas &= dc_ok[table.datacenters]
+
+    # Drivers.
+    for task in tg.tasks:
+        feas &= table.driver_mask(task.driver)
+
+    # Constraints: job + group + task level, via per-distinct-value masks.
+    constraints = list(job.constraints) + list(tg.constraints)
+    for task in tg.tasks:
+        constraints.extend(task.constraints)
+    units_cap = np.full(n, BIG_UNITS, dtype=np.int64)
+    for c in constraints:
+        if c.operand == CONSTRAINT_DISTINCT_HOSTS:
+            units_cap = np.minimum(units_cap, 1)
+            # exclude nodes already carrying this job's allocs
+            feas &= _job_free_mask(ctx, table, job.id)
+            continue
+        if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
+            cap_per_value = int(c.rtarget) if c.rtarget else 1
+            codes, values, exists = table.attr_codes(c.ltarget)
+            counts = _property_counts(ctx, table, job, c.ltarget)
+            remaining = np.maximum(
+                0, cap_per_value - counts
+            )  # per distinct value
+            units_cap = np.minimum(units_cap, remaining[codes])
+            feas &= exists
+            continue
+        codes, values, exists = table.attr_codes(c.ltarget)
+        rval, r_found = c.rtarget, True  # rtargets are literals for node feas
+        value_ok = np.zeros(len(values), dtype=bool)
+        for vi, val in enumerate(values):
+            value_ok[vi] = check_constraint(
+                ctx, c.operand, val, rval, True, r_found
+            )
+        mask = value_ok[codes]
+        # Attributes that didn't resolve fail every operand except is_not_set.
+        if c.operand == "is_not_set":
+            mask = mask | ~exists
+        else:
+            mask = mask & exists
+        feas &= mask
+
+    # Network: static-port / bandwidth screens stay host-side but cheap —
+    # mbits capacity folds into feasibility; a static-port ask caps the
+    # group at one instance per node and excludes nodes already holding
+    # the port (dynamic port selection still happens at plan build).
+    net_asks = list(tg.networks) + [
+        a for t in tg.tasks for a in t.resources.networks
+    ]
+    total_mbits = sum(a.mbits for a in net_asks)
+    if total_mbits > 0:
+        net_ok = np.array(
+            [
+                max((nw.mbits for nw in node.resources.networks), default=0)
+                >= total_mbits
+                for node in table.nodes
+            ],
+            dtype=bool,
+        )
+        feas &= net_ok
+    static_ports = [p.value for a in net_asks for p in a.reserved_ports if p.value]
+    if static_ports:
+        units_cap = np.minimum(units_cap, 1)
+        for port in static_ports:
+            feas &= ~table.used_port_mask(port)
+
+    # Devices.
+    dev_asks = [d for t in tg.tasks for d in t.resources.devices]
+    if dev_asks:
+        dev_ok = np.ones(n, dtype=bool)
+        for i, node in enumerate(table.nodes):
+            for ask in dev_asks:
+                if not any(
+                    d.matches(ask)
+                    and sum(1 for inst in d.instances if inst.healthy) >= ask.count
+                    for d in node.resources.devices
+                ):
+                    dev_ok[i] = False
+                    break
+        feas &= dev_ok
+
+    # Score bias: affinities (normalized like the host oracle) + static
+    # spread boosts; the solver adds this to the binpack score for ordering.
+    bias = np.zeros(n, dtype=np.float32)
+    affinities = list(job.affinities) + list(tg.affinities)
+    for task in tg.tasks:
+        affinities.extend(task.affinities)
+    if affinities:
+        total_weight = sum(abs(a.weight) for a in affinities) or 1
+        for a in affinities:
+            codes, values, exists = table.attr_codes(a.ltarget)
+            value_ok = np.zeros(len(values), dtype=bool)
+            for vi, val in enumerate(values):
+                value_ok[vi] = check_constraint(ctx, a.operand, val, a.rtarget, True, True)
+            match = value_ok[codes] & exists
+            bias += np.where(match, a.weight / total_weight, 0.0).astype(np.float32)
+
+    spreads = list(tg.spreads) + [
+        s for s in job.spreads if s.attribute not in {t.attribute for t in tg.spreads}
+    ]
+    if spreads:
+        sum_w = sum(abs(s.weight) for s in spreads) or 1
+        for s in spreads:
+            codes, values, exists = table.attr_codes(s.attribute)
+            counts = _property_counts(ctx, table, job, s.attribute, tg.name)
+            desired = _spread_desired(s, values, tg.count)
+            # boost = (desired - used)/desired per value (targeted spread);
+            # implicit even spread when no explicit targets.
+            with np.errstate(divide="ignore", invalid="ignore"):
+                boost = np.where(
+                    desired > 0, (desired - counts) / np.maximum(desired, 1), -1.0
+                )
+            bias += (boost[codes] * (s.weight / sum_w)).astype(np.float32)
+
+    ask = np.array(tg.combined_resources().vector(), dtype=np.int64)
+    return LoweredGroup(
+        key=(eval_id, tg.name),
+        job=job,
+        tg=tg,
+        count=len(requests),
+        ask=ask,
+        feasible=feas,
+        bias=bias,
+        units_cap=np.minimum(units_cap, BIG_UNITS).astype(np.int64),
+        priority=job.priority,
+        names=[r.name for r in requests],
+        requests=list(requests),
+    )
+
+
+def _job_free_mask(ctx: EvalContext, table: NodeTable, job_id: str) -> np.ndarray:
+    mask = np.ones(table.n, dtype=bool)
+    for i, node in enumerate(table.nodes):
+        for alloc in ctx.proposed_allocs(node.id):
+            if alloc.job_id == job_id:
+                mask[i] = False
+                break
+    return mask
+
+
+def _property_counts(
+    ctx: EvalContext, table: NodeTable, job: Job, attribute: str, tg_name: str = ""
+) -> np.ndarray:
+    """Existing alloc count per distinct attribute value (host-side; the
+    solver handles the within-batch delta via units caps)."""
+    codes, values, _ = table.attr_codes(attribute)
+    counts = np.zeros(len(values), dtype=np.int64)
+    stopped: set[str] = set()
+    if ctx.plan is not None:
+        for allocs_ in ctx.plan.node_update.values():
+            stopped.update(a.id for a in allocs_)
+    for alloc in ctx.state.allocs_by_job(job.namespace, job.id):
+        if alloc.terminal_status() or alloc.id in stopped:
+            continue
+        if tg_name and alloc.task_group != tg_name:
+            continue
+        idx = table.index_of.get(alloc.node_id)
+        if idx is not None:
+            counts[codes[idx]] += 1
+    return counts
+
+
+def _spread_desired(spread, values: list[str], count: int) -> np.ndarray:
+    import math
+
+    explicit = {t.value: t.percent for t in spread.targets}
+    desired = np.zeros(len(values), dtype=np.float64)
+    remaining = 100 - sum(explicit.values())
+    implicit = [v for v in values if v not in explicit]
+    implicit_pct = remaining / max(1, len(implicit))
+    for vi, val in enumerate(values):
+        pct = explicit.get(val, implicit_pct)
+        desired[vi] = math.ceil(pct / 100.0 * count)
+    return desired

@@ -1,0 +1,247 @@
+"""TPU-backed schedulers, registered through the standard factory seam.
+
+Reference seam: scheduler/scheduler.go BuiltinSchedulers :23 — the TPU
+backend plugs in as an alternate implementation of the same
+Scheduler/State/Planner contract, so Raft, plan application, and rejection
+semantics stay untouched (BASELINE.json north star).
+
+Two operating modes:
+  * TPUGenericScheduler / TPUBatchScheduler — drop-in single-eval
+    processing (the worker calls process(eval) exactly like the host
+    scheduler); the solver batch is just that one eval's groups.
+  * solve_eval_batch() — the high-throughput path: many pending evals
+    solved in ONE kernel invocation, emitting one plan per eval. The
+    server's TPU worker (and bench.py) drive this.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ...structs import Evaluation, Plan
+from ...structs.structs import (
+    EVAL_STATUS_COMPLETE,
+    EVAL_STATUS_FAILED,
+)
+from ..context import SchedulerConfig
+from ..generic import BLOCKED_EVAL_FAILED_PLACEMENTS, GenericScheduler
+from ..reconcile import AllocReconciler
+from ..util import (
+    SchedulerRetryError,
+    retry_max,
+    tainted_nodes,
+    update_non_terminal_allocs_to_lost,
+)
+from .solver import BatchSolver, GroupAsk
+
+logger = logging.getLogger("nomad_tpu.scheduler.tpu")
+
+
+class TPUGenericScheduler(GenericScheduler):
+    """GenericScheduler with the placement loop replaced by a batched
+    tensor solve. Reconciliation, stops, in-place updates, blocked-eval and
+    retry semantics are inherited unchanged."""
+
+    scheduler_type = "service"
+    solve_fn = None  # overridable: e.g. a mesh-sharded solver
+
+    def _compute_job_allocs(self, job) -> bool:
+        eval_obj = self.eval
+        allocs = self.state.allocs_by_job(eval_obj.namespace, eval_obj.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+        update_non_terminal_allocs_to_lost(self.plan, tainted, allocs)
+
+        deployment = None
+        if job is not None:
+            deployment = self.state.latest_deployment_by_job(
+                eval_obj.namespace, eval_obj.job_id
+            )
+            if deployment is not None and not deployment.active():
+                deployment = None
+
+        reconciler = AllocReconciler(
+            job if job is not None else self._tombstone(eval_obj),
+            eval_obj.job_id,
+            allocs,
+            tainted,
+            eval_obj,
+            deployment=deployment,
+            batch=self.batch,
+        )
+        results = reconciler.compute()
+        self.followup_evals = results.followup_evals
+        if results.deployment is not None:
+            self.plan.deployment = results.deployment
+        self.plan.deployment_updates = results.deployment_updates
+
+        for alloc, desc, client_status in results.stop:
+            self.plan.append_stopped_alloc(alloc, desc, client_status)
+        for updated in results.inplace_update:
+            self.plan.append_alloc(updated, updated.job)
+        for alloc_id, eval_id in results.attr_updates.items():
+            existing = self.state.alloc_by_id(alloc_id)
+            if existing is not None:
+                annotated = existing.copy()
+                annotated.followup_eval_id = eval_id
+                self.plan.append_alloc(annotated, annotated.job)
+
+        place_requests = []
+        for old, req in results.destructive_update:
+            self.plan.append_stopped_alloc(
+                old, "alloc not needed due to job update", ""
+            )
+            place_requests.append(req)
+        place_requests.extend(results.place)
+
+        if job is None or job.stopped():
+            return True
+
+        queued = {
+            tg: s.place + s.destructive
+            for tg, s in results.desired_tg_updates.items()
+        }
+
+        active_deployment = self.state.latest_deployment_by_job(job.namespace, job.id)
+        if active_deployment is not None and (
+            not active_deployment.active()
+            or active_deployment.job_version != job.version
+        ):
+            active_deployment = None
+
+        # --- the TPU departure: one batched solve instead of the loop ---
+        by_group: dict[str, list] = {}
+        for req in place_requests:
+            by_group.setdefault(req.task_group.name, []).append(req)
+        solver = BatchSolver(self.state, self.config, solve_fn=self.solve_fn)
+        asks = [
+            GroupAsk(eval_obj, job, tg_name, reqs, plan=self.plan)
+            for tg_name, reqs in by_group.items()
+        ]
+        outcome = solver.solve(asks)
+
+        for alloc in outcome.placements.get(eval_obj.id, []):
+            tg = job.lookup_task_group(alloc.task_group)
+            if self.plan.deployment is not None:
+                if tg is not None and tg.update is not None:
+                    alloc.deployment_id = self.plan.deployment.id
+                    dstate = self.plan.deployment.task_groups.get(alloc.task_group)
+                    if dstate is not None:
+                        dstate.placed_allocs += 1
+            elif job.type == "service" and active_deployment is not None:
+                alloc.deployment_id = active_deployment.id
+            self.plan.append_fresh_alloc(alloc, job)
+            queued[alloc.task_group] = max(0, queued.get(alloc.task_group, 0) - 1)
+
+        self.failed_tg_allocs = outcome.failures.get(eval_obj.id, {})
+        self.queued_allocs = queued
+        self.eval.queued_allocations = queued
+        return True
+
+    @staticmethod
+    def _tombstone(eval_obj):
+        from ...structs import Job
+
+        j = Job(id=eval_obj.job_id, namespace=eval_obj.namespace, stop=True)
+        j.task_groups = []
+        return j
+
+
+class TPUBatchScheduler(TPUGenericScheduler):
+    scheduler_type = "batch"
+
+
+def solve_eval_batch(
+    state,
+    planner,
+    evals: list[Evaluation],
+    config: Optional[SchedulerConfig] = None,
+    solve_fn=None,
+) -> dict[str, Plan]:
+    """High-throughput path: reconcile every pending eval, solve ALL their
+    placements in one kernel invocation, and emit one plan per eval.
+
+    Per-job serialization is the caller's duty (the eval broker already
+    guarantees one in-flight eval per job)."""
+    config = config or SchedulerConfig()
+    plans: dict[str, Plan] = {}
+    asks: list[GroupAsk] = []
+    deployments: dict[str, object] = {}
+    for ev in evals:
+        job = state.job_by_id(ev.namespace, ev.job_id)
+        plan = ev.make_plan(job)
+        plans[ev.id] = plan
+        allocs = state.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(state, allocs)
+        update_non_terminal_allocs_to_lost(plan, tainted, allocs)
+        if job is None or job.stopped():
+            for a in allocs:
+                if not a.terminal_status():
+                    plan.append_stopped_alloc(a, "alloc not needed", "")
+            continue
+        deployment = state.latest_deployment_by_job(ev.namespace, ev.job_id)
+        if deployment is not None and not deployment.active():
+            deployment = None
+        reconciler = AllocReconciler(
+            job,
+            ev.job_id,
+            allocs,
+            tainted,
+            ev,
+            deployment=deployment,
+            batch=(ev.type == "batch"),
+        )
+        results = reconciler.compute()
+        for fe in results.followup_evals:
+            planner.create_eval(fe)
+        if results.deployment is not None:
+            plan.deployment = results.deployment
+            deployments[ev.id] = results.deployment
+        plan.deployment_updates = results.deployment_updates
+        for alloc, desc, client_status in results.stop:
+            plan.append_stopped_alloc(alloc, desc, client_status)
+        for updated in results.inplace_update:
+            plan.append_alloc(updated, updated.job)
+        for alloc_id, follow_id in results.attr_updates.items():
+            existing = state.alloc_by_id(alloc_id)
+            if existing is not None:
+                annotated = existing.copy()
+                annotated.followup_eval_id = follow_id
+                plan.append_alloc(annotated, annotated.job)
+        place_requests = []
+        for old, req in results.destructive_update:
+            plan.append_stopped_alloc(old, "alloc not needed due to job update", "")
+            place_requests.append(req)
+        place_requests.extend(results.place)
+        by_group: dict[str, list] = {}
+        for req in place_requests:
+            by_group.setdefault(req.task_group.name, []).append(req)
+        for tg_name, reqs in by_group.items():
+            asks.append(GroupAsk(ev, job, tg_name, reqs, plan=plan))
+
+    solver = BatchSolver(state, config, solve_fn=solve_fn)
+    outcome = solver.solve(asks)
+    for ev in evals:
+        plan = plans[ev.id]
+        job = state.job_by_id(ev.namespace, ev.job_id)
+        deployment = plan.deployment or (
+            state.latest_deployment_by_job(ev.namespace, ev.job_id)
+            if job is not None
+            else None
+        )
+        if deployment is not None and job is not None and (
+            not getattr(deployment, "active", lambda: False)()
+            or deployment.job_version != job.version
+        ):
+            deployment = None
+        for alloc in outcome.placements.get(ev.id, []):
+            if deployment is not None and job is not None and job.type == "service":
+                tg = job.lookup_task_group(alloc.task_group)
+                if tg is not None and tg.update is not None:
+                    alloc.deployment_id = deployment.id
+                    dstate = deployment.task_groups.get(alloc.task_group)
+                    if dstate is not None and deployment is plan.deployment:
+                        dstate.placed_allocs += 1
+            plan.append_fresh_alloc(alloc, job)
+        ev.failed_tg_allocs = outcome.failures.get(ev.id, {})
+    return plans

@@ -1,0 +1,384 @@
+"""The batched TPU placement solver.
+
+Orchestration (reference analog: the per-eval loop in
+scheduler/generic_sched.go computePlacements :472, batched here across all
+pending evaluations — SURVEY.md north star):
+
+  1. host: reconcile each eval (unchanged AllocReconciler) → placement asks
+  2. host: lower nodes + groups to tensors (lower.py)
+  3. device: solve_placement kernel — score + waterfill every group
+  4. host: read back [G, N] assignment counts, pick ports (NetworkIndex),
+     mint Allocations, split into per-eval Plans, and *verify* every node
+     with the exact host-oracle AllocsFit — any overflow is repaired by
+     dropping that node's placements back to the failed list.
+
+The plans then feed the standard plan-queue/applier path unchanged; partial
+rejection and RefreshIndex semantics are untouched.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ...structs import (
+    AllocMetric,
+    AllocatedResources,
+    AllocatedTaskResources,
+    Allocation,
+    Evaluation,
+    Job,
+    NetworkIndex,
+    Plan,
+    generate_uuid,
+    now_ns,
+)
+from ..context import EvalContext, SchedulerConfig
+from ..reconcile import PlacementRequest
+from ..util import ready_nodes_in_dcs
+from .lower import LoweredGroup, build_node_table, lower_group
+from .kernels import pad_g, pad_n, solve_placement
+
+logger = logging.getLogger("nomad_tpu.scheduler.tpu")
+
+
+@dataclass
+class GroupAsk:
+    eval_obj: Evaluation
+    job: Job
+    tg_name: str
+    requests: list[PlacementRequest]
+    # The eval's plan-so-far (stops/updates appended by the reconciler pass):
+    # distinct_hosts / distinct_property / capacity must see vacated slots.
+    plan: Optional[Plan] = None
+
+
+@dataclass
+class SolveOutcome:
+    # eval_id -> plan additions
+    placements: dict[str, list[Allocation]] = field(default_factory=dict)
+    # eval_id -> {tg_name: AllocMetric} for failed asks
+    failures: dict[str, dict[str, AllocMetric]] = field(default_factory=dict)
+    groups: int = 0
+    solve_ns: int = 0
+
+
+class BatchSolver:
+    """Solves placement for a batch of evaluations against one snapshot."""
+
+    def __init__(self, state, config: Optional[SchedulerConfig] = None,
+                 solve_fn=None) -> None:
+        self.state = state
+        self.config = config or SchedulerConfig()
+        self.ctx = EvalContext(state, None, logger, self.config)
+        self.solve_fn = solve_fn or solve_placement
+        # Port-accounting index per node, shared across the whole batch so
+        # placements in this solve see each other's port reservations.
+        self._net_cache: dict[str, NetworkIndex] = {}
+
+    def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
+        out = SolveOutcome()
+        if not asks:
+            return out
+        # Priority order: higher-priority jobs consume capacity first
+        # (mirrors the eval broker's priority dequeue).
+        asks = sorted(asks, key=lambda a: -a.job.priority)
+
+        # One node universe per batch. Union of the jobs' datacenters.
+        all_nodes = {}
+        for ask in asks:
+            nodes, _ = ready_nodes_in_dcs(self.state, ask.job.datacenters)
+            for node in nodes:
+                all_nodes[node.id] = node
+        nodes = list(all_nodes.values())
+        if not nodes:
+            for ask in asks:
+                self._fail_all(out, ask, {})
+            return out
+
+        # Capacity freed by this batch's plans (stops/destructive updates)
+        # is usable: plan application re-verifies, so optimistic batching
+        # treats all batch stops as vacated (reference: the host oracle's
+        # ProposedAllocs does the same per plan, context.go:120).
+        stopped_ids: set[str] = set()
+        for ask in asks:
+            if ask.plan is not None:
+                for allocs_ in ask.plan.node_update.values():
+                    stopped_ids.update(a.id for a in allocs_)
+
+        def live_allocs(nid: str):
+            return [
+                a
+                for a in self.state.allocs_by_node_terminal(nid, False)
+                if a.id not in stopped_ids
+            ]
+
+        table = build_node_table(nodes, live_allocs)
+
+        groups: list[LoweredGroup] = []
+        base_of: dict[int, LoweredGroup] = {}  # group idx -> unrestricted base
+        for ask in asks:
+            tg = ask.job.lookup_task_group(ask.tg_name)
+            if tg is None or not ask.requests:
+                continue
+            self.ctx.plan = ask.plan  # plan-aware distinct/property masks
+            grp = lower_group(
+                self.ctx, table, ask.job, tg, ask.requests, ask.eval_obj.id
+            )
+            for sub in self._split_for_spread(table, ask.job, tg, grp):
+                base_of[len(groups)] = grp
+                groups.append(sub)
+            self.ctx.plan = None
+        if not groups:
+            return out
+        out.groups = len(groups)
+
+        n = table.n
+        used = np.clip(table.used, 0, 2**31 - 1).astype(np.int32)
+        t0 = now_ns()
+        assign, used_out = self._run_kernel(table, groups, used)
+        leftovers = self._materialize(out, table, groups, assign)
+
+        # Fallback pass: spread is a soft preference — requests a
+        # value-restricted sub-group could not place retry against the
+        # unrestricted base feasibility with updated utilization.
+        retry: list[LoweredGroup] = []
+        for gi, reqs in leftovers.items():
+            base = base_of[gi]
+            if reqs and groups[gi].restricted:
+                import dataclasses
+
+                retry.append(
+                    dataclasses.replace(
+                        base,
+                        count=len(reqs),
+                        names=[r.name for r in reqs],
+                        requests=reqs,
+                        restricted=False,
+                    )
+                )
+                # un-record the failure; _materialize re-adds if still stuck
+                out.failures.get(groups[gi].key[0], {}).pop(
+                    groups[gi].tg.name, None
+                )
+        if retry:
+            assign2, _ = self._run_kernel(table, retry, np.asarray(used_out)[:n])
+            self._materialize(out, table, retry, assign2)
+        out.solve_ns = now_ns() - t0
+        return out
+
+    def _run_kernel(self, table, groups: list[LoweredGroup], used_n: np.ndarray):
+        n, g = table.n, len(groups)
+        np_, gp = pad_n(n), pad_g(g)
+        cap = np.zeros((np_, 3), dtype=np.int32)
+        used = np.zeros((np_, 3), dtype=np.int32)
+        cap[:n] = np.clip(table.cap, 0, 2**31 - 1)
+        used[:n] = used_n[:n]
+        asks_arr = np.zeros((gp, 3), dtype=np.int32)
+        counts = np.zeros(gp, dtype=np.int32)
+        feas = np.zeros((gp, np_), dtype=bool)
+        bias = np.zeros((gp, np_), dtype=np.float32)
+        ucap = np.zeros((gp, np_), dtype=np.int32)
+        for i, grp in enumerate(groups):
+            asks_arr[i] = grp.ask
+            counts[i] = grp.count
+            feas[i, :n] = grp.feasible
+            bias[i, :n] = grp.bias
+            ucap[i, :n] = np.clip(grp.units_cap, 0, 2**31 - 1)
+        assign, used_out = self.solve_fn(
+            cap, used, asks_arr, counts, feas, bias, ucap
+        )
+        return np.asarray(assign), used_out
+
+    # ------------------------------------------------------------------
+
+    def _split_for_spread(
+        self, table, job: Job, tg, grp: LoweredGroup
+    ) -> list[LoweredGroup]:
+        """Spread stanzas become per-value sub-groups with quota counts.
+
+        The waterfill scan is greedy per group, so a within-batch spread
+        can't be expressed as a static score bias — instead the group is
+        split: one sub-group per attribute value, count = that value's
+        remaining desired share, feasibility ANDed with value membership.
+        Leftover instances become an unrestricted remainder sub-group.
+        (Multiple spread stanzas: the highest-weight one drives the split;
+        the rest stay score bias.)
+        """
+        import dataclasses
+
+        from .lower import _property_counts, _spread_desired
+
+        spreads = list(tg.spreads) + [
+            s
+            for s in job.spreads
+            if s.attribute not in {t.attribute for t in tg.spreads}
+        ]
+        if not spreads:
+            return [grp]
+        s = max(spreads, key=lambda x: x.weight)
+        codes, values, exists = table.attr_codes(s.attribute)
+        counts_v = _property_counts(self.ctx, table, job, s.attribute, tg.name)
+        desired = _spread_desired(s, values, tg.count)
+        quotas = np.maximum(0, desired - counts_v).astype(np.int64)
+        reqs = list(grp.requests)
+        out: list[LoweredGroup] = []
+        order = np.argsort(-(quotas / np.maximum(desired, 1)))
+        for vi in order:
+            if not reqs:
+                break
+            take = min(int(quotas[vi]), len(reqs))
+            if take <= 0:
+                continue
+            sub_reqs, reqs = reqs[:take], reqs[take:]
+            out.append(
+                dataclasses.replace(
+                    grp,
+                    count=take,
+                    feasible=grp.feasible & (codes == vi) & exists,
+                    names=[r.name for r in sub_reqs],
+                    requests=sub_reqs,
+                    restricted=True,
+                )
+            )
+        if reqs:
+            out.append(
+                dataclasses.replace(
+                    grp,
+                    count=len(reqs),
+                    names=[r.name for r in reqs],
+                    requests=reqs,
+                )
+            )
+        return out
+
+    def _materialize(
+        self,
+        out: SolveOutcome,
+        table,
+        groups: list[LoweredGroup],
+        assign: np.ndarray,
+    ) -> dict[int, list]:
+        """Turn [G, N] counts into Allocations; verify + repair per node.
+
+        Returns leftover (unplaced) requests per group index. Host-side
+        exact capacity verification replays the solver's placements with
+        integer math and drops overflow (the kernel is integer too, so this
+        only fires when two passes race the same capacity)."""
+        n = table.n
+        if not hasattr(self, "_free"):
+            self._free = table.cap - table.used  # [N, 3] int64
+        free = self._free
+        leftovers: dict[int, list] = {}
+        for gi, grp in enumerate(groups):
+            eval_id = grp.key[0]
+            placements = out.placements.setdefault(eval_id, [])
+            req_iter = iter(grp.requests)
+            unplaced: list = []
+            node_indices = np.nonzero(assign[gi, :n])[0]
+            for ni in node_indices:
+                node = table.nodes[ni]
+                take = int(assign[gi, ni])
+                for _ in range(take):
+                    req = next(req_iter, None)
+                    if req is None:
+                        break
+                    if np.any(free[ni] < grp.ask):
+                        unplaced.append(req)  # repair: out of exact capacity
+                        continue
+                    alloc = self._build_alloc(table, grp, node, req)
+                    if alloc is None:
+                        unplaced.append(req)  # port assignment failed
+                        continue
+                    free[ni] -= grp.ask
+                    placements.append(alloc)
+            unplaced.extend(req_iter)  # instances the kernel never placed
+            if unplaced:
+                leftovers[gi] = unplaced
+                metrics = out.failures.setdefault(eval_id, {})
+                existing = metrics.get(grp.tg.name)
+                if existing is None:
+                    metric = AllocMetric(nodes_evaluated=n)
+                    metric.nodes_filtered = n - int(np.sum(grp.feasible))
+                    metric.coalesced_failures = len(unplaced) - 1
+                    metrics[grp.tg.name] = metric
+                else:
+                    existing.coalesced_failures += len(unplaced)
+        return leftovers
+
+    def _build_alloc(
+        self, table, grp: LoweredGroup, node, req: PlacementRequest
+    ) -> Optional[Allocation]:
+        tg = grp.tg
+        net_idx = self._net_cache.get(node.id)
+        if net_idx is None:
+            net_idx = NetworkIndex()
+            net_idx.set_node(node)
+            net_idx.add_allocs(self.state.allocs_by_node_terminal(node.id, False))
+            self._net_cache[node.id] = net_idx
+
+        task_resources: dict[str, AllocatedTaskResources] = {}
+        for task in tg.tasks:
+            tr = AllocatedTaskResources(
+                cpu=task.resources.cpu, memory_mb=task.resources.memory_mb
+            )
+            for ask in task.resources.networks:
+                offer = net_idx.assign_network(ask)
+                if offer is None:
+                    return None
+                net_idx.add_reserved(offer)
+                tr.networks.append(offer)
+            task_resources[task.name] = tr
+        shared_networks = []
+        for ask in tg.networks:
+            offer = net_idx.assign_network(ask)
+            if offer is None:
+                return None
+            net_idx.add_reserved(offer)
+            shared_networks.append(offer)
+
+        alloc = Allocation(
+            id=generate_uuid(),
+            namespace=grp.job.namespace,
+            eval_id=grp.key[0],
+            name=req.name,
+            node_id=node.id,
+            node_name=node.name,
+            job_id=grp.job.id,
+            job=grp.job,
+            task_group=tg.name,
+            resources=AllocatedResources(
+                tasks=task_resources,
+                shared_disk_mb=tg.ephemeral_disk.size_mb,
+                shared_networks=shared_networks,
+            ),
+            metrics=AllocMetric(nodes_evaluated=table.n),
+        )
+        prev = req.previous_alloc
+        if prev is not None:
+            alloc.previous_allocation = prev.id
+            if req.reschedule:
+                from ...structs.structs import RescheduleEvent, RescheduleTracker
+
+                tracker = (
+                    prev.reschedule_tracker.copy()
+                    if prev.reschedule_tracker
+                    else RescheduleTracker()
+                )
+                tracker.events.append(
+                    RescheduleEvent(
+                        reschedule_time_ns=now_ns(),
+                        prev_alloc_id=prev.id,
+                        prev_node_id=prev.node_id,
+                    )
+                )
+                alloc.reschedule_tracker = tracker
+        return alloc
+
+    def _fail_all(self, out: SolveOutcome, ask: GroupAsk, dc_counts) -> None:
+        metric = AllocMetric(nodes_available=dict(dc_counts))
+        metric.coalesced_failures = max(0, len(ask.requests) - 1)
+        out.failures.setdefault(ask.eval_obj.id, {})[ask.tg_name] = metric

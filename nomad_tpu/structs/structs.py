@@ -136,7 +136,7 @@ RES_DISK = 2
 NUM_CORE_RESOURCES = 3
 
 
-@dataclass
+@dataclass(slots=True)
 class Port:
     label: str = ""
     value: int = 0
@@ -144,7 +144,7 @@ class Port:
     host_network: str = "default"
 
 
-@dataclass
+@dataclass(slots=True)
 class NetworkResource:
     """A network ask/offer (reference: structs.go NetworkResource :2441)."""
 
@@ -176,7 +176,7 @@ class NetworkResource:
         return out
 
 
-@dataclass
+@dataclass(slots=True)
 class RequestedDevice:
     """A device ask (reference: structs.go RequestedDevice :3035)."""
 
@@ -198,7 +198,7 @@ class RequestedDevice:
         return tuple(self.name.split("/"))
 
 
-@dataclass
+@dataclass(slots=True)
 class Resources:
     """A task's resource ask, flattened to the solver's core vector.
 
@@ -247,14 +247,14 @@ class Resources:
             raise ValueError("resources: memory must be >= 0")
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeDeviceInstance:
     id: str = ""
     healthy: bool = True
     locality: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeDeviceResource:
     """A device group present on a node (reference: structs.go NodeDeviceResource :3230)."""
 
@@ -287,7 +287,7 @@ class NodeDeviceResource:
         return False
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeResources:
     """What a node offers (reference: structs.go NodeResources :2797)."""
 
@@ -312,7 +312,7 @@ class NodeResources:
         return [float(self.cpu), float(self.memory_mb), float(self.disk_mb)]
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeReservedResources:
     """Resources the node holds back from scheduling (reference :2977)."""
 
@@ -338,7 +338,7 @@ class NodeReservedResources:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Constraint:
     """Hard placement restriction (reference: structs.go Constraint :8262)."""
 
@@ -365,7 +365,7 @@ class Constraint:
                 raise ValueError(f"constraint: {self.operand} requires rtarget")
 
 
-@dataclass
+@dataclass(slots=True)
 class Affinity:
     """Soft placement preference with weight in [-100, 100] (reference :8382)."""
 
@@ -384,13 +384,13 @@ class Affinity:
             raise ValueError("affinity: weight must be within [-100, 100]")
 
 
-@dataclass
+@dataclass(slots=True)
 class SpreadTarget:
     value: str = ""
     percent: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class Spread:
     """Spread allocs across attribute values (reference: structs.go Spread :8468)."""
 
@@ -420,7 +420,7 @@ class Spread:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class RestartPolicy:
     """Client-side restart policy (reference: structs.go RestartPolicy :4602)."""
 
@@ -433,7 +433,7 @@ class RestartPolicy:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class ReschedulePolicy:
     """Server-side reschedule policy (reference: structs.go ReschedulePolicy :4672)."""
 
@@ -451,7 +451,7 @@ class ReschedulePolicy:
         return self.unlimited or (self.attempts > 0 and self.interval_s > 0)
 
 
-@dataclass
+@dataclass(slots=True)
 class UpdateStrategy:
     """Rolling-update / deployment strategy (reference: structs.go :4369)."""
 
@@ -475,7 +475,7 @@ class UpdateStrategy:
         return self.canary > 0 and not self.auto_promote
 
 
-@dataclass
+@dataclass(slots=True)
 class MigrateStrategy:
     """Drain migration rate limits (reference: structs.go MigrateStrategy :4527)."""
 
@@ -488,7 +488,7 @@ class MigrateStrategy:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class EphemeralDisk:
     sticky: bool = False
     size_mb: int = 300
@@ -498,7 +498,7 @@ class EphemeralDisk:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class PeriodicConfig:
     """Cron-style launch config (reference: structs.go PeriodicConfig :4862)."""
 
@@ -512,7 +512,7 @@ class PeriodicConfig:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class ParameterizedJobConfig:
     """Dispatch-job config (reference: structs.go ParameterizedJobConfig :5095)."""
 
@@ -528,7 +528,7 @@ class ParameterizedJobConfig:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class VolumeRequest:
     """Group-level volume ask (reference: structs.go VolumeRequest :7162)."""
 
@@ -544,7 +544,7 @@ class VolumeRequest:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class Service:
     """Service registration (reference: structs.go Service :7582)."""
 
@@ -566,7 +566,7 @@ class Service:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class LogConfig:
     max_files: int = 10
     max_file_size_mb: int = 10
@@ -575,7 +575,7 @@ class LogConfig:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskArtifact:
     getter_source: str = ""
     getter_options: dict[str, str] = field(default_factory=dict)
@@ -591,7 +591,7 @@ class TaskArtifact:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class Template:
     source_path: str = ""
     dest_path: str = ""
@@ -605,7 +605,7 @@ class Template:
         return dataclasses.replace(self)
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskLifecycleConfig:
     hook: str = ""  # prestart | poststart | poststop
     sidecar: bool = False
@@ -619,7 +619,7 @@ class TaskLifecycleConfig:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Task:
     """A unit of work executed by a driver (reference: structs.go Task :6652)."""
 
@@ -690,7 +690,7 @@ class Task:
         return self.lifecycle is None
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskGroup:
     """A co-scheduled set of tasks (reference: structs.go TaskGroup :5923)."""
 
@@ -772,7 +772,7 @@ class TaskGroup:
             raise ValueError(f"group {self.name}: only one task may be leader")
 
 
-@dataclass
+@dataclass(slots=True)
 class Job:
     """The user-submitted unit of intent (reference: structs.go Job :3958)."""
 
@@ -953,7 +953,7 @@ class Job:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class DrainStrategy:
     """Node drain spec (reference: structs.go DrainStrategy :1710)."""
 
@@ -970,7 +970,7 @@ class DrainStrategy:
         ) or self.deadline_s < 0
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeEvent:
     message: str = ""
     subsystem: str = "Cluster"
@@ -978,14 +978,14 @@ class NodeEvent:
     timestamp_ns: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class HostVolumeConfig:
     name: str = ""
     path: str = ""
     read_only: bool = False
 
 
-@dataclass
+@dataclass(slots=True)
 class Node:
     """A fingerprinted machine (reference: structs.go Node :1812)."""
 
@@ -1067,7 +1067,7 @@ class Node:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class DriverInfo:
     attributes: dict[str, str] = field(default_factory=dict)
     detected: bool = False
@@ -1090,7 +1090,7 @@ class DriverInfo:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocMetric:
     """Placement decision metadata (reference: structs.go AllocMetric :9826)."""
 
@@ -1149,7 +1149,7 @@ class AllocMetric:
         self.scores[f"{node_id}.{scorer}"] = score
 
 
-@dataclass
+@dataclass(slots=True)
 class RescheduleEvent:
     reschedule_time_ns: int = 0
     prev_alloc_id: str = ""
@@ -1157,7 +1157,7 @@ class RescheduleEvent:
     delay_s: float = 0.0
 
 
-@dataclass
+@dataclass(slots=True)
 class RescheduleTracker:
     events: list[RescheduleEvent] = field(default_factory=list)
 
@@ -1165,7 +1165,7 @@ class RescheduleTracker:
         return RescheduleTracker(events=[dataclasses.replace(e) for e in self.events])
 
 
-@dataclass
+@dataclass(slots=True)
 class DesiredTransition:
     """Server-instructed transitions (reference: structs.go DesiredTransition :9042)."""
 
@@ -1183,7 +1183,7 @@ class DesiredTransition:
         return bool(self.force_reschedule)
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskState:
     state: str = "pending"  # pending | running | dead
     failed: bool = False
@@ -1208,7 +1208,7 @@ class TaskState:
         return self.state == "dead" and not self.failed
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocDeploymentStatus:
     healthy: Optional[bool] = None
     timestamp_ns: int = 0
@@ -1225,14 +1225,14 @@ class AllocDeploymentStatus:
         return self.healthy is False
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocNetworkStatus:
     interface_name: str = ""
     address: str = ""
     dns: dict[str, Any] = field(default_factory=dict)
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocatedTaskResources:
     cpu: int = 0
     memory_mb: int = 0
@@ -1248,7 +1248,7 @@ class AllocatedTaskResources:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class AllocatedResources:
     """Resources actually granted to an alloc (reference: structs.go :3609)."""
 
@@ -1271,7 +1271,7 @@ class AllocatedResources:
         return total
 
 
-@dataclass
+@dataclass(slots=True)
 class Allocation:
     """A placement of a task group on a node (reference: structs.go Allocation :9110)."""
 
@@ -1488,7 +1488,7 @@ def alloc_name(job_id: str, group: str, index: int) -> str:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Evaluation:
     """A request to (re)consider a job's placements (reference :10211)."""
 
@@ -1637,14 +1637,14 @@ class Evaluation:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class DeploymentStatusUpdate:
     deployment_id: str = ""
     status: str = ""
     status_description: str = ""
 
 
-@dataclass
+@dataclass(slots=True)
 class Plan:
     """A scheduler's proposed state mutation (reference: structs.go Plan :10505)."""
 
@@ -1681,6 +1681,13 @@ class Plan:
         new_alloc.job = job if job is not None else self.job
         self.node_allocation.setdefault(new_alloc.node_id, []).append(new_alloc)
 
+    def append_fresh_alloc(self, alloc: Allocation, job: Optional[Job] = None) -> None:
+        """append_alloc without the defensive copy — ONLY for allocs minted
+        by the caller this pass and referenced nowhere else (the batch
+        solver's hot path: 100k copies would dominate the solve)."""
+        alloc.job = job if job is not None else self.job
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
     def append_preempted_alloc(self, alloc: Allocation, preempting_id: str) -> None:
         new_alloc = alloc.copy()
         new_alloc.job = None
@@ -1709,7 +1716,7 @@ class Plan:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class PlanResult:
     """What the plan applier committed (reference: structs.go PlanResult :10749)."""
 
@@ -1740,7 +1747,7 @@ class PlanResult:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class DeploymentState:
     """Per-task-group rollout state (reference: structs.go DeploymentState :8863)."""
 
@@ -1772,7 +1779,7 @@ class DeploymentState:
         )
 
 
-@dataclass
+@dataclass(slots=True)
 class Deployment:
     """A tracked rollout of one job version (reference: structs.go Deployment :8767)."""
 

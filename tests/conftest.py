@@ -1,11 +1,18 @@
 """Test environment: force an 8-device virtual CPU platform so multi-chip
-sharding paths compile and run without TPU hardware."""
+sharding paths compile and run without TPU hardware.
+
+Note: the env sets JAX_PLATFORMS=axon via sitecustomize, so the env-var
+route is not enough — jax.config must be updated before backend init.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
