@@ -1,0 +1,140 @@
+"""Alloc runner: one allocation's lifecycle on a node.
+
+Reference: client/allocrunner/alloc_runner.go — Run :292, task-state fan-in
+handleTaskStateUpdates :479, Update :802, Destroy :956; the hook pipeline
+(alloc dir, networking, …) is a fixed inline sequence in round 1.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+from ..drivers import Driver
+from ..structs import Allocation, TaskState
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_COMPLETE,
+    ALLOC_CLIENT_STATUS_FAILED,
+    ALLOC_CLIENT_STATUS_PENDING,
+    ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_RUN,
+    JOB_TYPE_BATCH,
+    JOB_TYPE_SYSBATCH,
+)
+from .taskrunner import TaskRunner
+
+logger = logging.getLogger("nomad_tpu.allocrunner")
+
+
+class AllocRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        drivers: dict[str, Driver],
+        data_dir: str,
+        on_update: Callable[[Allocation], None],
+    ) -> None:
+        self.alloc = alloc.copy()
+        self.drivers = drivers
+        self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
+        self.on_update = on_update
+        self.task_runners: dict[str, TaskRunner] = {}
+        self._lock = threading.Lock()
+        self._destroyed = False
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        os.makedirs(self.alloc_dir, exist_ok=True)
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        if tg is None:
+            logger.error("alloc %s: unknown task group", self.alloc.id)
+            self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+            self.on_update(self.alloc)
+            return
+        batch = job.type in (JOB_TYPE_BATCH, JOB_TYPE_SYSBATCH)
+        for task in tg.tasks:
+            driver = self.drivers.get(task.driver)
+            if driver is None:
+                self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+                self.alloc.task_states[task.name] = TaskState(
+                    state="dead", failed=True
+                )
+                self.on_update(self.alloc)
+                return
+            tr = TaskRunner(
+                self.alloc,
+                task,
+                driver,
+                self.alloc_dir,
+                self._task_state_updated,
+                batch=batch,
+            )
+            self.task_runners[task.name] = tr
+        for tr in self.task_runners.values():
+            tr.start()
+        self._task_state_updated()
+
+    def _task_state_updated(self) -> None:
+        """Fan task states into the alloc's client status
+        (reference alloc_runner.go:479)."""
+        with self._lock:
+            states = {name: tr.state for name, tr in self.task_runners.items()}
+            self.alloc.task_states = {k: v.copy() for k, v in states.items()}
+            failed = any(s.failed for s in states.values())
+            all_dead = all(s.state == "dead" for s in states.values()) and states
+            any_running = any(s.state == "running" for s in states.values())
+            leader = next(
+                (
+                    name
+                    for name, tr in self.task_runners.items()
+                    if tr.task.leader
+                ),
+                None,
+            )
+            if failed:
+                status = ALLOC_CLIENT_STATUS_FAILED
+            elif all_dead:
+                status = ALLOC_CLIENT_STATUS_COMPLETE
+            elif any_running:
+                status = ALLOC_CLIENT_STATUS_RUNNING
+            else:
+                status = ALLOC_CLIENT_STATUS_PENDING
+            self.alloc.client_status = status
+            # leader death kills followers (reference task_hook_coordinator)
+            if leader and states.get(leader, TaskState()).state == "dead":
+                for name, tr in self.task_runners.items():
+                    if name != leader:
+                        tr.kill()
+        # Always sync: task_states changed even when status didn't, and the
+        # client's alloc-sync loop batches/dedups by alloc id anyway.
+        self.on_update(self.alloc)
+
+    # ------------------------------------------------------------------
+
+    def update(self, updated: Allocation) -> None:
+        """Server pushed a new version of this alloc (reference Update :802)."""
+        with self._lock:
+            self.alloc.desired_status = updated.desired_status
+            self.alloc.desired_description = updated.desired_description
+            self.alloc.modify_index = updated.modify_index
+        if updated.desired_status != ALLOC_DESIRED_STATUS_RUN:
+            self.stop()
+
+    def stop(self) -> None:
+        for tr in self.task_runners.values():
+            tr.kill()
+
+    def destroy(self) -> None:
+        self._destroyed = True
+        self.stop()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return all(tr.wait(timeout_s) for tr in self.task_runners.values())
+
+    def is_terminal(self) -> bool:
+        with self._lock:
+            return self.alloc.client_terminal_status()

@@ -1,0 +1,183 @@
+"""The node agent.
+
+Reference: client/client.go (3,085 LoC) — NewClient :325, registration +
+heartbeat :1554, watchAllocations :2003 (blocking query), runAllocs :2233
+(diff desired vs running), batched status sync allocSync :1936.
+
+The server connection is the `rpc` object — in-process round 1, the
+msgpack-RPC fabric in Phase 2. The client only uses five verbs, mirroring
+the reference's Node.* RPCs: register, heartbeat, get_client_allocs,
+update_allocs, deregister.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ..drivers import BUILTIN_DRIVERS, Driver
+from ..structs import Allocation, Node
+from ..structs.structs import ALLOC_DESIRED_STATUS_RUN, DriverInfo, now_ns
+from .allocrunner import AllocRunner
+from .fingerprint import fingerprint_node
+
+logger = logging.getLogger("nomad_tpu.client")
+
+ALLOC_SYNC_INTERVAL_S = 0.2  # reference: allocSyncIntv 200ms
+
+
+class ServerRPC:
+    """In-process stand-in for the client<->server RPC fabric."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def register(self, node: Node) -> float:
+        return self.server.node_register(node)
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.server.node_heartbeat(node_id)
+
+    def get_client_allocs(self, node_id: str, min_index: int, timeout_s: float):
+        return self.server.get_client_allocs(node_id, min_index, timeout_s)
+
+    def update_allocs(self, allocs: list[Allocation]) -> None:
+        self.server.update_allocs_from_client(allocs)
+
+
+class Client:
+    def __init__(
+        self,
+        rpc,
+        data_dir: str = "/tmp/nomad_tpu",
+        datacenter: str = "dc1",
+        node_class: str = "",
+        node: Optional[Node] = None,
+        drivers: Optional[dict[str, Driver]] = None,
+    ) -> None:
+        self.rpc = rpc
+        self.data_dir = data_dir
+        self.node = node or fingerprint_node(
+            datacenter=datacenter, node_class=node_class, data_dir="/tmp"
+        )
+        self.drivers = drivers or {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+        for name, driver in self.drivers.items():
+            fp = driver.fingerprint()
+            self.node.attributes.update(fp.attributes)
+            self.node.drivers[name] = DriverInfo(
+                attributes=fp.attributes, detected=True, healthy=True
+            )
+        from ..structs.node_class import compute_node_class
+
+        self.node.computed_class = compute_node_class(self.node)
+
+        self.alloc_runners: dict[str, AllocRunner] = {}
+        self._pending_updates: dict[str, Allocation] = {}
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.heartbeat_ttl = 10.0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self.heartbeat_ttl = self.rpc.register(self.node)
+        for target, name in (
+            (self._heartbeat_loop, "client-heartbeat"),
+            (self._watch_allocs, "client-watch"),
+            (self._alloc_sync, "client-allocsync"),
+        ):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for ar in list(self.alloc_runners.values()):
+            ar.destroy()
+
+    # -- loops ---------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            # heartbeat at half the granted TTL (reference client.go:1606)
+            self._shutdown.wait(max(self.heartbeat_ttl / 2, 0.5))
+            if self._shutdown.is_set():
+                return
+            try:
+                self.heartbeat_ttl = self.rpc.heartbeat(self.node.id)
+            except Exception:
+                logger.exception("heartbeat failed")
+
+    def _watch_allocs(self) -> None:
+        """Blocking-query loop on our alloc set (reference :2003)."""
+        index = 0
+        while not self._shutdown.is_set():
+            try:
+                allocs, index = self.rpc.get_client_allocs(
+                    self.node.id, index + 1, timeout_s=1.0
+                )
+            except Exception:
+                logger.exception("alloc watch failed")
+                self._shutdown.wait(1)
+                continue
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, server_allocs: list[Allocation]) -> None:
+        """Diff desired vs running (reference runAllocs :2233)."""
+        desired = {a.id: a for a in server_allocs}
+        with self._lock:
+            existing = dict(self.alloc_runners)
+        # removals (server GC'd the alloc entirely)
+        for alloc_id, runner in existing.items():
+            if alloc_id not in desired:
+                runner.destroy()
+                with self._lock:
+                    self.alloc_runners.pop(alloc_id, None)
+        for alloc_id, alloc in desired.items():
+            runner = existing.get(alloc_id)
+            if runner is None:
+                if (
+                    alloc.desired_status == ALLOC_DESIRED_STATUS_RUN
+                    and not alloc.client_terminal_status()
+                ):
+                    runner = AllocRunner(
+                        alloc, self.drivers, self.data_dir, self._alloc_updated
+                    )
+                    with self._lock:
+                        self.alloc_runners[alloc_id] = runner
+                    runner.run()
+            else:
+                if alloc.modify_index > runner.alloc.modify_index:
+                    runner.update(alloc)
+
+    def _alloc_updated(self, alloc: Allocation) -> None:
+        """AllocRunner reported a state change; queue for batched sync."""
+        with self._lock:
+            stub = alloc.copy(keep_job=False)
+            self._pending_updates[alloc.id] = stub
+
+    def _alloc_sync(self) -> None:
+        """Batched status push (reference allocSync :1936)."""
+        while not self._shutdown.is_set():
+            self._shutdown.wait(ALLOC_SYNC_INTERVAL_S)
+            with self._lock:
+                updates = list(self._pending_updates.values())
+                self._pending_updates.clear()
+            if not updates:
+                continue
+            try:
+                self.rpc.update_allocs(updates)
+            except Exception:
+                logger.exception("alloc sync failed")
+                with self._lock:
+                    for u in updates:
+                        self._pending_updates.setdefault(u.id, u)
+
+    # -- introspection -------------------------------------------------
+
+    def num_allocs(self) -> int:
+        with self._lock:
+            return len(self.alloc_runners)

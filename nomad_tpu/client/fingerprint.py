@@ -1,0 +1,81 @@
+"""Host fingerprinting: what does this machine offer?
+
+Reference: client/fingerprint/fingerprint.go:31-48 — arch, cpu, memory,
+storage, network, host, nomad-version fingerprinters, merged into the Node.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import shutil
+import socket
+import uuid
+
+from ..structs import NetworkResource, Node, NodeResources
+from ..structs.node_class import compute_node_class
+
+
+def _total_memory_mb() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return 1024
+
+
+def _cpu_mhz_total() -> int:
+    cores = os.cpu_count() or 1
+    mhz = 2000.0
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("cpu mhz"):
+                    mhz = float(line.split(":")[1])
+                    break
+    except OSError:
+        pass
+    return int(cores * mhz)
+
+
+def fingerprint_node(
+    node_id: str = "",
+    datacenter: str = "dc1",
+    node_class: str = "",
+    data_dir: str = "/tmp",
+) -> Node:
+    cores = os.cpu_count() or 1
+    disk = shutil.disk_usage(data_dir)
+    node = Node(
+        id=node_id or str(uuid.uuid4()),
+        name=socket.gethostname(),
+        datacenter=datacenter,
+        node_class=node_class,
+        attributes={
+            "kernel.name": platform.system().lower(),
+            "kernel.version": platform.release(),
+            "arch": platform.machine(),
+            "os.name": platform.system().lower(),
+            "cpu.numcores": str(cores),
+            "cpu.totalcompute": str(_cpu_mhz_total()),
+            "memory.totalbytes": str(_total_memory_mb() * 1024 * 1024),
+            "unique.hostname": socket.gethostname(),
+            "unique.storage.volume": data_dir,
+            "nomad.version": "0.1.0",
+        },
+        resources=NodeResources(
+            cpu=_cpu_mhz_total(),
+            memory_mb=_total_memory_mb(),
+            disk_mb=disk.free // (1024 * 1024),
+            networks=[
+                NetworkResource(
+                    device="lo", cidr="127.0.0.1/32", ip="127.0.0.1", mbits=1000
+                )
+            ],
+        ),
+    )
+    node.computed_class = compute_node_class(node)
+    return node

@@ -1,0 +1,226 @@
+"""Task runner: one task's lifecycle state machine.
+
+Reference: client/allocrunner/taskrunner/task_runner.go — the MAIN loop
+:516 (hooks → dispatch driver → wait → restart tracker → repeat), task
+event recording, kill handling. Round-1 hooks: task directory + env
+construction inline; artifact/template/logmon land with their subsystems.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from ..drivers import Driver, DriverError, TaskConfig
+from ..structs import Allocation, Task, TaskState, now_ns
+from .restarts import DECISION_RESTART, RestartTracker
+
+logger = logging.getLogger("nomad_tpu.taskrunner")
+
+EVENT_RECEIVED = "Received"
+EVENT_TASK_SETUP = "Task Setup"
+EVENT_STARTED = "Started"
+EVENT_TERMINATED = "Terminated"
+EVENT_RESTARTING = "Restarting"
+EVENT_NOT_RESTARTING = "Not Restarting"
+EVENT_KILLING = "Killing"
+EVENT_KILLED = "Killed"
+EVENT_DRIVER_FAILURE = "Driver Failure"
+
+
+class TaskRunner:
+    def __init__(
+        self,
+        alloc: Allocation,
+        task: Task,
+        driver: Driver,
+        alloc_dir: str,
+        on_state_change,
+        batch: bool = False,
+    ) -> None:
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.alloc_dir = alloc_dir
+        self.on_state_change = on_state_change
+        self.batch = batch
+        self.task_id = f"{alloc.id[:8]}/{task.name}"
+        self.state = TaskState(state="pending")
+        self.restart_tracker = RestartTracker(self._restart_policy())
+        self._kill = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _restart_policy(self):
+        from ..structs import RestartPolicy
+
+        tg = self.alloc.job.lookup_task_group(self.alloc.task_group) if self.alloc.job else None
+        return tg.restart_policy if tg is not None else RestartPolicy()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True, name=f"task-{self.task_id}"
+        )
+        self._thread.start()
+
+    def run(self) -> None:
+        """The MAIN loop (reference task_runner.go:516)."""
+        self._event(EVENT_RECEIVED)
+        task_dir = os.path.join(self.alloc_dir, self.task.name)
+        os.makedirs(os.path.join(task_dir, "local"), exist_ok=True)
+        os.makedirs(os.path.join(task_dir, "secrets"), exist_ok=True)
+        self._event(EVENT_TASK_SETUP)
+
+        while not self._kill.is_set():
+            try:
+                handle = self.driver.start_task(self._task_config(task_dir))
+            except DriverError as e:
+                self._event(EVENT_DRIVER_FAILURE, str(e))
+                decision, delay = self.restart_tracker.next_restart(
+                    exit_success=False, batch=self.batch
+                )
+                if decision == DECISION_RESTART:
+                    self._kill.wait(delay)
+                    if not self._kill.is_set():
+                        self._event(EVENT_RESTARTING)
+                        continue
+                    break  # killed during backoff: killed, not failed
+                self._fail(f"driver failure: {e}")
+                return
+
+            self.state.state = "running"
+            self.state.started_at_ns = now_ns()
+            self._event(EVENT_STARTED)
+            self.on_state_change()
+
+            # wait for exit OR kill
+            result = None
+            while result is None and not self._kill.is_set():
+                result = self.driver.wait_task(self.task_id, timeout_s=0.2)
+            if self._kill.is_set():
+                self._event(EVENT_KILLING)
+                try:
+                    self.driver.stop_task(self.task_id, self.task.kill_timeout_s)
+                    self.driver.destroy_task(self.task_id, force=True)
+                except DriverError:
+                    pass
+                self.state.state = "dead"
+                self.state.finished_at_ns = now_ns()
+                self._event(EVENT_KILLED)
+                self.on_state_change()
+                self._done.set()
+                return
+
+            success = result.successful()
+            self._event(
+                EVENT_TERMINATED,
+                f"exit_code={result.exit_code} signal={result.signal}",
+            )
+            try:
+                self.driver.destroy_task(self.task_id, force=True)
+            except DriverError:
+                pass
+
+            if success and self.batch:
+                self.state.state = "dead"
+                self.state.failed = False
+                self.state.finished_at_ns = now_ns()
+                self.on_state_change()
+                self._done.set()
+                return
+
+            decision, delay = self.restart_tracker.next_restart(
+                exit_success=success, batch=self.batch
+            )
+            if decision == DECISION_RESTART:
+                self.state.restarts += 1
+                self.state.last_restart_ns = now_ns()
+                self._event(EVENT_RESTARTING, f"in {delay:.1f}s")
+                self.on_state_change()
+                self._kill.wait(delay)
+                continue  # outer loop re-checks the kill flag
+            # no more restarts
+            if success:
+                self.state.state = "dead"
+                self.state.failed = False
+            else:
+                self._event(EVENT_NOT_RESTARTING)
+                self.state.failed = True
+                self.state.state = "dead"
+            self.state.finished_at_ns = now_ns()
+            self.on_state_change()
+            self._done.set()
+            return
+        # Killed while between runs (e.g. during a restart delay).
+        if self.state.state != "dead":
+            self.state.state = "dead"
+            self.state.finished_at_ns = now_ns()
+            self._event(EVENT_KILLED)
+            self.on_state_change()
+        self._done.set()
+
+    def kill(self) -> None:
+        self._kill.set()
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        return self._done.wait(timeout_s)
+
+    def _fail(self, reason: str) -> None:
+        self.state.state = "dead"
+        self.state.failed = True
+        self.state.finished_at_ns = now_ns()
+        self.on_state_change()
+        self._done.set()
+
+    def _task_config(self, task_dir: str) -> TaskConfig:
+        env = dict(self.task.env)
+        env.update(self._nomad_env())
+        return TaskConfig(
+            id=self.task_id,
+            name=self.task.name,
+            alloc_id=self.alloc.id,
+            env=env,
+            config=dict(self.task.config),
+            resources_cpu=self.task.resources.cpu,
+            resources_memory_mb=self.task.resources.memory_mb,
+            task_dir=task_dir,
+            stdout_path=os.path.join(task_dir, f"{self.task.name}.stdout.log"),
+            stderr_path=os.path.join(task_dir, f"{self.task.name}.stderr.log"),
+            user=self.task.user,
+        )
+
+    def _nomad_env(self) -> dict[str, str]:
+        """NOMAD_* task environment (reference client/taskenv)."""
+        alloc = self.alloc
+        env = {
+            "NOMAD_ALLOC_ID": alloc.id,
+            "NOMAD_ALLOC_NAME": alloc.name,
+            "NOMAD_ALLOC_INDEX": str(alloc.index()),
+            "NOMAD_TASK_NAME": self.task.name,
+            "NOMAD_GROUP_NAME": alloc.task_group,
+            "NOMAD_JOB_ID": alloc.job_id,
+            "NOMAD_JOB_NAME": alloc.job.name if alloc.job else "",
+            "NOMAD_NAMESPACE": alloc.namespace,
+            "NOMAD_DC": "",
+            "NOMAD_CPU_LIMIT": str(self.task.resources.cpu),
+            "NOMAD_MEMORY_LIMIT": str(self.task.resources.memory_mb),
+        }
+        if alloc.resources is not None:
+            tr = alloc.resources.tasks.get(self.task.name)
+            if tr is not None:
+                for net in tr.networks:
+                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                        env[f"NOMAD_PORT_{p.label}"] = str(p.value)
+                        env[f"NOMAD_IP_{p.label}"] = net.ip
+        for k, v in self.task.meta.items():
+            env[f"NOMAD_META_{k.upper()}"] = v
+        return env
+
+    def _event(self, etype: str, details: str = "") -> None:
+        self.state.events.append(
+            {"type": etype, "time": now_ns(), "details": details}
+        )

@@ -1,0 +1,23 @@
+from .base import (
+    Driver,
+    DriverError,
+    ExitResult,
+    Fingerprint,
+    TaskConfig,
+    TaskHandle,
+    TaskStatus,
+)
+from .mock import MockDriver
+from .rawexec import RawExecDriver
+
+BUILTIN_DRIVERS = {
+    "mock": MockDriver,
+    "rawexec": RawExecDriver,
+}
+
+
+def new_driver(name: str) -> Driver:
+    factory = BUILTIN_DRIVERS.get(name)
+    if factory is None:
+        raise ValueError(f"unknown driver '{name}'")
+    return factory()

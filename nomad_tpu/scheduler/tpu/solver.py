@@ -81,6 +81,7 @@ class BatchSolver:
 
     def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
         out = SolveOutcome()
+        self._outcome = out
         if not asks:
             return out
         # Priority order: higher-priority jobs consume capacity first
@@ -137,36 +138,50 @@ class BatchSolver:
         out.groups = len(groups)
 
         n = table.n
+        self._free = table.cap - table.used  # exact-repair ledger, per solve
         used = np.clip(table.used, 0, 2**31 - 1).astype(np.int32)
         t0 = now_ns()
         assign, used_out = self._run_kernel(table, groups, used)
-        leftovers = self._materialize(out, table, groups, assign)
+        leftovers = self._materialize(table, groups, assign)
 
         # Fallback pass: spread is a soft preference — requests a
         # value-restricted sub-group could not place retry against the
         # unrestricted base feasibility with updated utilization.
         retry: list[LoweredGroup] = []
+        final_unplaced: dict[tuple, tuple[LoweredGroup, list]] = {}
         for gi, reqs in leftovers.items():
-            base = base_of[gi]
-            if reqs and groups[gi].restricted:
+            grp = groups[gi]
+            if reqs and grp.restricted:
                 import dataclasses
 
                 retry.append(
                     dataclasses.replace(
-                        base,
+                        base_of[gi],
                         count=len(reqs),
                         names=[r.name for r in reqs],
                         requests=reqs,
                         restricted=False,
                     )
                 )
-                # un-record the failure; _materialize re-adds if still stuck
-                out.failures.get(groups[gi].key[0], {}).pop(
-                    groups[gi].tg.name, None
-                )
+            elif reqs:
+                key = (grp.key[0], grp.tg.name)
+                prev = final_unplaced.get(key)
+                final_unplaced[key] = (grp, (prev[1] if prev else []) + reqs)
         if retry:
             assign2, _ = self._run_kernel(table, retry, np.asarray(used_out)[:n])
-            self._materialize(out, table, retry, assign2)
+            leftovers2 = self._materialize(table, retry, assign2)
+            for gi, reqs in leftovers2.items():
+                grp = retry[gi]
+                key = (grp.key[0], grp.tg.name)
+                prev = final_unplaced.get(key)
+                final_unplaced[key] = (grp, (prev[1] if prev else []) + reqs)
+
+        # Failure metrics from the FINAL unplaced set (both passes).
+        for (eval_id, tg_name), (grp, reqs) in final_unplaced.items():
+            metric = AllocMetric(nodes_evaluated=n)
+            metric.nodes_filtered = n - int(np.sum(grp.feasible))
+            metric.coalesced_failures = len(reqs) - 1
+            out.failures.setdefault(eval_id, {})[tg_name] = metric
         out.solve_ns = now_ns() - t0
         return out
 
@@ -257,21 +272,20 @@ class BatchSolver:
 
     def _materialize(
         self,
-        out: SolveOutcome,
         table,
         groups: list[LoweredGroup],
         assign: np.ndarray,
     ) -> dict[int, list]:
         """Turn [G, N] counts into Allocations; verify + repair per node.
 
-        Returns leftover (unplaced) requests per group index. Host-side
-        exact capacity verification replays the solver's placements with
-        integer math and drops overflow (the kernel is integer too, so this
-        only fires when two passes race the same capacity)."""
+        Returns leftover (unplaced) requests per group index; the caller
+        aggregates failures after all passes. Host-side exact capacity
+        verification replays the solver's placements with integer math and
+        drops overflow (the kernel is integer too, so this only fires when
+        two passes race the same capacity)."""
         n = table.n
-        if not hasattr(self, "_free"):
-            self._free = table.cap - table.used  # [N, 3] int64
         free = self._free
+        out = self._outcome
         leftovers: dict[int, list] = {}
         for gi, grp in enumerate(groups):
             eval_id = grp.key[0]
@@ -298,15 +312,6 @@ class BatchSolver:
             unplaced.extend(req_iter)  # instances the kernel never placed
             if unplaced:
                 leftovers[gi] = unplaced
-                metrics = out.failures.setdefault(eval_id, {})
-                existing = metrics.get(grp.tg.name)
-                if existing is None:
-                    metric = AllocMetric(nodes_evaluated=n)
-                    metric.nodes_filtered = n - int(np.sum(grp.feasible))
-                    metric.coalesced_failures = len(unplaced) - 1
-                    metrics[grp.tg.name] = metric
-                else:
-                    existing.coalesced_failures += len(unplaced)
         return leftovers
 
     def _build_alloc(

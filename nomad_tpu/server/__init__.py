@@ -1,0 +1,8 @@
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .heartbeat import HeartbeatTimers, rate_scaled_interval
+from .plan_apply import PlanApplier, evaluate_node_plan, evaluate_plan
+from .plan_queue import PlanQueue
+from .raft import FSM, InmemLog
+from .server import Server
+from .worker import TPUBatchWorker, Worker, WorkerPlanner

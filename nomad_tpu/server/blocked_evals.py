@@ -1,0 +1,106 @@
+"""Blocked-evaluations tracker.
+
+Reference: nomad/blocked_evals.go (781 LoC) — evals that failed placement
+wait here, keyed by the computed node classes they found ineligible; any
+capacity-changing event (node up/updated, alloc freed) unblocks the evals
+that could now succeed and re-enqueues them into the broker.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..structs import Evaluation
+from ..structs.structs import EVAL_TRIGGER_MAX_PLANS
+
+
+class BlockedEvals:
+    def __init__(self, enqueue_fn: Callable[[Evaluation], None]) -> None:
+        self.enqueue_fn = enqueue_fn
+        self._lock = threading.Lock()
+        self._enabled = False
+        # eval id -> eval, for evals blocked on specific classes
+        self._captured: dict[str, Evaluation] = {}
+        # evals whose constraints escaped class tracking: unblock on any change
+        self._escaped: dict[str, Evaluation] = {}
+        # (ns, job) -> blocked eval id (one blocked eval per job)
+        self._by_job: dict[tuple[str, str], str] = {}
+        self.stats = {"total_blocked": 0, "total_escaped": 0, "unblocks": 0}
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                self._captured.clear()
+                self._escaped.clear()
+                self._by_job.clear()
+
+    def block(self, ev: Evaluation) -> None:
+        with self._lock:
+            if not self._enabled:
+                return
+            key = (ev.namespace, ev.job_id)
+            # newest blocked eval per job wins (the state store cancels the
+            # older one on upsert — mirror that here)
+            old_id = self._by_job.get(key)
+            if old_id:
+                self._captured.pop(old_id, None)
+                self._escaped.pop(old_id, None)
+            self._by_job[key] = ev.id
+            if ev.escaped_computed_class or not ev.class_eligibility:
+                self._escaped[ev.id] = ev
+                self.stats["total_escaped"] = len(self._escaped)
+            else:
+                self._captured[ev.id] = ev
+            self.stats["total_blocked"] = len(self._captured) + len(self._escaped)
+
+    def untrack(self, namespace: str, job_id: str) -> None:
+        """Job deregistered: drop its blocked eval."""
+        with self._lock:
+            eid = self._by_job.pop((namespace, job_id), None)
+            if eid:
+                self._captured.pop(eid, None)
+                self._escaped.pop(eid, None)
+
+    # -- unblock triggers ---------------------------------------------
+
+    def unblock(self, computed_class: str) -> None:
+        """Capacity freed/added on nodes of this class (reference Unblock)."""
+        to_run: list[Evaluation] = []
+        with self._lock:
+            if not self._enabled:
+                return
+            for eid in list(self._escaped):
+                to_run.append(self._escaped.pop(eid))
+            for eid, ev in list(self._captured.items()):
+                # eligible (True) => the class could place it: unblock.
+                # unknown class (not in map) => untested: unblock to retest.
+                elig = ev.class_eligibility.get(computed_class)
+                if elig is None or elig:
+                    to_run.append(self._captured.pop(eid))
+            for ev in to_run:
+                self._by_job.pop((ev.namespace, ev.job_id), None)
+            self.stats["unblocks"] += len(to_run)
+            self.stats["total_blocked"] = len(self._captured) + len(self._escaped)
+            self.stats["total_escaped"] = len(self._escaped)
+        for ev in to_run:
+            requeued = ev.copy()
+            requeued.status = "pending"
+            requeued.triggered_by = "queued-allocs"
+            self.enqueue_fn(requeued)
+
+    def unblock_all(self) -> None:
+        with self._lock:
+            evs = list(self._captured.values()) + list(self._escaped.values())
+            self._captured.clear()
+            self._escaped.clear()
+            self._by_job.clear()
+        for ev in evs:
+            requeued = ev.copy()
+            requeued.status = "pending"
+            self.enqueue_fn(requeued)
+
+    def blocked_count(self) -> int:
+        with self._lock:
+            return len(self._captured) + len(self._escaped)

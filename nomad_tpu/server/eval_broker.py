@@ -1,0 +1,271 @@
+"""Evaluation broker: leader-only priority queue of pending evaluations.
+
+Reference: nomad/eval_broker.go (901 LoC) — Enqueue :181, Dequeue :329,
+Ack :531, Nack :595, delayed-eval heap :751, PendingEvaluations :861.
+
+Semantics preserved:
+  * per-scheduler-type priority heaps (workers dequeue only the types they
+    run; the TPU batch worker dequeues many at once);
+  * per-job serialization — at most ONE eval per (namespace, job) in flight;
+    later evals for the same job wait in a per-job heap and are promoted on
+    ack of the previous one;
+  * ack/nack with a delivery limit: nacked evals re-enqueue after a delay,
+    over-limit evals land in the failed queue;
+  * delayed evals (wait_until in the future) sit in a time heap serviced by
+    a timer thread.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..structs import Evaluation, generate_uuid, now_ns
+
+DEFAULT_NACK_DELAY_S = 5.0
+DEFAULT_DELIVERY_LIMIT = 3
+FAILED_QUEUE = "_failed"
+
+
+class _PendingHeap:
+    """Priority heap: higher priority first, then FIFO."""
+
+    def __init__(self) -> None:
+        self._heap: list = []
+        self._counter = itertools.count()
+
+    def push(self, ev: Evaluation) -> None:
+        heapq.heappush(self._heap, (-ev.priority, next(self._counter), ev))
+
+    def pop(self) -> Optional[Evaluation]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[2]
+
+    def peek(self) -> Optional[Evaluation]:
+        return self._heap[0][2] if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class EvalBroker:
+    def __init__(
+        self,
+        nack_delay_s: float = DEFAULT_NACK_DELAY_S,
+        delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+    ) -> None:
+        self.nack_delay_s = nack_delay_s
+        self.delivery_limit = delivery_limit
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._enabled = False
+        # scheduler type -> ready heap
+        self._ready: dict[str, _PendingHeap] = {}
+        # eval id -> (eval, token, attempts) for unacked evals
+        self._unacked: dict[str, tuple[Evaluation, str, int]] = {}
+        # (ns, job) -> in-flight eval id
+        self._in_flight: dict[tuple[str, str], str] = {}
+        # (ns, job) -> heap of evals waiting behind the in-flight one
+        self._blocked_jobs: dict[tuple[str, str], _PendingHeap] = {}
+        # delayed evals: (wait_until_ns, seq, eval)
+        self._delayed: list = []
+        self._delayed_counter = itertools.count()
+        self._attempts: dict[str, int] = {}  # eval id -> deliveries
+        self._timer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.stats = {
+            "total_ready": 0,
+            "total_unacked": 0,
+            "total_blocked": 0,
+            "total_waiting": 0,
+            "failed": 0,
+        }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            was = self._enabled
+            self._enabled = enabled
+            if was and not enabled:
+                self._flush_locked()
+            if not was and enabled:
+                self._stop.clear()
+                self._timer = threading.Thread(
+                    target=self._delayed_loop, daemon=True, name="broker-delayed"
+                )
+                self._timer.start()
+            self._cv.notify_all()
+        if was and not enabled:
+            self._stop.set()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def _flush_locked(self) -> None:
+        self._ready.clear()
+        self._unacked.clear()
+        self._in_flight.clear()
+        self._blocked_jobs.clear()
+        self._delayed.clear()
+        self._attempts.clear()
+
+    # -- enqueue -------------------------------------------------------
+
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._enqueue_locked(ev.copy())
+
+    def enqueue_all(self, evals: list[Evaluation]) -> None:
+        with self._lock:
+            for ev in evals:
+                self._enqueue_locked(ev.copy())
+
+    def _enqueue_locked(self, ev: Evaluation) -> None:
+        if not self._enabled:
+            return
+        if ev.wait_until_ns and ev.wait_until_ns > now_ns():
+            heapq.heappush(
+                self._delayed, (ev.wait_until_ns, next(self._delayed_counter), ev)
+            )
+            self._cv.notify_all()
+            return
+        key = (ev.namespace, ev.job_id)
+        if ev.job_id and key in self._in_flight:
+            self._blocked_jobs.setdefault(key, _PendingHeap()).push(ev)
+            return
+        self._push_ready(ev)
+
+    def _push_ready(self, ev: Evaluation) -> None:
+        self._ready.setdefault(ev.type, _PendingHeap()).push(ev)
+        if ev.job_id:
+            self._in_flight[(ev.namespace, ev.job_id)] = ev.id
+        self._cv.notify_all()
+
+    # -- dequeue / ack / nack -----------------------------------------
+
+    def dequeue(
+        self, schedulers: list[str], timeout_s: Optional[float] = None
+    ) -> tuple[Optional[Evaluation], str]:
+        """Blocking dequeue of the highest-priority ready eval among the
+        given scheduler types. Returns (eval, token) or (None, "")."""
+        deadline = time.monotonic() + timeout_s if timeout_s is not None else None
+        with self._cv:
+            while True:
+                if self._enabled:
+                    ev = self._pop_best_locked(schedulers)
+                    if ev is not None:
+                        token = generate_uuid()
+                        attempts = self._attempts.get(ev.id, 0) + 1
+                        self._attempts[ev.id] = attempts
+                        self._unacked[ev.id] = (ev, token, attempts)
+                        return ev, token
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None, ""
+                    self._cv.wait(remaining)
+                else:
+                    self._cv.wait(1.0)
+
+    def _pop_best_locked(self, schedulers: list[str]) -> Optional[Evaluation]:
+        best_type = None
+        best = None
+        for stype in schedulers:
+            heap = self._ready.get(stype)
+            if heap is None:
+                continue
+            ev = heap.peek()
+            if ev is None:
+                continue
+            if best is None or ev.priority > best.priority:
+                best, best_type = ev, stype
+        if best is None:
+            return None
+        return self._ready[best_type].pop()
+
+    def ack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            entry = self._unacked.get(eval_id)
+            if entry is None or entry[1] != token:
+                raise ValueError(f"token mismatch or unknown eval {eval_id}")
+            del self._unacked[eval_id]
+            ev = entry[0]
+            self._attempts.pop(eval_id, None)
+            self._release_job_locked(ev, eval_id)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        with self._lock:
+            entry = self._unacked.get(eval_id)
+            if entry is None or entry[1] != token:
+                raise ValueError(f"token mismatch or unknown eval {eval_id}")
+            del self._unacked[eval_id]
+            ev, _, attempts = entry
+            key = (ev.namespace, ev.job_id)
+            if attempts >= self.delivery_limit:
+                # dead-letter: failed queue for the reaper; the job's waiting
+                # evals must still be promoted or they strand forever
+                self._attempts.pop(eval_id, None)
+                self._release_job_locked(ev, eval_id)
+                self._ready.setdefault(FAILED_QUEUE, _PendingHeap()).push(ev)
+                self.stats["failed"] += 1
+                self._cv.notify_all()
+                return
+            if self._in_flight.get(key) == eval_id:
+                del self._in_flight[key]
+            # re-enqueue after the nack delay
+            requeue_at = now_ns() + int(self.nack_delay_s * 1e9)
+            heapq.heappush(
+                self._delayed, (requeue_at, next(self._delayed_counter), ev)
+            )
+            self._cv.notify_all()
+
+    def _release_job_locked(self, ev: Evaluation, eval_id: str) -> None:
+        """Clear the job's in-flight marker and promote the next waiter."""
+        key = (ev.namespace, ev.job_id)
+        if self._in_flight.get(key) == eval_id:
+            del self._in_flight[key]
+        blocked = self._blocked_jobs.get(key)
+        if blocked:
+            nxt = blocked.pop()
+            if len(blocked) == 0:
+                del self._blocked_jobs[key]
+            if nxt is not None:
+                self._push_ready(nxt)
+
+    # -- delayed servicing --------------------------------------------
+
+    def _delayed_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cv:
+                now = now_ns()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, ev = heapq.heappop(self._delayed)
+                    key = (ev.namespace, ev.job_id)
+                    if ev.job_id and key in self._in_flight:
+                        self._blocked_jobs.setdefault(key, _PendingHeap()).push(ev)
+                    else:
+                        self._push_ready(ev)
+                wait = 0.2
+                if self._delayed:
+                    wait = min(wait, max(0.0, (self._delayed[0][0] - now) / 1e9))
+            self._stop.wait(wait)
+
+    # -- introspection -------------------------------------------------
+
+    def ready_count(self) -> int:
+        with self._lock:
+            return sum(len(h) for t, h in self._ready.items() if t != FAILED_QUEUE)
+
+    def inflight_count(self) -> int:
+        with self._lock:
+            return len(self._unacked)
+
+    def outstanding(self, eval_id: str) -> bool:
+        with self._lock:
+            return eval_id in self._unacked

@@ -1,0 +1,74 @@
+"""Node-liveness heartbeats (leader-only TTL timers).
+
+Reference: nomad/heartbeat.go — per-node TTL timers scaled by cluster size
+(lib.RateScaledInterval: max 50 heartbeats/sec cluster-wide, min 10s TTL);
+a missed TTL marks the node down and creates evals for its jobs.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Optional
+
+MIN_HEARTBEAT_TTL_S = 10.0
+MAX_HEARTBEATS_PER_SECOND = 50.0
+FAILOVER_GRACE_S = 5.0
+
+
+def rate_scaled_interval(n_nodes: int) -> float:
+    """TTL grows with the cluster to bound heartbeat throughput
+    (reference: helper lib.RateScaledInterval, heartbeat.go:104)."""
+    interval = float(n_nodes) / MAX_HEARTBEATS_PER_SECOND
+    return max(MIN_HEARTBEAT_TTL_S, interval)
+
+
+class HeartbeatTimers:
+    def __init__(self, on_expire: Callable[[str], None]) -> None:
+        self.on_expire = on_expire
+        self._lock = threading.Lock()
+        self._timers: dict[str, threading.Timer] = {}
+        self._enabled = False
+        self.node_count_fn: Callable[[], int] = lambda: 1
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self._enabled = enabled
+            if not enabled:
+                for t in self._timers.values():
+                    t.cancel()
+                self._timers.clear()
+
+    def reset(self, node_id: str) -> float:
+        """(Re)arm the node's TTL; returns the TTL granted, with splay so a
+        thundering herd of re-registrations doesn't expire simultaneously."""
+        ttl = rate_scaled_interval(self.node_count_fn())
+        ttl += random.uniform(0, ttl / 2)
+        with self._lock:
+            if not self._enabled:
+                return ttl
+            old = self._timers.pop(node_id, None)
+            if old:
+                old.cancel()
+            timer = threading.Timer(ttl, self._expire, args=(node_id,))
+            timer.daemon = True
+            self._timers[node_id] = timer
+            timer.start()
+        return ttl
+
+    def clear(self, node_id: str) -> None:
+        with self._lock:
+            old = self._timers.pop(node_id, None)
+            if old:
+                old.cancel()
+
+    def _expire(self, node_id: str) -> None:
+        with self._lock:
+            self._timers.pop(node_id, None)
+            if not self._enabled:
+                return
+        self.on_expire(node_id)
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._timers)

@@ -1,0 +1,126 @@
+"""Plan applier: THE serialization point of the optimistic scheduler.
+
+Reference: nomad/plan_apply.go — planApply :71, evaluatePlan :400,
+evaluateNodePlan :631. Scheduler workers race against stale snapshots; the
+applier re-verifies every touched node against the LATEST state and commits
+only the subset that still fits. A partial commit sets refresh_index, which
+forces the worker to refresh its snapshot and retry the remainder.
+
+Reference parallelizes per-node verification over a pool
+(plan_apply_pool.go) and pipelines verification of plan N+1 with the Raft
+apply of plan N; under the GIL a thread pool buys nothing, so verification
+here is a straight loop over touched nodes — the batched TPU path already
+amortizes this by submitting fewer, larger plans.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from ..structs import Plan, PlanResult, allocs_fit
+from ..structs.structs import NODE_STATUS_READY
+from .plan_queue import PlanQueue
+
+logger = logging.getLogger("nomad_tpu.plan_apply")
+
+
+def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
+    """Would this plan's changes to one node fit? (reference :631)."""
+    proposed = plan.node_allocation.get(node_id, [])
+    if not proposed:
+        return True, ""  # stops/preemptions alone always apply
+    node = snapshot.node_by_id(node_id)
+    if node is None:
+        return False, "node does not exist"
+    if node.status != NODE_STATUS_READY:
+        return False, f"node is {node.status}"
+
+    existing = snapshot.allocs_by_node_terminal(node_id, False)
+    remove = {a.id for a in plan.node_update.get(node_id, [])}
+    remove |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+    update_ids = {a.id for a in proposed}
+    keep = [a for a in existing if a.id not in remove and a.id not in update_ids]
+    fit, dim, _ = allocs_fit(node, keep + list(proposed))
+    if not fit:
+        return False, dim
+    return True, ""
+
+
+def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
+    """Re-verify the whole plan; return the committable subset
+    (reference :400)."""
+    result = PlanResult(
+        node_update=dict(plan.node_update),
+        node_allocation={},
+        node_preemptions=dict(plan.node_preemptions),
+        deployment=plan.deployment,
+        deployment_updates=list(plan.deployment_updates),
+    )
+    rejected = False
+    for node_id in plan.node_allocation:
+        ok, reason = evaluate_node_plan(snapshot, plan, node_id)
+        if ok:
+            result.node_allocation[node_id] = plan.node_allocation[node_id]
+        else:
+            rejected = True
+            logger.debug("plan for node %s rejected: %s", node_id, reason)
+    if rejected:
+        if plan.all_at_once:
+            # all-or-nothing jobs: reject the ENTIRE plan — stops,
+            # preemptions, and deployment changes must not land without
+            # their placements.
+            result.node_allocation = {}
+            result.node_update = {}
+            result.node_preemptions = {}
+            result.deployment = None
+            result.deployment_updates = []
+        result.refresh_index = snapshot.index
+    return result
+
+
+class PlanApplier:
+    """Dequeues plans, verifies, applies through the raft layer."""
+
+    def __init__(self, queue: PlanQueue, state, raft_apply: Callable) -> None:
+        self.queue = queue
+        self.state = state  # live StateStore
+        self.raft_apply = raft_apply  # (msg_type, payload) -> index
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="plan-applier"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            item = self.queue.dequeue(timeout_s=0.2)
+            if item is None:
+                continue
+            plan, fut = item
+            try:
+                result = self.apply_one(plan)
+                fut.set_result(result)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.exception("plan apply failed")
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def apply_one(self, plan: Plan) -> PlanResult:
+        snapshot = self.state.snapshot()
+        result = evaluate_plan(snapshot, plan)
+        if result.is_no_op():
+            return result
+        index = self.raft_apply("apply_plan_results", result)
+        result.alloc_index = index
+        return result

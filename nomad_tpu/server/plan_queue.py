@@ -1,0 +1,64 @@
+"""Plan queue: priority-ordered pending plans awaiting serial application.
+
+Reference: nomad/plan_queue.go — Enqueue :95 returns a future the scheduler
+worker blocks on; the plan applier dequeues in priority order.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import Optional
+
+from ..structs import Plan
+
+
+class PlanQueue:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._heap: list = []
+        self._counter = itertools.count()
+        self._enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            was = self._enabled
+            self._enabled = enabled
+            if was and not enabled:
+                for _, _, _, fut in self._heap:
+                    fut.cancel()
+                self._heap.clear()
+            self._cv.notify_all()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enqueue(self, plan: Plan) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if not self._enabled:
+                fut.set_exception(RuntimeError("plan queue is disabled"))
+                return fut
+            heapq.heappush(
+                self._heap, (-plan.priority, next(self._counter), plan, fut)
+            )
+            self._cv.notify_all()
+        return fut
+
+    def dequeue(self, timeout_s: Optional[float] = None) -> Optional[tuple[Plan, Future]]:
+        with self._cv:
+            while True:
+                if self._heap:
+                    _, _, plan, fut = heapq.heappop(self._heap)
+                    return plan, fut
+                if not self._cv.wait(timeout_s if timeout_s is not None else 1.0):
+                    if timeout_s is not None:
+                        return None
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)

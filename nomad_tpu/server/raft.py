@@ -1,0 +1,192 @@
+"""Replicated log + FSM layer.
+
+Reference: nomad/fsm.go (~45 message types applied to the state store) +
+hashicorp/raft. Round-1 scope: a single-node ordered log whose apply path
+runs through the same FSM dispatch a multi-node deployment will use —
+Phase 2 swaps `InmemLog` for a real replicated log (leader election,
+append-entries over the RPC fabric, snapshot install) without touching the
+FSM or any caller.
+
+Every state mutation in the server goes through `raft_apply(type, payload)`
+— nothing writes the state store directly — exactly the reference's
+discipline (fsm.go:210-306 dispatch).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Callable, Optional
+
+from ..state import StateStore
+from ..structs import (
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    PlanResult,
+)
+
+
+class FSM:
+    """Applies committed log entries to the state store.
+
+    Message types mirror the reference's MessageType set (structs.go:68-120
+    / fsm.go dispatch) with snake_case names.
+    """
+
+    def __init__(self, state: StateStore) -> None:
+        self.state = state
+        # side-channels the leader wires up (reference fsm.go:746: the FSM
+        # pokes the eval broker / blocked evals on apply)
+        self.on_eval_update: Optional[Callable] = None
+        self.on_node_update: Optional[Callable] = None
+        self.on_alloc_client_update: Optional[Callable] = None
+        self._handlers = {
+            "node_register": self._apply_node_register,
+            "node_deregister": self._apply_node_deregister,
+            "node_update_status": self._apply_node_status,
+            "node_update_drain": self._apply_node_drain,
+            "node_update_eligibility": self._apply_node_eligibility,
+            "job_register": self._apply_job_register,
+            "job_deregister": self._apply_job_deregister,
+            "eval_update": self._apply_eval_update,
+            "eval_delete": self._apply_eval_delete,
+            "alloc_update": self._apply_alloc_update,
+            "alloc_client_update": self._apply_alloc_client_update,
+            "alloc_update_desired_transition": self._apply_desired_transition,
+            "apply_plan_results": self._apply_plan_results,
+            "deployment_upsert": self._apply_deployment_upsert,
+            "deployment_status_update": self._apply_deployment_status,
+            "deployment_delete": self._apply_deployment_delete,
+            "batch_node_drain_update": self._apply_batch_drain,
+        }
+
+    def apply(self, index: int, msg_type: str, payload) -> object:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise ValueError(f"unknown raft message type {msg_type!r}")
+        return handler(index, payload)
+
+    # -- handlers ------------------------------------------------------
+
+    def _apply_node_register(self, index: int, node) -> None:
+        self.state.upsert_node(index, node)
+        if self.on_node_update:
+            self.on_node_update(node)
+
+    def _apply_node_deregister(self, index: int, node_id: str) -> None:
+        self.state.delete_node(index, node_id)
+
+    def _apply_node_status(self, index: int, payload) -> None:
+        node_id, status = payload
+        self.state.update_node_status(index, node_id, status)
+        if self.on_node_update:
+            self.on_node_update(self.state.node_by_id(node_id))
+
+    def _apply_node_drain(self, index: int, payload) -> None:
+        node_id, drain, mark_eligible = payload
+        self.state.update_node_drain(index, node_id, drain, mark_eligible)
+
+    def _apply_node_eligibility(self, index: int, payload) -> None:
+        node_id, eligibility = payload
+        self.state.update_node_eligibility(index, node_id, eligibility)
+        if self.on_node_update:
+            self.on_node_update(self.state.node_by_id(node_id))
+
+    def _apply_job_register(self, index: int, payload) -> None:
+        job, eval_obj = payload
+        self.state.upsert_job(index, job)
+        if eval_obj is not None:
+            self.state.upsert_evals(index, [eval_obj])
+            if self.on_eval_update:
+                self.on_eval_update([eval_obj])
+
+    def _apply_job_deregister(self, index: int, payload) -> None:
+        namespace, job_id, purge, eval_obj = payload
+        if purge:
+            self.state.delete_job(index, namespace, job_id)
+        else:
+            job = self.state.job_by_id(namespace, job_id)
+            if job is not None:
+                stopped = job.copy()
+                stopped.stop = True
+                self.state.upsert_job(index, stopped)
+        if eval_obj is not None:
+            self.state.upsert_evals(index, [eval_obj])
+            if self.on_eval_update:
+                self.on_eval_update([eval_obj])
+
+    def _apply_eval_update(self, index: int, evals: list[Evaluation]) -> None:
+        self.state.upsert_evals(index, evals)
+        if self.on_eval_update:
+            self.on_eval_update(evals)
+
+    def _apply_eval_delete(self, index: int, payload) -> None:
+        eval_ids, alloc_ids = payload
+        self.state.delete_evals(index, eval_ids, alloc_ids)
+
+    def _apply_alloc_update(self, index: int, allocs: list[Allocation]) -> None:
+        self.state.upsert_allocs(index, allocs)
+
+    def _apply_alloc_client_update(self, index: int, allocs) -> None:
+        self.state.update_allocs_from_client(index, allocs)
+        if self.on_alloc_client_update:
+            self.on_alloc_client_update(allocs)
+
+    def _apply_desired_transition(self, index: int, payload) -> None:
+        transitions, evals = payload
+        self.state.update_alloc_desired_transition(index, transitions, evals)
+        if evals and self.on_eval_update:
+            self.on_eval_update(evals)
+
+    def _apply_plan_results(self, index: int, result: PlanResult) -> None:
+        self.state.upsert_plan_results(index, result)
+
+    def _apply_deployment_upsert(self, index: int, deployment: Deployment) -> None:
+        self.state.upsert_deployment(index, deployment)
+
+    def _apply_deployment_status(self, index: int, update) -> None:
+        self.state.update_deployment_status(index, update)
+
+    def _apply_deployment_delete(self, index: int, ids: list[str]) -> None:
+        self.state.delete_deployment(index, ids)
+
+    def _apply_batch_drain(self, index: int, payload) -> None:
+        # {node_id: DrainStrategy|None}
+        for node_id, drain in payload.items():
+            self.state.update_node_drain(index, node_id, drain)
+
+
+class InmemLog:
+    """Single-node ordered log. Serial, durable-in-memory; snapshot() dumps
+    the entries for tests and for the Phase-2 replication layer to seed
+    followers."""
+
+    def __init__(self, fsm: FSM) -> None:
+        self.fsm = fsm
+        self._lock = threading.Lock()
+        self._index = 0
+        self._entries: list[tuple[int, str, object]] = []
+
+    @property
+    def last_index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def apply(self, msg_type: str, payload) -> int:
+        """Append + apply. Returns the entry's index."""
+        with self._lock:
+            self._index += 1
+            index = self._index
+            self._entries.append((index, msg_type, payload))
+        self.fsm.apply(index, msg_type, payload)
+        return index
+
+    def entries_since(self, index: int) -> list[tuple[int, str, object]]:
+        with self._lock:
+            return [e for e in self._entries if e[0] > index]
+
+    def snapshot_bytes(self) -> bytes:
+        with self._lock:
+            return pickle.dumps((self._index, self._entries))

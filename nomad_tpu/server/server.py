@@ -1,0 +1,377 @@
+"""The server: replicated state + leader-only scheduling subsystems.
+
+Reference: nomad/server.go (wiring), nomad/leader.go:224
+establishLeadership (broker/plan-queue/blocked-evals/heartbeat lifecycle),
+nomad/node_endpoint.go (node RPCs incl. createNodeEvals :495),
+nomad/job_endpoint.go (job register/deregister), nomad/eval_endpoint.go.
+
+Round-1 scope: single process, single "region"; every mutation flows
+through raft_apply so Phase 2 can drop in real replication. The endpoint
+methods here are what the RPC layer (and the HTTP API above it) call.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..scheduler.context import SchedulerConfig
+from ..state import StateStore
+from ..structs import (
+    Allocation,
+    DrainStrategy,
+    Evaluation,
+    Job,
+    generate_uuid,
+    now_ns,
+)
+from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_FAILED,
+    EVAL_STATUS_BLOCKED,
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_JOB_DEREGISTER,
+    EVAL_TRIGGER_JOB_REGISTER,
+    EVAL_TRIGGER_NODE_DRAIN,
+    EVAL_TRIGGER_NODE_UPDATE,
+    EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+    JOB_TYPE_CORE,
+    JOB_TYPE_SERVICE,
+    NODE_STATUS_DOWN,
+    NODE_STATUS_READY,
+)
+from .blocked_evals import BlockedEvals
+from .eval_broker import EvalBroker
+from .heartbeat import HeartbeatTimers
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .raft import FSM, InmemLog
+from .worker import TPUBatchWorker, Worker
+
+logger = logging.getLogger("nomad_tpu.server")
+
+
+class Server:
+    def __init__(
+        self,
+        num_workers: int = 2,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        use_tpu_batch_worker: bool = False,
+    ) -> None:
+        self.state = StateStore()
+        self.fsm = FSM(self.state)
+        self.log = InmemLog(self.fsm)
+        self.scheduler_config = scheduler_config or SchedulerConfig()
+
+        self.eval_broker = EvalBroker()
+        self.plan_queue = PlanQueue()
+        self.plan_applier = PlanApplier(self.plan_queue, self.state, self.raft_apply)
+        self.blocked_evals = BlockedEvals(self._requeue_unblocked)
+        self.heartbeaters = HeartbeatTimers(self._invalidate_heartbeat)
+        self.heartbeaters.node_count_fn = lambda: len(self.state.nodes())
+
+        self.workers: list[Worker] = []
+        self.tpu_worker: Optional[TPUBatchWorker] = None
+        if use_tpu_batch_worker:
+            self.tpu_worker = TPUBatchWorker(self, config=self.scheduler_config)
+            system_worker = Worker(
+                self, ["system", "sysbatch", JOB_TYPE_CORE],
+                self.scheduler_config, name="worker-system",
+            )
+            self.workers.append(system_worker)
+        else:
+            for i in range(num_workers):
+                self.workers.append(
+                    Worker(
+                        self,
+                        ["service", "batch", "system", "sysbatch", JOB_TYPE_CORE],
+                        self.scheduler_config,
+                        name=f"worker-{i}",
+                    )
+                )
+
+        # FSM side-channels (reference fsm.go:746)
+        self.fsm.on_eval_update = self._on_eval_update
+        self.fsm.on_node_update = self._on_node_update
+        self.fsm.on_alloc_client_update = self._on_alloc_client_update
+        self._leader = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def establish_leadership(self) -> None:
+        """Enable leader-only subsystems (reference leader.go:224)."""
+        self.eval_broker.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.heartbeaters.set_enabled(True)
+        self.plan_applier.start()
+        for w in self.workers:
+            w.start()
+        if self.tpu_worker:
+            self.tpu_worker.start()
+        self._leader = True
+        self._restore_evals()
+
+    def revoke_leadership(self) -> None:
+        self._leader = False
+        for w in self.workers:
+            w.stop()
+        if self.tpu_worker:
+            self.tpu_worker.stop()
+        self.plan_applier.stop()
+        self.eval_broker.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.heartbeaters.set_enabled(False)
+
+    def shutdown(self) -> None:
+        self.revoke_leadership()
+
+    def _restore_evals(self) -> None:
+        """Broker state is not persisted; rebuild from the state store
+        (reference leader.go:495 restoreEvals)."""
+        for ev in self.state.evals():
+            if ev.status == EVAL_STATUS_PENDING:
+                self.eval_broker.enqueue(ev)
+            elif ev.status == EVAL_STATUS_BLOCKED:
+                self.blocked_evals.block(ev)
+
+    # -- raft ----------------------------------------------------------
+
+    def raft_apply(self, msg_type: str, payload) -> int:
+        return self.log.apply(msg_type, payload)
+
+    # -- FSM side channels --------------------------------------------
+
+    def _on_eval_update(self, evals: list[Evaluation]) -> None:
+        if not self._leader:
+            return
+        for ev in evals:
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _on_node_update(self, node) -> None:
+        if not self._leader or node is None:
+            return
+        # capacity may have appeared: unblock evals for this class
+        if node.status == NODE_STATUS_READY:
+            self.blocked_evals.unblock(node.computed_class)
+
+    def _on_alloc_client_update(self, allocs) -> None:
+        if not self._leader:
+            return
+        # terminal allocs free capacity on their node's class
+        for alloc in allocs:
+            if alloc.client_terminal_status():
+                node = self.state.node_by_id(alloc.node_id)
+                if node is not None:
+                    self.blocked_evals.unblock(node.computed_class)
+
+    def _requeue_unblocked(self, ev: Evaluation) -> None:
+        self.raft_apply("eval_update", [ev])
+
+    # -- job endpoint --------------------------------------------------
+
+    def job_register(self, job: Job) -> str:
+        """Returns the created eval id (reference job_endpoint.go:80)."""
+        job = job.copy()
+        job.canonicalize()
+        job.validate()
+        ev = None
+        if not job.is_periodic() and not job.is_parameterized():
+            ev = Evaluation(
+                id=generate_uuid(),
+                namespace=job.namespace,
+                priority=job.priority,
+                type=job.type,
+                triggered_by=EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id,
+                status=EVAL_STATUS_PENDING,
+                create_time=now_ns(),
+                modify_time=now_ns(),
+            )
+        self.raft_apply("job_register", (job, ev))
+        return ev.id if ev else ""
+
+    def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
+        job = self.state.job_by_id(namespace, job_id)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by=EVAL_TRIGGER_JOB_DEREGISTER,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self.raft_apply("job_deregister", (namespace, job_id, purge, ev))
+        self.blocked_evals.untrack(namespace, job_id)
+        return ev.id
+
+    # -- node endpoint -------------------------------------------------
+
+    def node_register(self, node) -> float:
+        """Returns the heartbeat TTL (reference node_endpoint.go Register)."""
+        node = node.copy()
+        if not node.status:
+            node.status = NODE_STATUS_READY
+        self.raft_apply("node_register", node)
+        # A ready node may unblock system jobs / blocked evals
+        # (reference node_endpoint.go Register -> createNodeEvals).
+        stored = self.state.node_by_id(node.id)
+        if stored is not None and stored.ready():
+            self._create_node_evals(node.id)
+        return self.heartbeaters.reset(node.id)
+
+    def node_heartbeat(self, node_id: str) -> float:
+        """Node.UpdateStatus(ready) fast-path: rearm the TTL."""
+        node = self.state.node_by_id(node_id)
+        if node is None:
+            raise KeyError(f"unknown node {node_id}")
+        if node.status != NODE_STATUS_READY:
+            self.node_update_status(node_id, NODE_STATUS_READY)
+        return self.heartbeaters.reset(node_id)
+
+    def node_update_status(self, node_id: str, status: str) -> None:
+        self.raft_apply("node_update_status", (node_id, status))
+        if status == NODE_STATUS_DOWN:
+            self.heartbeaters.clear(node_id)
+            self._create_node_evals(node_id)
+
+    def node_update_drain(
+        self, node_id: str, drain: Optional[DrainStrategy], mark_eligible: bool = False
+    ) -> None:
+        self.raft_apply("node_update_drain", (node_id, drain, mark_eligible))
+        if drain is not None:
+            self._create_node_evals(node_id, trigger=EVAL_TRIGGER_NODE_DRAIN)
+
+    def node_update_eligibility(self, node_id: str, eligibility: str) -> None:
+        self.raft_apply("node_update_eligibility", (node_id, eligibility))
+
+    def _invalidate_heartbeat(self, node_id: str) -> None:
+        """TTL expired: node is presumed dead (reference heartbeat.go:128)."""
+        logger.warning("node %s missed heartbeat; marking down", node_id)
+        try:
+            self.node_update_status(node_id, NODE_STATUS_DOWN)
+        except KeyError:
+            pass
+
+    def _create_node_evals(
+        self, node_id: str, trigger: str = EVAL_TRIGGER_NODE_UPDATE
+    ) -> list[str]:
+        """One eval per job with allocs on the node (reference
+        node_endpoint.go:495 createNodeEvals)."""
+        node = self.state.node_by_id(node_id)
+        evals: list[Evaluation] = []
+        seen: set[tuple[str, str]] = set()
+        for alloc in self.state.allocs_by_node(node_id):
+            key = (alloc.namespace, alloc.job_id)
+            if key in seen or alloc.terminal_status():
+                continue
+            seen.add(key)
+            job = alloc.job or self.state.job_by_id(*key)
+            if job is None:
+                continue
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    namespace=alloc.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=trigger,
+                    job_id=alloc.job_id,
+                    node_id=node_id,
+                    node_modify_index=node.modify_index if node else 0,
+                    status=EVAL_STATUS_PENDING,
+                    create_time=now_ns(),
+                    modify_time=now_ns(),
+                )
+            )
+        # system jobs must also react to NEW nodes with no allocs yet
+        if trigger == EVAL_TRIGGER_NODE_UPDATE and node is not None and node.ready():
+            for job in self.state.jobs():
+                if job.type in ("system", "sysbatch") and (job.namespace, job.id) not in seen:
+                    evals.append(
+                        Evaluation(
+                            id=generate_uuid(),
+                            namespace=job.namespace,
+                            priority=job.priority,
+                            type=job.type,
+                            triggered_by=trigger,
+                            job_id=job.id,
+                            node_id=node_id,
+                            status=EVAL_STATUS_PENDING,
+                            create_time=now_ns(),
+                            modify_time=now_ns(),
+                        )
+                    )
+        if evals:
+            self.raft_apply("eval_update", evals)
+        return [e.id for e in evals]
+
+    # -- client alloc updates -----------------------------------------
+
+    def update_allocs_from_client(self, allocs: list[Allocation]) -> None:
+        """Node.UpdateAlloc: merge client status; failed allocs trigger
+        reschedule evals (reference node_endpoint.go UpdateAlloc)."""
+        self.raft_apply("alloc_client_update", allocs)
+        evals: list[Evaluation] = []
+        seen: set[tuple[str, str]] = set()
+        for alloc in allocs:
+            if alloc.client_status != ALLOC_CLIENT_STATUS_FAILED:
+                continue
+            key = (alloc.namespace, alloc.job_id)
+            if key in seen:
+                continue
+            stored = self.state.alloc_by_id(alloc.id)
+            job = (stored.job if stored else None) or self.state.job_by_id(*key)
+            if job is None or job.stopped():
+                continue
+            seen.add(key)
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    namespace=alloc.namespace,
+                    priority=job.priority,
+                    type=job.type,
+                    triggered_by=EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+                    job_id=alloc.job_id,
+                    status=EVAL_STATUS_PENDING,
+                    create_time=now_ns(),
+                    modify_time=now_ns(),
+                )
+            )
+        if evals:
+            self.raft_apply("eval_update", evals)
+
+    # -- client pull (blocking query) ---------------------------------
+
+    def get_client_allocs(
+        self, node_id: str, min_index: int = 0, timeout_s: float = 5.0
+    ) -> tuple[list[Allocation], int]:
+        """Node.GetClientAllocs: blocking query on the alloc table."""
+        from ..state.store import TABLE_ALLOCS
+
+        index = self.state.wait_for_index([TABLE_ALLOCS], min_index, timeout_s)
+        return self.state.allocs_by_node(node_id), index
+
+    # -- draining helpers ---------------------------------------------
+
+    def wait_for_evals(self, timeout_s: float = 10.0) -> bool:
+        """Test helper: block until no ready/in-flight evals remain."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if (
+                self.eval_broker.ready_count() == 0
+                and self.eval_broker.inflight_count() == 0
+                and self.plan_queue.depth() == 0
+            ):
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,212 @@
+"""Scheduler workers: dequeue evals, invoke a scheduler, submit plans.
+
+Reference: nomad/worker.go — run :105, dequeueEvaluation :142,
+snapshotMinIndex :228, invokeScheduler :244, SubmitPlan :277 (the Planner
+implementation backed by the plan queue).
+
+Two worker flavors:
+  * Worker — the reference-shaped loop: one eval at a time through the
+    scheduler factory (host or TPU backend per SchedulerConfig).
+  * TPUBatchWorker — drains many ready evals and solves them in ONE tensor
+    batch (scheduler/tpu solve_eval_batch), submitting one plan per eval.
+    This is what the ≥20x throughput target rides on: the broker's per-job
+    serialization still holds (each dequeued eval is a different job).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..scheduler import new_scheduler
+from ..scheduler.context import SchedulerConfig
+from ..structs import Evaluation, Plan, PlanResult
+
+logger = logging.getLogger("nomad_tpu.worker")
+
+DEQUEUE_TIMEOUT_S = 0.5
+
+
+class WorkerPlanner:
+    """Planner interface backed by the server's plan queue + raft apply."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+
+    def submit_plan(self, plan: Plan):
+        fut = self.server.plan_queue.enqueue(plan)
+        result: PlanResult = fut.result(timeout=30)
+        new_state = None
+        if result.refresh_index > 0:
+            new_state = self.server.state.snapshot_min_index(
+                result.refresh_index, timeout_s=5
+            )
+        return result, new_state
+
+    def update_eval(self, eval_obj: Evaluation) -> None:
+        self.server.raft_apply("eval_update", [eval_obj])
+
+    def create_eval(self, eval_obj: Evaluation) -> None:
+        self.server.raft_apply("eval_update", [eval_obj])
+
+    def refresh_state(self, min_index: int):
+        return self.server.state.snapshot_min_index(min_index, timeout_s=5)
+
+
+class Worker:
+    def __init__(
+        self,
+        server,
+        schedulers: list[str],
+        config: Optional[SchedulerConfig] = None,
+        name: str = "worker",
+    ) -> None:
+        self.server = server
+        self.schedulers = schedulers
+        self.config = config or SchedulerConfig()
+        self.name = name
+        self.planner = WorkerPlanner(server)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.processed = 0
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True, name=self.name)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 2.0) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    def _run(self) -> None:
+        broker = self.server.eval_broker
+        while not self._stop.is_set():
+            ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
+            if ev is None:
+                continue
+            try:
+                self._process(ev)
+            except Exception:
+                logger.exception("%s: eval %s failed", self.name, ev.id)
+                try:
+                    broker.nack(ev.id, token)
+                except ValueError:
+                    pass
+                continue
+            try:
+                broker.ack(ev.id, token)
+            except ValueError:
+                pass
+            self.processed += 1
+
+    def _process(self, ev: Evaluation) -> None:
+        # Wait until our snapshot has caught up to the eval's creation
+        # (reference: worker.go:121 snapshotMinIndex).
+        wait_index = max(ev.modify_index, ev.snapshot_index)
+        snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        sched = new_scheduler(ev.type, logger, snapshot, self.planner, self.config)
+        sched.process(ev)
+
+
+class TPUBatchWorker:
+    """Drains up to `batch_size` ready evals per cycle and solves them in
+    one batched tensor program."""
+
+    def __init__(
+        self,
+        server,
+        schedulers: list[str] = ("service", "batch"),
+        batch_size: int = 64,
+        config: Optional[SchedulerConfig] = None,
+    ) -> None:
+        self.server = server
+        self.schedulers = list(schedulers)
+        self.batch_size = batch_size
+        self.config = config or SchedulerConfig(backend="tpu")
+        self.planner = WorkerPlanner(server)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.processed = 0
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tpu-batch-worker"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        broker = self.server.eval_broker
+        while not self._stop.is_set():
+            batch: list[tuple[Evaluation, str]] = []
+            ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
+            if ev is None:
+                continue
+            batch.append((ev, token))
+            # opportunistically drain more ready evals without waiting
+            while len(batch) < self.batch_size:
+                ev2, token2 = broker.dequeue(self.schedulers, timeout_s=0.01)
+                if ev2 is None:
+                    break
+                batch.append((ev2, token2))
+            try:
+                self._process_batch([e for e, _ in batch])
+            except Exception:
+                logger.exception("tpu batch of %d failed", len(batch))
+                for ev_, tok in batch:
+                    try:
+                        broker.nack(ev_.id, tok)
+                    except ValueError:
+                        pass
+                continue
+            for ev_, tok in batch:
+                try:
+                    broker.ack(ev_.id, tok)
+                except ValueError:
+                    pass
+            self.processed += len(batch)
+
+    def _process_batch(self, evals: list[Evaluation]) -> None:
+        from ..scheduler.tpu import solve_eval_batch
+
+        wait_index = max(
+            max(ev.modify_index for ev in evals),
+            max(ev.snapshot_index for ev in evals),
+        )
+        snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        plans = solve_eval_batch(snapshot, self.planner, evals, self.config)
+        updates: list[Evaluation] = []
+        for ev in evals:
+            plan = plans[ev.id]
+            failed = dict(ev.failed_tg_allocs)
+            blocked: Optional[Evaluation] = None
+            if not plan.is_no_op():
+                result, new_state = self.planner.submit_plan(plan)
+                full, _, _ = result.full_commit(plan)
+                if not full:
+                    # partial commit: requeue the eval for a fresh pass
+                    retry = ev.copy()
+                    retry.status = "pending"
+                    retry.snapshot_index = result.refresh_index
+                    self.planner.create_eval(retry)
+                    continue
+            if failed:
+                blocked = ev.create_blocked_eval({}, True, "", failed)
+                blocked.status_description = "created to place remaining allocations"
+                self.planner.create_eval(blocked)
+            done = ev.copy()
+            done.status = "complete"
+            done.failed_tg_allocs = failed
+            if blocked is not None:
+                done.blocked_eval = blocked.id
+            updates.append(done)
+        if updates:
+            self.server.raft_apply("eval_update", updates)

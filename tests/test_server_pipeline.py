@@ -1,0 +1,309 @@
+"""Server pipeline tests: broker, plan queue/applier, workers, blocked
+evals, heartbeats (reference analogs: nomad/eval_broker_test.go,
+nomad/plan_apply_test.go, nomad/worker_test.go)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import EvalBroker, Server, evaluate_plan
+from nomad_tpu.server.eval_broker import FAILED_QUEUE
+from nomad_tpu.structs import Plan, PlanResult
+
+
+# ---------------------------------------------------------------------------
+# Broker
+# ---------------------------------------------------------------------------
+
+
+def test_broker_priority_and_fifo():
+    b = EvalBroker()
+    b.set_enabled(True)
+    low = mock.evaluation(priority=10)
+    high = mock.evaluation(priority=90)
+    mid1 = mock.evaluation(priority=50)
+    mid2 = mock.evaluation(priority=50)
+    for e in (low, mid1, mid2, high):
+        b.enqueue(e)
+    got = [b.dequeue(["service"], timeout_s=1)[0].id for _ in range(4)]
+    assert got == [high.id, mid1.id, mid2.id, low.id]
+    b.set_enabled(False)
+
+
+def test_broker_per_job_serialization():
+    b = EvalBroker()
+    b.set_enabled(True)
+    job_id = "serial-job"
+    e1 = mock.evaluation(job_id=job_id)
+    e2 = mock.evaluation(job_id=job_id)
+    b.enqueue(e1)
+    b.enqueue(e2)
+    got1, tok1 = b.dequeue(["service"], timeout_s=1)
+    assert got1.id == e1.id
+    # e2 must NOT be dequeueable while e1 is in flight
+    got_none, _ = b.dequeue(["service"], timeout_s=0.1)
+    assert got_none is None
+    b.ack(e1.id, tok1)
+    got2, tok2 = b.dequeue(["service"], timeout_s=1)
+    assert got2.id == e2.id
+    b.ack(e2.id, tok2)
+    b.set_enabled(False)
+
+
+def test_broker_nack_requeues_then_fails():
+    b = EvalBroker(nack_delay_s=0.01, delivery_limit=2)
+    b.set_enabled(True)
+    e = mock.evaluation()
+    b.enqueue(e)
+    got, tok = b.dequeue(["service"], timeout_s=1)
+    b.nack(got.id, tok)
+    got2, tok2 = b.dequeue(["service"], timeout_s=2)
+    assert got2.id == e.id
+    b.nack(got2.id, tok2)  # second nack hits the delivery limit
+    got3, _ = b.dequeue(["service"], timeout_s=0.3)
+    assert got3 is None  # went to failed queue, not service
+    failed, _ = b.dequeue([FAILED_QUEUE], timeout_s=0.5)
+    assert failed is not None and failed.id == e.id
+    b.set_enabled(False)
+
+
+def test_broker_scheduler_type_routing():
+    b = EvalBroker()
+    b.set_enabled(True)
+    svc = mock.evaluation(type="service")
+    sys_ = mock.evaluation(type="system")
+    b.enqueue(svc)
+    b.enqueue(sys_)
+    got, tok = b.dequeue(["system"], timeout_s=1)
+    assert got.id == sys_.id
+    b.ack(got.id, tok)
+    got2, tok2 = b.dequeue(["service"], timeout_s=1)
+    assert got2.id == svc.id
+    b.set_enabled(False)
+
+
+def test_broker_delayed_eval():
+    from nomad_tpu.structs import now_ns
+
+    b = EvalBroker()
+    b.set_enabled(True)
+    e = mock.evaluation(wait_until_ns=now_ns() + int(0.2 * 1e9))
+    b.enqueue(e)
+    got, _ = b.dequeue(["service"], timeout_s=0.05)
+    assert got is None  # not ready yet
+    got2, tok = b.dequeue(["service"], timeout_s=2)
+    assert got2 is not None and got2.id == e.id
+    b.ack(got2.id, tok)
+    b.set_enabled(False)
+
+
+def test_broker_token_mismatch():
+    b = EvalBroker()
+    b.set_enabled(True)
+    e = mock.evaluation()
+    b.enqueue(e)
+    got, tok = b.dequeue(["service"], timeout_s=1)
+    with pytest.raises(ValueError):
+        b.ack(got.id, "wrong-token")
+    b.ack(got.id, tok)
+    b.set_enabled(False)
+
+
+# ---------------------------------------------------------------------------
+# Plan applier verification
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_plan_rejects_overcommit():
+    from nomad_tpu.state import StateStore
+
+    s = StateStore()
+    node = mock.node()
+    s.upsert_node(1, node)
+    job = mock.job()
+    s.upsert_job(2, job)
+    # existing allocs fill the node (8 x 500)
+    existing = [mock.alloc(job, node, index=i) for i in range(8)]
+    s.upsert_allocs(3, existing)
+    plan = Plan(eval_id="e", job=job)
+    overflow = mock.alloc(job, node, index=9)
+    plan.append_alloc(overflow, job)
+    result = evaluate_plan(s.snapshot(), plan)
+    assert result.node_allocation == {}
+    assert result.refresh_index > 0
+
+    # stopping an alloc frees room: same plan plus a stop is accepted
+    plan2 = Plan(eval_id="e2", job=job)
+    plan2.append_stopped_alloc(existing[0], "making room")
+    plan2.append_alloc(overflow, job)
+    result2 = evaluate_plan(s.snapshot(), plan2)
+    assert len(result2.node_allocation.get(node.id, [])) == 1
+
+
+def test_evaluate_plan_rejects_down_node():
+    from nomad_tpu.state import StateStore
+
+    s = StateStore()
+    node = mock.node()
+    s.upsert_node(1, node)
+    s.update_node_status(2, node.id, "down")
+    job = mock.job()
+    plan = Plan(eval_id="e", job=job)
+    plan.append_alloc(mock.alloc(job, node), job)
+    result = evaluate_plan(s.snapshot(), plan)
+    assert result.node_allocation == {}
+
+
+# ---------------------------------------------------------------------------
+# Full single-process pipeline through the Server
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=2)
+    s.establish_leadership()
+    yield s
+    s.shutdown()
+
+
+def test_server_job_register_to_allocs(server):
+    for _ in range(5):
+        server.node_register(mock.node())
+    job = mock.job()
+    eval_id = server.job_register(job)
+    assert eval_id
+    assert server.wait_for_evals(10)
+    allocs = server.state.allocs_by_job(job.namespace, job.id)
+    assert len(allocs) == 10
+    ev = server.state.eval_by_id(eval_id)
+    assert ev.status == "complete"
+    assert server.state.job_by_id(job.namespace, job.id).status == "running"
+
+
+def test_server_deregister_stops(server):
+    for _ in range(3):
+        server.node_register(mock.node())
+    job = mock.job()
+    server.job_register(job)
+    server.wait_for_evals(10)
+    server.job_deregister(job.namespace, job.id)
+    server.wait_for_evals(10)
+    live = [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert live == []
+
+
+def test_server_blocked_eval_unblocks_on_capacity(server):
+    node = server_node = mock.node()
+    server.node_register(node)
+    job = mock.job()  # 10 x 500MHz; one node fits 8
+    server.job_register(job)
+    server.wait_for_evals(10)
+    placed = [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(placed) == 8
+    assert server.blocked_evals.blocked_count() == 1
+
+    # new node arrives -> blocked eval unblocks -> remaining 2 place
+    server.node_register(mock.node())
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        live = [
+            a
+            for a in server.state.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        if len(live) == 10:
+            break
+        time.sleep(0.05)
+    assert len(live) == 10
+
+
+def test_server_node_down_reschedules(server):
+    n1 = mock.node()
+    n2 = mock.node()
+    server.node_register(n1)
+    server.node_register(n2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    server.job_register(job)
+    server.wait_for_evals(10)
+    server.node_update_status(n1.id, "down")
+    server.wait_for_evals(10)
+    live = [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(live) == 2
+    assert all(a.node_id == n2.id for a in live)
+
+
+def test_server_failed_alloc_creates_reschedule_eval(server):
+    server.node_register(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].reschedule_policy.delay_s = 0
+    server.job_register(job)
+    server.wait_for_evals(10)
+    alloc = server.state.allocs_by_job(job.namespace, job.id)[0]
+    failed = alloc.copy()
+    failed.client_status = "failed"
+    server.update_allocs_from_client([failed])
+    server.wait_for_evals(10)
+    pending = [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(pending) == 1
+    assert pending[0].id != alloc.id
+    assert pending[0].previous_allocation == alloc.id
+
+
+def test_server_system_job_on_new_node(server):
+    server.node_register(mock.node())
+    job = mock.system_job()
+    server.job_register(job)
+    server.wait_for_evals(10)
+    assert len(server.state.allocs_by_job(job.namespace, job.id)) == 1
+    server.node_register(mock.node())
+    server.wait_for_evals(10)
+    live = [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(live) == 2
+
+
+def test_server_tpu_batch_worker():
+    s = Server(use_tpu_batch_worker=True)
+    s.establish_leadership()
+    try:
+        for _ in range(10):
+            s.node_register(mock.node())
+        jobs = []
+        for i in range(5):
+            job = mock.job(id=f"tpu-batch-{i}")
+            job.task_groups[0].count = 4
+            s.job_register(job)
+            jobs.append(job)
+        assert s.wait_for_evals(30)
+        for job in jobs:
+            live = [
+                a
+                for a in s.state.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            ]
+            assert len(live) == 4, job.id
+    finally:
+        s.shutdown()
