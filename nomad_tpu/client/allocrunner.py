@@ -23,6 +23,7 @@ from ..structs.structs import (
     JOB_TYPE_BATCH,
     JOB_TYPE_SYSBATCH,
 )
+from .allochealth import HealthTracker, new_deployment_status
 from .taskrunner import TaskRunner
 
 logger = logging.getLogger("nomad_tpu.allocrunner")
@@ -43,6 +44,7 @@ class AllocRunner:
         self.task_runners: dict[str, TaskRunner] = {}
         self._lock = threading.Lock()
         self._destroyed = False
+        self._health: Optional[HealthTracker] = None
 
     # ------------------------------------------------------------------
 
@@ -76,7 +78,23 @@ class AllocRunner:
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():
             tr.start()
+        # Deployment allocs get a health watcher (reference
+        # alloc_runner_hooks.go: allocHealthWatcherHook → client/allochealth)
+        if self.alloc.deployment_id and self.alloc.deployment_status is None:
+            self._health = HealthTracker(
+                self.alloc, self._task_states, self._set_health
+            )
+            self._health.start()
         self._task_state_updated()
+
+    def _task_states(self) -> dict:
+        with self._lock:
+            return {name: tr.state for name, tr in self.task_runners.items()}
+
+    def _set_health(self, healthy: bool) -> None:
+        with self._lock:
+            self.alloc.deployment_status = new_deployment_status(healthy)
+        self.on_update(self.alloc)
 
     def _task_state_updated(self) -> None:
         """Fan task states into the alloc's client status
@@ -125,11 +143,17 @@ class AllocRunner:
             self.stop()
 
     def stop(self) -> None:
+        # A server-initiated stop must not race the health tracker into
+        # reporting a killed (dead, not failed) alloc as healthy.
+        if self._health is not None:
+            self._health.stop()
         for tr in self.task_runners.values():
             tr.kill()
 
     def destroy(self) -> None:
         self._destroyed = True
+        if self._health is not None:
+            self._health.stop()
         self.stop()
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:

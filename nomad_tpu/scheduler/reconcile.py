@@ -215,12 +215,14 @@ class AllocReconciler:
             if node != "ok" and not a.client_terminal_status():
                 if node is None or node.status == NODE_STATUS_DOWN:
                     lost.append(a)
-                else:
-                    # Draining node. The reference waits for the drainer to
-                    # set desired_transition.migrate; until the drainer
-                    # subsystem rate-limits migrations, allocs on a draining
-                    # node migrate immediately.
+                elif a.desired_transition.should_migrate():
+                    # The drainer subsystem marks allocs for migration with
+                    # rate limiting (reference reconcile_util.go
+                    # filterByTainted: drain-node allocs migrate only once
+                    # DesiredTransition.ShouldMigrate is set).
                     migrate.append(a)
+                else:
+                    stable.append(a)  # awaiting its drainer slot
                 continue
             if a.client_status == ALLOC_CLIENT_STATUS_FAILED:
                 if a.desired_transition.should_force_reschedule():

@@ -1,5 +1,9 @@
 from .blocked_evals import BlockedEvals
+from .core_sched import CoreScheduler, core_eval
+from .deployment_watcher import DeploymentsWatcher
+from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
+from .periodic import CronSpec, PeriodicDispatch, next_launch
 from .heartbeat import HeartbeatTimers, rate_scaled_interval
 from .plan_apply import PlanApplier, evaluate_node_plan, evaluate_plan
 from .plan_queue import PlanQueue

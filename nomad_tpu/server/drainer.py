@@ -1,0 +1,160 @@
+"""Leader-only node drainer.
+
+Reference: nomad/drainer/ — drainer.go (RaftApplier :45), watch_nodes.go
+(tracks draining nodes), watch_jobs.go (per-job migrate-stanza rate
+limiting), drain_heap.go (deadline timers).
+
+Redesign: one batched `run_once` pass over a single snapshot computes, for
+every draining node at once, which allocs to mark `desired_transition.
+migrate` — bounded per job by the migrate stanza's max_parallel — plus
+which nodes are done draining. A poll thread drives it; tests call
+`run_once` directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..structs import Evaluation, generate_uuid, now_ns
+from ..structs.structs import (
+    EVAL_STATUS_PENDING,
+    EVAL_TRIGGER_NODE_DRAIN,
+    JOB_TYPE_SERVICE,
+    JOB_TYPE_SYSBATCH,
+    JOB_TYPE_SYSTEM,
+    DesiredTransition,
+)
+
+logger = logging.getLogger("nomad_tpu.drainer")
+
+
+class NodeDrainer:
+    def __init__(self, state, raft_apply, poll_interval_s: float = 0.25) -> None:
+        self.state = state
+        self.raft_apply = raft_apply
+        self.poll_interval_s = poll_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="node-drainer"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("drainer pass failed")
+
+    # -- the batched drain pass ----------------------------------------
+
+    def run_once(self) -> int:
+        """Returns the number of allocs newly marked for migration."""
+        draining = [n for n in self.state.nodes() if n.drain]
+        if not draining:
+            return 0
+
+        transitions: dict[str, DesiredTransition] = {}
+        eval_jobs: set[tuple[str, str]] = set()
+        done_nodes: dict[str, None] = {}
+
+        # In-flight migrations per job across ALL draining nodes: an alloc
+        # already marked migrate whose replacement isn't healthy yet holds a
+        # max_parallel slot (reference watch_jobs.go handleTaskGroup).
+        inflight: dict[tuple[str, str, str], int] = {}
+        for node in draining:
+            for a in self.state.allocs_by_node(node.id):
+                if a.terminal_status():
+                    continue
+                if a.desired_transition.should_migrate():
+                    key = (a.namespace, a.job_id, a.task_group)
+                    inflight[key] = inflight.get(key, 0) + 1
+
+        for node in draining:
+            strategy = node.drain_strategy
+            force = strategy.deadline_expired()
+            remaining = []
+            for a in self.state.allocs_by_node(node.id):
+                if a.terminal_status():
+                    continue
+                job = a.job or self.state.job_by_id(a.namespace, a.job_id)
+                system = job is not None and job.type in (
+                    JOB_TYPE_SYSTEM,
+                    JOB_TYPE_SYSBATCH,
+                )
+                if system and strategy.ignore_system_jobs:
+                    continue
+                if system:
+                    # System allocs are only stopped once every service
+                    # alloc has drained (reference drainer.go: system
+                    # drains last) or at the deadline.
+                    remaining.append((a, job, True))
+                else:
+                    remaining.append((a, job, False))
+
+            service_left = [r for r in remaining if not r[2]]
+            if not remaining:
+                done_nodes[node.id] = None
+                continue
+
+            for a, job, system in remaining:
+                if a.desired_transition.should_migrate():
+                    continue  # already marked
+                if system and service_left and not force:
+                    continue  # system waits for services
+                key = (a.namespace, a.job_id, a.task_group)
+                if not force:
+                    limit = self._max_parallel(job, a.task_group)
+                    if inflight.get(key, 0) >= limit:
+                        continue
+                transitions[a.id] = DesiredTransition(migrate=True)
+                inflight[key] = inflight.get(key, 0) + 1
+                eval_jobs.add((a.namespace, a.job_id))
+
+        if transitions or done_nodes:
+            evals = [
+                self._drain_eval(ns, job_id) for ns, job_id in sorted(eval_jobs)
+            ]
+            if transitions:
+                self.raft_apply(
+                    "alloc_update_desired_transition", (transitions, evals)
+                )
+            if done_nodes:
+                # Drain complete: drop the strategy, node stays ineligible
+                # (reference watch_nodes.go Remove + batcher).
+                self.raft_apply(
+                    "batch_node_drain_update",
+                    {node_id: None for node_id in done_nodes},
+                )
+        return len(transitions)
+
+    def _max_parallel(self, job, group: str) -> int:
+        if job is None:
+            return 1
+        tg = job.lookup_task_group(group)
+        if tg is None or tg.migrate is None:
+            return 1
+        return max(1, tg.migrate.max_parallel)
+
+    def _drain_eval(self, namespace: str, job_id: str) -> Evaluation:
+        job = self.state.job_by_id(namespace, job_id)
+        return Evaluation(
+            id=generate_uuid(),
+            namespace=namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by=EVAL_TRIGGER_NODE_DRAIN,
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )

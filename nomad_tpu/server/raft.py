@@ -42,6 +42,7 @@ class FSM:
         self.on_eval_update: Optional[Callable] = None
         self.on_node_update: Optional[Callable] = None
         self.on_alloc_client_update: Optional[Callable] = None
+        self.on_job_upsert: Optional[Callable] = None  # periodic tracking
         self._handlers = {
             "node_register": self._apply_node_register,
             "node_deregister": self._apply_node_deregister,
@@ -59,6 +60,8 @@ class FSM:
             "deployment_upsert": self._apply_deployment_upsert,
             "deployment_status_update": self._apply_deployment_status,
             "deployment_delete": self._apply_deployment_delete,
+            "deployment_promote": self._apply_deployment_promote,
+            "deployment_alloc_health": self._apply_deployment_alloc_health,
             "batch_node_drain_update": self._apply_batch_drain,
         }
 
@@ -97,6 +100,11 @@ class FSM:
     def _apply_job_register(self, index: int, payload) -> None:
         job, eval_obj = payload
         self.state.upsert_job(index, job)
+        if self.on_job_upsert:
+            self.on_job_upsert(
+                self.state.job_by_id(job.namespace, job.id),
+                (job.namespace, job.id),
+            )
         if eval_obj is not None:
             self.state.upsert_evals(index, [eval_obj])
             if self.on_eval_update:
@@ -112,6 +120,10 @@ class FSM:
                 stopped = job.copy()
                 stopped.stop = True
                 self.state.upsert_job(index, stopped)
+        if self.on_job_upsert:
+            self.on_job_upsert(
+                self.state.job_by_id(namespace, job_id), (namespace, job_id)
+            )
         if eval_obj is not None:
             self.state.upsert_evals(index, [eval_obj])
             if self.on_eval_update:
@@ -151,6 +163,30 @@ class FSM:
 
     def _apply_deployment_delete(self, index: int, ids: list[str]) -> None:
         self.state.delete_deployment(index, ids)
+
+    def _apply_deployment_promote(self, index: int, payload) -> None:
+        """(deployment_id, groups|None, eval) — reference fsm.go
+        ApplyDeploymentPromotion."""
+        deployment_id, groups, eval_obj = payload
+        self.state.update_deployment_promotion(index, deployment_id, groups, eval_obj)
+        if eval_obj is not None and self.on_eval_update:
+            self.on_eval_update([eval_obj])
+
+    def _apply_deployment_alloc_health(self, index: int, payload) -> None:
+        """dict payload — reference fsm.go ApplyDeploymentAllocHealth
+        (health set + optional status update + optional job revert, atomic)."""
+        self.state.update_alloc_deployment_health(
+            index,
+            payload["deployment_id"],
+            payload.get("healthy_ids", []),
+            payload.get("unhealthy_ids", []),
+            payload.get("status_update"),
+            payload.get("eval"),
+            payload.get("revert_job"),
+        )
+        ev = payload.get("eval")
+        if ev is not None and self.on_eval_update:
+            self.on_eval_update([ev])
 
     def _apply_batch_drain(self, index: int, payload) -> None:
         # {node_id: DrainStrategy|None}

@@ -41,8 +41,12 @@ from ..structs.structs import (
     NODE_STATUS_READY,
 )
 from .blocked_evals import BlockedEvals
+from .core_sched import core_eval
+from .deployment_watcher import DeploymentsWatcher
+from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
 from .heartbeat import HeartbeatTimers
+from .periodic import PeriodicDispatch
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .raft import FSM, InmemLog
@@ -69,6 +73,14 @@ class Server:
         self.blocked_evals = BlockedEvals(self._requeue_unblocked)
         self.heartbeaters = HeartbeatTimers(self._invalidate_heartbeat)
         self.heartbeaters.node_count_fn = lambda: len(self.state.nodes())
+        self.deployment_watcher = DeploymentsWatcher(self.state, self.raft_apply)
+        self.drainer = NodeDrainer(self.state, self.raft_apply)
+        self.periodic = PeriodicDispatch(self.state, self.raft_apply)
+        # Threshold GC cadence (reference leader.go schedulePeriodic: one
+        # timer per GC kind, 5m default).
+        self.gc_interval_s = 300.0
+        self._gc_stop = threading.Event()
+        self._gc_thread: Optional[threading.Thread] = None
 
         self.workers: list[Worker] = []
         self.tpu_worker: Optional[TPUBatchWorker] = None
@@ -94,6 +106,7 @@ class Server:
         self.fsm.on_eval_update = self._on_eval_update
         self.fsm.on_node_update = self._on_node_update
         self.fsm.on_alloc_client_update = self._on_alloc_client_update
+        self.fsm.on_job_upsert = self._on_job_upsert
         self._leader = False
 
     # -- lifecycle -----------------------------------------------------
@@ -109,11 +122,23 @@ class Server:
             w.start()
         if self.tpu_worker:
             self.tpu_worker.start()
+        self.deployment_watcher.start()
+        self.drainer.start()
+        self.periodic.start()
+        self._gc_stop.clear()
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, daemon=True, name="gc-scheduler"
+        )
+        self._gc_thread.start()
         self._leader = True
         self._restore_evals()
 
     def revoke_leadership(self) -> None:
         self._leader = False
+        self._gc_stop.set()
+        self.deployment_watcher.stop()
+        self.drainer.stop()
+        self.periodic.stop()
         for w in self.workers:
             w.stop()
         if self.tpu_worker:
@@ -172,6 +197,16 @@ class Server:
     def _requeue_unblocked(self, ev: Evaluation) -> None:
         self.raft_apply("eval_update", [ev])
 
+    def _on_job_upsert(self, job, ns_id) -> None:
+        """Keep the periodic dispatcher's tracked set in sync with the FSM
+        (reference fsm.go ApplyJobRegister -> periodicDispatcher.Add)."""
+        if not self._leader:
+            return
+        if job is None:
+            self.periodic.remove(*ns_id)
+        else:
+            self.periodic.add(job)
+
     # -- job endpoint --------------------------------------------------
 
     def job_register(self, job: Job) -> str:
@@ -179,6 +214,14 @@ class Server:
         job = job.copy()
         job.canonicalize()
         job.validate()
+        if job.is_periodic():
+            # A malformed cron spec must be rejected at the API, not fire
+            # wild from the dispatcher (reference periodic.go Add validates).
+            import time as _time
+
+            from .periodic import next_launch
+
+            next_launch(job.periodic, _time.time())
         ev = None
         if not job.is_periodic() and not job.is_parameterized():
             ev = Evaluation(
@@ -312,6 +355,126 @@ class Server:
         if evals:
             self.raft_apply("eval_update", evals)
         return [e.id for e in evals]
+
+    # -- deployment endpoint (reference nomad/deployment_endpoint.go) --
+
+    def deployment_promote(
+        self, deployment_id: str, groups: Optional[list[str]] = None
+    ) -> None:
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise KeyError(f"unknown deployment {deployment_id}")
+        if not d.active():
+            raise ValueError(f"deployment {deployment_id} is terminal")
+        self.deployment_watcher.promote(d, groups)
+
+    def deployment_pause(self, deployment_id: str, pause: bool) -> None:
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise KeyError(f"unknown deployment {deployment_id}")
+        if not d.active():
+            raise ValueError(f"deployment {deployment_id} is terminal")
+        self.deployment_watcher.pause(d, pause)
+
+    def deployment_fail(self, deployment_id: str) -> None:
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise KeyError(f"unknown deployment {deployment_id}")
+        if not d.active():
+            raise ValueError(f"deployment {deployment_id} is terminal")
+        self.deployment_watcher.fail_deployment(d)
+
+    def alloc_set_health(
+        self, deployment_id: str, healthy: list[str], unhealthy: list[str]
+    ) -> None:
+        """Deployment.SetAllocHealth (manual health override)."""
+        d = self.state.deployment_by_id(deployment_id)
+        if d is None:
+            raise KeyError(f"unknown deployment {deployment_id}")
+        self.raft_apply(
+            "deployment_alloc_health",
+            {
+                "deployment_id": deployment_id,
+                "healthy_ids": healthy,
+                "unhealthy_ids": unhealthy,
+            },
+        )
+
+    # -- job revert / dispatch (reference nomad/job_endpoint.go) -------
+
+    def job_revert(self, namespace: str, job_id: str, version: int) -> str:
+        """Re-register an older job version (reference Job.Revert)."""
+        current = self.state.job_by_id(namespace, job_id)
+        if current is None:
+            raise KeyError(f"unknown job {job_id}")
+        if version == current.version:
+            raise ValueError(f"job is already at version {version}")
+        target = self.state.job_version(namespace, job_id, version)
+        if target is None:
+            raise KeyError(f"job {job_id} has no version {version}")
+        revert = target.copy()
+        revert.stable = False
+        return self.job_register(revert)
+
+    def job_dispatch(
+        self,
+        namespace: str,
+        job_id: str,
+        payload: bytes = b"",
+        meta: Optional[dict[str, str]] = None,
+    ) -> tuple[str, str]:
+        """Dispatch a parameterized job (reference Job.Dispatch). Returns
+        (child_job_id, eval_id)."""
+        parent = self.state.job_by_id(namespace, job_id)
+        if parent is None:
+            raise KeyError(f"unknown job {job_id}")
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id} is not parameterized")
+        cfg = parent.parameterized
+        meta = dict(meta or {})
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload is required by this job")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload is forbidden by this job")
+        for key in cfg.meta_required:
+            if key not in meta:
+                raise ValueError(f"missing required dispatch meta {key!r}")
+        for key in meta:
+            if key not in cfg.meta_required and key not in cfg.meta_optional:
+                raise ValueError(f"dispatch meta {key!r} not allowed")
+        child = parent.copy()
+        child.id = f"{parent.id}/dispatch-{now_ns() // 1_000_000_000}-{generate_uuid()[:8]}"
+        child.name = child.id
+        child.parent_id = parent.id
+        child.dispatched = True
+        child.payload = payload
+        child.meta.update(meta)
+        child.status = ""
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=child.namespace,
+            priority=child.priority,
+            type=child.type,
+            triggered_by="job-register",
+            job_id=child.id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self.raft_apply("job_register", (child, ev))
+        return child.id, ev.id
+
+    # -- GC (reference nomad/system_endpoint.go + leader.go) -----------
+
+    def force_gc(self) -> None:
+        """System.GarbageCollect: enqueue a force-gc core eval."""
+        self.eval_broker.enqueue(core_eval("force-gc"))
+
+    def _gc_loop(self) -> None:
+        """Periodic threshold GC (reference leader.go schedulePeriodic)."""
+        while not self._gc_stop.wait(self.gc_interval_s):
+            for kind in ("eval-gc", "job-gc", "node-gc", "deployment-gc"):
+                self.eval_broker.enqueue(core_eval(kind))
 
     # -- client alloc updates -----------------------------------------
 

@@ -109,6 +109,17 @@ class Worker:
         # (reference: worker.go:121 snapshotMinIndex).
         wait_index = max(ev.modify_index, ev.snapshot_index)
         snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        if ev.type == "_core":
+            # GC evals dispatch to the CoreScheduler, which mutates state
+            # through the server's raft rather than submitting plans
+            # (reference worker.go invokeScheduler: eval.Type == "_core").
+            from .core_sched import CoreScheduler
+
+            CoreScheduler(self.server, snapshot).process(ev)
+            # Core evals are broker-only, never persisted (reference
+            # leader.go schedulePeriodic enqueues without Raft) — acking
+            # is all the cleanup they need.
+            return
         sched = new_scheduler(ev.type, logger, snapshot, self.planner, self.config)
         sched.process(ev)
 

@@ -431,6 +431,12 @@ class StateStore(_ReadMixin):
             node = existing.copy()
             node.drain_strategy = drain.copy() if drain is not None else None
             if drain is not None:
+                # Stamp the wall-clock force deadline once, at drain time
+                # (reference structs.go DrainStrategy.DeadlineTime).
+                if drain.deadline_s > 0 and not node.drain_strategy.force_deadline_ns:
+                    node.drain_strategy.force_deadline_ns = now_ns() + int(
+                        drain.deadline_s * 1e9
+                    )
                 node.scheduling_eligibility = NODE_SCHEDULING_INELIGIBLE
             elif mark_eligible:
                 node.scheduling_eligibility = NODE_SCHEDULING_ELIGIBLE
@@ -764,6 +770,7 @@ class StateStore(_ReadMixin):
         existing = t.get(deployment.id)
         deployment.create_index = existing.create_index if existing else index
         deployment.modify_index = index
+        deployment.modify_time = now_ns()
         t[deployment.id] = deployment
 
     def _update_deployment_status_txn(self, index: int, update) -> None:
@@ -775,6 +782,7 @@ class StateStore(_ReadMixin):
         d.status = update.status
         d.status_description = update.status_description
         d.modify_index = index
+        d.modify_time = now_ns()
         t[d.id] = d
 
     def update_deployment_status(self, index: int, update) -> None:
@@ -788,6 +796,126 @@ class StateStore(_ReadMixin):
             for did in deployment_ids:
                 t.pop(did, None)
             self._stamp(index, TABLE_DEPLOYMENTS)
+
+    def update_deployment_promotion(
+        self,
+        index: int,
+        deployment_id: str,
+        groups: Optional[list[str]] = None,
+        eval_obj: Optional[Evaluation] = None,
+    ) -> None:
+        """Promote canaries (reference state_store.go UpdateDeploymentPromotion).
+
+        Marks the given groups (all canary groups when None) promoted and
+        flips the promoted allocs' canary flag off. Raises when a group has
+        fewer healthy canaries than desired.
+        """
+        with self._lock:
+            t = self._wtable(TABLE_DEPLOYMENTS)
+            existing = t.get(deployment_id)
+            if existing is None:
+                raise KeyError(f"unknown deployment {deployment_id}")
+            d = existing.copy()
+            targets = groups if groups else [
+                g for g, s in d.task_groups.items() if s.desired_canaries > 0
+            ]
+            canary_ids: set[str] = set()
+            # Validation (healthy canary counts) happens in the endpoint
+            # BEFORE the raft commit (check_promotion_ready) — an FSM apply
+            # must never raise, or replay of the log would poison followers.
+            for g in targets:
+                dstate = d.task_groups.get(g)
+                if dstate is None:
+                    continue
+                dstate.promoted = True
+                canary_ids.update(dstate.placed_canaries)
+            if not any(
+                s.desired_canaries > 0 and not s.promoted
+                for s in d.task_groups.values()
+            ):
+                d.status_description = "Deployment is running"
+            d.modify_index = index
+            d.modify_time = now_ns()
+            t[d.id] = d
+            # clear the canary flag on promoted allocs
+            at = self._wtable(TABLE_ALLOCS)
+            for cid in canary_ids:
+                a = at.get(cid)
+                if a is None or a.deployment_status is None:
+                    continue
+                na = a.copy()
+                na.deployment_status.canary = False
+                na.modify_index = index
+                self._put_alloc(na, a)
+            if eval_obj is not None:
+                self._upsert_evals_txn(index, [eval_obj])
+                self._stamp(index, TABLE_EVALS)
+            self._stamp(index, TABLE_DEPLOYMENTS, TABLE_ALLOCS)
+
+    def update_alloc_deployment_health(
+        self,
+        index: int,
+        deployment_id: str,
+        healthy_ids: list[str],
+        unhealthy_ids: list[str],
+        status_update=None,
+        eval_obj: Optional[Evaluation] = None,
+        revert_job: Optional[Job] = None,
+    ) -> None:
+        """Set alloc deployment health and resync the deployment's
+        healthy/unhealthy counters (reference state_store.go
+        UpdateDeploymentAllocHealth / upsertDeploymentUpdate). The optional
+        revert_job is upserted atomically (auto-revert)."""
+        with self._lock:
+            at = self._wtable(TABLE_ALLOCS)
+            ts = now_ns()
+            for aid, healthy in [(i, True) for i in healthy_ids] + [
+                (i, False) for i in unhealthy_ids
+            ]:
+                a = at.get(aid)
+                if a is None:
+                    continue
+                na = a.copy()
+                if na.deployment_status is None:
+                    from ..structs.structs import AllocDeploymentStatus
+
+                    na.deployment_status = AllocDeploymentStatus()
+                na.deployment_status.healthy = healthy
+                na.deployment_status.timestamp_ns = ts
+                na.modify_index = index
+                self._put_alloc(na, a)
+            # resync counters from the alloc table (single source of truth)
+            dt = self._wtable(TABLE_DEPLOYMENTS)
+            existing = dt.get(deployment_id)
+            if existing is not None:
+                d = existing.copy()
+                counts: dict[str, list[int]] = {g: [0, 0] for g in d.task_groups}
+                for a in self.allocs_by_deployment(deployment_id):
+                    if (
+                        a.deployment_status is None
+                        or a.task_group not in counts
+                        or a.terminal_status()
+                    ):
+                        continue
+                    if a.deployment_status.is_healthy():
+                        counts[a.task_group][0] += 1
+                    elif a.deployment_status.is_unhealthy():
+                        counts[a.task_group][1] += 1
+                for g, (h, u) in counts.items():
+                    d.task_groups[g].healthy_allocs = h
+                    d.task_groups[g].unhealthy_allocs = u
+                d.modify_index = index
+                d.modify_time = now_ns()
+                dt[d.id] = d
+            if status_update is not None:
+                self._update_deployment_status_txn(index, status_update)
+            if revert_job is not None:
+                self._upsert_job_txn(index, revert_job)
+                self._stamp(index, TABLE_JOBS)
+            if eval_obj is not None:
+                self._upsert_evals_txn(index, [eval_obj])
+                self._stamp(index, TABLE_EVALS)
+            self._stamp(index, TABLE_DEPLOYMENTS, TABLE_ALLOCS)
 
     # -- derived state -------------------------------------------------
 

@@ -1796,6 +1796,7 @@ class Deployment:
     status_description: str = "Deployment is running"
     create_index: int = 0
     modify_index: int = 0
+    modify_time: int = 0  # wall-clock ns, for GC thresholds
 
     def copy(self) -> "Deployment":
         return Deployment(
@@ -1812,6 +1813,7 @@ class Deployment:
             status_description=self.status_description,
             create_index=self.create_index,
             modify_index=self.modify_index,
+            modify_time=self.modify_time,
         )
 
     def active(self) -> bool:

@@ -1,0 +1,122 @@
+"""E2E lifecycle: deployments, drain, periodic, GC through the live
+server + client (reference analog: e2e/rescheduling, e2e/nodedrain,
+e2e/periodic suites — run in-process per SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ServerRPC
+from nomad_tpu.server import Server
+from nomad_tpu.structs import DrainStrategy
+from nomad_tpu.structs.structs import PeriodicConfig, UpdateStrategy
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    server = Server(num_workers=2)
+    server.deployment_watcher.poll_interval_s = 0.05
+    server.drainer.poll_interval_s = 0.05
+    server.establish_leadership()
+    clients = []
+
+    def add_client(**kw):
+        c = Client(
+            ServerRPC(server), data_dir=str(tmp_path / f"c{len(clients)}"), **kw
+        )
+        c.start()
+        clients.append(c)
+        return c
+
+    yield server, add_client
+    for c in clients:
+        c.shutdown()
+    server.shutdown()
+
+
+def test_e2e_deployment_completes_and_drain_migrates(cluster):
+    server, add_client = cluster
+    c1 = add_client()
+    c2 = add_client()
+
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {}
+    job.datacenters = [c1.node.datacenter]
+    job.update = UpdateStrategy(max_parallel=1, min_healthy_time_s=0.0)
+    job.task_groups[0].update = job.update.copy()
+    server.job_register(job)
+
+    assert wait_until(
+        lambda: sum(
+            1
+            for a in server.state.allocs_by_job(job.namespace, job.id)
+            if a.client_status == "running"
+        )
+        == 2
+    ), "allocs should run"
+    deps = server.state.deployments_by_job(job.namespace, job.id)
+    assert deps, "scheduler should create a deployment"
+    assert wait_until(
+        lambda: server.state.deployments_by_job(job.namespace, job.id)[0].status
+        == "successful"
+    ), "deployment should complete via client health reports"
+    assert wait_until(
+        lambda: server.state.job_by_id(job.namespace, job.id).stable
+    ), "job version should be marked stable"
+
+    # drain c1: allocs migrate to c2, drain clears itself
+    server.node_update_drain(c1.node.id, DrainStrategy(deadline_s=600))
+    assert wait_until(
+        lambda: sum(
+            1
+            for a in server.state.allocs_by_job(job.namespace, job.id)
+            if a.client_status == "running" and a.node_id == c2.node.id
+        )
+        == 2
+    ), "allocs should migrate to the other node"
+    assert wait_until(
+        lambda: not server.state.node_by_id(c1.node.id).drain
+    ), "drain should complete and clear"
+
+
+def test_e2e_periodic_force_launch_and_gc(cluster):
+    server, add_client = cluster
+    c = add_client()
+
+    pj = mock.job(id="cron-job")
+    pj.type = "batch"
+    pj.datacenters = [c.node.datacenter]
+    pj.task_groups[0].count = 1
+    pj.task_groups[0].tasks[0].config = {"run_for": 0.1}
+    pj.periodic = PeriodicConfig(enabled=True, spec="0 0 1 1 *")
+    server.job_register(pj)
+
+    assert wait_until(lambda: len(server.periodic.tracked()) == 1)
+    child_id = server.periodic.force_launch(pj.namespace, pj.id)
+    assert wait_until(
+        lambda: any(
+            a.client_status == "complete"
+            for a in server.state.allocs_by_job(pj.namespace, child_id)
+        )
+    ), "periodic child should run to completion"
+
+    server.job_deregister(pj.namespace, child_id, purge=False)
+    assert wait_until(
+        lambda: (j := server.state.job_by_id(pj.namespace, child_id)) is not None
+        and j.status == "dead"
+    )
+    server.force_gc()
+    assert wait_until(
+        lambda: server.state.job_by_id(pj.namespace, child_id) is None
+    ), "force GC should purge the dead child"

@@ -1,0 +1,432 @@
+"""Lifecycle subsystem tests: deployment watcher, node drainer, periodic
+dispatch, core GC (reference analogs: nomad/deploymentwatcher/
+deployments_watcher_test.go, nomad/drainer/drainer_test.go,
+nomad/periodic_test.go, nomad/core_sched_test.go)."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import CoreScheduler, CronSpec, core_eval
+from nomad_tpu.server.deployment_watcher import DeploymentsWatcher
+from nomad_tpu.server.drainer import NodeDrainer
+from nomad_tpu.server.periodic import PeriodicDispatch, next_launch
+from nomad_tpu.server.raft import FSM, InmemLog
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import DrainStrategy, now_ns
+from nomad_tpu.structs.structs import (
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_RUNNING,
+    DEPLOYMENT_STATUS_SUCCESSFUL,
+    AllocDeploymentStatus,
+    DeploymentState,
+    PeriodicConfig,
+    UpdateStrategy,
+    new_deployment,
+)
+
+
+class Pipe:
+    """StateStore + FSM + single-node log: just enough server for the
+    leader subsystems."""
+
+    def __init__(self):
+        self.state = StateStore()
+        self.fsm = FSM(self.state)
+        self.log = InmemLog(self.fsm)
+        self.raft_apply = self.log.apply
+        self._i = 1
+
+    def idx(self):
+        self._i += 1
+        return self.log.last_index + 1000 + self._i
+
+
+# ---------------------------------------------------------------------------
+# Deployment watcher
+# ---------------------------------------------------------------------------
+
+
+def _deployed_job(p, auto_revert=False, auto_promote=False, canary=0):
+    job = mock.job()
+    job.update = UpdateStrategy(
+        auto_revert=auto_revert, auto_promote=auto_promote, canary=canary
+    )
+    job.task_groups[0].update = job.update.copy()
+    job.canonicalize()
+    p.raft_apply("job_register", (job, None))
+    return p.state.job_by_id(job.namespace, job.id)
+
+
+def _deployment_for(p, job, desired=2, canaries=0):
+    d = new_deployment(job)
+    d.task_groups[job.task_groups[0].name] = DeploymentState(
+        auto_revert=job.update.auto_revert,
+        auto_promote=job.update.auto_promote,
+        desired_canaries=canaries,
+        desired_total=desired,
+        placed_allocs=desired,
+    )
+    p.raft_apply("deployment_upsert", d)
+    return p.state.deployment_by_id(d.id)
+
+
+def _place_allocs(p, job, d, n, healthy=None, canary=False):
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    allocs = []
+    for i in range(n):
+        a = mock.alloc(job_=job, node_=node, index=i)
+        a.deployment_id = d.id
+        a.client_status = "running"
+        if healthy is not None:
+            a.deployment_status = AllocDeploymentStatus(
+                healthy=healthy, canary=canary
+            )
+        allocs.append(a)
+    p.raft_apply("alloc_update", allocs)
+    return allocs
+
+
+def test_deployment_success_marks_stable():
+    p = Pipe()
+    job = _deployed_job(p)
+    d = _deployment_for(p, job, desired=2)
+    _place_allocs(p, job, d, 2, healthy=True)
+    w = DeploymentsWatcher(p.state, p.raft_apply)
+    # first pass syncs counters, second judges completion
+    w.run_once()
+    w.run_once()
+    got = p.state.deployment_by_id(d.id)
+    assert got.status == DEPLOYMENT_STATUS_SUCCESSFUL
+    assert p.state.job_by_id(job.namespace, job.id).stable
+
+
+def test_deployment_unhealthy_fails_and_autoreverts():
+    p = Pipe()
+    job = _deployed_job(p, auto_revert=True)
+    # v0 must be stable to be a revert target; then push v1
+    stable0 = p.state.job_by_id(job.namespace, job.id).copy()
+    stable0.stable = True
+    p.raft_apply("job_register", (stable0, None))
+    v1 = stable0.copy()
+    v1.task_groups[0].tasks[0].env["V"] = "2"
+    v1.stable = False
+    p.raft_apply("job_register", (v1, None))
+    v1 = p.state.job_by_id(job.namespace, job.id)
+    assert v1.version == 1
+
+    d = _deployment_for(p, v1, desired=2)
+    _place_allocs(p, v1, d, 2, healthy=False)
+    w = DeploymentsWatcher(p.state, p.raft_apply)
+    w.run_once()
+    got = p.state.deployment_by_id(d.id)
+    assert got.status == DEPLOYMENT_STATUS_FAILED
+    assert "rolling back" in got.status_description
+    # job reverted: new version with v0's spec
+    reverted = p.state.job_by_id(job.namespace, job.id)
+    assert reverted.version == 2
+    assert "V" not in reverted.task_groups[0].tasks[0].env
+    # a deployment-watcher eval was created for the scheduler to roll back
+    evs = p.state.evals_by_job(job.namespace, job.id)
+    assert any(e.triggered_by == "deployment-watcher" for e in evs)
+
+
+def test_deployment_healthy_deadline_marks_unhealthy():
+    p = Pipe()
+    job = _deployed_job(p)
+    job_stored = p.state.job_by_id(job.namespace, job.id)
+    tg = job_stored.task_groups[0]
+    tg.update.healthy_deadline_s = 0.000001  # immediately past deadline
+    d = _deployment_for(p, job_stored, desired=1)
+    allocs = _place_allocs(p, job_stored, d, 1, healthy=None)
+    # make the alloc old enough
+    time.sleep(0.01)
+    w = DeploymentsWatcher(p.state, p.raft_apply)
+    w.run_once()
+    got = p.state.deployment_by_id(d.id)
+    assert got.status == DEPLOYMENT_STATUS_FAILED
+    a = p.state.alloc_by_id(allocs[0].id)
+    assert a.deployment_status.is_unhealthy()
+
+
+def test_deployment_auto_promote():
+    p = Pipe()
+    job = _deployed_job(p, auto_promote=True, canary=1)
+    d = _deployment_for(p, job, desired=2, canaries=1)
+    allocs = _place_allocs(p, job, d, 1, healthy=True, canary=True)
+    dd = p.state.deployment_by_id(d.id).copy()
+    dd.task_groups[job.task_groups[0].name].placed_canaries = [allocs[0].id]
+    p.raft_apply("deployment_upsert", dd)
+
+    w = DeploymentsWatcher(p.state, p.raft_apply)
+    w.run_once()
+    got = p.state.deployment_by_id(d.id)
+    assert got.task_groups[job.task_groups[0].name].promoted
+    # canary flag cleared on promotion
+    assert not p.state.alloc_by_id(allocs[0].id).deployment_status.canary
+
+
+def test_deployment_manual_promote_requires_healthy_canaries():
+    p = Pipe()
+    job = _deployed_job(p, canary=1)
+    d = _deployment_for(p, job, desired=2, canaries=1)
+    allocs = _place_allocs(p, job, d, 1, healthy=False, canary=True)
+    dd = p.state.deployment_by_id(d.id).copy()
+    dd.task_groups[job.task_groups[0].name].placed_canaries = [allocs[0].id]
+    p.raft_apply("deployment_upsert", dd)
+    # validation happens endpoint-side, before the raft commit
+    w = DeploymentsWatcher(p.state, p.raft_apply)
+    with pytest.raises(ValueError, match="healthy canaries"):
+        w.promote(p.state.deployment_by_id(d.id))
+
+
+# ---------------------------------------------------------------------------
+# Node drainer
+# ---------------------------------------------------------------------------
+
+
+def _drain_setup(p, n_allocs=3, max_parallel=1):
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    job = mock.job()
+    job.task_groups[0].count = n_allocs
+    from nomad_tpu.structs.structs import MigrateStrategy
+
+    job.task_groups[0].migrate = MigrateStrategy(max_parallel=max_parallel)
+    p.raft_apply("job_register", (job, None))
+    job = p.state.job_by_id(job.namespace, job.id)
+    allocs = []
+    for i in range(n_allocs):
+        a = mock.alloc(job_=job, node_=node, index=i)
+        a.client_status = "running"
+        allocs.append(a)
+    p.raft_apply("alloc_update", allocs)
+    return node, job, allocs
+
+
+def test_drainer_rate_limits_by_migrate_stanza():
+    p = Pipe()
+    node, job, allocs = _drain_setup(p, n_allocs=3, max_parallel=1)
+    p.raft_apply("node_update_drain", (node.id, DrainStrategy(deadline_s=600), False))
+    d = NodeDrainer(p.state, p.raft_apply)
+    assert d.run_once() == 1  # only max_parallel=1 marked
+    marked = [
+        a
+        for a in p.state.allocs_by_node(node.id)
+        if a.desired_transition.should_migrate()
+    ]
+    assert len(marked) == 1
+    # second pass: slot still held (migration not finished) -> no new marks
+    assert d.run_once() == 0
+    # the migrating alloc stops (migration completed) -> next slot opens
+    stopped = marked[0].copy()
+    stopped.desired_status = "stop"
+    stopped.client_status = "complete"
+    p.raft_apply("alloc_update", [stopped])
+    assert d.run_once() == 1
+
+
+def test_drainer_deadline_forces_all():
+    p = Pipe()
+    node, job, allocs = _drain_setup(p, n_allocs=3, max_parallel=1)
+    p.raft_apply("node_update_drain", (node.id, DrainStrategy(deadline_s=-1), False))
+    d = NodeDrainer(p.state, p.raft_apply)
+    assert d.run_once() == 3
+    # drain eval created for the job
+    evs = p.state.evals_by_job(job.namespace, job.id)
+    assert any(e.triggered_by == "node-drain" for e in evs)
+
+
+def test_drainer_completes_when_empty():
+    p = Pipe()
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    p.raft_apply("node_update_drain", (node.id, DrainStrategy(deadline_s=600), False))
+    assert p.state.node_by_id(node.id).drain
+    d = NodeDrainer(p.state, p.raft_apply)
+    d.run_once()
+    got = p.state.node_by_id(node.id)
+    assert not got.drain
+    assert got.scheduling_eligibility == "ineligible"  # stays out of service
+
+
+def test_drainer_ignores_system_jobs_when_asked():
+    p = Pipe()
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    sysjob = mock.system_job()
+    p.raft_apply("job_register", (sysjob, None))
+    sysjob = p.state.job_by_id(sysjob.namespace, sysjob.id)
+    a = mock.alloc(job_=sysjob, node_=node)
+    a.client_status = "running"
+    p.raft_apply("alloc_update", [a])
+    p.raft_apply(
+        "node_update_drain",
+        (node.id, DrainStrategy(deadline_s=600, ignore_system_jobs=True), False),
+    )
+    d = NodeDrainer(p.state, p.raft_apply)
+    assert d.run_once() == 0
+    # node counts as done: only ignored system allocs remain
+    assert not p.state.node_by_id(node.id).drain
+
+
+# ---------------------------------------------------------------------------
+# Periodic dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_cron_next_after():
+    spec = CronSpec("*/15 * * * *")
+    # 2021-01-01 00:07:00 UTC -> next quarter hour
+    import calendar
+
+    t0 = calendar.timegm((2021, 1, 1, 0, 7, 0, 0, 0, 0))
+    nxt = spec.next_after(t0)
+    assert time.gmtime(nxt)[:5] == (2021, 1, 1, 0, 15)
+    # exact boundary is exclusive
+    t1 = calendar.timegm((2021, 1, 1, 0, 15, 0, 0, 0, 0))
+    assert time.gmtime(spec.next_after(t1))[:5] == (2021, 1, 1, 0, 30)
+
+
+def test_cron_fields():
+    spec = CronSpec("30 4 1,15 * 5")  # 04:30 on the 1st, 15th and Fridays
+    import calendar
+
+    t0 = calendar.timegm((2021, 3, 2, 0, 0, 0, 0, 0, 0))  # Tue Mar 2
+    nxt = time.gmtime(spec.next_after(t0))
+    assert nxt[:5] == (2021, 3, 5, 4, 30)  # Friday Mar 5 (dow OR dom)
+
+
+def test_periodic_launches_child():
+    p = Pipe()
+    job = mock.job()
+    job.type = "batch"
+    job.periodic = PeriodicConfig(enabled=True, spec="*/5 * * * *")
+    p.raft_apply("job_register", (job, None))
+    pd = PeriodicDispatch(p.state, p.raft_apply)
+    pd.restore()
+    assert len(pd.tracked()) == 1
+    # force the clock past the next launch
+    key = (job.namespace, job.id)
+    when = pd._next[key]
+    assert pd.run_once(when + 1) == 1
+    children = p.state.jobs_by_parent(job.namespace, job.id)
+    assert len(children) == 1
+    assert children[0].id.startswith(job.id + "/periodic-")
+    assert children[0].parent_id == job.id
+    evs = p.state.evals_by_job(job.namespace, children[0].id)
+    assert len(evs) == 1 and evs[0].triggered_by == "periodic-job"
+
+
+def test_periodic_prohibit_overlap():
+    p = Pipe()
+    job = mock.job()
+    job.type = "batch"
+    job.periodic = PeriodicConfig(
+        enabled=True, spec="*/5 * * * *", prohibit_overlap=True
+    )
+    p.raft_apply("job_register", (job, None))
+    pd = PeriodicDispatch(p.state, p.raft_apply)
+    pd.restore()
+    when = pd._next[(job.namespace, job.id)]
+    assert pd.run_once(when + 1) == 1
+    # child still pending -> second due launch is skipped
+    when2 = pd._next[(job.namespace, job.id)]
+    assert pd.run_once(when2 + 1) == 0
+
+
+def test_periodic_every_spec():
+    cfg = PeriodicConfig(enabled=True, spec="@every 30s")
+    assert next_launch(cfg, 1000.0) == 1030.0
+
+
+# ---------------------------------------------------------------------------
+# Core GC
+# ---------------------------------------------------------------------------
+
+
+class FakeServer:
+    def __init__(self, p):
+        self.p = p
+        self.raft_apply = p.raft_apply
+
+
+def test_core_eval_gc():
+    p = Pipe()
+    job = mock.job()
+    p.raft_apply("job_register", (job, None))
+    job = p.state.job_by_id(job.namespace, job.id)
+    ev = mock.eval_for_job(job, status="complete")
+    p.raft_apply("eval_update", [ev])
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    a = mock.alloc(job_=job, node_=node, eval_id=ev.id, client_status="complete")
+    a.desired_status = "stop"
+    p.raft_apply("alloc_update", [a])
+
+    core = CoreScheduler(FakeServer(p), p.state.snapshot())
+    n_evals, n_allocs = core.eval_gc(force=True)
+    assert (n_evals, n_allocs) == (1, 1)
+    assert p.state.eval_by_id(ev.id) is None
+    assert p.state.alloc_by_id(a.id) is None
+
+
+def test_core_eval_gc_spares_live():
+    p = Pipe()
+    job = mock.job()
+    p.raft_apply("job_register", (job, None))
+    job = p.state.job_by_id(job.namespace, job.id)
+    ev = mock.eval_for_job(job, status="complete")
+    p.raft_apply("eval_update", [ev])
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    a = mock.alloc(job_=job, node_=node, eval_id=ev.id, client_status="running")
+    p.raft_apply("alloc_update", [a])
+    core = CoreScheduler(FakeServer(p), p.state.snapshot())
+    assert core.eval_gc(force=True) == (0, 0)
+    assert p.state.eval_by_id(ev.id) is not None
+
+
+def test_core_job_gc():
+    p = Pipe()
+    job = mock.job()
+    job.stop = True
+    p.raft_apply("job_register", (job, None))
+    core = CoreScheduler(FakeServer(p), p.state.snapshot())
+    assert core.job_gc(force=True) == 1
+    assert p.state.job_by_id(job.namespace, job.id) is None
+
+
+def test_core_node_gc():
+    p = Pipe()
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    p.raft_apply("node_update_status", (node.id, "down"))
+    core = CoreScheduler(FakeServer(p), p.state.snapshot())
+    assert core.node_gc(force=True) == 1
+    assert p.state.node_by_id(node.id) is None
+
+
+def test_core_deployment_gc():
+    p = Pipe()
+    job = mock.job()
+    p.raft_apply("job_register", (job, None))
+    job = p.state.job_by_id(job.namespace, job.id)
+    d = new_deployment(job)
+    d.status = "failed"
+    p.raft_apply("deployment_upsert", d)
+    core = CoreScheduler(FakeServer(p), p.state.snapshot())
+    assert core.deployment_gc(force=True) == 1
+    assert p.state.deployment_by_id(d.id) is None
+
+
+def test_force_gc_via_core_eval():
+    p = Pipe()
+    node = mock.node()
+    p.raft_apply("node_register", node)
+    p.raft_apply("node_update_status", (node.id, "down"))
+    core = CoreScheduler(FakeServer(p), p.state.snapshot())
+    core.process(core_eval("force-gc"))
+    assert p.state.node_by_id(node.id) is None

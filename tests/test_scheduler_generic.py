@@ -194,9 +194,20 @@ def test_node_drain_migrates():
     h.state.upsert_job(h.next_index(), job)
     h.process("service", mock.eval_for_job(job))
 
+    from nomad_tpu.server.drainer import NodeDrainer
     from nomad_tpu.structs import DrainStrategy
 
     h.state.update_node_drain(h.next_index(), n1.id, DrainStrategy(deadline_s=600))
+    # The drainer subsystem marks allocs for migration (rate-limited); the
+    # reconciler only migrates marked allocs (reference drainer + reconciler
+    # split). Deadline -1 = force-drain everything at once.
+    drainer = NodeDrainer(
+        h.state, lambda t, p: h.state.update_alloc_desired_transition(
+            h.next_index(), *p
+        ) if t == "alloc_update_desired_transition" else None
+    )
+    h.state.update_node_drain(h.next_index(), n1.id, DrainStrategy(deadline_s=-1))
+    drainer.run_once()
     h.process("service", mock.eval_for_job(job, triggered_by="node-drain"))
     live = [
         a
