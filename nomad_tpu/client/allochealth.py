@@ -31,6 +31,7 @@ class HealthTracker:
         self.on_healthy = on_healthy
         self.poll_interval_s = poll_interval_s
         self._stop = threading.Event()
+        self._verdict_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
@@ -45,7 +46,17 @@ class HealthTracker:
         self._thread.start()
 
     def stop(self) -> None:
-        self._stop.set()
+        # Taking the verdict lock means stop() can't land between the
+        # tracker's last poll and its callback: after stop returns, no
+        # healthy-verdict for a being-killed alloc can be delivered.
+        with self._verdict_lock:
+            self._stop.set()
+
+    def _deliver(self, healthy: bool) -> None:
+        with self._verdict_lock:
+            if self._stop.is_set():
+                return
+            self.on_healthy(healthy)
 
     def _run(self) -> None:
         deadline = time.monotonic() + self.deadline_s
@@ -55,7 +66,7 @@ class HealthTracker:
             if not states:
                 continue
             if any(s.failed for s in states.values()):
-                self.on_healthy(False)
+                self._deliver(False)
                 return
             now = time.monotonic()
             # batch-style tasks that ran to successful completion count as
@@ -67,12 +78,12 @@ class HealthTracker:
                 if healthy_since is None:
                     healthy_since = now
                 if now - healthy_since >= self.min_healthy_s:
-                    self.on_healthy(True)
+                    self._deliver(True)
                     return
             else:
                 healthy_since = None
             if now > deadline:
-                self.on_healthy(False)
+                self._deliver(False)
                 return
 
 

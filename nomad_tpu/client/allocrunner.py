@@ -152,8 +152,6 @@ class AllocRunner:
 
     def destroy(self) -> None:
         self._destroyed = True
-        if self._health is not None:
-            self._health.stop()
         self.stop()
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:

@@ -192,7 +192,13 @@ def diff_system_allocs(
                 elif node.drain and (
                     not node.drain_strategy.ignore_system_jobs
                 ):
-                    result.stop.append((alloc, "node is draining"))
+                    # Stop only once the drainer has marked the alloc —
+                    # it withholds the mark until every service alloc has
+                    # drained (system drains last; drainer.py run_once).
+                    if alloc.desired_transition.should_migrate():
+                        result.stop.append((alloc, "node is draining"))
+                    else:
+                        result.ignore.append(alloc)
                 else:
                     result.ignore.append(alloc)
                 continue

@@ -86,17 +86,23 @@ class DeploymentsWatcher:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
-        self._stop.clear()
+        # Fresh Event per incarnation (see drainer.start): a thread that
+        # outlives join(timeout) polls its own event and still exits.
+        self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="deployment-watcher"
+            target=self._run, args=(self._stop,), daemon=True,
+            name="deployment-watcher"
         )
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
 
-    def _run(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.wait(self.poll_interval_s):
             try:
                 self.run_once()
             except Exception:

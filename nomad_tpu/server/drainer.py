@@ -21,6 +21,7 @@ from ..structs import Evaluation, generate_uuid, now_ns
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_NODE_DRAIN,
+    JOB_TYPE_BATCH,
     JOB_TYPE_SERVICE,
     JOB_TYPE_SYSBATCH,
     JOB_TYPE_SYSTEM,
@@ -39,17 +40,23 @@ class NodeDrainer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
-        self._stop.clear()
+        # Fresh Event per incarnation: a thread that outlives a
+        # join(timeout) keeps polling ITS event (passed as arg) and still
+        # exits, instead of seeing a cleared shared flag and resuming.
+        self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="node-drainer"
+            target=self._run, args=(self._stop,), daemon=True, name="node-drainer"
         )
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
 
-    def _run(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.wait(self.poll_interval_s):
             try:
                 self.run_once()
             except Exception:
@@ -67,17 +74,11 @@ class NodeDrainer:
         eval_jobs: set[tuple[str, str]] = set()
         done_nodes: dict[str, None] = {}
 
-        # In-flight migrations per job across ALL draining nodes: an alloc
-        # already marked migrate whose replacement isn't healthy yet holds a
-        # max_parallel slot (reference watch_jobs.go handleTaskGroup).
-        inflight: dict[tuple[str, str, str], int] = {}
-        for node in draining:
-            for a in self.state.allocs_by_node(node.id):
-                if a.terminal_status():
-                    continue
-                if a.desired_transition.should_migrate():
-                    key = (a.namespace, a.job_id, a.task_group)
-                    inflight[key] = inflight.get(key, 0) + 1
+        # Candidate allocs to mark, grouped per task group across ALL
+        # draining nodes; the migrate budget is per task group, not per
+        # node (reference watch_jobs.go handleTaskGroup).
+        candidates: dict[tuple[str, str, str], list] = {}
+        jobs: dict[tuple[str, str, str], object] = {}
 
         for node in draining:
             strategy = node.drain_strategy
@@ -86,39 +87,74 @@ class NodeDrainer:
             for a in self.state.allocs_by_node(node.id):
                 if a.terminal_status():
                     continue
-                job = a.job or self.state.job_by_id(a.namespace, a.job_id)
+                # Prefer the CURRENT job from state: a live migrate-stanza
+                # change (e.g. raising max_parallel mid-drain) must take
+                # effect; the alloc's embedded job is placement-time stale.
+                job = self.state.job_by_id(a.namespace, a.job_id) or a.job
                 system = job is not None and job.type in (
                     JOB_TYPE_SYSTEM,
                     JOB_TYPE_SYSBATCH,
                 )
                 if system and strategy.ignore_system_jobs:
                     continue
-                if system:
-                    # System allocs are only stopped once every service
-                    # alloc has drained (reference drainer.go: system
-                    # drains last) or at the deadline.
-                    remaining.append((a, job, True))
-                else:
-                    remaining.append((a, job, False))
+                remaining.append((a, job, system))
 
-            service_left = [r for r in remaining if not r[2]]
             if not remaining:
                 done_nodes[node.id] = None
                 continue
+            service_left = [r for r in remaining if not r[2]]
 
             for a, job, system in remaining:
                 if a.desired_transition.should_migrate():
                     continue  # already marked
                 if system and service_left and not force:
-                    continue  # system waits for services
+                    # System allocs are only stopped once every service
+                    # alloc has drained (reference drainer.go: system
+                    # drains last) or at the deadline.
+                    continue
+                if force:
+                    transitions[a.id] = DesiredTransition(migrate=True)
+                    eval_jobs.add((a.namespace, a.job_id))
+                    continue
+                if job is not None and job.type == JOB_TYPE_BATCH:
+                    # Batch allocs are never migrated by the rate-limited
+                    # path — they run to completion (or the deadline);
+                    # the node stays draining meanwhile (reference
+                    # watch_jobs.go: "We don't mark batch for drain").
+                    continue
                 key = (a.namespace, a.job_id, a.task_group)
-                if not force:
-                    limit = self._max_parallel(job, a.task_group)
-                    if inflight.get(key, 0) >= limit:
-                        continue
+                candidates.setdefault(key, []).append(a)
+                jobs[key] = job
+
+        # Rate-limited marking: an alloc already drained off a draining
+        # node keeps holding a max_parallel slot until its REPLACEMENT
+        # reports health — expressed as the reference does it: allowed new
+        # marks = healthy-anywhere − (group count − max_parallel)
+        # (reference watch_jobs.go handleTaskGroup thresholdCount;
+        # "healthy" there is HasHealth on any non-terminal alloc).
+        for key, allocs in candidates.items():
+            ns, job_id, tg_name = key
+            job = jobs[key]
+            limit = self._max_parallel(job, tg_name)
+            count = self._group_count(job, tg_name)
+            healthy = 0
+            for a in self.state.allocs_by_job(ns, job_id):
+                if a.terminal_status() or a.task_group != tg_name:
+                    continue
+                if a.desired_transition.should_migrate():
+                    # Marked but not yet stopped by the scheduler: it is
+                    # mid-migration and holds its slot (the reference sees
+                    # these as terminal by the time its watcher re-fires).
+                    continue
+                ds = a.deployment_status
+                if (ds is not None and ds.healthy is not None) or (
+                    ds is None and a.client_status == "running"
+                ):
+                    healthy += 1
+            allowed = healthy - (count - limit)
+            for a in allocs[: max(0, allowed)]:
                 transitions[a.id] = DesiredTransition(migrate=True)
-                inflight[key] = inflight.get(key, 0) + 1
-                eval_jobs.add((a.namespace, a.job_id))
+                eval_jobs.add((ns, job_id))
 
         if transitions or done_nodes:
             evals = [
@@ -144,6 +180,12 @@ class NodeDrainer:
         if tg is None or tg.migrate is None:
             return 1
         return max(1, tg.migrate.max_parallel)
+
+    def _group_count(self, job, group: str) -> int:
+        if job is None:
+            return 1
+        tg = job.lookup_task_group(group)
+        return tg.count if tg is not None else 1
 
     def _drain_eval(self, namespace: str, job_id: str) -> Evaluation:
         job = self.state.job_by_id(namespace, job_id)

@@ -71,6 +71,8 @@ class CronSpec:
         self.dow = _parse_field(fields[4], 0, 6)  # 0 = Sunday
         self.dom_wild = fields[2] == "*"
         self.dow_wild = fields[4] == "*"
+        self._hours_sorted = sorted(self.hours)
+        self._minutes_sorted = sorted(self.minutes)
 
     def _day_match(self, y: int, mo: int, d: int) -> bool:
         # python weekday(): Monday=0 → cron Sunday=0 conversion
@@ -86,31 +88,41 @@ class CronSpec:
         return dom_ok or dow_ok  # standard cron OR semantics
 
     def next_after(self, ts: float) -> float:
-        """Next matching epoch-second strictly after ts (UTC)."""
+        """Next matching epoch-second strictly after ts (UTC).
+
+        Walks at day granularity (skipping whole non-matching months), so
+        sparse specs like Feb-29 cost thousands of iterations, not the
+        ~520k minute steps a naive walk needs — this runs inside the raft
+        apply path via PeriodicDispatch.add, so it must stay cheap.
+        """
         t = time.gmtime(int(ts) - int(ts) % 60 + 60)
         y, mo, d, h, mi = t.tm_year, t.tm_mon, t.tm_mday, t.tm_hour, t.tm_min
-        for _ in range(366 * 24 * 60):  # bounded walk, minute granularity
-            if (
-                mo in self.months
-                and self._day_match(y, mo, d)
-                and h in self.hours
-                and mi in self.minutes
-            ):
-                return calendar.timegm((y, mo, d, h, mi, 0, 0, 0, 0))
-            mi += 1
-            if mi > 59:
-                mi = 0
-                h += 1
-            if h > 23:
-                h = 0
-                d += 1
+        for _ in range(366 * 6):  # day-granularity bound, ~6 years
+            if mo not in self.months:
+                # jump to the 1st of the next month
+                mo += 1
+                if mo > 12:
+                    mo, y = 1, y + 1
+                d, h, mi = 1, 0, 0
+                continue
+            if self._day_match(y, mo, d):
+                for hh in self._hours_sorted:
+                    if hh < h:
+                        continue
+                    for mm in self._minutes_sorted:
+                        if hh == h and mm < mi:
+                            continue
+                        return calendar.timegm((y, mo, d, hh, mm, 0, 0, 0, 0))
+                    h, mi = hh + 1, 0  # no minute left this hour
+            d += 1
+            h, mi = 0, 0
             if d > calendar.monthrange(y, mo)[1]:
                 d = 1
                 mo += 1
             if mo > 12:
                 mo = 1
                 y += 1
-        raise ValueError("no cron match within a year")
+        raise ValueError("no cron match within 6 years")
 
 
 def next_launch(periodic, after_ts: float) -> float:
@@ -126,7 +138,11 @@ def next_launch(periodic, after_ts: float) -> float:
             raise ValueError(
                 f"@every duration needs an s/m/h suffix: {dur!r}"
             )
-        return after_ts + float(dur[:-1]) * mult
+        seconds = float(dur[:-1]) * mult
+        if seconds <= 0:
+            # A non-positive period would fire a child on every poll pass.
+            raise ValueError(f"@every duration must be positive: {dur!r}")
+        return after_ts + seconds
     return CronSpec(spec).next_after(after_ts)
 
 
@@ -149,6 +165,10 @@ class PeriodicDispatch:
         self._tracked: dict[tuple[str, str], Job] = {}
         self._next: dict[tuple[str, str], float] = {}
         self._lock = threading.Lock()
+        # Serializes child launches (probe + register must be atomic).
+        # Separate from _lock: raft_apply re-enters add() via the FSM
+        # job-upsert side-channel, which takes _lock.
+        self._launch_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -156,20 +176,26 @@ class PeriodicDispatch:
 
     def start(self) -> None:
         self.restore()
-        self._stop.clear()
+        # Fresh Event per incarnation (see drainer.start): a thread that
+        # outlives join(timeout) polls its own event and still exits.
+        self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="periodic-dispatch"
+            target=self._run, args=(self._stop,), daemon=True,
+            name="periodic-dispatch"
         )
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
         with self._lock:
             self._tracked.clear()
             self._next.clear()
 
-    def _run(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.wait(self.poll_interval_s):
             try:
                 self.run_once(time.time())
             except Exception:
@@ -208,10 +234,18 @@ class PeriodicDispatch:
         with self._lock:
             for key, when in list(self._next.items()):
                 if when <= now_ts:
+                    try:
+                        self._next[key] = next_launch(
+                            self._tracked[key].periodic, now_ts
+                        )
+                    except ValueError:
+                        # A spec with no future fire time can't wedge the
+                        # pass (or hot-loop): untrack it.
+                        logger.exception("periodic job %s untracked", key)
+                        self._tracked.pop(key, None)
+                        self._next.pop(key, None)
+                        continue
                     due.append(self._tracked[key])
-                    self._next[key] = next_launch(
-                        self._tracked[key].periodic, now_ts
-                    )
         launched = 0
         for job in due:
             if job.periodic.prohibit_overlap and self._has_live_child(job):
@@ -231,7 +265,7 @@ class PeriodicDispatch:
             job = self._tracked.get((namespace, job_id))
         if job is None:
             job = self.state.job_by_id(namespace, job_id)
-        if job is None or not job.is_periodic():
+        if job is None or not job.is_periodic() or job.stopped():
             raise KeyError(f"{job_id} is not a tracked periodic job")
         return self.create_child(job, int(time.time()))
 
@@ -239,23 +273,36 @@ class PeriodicDispatch:
         """Fork `<parent>/periodic-<ts>` + eval (reference periodic.go
         createEval/deriveJob)."""
         child = parent.copy()
-        child.id = f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{launch_ts}"
-        child.name = child.id
-        child.parent_id = parent.id
-        child.periodic = None
-        child.status = ""
-        ev = Evaluation(
-            id=generate_uuid(),
-            namespace=child.namespace,
-            priority=child.priority,
-            type=child.type,
-            triggered_by=EVAL_TRIGGER_PERIODIC_JOB,
-            job_id=child.id,
-            status=EVAL_STATUS_PENDING,
-            create_time=now_ns(),
-            modify_time=now_ns(),
-        )
-        self.raft_apply("job_register", (child, ev))
+        # Second-granularity launch ids can collide (force_launch racing a
+        # scheduled fire); the launch lock makes probe + register atomic,
+        # and the bump loop picks the first unused id, so a collision
+        # can't silently upsert over an existing child.
+        with self._launch_lock:
+            ts = launch_ts
+            while (
+                self.state.job_by_id(
+                    parent.namespace, f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{ts}"
+                )
+                is not None
+            ):
+                ts += 1
+            child.id = f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{ts}"
+            child.name = child.id
+            child.parent_id = parent.id
+            child.periodic = None
+            child.status = ""
+            ev = Evaluation(
+                id=generate_uuid(),
+                namespace=child.namespace,
+                priority=child.priority,
+                type=child.type,
+                triggered_by=EVAL_TRIGGER_PERIODIC_JOB,
+                job_id=child.id,
+                status=EVAL_STATUS_PENDING,
+                create_time=now_ns(),
+                modify_time=now_ns(),
+            )
+            self.raft_apply("job_register", (child, ev))
         return child.id
 
     def _has_live_child(self, parent: Job) -> bool:

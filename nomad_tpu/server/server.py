@@ -125,9 +125,12 @@ class Server:
         self.deployment_watcher.start()
         self.drainer.start()
         self.periodic.start()
-        self._gc_stop.clear()
+        # Fresh Event per incarnation (see Worker.start): a thread that
+        # outlives join(timeout) polls its own event and still exits.
+        self._gc_stop = threading.Event()
         self._gc_thread = threading.Thread(
-            target=self._gc_loop, daemon=True, name="gc-scheduler"
+            target=self._gc_loop, args=(self._gc_stop,), daemon=True,
+            name="gc-scheduler"
         )
         self._gc_thread.start()
         self._leader = True
@@ -136,11 +139,16 @@ class Server:
     def revoke_leadership(self) -> None:
         self._leader = False
         self._gc_stop.set()
+        if self._gc_thread:
+            self._gc_thread.join(timeout=5)
+            self._gc_thread = None
         self.deployment_watcher.stop()
         self.drainer.stop()
         self.periodic.stop()
         for w in self.workers:
             w.stop()
+        for w in self.workers:
+            w.join(timeout=5)
         if self.tpu_worker:
             self.tpu_worker.stop()
         self.plan_applier.stop()
@@ -470,9 +478,9 @@ class Server:
         """System.GarbageCollect: enqueue a force-gc core eval."""
         self.eval_broker.enqueue(core_eval("force-gc"))
 
-    def _gc_loop(self) -> None:
+    def _gc_loop(self, stop: threading.Event) -> None:
         """Periodic threshold GC (reference leader.go schedulePeriodic)."""
-        while not self._gc_stop.wait(self.gc_interval_s):
+        while not stop.wait(self.gc_interval_s):
             for kind in ("eval-gc", "job-gc", "node-gc", "deployment-gc"):
                 self.eval_broker.enqueue(core_eval(kind))
 

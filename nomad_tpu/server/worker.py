@@ -72,8 +72,13 @@ class Worker:
         self.processed = 0
 
     def start(self) -> None:
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._run, daemon=True, name=self.name)
+        # Fresh Event per incarnation: a thread that outlives join(timeout)
+        # (e.g. blocked in submit_plan) polls ITS event and still exits,
+        # instead of seeing a cleared shared flag and resuming as a twin.
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(self._stop,), daemon=True, name=self.name
+        )
         self._thread.start()
 
     def stop(self) -> None:
@@ -83,9 +88,9 @@ class Worker:
         if self._thread:
             self._thread.join(timeout)
 
-    def _run(self) -> None:
+    def _run(self, stop: threading.Event) -> None:
         broker = self.server.eval_broker
-        while not self._stop.is_set():
+        while not stop.is_set():
             ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
             if ev is None:
                 continue
@@ -145,18 +150,23 @@ class TPUBatchWorker:
         self.processed = 0
 
     def start(self) -> None:
-        self._stop.clear()
+        # Fresh Event per incarnation (see Worker.start).
+        self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._run, daemon=True, name="tpu-batch-worker"
+            target=self._run, args=(self._stop,), daemon=True,
+            name="tpu-batch-worker"
         )
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
 
-    def _run(self) -> None:
+    def _run(self, stop: threading.Event) -> None:
         broker = self.server.eval_broker
-        while not self._stop.is_set():
+        while not stop.is_set():
             batch: list[tuple[Evaluation, str]] = []
             ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
             if ev is None:

@@ -846,6 +846,7 @@ class StateStore(_ReadMixin):
                 na = a.copy()
                 na.deployment_status.canary = False
                 na.modify_index = index
+                na.modify_time = now_ns()
                 self._put_alloc(na, a)
             if eval_obj is not None:
                 self._upsert_evals_txn(index, [eval_obj])
@@ -883,6 +884,7 @@ class StateStore(_ReadMixin):
                 na.deployment_status.healthy = healthy
                 na.deployment_status.timestamp_ns = ts
                 na.modify_index = index
+                na.modify_time = ts
                 self._put_alloc(na, a)
             # resync counters from the alloc table (single source of truth)
             dt = self._wtable(TABLE_DEPLOYMENTS)

@@ -220,11 +220,20 @@ def test_drainer_rate_limits_by_migrate_stanza():
     assert len(marked) == 1
     # second pass: slot still held (migration not finished) -> no new marks
     assert d.run_once() == 0
-    # the migrating alloc stops (migration completed) -> next slot opens
+    # the migrating alloc stops, but its replacement hasn't reported
+    # health yet -> the slot is STILL held (reference watch_jobs.go:
+    # healthy - (count - max_parallel) gate)
     stopped = marked[0].copy()
     stopped.desired_status = "stop"
     stopped.client_status = "complete"
     p.raft_apply("alloc_update", [stopped])
+    assert d.run_once() == 0
+    # a running replacement on a non-draining node opens the next slot
+    other = mock.node()
+    p.raft_apply("node_register", other)
+    repl = mock.alloc(job_=job, node_=other, index=0)
+    repl.client_status = "running"
+    p.raft_apply("alloc_update", [repl])
     assert d.run_once() == 1
 
 

@@ -45,6 +45,17 @@ def test_system_drain_stops():
     h.state.upsert_job(h.next_index(), job)
     h.process("system", mock.eval_for_job(job))
     h.state.update_node_drain(h.next_index(), n1.id, DrainStrategy(deadline_s=60))
+    # The drainer (not the scheduler) owns the migrate decision for system
+    # allocs — it withholds the mark until services have drained. Mark the
+    # alloc the way the drainer does, then the scheduler acts on it.
+    from nomad_tpu.structs.structs import DesiredTransition
+
+    marked = {
+        a.id: DesiredTransition(migrate=True)
+        for a in h.state.allocs_by_job(job.namespace, job.id)
+        if a.node_id == n1.id and not a.terminal_status()
+    }
+    h.state.update_alloc_desired_transition(h.next_index(), marked, [])
     h.process("system", mock.eval_for_job(job, triggered_by="node-drain"))
     live = [a for a in h.state.allocs_by_job(job.namespace, job.id) if not a.terminal_status()]
     assert len(live) == 1
