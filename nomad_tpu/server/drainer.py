@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..structs import Evaluation, generate_uuid, now_ns
 from ..structs.structs import (
+    ALLOC_CLIENT_STATUS_RUNNING,
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_NODE_DRAIN,
     JOB_TYPE_BATCH,
@@ -131,7 +132,9 @@ class NodeDrainer:
         # reports health — expressed as the reference does it: allowed new
         # marks = healthy-anywhere − (group count − max_parallel)
         # (reference watch_jobs.go handleTaskGroup thresholdCount;
-        # "healthy" there is HasHealth on any non-terminal alloc).
+        # "healthy" there is IsHealthy — healthy==true — on any
+        # non-terminal alloc; allocs without a deployment fall back to
+        # client running status).
         for key, allocs in candidates.items():
             ns, job_id, tg_name = key
             job = jobs[key]
@@ -147,8 +150,8 @@ class NodeDrainer:
                     # these as terminal by the time its watcher re-fires).
                     continue
                 ds = a.deployment_status
-                if (ds is not None and ds.healthy is not None) or (
-                    ds is None and a.client_status == "running"
+                if (ds is not None and ds.healthy is True) or (
+                    ds is None and a.client_status == ALLOC_CLIENT_STATUS_RUNNING
                 ):
                     healthy += 1
             allowed = healthy - (count - limit)

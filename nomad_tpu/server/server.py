@@ -18,6 +18,8 @@ from typing import Optional
 
 from ..scheduler.context import SchedulerConfig
 from ..state import StateStore
+from ..state.events import wire_events
+from ..stream import EventBroker
 from ..structs import (
     Allocation,
     DrainStrategy,
@@ -65,6 +67,10 @@ class Server:
         self.state = StateStore()
         self.fsm = FSM(self.state)
         self.log = InmemLog(self.fsm)
+        # Event stream backbone (reference nomad/stream/event_broker.go,
+        # wired from state txns via nomad/state/events.go).
+        self.event_broker = EventBroker()
+        wire_events(self.state, self.event_broker)
         self.scheduler_config = scheduler_config or SchedulerConfig()
 
         self.eval_broker = EvalBroker()

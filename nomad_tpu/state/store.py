@@ -258,8 +258,11 @@ class StateStore(_ReadMixin):
         self._shared: set[str] = set()
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
-        # Event hooks: called under lock with (index, table, list-of-objects).
-        self._subscribers: list[Callable[[int, str, list], None]] = []
+        # Event hooks: called under lock with
+        # (index, table, list-of-objects, event-type). The event type mirrors
+        # the reference's raft-message-derived stream event types
+        # (nomad/state/events.go eventFromChange).
+        self._subscribers: list[Callable[[int, str, list, str], None]] = []
 
     # -- snapshot / watch ----------------------------------------------
 
@@ -313,7 +316,7 @@ class StateStore(_ReadMixin):
                     return cur
                 self._cv.wait(remaining)
 
-    def subscribe(self, fn: Callable[[int, str, list], None]) -> None:
+    def subscribe(self, fn: Callable[[int, str, list, str], None]) -> None:
         self._subscribers.append(fn)
 
     # -- write plumbing ------------------------------------------------
@@ -332,9 +335,11 @@ class StateStore(_ReadMixin):
             self._latest_index = index
         self._cv.notify_all()
 
-    def _publish(self, index: int, table: str, objs: list) -> None:
+    def _publish(
+        self, index: int, table: str, objs: list, etype: str = ""
+    ) -> None:
         for fn in self._subscribers:
-            fn(index, table, objs)
+            fn(index, table, objs, etype)
 
     def _idx_put(self, table: str, key, alloc: Allocation) -> None:
         t = self._wtable(table)
@@ -393,7 +398,7 @@ class StateStore(_ReadMixin):
             node.canonicalize()
             t[node.id] = node
             self._stamp(index, TABLE_NODES)
-            self._publish(index, TABLE_NODES, [node])
+            self._publish(index, TABLE_NODES, [node], "NodeRegistration")
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
@@ -414,7 +419,7 @@ class StateStore(_ReadMixin):
             node.modify_index = index
             t[node_id] = node
             self._stamp(index, TABLE_NODES)
-            self._publish(index, TABLE_NODES, [node])
+            self._publish(index, TABLE_NODES, [node], "NodeStatusUpdate")
 
     def update_node_drain(
         self,
@@ -443,7 +448,7 @@ class StateStore(_ReadMixin):
             node.modify_index = index
             t[node_id] = node
             self._stamp(index, TABLE_NODES)
-            self._publish(index, TABLE_NODES, [node])
+            self._publish(index, TABLE_NODES, [node], "NodeDrain")
 
     def update_node_eligibility(
         self, index: int, node_id: str, eligibility: str
@@ -469,7 +474,12 @@ class StateStore(_ReadMixin):
         with self._lock:
             self._upsert_job_txn(index, job, keep_version)
             self._stamp(index, TABLE_JOBS, TABLE_JOB_VERSIONS, TABLE_JOB_SUMMARIES)
-            self._publish(index, TABLE_JOBS, [self._tables[TABLE_JOBS][job.ns_id()]])
+            self._publish(
+                index,
+                TABLE_JOBS,
+                [self._tables[TABLE_JOBS][job.ns_id()]],
+                "JobRegistered",
+            )
 
     def _upsert_job_txn(self, index: int, job: Job, keep_version: bool = False) -> None:
         t = self._wtable(TABLE_JOBS)
@@ -543,7 +553,7 @@ class StateStore(_ReadMixin):
         with self._lock:
             stored = self._upsert_evals_txn(index, evals)
             self._stamp(index, TABLE_EVALS)
-            self._publish(index, TABLE_EVALS, stored)
+            self._publish(index, TABLE_EVALS, stored, "EvaluationUpdated")
 
     def _upsert_evals_txn(self, index: int, evals: list[Evaluation]) -> list[Evaluation]:
         t = self._wtable(TABLE_EVALS)
@@ -594,7 +604,7 @@ class StateStore(_ReadMixin):
         with self._lock:
             stored = self._upsert_allocs_txn(index, allocs)
             self._stamp(index, TABLE_ALLOCS, TABLE_JOB_SUMMARIES)
-            self._publish(index, TABLE_ALLOCS, stored)
+            self._publish(index, TABLE_ALLOCS, stored, "AllocationUpdated")
 
     def _upsert_allocs_txn(self, index: int, allocs: list[Allocation]) -> list[Allocation]:
         t = self._wtable(TABLE_ALLOCS)
@@ -671,7 +681,9 @@ class StateStore(_ReadMixin):
             for ns, job_id in jobs_touched:
                 self._update_job_status_txn(index, ns, job_id)
             self._stamp(index, TABLE_ALLOCS, TABLE_JOB_SUMMARIES)
-            self._publish(index, TABLE_ALLOCS, stored)
+            self._publish(
+                index, TABLE_ALLOCS, stored, "AllocationUpdatedFromClient"
+            )
 
     def update_alloc_desired_transition(
         self, index: int, transitions: dict[str, "DesiredTransition"], evals: list[Evaluation]
@@ -754,7 +766,7 @@ class StateStore(_ReadMixin):
             self._reconcile_summaries_txn(index, jobs_touched)
             for ns, job_id in jobs_touched:
                 self._update_job_status_txn(index, ns, job_id)
-            self._publish(index, TABLE_ALLOCS, committed)
+            self._publish(index, TABLE_ALLOCS, committed, "PlanResult")
 
     # -- deployments ---------------------------------------------------
 
@@ -762,7 +774,9 @@ class StateStore(_ReadMixin):
         with self._lock:
             self._upsert_deployment_txn(index, deployment)
             self._stamp(index, TABLE_DEPLOYMENTS)
-            self._publish(index, TABLE_DEPLOYMENTS, [deployment])
+            self._publish(
+                index, TABLE_DEPLOYMENTS, [deployment], "DeploymentStatusUpdate"
+            )
 
     def _upsert_deployment_txn(self, index: int, deployment: Deployment) -> None:
         t = self._wtable(TABLE_DEPLOYMENTS)

@@ -1,0 +1,17 @@
+"""Event streaming (reference: nomad/stream/)."""
+
+from .event_broker import (
+    Event,
+    EventBroker,
+    Subscription,
+    SubscriptionClosedError,
+    TOPIC_ALL,
+)
+
+__all__ = [
+    "Event",
+    "EventBroker",
+    "Subscription",
+    "SubscriptionClosedError",
+    "TOPIC_ALL",
+]

@@ -1,0 +1,174 @@
+"""Ring-buffer event broker with topic-filtered subscriptions.
+
+Reference: nomad/stream/event_broker.go:55 (EventBroker),
+event_buffer.go (ring buffer of event blocks, dropped-tail detection) and
+subscription.go (per-subscriber cursor + filter). The TPU-native redesign
+keeps the same contract:
+
+  * `publish` appends a block of events sharing one raft index;
+  * each `Subscription` holds a cursor into the buffer and blocks until
+    events past its cursor arrive;
+  * a slow subscriber whose cursor falls off the ring is closed with
+    `SubscriptionClosedError` and must re-subscribe (possibly re-reading
+    current state first) — exactly the reference's
+    ErrSubscriptionClosed discipline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+TOPIC_ALL = "*"
+KEY_ALL = "*"
+
+# Topics (reference: nomad/structs/structs.go TopicNode/TopicJob/...)
+TOPIC_NODE = "Node"
+TOPIC_JOB = "Job"
+TOPIC_EVAL = "Evaluation"
+TOPIC_ALLOC = "Allocation"
+TOPIC_DEPLOYMENT = "Deployment"
+
+
+@dataclass(frozen=True)
+class Event:
+    """One change event (reference structs.Event)."""
+
+    topic: str
+    type: str
+    key: str
+    index: int
+    payload: object
+    namespace: str = ""
+    filter_keys: tuple = field(default_factory=tuple)
+
+    def matches(self, topics: dict[str, list[str]]) -> bool:
+        for topic in (self.topic, TOPIC_ALL):
+            keys = topics.get(topic)
+            if keys is None:
+                continue
+            for k in keys:
+                if k == KEY_ALL or k == self.key or k in self.filter_keys:
+                    return True
+        return False
+
+
+class SubscriptionClosedError(Exception):
+    """The subscriber fell off the ring buffer (or the broker closed)."""
+
+
+class Subscription:
+    def __init__(self, broker: "EventBroker", topics: dict[str, list[str]], start_seq: int):
+        self._broker = broker
+        self._topics = topics
+        self._seq = start_seq  # next block sequence number to consume
+        self._closed = False
+
+    def next(self, timeout_s: Optional[float] = 5.0) -> list[Event]:
+        """Block for the next matching block of events.
+
+        Returns [] on timeout. Raises SubscriptionClosedError if the ring
+        has overwritten our cursor or the broker shut down. The timeout is
+        a single deadline across non-matching blocks — a busy broker full
+        of filtered-out events can't extend it.
+        """
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return []
+            block = self._broker._next_block(self, remaining)
+            if block is None:
+                return []
+            events = [e for e in block if e.matches(self._topics)]
+            if events:
+                return events
+
+    def close(self) -> None:
+        self._closed = True
+        with self._broker._cv:
+            self._broker._cv.notify_all()
+
+
+class EventBroker:
+    """Fixed-size ring of event blocks; fan-out to subscriptions.
+
+    Reference: nomad/stream/event_broker.go (size from
+    `event_buffer_size` agent config, default 100).
+    """
+
+    def __init__(self, size: int = 1024) -> None:
+        self._size = size
+        self._blocks: deque[tuple[int, int, list[Event]]] = deque()  # (seq, index, events)
+        self._next_seq = 0
+        self._latest_index = 0
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+
+    # -- publishing ----------------------------------------------------
+
+    def publish(self, events: list[Event]) -> None:
+        if not events:
+            return
+        with self._cv:
+            index = events[0].index
+            self._blocks.append((self._next_seq, index, list(events)))
+            self._next_seq += 1
+            while len(self._blocks) > self._size:
+                self._blocks.popleft()
+            if index > self._latest_index:
+                self._latest_index = index
+            self._cv.notify_all()
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return self._latest_index
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    # -- subscribing ---------------------------------------------------
+
+    def subscribe(
+        self,
+        topics: Optional[dict[str, list[str]]] = None,
+        from_index: int = 0,
+    ) -> Subscription:
+        """Subscribe starting at the first buffered block with
+        index > from_index (0 ⇒ only new events)."""
+        topics = topics or {TOPIC_ALL: [KEY_ALL]}
+        with self._lock:
+            if from_index == 0:
+                start_seq = self._next_seq
+            else:
+                start_seq = self._next_seq
+                for seq, index, _ in self._blocks:
+                    if index > from_index:
+                        start_seq = seq
+                        break
+            return Subscription(self, topics, start_seq)
+
+    def _next_block(
+        self, sub: Subscription, timeout_s: Optional[float]
+    ) -> Optional[list[Event]]:
+        with self._cv:
+            while True:
+                if sub._closed or self._closed:
+                    raise SubscriptionClosedError()
+                oldest_seq = self._blocks[0][0] if self._blocks else self._next_seq
+                if sub._seq < oldest_seq:
+                    # Ring overwrote our cursor: too slow.
+                    raise SubscriptionClosedError("subscriber fell behind")
+                if sub._seq < self._next_seq:
+                    offset = sub._seq - oldest_seq
+                    block = self._blocks[offset][2]
+                    sub._seq += 1
+                    return block
+                if not self._cv.wait(timeout_s):
+                    return None

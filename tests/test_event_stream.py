@@ -1,0 +1,121 @@
+"""Event broker + state→stream bridge tests.
+
+Reference behaviors: nomad/stream/event_broker_test.go,
+subscription semantics (close-on-overrun), topic/key filtering.
+"""
+
+import threading
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.events import wire_events
+from nomad_tpu.stream import (
+    Event,
+    EventBroker,
+    SubscriptionClosedError,
+)
+
+
+def _ev(i, topic="Node", key="k", etype="T"):
+    return Event(topic=topic, type=etype, key=key, index=i, payload=None)
+
+
+class TestEventBroker:
+    def test_publish_subscribe(self):
+        b = EventBroker()
+        sub = b.subscribe({"Node": ["*"]})
+        b.publish([_ev(1)])
+        got = sub.next(timeout_s=1)
+        assert len(got) == 1 and got[0].index == 1
+
+    def test_topic_filtering(self):
+        b = EventBroker()
+        sub = b.subscribe({"Job": ["*"]})
+        b.publish([_ev(1, topic="Node")])
+        b.publish([_ev(2, topic="Job")])
+        got = sub.next(timeout_s=1)
+        assert got and all(e.topic == "Job" for e in got)
+
+    def test_key_filtering(self):
+        b = EventBroker()
+        sub = b.subscribe({"Node": ["n2"]})
+        b.publish([_ev(1, key="n1"), _ev(1, key="n2")])
+        got = sub.next(timeout_s=1)
+        assert [e.key for e in got] == ["n2"]
+
+    def test_from_index_replay(self):
+        b = EventBroker()
+        for i in range(1, 6):
+            b.publish([_ev(i)])
+        sub = b.subscribe({"*": ["*"]}, from_index=3)
+        got = sub.next(timeout_s=1)
+        assert got[0].index == 4
+
+    def test_timeout_returns_empty(self):
+        b = EventBroker()
+        sub = b.subscribe()
+        assert sub.next(timeout_s=0.05) == []
+
+    def test_slow_subscriber_closed(self):
+        b = EventBroker(size=4)
+        sub = b.subscribe()
+        b.publish([_ev(1)])
+        for i in range(2, 10):
+            b.publish([_ev(i)])
+        with pytest.raises(SubscriptionClosedError):
+            sub.next(timeout_s=1)
+
+    def test_close_wakes_blocked_subscriber(self):
+        b = EventBroker()
+        sub = b.subscribe()
+        errs = []
+
+        def reader():
+            try:
+                sub.next(timeout_s=5)
+            except SubscriptionClosedError:
+                errs.append(True)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        sub.close()
+        t.join(2)
+        assert errs == [True]
+
+
+class TestStateEvents:
+    def test_node_registration_event(self):
+        store, broker = StateStore(), EventBroker()
+        wire_events(store, broker)
+        sub = broker.subscribe({"Node": ["*"]})
+        n = mock.node()
+        store.upsert_node(1, n)
+        got = sub.next(timeout_s=1)
+        assert got[0].type == "NodeRegistration"
+        assert got[0].key == n.id
+
+    def test_job_and_eval_events(self):
+        store, broker = StateStore(), EventBroker()
+        wire_events(store, broker)
+        sub = broker.subscribe({"Job": ["*"], "Evaluation": ["*"]})
+        job = mock.job()
+        store.upsert_job(1, job)
+        got = sub.next(timeout_s=1)
+        assert got[0].topic == "Job" and got[0].type == "JobRegistered"
+        ev = mock.eval_for_job(job)
+        store.upsert_evals(2, [ev])
+        got = sub.next(timeout_s=1)
+        assert got[0].topic == "Evaluation"
+
+    def test_alloc_filter_by_job_key(self):
+        store, broker = StateStore(), EventBroker()
+        wire_events(store, broker)
+        job = mock.job()
+        store.upsert_job(1, job)
+        sub = broker.subscribe({"Allocation": [job.id]})
+        alloc = mock.alloc(job_=job)
+        store.upsert_allocs(2, [alloc])
+        got = sub.next(timeout_s=1)
+        assert got[0].key == alloc.id
