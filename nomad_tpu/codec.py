@@ -1,0 +1,130 @@
+"""Wire codec for the shared struct vocabulary.
+
+Reference: the Go tree serializes every RPC payload with msgpack struct
+codecs (helper/pool/pool.go:22-30 msgpackHandle; nomad/structs/structs.go
+codec tags) and the HTTP API with encoding/json. The TPU-native build keeps
+one reflective codec for both paths:
+
+  * ``to_wire`` lowers any registered dataclass (Job, Node, Allocation, …)
+    to plain JSON-able data tagged with its type name;
+  * ``from_wire`` reconstructs typed structs recursively;
+  * ``pack``/``unpack`` frame that through msgpack for the RPC fabric —
+    never pickle, so a malicious peer can at worst produce garbage structs,
+    not code execution.
+
+Tuple dict-keys (the state store's (namespace, job_id) keys) and tuples as
+values are encoded explicitly since neither JSON nor msgpack has them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import msgpack
+
+_TYPE_KEY = "$t"
+_TUPLE_KEY = "$tuple"
+_MAP_KEY = "$map"  # dict with non-str keys: list of [k, v] pairs
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_type(cls: type) -> type:
+    """Register a dataclass for wire round-trips (idempotent)."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _register_builtin_structs() -> None:
+    from . import structs as structs_pkg
+    from .structs import structs as structs_mod
+
+    for mod in (
+        structs_mod,
+        __import__("nomad_tpu.structs.network", fromlist=["x"]),
+        __import__("nomad_tpu.structs.devices", fromlist=["x"]),
+    ):
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if isinstance(obj, type) and dataclasses.is_dataclass(obj):
+                register_type(obj)
+
+
+def to_wire(obj: Any) -> Any:
+    """Lower to JSON/msgpack-able data. Unknown object types are an error —
+    payloads must be built from registered structs and primitives."""
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, tuple):
+        return {_TUPLE_KEY: [to_wire(v) for v in obj]}
+    if isinstance(obj, (list, set, frozenset)):
+        return [to_wire(v) for v in obj]
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) for k in obj):
+            return {k: to_wire(v) for k, v in obj.items()}
+        return {_MAP_KEY: [[to_wire(k), to_wire(v)] for k, v in obj.items()]}
+    cls = type(obj)
+    if dataclasses.is_dataclass(obj):
+        if cls.__name__ not in _REGISTRY:
+            register_type(cls)
+        out: dict[str, Any] = {_TYPE_KEY: cls.__name__}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_wire(getattr(obj, f.name))
+        return out
+    # Non-dataclass registered types (e.g. JobSummary) round-trip via
+    # __dict__.
+    if cls.__name__ in _REGISTRY:
+        out = {_TYPE_KEY: cls.__name__}
+        for k, v in vars(obj).items():
+            out[k] = to_wire(v)
+        return out
+    raise TypeError(f"cannot encode {cls.__name__!r} for the wire")
+
+
+def from_wire(data: Any) -> Any:
+    if data is None or isinstance(data, (bool, int, float, str, bytes)):
+        return data
+    if isinstance(data, list):
+        return [from_wire(v) for v in data]
+    if isinstance(data, dict):
+        if _TUPLE_KEY in data and len(data) == 1:
+            return tuple(from_wire(v) for v in data[_TUPLE_KEY])
+        if _MAP_KEY in data and len(data) == 1:
+            return {from_wire(k): from_wire(v) for k, v in data[_MAP_KEY]}
+        tname = data.get(_TYPE_KEY)
+        if tname is None:
+            return {k: from_wire(v) for k, v in data.items()}
+        cls = _REGISTRY.get(tname)
+        if cls is None:
+            raise TypeError(f"unknown wire type {tname!r}")
+        obj = cls.__new__(cls)
+        seen = set()
+        for k, v in data.items():
+            if k == _TYPE_KEY:
+                continue
+            setattr(obj, k, from_wire(v))
+            seen.add(k)
+        # Fields the sender didn't know about (version skew) get their
+        # declared defaults so the struct is always fully formed.
+        if dataclasses.is_dataclass(cls):
+            for f in dataclasses.fields(cls):
+                if f.name in seen:
+                    continue
+                if f.default is not dataclasses.MISSING:
+                    setattr(obj, f.name, f.default)
+                elif f.default_factory is not dataclasses.MISSING:
+                    setattr(obj, f.name, f.default_factory())
+        return obj
+    raise TypeError(f"cannot decode wire value of type {type(data).__name__}")
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(to_wire(obj), use_bin_type=True)
+
+
+def unpack(raw: bytes) -> Any:
+    return from_wire(msgpack.unpackb(raw, raw=False, strict_map_key=False))
+
+
+_register_builtin_structs()

@@ -1,0 +1,6 @@
+"""RPC fabric (reference: nomad/rpc.go, helper/pool/)."""
+
+from .client import ConnPool, RPCError
+from .server import RPCServer, StreamSession
+
+__all__ = ["ConnPool", "RPCError", "RPCServer", "StreamSession"]

@@ -1,0 +1,178 @@
+"""RPC client: pooled, pipelined connections.
+
+Reference: helper/pool/pool.go — one pooled session per remote address,
+many in-flight requests multiplexed over it (the reference uses yamux
+streams; here, pipelined frames matched by sequence number), with
+connection rundown on error and a streaming-connection escape hatch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import threading
+from typing import Optional
+
+from .. import codec
+from .server import StreamSession
+from .wire import BYTE_RPC, BYTE_STREAMING, recv_frame, send_frame
+
+logger = logging.getLogger("nomad_tpu.rpc")
+
+
+class RPCError(Exception):
+    """A handler-side error string carried back over the wire."""
+
+
+class _Conn:
+    """One pipelined connection: writer = any caller thread (locked),
+    reader = dedicated thread demuxing responses by seq."""
+
+    def __init__(self, addr: tuple[str, int], connect_timeout_s: float) -> None:
+        self.sock = socket.create_connection(addr, timeout=connect_timeout_s)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.settimeout(None)
+        self.sock.sendall(bytes([BYTE_RPC]))
+        self._wlock = threading.Lock()
+        self._seq = itertools.count()
+        self._pending: dict[int, dict] = {}
+        self._pending_lock = threading.Lock()
+        self.dead = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="rpc-conn-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                resp = codec.unpack(recv_frame(self.sock))
+                with self._pending_lock:
+                    waiter = self._pending.pop(resp.get("seq"), None)
+                if waiter is not None:
+                    waiter["resp"] = resp
+                    waiter["event"].set()
+        except (ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc reader failed")
+        finally:
+            self.dead = True
+            # Close our half immediately so the peer's port can leave
+            # FIN_WAIT and be rebound (matters for fast server restarts).
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            with self._pending_lock:
+                pending, self._pending = self._pending, {}
+            for waiter in pending.values():
+                waiter["resp"] = {"error": "connection closed"}
+                waiter["event"].set()
+
+    def call(self, method: str, args, timeout_s: float):
+        seq = next(self._seq)
+        waiter = {"event": threading.Event(), "resp": None}
+        with self._pending_lock:
+            if self.dead:
+                raise ConnectionError("connection closed")
+            self._pending[seq] = waiter
+        try:
+            payload = codec.pack({"seq": seq, "method": method, "args": args})
+            with self._wlock:
+                send_frame(self.sock, payload)
+        except (ConnectionError, OSError):
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            self.dead = True
+            raise
+        if not waiter["event"].wait(timeout_s):
+            with self._pending_lock:
+                self._pending.pop(seq, None)
+            raise TimeoutError(f"rpc {method} timed out after {timeout_s}s")
+        resp = waiter["resp"]
+        if "error" in resp:
+            if resp["error"] == "connection closed":
+                raise ConnectionError("connection closed")
+            raise RPCError(resp["error"])
+        return resp.get("result")
+
+    def close(self) -> None:
+        self.dead = True
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class ConnPool:
+    """Pooled RPC connections keyed by address (reference helper/pool)."""
+
+    def __init__(self, connect_timeout_s: float = 5.0) -> None:
+        self._conns: dict[tuple[str, int], _Conn] = {}
+        self._lock = threading.Lock()
+        self._connect_timeout_s = connect_timeout_s
+
+    def call(
+        self,
+        addr: tuple[str, int],
+        method: str,
+        args=None,
+        timeout_s: float = 30.0,
+        retries: int = 1,
+    ):
+        """Invoke `Endpoint.method` at addr. One automatic retry on a dead
+        pooled connection (the reference's pool does the same rundown +
+        redial)."""
+        addr = (addr[0], addr[1])
+        last_err: Optional[Exception] = None
+        for _ in range(retries + 1):
+            conn = self._get(addr)
+            try:
+                return conn.call(method, args, timeout_s)
+            except (ConnectionError, OSError) as e:
+                last_err = e
+                self._drop(addr, conn)
+        raise last_err  # type: ignore[misc]
+
+    def stream(
+        self, addr: tuple[str, int], method: str, header: Optional[dict] = None
+    ) -> StreamSession:
+        """Open a dedicated streaming session (reference RpcStreaming)."""
+        sock = socket.create_connection(addr, timeout=self._connect_timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        sock.sendall(bytes([BYTE_STREAMING]))
+        session = StreamSession(sock)
+        hdr = dict(header or {})
+        hdr["method"] = method
+        session.send(hdr)
+        ack = session.recv(timeout_s=30)
+        if "error" in ack:
+            session.close()
+            raise RPCError(ack["error"])
+        return session
+
+    def _get(self, addr: tuple[str, int]) -> _Conn:
+        with self._lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.dead:
+                return conn
+            conn = _Conn(addr, self._connect_timeout_s)
+            self._conns[addr] = conn
+            return conn
+
+    def _drop(self, addr: tuple[str, int], conn: _Conn) -> None:
+        with self._lock:
+            if self._conns.get(addr) is conn:
+                del self._conns[addr]
+        conn.close()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()

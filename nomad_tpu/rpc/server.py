@@ -1,0 +1,227 @@
+"""RPC server: TCP listener with first-byte protocol switch.
+
+Reference: nomad/rpc.go — listen loop (:178 listen), handleConn (:229,
+first-byte switch), handleNomadConn request loop (:352), endpoint structs
+registered on a net/rpc server (nomad/server.go:1137-1184), streaming
+handlers (:299 RpcStreaming), and the dedicated Raft stream layer
+(nomad/raft_rpc.go).
+
+Design: each accepted connection gets a reader thread. RPC requests are
+dispatched to a small worker pool so one slow handler doesn't stall the
+connection (net/rpc semantics — responses may arrive out of order, matched
+by seq). Streaming connections hand the raw socket to the registered
+stream handler. Raft connections are dispatched to the raft transport
+handler installed by the replication layer.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+from .. import codec
+from .wire import BYTE_RAFT, BYTE_RPC, BYTE_STREAMING, recv_frame, send_frame
+
+logger = logging.getLogger("nomad_tpu.rpc")
+
+
+class StreamSession:
+    """A byte-frame session handed to streaming handlers (reference:
+    nomad/structs/streaming_rpc.go)."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        self._wlock = threading.Lock()
+
+    def send(self, obj) -> None:
+        with self._wlock:
+            send_frame(self._sock, codec.pack(obj))
+
+    def recv(self, timeout_s: Optional[float] = None):
+        self._sock.settimeout(timeout_s)
+        return codec.unpack(recv_frame(self._sock))
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class RPCServer:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        num_workers: int = 8,
+    ) -> None:
+        self._endpoints: dict[str, object] = {}
+        self._stream_handlers: dict[str, Callable[[StreamSession, dict], None]] = {}
+        self.raft_handler: Optional[Callable[[StreamSession], None]] = None
+        # Fixed-port binds retry briefly: an in-process restart races the
+        # previous incarnation's sockets draining out of FIN_WAIT.
+        deadline = time.monotonic() + (5.0 if port else 0.0)
+        while True:
+            try:
+                self._listener = socket.create_server((host, port))
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        self.addr = self._listener.getsockname()  # (host, port)
+        self._pool = ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix="rpc"
+        )
+        self._shutdown = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+
+    # -- registration --------------------------------------------------
+
+    def register(self, name: str, endpoint: object) -> None:
+        """Register an endpoint struct; its public methods become
+        `Name.method` RPCs (reference nomad/server.go setupRpcServer)."""
+        self._endpoints[name] = endpoint
+
+    def register_stream(
+        self, method: str, handler: Callable[[StreamSession, dict], None]
+    ) -> None:
+        self._stream_handlers[method] = handler
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rpc-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        # shutdown() interrupts the thread blocked in accept(); a bare
+        # close() would leave the fd (and the LISTEN port) held until the
+        # accept call returned.
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+        self._pool.shutdown(wait=False)
+        if self._accept_thread:
+            self._accept_thread.join(timeout=5)
+
+    # -- connection handling -------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _drop_conn(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            first = conn.recv(1)
+            if not first:
+                return
+            proto = first[0]
+            if proto == BYTE_RPC:
+                self._handle_rpc_conn(conn)
+            elif proto == BYTE_STREAMING:
+                self._handle_stream_conn(conn)
+            elif proto == BYTE_RAFT:
+                if self.raft_handler is not None:
+                    self.raft_handler(StreamSession(conn))
+                else:
+                    logger.warning("raft connection but no raft handler")
+            else:
+                logger.warning("unrecognized rpc protocol byte %#x", proto)
+        except (ConnectionError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc connection handler failed")
+        finally:
+            self._drop_conn(conn)
+
+    def _handle_rpc_conn(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        while not self._shutdown.is_set():
+            req = codec.unpack(recv_frame(conn))
+            self._pool.submit(self._dispatch, conn, wlock, req)
+
+    def _dispatch(self, conn: socket.socket, wlock: threading.Lock, req) -> None:
+        seq = req.get("seq")
+        method = req.get("method", "")
+        try:
+            result = self.dispatch_local(method, req.get("args"))
+            resp = {"seq": seq, "result": result}
+        except Exception as e:  # handler errors travel as strings
+            logger.debug("rpc %s failed: %s", method, e)
+            resp = {"seq": seq, "error": f"{type(e).__name__}: {e}"}
+        try:
+            with wlock:
+                send_frame(conn, codec.pack(resp))
+        except (ConnectionError, OSError):
+            pass
+
+    def dispatch_local(self, method: str, args):
+        """Resolve `Endpoint.method` and invoke it (also used in-process to
+        skip the socket for self-calls, like the reference's
+        server.RPC fast path)."""
+        try:
+            name, meth = method.split(".", 1)
+        except ValueError:
+            raise ValueError(f"malformed rpc method {method!r}")
+        endpoint = self._endpoints.get(name)
+        if endpoint is None:
+            raise ValueError(f"unknown rpc endpoint {name!r}")
+        if meth.startswith("_"):
+            raise ValueError(f"invalid rpc method {method!r}")
+        fn = getattr(endpoint, meth, None)
+        if fn is None or not callable(fn):
+            raise ValueError(f"unknown rpc method {method!r}")
+        return fn(args)
+
+    def _handle_stream_conn(self, conn: socket.socket) -> None:
+        session = StreamSession(conn)
+        header = session.recv(timeout_s=30)
+        method = header.get("method", "")
+        handler = self._stream_handlers.get(method)
+        if handler is None:
+            session.send({"error": f"unknown stream method {method!r}"})
+            session.close()
+            return
+        session.send({"ok": True})
+        handler(session, header)

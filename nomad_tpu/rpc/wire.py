@@ -1,0 +1,44 @@
+"""Framing + protocol bytes shared by RPC client and server.
+
+Reference: nomad/rpc.go:229-316 — a raw TCP connection's first byte selects
+the protocol (RpcNomad/RpcRaft/RpcMultiplex/RpcTLS/RpcStreaming). The
+TPU-native fabric keeps the same first-byte switch with length-prefixed
+msgpack frames instead of net/rpc + yamux: one logical request/response (or
+stream chunk) per frame, with interleaving by sequence number replacing
+stream multiplexing — simpler, and equally pipelined.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+# First-byte protocol identifiers (reference nomad/rpc.go RpcNomad=0x01...)
+BYTE_RPC = 0x01
+BYTE_RAFT = 0x02
+BYTE_STREAMING = 0x03
+
+MAX_FRAME = 256 * 1024 * 1024
+
+_LEN = struct.Struct("!I")
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {length}")
+    return recv_exact(sock, length)

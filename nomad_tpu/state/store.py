@@ -403,9 +403,11 @@ class StateStore(_ReadMixin):
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:
             t = self._wtable(TABLE_NODES)
-            if node_id in t:
+            node = t.get(node_id)
+            if node is not None:
                 del t[node_id]
                 self._stamp(index, TABLE_NODES)
+                self._publish(index, TABLE_NODES, [node], "NodeDeregistration")
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
         with self._lock:
@@ -467,6 +469,7 @@ class StateStore(_ReadMixin):
             node.modify_index = index
             t[node_id] = node
             self._stamp(index, TABLE_NODES)
+            self._publish(index, TABLE_NODES, [node], "NodeEligibilityUpdate")
 
     # -- jobs ----------------------------------------------------------
 
@@ -538,7 +541,8 @@ class StateStore(_ReadMixin):
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
             t = self._wtable(TABLE_JOBS)
-            if (namespace, job_id) in t:
+            job = t.get((namespace, job_id))
+            if job is not None:
                 del t[(namespace, job_id)]
             vt = self._wtable(TABLE_JOB_VERSIONS)
             for k in [k for k in vt if k[0] == namespace and k[1] == job_id]:
@@ -546,6 +550,8 @@ class StateStore(_ReadMixin):
             st = self._wtable(TABLE_JOB_SUMMARIES)
             st.pop((namespace, job_id), None)
             self._stamp(index, TABLE_JOBS, TABLE_JOB_VERSIONS, TABLE_JOB_SUMMARIES)
+            if job is not None:
+                self._publish(index, TABLE_JOBS, [job], "JobDeregistered")
 
     # -- evals ---------------------------------------------------------
 
@@ -592,11 +598,19 @@ class StateStore(_ReadMixin):
     def delete_evals(self, index: int, eval_ids: list[str], alloc_ids: list[str]) -> None:
         with self._lock:
             t = self._wtable(TABLE_EVALS)
-            for eid in eval_ids:
-                t.pop(eid, None)
+            gone_evals = [t.pop(eid) for eid in eval_ids if eid in t]
+            gone_allocs = [
+                a
+                for aid in alloc_ids
+                if (a := self._tables[TABLE_ALLOCS].get(aid)) is not None
+            ]
             for aid in alloc_ids:
                 self._del_alloc(aid)
             self._stamp(index, TABLE_EVALS, TABLE_ALLOCS)
+            if gone_evals:
+                self._publish(index, TABLE_EVALS, gone_evals, "EvaluationDeleted")
+            if gone_allocs:
+                self._publish(index, TABLE_ALLOCS, gone_allocs, "AllocationDeleted")
 
     # -- allocs --------------------------------------------------------
 
@@ -692,6 +706,7 @@ class StateStore(_ReadMixin):
 
         with self._lock:
             t = self._wtable(TABLE_ALLOCS)
+            changed: list[Allocation] = []
             for alloc_id, transition in transitions.items():
                 existing = t.get(alloc_id)
                 if existing is None:
@@ -706,10 +721,17 @@ class StateStore(_ReadMixin):
                     dt.force_reschedule = transition.force_reschedule
                 alloc.modify_index = index
                 self._put_alloc(alloc, existing)
+                changed.append(alloc)
             if evals:
-                self._upsert_evals_txn(index, evals)
+                stored_evals = self._upsert_evals_txn(index, evals)
                 self._stamp(index, TABLE_EVALS)
             self._stamp(index, TABLE_ALLOCS)
+            if changed:
+                self._publish(
+                    index, TABLE_ALLOCS, changed, "AllocationUpdateDesiredStatus"
+                )
+            if evals:
+                self._publish(index, TABLE_EVALS, stored_evals, "EvaluationUpdated")
 
     # -- plan results (the serialization point) ------------------------
 
@@ -726,10 +748,17 @@ class StateStore(_ReadMixin):
             for allocs in result.node_preemptions.values():
                 preempted.extend(allocs)
 
+            deployment_events: list = []
             if result.deployment is not None:
                 self._upsert_deployment_txn(index, result.deployment)
+                deployment_events.append(
+                    self._tables[TABLE_DEPLOYMENTS][result.deployment.id]
+                )
             for du in result.deployment_updates:
                 self._update_deployment_status_txn(index, du)
+                d = self._tables[TABLE_DEPLOYMENTS].get(du.deployment_id)
+                if d is not None:
+                    deployment_events.append(d)
 
             t = self._wtable(TABLE_ALLOCS)
             # Stops and preemptions merge desired-status changes onto the
@@ -767,6 +796,13 @@ class StateStore(_ReadMixin):
             for ns, job_id in jobs_touched:
                 self._update_job_status_txn(index, ns, job_id)
             self._publish(index, TABLE_ALLOCS, committed, "PlanResult")
+            if deployment_events:
+                self._publish(
+                    index,
+                    TABLE_DEPLOYMENTS,
+                    deployment_events,
+                    "DeploymentStatusUpdate",
+                )
 
     # -- deployments ---------------------------------------------------
 
@@ -803,13 +839,21 @@ class StateStore(_ReadMixin):
         with self._lock:
             self._update_deployment_status_txn(index, update)
             self._stamp(index, TABLE_DEPLOYMENTS)
+            d = self._tables[TABLE_DEPLOYMENTS].get(update.deployment_id)
+            if d is not None:
+                self._publish(
+                    index, TABLE_DEPLOYMENTS, [d], "DeploymentStatusUpdate"
+                )
 
     def delete_deployment(self, index: int, deployment_ids: list[str]) -> None:
         with self._lock:
             t = self._wtable(TABLE_DEPLOYMENTS)
-            for did in deployment_ids:
-                t.pop(did, None)
+            gone = [t.pop(did) for did in deployment_ids if did in t]
             self._stamp(index, TABLE_DEPLOYMENTS)
+            if gone:
+                self._publish(
+                    index, TABLE_DEPLOYMENTS, gone, "DeploymentDeleted"
+                )
 
     def update_deployment_promotion(
         self,
@@ -866,6 +910,14 @@ class StateStore(_ReadMixin):
                 self._upsert_evals_txn(index, [eval_obj])
                 self._stamp(index, TABLE_EVALS)
             self._stamp(index, TABLE_DEPLOYMENTS, TABLE_ALLOCS)
+            d2 = self._tables[TABLE_DEPLOYMENTS].get(deployment_id)
+            if d2 is not None:
+                self._publish(
+                    index, TABLE_DEPLOYMENTS, [d2], "DeploymentAllocHealth"
+                )
+            self._publish(
+                index, TABLE_DEPLOYMENTS, [d], "DeploymentPromotion"
+            )
 
     def update_alloc_deployment_health(
         self,

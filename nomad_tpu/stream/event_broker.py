@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -61,11 +60,23 @@ class SubscriptionClosedError(Exception):
 
 
 class Subscription:
-    def __init__(self, broker: "EventBroker", topics: dict[str, list[str]], start_seq: int):
+    def __init__(
+        self,
+        broker: "EventBroker",
+        topics: dict[str, list[str]],
+        start_seq: int,
+        namespace: str = "",
+    ):
         self._broker = broker
         self._topics = topics
+        self._namespace = namespace  # "" ⇒ all namespaces
         self._seq = start_seq  # next block sequence number to consume
         self._closed = False
+
+    def _match(self, e: Event) -> bool:
+        if self._namespace and e.namespace and e.namespace != self._namespace:
+            return False
+        return e.matches(self._topics)
 
     def next(self, timeout_s: Optional[float] = 5.0) -> list[Event]:
         """Block for the next matching block of events.
@@ -83,7 +94,7 @@ class Subscription:
             block = self._broker._next_block(self, remaining)
             if block is None:
                 return []
-            events = [e for e in block if e.matches(self._topics)]
+            events = [e for e in block if self._match(e)]
             if events:
                 return events
 
@@ -102,7 +113,10 @@ class EventBroker:
 
     def __init__(self, size: int = 1024) -> None:
         self._size = size
-        self._blocks: deque[tuple[int, int, list[Event]]] = deque()  # (seq, index, events)
+        # seq -> (raft index, events); insertion-ordered, evicted oldest
+        # first. A dict keyed by seq gives O(1) random access for lagging
+        # subscribers (a deque would make catch-up O(size) per block).
+        self._blocks: dict[int, tuple[int, list[Event]]] = {}
         self._next_seq = 0
         self._latest_index = 0
         self._lock = threading.Lock()
@@ -116,10 +130,10 @@ class EventBroker:
             return
         with self._cv:
             index = events[0].index
-            self._blocks.append((self._next_seq, index, list(events)))
+            self._blocks[self._next_seq] = (index, list(events))
             self._next_seq += 1
             while len(self._blocks) > self._size:
-                self._blocks.popleft()
+                self._blocks.pop(next(iter(self._blocks)))
             if index > self._latest_index:
                 self._latest_index = index
             self._cv.notify_all()
@@ -139,20 +153,20 @@ class EventBroker:
         self,
         topics: Optional[dict[str, list[str]]] = None,
         from_index: int = 0,
+        namespace: str = "",
     ) -> Subscription:
         """Subscribe starting at the first buffered block with
-        index > from_index (0 ⇒ only new events)."""
+        index > from_index (0 ⇒ only new events). A non-empty namespace
+        scopes the subscription (reference SubscribeRequest.Namespace)."""
         topics = topics or {TOPIC_ALL: [KEY_ALL]}
         with self._lock:
-            if from_index == 0:
-                start_seq = self._next_seq
-            else:
-                start_seq = self._next_seq
-                for seq, index, _ in self._blocks:
+            start_seq = self._next_seq
+            if from_index != 0:
+                for seq, (index, _) in self._blocks.items():
                     if index > from_index:
                         start_seq = seq
                         break
-            return Subscription(self, topics, start_seq)
+            return Subscription(self, topics, start_seq, namespace)
 
     def _next_block(
         self, sub: Subscription, timeout_s: Optional[float]
@@ -161,14 +175,12 @@ class EventBroker:
             while True:
                 if sub._closed or self._closed:
                     raise SubscriptionClosedError()
-                oldest_seq = self._blocks[0][0] if self._blocks else self._next_seq
-                if sub._seq < oldest_seq:
-                    # Ring overwrote our cursor: too slow.
-                    raise SubscriptionClosedError("subscriber fell behind")
-                if sub._seq < self._next_seq:
-                    offset = sub._seq - oldest_seq
-                    block = self._blocks[offset][2]
+                block = self._blocks.get(sub._seq)
+                if block is not None:
                     sub._seq += 1
-                    return block
+                    return block[1]
+                if sub._seq < self._next_seq:
+                    # Evicted from the ring before we read it: too slow.
+                    raise SubscriptionClosedError("subscriber fell behind")
                 if not self._cv.wait(timeout_s):
                     return None

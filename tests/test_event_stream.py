@@ -119,3 +119,46 @@ class TestStateEvents:
         store.upsert_allocs(2, [alloc])
         got = sub.next(timeout_s=1)
         assert got[0].key == alloc.id
+
+
+class TestStateEventCoverage:
+    """Every mutating store path must publish (code-review finding)."""
+
+    def test_delete_events(self):
+        store, broker = StateStore(), EventBroker()
+        wire_events(store, broker)
+        n = mock.node()
+        job = mock.job()
+        store.upsert_node(1, n)
+        store.upsert_job(2, job)
+        sub = broker.subscribe({"Node": ["*"], "Job": ["*"]})
+        store.delete_node(3, n.id)
+        got = sub.next(timeout_s=1)
+        assert got[0].type == "NodeDeregistration"
+        store.delete_job(4, job.namespace, job.id)
+        got = sub.next(timeout_s=1)
+        assert got[0].type == "JobDeregistered"
+
+    def test_desired_transition_publishes(self):
+        from nomad_tpu.structs.structs import DesiredTransition
+
+        store, broker = StateStore(), EventBroker()
+        wire_events(store, broker)
+        job = mock.job()
+        alloc = mock.alloc(job_=job)
+        store.upsert_job(1, job)
+        store.upsert_allocs(2, [alloc])
+        sub = broker.subscribe({"Allocation": ["*"]})
+        store.update_alloc_desired_transition(
+            3, {alloc.id: DesiredTransition(migrate=True)}, []
+        )
+        got = sub.next(timeout_s=1)
+        assert got[0].type == "AllocationUpdateDesiredStatus"
+
+    def test_namespace_scoped_subscription(self):
+        store, broker = StateStore(), EventBroker()
+        wire_events(store, broker)
+        sub = broker.subscribe({"Job": ["*"]}, namespace="other")
+        job = mock.job()  # default namespace
+        store.upsert_job(1, job)
+        assert sub.next(timeout_s=0.1) == []

@@ -1,0 +1,205 @@
+"""Codec + RPC fabric tests.
+
+Reference behaviors: nomad/rpc.go first-byte switch + request loop,
+helper/pool pooled pipelined calls, streaming sessions.
+"""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import codec, mock
+from nomad_tpu.rpc import ConnPool, RPCError, RPCServer
+
+
+class TestCodec:
+    def test_roundtrip_primitives(self):
+        for v in (None, True, 42, 3.5, "x", b"raw", [1, 2], {"a": 1}):
+            assert codec.unpack(codec.pack(v)) == v
+
+    def test_roundtrip_tuple_and_tuple_keys(self):
+        v = {("ns", "job"): [1, 2], "plain": (3, 4)}
+        out = codec.unpack(codec.pack(v))
+        assert out == {("ns", "job"): [1, 2], "plain": (3, 4)}
+
+    def test_roundtrip_job(self):
+        job = mock.job()
+        out = codec.unpack(codec.pack(job))
+        assert out.id == job.id
+        assert out.task_groups[0].tasks[0].resources.cpu == \
+            job.task_groups[0].tasks[0].resources.cpu
+        # independent object, not a reference
+        out.task_groups[0].count = 999
+        assert job.task_groups[0].count != 999
+
+    def test_roundtrip_node_alloc_eval(self):
+        node = mock.node()
+        job = mock.job()
+        alloc = mock.alloc(job_=job, node_=node)
+        ev = mock.eval_for_job(job)
+        out = codec.unpack(codec.pack({"n": node, "a": alloc, "e": ev}))
+        assert out["n"].id == node.id
+        assert (
+            out["a"].resources.tasks["web"].cpu
+            == alloc.resources.tasks["web"].cpu
+        )
+        assert out["e"].job_id == job.id
+
+    def test_unknown_type_rejected(self):
+        class NotRegistered:
+            pass
+
+        with pytest.raises(TypeError):
+            codec.pack(NotRegistered())
+
+
+class Echo:
+    def echo(self, args):
+        return args
+
+    def boom(self, args):
+        raise RuntimeError("kaboom")
+
+    def slow(self, args):
+        time.sleep(args["delay"])
+        return args["delay"]
+
+
+@pytest.fixture
+def rpc():
+    server = RPCServer()
+    server.register("Echo", Echo())
+    server.start()
+    pool = ConnPool()
+    yield server, pool
+    pool.shutdown()
+    server.shutdown()
+
+
+class TestRPC:
+    def test_echo(self, rpc):
+        server, pool = rpc
+        job = mock.job()
+        out = pool.call(server.addr, "Echo.echo", {"job": job})
+        assert out["job"].id == job.id
+
+    def test_error_propagates(self, rpc):
+        server, pool = rpc
+        with pytest.raises(RPCError, match="kaboom"):
+            pool.call(server.addr, "Echo.boom")
+
+    def test_unknown_method(self, rpc):
+        server, pool = rpc
+        with pytest.raises(RPCError, match="unknown rpc"):
+            pool.call(server.addr, "Echo.nope")
+        with pytest.raises(RPCError, match="unknown rpc"):
+            pool.call(server.addr, "Nope.echo")
+
+    def test_private_method_rejected(self, rpc):
+        server, pool = rpc
+        with pytest.raises(RPCError):
+            pool.call(server.addr, "Echo._dispatch")
+
+    def test_pipelining_out_of_order(self, rpc):
+        """A slow call must not block a fast one on the same pooled conn."""
+        server, pool = rpc
+        results = {}
+
+        def slow():
+            results["slow"] = pool.call(
+                server.addr, "Echo.slow", {"delay": 0.5}, timeout_s=5
+            )
+
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.05)
+        t0 = time.monotonic()
+        assert pool.call(server.addr, "Echo.echo", 1) == 1
+        fast_elapsed = time.monotonic() - t0
+        t.join()
+        assert results["slow"] == 0.5
+        assert fast_elapsed < 0.4, "fast call waited behind slow call"
+
+    def test_concurrent_calls(self, rpc):
+        server, pool = rpc
+        errs = []
+
+        def worker(i):
+            try:
+                for j in range(20):
+                    assert pool.call(server.addr, "Echo.echo", [i, j]) == [i, j]
+            except Exception as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+
+    def test_reconnect_after_server_restart(self):
+        server = RPCServer()
+        server.register("Echo", Echo())
+        server.start()
+        pool = ConnPool()
+        try:
+            port = server.addr[1]
+            assert pool.call(server.addr, "Echo.echo", "a") == "a"
+            server.shutdown()
+            server2 = RPCServer(port=port)
+            server2.register("Echo", Echo())
+            server2.start()
+            try:
+                assert pool.call(server2.addr, "Echo.echo", "b") == "b"
+            finally:
+                server2.shutdown()
+        finally:
+            pool.shutdown()
+
+    def test_timeout(self, rpc):
+        server, pool = rpc
+        with pytest.raises(TimeoutError):
+            pool.call(server.addr, "Echo.slow", {"delay": 2}, timeout_s=0.1)
+
+
+class TestStreaming:
+    def test_stream_session(self):
+        server = RPCServer()
+
+        def handler(session, header):
+            # echo frames back until the peer sends {"eof": True}
+            while True:
+                msg = session.recv(timeout_s=5)
+                if msg.get("eof"):
+                    session.send({"bye": True})
+                    session.close()
+                    return
+                session.send({"echo": msg["data"]})
+
+        server.register_stream("FileSystem.logs", handler)
+        server.start()
+        pool = ConnPool()
+        try:
+            s = pool.stream(server.addr, "FileSystem.logs", {"alloc_id": "x"})
+            s.send({"data": "hello"})
+            assert s.recv(timeout_s=5)["echo"] == "hello"
+            s.send({"data": b"bytes"})
+            assert s.recv(timeout_s=5)["echo"] == b"bytes"
+            s.send({"eof": True})
+            assert s.recv(timeout_s=5)["bye"] is True
+        finally:
+            pool.shutdown()
+            server.shutdown()
+
+    def test_unknown_stream_method(self):
+        server = RPCServer()
+        server.start()
+        pool = ConnPool()
+        try:
+            with pytest.raises(RPCError, match="unknown stream"):
+                pool.stream(server.addr, "Nope.stream")
+        finally:
+            pool.shutdown()
+            server.shutdown()
