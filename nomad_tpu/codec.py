@@ -49,6 +49,10 @@ def _register_builtin_structs() -> None:
             obj = getattr(mod, name)
             if isinstance(obj, type) and dataclasses.is_dataclass(obj):
                 register_type(obj)
+    # Non-dataclass state-store types that ride in FSM snapshots.
+    from .state.store import JobSummary
+
+    register_type(JobSummary)
 
 
 def to_wire(obj: Any) -> Any:

@@ -78,6 +78,14 @@ class RPCServer:
         self._pool = ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix="rpc"
         )
+        # Raft traffic gets its own lane: blocking queries and slow
+        # forwards on the shared pool must never delay heartbeats or
+        # elections destabilize (the reference runs raft on a dedicated
+        # stream layer, nomad/raft_rpc.go, for the same reason).
+        self._priority_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="rpc-raft"
+        )
+        self._priority_prefixes = ("Raft.",)
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set[socket.socket] = set()
@@ -125,6 +133,7 @@ class RPCServer:
                 pass
             c.close()
         self._pool.shutdown(wait=False)
+        self._priority_pool.shutdown(wait=False)
         if self._accept_thread:
             self._accept_thread.join(timeout=5)
 
@@ -179,7 +188,13 @@ class RPCServer:
         wlock = threading.Lock()
         while not self._shutdown.is_set():
             req = codec.unpack(recv_frame(conn))
-            self._pool.submit(self._dispatch, conn, wlock, req)
+            method = req.get("method", "")
+            pool = (
+                self._priority_pool
+                if method.startswith(self._priority_prefixes)
+                else self._pool
+            )
+            pool.submit(self._dispatch, conn, wlock, req)
 
     def _dispatch(self, conn: socket.socket, wlock: threading.Lock, req) -> None:
         seq = req.get("seq")

@@ -1,0 +1,341 @@
+"""Clustered server: Raft-replicated control plane over the RPC fabric.
+
+Reference: nomad/server.go (server wiring: RPC at :1073, Raft at :1210),
+nomad/rpc.go `forward` (any server forwards writes to the leader),
+nomad/leader.go leadership transitions driving leader-only subsystems, and
+client/servers manager (clients fail over between servers).
+
+One ClusterServer = one `nomad agent -server` process-equivalent:
+  * a core `Server` (state store, FSM, brokers, schedulers, watchers);
+  * a `RaftNode` replicating every state mutation;
+  * an `RPCServer` exposing Raft.* plus the public endpoints
+    (Job/Node/Eval/Alloc/Deployment/Status);
+  * leadership changes from raft enable/disable the leader-only
+    subsystems, exactly like establishLeadership/revokeLeadership.
+
+Writes land on any server and are forwarded to the leader; reads are
+served from the local replica (the reference's default-consistent reads
+forward too — our forwarding helper takes `local_ok` to choose).
+
+Scheduler workers run only on the leader — a deliberate departure from
+the reference (which runs workers on every server, submitting plans to
+the leader over Plan.Submit): the TPU batch solver wants all pending
+evals in one dense batch on the chip, so spreading workers across
+followers would shrink batches and add a network hop per plan. Horizontal
+scheduler scale comes from the solver's device mesh instead (SURVEY.md
+§2.9 point 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..rpc import ConnPool, RPCError, RPCServer
+from ..structs import Allocation, Job, Node
+from .raft_replication import NotLeaderError, RaftNode
+from .server import Server
+
+logger = logging.getLogger("nomad_tpu.cluster")
+
+
+class _Forwarder:
+    """Endpoint helper: run locally on the leader, else forward the same
+    RPC to the leader (reference nomad/rpc.go forward)."""
+
+    def __init__(self, cs: "ClusterServer") -> None:
+        self.cs = cs
+
+    def _forward(self, method: str, args, local_fn, local_ok: bool = False):
+        if local_ok or self.cs.raft.is_leader():
+            return local_fn(args)
+        addr = self.cs.raft.leader_addr()
+        # A stale self-hint would loop the RPC back into our own worker
+        # pool until it deadlocks — treat it as leaderless instead.
+        if addr is None or addr == self.cs.rpc.addr:
+            raise RPCError("no cluster leader")
+        return self.cs.pool.call(addr, method, args, timeout_s=30.0)
+
+
+class JobEndpoint(_Forwarder):
+    def register(self, args):
+        return self._forward(
+            "Job.register", args, lambda a: self.cs.server.job_register(a["job"])
+        )
+
+    def deregister(self, args):
+        return self._forward(
+            "Job.deregister",
+            args,
+            lambda a: self.cs.server.job_deregister(
+                a["namespace"], a["job_id"], a.get("purge", False)
+            ),
+        )
+
+    def get(self, args):
+        return self.cs.server.state.job_by_id(args["namespace"], args["job_id"])
+
+    def list(self, args):
+        return self.cs.server.state.jobs(args.get("namespace"))
+
+    def allocs(self, args):
+        return self.cs.server.state.allocs_by_job(
+            args["namespace"], args["job_id"]
+        )
+
+    def summary(self, args):
+        return self.cs.server.state.job_summary_by_id(
+            args["namespace"], args["job_id"]
+        )
+
+
+class NodeEndpoint(_Forwarder):
+    def register(self, args):
+        return self._forward(
+            "Node.register", args, lambda a: self.cs.server.node_register(a["node"])
+        )
+
+    def heartbeat(self, args):
+        return self._forward(
+            "Node.heartbeat",
+            args,
+            lambda a: self.cs.server.node_heartbeat(a["node_id"]),
+        )
+
+    def update_status(self, args):
+        return self._forward(
+            "Node.update_status",
+            args,
+            lambda a: self.cs.server.node_update_status(a["node_id"], a["status"]),
+        )
+
+    def update_drain(self, args):
+        return self._forward(
+            "Node.update_drain",
+            args,
+            lambda a: self.cs.server.node_update_drain(
+                a["node_id"], a.get("drain"), a.get("mark_eligible", False)
+            ),
+        )
+
+    def update_eligibility(self, args):
+        return self._forward(
+            "Node.update_eligibility",
+            args,
+            lambda a: self.cs.server.node_update_eligibility(
+                a["node_id"], a["eligibility"]
+            ),
+        )
+
+    def get_client_allocs(self, args):
+        # Blocking query served from the local replica: alloc writes reach
+        # followers via raft, waking the same watch channels.
+        allocs, index = self.cs.server.get_client_allocs(
+            args["node_id"],
+            args.get("min_index", 0),
+            args.get("timeout_s", 5.0),
+        )
+        return {"allocs": allocs, "index": index}
+
+    def update_allocs(self, args):
+        return self._forward(
+            "Node.update_allocs",
+            args,
+            lambda a: self.cs.server.update_allocs_from_client(a["allocs"]),
+        )
+
+    def get(self, args):
+        return self.cs.server.state.node_by_id(args["node_id"])
+
+    def list(self, args):
+        return self.cs.server.state.nodes()
+
+
+class EvalEndpoint(_Forwarder):
+    def get(self, args):
+        return self.cs.server.state.eval_by_id(args["eval_id"])
+
+    def list(self, args):
+        return self.cs.server.state.evals()
+
+
+class AllocEndpoint(_Forwarder):
+    def get(self, args):
+        return self.cs.server.state.alloc_by_id(args["alloc_id"])
+
+    def list_by_node(self, args):
+        return self.cs.server.state.allocs_by_node(args["node_id"])
+
+
+class DeploymentEndpoint(_Forwarder):
+    def get(self, args):
+        return self.cs.server.state.deployment_by_id(args["deployment_id"])
+
+    def list(self, args):
+        return self.cs.server.state.deployments()
+
+    def promote(self, args):
+        return self._forward(
+            "Deployment.promote",
+            args,
+            lambda a: self.cs.server.deployment_promote(
+                a["deployment_id"], a.get("groups")
+            ),
+        )
+
+    def pause(self, args):
+        return self._forward(
+            "Deployment.pause",
+            args,
+            lambda a: self.cs.server.deployment_pause(
+                a["deployment_id"], a["pause"]
+            ),
+        )
+
+    def fail(self, args):
+        return self._forward(
+            "Deployment.fail",
+            args,
+            lambda a: self.cs.server.deployment_fail(a["deployment_id"]),
+        )
+
+
+class StatusEndpoint(_Forwarder):
+    def leader(self, args):
+        addr = self.cs.raft.leader_addr()
+        return {"leader": list(addr) if addr else None}
+
+    def peers(self, args):
+        out = [
+            {"id": self.cs.node_id, "addr": list(self.cs.rpc.addr)}
+        ]
+        for pid, addr in self.cs.raft.peers.items():
+            out.append({"id": pid, "addr": list(addr)})
+        return out
+
+    def ping(self, args):
+        return "pong"
+
+
+class ClusterServer:
+    def __init__(
+        self,
+        node_id: str,
+        peers: Optional[dict[str, tuple[str, int]]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        num_workers: int = 2,
+        use_tpu_batch_worker: bool = False,
+        **raft_kw,
+    ) -> None:
+        self.node_id = node_id
+        self.rpc = RPCServer(host=host, port=port)
+        self.pool = ConnPool()
+        self.server = Server(
+            num_workers=num_workers, use_tpu_batch_worker=use_tpu_batch_worker
+        )
+        # Wider timers than the raw RaftNode defaults: a full server stacks
+        # scheduler workers, watchers, and client traffic onto the same
+        # process, so heartbeat delivery jitter is much higher than in a
+        # bare raft cluster (GIL contention).
+        raft_kw.setdefault("heartbeat_ms", 100)
+        raft_kw.setdefault("election_ms", 1000)
+        self.raft = RaftNode(
+            node_id,
+            self.server.fsm,
+            self.pool,
+            self.rpc.addr,
+            peers or {},
+            snapshot_fn=self.server.state.serialize,
+            restore_fn=self.server.state.restore_from,
+            on_leader_change=self._on_leader_change,
+            **raft_kw,
+        )
+        self.server.set_raft_applier(self._raft_apply)
+        self.rpc.register("Raft", self.raft.endpoint)
+        for name, ep in (
+            ("Job", JobEndpoint(self)),
+            ("Node", NodeEndpoint(self)),
+            ("Eval", EvalEndpoint(self)),
+            ("Alloc", AllocEndpoint(self)),
+            ("Deployment", DeploymentEndpoint(self)),
+            ("Status", StatusEndpoint(self)),
+        ):
+            self.rpc.register(name, ep)
+
+    # -- wiring --------------------------------------------------------
+
+    def _raft_apply(self, msg_type: str, payload) -> int:
+        return self.raft.apply(msg_type, payload)
+
+    def _on_leader_change(self, is_leader: bool) -> None:
+        if is_leader:
+            logger.info("%s: establishing leadership", self.node_id)
+            self.server.establish_leadership()
+        else:
+            logger.info("%s: revoking leadership", self.node_id)
+            self.server.revoke_leadership()
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self.rpc.addr
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    def start(self) -> None:
+        self.rpc.start()
+        self.raft.start()
+
+    def shutdown(self) -> None:
+        was_leader = self.raft.is_leader()
+        self.raft.stop()
+        if was_leader:
+            self.server.revoke_leadership()
+        self.server.shutdown()
+        self.rpc.shutdown()
+        self.pool.shutdown()
+
+
+class ClusterRPC:
+    """Client-side server connection over the fabric, with failover.
+
+    Reference: client/servers manager — the client holds a ring of server
+    addresses and rotates on RPC failure; any server forwards to the
+    leader. Satisfies the same five-verb interface as the in-process
+    ServerRPC shim (client/client.py).
+    """
+
+    def __init__(self, addrs: list[tuple[str, int]], pool: Optional[ConnPool] = None):
+        self.addrs = [tuple(a) for a in addrs]
+        self.pool = pool or ConnPool()
+
+    def _call(self, method: str, args, timeout_s: float = 30.0):
+        last: Optional[Exception] = None
+        for _ in range(len(self.addrs)):
+            addr = self.addrs[0]
+            try:
+                return self.pool.call(addr, method, args, timeout_s=timeout_s)
+            except (ConnectionError, OSError, TimeoutError, RPCError) as e:
+                last = e
+                # rotate: try the next server (reference servers.Manager)
+                self.addrs.append(self.addrs.pop(0))
+        raise last  # type: ignore[misc]
+
+    def register(self, node: Node) -> float:
+        return self._call("Node.register", {"node": node})
+
+    def heartbeat(self, node_id: str) -> float:
+        return self._call("Node.heartbeat", {"node_id": node_id})
+
+    def get_client_allocs(self, node_id: str, min_index: int, timeout_s: float):
+        resp = self._call(
+            "Node.get_client_allocs",
+            {"node_id": node_id, "min_index": min_index, "timeout_s": timeout_s},
+            timeout_s=timeout_s + 10.0,
+        )
+        return resp["allocs"], resp["index"]
+
+    def update_allocs(self, allocs: list[Allocation]) -> None:
+        self._call("Node.update_allocs", {"allocs": allocs})

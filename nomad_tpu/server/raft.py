@@ -44,6 +44,7 @@ class FSM:
         self.on_alloc_client_update: Optional[Callable] = None
         self.on_job_upsert: Optional[Callable] = None  # periodic tracking
         self._handlers = {
+            "noop": lambda index, payload: None,  # leader election barrier
             "node_register": self._apply_node_register,
             "node_deregister": self._apply_node_deregister,
             "node_update_status": self._apply_node_status,

@@ -1,0 +1,647 @@
+"""Multi-node Raft replication over the RPC fabric.
+
+Reference: the Go tree vendors hashicorp/raft and wires it in
+nomad/server.go:1210 (setupRaft) with a dedicated stream transport
+(nomad/raft_rpc.go); the FSM is nomad/fsm.go. This is a from-scratch Raft
+(Ongaro & Ousterhout, "In Search of an Understandable Consensus
+Algorithm") — elections with randomized timeouts, log replication with
+the AppendEntries consistency check, majority commit restricted to
+current-term entries (§5.4.2), and InstallSnapshot for lagging followers.
+
+Departures from the reference's transport, deliberate: raft RPCs ride the
+same framed-msgpack fabric as everything else (`Raft.*` endpoint methods)
+instead of a dedicated byte-stream layer — the fabric already pipelines,
+and one transport keeps the failure model uniform.
+
+The FSM contract is unchanged from the single-node path (raft.py): apply()
+is only ever invoked with committed entries, in order, exactly once per
+index on a given store. `RaftNode.apply()` blocks until commit, then
+returns the entry's index — the same contract `Server.raft_apply` had with
+InmemLog, so the whole control plane is replication-agnostic.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..rpc import ConnPool
+from .raft import FSM
+
+logger = logging.getLogger("nomad_tpu.raft")
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader_addr: Optional[tuple[str, int]]):
+        self.leader_addr = leader_addr
+        super().__init__(f"not the leader (leader hint: {leader_addr})")
+
+
+@dataclass
+class LogEntry:
+    index: int
+    term: int
+    msg_type: str
+    payload: object
+
+
+class RaftEndpoint:
+    """RPC surface registered as `Raft` on the fabric."""
+
+    def __init__(self, node: "RaftNode") -> None:
+        self._node = node
+
+    def request_vote(self, args):
+        return self._node._handle_request_vote(args)
+
+    def append_entries(self, args):
+        return self._node._handle_append_entries(args)
+
+    def install_snapshot(self, args):
+        return self._node._handle_install_snapshot(args)
+
+
+class RaftNode:
+    """One Raft participant. Owns the log and drives the FSM.
+
+    Timers (defaults sized for in-process clusters; production configs
+    scale them up): heartbeat every `heartbeat_ms`, election timeout
+    randomized in [election_ms, 2*election_ms].
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        fsm: FSM,
+        pool: ConnPool,
+        advertise: tuple[str, int],
+        peers: dict[str, tuple[str, int]],
+        heartbeat_ms: int = 60,
+        election_ms: int = 250,
+        snapshot_threshold: int = 8192,
+        snapshot_fn: Optional[Callable[[], bytes]] = None,
+        restore_fn: Optional[Callable[[bytes], None]] = None,
+        on_leader_change: Optional[Callable[[bool], None]] = None,
+    ) -> None:
+        self.node_id = node_id
+        self.fsm = fsm
+        self.pool = pool
+        self.advertise = advertise
+        # peers maps node_id -> rpc addr for every OTHER member
+        self.peers = dict(peers)
+        self.heartbeat_s = heartbeat_ms / 1000.0
+        self.election_s = election_ms / 1000.0
+        self.snapshot_threshold = snapshot_threshold
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.on_leader_change = on_leader_change
+
+        self._lock = threading.RLock()
+        self._commit_cv = threading.Condition(self._lock)
+        # Persistent state (in-memory for in-process clusters; the
+        # snapshot/restore path in snapshot.py provides durability).
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self._log: list[LogEntry] = []  # log[i] has index snapshot_index+i+1
+        self._snap_last_index = 0
+        self._snap_last_term = 0
+        self._snap_bytes: Optional[bytes] = None
+        # Volatile state
+        self.state = FOLLOWER
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+        self._last_heartbeat = time.monotonic()
+        self._votes: set[str] = set()
+        # Leader volatile state
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._repl_wake: dict[str, threading.Event] = {}
+
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        # Leadership transitions are delivered IN ORDER on one dispatcher
+        # thread — firing them on ad-hoc threads could run a revoke before
+        # the establish it follows, leaving leader subsystems on a follower.
+        self._leader_events: "queue.Queue[Optional[bool]]" = queue.Queue()
+        # Bumped by InstallSnapshot so an in-flight apply batch of stale
+        # entries is discarded instead of landing on top of restored state;
+        # the mutex serializes individual FSM applies against the restore
+        # itself (the epoch check alone can't cover an apply in progress).
+        self._restore_epoch = 0
+        self._fsm_mutex = threading.Lock()
+        self.endpoint = RaftEndpoint(self)
+
+    # ------------------------------------------------------------------
+    # log helpers (all under lock)
+
+    def _last_log_index(self) -> int:
+        return self._log[-1].index if self._log else self._snap_last_index
+
+    def _last_log_term(self) -> int:
+        return self._log[-1].term if self._log else self._snap_last_term
+
+    def _entry_at(self, index: int) -> Optional[LogEntry]:
+        i = index - self._snap_last_index - 1
+        if 0 <= i < len(self._log):
+            return self._log[i]
+        return None
+
+    def _term_at(self, index: int) -> Optional[int]:
+        if index == 0:
+            return 0
+        if index == self._snap_last_index:
+            return self._snap_last_term
+        e = self._entry_at(index)
+        return e.term if e else None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._ticker, name=f"raft-tick-{self.node_id}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._apply_loop, name=f"raft-apply-{self.node_id}", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(
+            target=self._leader_change_loop,
+            name=f"raft-leadership-{self.node_id}",
+            daemon=True,
+        )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._leader_events.put(None)
+        with self._commit_cv:
+            self._commit_cv.notify_all()
+        for ev in self._repl_wake.values():
+            ev.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def _emit_leader_change(self, is_leader: bool) -> None:
+        if self.on_leader_change:
+            self._leader_events.put(is_leader)
+
+    def _leader_change_loop(self) -> None:
+        last: Optional[bool] = None
+        while True:
+            ev = self._leader_events.get()
+            if ev is None:
+                return
+            if ev == last:
+                continue
+            last = ev
+            try:
+                self.on_leader_change(ev)
+            except Exception:
+                logger.exception("%s: leader-change callback failed", self.node_id)
+
+    # ------------------------------------------------------------------
+    # public write path
+
+    def apply(self, msg_type: str, payload, timeout_s: float = 10.0):
+        """Append on the leader, replicate, block until committed AND
+        applied locally. Returns the entry index."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_addr())
+            index = self._last_log_index() + 1
+            term = self.current_term
+            entry = LogEntry(index, term, msg_type, payload)
+            self._log.append(entry)
+            self._match_index[self.node_id] = index
+            for ev in self._repl_wake.values():
+                ev.set()
+            if not self.peers:
+                self._advance_commit_locked()
+        deadline = time.monotonic() + timeout_s
+        with self._commit_cv:
+            while self.last_applied < index:
+                # A leader's log in its own term is append-only, so staying
+                # LEADER at `term` guarantees our entry is still at `index`.
+                # Any truncation implies a follower interlude (term bump),
+                # which this check catches even if we re-won in between.
+                if self.state != LEADER or self.current_term != term:
+                    raise NotLeaderError(self.leader_addr())
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"raft apply timed out at index {index}")
+                self._commit_cv.wait(remaining)
+            if self.state != LEADER or self.current_term != term:
+                raise NotLeaderError(self.leader_addr())
+        return index
+
+    def leader_addr(self) -> Optional[tuple[str, int]]:
+        if self.leader_id is None:
+            return None
+        if self.leader_id == self.node_id:
+            return self.advertise
+        return self.peers.get(self.leader_id)
+
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    @property
+    def last_index(self) -> int:
+        with self._lock:
+            return self._last_log_index()
+
+    # ------------------------------------------------------------------
+    # ticker: election timeout + heartbeats
+
+    def _ticker(self) -> None:
+        timeout = self._rand_election_timeout()
+        while not self._stop.is_set():
+            time.sleep(self.heartbeat_s / 2)
+            with self._lock:
+                state = self.state
+                elapsed = time.monotonic() - self._last_heartbeat
+            if state == LEADER:
+                continue  # replication threads heartbeat
+            if elapsed >= timeout:
+                self._start_election()
+                timeout = self._rand_election_timeout()
+
+    def _rand_election_timeout(self) -> float:
+        return self.election_s * (1.0 + random.random())
+
+    def _start_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.current_term += 1
+            term = self.current_term
+            self.voted_for = self.node_id
+            self._votes = {self.node_id}
+            self.leader_id = None
+            self._last_heartbeat = time.monotonic()
+            last_idx = self._last_log_index()
+            last_term = self._last_log_term()
+        logger.debug("%s: starting election term %d", self.node_id, term)
+        if self._won_locked_check():
+            return
+        for peer_id, addr in self.peers.items():
+            threading.Thread(
+                target=self._solicit_vote,
+                args=(peer_id, addr, term, last_idx, last_term),
+                daemon=True,
+            ).start()
+
+    def _solicit_vote(self, peer_id, addr, term, last_idx, last_term) -> None:
+        try:
+            resp = self.pool.call(
+                addr,
+                "Raft.request_vote",
+                {
+                    "term": term,
+                    "candidate_id": self.node_id,
+                    "last_log_index": last_idx,
+                    "last_log_term": last_term,
+                },
+                timeout_s=self.election_s,
+            )
+        except Exception:
+            return
+        with self._lock:
+            if resp["term"] > self.current_term:
+                self._become_follower_locked(resp["term"])
+                return
+            if (
+                self.state != CANDIDATE
+                or self.current_term != term
+                or not resp.get("granted")
+            ):
+                return
+            self._votes.add(peer_id)
+        self._won_locked_check()
+
+    def _won_locked_check(self) -> bool:
+        with self._lock:
+            cluster_n = len(self.peers) + 1
+            if self.state == CANDIDATE and len(self._votes) * 2 > cluster_n:
+                self._become_leader_locked()
+                return True
+        return False
+
+    def _become_leader_locked(self) -> None:
+        logger.info("%s: leader for term %d", self.node_id, self.current_term)
+        self.state = LEADER
+        self.leader_id = self.node_id
+        # Barrier no-op in our own term: commit can only count current-term
+        # entries (§5.4.2), so without this a fresh leader would sit on
+        # fully-replicated prior-term entries until the next real write.
+        self._log.append(
+            LogEntry(self._last_log_index() + 1, self.current_term, "noop", None)
+        )
+        last = self._last_log_index()
+        self._next_index = {p: last + 1 for p in self.peers}
+        self._match_index = {p: 0 for p in self.peers}
+        self._match_index[self.node_id] = last
+        self._repl_wake = {p: threading.Event() for p in self.peers}
+        for peer_id in self.peers:
+            t = threading.Thread(
+                target=self._replicate_loop,
+                args=(peer_id,),
+                name=f"raft-repl-{self.node_id}-{peer_id}",
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        if not self.peers:
+            self._advance_commit_locked()
+        self._emit_leader_change(True)
+
+    def _become_follower_locked(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+        self.state = FOLLOWER
+        # Forget the old leader until an AppendEntries names the new one —
+        # a deposed leader keeping itself as the hint would make forwards
+        # loop back to itself.
+        self.leader_id = None
+        self._last_heartbeat = time.monotonic()
+        if was_leader:
+            self._emit_leader_change(False)
+        self._commit_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # leader replication
+
+    def _replicate_loop(self, peer_id: str) -> None:
+        """One thread per follower: push entries / heartbeats, retry on
+        mismatch by walking next_index back (§5.3)."""
+        addr = self.peers[peer_id]
+        while not self._stop.is_set():
+            with self._lock:
+                if self.state != LEADER:
+                    return
+                term = self.current_term
+                next_idx = self._next_index[peer_id]
+                if next_idx <= self._snap_last_index:
+                    self._send_snapshot(peer_id, addr, term)
+                    continue
+                prev_idx = next_idx - 1
+                prev_term = self._term_at(prev_idx)
+                if prev_term is None:
+                    self._send_snapshot(peer_id, addr, term)
+                    continue
+                off = next_idx - self._snap_last_index - 1
+                entries = self._log[off : off + 512]
+                req = {
+                    "term": term,
+                    "leader_id": self.node_id,
+                    "prev_log_index": prev_idx,
+                    "prev_log_term": prev_term,
+                    "entries": [
+                        (e.index, e.term, e.msg_type, e.payload) for e in entries
+                    ],
+                    "leader_commit": self.commit_index,
+                }
+                wake = self._repl_wake[peer_id]
+                wake.clear()
+            try:
+                resp = self.pool.call(
+                    addr, "Raft.append_entries", req, timeout_s=2.0
+                )
+            except Exception:
+                wake.wait(self.heartbeat_s)
+                continue
+            with self._lock:
+                if self.state != LEADER or self.current_term != term:
+                    return
+                if resp["term"] > self.current_term:
+                    self._become_follower_locked(resp["term"])
+                    return
+                if resp.get("success"):
+                    if entries:
+                        self._match_index[peer_id] = entries[-1].index
+                        self._next_index[peer_id] = entries[-1].index + 1
+                        self._advance_commit_locked()
+                    more = self._last_log_index() >= self._next_index[peer_id]
+                else:
+                    # Conflict: follower tells us how far back to jump.
+                    hint = resp.get("conflict_index")
+                    self._next_index[peer_id] = max(
+                        1, hint if hint else self._next_index[peer_id] - 1
+                    )
+                    more = True
+            if not more:
+                wake.wait(self.heartbeat_s)
+
+    def _send_snapshot(self, peer_id: str, addr, term: int) -> None:
+        """Called under lock; releases it around the network call."""
+        if self._snap_bytes is None and self.snapshot_fn is not None:
+            self._take_snapshot_locked()
+        snap = (self._snap_bytes, self._snap_last_index, self._snap_last_term)
+        self._lock.release()
+        try:
+            resp = self.pool.call(
+                addr,
+                "Raft.install_snapshot",
+                {
+                    "term": term,
+                    "leader_id": self.node_id,
+                    "last_included_index": snap[1],
+                    "last_included_term": snap[2],
+                    "data": snap[0],
+                },
+                timeout_s=10.0,
+            )
+        except Exception:
+            resp = None
+            time.sleep(self.heartbeat_s)
+        finally:
+            self._lock.acquire()
+        if resp is None:
+            return
+        if resp["term"] > self.current_term:
+            self._become_follower_locked(resp["term"])
+            return
+        self._next_index[peer_id] = snap[1] + 1
+        self._match_index[peer_id] = snap[1]
+
+    def _advance_commit_locked(self) -> None:
+        """Majority-match commit, current-term entries only (§5.4.2)."""
+        cluster_n = len(self.peers) + 1
+        matches = sorted(
+            self._match_index.get(p, 0) for p in list(self.peers) + [self.node_id]
+        )
+        # Highest index replicated on a strict majority: with matches
+        # ascending, that's matches[n - majority] = matches[(n-1)//2]
+        # (e.g. n=4 ⇒ 3 replicas needed ⇒ matches[1], NOT matches[2]).
+        majority_idx = matches[(cluster_n - 1) // 2]
+        # walk down to the highest current-term entry <= majority_idx
+        n = majority_idx
+        while n > self.commit_index:
+            if self._term_at(n) == self.current_term:
+                self.commit_index = n
+                self._commit_cv.notify_all()
+                break
+            n -= 1
+
+    # ------------------------------------------------------------------
+    # apply loop (leader and followers)
+
+    def _apply_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._commit_cv:
+                while (
+                    self.last_applied >= self.commit_index
+                    and not self._stop.is_set()
+                ):
+                    self._commit_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                start = self.last_applied + 1
+                end = self.commit_index
+                epoch = self._restore_epoch
+                off = start - self._snap_last_index - 1
+                entries = self._log[off : off + (end - start + 1)] if off >= 0 else []
+            for e in entries:
+                # A snapshot restore while we were applying makes the rest
+                # of this batch stale — re-applying old entries on top of
+                # newer restored state would corrupt it.
+                with self._fsm_mutex:
+                    if self._restore_epoch != epoch:
+                        break
+                    try:
+                        self.fsm.apply(e.index, e.msg_type, e.payload)
+                    except Exception:
+                        logger.exception(
+                            "%s: FSM apply failed at %d", self.node_id, e.index
+                        )
+            with self._commit_cv:
+                if self._restore_epoch == epoch and end > self.last_applied:
+                    self.last_applied = end
+                    self._commit_cv.notify_all()
+                self._maybe_compact_locked()
+
+    def _take_snapshot_locked(self) -> None:
+        if self.snapshot_fn is None:
+            return
+        idx = self.last_applied
+        term = self._term_at(idx)
+        if term is None:
+            return
+        self._snap_bytes = self.snapshot_fn()
+        self._snap_last_index = idx
+        self._snap_last_term = term
+        self._log = [e for e in self._log if e.index > idx]
+        logger.info("%s: snapshot at index %d", self.node_id, idx)
+
+    def _maybe_compact_locked(self) -> None:
+        if (
+            self.snapshot_fn is not None
+            and len(self._log) >= self.snapshot_threshold
+            and self.last_applied > self._snap_last_index
+        ):
+            self._take_snapshot_locked()
+
+    # ------------------------------------------------------------------
+    # RPC handlers (follower side)
+
+    def _handle_request_vote(self, args):
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "granted": False}
+            if term > self.current_term:
+                self._become_follower_locked(term)
+            up_to_date = args["last_log_term"] > self._last_log_term() or (
+                args["last_log_term"] == self._last_log_term()
+                and args["last_log_index"] >= self._last_log_index()
+            )
+            if up_to_date and self.voted_for in (None, args["candidate_id"]):
+                self.voted_for = args["candidate_id"]
+                self._last_heartbeat = time.monotonic()
+                return {"term": self.current_term, "granted": True}
+            return {"term": self.current_term, "granted": False}
+
+    def _handle_append_entries(self, args):
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term, "success": False}
+            if term > self.current_term or self.state != FOLLOWER:
+                self._become_follower_locked(term)
+            self.leader_id = args["leader_id"]
+            self._last_heartbeat = time.monotonic()
+
+            prev_idx = args["prev_log_index"]
+            prev_term = args["prev_log_term"]
+            our_term = self._term_at(prev_idx)
+            if our_term is None:
+                # We don't have prev_idx at all — tell the leader where
+                # our log ends so it can jump straight there.
+                return {
+                    "term": self.current_term,
+                    "success": False,
+                    "conflict_index": self._last_log_index() + 1,
+                }
+            if our_term != prev_term:
+                # Find the first index of the conflicting term.
+                ci = prev_idx
+                while ci > self._snap_last_index + 1 and self._term_at(ci - 1) == our_term:
+                    ci -= 1
+                return {
+                    "term": self.current_term,
+                    "success": False,
+                    "conflict_index": ci,
+                }
+            for raw in args["entries"]:
+                idx, eterm, msg_type, payload = raw
+                existing = self._entry_at(idx)
+                if existing is not None:
+                    if existing.term == eterm:
+                        continue
+                    # conflict: truncate from idx on
+                    keep = idx - self._snap_last_index - 1
+                    self._log = self._log[:keep]
+                if idx == self._last_log_index() + 1:
+                    self._log.append(LogEntry(idx, eterm, msg_type, payload))
+            if args["leader_commit"] > self.commit_index:
+                self.commit_index = min(
+                    args["leader_commit"], self._last_log_index()
+                )
+                self._commit_cv.notify_all()
+            return {"term": self.current_term, "success": True}
+
+    def _handle_install_snapshot(self, args):
+        with self._lock:
+            term = args["term"]
+            if term < self.current_term:
+                return {"term": self.current_term}
+            self._become_follower_locked(term)
+            self.leader_id = args["leader_id"]
+            self._last_heartbeat = time.monotonic()
+            last_idx = args["last_included_index"]
+            last_term = args["last_included_term"]
+            if last_idx <= self._snap_last_index or last_idx <= self.last_applied:
+                return {"term": self.current_term}
+            with self._fsm_mutex:
+                self._restore_epoch += 1
+                if self.restore_fn is not None and args["data"] is not None:
+                    self.restore_fn(args["data"])
+            self._snap_bytes = args["data"]
+            self._snap_last_index = last_idx
+            self._snap_last_term = last_term
+            self._log = [e for e in self._log if e.index > last_idx]
+            self.commit_index = max(self.commit_index, last_idx)
+            self.last_applied = max(self.last_applied, last_idx)
+            self._commit_cv.notify_all()
+            return {"term": self.current_term}

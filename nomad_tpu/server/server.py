@@ -177,7 +177,16 @@ class Server:
 
     # -- raft ----------------------------------------------------------
 
+    def set_raft_applier(self, applier) -> None:
+        """Swap the single-node InmemLog for a replicated log (the cluster
+        layer installs RaftNode.apply). Every subsystem routes through
+        raft_apply, so nothing else changes."""
+        self._raft_applier = applier
+
     def raft_apply(self, msg_type: str, payload) -> int:
+        applier = getattr(self, "_raft_applier", None)
+        if applier is not None:
+            return applier(msg_type, payload)
         return self.log.apply(msg_type, payload)
 
     # -- FSM side channels --------------------------------------------
@@ -209,7 +218,23 @@ class Server:
                     self.blocked_evals.unblock(node.computed_class)
 
     def _requeue_unblocked(self, ev: Evaluation) -> None:
-        self.raft_apply("eval_update", [ev])
+        """Write an unblocked eval back to pending.
+
+        MUST be asynchronous: this fires from FSM side-channels, i.e. from
+        inside the raft apply loop — a synchronous raft_apply here would
+        block the apply thread on a commit that needs the apply thread
+        (the reference's BlockedEvals likewise hands unblocks to the
+        broker via a channel, never re-entering Raft from the FSM)."""
+
+        def write():
+            try:
+                self.raft_apply("eval_update", [ev])
+            except Exception:
+                # Lost leadership mid-unblock: the new leader rebuilds
+                # blocked-eval state from the store (restoreEvals).
+                logger.debug("requeue of unblocked eval %s dropped", ev.id)
+
+        threading.Thread(target=write, daemon=True, name="unblock-write").start()
 
     def _on_job_upsert(self, job, ns_id) -> None:
         """Keep the periodic dispatcher's tracked set in sync with the FSM

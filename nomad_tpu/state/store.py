@@ -319,6 +319,36 @@ class StateStore(_ReadMixin):
     def subscribe(self, fn: Callable[[int, str, list, str], None]) -> None:
         self._subscribers.append(fn)
 
+    # -- snapshot persistence ------------------------------------------
+
+    def serialize(self) -> bytes:
+        """Full-state snapshot bytes (reference fsm.go:1860 Persist streams
+        every table; here one codec blob — tables, indexes, latest)."""
+        from .. import codec
+
+        with self._lock:
+            return codec.pack(
+                {
+                    "tables": self._tables,
+                    "indexes": self._indexes,
+                    "latest": self._latest_index,
+                }
+            )
+
+    def restore_from(self, raw: bytes) -> None:
+        """Replace all state from snapshot bytes (reference fsm.go:1381
+        Restore). Watchers are woken; subscribers are NOT replayed — stream
+        consumers must re-subscribe after a restore, as in the reference."""
+        from .. import codec
+
+        data = codec.unpack(raw)
+        with self._cv:
+            self._tables = data["tables"]
+            self._indexes = data["indexes"]
+            self._latest_index = data["latest"]
+            self._shared = set()
+            self._cv.notify_all()
+
     # -- write plumbing ------------------------------------------------
 
     def _wtable(self, table: str) -> dict:
@@ -910,11 +940,6 @@ class StateStore(_ReadMixin):
                 self._upsert_evals_txn(index, [eval_obj])
                 self._stamp(index, TABLE_EVALS)
             self._stamp(index, TABLE_DEPLOYMENTS, TABLE_ALLOCS)
-            d2 = self._tables[TABLE_DEPLOYMENTS].get(deployment_id)
-            if d2 is not None:
-                self._publish(
-                    index, TABLE_DEPLOYMENTS, [d2], "DeploymentAllocHealth"
-                )
             self._publish(
                 index, TABLE_DEPLOYMENTS, [d], "DeploymentPromotion"
             )
@@ -984,6 +1009,11 @@ class StateStore(_ReadMixin):
                 self._upsert_evals_txn(index, [eval_obj])
                 self._stamp(index, TABLE_EVALS)
             self._stamp(index, TABLE_DEPLOYMENTS, TABLE_ALLOCS)
+            d2 = self._tables[TABLE_DEPLOYMENTS].get(deployment_id)
+            if d2 is not None:
+                self._publish(
+                    index, TABLE_DEPLOYMENTS, [d2], "DeploymentAllocHealth"
+                )
 
     # -- derived state -------------------------------------------------
 

@@ -1,0 +1,158 @@
+"""Clustered control-plane e2e: Raft-replicated servers + networked client.
+
+Reference analog: nomad/leader_test.go + client/testing.go — several
+in-process servers joined, a client agent over the wire, failover.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client
+from nomad_tpu.rpc import ConnPool
+from nomad_tpu.server.cluster import ClusterRPC, ClusterServer
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    # Three servers with static peer wiring (serf-style discovery is the
+    # membership layer's job; raft takes a fixed member map).
+    import socket
+
+    ports = []
+    socks = []
+    for _ in range(3):
+        s = socket.create_server(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    ids = [f"s{i}" for i in range(3)]
+    addrs = {nid: ("127.0.0.1", ports[i]) for i, nid in enumerate(ids)}
+    servers = {}
+    for nid in ids:
+        servers[nid] = ClusterServer(
+            nid,
+            peers={p: a for p, a in addrs.items() if p != nid},
+            port=addrs[nid][1],
+            num_workers=1,
+        )
+    for s in servers.values():
+        s.start()
+    clients = []
+
+    def add_client(**kw):
+        c = Client(
+            ClusterRPC([s.addr for s in servers.values()]),
+            data_dir=str(tmp_path / f"c{len(clients)}"),
+            **kw,
+        )
+        c.start()
+        clients.append(c)
+        return c
+
+    yield servers, add_client
+    for c in clients:
+        c.shutdown()
+    for s in servers.values():
+        s.shutdown()
+
+
+def _leader(servers):
+    for s in servers.values():
+        if s.is_leader():
+            return s
+    return None
+
+
+def test_cluster_runs_job_via_follower(cluster3):
+    servers, add_client = cluster3
+    assert wait_until(lambda: _leader(servers) is not None)
+    client = add_client()
+    leader = _leader(servers)
+    follower = next(s for s in servers.values() if s is not leader)
+
+    # Register through a FOLLOWER: must forward to the leader.
+    job = mock.job()
+    job.task_groups[0].count = 2
+    job.task_groups[0].tasks[0].config = {}
+    job.datacenters = [client.node.datacenter]
+    pool = ConnPool()
+    try:
+        eval_id = pool.call(follower.addr, "Job.register", {"job": job})
+        assert eval_id
+
+        def running_everywhere():
+            for s in servers.values():
+                allocs = s.server.state.allocs_by_job(job.namespace, job.id)
+                if len(allocs) != 2:
+                    return False
+                if not all(a.client_status == "running" for a in allocs):
+                    return False
+            return True
+
+        assert wait_until(running_everywhere, 20), (
+            "2 allocs should reach running and replicate to every server"
+        )
+    finally:
+        pool.shutdown()
+
+
+def test_leader_failover_reschedules(cluster3):
+    servers, add_client = cluster3
+    assert wait_until(lambda: _leader(servers) is not None)
+    client = add_client()
+    leader = _leader(servers)
+
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].config = {}
+    job.datacenters = [client.node.datacenter]
+    pool = ConnPool()
+    try:
+        pool.call(leader.addr, "Job.register", {"job": job})
+        assert wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in leader.server.state.allocs_by_job(job.namespace, job.id)
+            ),
+            20,
+        )
+
+        # Kill the leader. A new one must take over and keep serving.
+        dead_id = leader.node_id
+        leader.shutdown()
+        del servers[dead_id]
+        assert wait_until(lambda: _leader(servers) is not None, 20), (
+            "a new leader should be elected"
+        )
+        new_leader = _leader(servers)
+
+        # The surviving cluster accepts and runs a second job (the client
+        # fails over between servers transparently).
+        job2 = mock.job(id="after-failover")
+        job2.task_groups[0].count = 1
+        job2.task_groups[0].tasks[0].config = {}
+        job2.datacenters = [client.node.datacenter]
+        pool.call(new_leader.addr, "Job.register", {"job": job2})
+        assert wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in new_leader.server.state.allocs_by_job(
+                    job2.namespace, job2.id
+                )
+            ),
+            25,
+        ), "job registered after failover should run"
+    finally:
+        pool.shutdown()
