@@ -120,6 +120,8 @@ class Client:
                     self.node.id, index + 1, timeout_s=1.0
                 )
             except Exception:
+                if self._shutdown.is_set():
+                    return
                 logger.exception("alloc watch failed")
                 self._shutdown.wait(1)
                 continue

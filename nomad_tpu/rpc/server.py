@@ -85,7 +85,9 @@ class RPCServer:
         self._priority_pool = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="rpc-raft"
         )
-        self._priority_prefixes = ("Raft.",)
+        # Serf shares the lane: a starved probe ack looks like a dead
+        # member and gets a live raft peer removed.
+        self._priority_prefixes = ("Raft.", "Serf.")
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set[socket.socket] = set()

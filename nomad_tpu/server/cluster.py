@@ -29,11 +29,13 @@ scheduler scale comes from the solver's device mesh instead (SURVEY.md
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 from typing import Optional
 
 from ..rpc import ConnPool, RPCError, RPCServer
 from ..structs import Allocation, Job, Node
+from .membership import Membership
 from .raft_replication import NotLeaderError, RaftNode
 from .server import Server
 
@@ -210,12 +212,17 @@ class StatusEndpoint(_Forwarder):
         out = [
             {"id": self.cs.node_id, "addr": list(self.cs.rpc.addr)}
         ]
-        for pid, addr in self.cs.raft.peers.items():
+        with self.cs.raft._lock:  # applies mutate the dict in place
+            peers = dict(self.cs.raft.peers)
+        for pid, addr in peers.items():
             out.append({"id": pid, "addr": list(addr)})
         return out
 
     def ping(self, args):
         return "pong"
+
+    def members(self, args):
+        return [m.to_wire() for m in self.cs.serf.members()]
 
 
 class ClusterServer:
@@ -227,9 +234,12 @@ class ClusterServer:
         port: int = 0,
         num_workers: int = 2,
         use_tpu_batch_worker: bool = False,
+        region: str = "global",
+        bootstrap_expect: Optional[int] = None,
         **raft_kw,
     ) -> None:
         self.node_id = node_id
+        self.region = region
         self.rpc = RPCServer(host=host, port=port)
         self.pool = ConnPool()
         self.server = Server(
@@ -241,6 +251,14 @@ class ClusterServer:
         # bare raft cluster (GIL contention).
         raft_kw.setdefault("heartbeat_ms", 100)
         raft_kw.setdefault("election_ms", 1000)
+        # Static peer wiring (tests, fixed configs) bootstraps immediately;
+        # gossip-discovered clusters wait for bootstrap_expect members
+        # (reference server config bootstrap_expect + serf discovery).
+        if bootstrap_expect is None:
+            bootstrap_expect = len(peers) + 1 if peers else 1
+        raft_kw.setdefault("bootstrap_expect", bootstrap_expect)
+        self._bootstrap_expect = bootstrap_expect
+        self._bootstrapped = bool(peers) or bootstrap_expect <= 1
         self.raft = RaftNode(
             node_id,
             self.server.fsm,
@@ -263,6 +281,26 @@ class ClusterServer:
             ("Status", StatusEndpoint(self)),
         ):
             self.rpc.register(name, ep)
+        # Gossip membership (reference setupSerf): server-role tagged,
+        # events drive leader-side raft peer reconciliation.
+        self.serf = Membership(
+            node_id,
+            self.rpc.addr,
+            pool=self.pool,
+            tags={"role": "server", "region": region},
+            on_event=self._on_member_event,
+        )
+        self.rpc.register("Serf", self.serf.endpoint)
+        # Member events are handled on a dedicated reconciler thread:
+        # add_peer/remove_peer block on raft commit (up to 10s with no
+        # quorum), which must never stall the gossip probe loop.
+        self._reconcile_q: "queue.Queue" = queue.Queue()
+        self._reconciler = threading.Thread(
+            target=self._reconcile_loop,
+            name=f"reconcile-{node_id}",
+            daemon=True,
+        )
+        self._reconciler.start()
 
     # -- wiring --------------------------------------------------------
 
@@ -287,9 +325,66 @@ class ClusterServer:
     def start(self) -> None:
         self.rpc.start()
         self.raft.start()
+        self.serf.start()
+
+    def join(self, seeds: list[tuple[str, int]]) -> int:
+        """Gossip-join an existing cluster (reference `nomad server join` /
+        server_join config). Raft adoption follows via member events."""
+        return self.serf.join(seeds)
+
+    def _on_member_event(self, kind: str, member) -> None:
+        if member.tags.get("role") != "server":
+            return
+        # Initial bootstrap: once bootstrap_expect servers see each other,
+        # every one of them derives the SAME peer map from gossip and raft
+        # elections begin (reference serf.go maybeBootstrap). Cheap — runs
+        # inline on the probe thread.
+        if not self._bootstrapped and kind == "member-join":
+            servers = {
+                m.id: tuple(m.addr)
+                for m in self.serf.members()
+                if m.tags.get("role") == "server" and m.status == "alive"
+            }
+            servers[self.node_id] = self.rpc.addr
+            if len(servers) >= self._bootstrap_expect:
+                with self.raft._lock:
+                    if not self.raft.peers:
+                        self.raft.peers = {
+                            p: a for p, a in servers.items() if p != self.node_id
+                        }
+                self._bootstrapped = True
+                logger.info(
+                    "%s: bootstrapped raft with %d servers",
+                    self.node_id,
+                    len(servers),
+                )
+            return
+        self._reconcile_q.put((kind, member))
+
+    def _reconcile_loop(self) -> None:
+        """Leader-side raft config reconciliation off the gossip thread
+        (reference leader.go reconcileMember)."""
+        while True:
+            item = self._reconcile_q.get()
+            if item is None:
+                return
+            kind, member = item
+            if not self.raft.is_leader():
+                continue
+            try:
+                if kind in ("member-join", "member-alive"):
+                    self.raft.add_peer(member.id, tuple(member.addr))
+                elif kind in ("member-failed", "member-leave"):
+                    self.raft.remove_peer(member.id)
+            except (NotLeaderError, TimeoutError):
+                pass
+            except Exception:
+                logger.exception("member reconciliation failed")
 
     def shutdown(self) -> None:
         was_leader = self.raft.is_leader()
+        self.serf.stop()
+        self._reconcile_q.put(None)
         self.raft.stop()
         if was_leader:
             self.server.revoke_leadership()
@@ -310,17 +405,25 @@ class ClusterRPC:
     def __init__(self, addrs: list[tuple[str, int]], pool: Optional[ConnPool] = None):
         self.addrs = [tuple(a) for a in addrs]
         self.pool = pool or ConnPool()
+        # The client's heartbeat and watch threads share this object;
+        # rotation must be atomic or concurrent failures double-rotate
+        # past live servers.
+        self._lock = threading.Lock()
 
     def _call(self, method: str, args, timeout_s: float = 30.0):
         last: Optional[Exception] = None
-        for _ in range(len(self.addrs)):
-            addr = self.addrs[0]
+        with self._lock:
+            candidates = list(self.addrs)
+        for addr in candidates:
             try:
                 return self.pool.call(addr, method, args, timeout_s=timeout_s)
             except (ConnectionError, OSError, TimeoutError, RPCError) as e:
                 last = e
-                # rotate: try the next server (reference servers.Manager)
-                self.addrs.append(self.addrs.pop(0))
+                # rotate the shared ring only if this addr is still at the
+                # front (another thread may have rotated already)
+                with self._lock:
+                    if self.addrs and self.addrs[0] == addr:
+                        self.addrs.append(self.addrs.pop(0))
         raise last  # type: ignore[misc]
 
     def register(self, node: Node) -> float:

@@ -87,6 +87,7 @@ class RaftNode:
         peers: dict[str, tuple[str, int]],
         heartbeat_ms: int = 60,
         election_ms: int = 250,
+        bootstrap_expect: int = 1,
         snapshot_threshold: int = 8192,
         snapshot_fn: Optional[Callable[[], bytes]] = None,
         restore_fn: Optional[Callable[[bytes], None]] = None,
@@ -98,6 +99,11 @@ class RaftNode:
         self.advertise = advertise
         # peers maps node_id -> rpc addr for every OTHER member
         self.peers = dict(peers)
+        # Elections only start once the known cluster reaches this size
+        # (reference bootstrap_expect): a blank server joining an existing
+        # cluster must never elect itself leader of a cluster of one.
+        # 0 ⇒ never self-bootstrap (wait to be adopted via raft_add_peer).
+        self.bootstrap_expect = bootstrap_expect
         self.heartbeat_s = heartbeat_ms / 1000.0
         self.election_s = election_ms / 1000.0
         self.snapshot_threshold = snapshot_threshold
@@ -187,7 +193,8 @@ class RaftNode:
         self._leader_events.put(None)
         with self._commit_cv:
             self._commit_cv.notify_all()
-        for ev in self._repl_wake.values():
+            wakes = list(self._repl_wake.values())
+        for ev in wakes:
             ev.set()
         for t in self._threads:
             t.join(timeout=2)
@@ -245,6 +252,57 @@ class RaftNode:
                 raise NotLeaderError(self.leader_addr())
         return index
 
+    # -- membership changes (single-server-at-a-time, via the log) ------
+
+    def add_peer(self, peer_id: str, addr: tuple[str, int]) -> None:
+        """Leader-only: adopt a new member (reference leader.go
+        addRaftPeer). Rides the log so every replica converges on the
+        same configuration at the same index."""
+        if peer_id == self.node_id or peer_id in self.peers:
+            return
+        self.apply("raft_add_peer", (peer_id, tuple(addr)))
+
+    def remove_peer(self, peer_id: str) -> None:
+        """Leader-only (reference removeRaftPeer / autopilot cleanup)."""
+        if peer_id not in self.peers:
+            return
+        self.apply("raft_remove_peer", peer_id)
+
+    def _apply_peer_change(
+        self, msg_type: str, payload, epoch: Optional[int] = None
+    ) -> None:
+        with self._lock:
+            if epoch is not None and self._restore_epoch != epoch:
+                return
+            if msg_type == "raft_add_peer":
+                peer_id, addr = payload
+                addr = tuple(addr)
+                if peer_id == self.node_id or peer_id in self.peers:
+                    return
+                self.peers[peer_id] = addr
+                if self.state == LEADER:
+                    self._next_index[peer_id] = self._last_log_index() + 1
+                    self._match_index[peer_id] = 0
+                    self._repl_wake[peer_id] = threading.Event()
+                    t = threading.Thread(
+                        target=self._replicate_loop,
+                        args=(peer_id,),
+                        name=f"raft-repl-{self.node_id}-{peer_id}",
+                        daemon=True,
+                    )
+                    t.start()
+                    self._threads.append(t)
+            else:
+                peer_id = payload
+                self.peers.pop(peer_id, None)
+                self._next_index.pop(peer_id, None)
+                self._match_index.pop(peer_id, None)
+                wake = self._repl_wake.pop(peer_id, None)
+                if wake is not None:
+                    wake.set()  # its replicate loop exits on next check
+                if self.state == LEADER:
+                    self._advance_commit_locked()
+
     def leader_addr(self) -> Optional[tuple[str, int]]:
         if self.leader_id is None:
             return None
@@ -267,14 +325,26 @@ class RaftNode:
         timeout = self._rand_election_timeout()
         while not self._stop.is_set():
             time.sleep(self.heartbeat_s / 2)
-            with self._lock:
-                state = self.state
-                elapsed = time.monotonic() - self._last_heartbeat
-            if state == LEADER:
-                continue  # replication threads heartbeat
-            if elapsed >= timeout:
-                self._start_election()
-                timeout = self._rand_election_timeout()
+            try:
+                with self._lock:
+                    state = self.state
+                    elapsed = time.monotonic() - self._last_heartbeat
+                if state == LEADER:
+                    continue  # replication threads heartbeat
+                if elapsed >= timeout:
+                    with self._lock:
+                        quorum_known = (
+                            self.bootstrap_expect > 0
+                            and len(self.peers) + 1 >= self.bootstrap_expect
+                        )
+                    if quorum_known:
+                        self._start_election()
+                    timeout = self._rand_election_timeout()
+            except Exception:
+                # The ticker is the node's heartbeat-of-last-resort; it
+                # must survive anything (a dead ticker = a zombie node
+                # that can never call an election again).
+                logger.exception("%s: ticker iteration failed", self.node_id)
 
     def _rand_election_timeout(self) -> float:
         return self.election_s * (1.0 + random.random())
@@ -290,10 +360,11 @@ class RaftNode:
             self._last_heartbeat = time.monotonic()
             last_idx = self._last_log_index()
             last_term = self._last_log_term()
+            peers = dict(self.peers)  # snapshot: applies mutate in place
         logger.debug("%s: starting election term %d", self.node_id, term)
         if self._won_locked_check():
             return
-        for peer_id, addr in self.peers.items():
+        for peer_id, addr in peers.items():
             threading.Thread(
                 target=self._solicit_vote,
                 args=(peer_id, addr, term, last_idx, last_term),
@@ -388,7 +459,7 @@ class RaftNode:
         addr = self.peers[peer_id]
         while not self._stop.is_set():
             with self._lock:
-                if self.state != LEADER:
+                if self.state != LEADER or peer_id not in self.peers:
                     return
                 term = self.current_term
                 next_idx = self._next_index[peer_id]
@@ -448,6 +519,11 @@ class RaftNode:
         if self._snap_bytes is None and self.snapshot_fn is not None:
             self._take_snapshot_locked()
         snap = (self._snap_bytes, self._snap_last_index, self._snap_last_term)
+        # Snapshot carries the member configuration too: a blank follower
+        # restored from snapshot must know the full peer set (the add-peer
+        # log entries it would have learned it from were compacted away).
+        config = {self.node_id: list(self.advertise)}
+        config.update({p: list(a) for p, a in self.peers.items()})
         self._lock.release()
         try:
             resp = self.pool.call(
@@ -459,6 +535,7 @@ class RaftNode:
                     "last_included_index": snap[1],
                     "last_included_term": snap[2],
                     "data": snap[0],
+                    "config": config,
                 },
                 timeout_s=10.0,
             )
@@ -516,6 +593,12 @@ class RaftNode:
                 # A snapshot restore while we were applying makes the rest
                 # of this batch stale — re-applying old entries on top of
                 # newer restored state would corrupt it.
+                if e.msg_type in ("raft_add_peer", "raft_remove_peer"):
+                    # Raft-level config change: needs _lock, not the FSM
+                    # mutex (taking _lock under _fsm_mutex would deadlock
+                    # against InstallSnapshot's _lock → _fsm_mutex order).
+                    self._apply_peer_change(e.msg_type, e.payload, epoch)
+                    continue
                 with self._fsm_mutex:
                     if self._restore_epoch != epoch:
                         break
@@ -637,6 +720,11 @@ class RaftNode:
                 self._restore_epoch += 1
                 if self.restore_fn is not None and args["data"] is not None:
                     self.restore_fn(args["data"])
+            config = args.get("config")
+            if config:
+                self.peers = {
+                    p: tuple(a) for p, a in config.items() if p != self.node_id
+                }
             self._snap_bytes = args["data"]
             self._snap_last_index = last_idx
             self._snap_last_term = last_term

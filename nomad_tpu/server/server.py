@@ -108,6 +108,16 @@ class Server:
                     )
                 )
 
+        # Single writer draining unblocked-eval re-queues (see
+        # _requeue_unblocked for why this must be async).
+        import queue as _queue
+
+        self._unblock_q: "_queue.Queue" = _queue.Queue()
+        self._unblock_thread = threading.Thread(
+            target=self._unblock_writer, daemon=True, name="unblock-writer"
+        )
+        self._unblock_thread.start()
+
         # FSM side-channels (reference fsm.go:746)
         self.fsm.on_eval_update = self._on_eval_update
         self.fsm.on_node_update = self._on_node_update
@@ -165,6 +175,7 @@ class Server:
 
     def shutdown(self) -> None:
         self.revoke_leadership()
+        self._unblock_q.put(None)
 
     def _restore_evals(self) -> None:
         """Broker state is not persisted; rebuild from the state store
@@ -224,17 +235,22 @@ class Server:
         inside the raft apply loop — a synchronous raft_apply here would
         block the apply thread on a commit that needs the apply thread
         (the reference's BlockedEvals likewise hands unblocks to the
-        broker via a channel, never re-entering Raft from the FSM)."""
+        broker via a channel, never re-entering Raft from the FSM). A
+        single writer thread drains the queue so a mass unblock (drain
+        ending, big node joining) costs one thread, not hundreds."""
+        self._unblock_q.put(ev)
 
-        def write():
+    def _unblock_writer(self) -> None:
+        while True:
+            ev = self._unblock_q.get()
+            if ev is None:
+                return
             try:
                 self.raft_apply("eval_update", [ev])
             except Exception:
                 # Lost leadership mid-unblock: the new leader rebuilds
                 # blocked-eval state from the store (restoreEvals).
                 logger.debug("requeue of unblocked eval %s dropped", ev.id)
-
-        threading.Thread(target=write, daemon=True, name="unblock-write").start()
 
     def _on_job_upsert(self, job, ns_id) -> None:
         """Keep the periodic dispatcher's tracked set in sync with the FSM
