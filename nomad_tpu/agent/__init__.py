@@ -1,0 +1,6 @@
+"""Agent: HTTP API + embedded server/client (reference command/agent/)."""
+
+from .agent import Agent, AgentConfig
+from .http import HTTPAgentServer
+
+__all__ = ["Agent", "AgentConfig", "HTTPAgentServer"]

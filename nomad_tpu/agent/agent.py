@@ -1,0 +1,124 @@
+"""The agent process: embedded server and/or client plus the HTTP API.
+
+Reference: command/agent/agent.go + command/agent/command.go — `nomad
+agent` reads config, conditionally starts an in-process server and/or
+client, wires them together (a co-located client talks to its own server
+first), and serves HTTP.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..client import Client
+from ..server.cluster import ClusterRPC, ClusterServer
+
+logger = logging.getLogger("nomad_tpu.agent")
+
+
+@dataclass
+class AgentConfig:
+    """Reference: command/agent/config.go (subset; grows with features)."""
+
+    node_name: str = ""
+    region: str = "global"
+    datacenter: str = "dc1"
+    data_dir: str = "/tmp/nomad_tpu"
+    bind_addr: str = "127.0.0.1"
+    # server stanza
+    server_enabled: bool = False
+    bootstrap_expect: int = 1
+    rpc_port: int = 0  # 0 = ephemeral (reference default 4647)
+    # client stanza
+    client_enabled: bool = False
+    client_servers: list = field(default_factory=list)  # [(host, port)]
+    node_class: str = ""
+    # http
+    http_port: int = 0  # reference default 4646
+    # scheduler
+    num_schedulers: int = 2
+    use_tpu_batch_worker: bool = False
+    # retry_join seeds (serf)
+    server_join: list = field(default_factory=list)
+
+    @staticmethod
+    def dev() -> "AgentConfig":
+        """-dev mode: server + client in one process (reference
+        DevConfig, command.go)."""
+        return AgentConfig(server_enabled=True, client_enabled=True)
+
+
+class Agent:
+    def __init__(self, config: AgentConfig) -> None:
+        self.config = config
+        self.server: Optional[ClusterServer] = None
+        self.client: Optional[Client] = None
+        self.http = None
+
+        if config.server_enabled:
+            # A join-configured server is joining an EXISTING cluster:
+            # never self-bootstrap a cluster of one (expect=0 ⇒ wait to be
+            # adopted), unless a larger bootstrap_expect says otherwise.
+            expect = config.bootstrap_expect
+            if config.server_join and expect <= 1:
+                expect = 0
+            self.server = ClusterServer(
+                config.node_name or f"server-{id(self) & 0xFFFF:x}",
+                host=config.bind_addr,
+                port=config.rpc_port,
+                num_workers=config.num_schedulers,
+                use_tpu_batch_worker=config.use_tpu_batch_worker,
+                region=config.region,
+                bootstrap_expect=expect,
+            )
+        if config.client_enabled:
+            if self.server is not None:
+                # co-located client: talk to our own server in-process
+                from ..client import ServerRPC
+
+                rpc = ServerRPC(self.server.server)
+            else:
+                if not config.client_servers:
+                    raise ValueError("client agent needs `servers` addresses")
+                rpc = ClusterRPC([tuple(a) for a in config.client_servers])
+            self.client = Client(
+                rpc,
+                data_dir=config.data_dir,
+                datacenter=config.datacenter,
+                node_class=config.node_class,
+            )
+        if self.server is not None:
+            from .http import HTTPAgentServer
+
+            self.http = HTTPAgentServer(
+                self.server,
+                client=self.client,
+                host=config.bind_addr,
+                port=config.http_port,
+            )
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+            if self.config.server_join:
+                self.server.join([tuple(a) for a in self.config.server_join])
+        # HTTP before the client: the API must come up even if client
+        # registration is still waiting on a leader.
+        if self.http is not None:
+            self.http.start()
+        if self.client is not None:
+            self.client.start()
+
+    def shutdown(self) -> None:
+        if self.http is not None:
+            self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+
+    @property
+    def http_addr(self) -> Optional[tuple[str, int]]:
+        return None if self.http is None else self.http.addr

@@ -1,0 +1,492 @@
+"""HTTP API server.
+
+Reference: command/agent/http.go (~60 routes at :252-360, wrap() adds
+region/blocking-query/auth handling), the per-resource endpoint files
+(job_endpoint.go, node_endpoint.go, …), and the NDJSON event stream
+endpoint (event_endpoint.go).
+
+JSON convention: payloads are the codec wire form of the shared structs —
+plain JSON with a `$t` type tag per struct, so the SDK decodes straight
+back into typed dataclasses and third-party consumers still read ordinary
+JSON. Blocking queries take `?index=N&wait=SECONDS` like the reference
+and respond with the `X-Nomad-Index` header.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .. import codec
+from ..state.store import (
+    TABLE_ALLOCS,
+    TABLE_DEPLOYMENTS,
+    TABLE_EVALS,
+    TABLE_JOBS,
+    TABLE_NODES,
+)
+from ..stream import SubscriptionClosedError
+
+logger = logging.getLogger("nomad_tpu.http")
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        self.status = status
+        self.message = message
+        super().__init__(message)
+
+
+_json_default = codec.json_default
+
+
+class HTTPAgentServer:
+    """Routes /v1/... onto a ClusterServer (and optionally a Client).
+
+    Route handlers get (params, query, body, token) and return a
+    JSON-able wire object (codec.to_wire applied to struct returns).
+    """
+
+    def __init__(
+        self,
+        cluster,  # ClusterServer
+        client=None,  # optional co-located node agent
+        host: str = "127.0.0.1",
+        port: int = 0,
+        acl_resolver=None,  # installed by the ACL layer (nomad_tpu/acl)
+    ) -> None:
+        self.cluster = cluster
+        self.client = client
+        self.acl_resolver = acl_resolver
+        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._register_routes()
+        handler = self._make_handler()
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self.addr = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-agent", daemon=True
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- routing -------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        srv = self.cluster.server
+
+        def route(method: str, pattern: str, fn: Callable) -> None:
+            self._routes.append((method, re.compile(f"^{pattern}$"), fn))
+
+        def blocking(tables, query, reader):
+            """Common blocking-query wrapper (reference http.go wrap +
+            setMeta): ?index=N&wait=S parks on the state watch."""
+            min_index = int(query.get("index", ["0"])[0])
+            wait_s = _parse_wait(query.get("wait", ["0"])[0])
+            if min_index > 0 and wait_s > 0:
+                idx = srv.state.wait_for_index(tables, min_index + 1, wait_s)
+            else:
+                idx = srv.state.table_index(*tables)
+            return reader(), idx
+
+        # -- jobs ------------------------------------------------------
+        def jobs_list(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            data, idx = blocking(
+                [TABLE_JOBS], q, lambda: srv.state.jobs(None if ns == "*" else ns)
+            )
+            prefix = q.get("prefix", [""])[0]
+            if prefix:
+                data = [j for j in data if j.id.startswith(prefix)]
+            return data, idx
+
+        def jobs_register(p, q, body, tok):
+            job = codec.from_wire(body["Job"])
+            return self.cluster.rpc_self("Job.register", {"job": job})
+
+        def job_get(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            job = srv.state.job_by_id(ns, p["id"])
+            if job is None:
+                raise HTTPError(404, f"job {p['id']} not found")
+            return job
+
+        def job_delete(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            purge = q.get("purge", ["false"])[0] == "true"
+            return self.cluster.rpc_self(
+                "Job.deregister",
+                {"namespace": ns, "job_id": p["id"], "purge": purge},
+            )
+
+        def job_allocs(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            data, idx = blocking(
+                [TABLE_ALLOCS], q, lambda: srv.state.allocs_by_job(ns, p["id"])
+            )
+            return data, idx
+
+        def job_evals(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return srv.state.evals_by_job(ns, p["id"])
+
+        def job_summary(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            s = srv.state.job_summary_by_id(ns, p["id"])
+            if s is None:
+                raise HTTPError(404, "no summary")
+            return s
+
+        def job_versions(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return srv.state.job_versions(ns, p["id"])
+
+        def job_revert(p, q, body, tok):
+            ns = body.get("Namespace", "default")
+            return srv.job_revert(ns, p["id"], body["JobVersion"])
+
+        def job_dispatch(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return srv.job_dispatch(
+                ns,
+                p["id"],
+                meta=body.get("Meta") or {},
+                payload=body.get("Payload"),
+            )
+
+        def job_periodic_force(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return srv.periodic.force_launch(ns, p["id"])
+
+        route("GET", "/v1/jobs", jobs_list)
+        route("PUT", "/v1/jobs", jobs_register)
+        route("POST", "/v1/jobs", jobs_register)
+        route("GET", "/v1/job/(?P<id>[^/]+)", job_get)
+        route("DELETE", "/v1/job/(?P<id>[^/]+)", job_delete)
+        route("GET", "/v1/job/(?P<id>[^/]+)/allocations", job_allocs)
+        route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
+        route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
+        route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        route("PUT", "/v1/job/(?P<id>[^/]+)/revert", job_revert)
+        route("PUT", "/v1/job/(?P<id>[^/]+)/dispatch", job_dispatch)
+        route("POST", "/v1/job/(?P<id>[^/]+)/dispatch", job_dispatch)
+        route(
+            "PUT", "/v1/job/(?P<id>[^/]+)/periodic/force", job_periodic_force
+        )
+
+        # -- nodes -----------------------------------------------------
+        def nodes_list(p, q, body, tok):
+            data, idx = blocking([TABLE_NODES], q, srv.state.nodes)
+            prefix = q.get("prefix", [""])[0]
+            if prefix:
+                data = [n for n in data if n.id.startswith(prefix)]
+            return data, idx
+
+        def node_get(p, q, body, tok):
+            node = srv.state.node_by_id(p["id"])
+            if node is None:
+                raise HTTPError(404, f"node {p['id']} not found")
+            return node
+
+        def node_allocs(p, q, body, tok):
+            data, idx = blocking(
+                [TABLE_ALLOCS], q, lambda: srv.state.allocs_by_node(p["id"])
+            )
+            return data, idx
+
+        def node_drain(p, q, body, tok):
+            drain = (
+                codec.from_wire(body["DrainSpec"])
+                if body.get("DrainSpec") is not None
+                else None
+            )
+            self.cluster.rpc_self(
+                "Node.update_drain",
+                {
+                    "node_id": p["id"],
+                    "drain": drain,
+                    "mark_eligible": body.get("MarkEligible", False),
+                },
+            )
+            return {"NodeModifyIndex": srv.state.latest_index()}
+
+        def node_eligibility(p, q, body, tok):
+            self.cluster.rpc_self(
+                "Node.update_eligibility",
+                {"node_id": p["id"], "eligibility": body["Eligibility"]},
+            )
+            return {}
+
+        def node_purge(p, q, body, tok):
+            srv.raft_apply("node_deregister", p["id"])
+            return {}
+
+        route("GET", "/v1/nodes", nodes_list)
+        route("GET", "/v1/node/(?P<id>[^/]+)", node_get)
+        route("GET", "/v1/node/(?P<id>[^/]+)/allocations", node_allocs)
+        route("PUT", "/v1/node/(?P<id>[^/]+)/drain", node_drain)
+        route("POST", "/v1/node/(?P<id>[^/]+)/drain", node_drain)
+        route("PUT", "/v1/node/(?P<id>[^/]+)/eligibility", node_eligibility)
+        route("PUT", "/v1/node/(?P<id>[^/]+)/purge", node_purge)
+
+        # -- allocs / evals -------------------------------------------
+        def allocs_list(p, q, body, tok):
+            data, idx = blocking([TABLE_ALLOCS], q, srv.state.allocs)
+            return data, idx
+
+        def alloc_get(p, q, body, tok):
+            a = srv.state.alloc_by_id(p["id"])
+            if a is None:
+                raise HTTPError(404, f"alloc {p['id']} not found")
+            return a
+
+        def evals_list(p, q, body, tok):
+            data, idx = blocking([TABLE_EVALS], q, srv.state.evals)
+            return data, idx
+
+        def eval_get(p, q, body, tok):
+            e = srv.state.eval_by_id(p["id"])
+            if e is None:
+                raise HTTPError(404, f"eval {p['id']} not found")
+            return e
+
+        def eval_allocs(p, q, body, tok):
+            return srv.state.allocs_by_eval(p["id"])
+
+        route("GET", "/v1/allocations", allocs_list)
+        route("GET", "/v1/allocation/(?P<id>[^/]+)", alloc_get)
+        route("GET", "/v1/evaluations", evals_list)
+        route("GET", "/v1/evaluation/(?P<id>[^/]+)", eval_get)
+        route("GET", "/v1/evaluation/(?P<id>[^/]+)/allocations", eval_allocs)
+
+        # -- deployments ----------------------------------------------
+        def deployments_list(p, q, body, tok):
+            data, idx = blocking([TABLE_DEPLOYMENTS], q, srv.state.deployments)
+            return data, idx
+
+        def deployment_get(p, q, body, tok):
+            d = srv.state.deployment_by_id(p["id"])
+            if d is None:
+                raise HTTPError(404, f"deployment {p['id']} not found")
+            return d
+
+        def deployment_allocs(p, q, body, tok):
+            return srv.state.allocs_by_deployment(p["id"])
+
+        def deployment_promote(p, q, body, tok):
+            self.cluster.rpc_self(
+                "Deployment.promote",
+                {
+                    "deployment_id": p["id"],
+                    "groups": body.get("Groups"),
+                },
+            )
+            return {}
+
+        def deployment_pause(p, q, body, tok):
+            self.cluster.rpc_self(
+                "Deployment.pause",
+                {"deployment_id": p["id"], "pause": body.get("Pause", True)},
+            )
+            return {}
+
+        def deployment_fail(p, q, body, tok):
+            self.cluster.rpc_self(
+                "Deployment.fail", {"deployment_id": p["id"]}
+            )
+            return {}
+
+        route("GET", "/v1/deployments", deployments_list)
+        route("GET", "/v1/deployment/(?P<id>[^/]+)", deployment_get)
+        route(
+            "GET", "/v1/deployment/allocations/(?P<id>[^/]+)", deployment_allocs
+        )
+        route("PUT", "/v1/deployment/promote/(?P<id>[^/]+)", deployment_promote)
+        route("PUT", "/v1/deployment/pause/(?P<id>[^/]+)", deployment_pause)
+        route("PUT", "/v1/deployment/fail/(?P<id>[^/]+)", deployment_fail)
+
+        # -- status / agent -------------------------------------------
+        def status_leader(p, q, body, tok):
+            addr = self.cluster.raft.leader_addr()
+            return f"{addr[0]}:{addr[1]}" if addr else None
+
+        def status_peers(p, q, body, tok):
+            return self.cluster.rpc_self("Status.peers", {})
+
+        def agent_members(p, q, body, tok):
+            return [m.to_wire() for m in self.cluster.serf.members()]
+
+        def agent_self(p, q, body, tok):
+            return {
+                "member": self.cluster.serf.local.to_wire(),
+                "stats": {
+                    "leader": self.cluster.is_leader(),
+                    "raft_last_index": self.cluster.raft.last_index,
+                },
+            }
+
+        def agent_health(p, q, body, tok):
+            return {"server": {"ok": True}, "client": {"ok": self.client is not None}}
+
+        route("GET", "/v1/status/leader", status_leader)
+        route("GET", "/v1/status/peers", status_peers)
+        route("GET", "/v1/agent/members", agent_members)
+        route("GET", "/v1/agent/self", agent_self)
+        route("GET", "/v1/agent/health", agent_health)
+
+    # -- event stream (long-lived NDJSON response) ---------------------
+
+    def _serve_event_stream(self, handler, query) -> None:
+        topics: dict[str, list[str]] = {}
+        for t in query.get("topic", []):
+            if ":" in t:
+                topic, key = t.split(":", 1)
+            else:
+                topic, key = t, "*"
+            topics.setdefault(topic, []).append(key)
+        index = int(query.get("index", ["0"])[0])
+        ns = query.get("namespace", [""])[0]
+        sub = self.cluster.server.event_broker.subscribe(
+            topics or None, from_index=index, namespace=ns
+        )
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+        def write_chunk(data: bytes) -> None:
+            handler.wfile.write(f"{len(data):x}\r\n".encode())
+            handler.wfile.write(data + b"\r\n")
+            handler.wfile.flush()
+
+        try:
+            while True:
+                try:
+                    events = sub.next(timeout_s=10.0)
+                except SubscriptionClosedError:
+                    return
+                if not events:
+                    write_chunk(b"{}\n")  # heartbeat (reference sends {})
+                    continue
+                payload = {
+                    "Index": events[-1].index,
+                    "Events": [
+                        {
+                            "Topic": e.topic,
+                            "Type": e.type,
+                            "Key": e.key,
+                            "Namespace": e.namespace,
+                            "Index": e.index,
+                            "Payload": codec.to_wire(e.payload),
+                        }
+                        for e in events
+                    ],
+                }
+                write_chunk(json.dumps(payload, default=_json_default).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            sub.close()
+            try:
+                write_chunk(b"")
+            except OSError:
+                pass
+
+    # -- the handler class ---------------------------------------------
+
+    def _make_handler(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("http: " + fmt, *args)
+
+            def _dispatch(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                query = parse_qs(parsed.query)
+                token = self.headers.get("X-Nomad-Token", "")
+                try:
+                    if outer.acl_resolver is not None:
+                        outer.acl_resolver(method, parsed.path, token)
+                    if parsed.path == "/v1/event/stream":
+                        outer._serve_event_stream(self, query)
+                        return
+                    for m, pattern, fn in outer._routes:
+                        if m != method:
+                            continue
+                        match = pattern.match(parsed.path)
+                        if match is None:
+                            continue
+                        body = {}
+                        length = int(self.headers.get("Content-Length") or 0)
+                        if length:
+                            body = json.loads(self.rfile.read(length) or b"{}")
+                        result = fn(match.groupdict(), query, body, token)
+                        index = None
+                        if isinstance(result, tuple):
+                            result, index = result
+                        self._reply(200, codec.to_wire(result), index)
+                        return
+                    self._reply(404, {"error": f"no route {method} {parsed.path}"})
+                except HTTPError as e:
+                    self._reply(e.status, {"error": e.message})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except Exception as e:
+                    logger.exception("http handler failed")
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _reply(self, status: int, payload, index: Optional[int] = None):
+                data = json.dumps(payload, default=_json_default).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                if index is not None:
+                    self.send_header("X-Nomad-Index", str(index))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        return Handler
+
+
+def _parse_wait(raw: str) -> float:
+    """'5s' / '1m' / '500ms' / plain seconds (reference parses duration)."""
+    raw = raw.strip()
+    if not raw or raw == "0":
+        return 0.0
+    if raw.endswith("ms"):
+        return float(raw[:-2]) / 1000.0
+    if raw.endswith("s"):
+        return float(raw[:-1])
+    if raw.endswith("m"):
+        return float(raw[:-1]) * 60.0
+    return float(raw)

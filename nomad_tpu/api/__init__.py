@@ -1,0 +1,6 @@
+"""Python SDK over the HTTP API (reference: api/ Go SDK, 19k LoC —
+one resource group per class here like one file per resource there)."""
+
+from .client import APIError, NomadClient
+
+__all__ = ["APIError", "NomadClient"]

@@ -1,0 +1,319 @@
+"""Typed HTTP client.
+
+Reference: api/api.go (Client, QueryOptions/WriteOptions, blocking
+queries), api/jobs.go, api/nodes.go, api/allocations.go,
+api/evaluations.go, api/deployments.go, api/event_stream.go.
+
+Decodes codec wire payloads back into the shared typed structs, so
+`client.jobs.get("x")` returns a real Job dataclass, like the Go SDK's
+typed structs.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Iterator, Optional
+
+from .. import codec
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        self.status = status
+        super().__init__(f"HTTP {status}: {message}")
+
+
+class NomadClient:
+    def __init__(
+        self,
+        address: str = "http://127.0.0.1:4646",
+        token: str = "",
+        namespace: str = "default",
+        timeout_s: float = 35.0,
+    ) -> None:
+        self.address = address.rstrip("/")
+        self.token = token
+        self.namespace = namespace
+        self.timeout_s = timeout_s
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.deployments = Deployments(self)
+        self.agent = AgentAPI(self)
+        self.status = Status(self)
+
+    # -- plumbing ------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        params: Optional[dict] = None,
+        body=None,
+        raw: bool = False,
+        timeout_s: Optional[float] = None,
+    ):
+        params = {k: v for k, v in (params or {}).items() if v not in (None, "")}
+        url = self.address + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params, doseq=True)
+        data = None
+        if body is not None:
+            data = json.dumps(body, default=codec.json_default).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("X-Nomad-Token", self.token)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout_s or self.timeout_s
+            )
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise APIError(e.code, msg) from None
+        if raw:
+            return resp
+        payload = json.loads(resp.read() or b"null")
+        index = resp.headers.get("X-Nomad-Index")
+        decoded = codec.from_wire(payload)
+        if index is not None:
+            return decoded, int(index)
+        return decoded
+
+    def get(self, path, **kw):
+        return self._request("GET", path, **kw)
+
+    def put(self, path, body=None, **kw):
+        return self._request("PUT", path, body=body, **kw)
+
+    def delete(self, path, **kw):
+        return self._request("DELETE", path, **kw)
+
+
+class _Resource:
+    def __init__(self, c: NomadClient) -> None:
+        self.c = c
+
+
+class Jobs(_Resource):
+    def list(self, prefix: str = "", namespace: Optional[str] = None):
+        out = self.c.get(
+            "/v1/jobs",
+            params={
+                "prefix": prefix,
+                "namespace": namespace or self.c.namespace,
+            },
+        )
+        return out[0] if isinstance(out, tuple) else out
+
+    def register(self, job) -> str:
+        """Returns the eval id (reference api/jobs.go Register)."""
+        return self.c.put("/v1/jobs", body={"Job": codec.to_wire(job)})
+
+    def get(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/job/{job_id}",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def deregister(
+        self, job_id: str, purge: bool = False, namespace: Optional[str] = None
+    ) -> str:
+        return self.c.delete(
+            f"/v1/job/{job_id}",
+            params={
+                "purge": "true" if purge else "false",
+                "namespace": namespace or self.c.namespace,
+            },
+        )
+
+    def allocations(self, job_id: str, namespace: Optional[str] = None):
+        out = self.c.get(
+            f"/v1/job/{job_id}/allocations",
+            params={"namespace": namespace or self.c.namespace},
+        )
+        return out[0] if isinstance(out, tuple) else out
+
+    def evaluations(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/job/{job_id}/evaluations",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def summary(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/job/{job_id}/summary",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def versions(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/job/{job_id}/versions",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def revert(self, job_id: str, version: int, namespace: Optional[str] = None):
+        return self.c.put(
+            f"/v1/job/{job_id}/revert",
+            body={
+                "JobVersion": version,
+                "Namespace": namespace or self.c.namespace,
+            },
+        )
+
+    def dispatch(
+        self,
+        job_id: str,
+        meta: Optional[dict] = None,
+        payload: Optional[str] = None,
+        namespace: Optional[str] = None,
+    ):
+        return self.c.put(
+            f"/v1/job/{job_id}/dispatch",
+            params={"namespace": namespace or self.c.namespace},
+            body={"Meta": meta or {}, "Payload": payload},
+        )
+
+    def periodic_force(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.put(
+            f"/v1/job/{job_id}/periodic/force",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+
+class Nodes(_Resource):
+    def list(self, prefix: str = ""):
+        out = self.c.get("/v1/nodes", params={"prefix": prefix})
+        return out[0] if isinstance(out, tuple) else out
+
+    def get(self, node_id: str):
+        return self.c.get(f"/v1/node/{node_id}")
+
+    def allocations(self, node_id: str):
+        out = self.c.get(f"/v1/node/{node_id}/allocations")
+        return out[0] if isinstance(out, tuple) else out
+
+    def drain(self, node_id: str, spec=None, mark_eligible: bool = False):
+        return self.c.put(
+            f"/v1/node/{node_id}/drain",
+            body={
+                "DrainSpec": codec.to_wire(spec) if spec is not None else None,
+                "MarkEligible": mark_eligible,
+            },
+        )
+
+    def eligibility(self, node_id: str, eligible: bool):
+        return self.c.put(
+            f"/v1/node/{node_id}/eligibility",
+            body={"Eligibility": "eligible" if eligible else "ineligible"},
+        )
+
+    def purge(self, node_id: str):
+        return self.c.put(f"/v1/node/{node_id}/purge")
+
+
+class Allocations(_Resource):
+    def list(self):
+        out = self.c.get("/v1/allocations")
+        return out[0] if isinstance(out, tuple) else out
+
+    def get(self, alloc_id: str):
+        return self.c.get(f"/v1/allocation/{alloc_id}")
+
+
+class Evaluations(_Resource):
+    def list(self):
+        out = self.c.get("/v1/evaluations")
+        return out[0] if isinstance(out, tuple) else out
+
+    def get(self, eval_id: str):
+        return self.c.get(f"/v1/evaluation/{eval_id}")
+
+    def allocations(self, eval_id: str):
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations")
+
+
+class Deployments(_Resource):
+    def list(self):
+        out = self.c.get("/v1/deployments")
+        return out[0] if isinstance(out, tuple) else out
+
+    def get(self, deployment_id: str):
+        return self.c.get(f"/v1/deployment/{deployment_id}")
+
+    def allocations(self, deployment_id: str):
+        return self.c.get(f"/v1/deployment/allocations/{deployment_id}")
+
+    def promote(self, deployment_id: str, groups=None):
+        return self.c.put(
+            f"/v1/deployment/promote/{deployment_id}",
+            body={"Groups": groups},
+        )
+
+    def pause(self, deployment_id: str, pause: bool = True):
+        return self.c.put(
+            f"/v1/deployment/pause/{deployment_id}", body={"Pause": pause}
+        )
+
+    def fail(self, deployment_id: str):
+        return self.c.put(f"/v1/deployment/fail/{deployment_id}")
+
+
+class AgentAPI(_Resource):
+    def members(self):
+        return self.c.get("/v1/agent/members")
+
+    def self(self):
+        return self.c.get("/v1/agent/self")
+
+    def health(self):
+        return self.c.get("/v1/agent/health")
+
+
+class Status(_Resource):
+    def leader(self):
+        return self.c.get("/v1/status/leader")
+
+    def peers(self):
+        return self.c.get("/v1/status/peers")
+
+
+def event_stream(
+    client: NomadClient,
+    topics: Optional[dict] = None,
+    index: int = 0,
+    namespace: str = "",
+) -> Iterator[dict]:
+    """Generator over /v1/event/stream NDJSON frames (reference
+    api/event_stream.go). Yields {"Index": n, "Events": [...]} dicts with
+    decoded payloads; skips heartbeats."""
+    params: list[tuple[str, str]] = []
+    for topic, keys in (topics or {}).items():
+        for k in keys:
+            params.append(("topic", f"{topic}:{k}"))
+    if index:
+        params.append(("index", str(index)))
+    if namespace:
+        params.append(("namespace", namespace))
+    url = client.address + "/v1/event/stream"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+    req = urllib.request.Request(url)
+    if client.token:
+        req.add_header("X-Nomad-Token", client.token)
+    resp = urllib.request.urlopen(req)
+    for line in resp:
+        line = line.strip()
+        if not line or line == b"{}":
+            continue
+        frame = json.loads(line)
+        for ev in frame.get("Events", []):
+            ev["Payload"] = codec.from_wire(ev["Payload"])
+        yield frame

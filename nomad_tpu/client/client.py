@@ -83,7 +83,10 @@ class Client:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
-        self.heartbeat_ttl = self.rpc.register(self.node)
+        # Registration happens ON the heartbeat thread with retries
+        # (reference registerAndHeartbeat runs in a goroutine): agent boot
+        # must not block on servers that are still electing a leader.
+        self._registered = threading.Event()
         for target, name in (
             (self._heartbeat_loop, "client-heartbeat"),
             (self._watch_allocs, "client-watch"),
@@ -100,7 +103,17 @@ class Client:
 
     # -- loops ---------------------------------------------------------
 
+    def wait_registered(self, timeout_s: float = 15.0) -> bool:
+        return self._registered.wait(timeout_s)
+
     def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set() and not self._registered.is_set():
+            try:
+                self.heartbeat_ttl = self.rpc.register(self.node)
+                self._registered.set()
+            except Exception:
+                logger.debug("registration failed; retrying")
+                self._shutdown.wait(0.2)
         while not self._shutdown.is_set():
             # heartbeat at half the granted TTL (reference client.go:1606)
             self._shutdown.wait(max(self.heartbeat_ttl / 2, 0.5))

@@ -26,6 +26,7 @@ import msgpack
 _TYPE_KEY = "$t"
 _TUPLE_KEY = "$tuple"
 _MAP_KEY = "$map"  # dict with non-str keys: list of [k, v] pairs
+_BYTES_KEY = "$b64"  # JSON transports bytes base64-tagged (msgpack: native)
 
 _REGISTRY: dict[str, type] = {}
 
@@ -65,7 +66,12 @@ def to_wire(obj: Any) -> Any:
     if isinstance(obj, (list, set, frozenset)):
         return [to_wire(v) for v in obj]
     if isinstance(obj, dict):
-        if all(isinstance(k, str) for k in obj):
+        # A "$"-prefixed key in user data could collide with our tags
+        # ($t/$tuple/$map/$b64) — escape such dicts into the pair-list
+        # form, which decodes any keys verbatim.
+        if all(isinstance(k, str) for k in obj) and not any(
+            k.startswith("$") for k in obj
+        ):
             return {k: to_wire(v) for k, v in obj.items()}
         return {_MAP_KEY: [[to_wire(k), to_wire(v)] for k, v in obj.items()]}
     cls = type(obj)
@@ -96,6 +102,10 @@ def from_wire(data: Any) -> Any:
             return tuple(from_wire(v) for v in data[_TUPLE_KEY])
         if _MAP_KEY in data and len(data) == 1:
             return {from_wire(k): from_wire(v) for k, v in data[_MAP_KEY]}
+        if _BYTES_KEY in data and len(data) == 1:
+            import base64
+
+            return base64.b64decode(data[_BYTES_KEY])
         tname = data.get(_TYPE_KEY)
         if tname is None:
             return {k: from_wire(v) for k, v in data.items()}
@@ -121,6 +131,15 @@ def from_wire(data: Any) -> Any:
                     setattr(obj, f.name, f.default_factory())
         return obj
     raise TypeError(f"cannot decode wire value of type {type(data).__name__}")
+
+
+def json_default(o):
+    """json.dumps default for wire payloads: bytes ride base64-tagged."""
+    if isinstance(o, bytes):
+        import base64
+
+        return {_BYTES_KEY: base64.b64encode(o).decode()}
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
 
 
 def pack(obj: Any) -> bytes:

@@ -319,6 +319,12 @@ class ClusterServer:
     def addr(self) -> tuple[str, int]:
         return self.rpc.addr
 
+    def rpc_self(self, method: str, args):
+        """In-process RPC dispatch (no socket hop): runs the endpoint
+        locally, which itself forwards to the leader when needed — the
+        reference's server.RPC fast path."""
+        return self.rpc.dispatch_local(method, args)
+
     def is_leader(self) -> bool:
         return self.raft.is_leader()
 

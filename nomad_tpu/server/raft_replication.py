@@ -174,6 +174,10 @@ class RaftNode:
     # lifecycle
 
     def start(self) -> None:
+        # A deliberate single-node cluster needs no timeout dance: elect
+        # immediately (dev mode / tests would otherwise wait 1-2s).
+        if not self.peers and self.bootstrap_expect == 1:
+            self._start_election()
         t = threading.Thread(target=self._ticker, name=f"raft-tick-{self.node_id}", daemon=True)
         t.start()
         self._threads.append(t)

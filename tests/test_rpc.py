@@ -203,3 +203,10 @@ class TestStreaming:
         finally:
             pool.shutdown()
             server.shutdown()
+
+
+class TestCodecEscaping:
+    def test_dollar_key_user_dict_roundtrips(self):
+        """Reserved-tag collision: user data with $-keys must survive."""
+        v = {"$b64": "hello", "$t": "NotAType", "normal": 1}
+        assert codec.unpack(codec.pack(v)) == v
