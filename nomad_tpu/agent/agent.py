@@ -18,6 +18,30 @@ from ..server.cluster import ClusterRPC, ClusterServer
 logger = logging.getLogger("nomad_tpu.agent")
 
 
+class InProcessClusterRPC:
+    """Client→server verbs dispatched through the local ClusterServer's
+    forwarding endpoints (no socket hop; leader forwarding intact)."""
+
+    def __init__(self, cluster: ClusterServer) -> None:
+        self.cluster = cluster
+
+    def register(self, node) -> float:
+        return self.cluster.rpc_self("Node.register", {"node": node})
+
+    def heartbeat(self, node_id: str) -> float:
+        return self.cluster.rpc_self("Node.heartbeat", {"node_id": node_id})
+
+    def get_client_allocs(self, node_id: str, min_index: int, timeout_s: float):
+        resp = self.cluster.rpc_self(
+            "Node.get_client_allocs",
+            {"node_id": node_id, "min_index": min_index, "timeout_s": timeout_s},
+        )
+        return resp["allocs"], resp["index"]
+
+    def update_allocs(self, allocs) -> None:
+        self.cluster.rpc_self("Node.update_allocs", {"allocs": allocs})
+
+
 @dataclass
 class AgentConfig:
     """Reference: command/agent/config.go (subset; grows with features)."""
@@ -75,10 +99,12 @@ class Agent:
             )
         if config.client_enabled:
             if self.server is not None:
-                # co-located client: talk to our own server in-process
-                from ..client import ServerRPC
-
-                rpc = ServerRPC(self.server.server)
+                # Co-located client: in-process, but through the CLUSTER
+                # endpoints so writes forward to the leader — a client on
+                # a follower server agent must still register (binding
+                # ServerRPC to the local core server would NotLeaderError
+                # forever).
+                rpc = InProcessClusterRPC(self.server)
             else:
                 if not config.client_servers:
                     raise ValueError("client agent needs `servers` addresses")

@@ -158,20 +158,31 @@ class HTTPAgentServer:
 
         def job_revert(p, q, body, tok):
             ns = body.get("Namespace", "default")
-            return srv.job_revert(ns, p["id"], body["JobVersion"])
+            return self.cluster.rpc_self(
+                "Job.revert",
+                {"namespace": ns, "job_id": p["id"], "version": body["JobVersion"]},
+            )
 
         def job_dispatch(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return srv.job_dispatch(
-                ns,
-                p["id"],
-                meta=body.get("Meta") or {},
-                payload=body.get("Payload"),
+            payload = codec.from_wire(body.get("Payload"))
+            if isinstance(payload, str):
+                payload = payload.encode()
+            return self.cluster.rpc_self(
+                "Job.dispatch",
+                {
+                    "namespace": ns,
+                    "job_id": p["id"],
+                    "meta": body.get("Meta") or {},
+                    "payload": payload,
+                },
             )
 
         def job_periodic_force(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return srv.periodic.force_launch(ns, p["id"])
+            return self.cluster.rpc_self(
+                "Job.periodic_force", {"namespace": ns, "job_id": p["id"]}
+            )
 
         route("GET", "/v1/jobs", jobs_list)
         route("PUT", "/v1/jobs", jobs_register)
@@ -233,7 +244,7 @@ class HTTPAgentServer:
             return {}
 
         def node_purge(p, q, body, tok):
-            srv.raft_apply("node_deregister", p["id"])
+            self.cluster.rpc_self("Node.purge", {"node_id": p["id"]})
             return {}
 
         route("GET", "/v1/nodes", nodes_list)
@@ -422,6 +433,13 @@ class HTTPAgentServer:
                 parsed = urlparse(self.path)
                 query = parse_qs(parsed.query)
                 token = self.headers.get("X-Nomad-Token", "")
+                # Drain the body up front: on keep-alive connections an
+                # unread body (404 path, ACL reject) would desync the
+                # next request on the same socket.
+                raw_body = b""
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    raw_body = self.rfile.read(length)
                 try:
                     if outer.acl_resolver is not None:
                         outer.acl_resolver(method, parsed.path, token)
@@ -434,10 +452,7 @@ class HTTPAgentServer:
                         match = pattern.match(parsed.path)
                         if match is None:
                             continue
-                        body = {}
-                        length = int(self.headers.get("Content-Length") or 0)
-                        if length:
-                            body = json.loads(self.rfile.read(length) or b"{}")
+                        body = json.loads(raw_body or b"{}")
                         result = fn(match.groupdict(), query, body, token)
                         index = None
                         if isinstance(result, tuple):

@@ -55,6 +55,7 @@ class NomadClient:
         params: Optional[dict] = None,
         body=None,
         raw: bool = False,
+        with_index: bool = False,
         timeout_s: Optional[float] = None,
     ):
         params = {k: v for k, v in (params or {}).items() if v not in (None, "")}
@@ -83,12 +84,16 @@ class NomadClient:
         payload = json.loads(resp.read() or b"null")
         index = resp.headers.get("X-Nomad-Index")
         decoded = codec.from_wire(payload)
-        if index is not None:
-            return decoded, int(index)
+        if with_index:
+            return decoded, int(index) if index is not None else 0
         return decoded
 
     def get(self, path, **kw):
         return self._request("GET", path, **kw)
+
+    def get_with_index(self, path, **kw):
+        """Blocking-query form: returns (decoded, X-Nomad-Index)."""
+        return self._request("GET", path, with_index=True, **kw)
 
     def put(self, path, body=None, **kw):
         return self._request("PUT", path, body=body, **kw)
@@ -104,14 +109,13 @@ class _Resource:
 
 class Jobs(_Resource):
     def list(self, prefix: str = "", namespace: Optional[str] = None):
-        out = self.c.get(
+        return self.c.get(
             "/v1/jobs",
             params={
                 "prefix": prefix,
                 "namespace": namespace or self.c.namespace,
             },
         )
-        return out[0] if isinstance(out, tuple) else out
 
     def register(self, job) -> str:
         """Returns the eval id (reference api/jobs.go Register)."""
@@ -135,11 +139,10 @@ class Jobs(_Resource):
         )
 
     def allocations(self, job_id: str, namespace: Optional[str] = None):
-        out = self.c.get(
+        return self.c.get(
             f"/v1/job/{job_id}/allocations",
             params={"namespace": namespace or self.c.namespace},
         )
-        return out[0] if isinstance(out, tuple) else out
 
     def evaluations(self, job_id: str, namespace: Optional[str] = None):
         return self.c.get(
@@ -190,15 +193,13 @@ class Jobs(_Resource):
 
 class Nodes(_Resource):
     def list(self, prefix: str = ""):
-        out = self.c.get("/v1/nodes", params={"prefix": prefix})
-        return out[0] if isinstance(out, tuple) else out
+        return self.c.get("/v1/nodes", params={"prefix": prefix})
 
     def get(self, node_id: str):
         return self.c.get(f"/v1/node/{node_id}")
 
     def allocations(self, node_id: str):
-        out = self.c.get(f"/v1/node/{node_id}/allocations")
-        return out[0] if isinstance(out, tuple) else out
+        return self.c.get(f"/v1/node/{node_id}/allocations")
 
     def drain(self, node_id: str, spec=None, mark_eligible: bool = False):
         return self.c.put(
@@ -221,8 +222,7 @@ class Nodes(_Resource):
 
 class Allocations(_Resource):
     def list(self):
-        out = self.c.get("/v1/allocations")
-        return out[0] if isinstance(out, tuple) else out
+        return self.c.get("/v1/allocations")
 
     def get(self, alloc_id: str):
         return self.c.get(f"/v1/allocation/{alloc_id}")
@@ -230,8 +230,7 @@ class Allocations(_Resource):
 
 class Evaluations(_Resource):
     def list(self):
-        out = self.c.get("/v1/evaluations")
-        return out[0] if isinstance(out, tuple) else out
+        return self.c.get("/v1/evaluations")
 
     def get(self, eval_id: str):
         return self.c.get(f"/v1/evaluation/{eval_id}")
@@ -242,8 +241,7 @@ class Evaluations(_Resource):
 
 class Deployments(_Resource):
     def list(self):
-        out = self.c.get("/v1/deployments")
-        return out[0] if isinstance(out, tuple) else out
+        return self.c.get("/v1/deployments")
 
     def get(self, deployment_id: str):
         return self.c.get(f"/v1/deployment/{deployment_id}")

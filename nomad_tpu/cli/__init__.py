@@ -1,0 +1,5 @@
+"""CLI (reference: command/ — ~140 subcommands; the core set here)."""
+
+from .main import main
+
+__all__ = ["main"]

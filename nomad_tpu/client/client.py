@@ -77,6 +77,7 @@ class Client:
         self._pending_updates: dict[str, Allocation] = {}
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        self._registered = threading.Event()
         self._threads: list[threading.Thread] = []
         self.heartbeat_ttl = 10.0
 
@@ -86,7 +87,6 @@ class Client:
         # Registration happens ON the heartbeat thread with retries
         # (reference registerAndHeartbeat runs in a goroutine): agent boot
         # must not block on servers that are still electing a leader.
-        self._registered = threading.Event()
         for target, name in (
             (self._heartbeat_loop, "client-heartbeat"),
             (self._watch_allocs, "client-watch"),

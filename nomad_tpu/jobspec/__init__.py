@@ -1,0 +1,13 @@
+"""Jobspec DSL (reference: jobspec/ + jobspec2/)."""
+
+from .hcl import Body, HCLParseError, parse, parse_duration
+from .parse import JobspecError, parse_job
+
+__all__ = [
+    "Body",
+    "HCLParseError",
+    "JobspecError",
+    "parse",
+    "parse_duration",
+    "parse_job",
+]

@@ -1,0 +1,386 @@
+"""HCL2-subset parser (no third-party deps).
+
+Reference: jobspec2/ parses the job DSL with hashicorp/hcl/v2; this is a
+from-scratch subset covering what jobspecs actually use:
+
+  * attributes `key = expr` and blocks `type "label" ... { body }`
+  * strings with escapes and `${var.name}` interpolation, heredocs
+  * numbers, bools, null, lists, objects
+  * line (`#`, `//`) and block (`/* */`) comments
+  * `variable "name" { default = ... }` declarations with caller
+    overrides (the jobspec2 variables feature)
+
+Expressions are data-only: a `${...}` may reference `var.<name>` or
+`meta.<name>`-style dotted names resolved from the caller-supplied
+variable map. Function calls/conditionals are out of scope (jobspec2
+supports them; almost no real jobspec uses them).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+class HCLParseError(Exception):
+    def __init__(self, msg: str, line: int) -> None:
+        super().__init__(f"line {line}: {msg}")
+        self.line = line
+
+
+@dataclass
+class Attr:
+    key: str
+    value: Any
+    line: int
+
+
+@dataclass
+class Block:
+    type: str
+    labels: list[str]
+    body: "Body"
+    line: int
+
+
+@dataclass
+class Body:
+    items: list = field(default_factory=list)
+
+    def attrs(self) -> dict[str, Any]:
+        return {i.key: i.value for i in self.items if isinstance(i, Attr)}
+
+    def blocks(self, btype: Optional[str] = None) -> list[Block]:
+        out = [i for i in self.items if isinstance(i, Block)]
+        if btype is not None:
+            out = [b for b in out if b.type == btype]
+        return out
+
+    def block(self, btype: str) -> Optional[Block]:
+        bs = self.blocks(btype)
+        return bs[0] if bs else None
+
+
+# Sentinel for `${...}` references resolved at evaluation time.
+@dataclass
+class Ref:
+    path: str  # e.g. "var.region"
+    line: int
+
+
+@dataclass
+class Template:
+    """A string with interpolation parts: list of str | Ref."""
+
+    parts: list
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>[ \t\r]+)
+  | (?P<comment>\#[^\n]*|//[^\n]*|/\*.*?\*/)
+  | (?P<nl>\n)
+  | (?P<heredoc><<-?(?P<htag>[A-Za-z_][A-Za-z0-9_]*)\n)
+  | (?P<num>-?\d+(\.\d+)?(?![A-Za-z_]))
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.-]*)
+  | (?P<string>")
+  | (?P<punct>[{}\[\]=,:()])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+class _Lexer:
+    def __init__(self, src: str) -> None:
+        self.src = src
+        self.pos = 0
+        self.line = 1
+        self.tokens: list[tuple[str, Any, int]] = []
+        self._lex()
+        self.i = 0
+
+    def _lex(self) -> None:
+        src = self.src
+        while self.pos < len(src):
+            m = _TOKEN_RE.match(src, self.pos)
+            if m is None:
+                raise HCLParseError(
+                    f"unexpected character {src[self.pos]!r}", self.line
+                )
+            kind = m.lastgroup
+            if kind == "htag":
+                kind = "heredoc"
+            text = m.group(0)
+            if kind == "ws":
+                pass
+            elif kind == "comment":
+                self.line += text.count("\n")
+            elif kind == "nl":
+                self.tokens.append(("nl", None, self.line))
+                self.line += 1
+            elif kind == "heredoc":
+                self.pos = m.end()
+                self._lex_heredoc(m.group("htag"), text.startswith("<<-"))
+                continue
+            elif kind == "num":
+                n = float(text) if "." in text else int(text)
+                self.tokens.append(("num", n, self.line))
+            elif kind == "ident":
+                self.tokens.append(("ident", text, self.line))
+            elif kind == "string":
+                self.pos = m.end()
+                self._lex_string()
+                continue
+            else:
+                self.tokens.append(("punct", text, self.line))
+            self.pos = m.end()
+        self.tokens.append(("eof", None, self.line))
+
+    def _lex_heredoc(self, tag: str, indent: bool) -> None:
+        self.line += 1
+        lines = []
+        while True:
+            end = self.src.find("\n", self.pos)
+            if end == -1:
+                raise HCLParseError(f"unterminated heredoc {tag}", self.line)
+            ln = self.src[self.pos : end]
+            self.pos = end + 1
+            self.line += 1
+            if ln.strip() == tag:
+                break
+            lines.append(ln)
+        if indent and lines:
+            pad = min(
+                (len(l) - len(l.lstrip()) for l in lines if l.strip()),
+                default=0,
+            )
+            lines = [l[pad:] for l in lines]
+        self.tokens.append(("str", "\n".join(lines) + "\n", self.line))
+
+    def _lex_string(self) -> None:
+        """From after the opening quote: handle escapes + ${...}."""
+        parts: list = []
+        buf: list[str] = []
+        src = self.src
+        while True:
+            if self.pos >= len(src):
+                raise HCLParseError("unterminated string", self.line)
+            ch = src[self.pos]
+            if ch == '"':
+                self.pos += 1
+                break
+            if ch == "\\":
+                esc = src[self.pos + 1 : self.pos + 2]
+                buf.append(
+                    {"n": "\n", "t": "\t", '"': '"', "\\": "\\"}.get(esc, esc)
+                )
+                self.pos += 2
+                continue
+            if ch == "$" and src[self.pos + 1 : self.pos + 2] == "{":
+                end = src.find("}", self.pos)
+                if end == -1:
+                    raise HCLParseError("unterminated interpolation", self.line)
+                expr = src[self.pos + 2 : end].strip()
+                if buf:
+                    parts.append("".join(buf))
+                    buf = []
+                parts.append(Ref(expr, self.line))
+                self.pos = end + 1
+                continue
+            if ch == "\n":
+                self.line += 1
+            buf.append(ch)
+            self.pos += 1
+        if buf or not parts:
+            parts.append("".join(buf))
+        if len(parts) == 1 and isinstance(parts[0], str):
+            self.tokens.append(("str", parts[0], self.line))
+        else:
+            self.tokens.append(("str", Template(parts), self.line))
+
+    # -- token stream --------------------------------------------------
+
+    def peek(self, skip_nl: bool = True):
+        i = self.i
+        while skip_nl and self.tokens[i][0] == "nl":
+            i += 1
+        return self.tokens[i]
+
+    def next(self, skip_nl: bool = True):
+        while skip_nl and self.tokens[self.i][0] == "nl":
+            self.i += 1
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def expect_punct(self, p: str):
+        kind, val, line = self.next()
+        if kind != "punct" or val != p:
+            raise HCLParseError(f"expected {p!r}, got {val!r}", line)
+
+
+def _parse_body(lx: _Lexer, outermost: bool = False) -> Body:
+    body = Body()
+    while True:
+        kind, val, line = lx.peek()
+        if kind == "eof":
+            if not outermost:
+                raise HCLParseError("unexpected EOF in block", line)
+            return body
+        if kind == "punct" and val == "}":
+            lx.next()
+            return body
+        if kind != "ident" and kind != "str":
+            raise HCLParseError(f"expected identifier, got {val!r}", line)
+        name = lx.next()[1]
+        # attribute or block?
+        kind2, val2, line2 = lx.peek()
+        if kind2 == "punct" and val2 == "=":
+            lx.next()
+            body.items.append(Attr(name, _parse_expr(lx), line))
+            continue
+        labels: list[str] = []
+        while True:
+            kind2, val2, line2 = lx.peek()
+            if kind2 in ("str", "ident") and not (
+                kind2 == "punct"
+            ):
+                labels.append(lx.next()[1])
+                continue
+            break
+        lx.expect_punct("{")
+        body.items.append(Block(name, labels, _parse_body(lx), line))
+
+
+def _parse_expr(lx: _Lexer):
+    kind, val, line = lx.next()
+    if kind in ("num", "str"):
+        return val
+    if kind == "ident":
+        if val == "true":
+            return True
+        if val == "false":
+            return False
+        if val == "null":
+            return None
+        return Ref(val, line)  # bare reference, e.g. var.count
+    if kind == "punct" and val == "[":
+        items = []
+        while True:
+            k, v, l = lx.peek()
+            if k == "punct" and v == "]":
+                lx.next()
+                return items
+            items.append(_parse_expr(lx))
+            k, v, l = lx.peek()
+            if k == "punct" and v == ",":
+                lx.next()
+    if kind == "punct" and val == "{":
+        obj = {}
+        while True:
+            k, v, l = lx.peek()
+            if k == "punct" and v == "}":
+                lx.next()
+                return obj
+            key = lx.next()
+            if key[0] not in ("ident", "str"):
+                raise HCLParseError(f"bad object key {key[1]!r}", l)
+            sep = lx.next()
+            if sep[0] != "punct" or sep[1] not in ("=", ":"):
+                raise HCLParseError("expected = or : in object", l)
+            obj[key[1]] = _parse_expr(lx)
+            k, v, l = lx.peek()
+            if k == "punct" and v == ",":
+                lx.next()
+    raise HCLParseError(f"unexpected token {val!r}", line)
+
+
+def _resolve(value, variables: dict):
+    """Evaluate Refs/Templates against the variable map. Non-`var.`
+    references (`${attr.kernel.name}`, `${node.datacenter}`, `${meta.x}`,
+    `${env "X"}`-style) are RUNTIME interpolations — the scheduler and
+    taskenv resolve them later — so they pass through as literal
+    `${...}` text, exactly like the reference jobspec."""
+    if isinstance(value, Ref):
+        return _lookup(value.path, variables, value.line)
+    if isinstance(value, Template):
+        out = []
+        for p in value.parts:
+            if isinstance(p, Ref):
+                v = _lookup(p.path, variables, p.line)
+                out.append(v if isinstance(v, str) else str(v))
+            else:
+                out.append(p)
+        return "".join(out)
+    if isinstance(value, list):
+        return [_resolve(v, variables) for v in value]
+    if isinstance(value, dict):
+        return {k: _resolve(v, variables) for k, v in value.items()}
+    return value
+
+
+def _lookup(path: str, variables: dict, line: int):
+    parts = path.split(".")
+    if parts[0] != "var":
+        return "${" + path + "}"  # runtime interpolation: pass through
+    parts = parts[1:]
+    cur: Any = variables
+    for p in parts:
+        if isinstance(cur, dict) and p in cur:
+            cur = cur[p]
+        else:
+            raise HCLParseError(f"unknown variable {path!r}", line)
+    return cur
+
+
+def parse(src: str, variables: Optional[dict] = None) -> Body:
+    """Parse HCL source; resolve `variable` blocks + interpolation."""
+    lx = _Lexer(src)
+    body = _parse_body(lx, outermost=True)
+    # collect variable defaults (jobspec2 Variables)
+    var_map: dict[str, Any] = {}
+    rest = Body()
+    for item in body.items:
+        if isinstance(item, Block) and item.type == "variable":
+            name = item.labels[0] if item.labels else ""
+            var_map[name] = _resolve(item.body.attrs().get("default"), {})
+        else:
+            rest.items.append(item)
+    var_map.update(variables or {})
+    return _resolve_body(rest, var_map)
+
+
+def _resolve_body(body: Body, variables: dict) -> Body:
+    out = Body()
+    for item in body.items:
+        if isinstance(item, Attr):
+            out.items.append(
+                Attr(item.key, _resolve(item.value, variables), item.line)
+            )
+        else:
+            out.items.append(
+                Block(
+                    item.type,
+                    [
+                        _resolve(l, variables) if not isinstance(l, str) else l
+                        for l in item.labels
+                    ],
+                    _resolve_body(item.body, variables),
+                    item.line,
+                )
+            )
+    return out
+
+
+def parse_duration(v) -> float:
+    """'30s' / '5m' / '1h' / '250ms' / bare number → seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h|d)?", s)
+    if m is None:
+        raise ValueError(f"bad duration {v!r}")
+    n = float(m.group(1))
+    unit = m.group(2) or "s"
+    return n * {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[unit]

@@ -91,6 +91,36 @@ class JobEndpoint(_Forwarder):
             args["namespace"], args["job_id"]
         )
 
+    def revert(self, args):
+        return self._forward(
+            "Job.revert",
+            args,
+            lambda a: self.cs.server.job_revert(
+                a["namespace"], a["job_id"], a["version"]
+            ),
+        )
+
+    def dispatch(self, args):
+        return self._forward(
+            "Job.dispatch",
+            args,
+            lambda a: self.cs.server.job_dispatch(
+                a["namespace"],
+                a["job_id"],
+                payload=a.get("payload") or b"",
+                meta=a.get("meta") or {},
+            ),
+        )
+
+    def periodic_force(self, args):
+        return self._forward(
+            "Job.periodic_force",
+            args,
+            lambda a: self.cs.server.periodic.force_launch(
+                a["namespace"], a["job_id"]
+            ),
+        )
+
 
 class NodeEndpoint(_Forwarder):
     def register(self, args):
@@ -152,6 +182,13 @@ class NodeEndpoint(_Forwarder):
 
     def list(self, args):
         return self.cs.server.state.nodes()
+
+    def purge(self, args):
+        return self._forward(
+            "Node.purge",
+            args,
+            lambda a: self.cs.server.raft_apply("node_deregister", a["node_id"]),
+        )
 
 
 class EvalEndpoint(_Forwarder):

@@ -128,7 +128,6 @@ class TestHTTPStatus:
 
 class TestBlockingQueries:
     def test_blocking_job_list_unblocks_on_register(self, agent, api):
-        out = api.c_get_index() if hasattr(api, "c_get_index") else None
         # initial non-blocking fetch for the index
         _, idx = api.get_raw_jobs()
         results = {}
@@ -179,7 +178,7 @@ def _get_raw_jobs(self, index=None, wait=None):
         params["index"] = str(index)
     if wait is not None:
         params["wait"] = wait
-    return self.get("/v1/jobs", params=params)
+    return self.get_with_index("/v1/jobs", params=params)
 
 
 NomadClient.get_raw_jobs = _get_raw_jobs

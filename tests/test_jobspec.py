@@ -1,0 +1,319 @@
+"""Jobspec HCL parser tests.
+
+Reference analog: jobspec/parse_test.go (table of .hcl fixtures →
+expected Job structs).
+"""
+
+import pytest
+
+from nomad_tpu.jobspec import HCLParseError, JobspecError, parse_duration, parse_job
+
+FULL_SPEC = """
+# a fairly complete service jobspec
+variable "dc" {
+  default = "dc1"
+}
+
+job "web-app" {
+  region      = "global"
+  datacenters = [var.dc, "dc2"]
+  type        = "service"
+  priority    = 70
+
+  meta {
+    owner = "team-web"
+  }
+
+  constraint {
+    attribute = "${attr.kernel.name}"
+    value     = "linux"
+  }
+
+  update {
+    max_parallel      = 2
+    canary            = 1
+    auto_revert       = true
+    min_healthy_time  = "15s"
+    healthy_deadline  = "3m"
+  }
+
+  spread {
+    attribute = "${node.datacenter}"
+    weight    = 60
+    target "dc1" {
+      percent = 70
+    }
+  }
+
+  group "frontend" {
+    count = 3
+
+    restart {
+      attempts = 3
+      interval = "30m"
+      delay    = "10s"
+      mode     = "fail"
+    }
+
+    reschedule {
+      delay          = "5s"
+      delay_function = "exponential"
+      unlimited      = true
+    }
+
+    migrate {
+      max_parallel = 1
+    }
+
+    ephemeral_disk {
+      size = 500
+    }
+
+    network {
+      mode = "host"
+      port "http" {
+        to = 8080
+      }
+      port "admin" {
+        static = 9090
+      }
+    }
+
+    volume "data" {
+      type      = "host"
+      source    = "shared-data"
+      read_only = true
+    }
+
+    task "server" {
+      driver = "rawexec"
+
+      config {
+        command = "/bin/server"
+        args    = ["-port", "8080"]
+      }
+
+      env {
+        PORT   = "8080"
+        REGION = var.dc
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+        device "tpu" {
+          count = 1
+        }
+      }
+
+      logs {
+        max_files     = 5
+        max_file_size = 20
+      }
+
+      template {
+        data        = <<EOF
+server {
+  port = {{ env "PORT" }}
+}
+EOF
+        destination = "local/conf.d/server.conf"
+        change_mode = "restart"
+      }
+
+      artifact {
+        source      = "https://example.com/app.tar.gz"
+        destination = "local/app"
+      }
+
+      service {
+        name = "web"
+        port = "http"
+        tags = ["frontend", "v1"]
+        check {
+          type     = "http"
+          path     = "/health"
+          interval = "10s"
+          timeout  = "2s"
+        }
+      }
+
+      kill_timeout = "20s"
+    }
+
+    task "sidecar" {
+      driver = "mock"
+      lifecycle {
+        hook    = "prestart"
+        sidecar = true
+      }
+    }
+  }
+}
+"""
+
+
+class TestFullSpec:
+    def test_parse_full(self):
+        job = parse_job(FULL_SPEC)
+        assert job.id == "web-app"
+        assert job.priority == 70
+        assert job.datacenters == ["dc1", "dc2"]
+        assert job.meta["owner"] == "team-web"
+        assert job.constraints[0].ltarget == "${attr.kernel.name}"
+        assert job.constraints[0].rtarget == "linux"
+        assert job.update.canary == 1
+        assert job.update.auto_revert is True
+        assert job.update.min_healthy_time_s == 15.0
+        assert job.update.healthy_deadline_s == 180.0
+        assert job.spreads[0].weight == 60
+        assert job.spreads[0].targets[0].value == "dc1"
+        assert job.spreads[0].targets[0].percent == 70
+
+        tg = job.task_groups[0]
+        assert tg.name == "frontend" and tg.count == 3
+        assert tg.restart_policy.attempts == 3
+        assert tg.restart_policy.interval_s == 1800.0
+        assert tg.reschedule_policy.delay_s == 5.0
+        assert tg.migrate.max_parallel == 1
+        assert tg.ephemeral_disk.size_mb == 500
+        net = tg.networks[0]
+        assert [p.label for p in net.dynamic_ports] == ["http"]
+        assert net.dynamic_ports[0].to == 8080
+        assert [p.label for p in net.reserved_ports] == ["admin"]
+        assert net.reserved_ports[0].value == 9090
+        assert tg.volumes["data"].source == "shared-data"
+        assert tg.volumes["data"].read_only is True
+
+        server = tg.tasks[0]
+        assert server.driver == "rawexec"
+        assert server.config["command"] == "/bin/server"
+        assert server.config["args"] == ["-port", "8080"]
+        assert server.env == {"PORT": "8080", "REGION": "dc1"}
+        assert server.resources.cpu == 500
+        assert server.resources.memory_mb == 256
+        assert server.resources.devices[0].name == "tpu"
+        assert server.log_config.max_files == 5
+        assert "port = {{ env" in server.templates[0].embedded_tmpl
+        assert server.artifacts[0].getter_source.endswith("app.tar.gz")
+        svc = server.services[0]
+        assert svc.name == "web" and svc.tags == ["frontend", "v1"]
+        assert svc.checks[0]["interval_s"] == 10.0
+        assert server.kill_timeout_s == 20.0
+
+        sidecar = tg.tasks[1]
+        assert sidecar.lifecycle.hook == "prestart"
+        assert sidecar.lifecycle.sidecar is True
+
+    def test_variable_override(self):
+        job = parse_job(FULL_SPEC, variables={"dc": "dc9"})
+        assert job.datacenters[0] == "dc9"
+        assert job.task_groups[0].tasks[0].env["REGION"] == "dc9"
+
+    def test_parsed_job_validates_and_runs_through_scheduler(self):
+        from nomad_tpu import mock
+        from nomad_tpu.testing import Harness
+
+        job = parse_job(FULL_SPEC)
+        job.task_groups[0].tasks[0].driver = "mock"
+        job.task_groups[0].tasks[0].resources.devices = []
+        job.canonicalize()
+        job.validate()
+        h = Harness()
+        for _ in range(4):
+            n = mock.node()
+            h.state.upsert_node(h.next_index(), n)
+        h.state.upsert_job(h.next_index(), job)
+        ev = mock.eval_for_job(job)
+        h.process("service", ev)
+        assert h.plans, "parsed job should produce a plan"
+
+
+class TestSmallSpecs:
+    def test_batch_with_periodic(self):
+        job = parse_job(
+            """
+job "cleanup" {
+  type = "batch"
+  periodic {
+    cron             = "*/15 * * * *"
+    prohibit_overlap = true
+  }
+  group "g" {
+    task "t" {
+      driver = "mock"
+    }
+  }
+}
+"""
+        )
+        assert job.type == "batch"
+        assert job.periodic.spec == "*/15 * * * *"
+        assert job.periodic.prohibit_overlap is True
+
+    def test_parameterized(self):
+        job = parse_job(
+            """
+job "dispatcher" {
+  type = "batch"
+  parameterized {
+    payload       = "required"
+    meta_required = ["target"]
+  }
+  group "g" {
+    task "t" {
+      driver = "mock"
+    }
+  }
+}
+"""
+        )
+        assert job.parameterized.payload == "required"
+        assert job.parameterized.meta_required == ["target"]
+
+    def test_task_directly_under_job(self):
+        job = parse_job(
+            """
+job "simple" {
+  task "only" {
+    driver = "mock"
+  }
+}
+"""
+        )
+        assert job.task_groups[0].name == "simple"
+        assert job.task_groups[0].tasks[0].name == "only"
+
+    def test_distinct_hosts_sugar(self):
+        job = parse_job(
+            """
+job "d" {
+  constraint {
+    distinct_hosts = true
+  }
+  group "g" {
+    task "t" { driver = "mock" }
+  }
+}
+"""
+        )
+        assert job.constraints[0].operand == "distinct_hosts"
+
+    def test_errors(self):
+        with pytest.raises(JobspecError):
+            parse_job('job "empty" {}')
+        with pytest.raises(HCLParseError):
+            parse_job('job "bad" { count = }')
+        with pytest.raises(HCLParseError):
+            parse_job('job "x" { dc = var.missing \n group "g" { task "t" {driver="mock"} } }')
+
+
+class TestDuration:
+    def test_parse_duration(self):
+        assert parse_duration("30s") == 30.0
+        assert parse_duration("5m") == 300.0
+        assert parse_duration("2h") == 7200.0
+        assert parse_duration("250ms") == 0.25
+        assert parse_duration(45) == 45.0
+        with pytest.raises(ValueError):
+            parse_duration("nope")
