@@ -1,0 +1,22 @@
+"""ACL system (reference: acl/ + nomad/acl.go)."""
+
+from .acl import ACL, ACLError, compile_policies
+from .policy import (
+    CAP_DENY,
+    NAMESPACE_CAPABILITIES,
+    Policy,
+    parse_policy,
+)
+from .structs import ACLPolicy, ACLToken
+
+__all__ = [
+    "ACL",
+    "ACLError",
+    "ACLPolicy",
+    "ACLToken",
+    "CAP_DENY",
+    "NAMESPACE_CAPABILITIES",
+    "Policy",
+    "compile_policies",
+    "parse_policy",
+]

@@ -1,0 +1,132 @@
+"""HTTP-route → ACL capability enforcement.
+
+Reference: each endpoint in nomad/ resolves the token and checks the
+specific capability (e.g. nomad/job_endpoint.go Register checks
+NamespaceValidator(acl.NamespaceCapabilitySubmitJob)). Here the mapping
+lives in one table keyed on route shape, applied by the HTTP layer
+before dispatch.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from .acl import ACL
+from .policy import (
+    CAP_DISPATCH_JOB,
+    CAP_LIST_JOBS,
+    CAP_READ_JOB,
+    CAP_SUBMIT_JOB,
+)
+
+
+class AuthError(Exception):
+    def __init__(self, status: int, message: str) -> None:
+        self.status = status
+        self.message = message
+        super().__init__(message)
+
+
+_NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("GET", re.compile(r"^/v1/jobs$"), CAP_LIST_JOBS),
+    ("PUT", re.compile(r"^/v1/jobs$"), CAP_SUBMIT_JOB),
+    ("POST", re.compile(r"^/v1/jobs$"), CAP_SUBMIT_JOB),
+    ("GET", re.compile(r"^/v1/job/[^/]+(/.*)?$"), CAP_READ_JOB),
+    ("DELETE", re.compile(r"^/v1/job/[^/]+$"), CAP_SUBMIT_JOB),
+    ("PUT", re.compile(r"^/v1/job/[^/]+/dispatch$"), CAP_DISPATCH_JOB),
+    ("POST", re.compile(r"^/v1/job/[^/]+/dispatch$"), CAP_DISPATCH_JOB),
+    ("PUT", re.compile(r"^/v1/job/[^/]+/.*$"), CAP_SUBMIT_JOB),
+    ("GET", re.compile(r"^/v1/allocations$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/allocation/.*$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/evaluations$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/evaluation/.*$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/deployments$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/deployment/.*$"), CAP_READ_JOB),
+    ("PUT", re.compile(r"^/v1/deployment/.*$"), CAP_SUBMIT_JOB),
+    ("GET", re.compile(r"^/v1/event/stream$"), CAP_READ_JOB),
+]
+
+_NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]
+_NODE_WRITE = [("PUT", re.compile(r"^/v1/node/.*$")), ("POST", re.compile(r"^/v1/node/.*$"))]
+_AGENT_READ = [("GET", re.compile(r"^/v1/agent/.*$"))]
+
+
+def make_http_resolver(server, enabled: bool = True):
+    """Returns resolver(method, path, token_secret, query) raising
+    AuthError on deny. `server` is the core Server (owns state +
+    resolve_token)."""
+
+    def resolver(
+        method: str, path: str, secret: str, query: dict, body: bytes = b""
+    ) -> None:
+        if not enabled:
+            return
+        # Status endpoints stay open (cluster plumbing, like the
+        # reference's unauthenticated Status.Ping/Leader).
+        if path.startswith("/v1/status/"):
+            return
+        # Bootstrap is the chicken-and-egg exception.
+        if path == "/v1/acl/bootstrap":
+            return
+        try:
+            acl: Optional[ACL] = server.resolve_token(secret)
+        except PermissionError:
+            raise AuthError(401, "ACL token not found")
+        if path == "/v1/acl/token/self":
+            if acl is None:
+                raise AuthError(401, "missing ACL token")
+            return
+        if path.startswith("/v1/acl/"):
+            if acl is None or not acl.is_management():
+                raise AuthError(403, "management token required")
+            return
+        if acl is None:
+            # anonymous: deny by default (no anonymous policy support yet)
+            raise AuthError(401, "missing ACL token")
+        if acl.is_management():
+            return
+        ns = query.get("namespace", ["default"])[0]
+        # Job registration: the namespace that matters is the one in the
+        # JOB BODY (that's what the handler registers into) — checking
+        # only the query namespace would let a default-scoped token write
+        # into any namespace.
+        if path == "/v1/jobs" and method in ("PUT", "POST") and body:
+            import json as _json
+
+            try:
+                job = _json.loads(body).get("Job") or {}
+                ns = job.get("namespace") or ns
+            except Exception:
+                pass
+        if path == "/v1/event/stream":
+            # "*" streams every namespace: management only.
+            if ns == "*":
+                raise AuthError(403, "all-namespace stream requires management")
+        for m, pat, cap in _NS_ROUTES:
+            if m == method and pat.match(path):
+                if not acl.allow_namespace_op(ns, cap):
+                    raise AuthError(
+                        403, f"missing namespace capability {cap!r}"
+                    )
+                return
+        for m, pat in _NODE_WRITE:
+            if m == method and pat.match(path):
+                if not acl.allow_node_write():
+                    raise AuthError(403, "node write denied")
+                return
+        for m, pat in _NODE_READ:
+            if m == method and pat.match(path):
+                if not acl.allow_node_read():
+                    raise AuthError(403, "node read denied")
+                return
+        for m, pat in _AGENT_READ:
+            if m == method and pat.match(path):
+                if not acl.allow_agent_read():
+                    raise AuthError(403, "agent read denied")
+                return
+        # Unmapped route under enforcement: require management (safe
+        # default — new routes must be classified to be non-management).
+        raise AuthError(403, "permission denied")
+
+    return resolver

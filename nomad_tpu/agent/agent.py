@@ -66,6 +66,8 @@ class AgentConfig:
     use_tpu_batch_worker: bool = False
     # retry_join seeds (serf)
     server_join: list = field(default_factory=list)
+    # acl stanza
+    acl_enabled: bool = False
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -118,11 +120,17 @@ class Agent:
         if self.server is not None:
             from .http import HTTPAgentServer
 
+            resolver = None
+            if config.acl_enabled:
+                from ..acl.enforce import make_http_resolver
+
+                resolver = make_http_resolver(self.server.server)
             self.http = HTTPAgentServer(
                 self.server,
                 client=self.client,
                 host=config.bind_addr,
                 port=config.http_port,
+                acl_resolver=resolver,
             )
 
     def start(self) -> None:

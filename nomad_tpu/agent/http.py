@@ -354,6 +354,79 @@ class HTTPAgentServer:
         def agent_health(p, q, body, tok):
             return {"server": {"ok": True}, "client": {"ok": self.client is not None}}
 
+        # -- acl -------------------------------------------------------
+        def acl_bootstrap(p, q, body, tok):
+            return self.cluster.rpc_self("ACL.bootstrap", {})
+
+        def acl_policies(p, q, body, tok):
+            return self.cluster.rpc_self("ACL.policy_list", {})
+
+        def acl_policy_get(p, q, body, tok):
+            pol = self.cluster.rpc_self("ACL.policy_get", {"name": p["name"]})
+            if pol is None:
+                raise HTTPError(404, f"policy {p['name']} not found")
+            return pol
+
+        def acl_policy_put(p, q, body, tok):
+            from ..acl import ACLPolicy
+
+            pol = ACLPolicy(
+                name=p["name"],
+                description=body.get("Description", ""),
+                rules=body.get("Rules", ""),
+            )
+            self.cluster.rpc_self("ACL.policy_upsert", {"policies": [pol]})
+            return {}
+
+        def acl_policy_delete(p, q, body, tok):
+            self.cluster.rpc_self("ACL.policy_delete", {"names": [p["name"]]})
+            return {}
+
+        def acl_tokens(p, q, body, tok):
+            return self.cluster.rpc_self("ACL.token_list", {})
+
+        def acl_token_put(p, q, body, tok):
+            from ..acl import ACLToken
+
+            t = ACLToken(
+                name=body.get("Name", ""),
+                type=body.get("Type", "client"),
+                policies=body.get("Policies") or [],
+            )
+            return self.cluster.rpc_self("ACL.token_create", {"token": t})
+
+        def acl_token_get(p, q, body, tok):
+            t = self.cluster.rpc_self(
+                "ACL.token_get", {"accessor_id": p["id"]}
+            )
+            if t is None:
+                raise HTTPError(404, f"token {p['id']} not found")
+            return t
+
+        def acl_token_delete(p, q, body, tok):
+            self.cluster.rpc_self(
+                "ACL.token_delete", {"accessor_ids": [p["id"]]}
+            )
+            return {}
+
+        def acl_token_self(p, q, body, tok):
+            t = self.cluster.server.state.acl_token_by_secret(tok)
+            if t is None:
+                raise HTTPError(404, "token not found")
+            return t
+
+        route("PUT", "/v1/acl/bootstrap", acl_bootstrap)
+        route("POST", "/v1/acl/bootstrap", acl_bootstrap)
+        route("GET", "/v1/acl/policies", acl_policies)
+        route("GET", "/v1/acl/policy/(?P<name>[^/]+)", acl_policy_get)
+        route("PUT", "/v1/acl/policy/(?P<name>[^/]+)", acl_policy_put)
+        route("DELETE", "/v1/acl/policy/(?P<name>[^/]+)", acl_policy_delete)
+        route("GET", "/v1/acl/tokens", acl_tokens)
+        route("PUT", "/v1/acl/token", acl_token_put)
+        route("GET", "/v1/acl/token/self", acl_token_self)
+        route("GET", "/v1/acl/token/(?P<id>[^/]+)", acl_token_get)
+        route("DELETE", "/v1/acl/token/(?P<id>[^/]+)", acl_token_delete)
+
         route("GET", "/v1/status/leader", status_leader)
         route("GET", "/v1/status/peers", status_peers)
         route("GET", "/v1/agent/members", agent_members)
@@ -371,7 +444,16 @@ class HTTPAgentServer:
                 topic, key = t, "*"
             topics.setdefault(topic, []).append(key)
         index = int(query.get("index", ["0"])[0])
-        ns = query.get("namespace", [""])[0]
+        # Namespace defaults differ by mode: with ACLs enforced the stream
+        # is scoped to one namespace ("default" unless asked); "*" (all)
+        # is management-only and checked by the resolver. Without ACLs,
+        # default to everything — the convenient open-mode behavior.
+        if self.acl_resolver is not None:
+            ns = query.get("namespace", ["default"])[0]
+            if ns == "*":
+                ns = ""
+        else:
+            ns = query.get("namespace", [""])[0]
         sub = self.cluster.server.event_broker.subscribe(
             topics or None, from_index=index, namespace=ns
         )
@@ -442,7 +524,14 @@ class HTTPAgentServer:
                     raw_body = self.rfile.read(length)
                 try:
                     if outer.acl_resolver is not None:
-                        outer.acl_resolver(method, parsed.path, token)
+                        from ..acl.enforce import AuthError
+
+                        try:
+                            outer.acl_resolver(
+                                method, parsed.path, token, query, raw_body
+                            )
+                        except AuthError as ae:
+                            raise HTTPError(ae.status, ae.message)
                     if parsed.path == "/v1/event/stream":
                         outer._serve_event_stream(self, query)
                         return
@@ -462,6 +551,10 @@ class HTTPAgentServer:
                     self._reply(404, {"error": f"no route {method} {parsed.path}"})
                 except HTTPError as e:
                     self._reply(e.status, {"error": e.message})
+                except PermissionError as e:
+                    # Expected operational rejections (e.g. re-running acl
+                    # bootstrap): client error, not a 500.
+                    self._reply(400, {"error": str(e)})
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 except Exception as e:

@@ -45,6 +45,7 @@ class NomadClient:
         self.deployments = Deployments(self)
         self.agent = AgentAPI(self)
         self.status = Status(self)
+        self.acl = ACLAPI(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -281,6 +282,46 @@ class Status(_Resource):
 
     def peers(self):
         return self.c.get("/v1/status/peers")
+
+
+class ACLAPI(_Resource):
+    def bootstrap(self):
+        return self.c.put("/v1/acl/bootstrap")
+
+    def policies(self):
+        return self.c.get("/v1/acl/policies")
+
+    def policy(self, name: str):
+        return self.c.get(f"/v1/acl/policy/{name}")
+
+    def policy_apply(self, name: str, rules: str, description: str = ""):
+        return self.c.put(
+            f"/v1/acl/policy/{name}",
+            body={"Rules": rules, "Description": description},
+        )
+
+    def policy_delete(self, name: str):
+        return self.c.delete(f"/v1/acl/policy/{name}")
+
+    def tokens(self):
+        return self.c.get("/v1/acl/tokens")
+
+    def token(self, accessor_id: str):
+        return self.c.get(f"/v1/acl/token/{accessor_id}")
+
+    def token_self(self):
+        return self.c.get("/v1/acl/token/self")
+
+    def token_create(
+        self, name: str = "", type: str = "client", policies=None
+    ):
+        return self.c.put(
+            "/v1/acl/token",
+            body={"Name": name, "Type": type, "Policies": policies or []},
+        )
+
+    def token_delete(self, accessor_id: str):
+        return self.c.delete(f"/v1/acl/token/{accessor_id}")
 
 
 def event_stream(

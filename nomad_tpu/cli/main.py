@@ -165,6 +165,9 @@ def _load_agent_config(path: str):
         pa = pb.body.attrs()
         cfg.http_port = int(pa.get("http", 0))
         cfg.rpc_port = int(pa.get("rpc", 0))
+    ab = body.block("acl")
+    if ab is not None:
+        cfg.acl_enabled = bool(ab.body.attrs().get("enabled", False))
     return cfg
 
 
@@ -180,6 +183,8 @@ def _apply_config_dict(cfg, data: dict) -> None:
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)
+        elif k == "acl" and isinstance(v, dict):
+            cfg.acl_enabled = v.get("enabled", False)
         elif hasattr(cfg, k):
             setattr(cfg, k, v)
 
@@ -429,14 +434,7 @@ def cmd_node_status(args) -> int:
 
 
 def _find_by_prefix(items, prefix: str):
-    matches = [i for i in items if i.id.startswith(prefix)]
-    if not matches:
-        raise SystemExit(f"No object with ID prefix {prefix!r}")
-    if len(matches) > 1:
-        raise SystemExit(
-            f"Ambiguous prefix {prefix!r} matches {len(matches)} objects"
-        )
-    return matches[0]
+    return _find_by_prefix_attr(items, "id", prefix)
 
 
 def cmd_node_drain(args) -> int:
@@ -603,6 +601,90 @@ def cmd_deployment_pause(args) -> int:
 # server / status / misc
 
 
+def cmd_acl_bootstrap(args) -> int:
+    api = _client(args)
+    token = api.acl.bootstrap()
+    print(f"Accessor ID = {token.accessor_id}")
+    print(f"Secret ID   = {token.secret_id}")
+    print(f"Type        = {token.type}")
+    return 0
+
+
+def cmd_acl_policy_apply(args) -> int:
+    api = _client(args)
+    with open(args.rules_file) as f:
+        rules = f.read()
+    api.acl.policy_apply(args.name, rules, description=args.description or "")
+    print(f'ACL policy "{args.name}" applied')
+    return 0
+
+
+def cmd_acl_policy_list(args) -> int:
+    api = _client(args)
+    pols = api.acl.policies()
+    print(
+        _fmt_table(
+            [[p.name, p.description] for p in pols],
+            header=["Name", "Description"],
+        )
+    )
+    return 0
+
+
+def cmd_acl_policy_delete(args) -> int:
+    api = _client(args)
+    api.acl.policy_delete(args.name)
+    print(f'ACL policy "{args.name}" deleted')
+    return 0
+
+
+def cmd_acl_token_create(args) -> int:
+    api = _client(args)
+    token = api.acl.token_create(
+        name=args.name or "", type=args.type, policies=args.policy or []
+    )
+    print(f"Accessor ID = {token.accessor_id}")
+    print(f"Secret ID   = {token.secret_id}")
+    print(f"Type        = {token.type}")
+    print(f"Policies    = {','.join(token.policies)}")
+    return 0
+
+
+def cmd_acl_token_list(args) -> int:
+    api = _client(args)
+    tokens = api.acl.tokens()
+    print(
+        _fmt_table(
+            [
+                [t.accessor_id[:8], t.name, t.type, ",".join(t.policies)]
+                for t in tokens
+            ],
+            header=["Accessor", "Name", "Type", "Policies"],
+        )
+    )
+    return 0
+
+
+def cmd_acl_token_delete(args) -> int:
+    api = _client(args)
+    tokens = api.acl.tokens()
+    match = _find_by_prefix_attr(tokens, "accessor_id", args.accessor_id)
+    api.acl.token_delete(match.accessor_id)
+    print(f"Token {match.accessor_id[:8]} deleted")
+    return 0
+
+
+def _find_by_prefix_attr(items, attr: str, prefix: str):
+    matches = [i for i in items if getattr(i, attr).startswith(prefix)]
+    if not matches:
+        raise SystemExit(f"No object with ID prefix {prefix!r}")
+    if len(matches) > 1:
+        raise SystemExit(
+            f"Ambiguous prefix {prefix!r} matches {len(matches)} objects"
+        )
+    return matches[0]
+
+
 def cmd_server_members(args) -> int:
     api = _client(args)
     members = api.agent.members()
@@ -745,6 +827,35 @@ def build_parser() -> argparse.ArgumentParser:
     dpa.add_argument("deployment_id")
     dpa.add_argument("-resume", action="store_true")
     dpa.set_defaults(fn=cmd_deployment_pause)
+
+    acl = sub.add_parser("acl", help="ACL commands")
+    aclsub = acl.add_subparsers(dest="subcmd")
+    ab = aclsub.add_parser("bootstrap")
+    ab.set_defaults(fn=cmd_acl_bootstrap)
+    ap_ = aclsub.add_parser("policy")
+    apsub = ap_.add_subparsers(dest="subsubcmd")
+    apa = apsub.add_parser("apply")
+    apa.add_argument("name")
+    apa.add_argument("rules_file")
+    apa.add_argument("-description", default=None)
+    apa.set_defaults(fn=cmd_acl_policy_apply)
+    apl = apsub.add_parser("list")
+    apl.set_defaults(fn=cmd_acl_policy_list)
+    apd = apsub.add_parser("delete")
+    apd.add_argument("name")
+    apd.set_defaults(fn=cmd_acl_policy_delete)
+    at = aclsub.add_parser("token")
+    atsub = at.add_subparsers(dest="subsubcmd")
+    atc = atsub.add_parser("create")
+    atc.add_argument("-name", default=None)
+    atc.add_argument("-type", default="client")
+    atc.add_argument("-policy", action="append", default=[])
+    atc.set_defaults(fn=cmd_acl_token_create)
+    atl = atsub.add_parser("list")
+    atl.set_defaults(fn=cmd_acl_token_list)
+    atd = atsub.add_parser("delete")
+    atd.add_argument("accessor_id")
+    atd.set_defaults(fn=cmd_acl_token_delete)
 
     srv = sub.add_parser("server", help="server commands")
     ssub = srv.add_subparsers(dest="subcmd")

@@ -54,6 +54,10 @@ def _register_builtin_structs() -> None:
     from .state.store import JobSummary
 
     register_type(JobSummary)
+    from .acl.structs import ACLPolicy, ACLToken
+
+    register_type(ACLPolicy)
+    register_type(ACLToken)
 
 
 def to_wire(obj: Any) -> Any:

@@ -243,9 +243,7 @@ def _parse_body(lx: _Lexer, outermost: bool = False) -> Body:
         labels: list[str] = []
         while True:
             kind2, val2, line2 = lx.peek()
-            if kind2 in ("str", "ident") and not (
-                kind2 == "punct"
-            ):
+            if kind2 in ("str", "ident"):
                 labels.append(lx.next()[1])
                 continue
             break

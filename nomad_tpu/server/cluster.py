@@ -240,6 +240,59 @@ class DeploymentEndpoint(_Forwarder):
         )
 
 
+class ACLEndpoint(_Forwarder):
+    def bootstrap(self, args):
+        return self._forward(
+            "ACL.bootstrap", args, lambda a: self.cs.server.acl_bootstrap()
+        )
+
+    def policy_upsert(self, args):
+        return self._forward(
+            "ACL.policy_upsert",
+            args,
+            lambda a: self.cs.server.acl_policy_upsert(a["policies"]),
+        )
+
+    def policy_delete(self, args):
+        return self._forward(
+            "ACL.policy_delete",
+            args,
+            lambda a: self.cs.server.acl_policy_delete(a["names"]),
+        )
+
+    def policy_get(self, args):
+        return self.cs.server.state.acl_policy_by_name(args["name"])
+
+    def policy_list(self, args):
+        return self.cs.server.state.acl_policies()
+
+    def token_create(self, args):
+        return self._forward(
+            "ACL.token_create",
+            args,
+            lambda a: self.cs.server.acl_token_create(a["token"]),
+        )
+
+    def token_delete(self, args):
+        return self._forward(
+            "ACL.token_delete",
+            args,
+            lambda a: self.cs.server.acl_token_delete(a["accessor_ids"]),
+        )
+
+    def token_get(self, args):
+        return self.cs.server.state.acl_token_by_accessor(args["accessor_id"])
+
+    def token_list(self, args):
+        # Secrets are never listed (reference redacts SecretID on list).
+        out = []
+        for t in self.cs.server.state.acl_tokens():
+            c = t.copy()
+            c.secret_id = ""
+            out.append(c)
+        return out
+
+
 class StatusEndpoint(_Forwarder):
     def leader(self, args):
         addr = self.cs.raft.leader_addr()
@@ -315,6 +368,7 @@ class ClusterServer:
             ("Eval", EvalEndpoint(self)),
             ("Alloc", AllocEndpoint(self)),
             ("Deployment", DeploymentEndpoint(self)),
+            ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),
         ):
             self.rpc.register(name, ep)

@@ -64,6 +64,10 @@ class FSM:
             "deployment_promote": self._apply_deployment_promote,
             "deployment_alloc_health": self._apply_deployment_alloc_health,
             "batch_node_drain_update": self._apply_batch_drain,
+            "acl_policy_upsert": lambda i, p: self.state.upsert_acl_policies(i, p),
+            "acl_policy_delete": lambda i, p: self.state.delete_acl_policies(i, p),
+            "acl_token_upsert": lambda i, p: self.state.upsert_acl_tokens(i, p),
+            "acl_token_delete": lambda i, p: self.state.delete_acl_tokens(i, p),
         }
 
     def apply(self, index: int, msg_type: str, payload) -> object:

@@ -108,6 +108,11 @@ class Server:
                     )
                 )
 
+        # Token→ACL resolution cache, invalidated by acl table index
+        # (reference nomad/acl.go aclCache).
+        self._acl_cache: dict[str, tuple[int, object]] = {}
+        self._acl_bootstrap_lock = threading.Lock()
+
         # Single writer draining unblocked-eval re-queues (see
         # _requeue_unblocked for why this must be async).
         import queue as _queue
@@ -520,6 +525,75 @@ class Server:
         return child.id, ev.id
 
     # -- GC (reference nomad/system_endpoint.go + leader.go) -----------
+
+    # -- ACL endpoint (reference nomad/acl_endpoint.go) -----------------
+
+    def acl_bootstrap(self):
+        """One-shot initial management token (reference ACL.Bootstrap).
+        The lock closes the check-then-act window between two concurrent
+        bootstrap requests (the reference uses a bootstrap-index CAS)."""
+        from ..acl.structs import ACLToken
+
+        with self._acl_bootstrap_lock:
+            if self.state.acl_has_management_token():
+                raise PermissionError("ACL bootstrap already done")
+            token = ACLToken.new(name="Bootstrap Token", type="management")
+            self.raft_apply("acl_token_upsert", [token])
+            return self.state.acl_token_by_accessor(token.accessor_id)
+
+    def acl_policy_upsert(self, policies) -> None:
+        for pol in policies:
+            pol.validate()
+        self.raft_apply("acl_policy_upsert", policies)
+
+    def acl_policy_delete(self, names: list[str]) -> None:
+        self.raft_apply("acl_policy_delete", names)
+
+    def acl_token_create(self, token):
+        from ..acl.structs import ACLToken
+
+        if not token.accessor_id:
+            fresh = ACLToken.new(
+                name=token.name, type=token.type, policies=token.policies
+            )
+            fresh.global_ = token.global_
+            token = fresh
+        token.validate()
+        self.raft_apply("acl_token_upsert", [token])
+        return self.state.acl_token_by_accessor(token.accessor_id)
+
+    def acl_token_delete(self, accessor_ids: list[str]) -> None:
+        self.raft_apply("acl_token_delete", accessor_ids)
+
+    def resolve_token(self, secret_id: str):
+        """secret → compiled ACL (reference nomad/acl.go ResolveToken).
+        None ⇒ anonymous. Cached per (secret, acl table index)."""
+        from ..acl import compile_policies, parse_policy
+        from ..acl.acl import MANAGEMENT_ACL
+        from ..state.store import TABLE_ACL_POLICIES, TABLE_ACL_TOKENS
+
+        if not secret_id:
+            return None
+        idx = self.state.table_index(TABLE_ACL_POLICIES, TABLE_ACL_TOKENS)
+        cached = self._acl_cache.get(secret_id)
+        if cached is not None and cached[0] == idx:
+            return cached[1]
+        token = self.state.acl_token_by_secret(secret_id)
+        if token is None:
+            raise PermissionError("token not found")
+        if token.is_management():
+            acl = MANAGEMENT_ACL
+        else:
+            policies = []
+            for name in token.policies:
+                pol = self.state.acl_policy_by_name(name)
+                if pol is not None:
+                    policies.append(parse_policy(pol.rules))
+            acl = compile_policies(policies)
+        if len(self._acl_cache) > 512:
+            self._acl_cache.clear()
+        self._acl_cache[secret_id] = (idx, acl)
+        return acl
 
     def force_gc(self) -> None:
         """System.GarbageCollect: enqueue a force-gc core eval."""

@@ -63,6 +63,8 @@ TABLE_JOB_SUMMARIES = "job_summary"
 TABLE_EVALS = "evals"
 TABLE_ALLOCS = "allocs"
 TABLE_DEPLOYMENTS = "deployment"
+TABLE_ACL_POLICIES = "acl_policy"
+TABLE_ACL_TOKENS = "acl_token"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -71,6 +73,8 @@ ALL_TABLES = (
     TABLE_EVALS,
     TABLE_ALLOCS,
     TABLE_DEPLOYMENTS,
+    TABLE_ACL_POLICIES,
+    TABLE_ACL_TOKENS,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -318,6 +322,68 @@ class StateStore(_ReadMixin):
 
     def subscribe(self, fn: Callable[[int, str, list, str], None]) -> None:
         self._subscribers.append(fn)
+
+    # -- ACL -----------------------------------------------------------
+
+    def upsert_acl_policies(self, index: int, policies: list) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_ACL_POLICIES)
+            for pol in policies:
+                pol = pol.copy()
+                existing = t.get(pol.name)
+                pol.create_index = existing.create_index if existing else index
+                pol.modify_index = index
+                t[pol.name] = pol
+            self._stamp(index, TABLE_ACL_POLICIES)
+
+    def delete_acl_policies(self, index: int, names: list[str]) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_ACL_POLICIES)
+            for name in names:
+                t.pop(name, None)
+            self._stamp(index, TABLE_ACL_POLICIES)
+
+    def acl_policy_by_name(self, name: str):
+        return self._tables[TABLE_ACL_POLICIES].get(name)
+
+    def acl_policies(self) -> list:
+        return list(self._tables[TABLE_ACL_POLICIES].values())
+
+    def upsert_acl_tokens(self, index: int, tokens: list) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_ACL_TOKENS)
+            for tok in tokens:
+                tok = tok.copy()
+                existing = t.get(tok.accessor_id)
+                tok.create_index = existing.create_index if existing else index
+                tok.modify_index = index
+                t[tok.accessor_id] = tok
+            self._stamp(index, TABLE_ACL_TOKENS)
+
+    def delete_acl_tokens(self, index: int, accessor_ids: list[str]) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_ACL_TOKENS)
+            for aid in accessor_ids:
+                t.pop(aid, None)
+            self._stamp(index, TABLE_ACL_TOKENS)
+
+    def acl_token_by_accessor(self, accessor_id: str):
+        return self._tables[TABLE_ACL_TOKENS].get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        for tok in self._tables[TABLE_ACL_TOKENS].values():
+            if tok.secret_id == secret_id:
+                return tok
+        return None
+
+    def acl_tokens(self) -> list:
+        return list(self._tables[TABLE_ACL_TOKENS].values())
+
+    def acl_has_management_token(self) -> bool:
+        return any(
+            t.type == "management"
+            for t in self._tables[TABLE_ACL_TOKENS].values()
+        )
 
     # -- snapshot persistence ------------------------------------------
 

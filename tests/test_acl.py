@@ -1,0 +1,216 @@
+"""ACL tests: policy DSL, compiled ACL semantics, HTTP enforcement.
+
+Reference analogs: acl/policy_test.go, acl/acl_test.go,
+nomad/acl_endpoint_test.go.
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu.acl import compile_policies, parse_policy
+from nomad_tpu.acl.policy import PolicyError
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import APIError, NomadClient
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestPolicyParsing:
+    def test_parse_basic(self):
+        pol = parse_policy(
+            """
+namespace "default" {
+  policy = "write"
+}
+node {
+  policy = "read"
+}
+agent {
+  policy = "write"
+}
+"""
+        )
+        assert pol.namespaces[0].name == "default"
+        assert pol.namespaces[0].policy == "write"
+        assert pol.node == "read"
+        assert pol.agent == "write"
+
+    def test_parse_capabilities(self):
+        pol = parse_policy(
+            """
+namespace "ops-*" {
+  policy       = "read"
+  capabilities = ["submit-job"]
+}
+"""
+        )
+        assert pol.namespaces[0].capabilities == ["submit-job"]
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(PolicyError):
+            parse_policy('namespace "x" { policy = "banana" }')
+        with pytest.raises(PolicyError):
+            parse_policy('namespace "x" { capabilities = ["nope"] }')
+        with pytest.raises(PolicyError):
+            parse_policy('node { policy = "list" }')
+
+
+class TestCompiledACL:
+    def test_read_vs_write(self):
+        acl = compile_policies(
+            [parse_policy('namespace "default" { policy = "read" }')]
+        )
+        assert acl.allow_namespace_op("default", "read-job")
+        assert acl.allow_namespace_op("default", "list-jobs")
+        assert not acl.allow_namespace_op("default", "submit-job")
+        assert not acl.allow_namespace_op("other", "read-job")
+
+    def test_glob_specificity(self):
+        acl = compile_policies(
+            [
+                parse_policy('namespace "*" { policy = "read" }'),
+                parse_policy('namespace "ops-*" { policy = "write" }'),
+            ]
+        )
+        assert acl.allow_namespace_op("anything", "read-job")
+        assert not acl.allow_namespace_op("anything", "submit-job")
+        # more-specific glob wins
+        assert acl.allow_namespace_op("ops-prod", "submit-job")
+
+    def test_deny_wins(self):
+        acl = compile_policies(
+            [parse_policy('namespace "secret" { policy = "deny" }')]
+        )
+        assert not acl.allow_namespace_op("secret", "read-job")
+
+    def test_merge_levels(self):
+        acl = compile_policies(
+            [
+                parse_policy('node { policy = "read" }'),
+                parse_policy('node { policy = "write" }'),
+            ]
+        )
+        assert acl.allow_node_write()
+
+    def test_management(self):
+        from nomad_tpu.acl.acl import MANAGEMENT_ACL
+
+        assert MANAGEMENT_ACL.allow_namespace_op("any", "submit-job")
+        assert MANAGEMENT_ACL.allow_node_write()
+
+
+@pytest.fixture(scope="module")
+def acl_agent(tmp_path_factory):
+    cfg = AgentConfig.dev()
+    cfg.acl_enabled = True
+    cfg.data_dir = str(tmp_path_factory.mktemp("acl-agent"))
+    a = Agent(cfg)
+    a.start()
+    assert wait_until(lambda: a.server.is_leader(), 15)
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def root(acl_agent):
+    host, port = acl_agent.http_addr
+    api = NomadClient(f"http://{host}:{port}")
+    token = api.acl.bootstrap()
+    return NomadClient(f"http://{host}:{port}", token=token.secret_id)
+
+
+class TestHTTPEnforcement:
+    def test_anonymous_denied(self, acl_agent, root):
+        host, port = acl_agent.http_addr
+        anon = NomadClient(f"http://{host}:{port}")
+        with pytest.raises(APIError) as e:
+            anon.jobs.list()
+        assert e.value.status == 401
+        # status stays open
+        assert anon.status.leader()
+
+    def test_bootstrap_once(self, root):
+        with pytest.raises(APIError):
+            root.acl.bootstrap()
+
+    def test_management_allowed(self, root):
+        assert root.jobs.list() == []
+        assert root.nodes.list() is not None
+
+    def test_scoped_client_token(self, acl_agent, root):
+        host, port = acl_agent.http_addr
+        root.acl.policy_apply(
+            "readonly", 'namespace "default" { policy = "read" }'
+        )
+        tok = root.acl.token_create(
+            name="reader", policies=["readonly"]
+        )
+        reader = NomadClient(f"http://{host}:{port}", token=tok.secret_id)
+        assert reader.jobs.list() == []  # list-jobs allowed
+        from nomad_tpu import mock
+
+        job = mock.job()
+        with pytest.raises(APIError) as e:
+            reader.jobs.register(job)  # submit-job denied
+        assert e.value.status == 403
+        with pytest.raises(APIError) as e:
+            reader.nodes.list()  # no node policy
+        assert e.value.status == 403
+        # token/self works for any valid token
+        me = reader.acl.token_self()
+        assert me.accessor_id == tok.accessor_id
+        # acl admin requires management
+        with pytest.raises(APIError) as e:
+            reader.acl.tokens()
+        assert e.value.status == 403
+
+    def test_bad_token_401(self, acl_agent):
+        host, port = acl_agent.http_addr
+        bad = NomadClient(f"http://{host}:{port}", token="not-a-token")
+        with pytest.raises(APIError) as e:
+            bad.jobs.list()
+        assert e.value.status == 401
+
+    def test_token_lifecycle(self, root):
+        tok = root.acl.token_create(name="temp", policies=["readonly"])
+        listed = root.acl.tokens()
+        assert any(t.accessor_id == tok.accessor_id for t in listed)
+        # secrets never listed
+        assert all(t.secret_id == "" for t in listed)
+        root.acl.token_delete(tok.accessor_id)
+        with pytest.raises(APIError):
+            root.acl.token(tok.accessor_id)
+
+    def test_body_namespace_escalation_blocked(self, acl_agent, root):
+        """submit-job on 'default' must not allow registering into
+        another namespace via the job body (review finding)."""
+        host, port = acl_agent.http_addr
+        root.acl.policy_apply(
+            "submit-default", 'namespace "default" { policy = "write" }'
+        )
+        tok = root.acl.token_create(
+            name="submitter", policies=["submit-default"]
+        )
+        submitter = NomadClient(f"http://{host}:{port}", token=tok.secret_id)
+        from nomad_tpu import mock
+
+        ok_job = mock.job()
+        assert submitter.jobs.register(ok_job)  # default ns: allowed
+        evil = mock.job()
+        evil.namespace = "prod"
+        with pytest.raises(APIError) as e:
+            submitter.jobs.register(evil)
+        assert e.value.status == 403
+
+    def test_second_bootstrap_is_400(self, acl_agent, root):
+        with pytest.raises(APIError) as e:
+            root.acl.bootstrap()
+        assert e.value.status == 400
