@@ -13,7 +13,10 @@ from typing import Optional
 
 from .policy import CAP_DENY, Policy, expand_namespace_policy
 
-_LEVELS = {"": 0, "deny": 0, "list": 1, "read": 1, "write": 2}
+_LEVELS = {"": 0, "deny": 0, "read": 1, "write": 2}
+# plugin has its own ladder: list < read (the policy validator keeps them
+# distinct; collapsing them would give list-scoped tokens read access)
+_PLUGIN_LEVELS = {"": 0, "deny": 0, "list": 1, "read": 2}
 
 
 class ACLError(Exception):
@@ -66,7 +69,8 @@ class ACL:
         return best[1] if best else None
 
     def _level(self, attr: str) -> int:
-        return _LEVELS.get(getattr(self, attr), 0)
+        levels = _PLUGIN_LEVELS if attr == "plugin" else _LEVELS
+        return levels.get(getattr(self, attr), 0)
 
     def allow_node_read(self) -> bool:
         return self.management or self._level("node") >= 1
@@ -87,6 +91,9 @@ class ACL:
         return self.management or self._level("operator") >= 2
 
     def allow_plugin_read(self) -> bool:
+        return self.management or self._level("plugin") >= 2
+
+    def allow_plugin_list(self) -> bool:
         return self.management or self._level("plugin") >= 1
 
 
@@ -95,9 +102,12 @@ MANAGEMENT_ACL = ACL(management=True)
 
 
 def compile_policies(policies: list[Policy]) -> ACL:
-    """Merge policies; capability unions, precedence write > read > deny
-    handled by union + explicit deny (reference NewACL)."""
+    """Merge policies. Namespace capabilities union (explicit CAP_DENY
+    poisons the namespace); for the coarse node/agent/operator/plugin
+    levels an explicit deny ALWAYS wins, exactly like the reference's
+    maxPrivilege — a read policy must never override a deny policy."""
     acl = ACL()
+    denied: set[str] = set()
     for pol in policies:
         for np in pol.namespaces:
             caps = acl._namespaces.setdefault(np.name, set())
@@ -106,6 +116,13 @@ def compile_policies(policies: list[Policy]) -> ACL:
             caps.update(np.capabilities)
         for attr in ("node", "agent", "operator", "plugin"):
             val = getattr(pol, attr)
-            if val and _LEVELS.get(val, 0) >= _LEVELS.get(getattr(acl, attr), 0):
+            if not val:
+                continue
+            if val == "deny":
+                denied.add(attr)
+            levels = _PLUGIN_LEVELS if attr == "plugin" else _LEVELS
+            if levels.get(val, 0) >= levels.get(getattr(acl, attr), 0):
                 setattr(acl, attr, val)
+    for attr in denied:
+        setattr(acl, attr, "deny")
     return acl

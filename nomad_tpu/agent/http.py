@@ -23,6 +23,7 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .. import codec
+from ..server.server import ConflictError
 from ..state.store import (
     TABLE_ALLOCS,
     TABLE_DEPLOYMENTS,
@@ -84,6 +85,39 @@ class HTTPAgentServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+    # -- ACL helpers (second-stage, object-namespace-aware) ------------
+
+    def _acl_for(self, token: str):
+        """None ⇒ enforcement off or management. Raises on bad token."""
+        if self.acl_resolver is None:
+            return None
+        try:
+            acl = self.cluster.server.resolve_token(token)
+        except PermissionError:
+            raise HTTPError(401, "ACL token not found")
+        if acl is None:
+            raise HTTPError(401, "missing ACL token")
+        return None if acl.is_management() else acl
+
+    def _ns_guard(self, token: str, namespace: str, cap: str) -> None:
+        """Check a capability against an OBJECT's namespace — the route
+        pre-check only sees the query namespace, which need not match the
+        object the handler acts on (cross-namespace escalation)."""
+        acl = self._acl_for(token)
+        if acl is not None and not acl.allow_namespace_op(namespace, cap):
+            raise HTTPError(403, f"missing {cap!r} on namespace {namespace!r}")
+
+    def _ns_filter(self, token: str, objs: list, cap: str) -> list:
+        """Drop objects in namespaces the token can't read."""
+        acl = self._acl_for(token)
+        if acl is None:
+            return objs
+        return [
+            o
+            for o in objs
+            if acl.allow_namespace_op(getattr(o, "namespace", "default"), cap)
+        ]
 
     # -- routing -------------------------------------------------------
 
@@ -158,6 +192,7 @@ class HTTPAgentServer:
 
         def job_revert(p, q, body, tok):
             ns = body.get("Namespace", "default")
+            self._ns_guard(tok, ns, "submit-job")
             return self.cluster.rpc_self(
                 "Job.revert",
                 {"namespace": ns, "job_id": p["id"], "version": body["JobVersion"]},
@@ -258,22 +293,24 @@ class HTTPAgentServer:
         # -- allocs / evals -------------------------------------------
         def allocs_list(p, q, body, tok):
             data, idx = blocking([TABLE_ALLOCS], q, srv.state.allocs)
-            return data, idx
+            return self._ns_filter(tok, data, "read-job"), idx
 
         def alloc_get(p, q, body, tok):
             a = srv.state.alloc_by_id(p["id"])
             if a is None:
                 raise HTTPError(404, f"alloc {p['id']} not found")
+            self._ns_guard(tok, a.namespace, "read-job")
             return a
 
         def evals_list(p, q, body, tok):
             data, idx = blocking([TABLE_EVALS], q, srv.state.evals)
-            return data, idx
+            return self._ns_filter(tok, data, "read-job"), idx
 
         def eval_get(p, q, body, tok):
             e = srv.state.eval_by_id(p["id"])
             if e is None:
                 raise HTTPError(404, f"eval {p['id']} not found")
+            self._ns_guard(tok, e.namespace, "read-job")
             return e
 
         def eval_allocs(p, q, body, tok):
@@ -288,18 +325,24 @@ class HTTPAgentServer:
         # -- deployments ----------------------------------------------
         def deployments_list(p, q, body, tok):
             data, idx = blocking([TABLE_DEPLOYMENTS], q, srv.state.deployments)
-            return data, idx
+            return self._ns_filter(tok, data, "read-job"), idx
 
         def deployment_get(p, q, body, tok):
             d = srv.state.deployment_by_id(p["id"])
             if d is None:
                 raise HTTPError(404, f"deployment {p['id']} not found")
+            self._ns_guard(tok, d.namespace, "read-job")
             return d
 
         def deployment_allocs(p, q, body, tok):
-            return srv.state.allocs_by_deployment(p["id"])
+            return self._ns_filter(
+                tok, srv.state.allocs_by_deployment(p["id"]), "read-job"
+            )
 
         def deployment_promote(p, q, body, tok):
+            d = srv.state.deployment_by_id(p["id"])
+            if d is not None:
+                self._ns_guard(tok, d.namespace, "submit-job")
             self.cluster.rpc_self(
                 "Deployment.promote",
                 {
@@ -310,6 +353,9 @@ class HTTPAgentServer:
             return {}
 
         def deployment_pause(p, q, body, tok):
+            d = srv.state.deployment_by_id(p["id"])
+            if d is not None:
+                self._ns_guard(tok, d.namespace, "submit-job")
             self.cluster.rpc_self(
                 "Deployment.pause",
                 {"deployment_id": p["id"], "pause": body.get("Pause", True)},
@@ -317,6 +363,9 @@ class HTTPAgentServer:
             return {}
 
         def deployment_fail(p, q, body, tok):
+            d = srv.state.deployment_by_id(p["id"])
+            if d is not None:
+                self._ns_guard(tok, d.namespace, "submit-job")
             self.cluster.rpc_self(
                 "Deployment.fail", {"deployment_id": p["id"]}
             )
@@ -551,7 +600,7 @@ class HTTPAgentServer:
                     self._reply(404, {"error": f"no route {method} {parsed.path}"})
                 except HTTPError as e:
                     self._reply(e.status, {"error": e.message})
-                except PermissionError as e:
+                except ConflictError as e:
                     # Expected operational rejections (e.g. re-running acl
                     # bootstrap): client error, not a 500.
                     self._reply(400, {"error": str(e)})

@@ -58,6 +58,11 @@ def _register_builtin_structs() -> None:
 
     register_type(ACLPolicy)
     register_type(ACLToken)
+    # Driver plugin boundary payloads (nomad_tpu/drivers/plugin.py).
+    from .drivers import base as driver_base
+
+    for name in ("Fingerprint", "TaskConfig", "ExitResult", "TaskStatus"):
+        register_type(getattr(driver_base, name))
 
 
 def to_wire(obj: Any) -> Any:

@@ -7,12 +7,14 @@ from .base import (
     TaskHandle,
     TaskStatus,
 )
+from .exec import ExecDriver
 from .mock import MockDriver
 from .rawexec import RawExecDriver
 
 BUILTIN_DRIVERS = {
     "mock": MockDriver,
     "rawexec": RawExecDriver,
+    "exec": ExecDriver,
 }
 
 

@@ -1,0 +1,188 @@
+"""Python side of the native executor.
+
+Reference: drivers/shared/executor — the Go client half that talks gRPC
+to the out-of-process supervisor (z_executor_cmd.go re-attach). Here:
+compile `native/executor.cc` once per machine (g++, cached by source
+hash), launch it detached, and speak its line protocol over the unix
+socket. Re-attach after a client restart = reconnect to the socket.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import socket
+import subprocess
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).parent / "native" / "executor.cc"
+_BUILD_LOCK = threading.Lock()
+
+
+class ExecutorError(Exception):
+    pass
+
+
+def executor_binary(cache_dir: Optional[str] = None) -> str:
+    """Compile (once) and return the executor binary path."""
+    cache = Path(
+        cache_dir
+        or os.environ.get("NOMAD_TPU_BIN_DIR")
+        or Path.home() / ".cache" / "nomad_tpu" / "bin"
+    )
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = cache / f"nomad-executor-{tag}"
+    if out.exists():
+        return str(out)
+    with _BUILD_LOCK:
+        if out.exists():
+            return str(out)
+        cache.mkdir(parents=True, exist_ok=True)
+        gxx = shutil.which("g++") or shutil.which("c++")
+        if gxx is None:
+            raise ExecutorError("no C++ compiler available")
+        tmp = str(out) + ".tmp"
+        proc = subprocess.run(
+            [gxx, "-O2", "-std=c++17", "-o", tmp, str(_SRC)],
+            capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            raise ExecutorError(f"executor build failed:\n{proc.stderr}")
+        os.replace(tmp, out)
+    return str(out)
+
+
+class ExecutorHandle:
+    """Control connection to one running executor."""
+
+    def __init__(self, socket_path: str, daemon_pid: int = 0) -> None:
+        self.socket_path = socket_path
+        self.daemon_pid = daemon_pid
+
+    # -- protocol ------------------------------------------------------
+
+    def _cmd(self, line: str, timeout_s: Optional[float] = 10.0) -> dict:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(5.0)
+        try:
+            conn.connect(self.socket_path)
+            conn.settimeout(timeout_s)
+            conn.sendall(line.encode() + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(4096)
+                if not chunk:
+                    raise ExecutorError("executor connection closed")
+                buf += chunk
+        finally:
+            conn.close()
+        text = buf.decode().strip()
+        if text.startswith("err"):
+            raise ExecutorError(text[4:] or "executor error")
+        out: dict = {}
+        for part in text.split()[1:]:
+            if "=" in part:
+                k, v = part.split("=", 1)
+                try:
+                    out[k] = int(v)
+                except ValueError:
+                    out[k] = v
+        return out
+
+    def status(self) -> dict:
+        return self._cmd("status")
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[dict]:
+        """Block until the task exits; None on timeout."""
+        try:
+            return self._cmd("wait", timeout_s=timeout_s)
+        except socket.timeout:
+            return None
+
+    def signal(self, signo: int) -> None:
+        self._cmd(f"signal {signo}")
+
+    def stop(self, grace_s: float = 5.0, signo: int = 15) -> None:
+        self._cmd(f"stop {int(grace_s * 1000)} {signo}")
+
+    def stats(self) -> dict:
+        return self._cmd("stats")
+
+    def shutdown(self) -> None:
+        try:
+            self._cmd("shutdown")
+        except (ExecutorError, OSError):
+            pass
+
+    def alive(self) -> bool:
+        try:
+            self.status()
+            return True
+        except (OSError, ExecutorError):
+            return False
+
+
+def launch_executor(
+    task_dir: str,
+    command: str,
+    args: list[str],
+    env: dict[str, str],
+    stdout_path: str = "",
+    stderr_path: str = "",
+    cwd: str = "",
+    user: str = "",
+    cgroup: str = "",
+    memory_max_bytes: int = 0,
+    cpu_weight: int = 0,
+    cache_dir: Optional[str] = None,
+) -> ExecutorHandle:
+    """Write the spec, launch the daemonized supervisor, return a handle."""
+    binary = executor_binary(cache_dir)
+    ctl_dir = Path(task_dir)
+    ctl_dir.mkdir(parents=True, exist_ok=True)
+    sock = str(ctl_dir / "executor.sock")
+    spec_path = str(ctl_dir / "executor.spec")
+    lines = [f"command\t{command}"]
+    lines += [f"arg\t{a}" for a in args]
+    lines += [f"env\t{k}={v}" for k, v in env.items()]
+    if cwd:
+        lines.append(f"cwd\t{cwd}")
+    if stdout_path:
+        lines.append(f"stdout\t{stdout_path}")
+    if stderr_path:
+        lines.append(f"stderr\t{stderr_path}")
+    lines.append(f"socket\t{sock}")
+    lines.append(f"pidfile\t{ctl_dir / 'executor.pid'}")
+    if user:
+        lines.append(f"user\t{user}")
+    if cgroup:
+        lines.append(f"cgroup\t{cgroup}")
+        if memory_max_bytes:
+            lines.append(f"memory_max\t{memory_max_bytes}")
+        if cpu_weight:
+            lines.append(f"cpu_weight\t{cpu_weight}")
+    Path(spec_path).write_text("\n".join(lines) + "\n")
+
+    proc = subprocess.run(
+        [binary, spec_path], capture_output=True, text=True, timeout=30
+    )
+    if proc.returncode != 0 or not proc.stdout.startswith("READY"):
+        raise ExecutorError(
+            f"executor launch failed: {proc.stdout} {proc.stderr}"
+        )
+    daemon_pid = int(proc.stdout.split()[1])
+    handle = ExecutorHandle(sock, daemon_pid)
+    # The daemon binds before the parent prints READY; still, guard the
+    # first connect with a short retry for slow filesystems.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if handle.alive():
+            return handle
+        time.sleep(0.02)
+    raise ExecutorError("executor socket never came up")

@@ -1,0 +1,370 @@
+// nomad-tpu native executor: out-of-process task supervisor.
+//
+// Reference: drivers/shared/executor (executor_linux.go — the
+// libcontainer-backed process supervisor that outlives the client agent
+// so tasks survive agent restarts, plus the gRPC control surface in
+// proto/executor.proto). This is the TPU-native equivalent in C++:
+//
+//   * reads a tab-separated spec file (see Spec below);
+//   * daemonizes (the task must NOT die with the client agent);
+//   * forks the task into its own session/process-group, with optional
+//     cgroup v2 placement (memory.max / cpu.weight, best-effort) and
+//     optional setuid/setgid;
+//   * serves a line protocol on a unix socket: status / wait / signal /
+//     stop <grace_ms> / stats / shutdown — the Python driver reconnects
+//     to the same socket after a client restart (RecoverTask).
+//
+// Protocol responses are single lines: "ok k=v k=v ..." or "err <msg>".
+// Single-threaded poll(2) loop; "wait" parks the connection until the
+// task exits (deferred response), so no threads are needed.
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <grp.h>
+#include <poll.h>
+#include <pwd.h>
+#include <signal.h>
+#include <string>
+#include <sys/resource.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+struct Spec {
+  std::string command;
+  std::vector<std::string> args;
+  std::vector<std::string> env;   // KEY=VAL
+  std::string cwd;
+  std::string stdout_path;
+  std::string stderr_path;
+  std::string socket_path;
+  std::string pidfile;
+  std::string cgroup;             // cgroup v2 dir to create/join
+  long long memory_max = 0;       // bytes, 0 = unset
+  int cpu_weight = 0;             // cgroup v2 cpu.weight, 0 = unset
+  std::string user;
+};
+
+static bool read_spec(const char *path, Spec &s) {
+  FILE *f = fopen(path, "r");
+  if (!f) return false;
+  char *line = nullptr;
+  size_t cap = 0;
+  ssize_t n;
+  while ((n = getline(&line, &cap, f)) > 0) {
+    if (line[n - 1] == '\n') line[n - 1] = '\0';
+    char *tab = strchr(line, '\t');
+    if (!tab) continue;
+    *tab = '\0';
+    std::string key = line, val = tab + 1;
+    if (key == "command") s.command = val;
+    else if (key == "arg") s.args.push_back(val);
+    else if (key == "env") s.env.push_back(val);
+    else if (key == "cwd") s.cwd = val;
+    else if (key == "stdout") s.stdout_path = val;
+    else if (key == "stderr") s.stderr_path = val;
+    else if (key == "socket") s.socket_path = val;
+    else if (key == "pidfile") s.pidfile = val;
+    else if (key == "cgroup") s.cgroup = val;
+    else if (key == "memory_max") s.memory_max = atoll(val.c_str());
+    else if (key == "cpu_weight") s.cpu_weight = atoi(val.c_str());
+    else if (key == "user") s.user = val;
+  }
+  free(line);
+  fclose(f);
+  return !s.command.empty() && !s.socket_path.empty();
+}
+
+static void write_file(const std::string &path, const std::string &val) {
+  int fd = open(path.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd >= 0) {
+    ssize_t r = write(fd, val.c_str(), val.size());
+    (void)r;
+    close(fd);
+  }
+}
+
+// Best-effort cgroup v2 setup. Returns true if the task pid should be
+// written into cgroup.procs (dir exists/writable).
+static bool setup_cgroup(const Spec &s) {
+  if (s.cgroup.empty()) return false;
+  if (mkdir(s.cgroup.c_str(), 0755) != 0 && errno != EEXIST) return false;
+  if (s.memory_max > 0)
+    write_file(s.cgroup + "/memory.max", std::to_string(s.memory_max));
+  if (s.cpu_weight > 0)
+    write_file(s.cgroup + "/cpu.weight", std::to_string(s.cpu_weight));
+  return true;
+}
+
+struct TaskState {
+  pid_t pid = -1;
+  bool exited = false;
+  int exit_code = 0;
+  int term_signal = 0;
+  long long start_ns = 0;
+  long long end_ns = 0;
+};
+
+static long long now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static pid_t spawn_task(const Spec &s, bool join_cgroup) {
+  pid_t pid = fork();
+  if (pid != 0) return pid;
+  // task child
+  setsid();
+  if (join_cgroup) {
+    // v2: write 0 (self) into cgroup.procs before exec
+    std::string procs = s.cgroup + "/cgroup.procs";
+    int fd = open(procs.c_str(), O_WRONLY);
+    if (fd >= 0) {
+      ssize_t r = write(fd, "0", 1);
+      (void)r;
+      close(fd);
+    }
+  }
+  if (!s.stdout_path.empty()) {
+    int fd = open(s.stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (fd >= 0) { dup2(fd, 1); close(fd); }
+  }
+  if (!s.stderr_path.empty()) {
+    int fd = open(s.stderr_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (fd >= 0) { dup2(fd, 2); close(fd); }
+  }
+  if (!s.cwd.empty() && chdir(s.cwd.c_str()) != 0) _exit(126);
+  if (!s.user.empty() && getuid() == 0) {
+    struct passwd *pw = getpwnam(s.user.c_str());
+    if (pw) {
+      if (initgroups(pw->pw_name, pw->pw_gid) != 0 ||
+          setgid(pw->pw_gid) != 0 || setuid(pw->pw_uid) != 0)
+        _exit(126);
+    }
+  }
+  std::vector<char *> argv;
+  argv.push_back(const_cast<char *>(s.command.c_str()));
+  for (auto &a : s.args) argv.push_back(const_cast<char *>(a.c_str()));
+  argv.push_back(nullptr);
+  std::vector<char *> envp;
+  for (auto &e : s.env) envp.push_back(const_cast<char *>(e.c_str()));
+  envp.push_back(nullptr);
+  execvpe(s.command.c_str(), argv.data(), envp.data());
+  _exit(127);
+}
+
+// /proc/<pid>/stat fields 14/15 (utime/stime, ticks) and 24 (rss pages).
+static bool read_proc_stats(pid_t pid, long long &utime, long long &stime,
+                            long long &rss_bytes) {
+  char path[64];
+  snprintf(path, sizeof path, "/proc/%d/stat", pid);
+  FILE *f = fopen(path, "r");
+  if (!f) return false;
+  char buf[4096];
+  size_t n = fread(buf, 1, sizeof buf - 1, f);
+  fclose(f);
+  buf[n] = '\0';
+  // skip past comm field "(...)" which may contain spaces
+  char *p = strrchr(buf, ')');
+  if (!p) return false;
+  p += 2;
+  long long vals[22] = {0};
+  int i = 0;
+  char *tok = strtok(p, " ");
+  while (tok && i < 22) { vals[i++] = atoll(tok); tok = strtok(nullptr, " "); }
+  if (i < 22) return false;
+  utime = vals[11];  // field 14 overall
+  stime = vals[12];
+  rss_bytes = vals[21] * sysconf(_SC_PAGESIZE);
+  return true;
+}
+
+struct Waiter { int fd; };
+struct PendingKill { bool armed = false; long long deadline_ns = 0; };
+
+static void reply(int fd, const std::string &line) {
+  std::string out = line + "\n";
+  ssize_t r = write(fd, out.c_str(), out.size());
+  (void)r;
+}
+
+static std::string status_line(const TaskState &t) {
+  char buf[256];
+  snprintf(buf, sizeof buf,
+           "ok state=%s pid=%d exit_code=%d signal=%d start_ns=%lld end_ns=%lld",
+           t.exited ? "exited" : "running", t.pid, t.exit_code, t.term_signal,
+           t.start_ns, t.end_ns);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: nomad-executor <specfile>\n");
+    return 2;
+  }
+  Spec spec;
+  if (!read_spec(argv[1], spec)) {
+    fprintf(stderr, "bad spec %s\n", argv[1]);
+    return 2;
+  }
+
+  // Bind the control socket BEFORE daemonizing so the launcher can
+  // connect as soon as we print READY.
+  unlink(spec.socket_path.c_str());
+  int lfd = socket(AF_UNIX, SOCK_STREAM, 0);
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, spec.socket_path.c_str(), sizeof addr.sun_path - 1);
+  if (bind(lfd, (struct sockaddr *)&addr, sizeof addr) != 0 ||
+      listen(lfd, 8) != 0) {
+    fprintf(stderr, "bind %s: %s\n", spec.socket_path.c_str(), strerror(errno));
+    return 2;
+  }
+
+  // Daemonize: the supervisor must survive the launching client agent.
+  pid_t child = fork();
+  if (child < 0) return 2;
+  if (child > 0) {
+    printf("READY %d\n", child);
+    fflush(stdout);
+    return 0;
+  }
+  setsid();
+  signal(SIGPIPE, SIG_IGN);
+  // Detach stdio: the launcher's pipe must reach EOF once the parent
+  // prints READY, or its subprocess.run would hang on the inherited fd.
+  int devnull = open("/dev/null", O_RDWR);
+  if (devnull >= 0) {
+    dup2(devnull, 0);
+    dup2(devnull, 1);
+    dup2(devnull, 2);
+    if (devnull > 2) close(devnull);
+  }
+
+  bool join_cg = setup_cgroup(spec);
+  TaskState task;
+  task.start_ns = now_ns();
+  task.pid = spawn_task(spec, join_cg);
+  if (!spec.pidfile.empty())
+    write_file(spec.pidfile, std::to_string(getpid()));
+
+  std::vector<struct pollfd> fds;
+  std::vector<Waiter> waiters;
+  std::vector<int> clients;
+  PendingKill pending;
+  bool shutdown_req = false;
+
+  while (true) {
+    // reap
+    if (!task.exited) {
+      int st;
+      pid_t r = waitpid(task.pid, &st, WNOHANG);
+      if (r == task.pid) {
+        task.exited = true;
+        task.end_ns = now_ns();
+        if (WIFEXITED(st)) task.exit_code = WEXITSTATUS(st);
+        else if (WIFSIGNALED(st)) {
+          task.term_signal = WTERMSIG(st);
+          task.exit_code = 128 + task.term_signal;
+        }
+        for (auto &w : waiters) { reply(w.fd, status_line(task)); }
+        waiters.clear();
+      }
+    }
+    if (pending.armed && !task.exited && now_ns() >= pending.deadline_ns) {
+      kill(-task.pid, SIGKILL);
+      pending.armed = false;
+    }
+    if (shutdown_req && task.exited && waiters.empty()) break;
+
+    fds.clear();
+    fds.push_back({lfd, POLLIN, 0});
+    for (int cfd : clients) fds.push_back({cfd, POLLIN, 0});
+    int rc = poll(fds.data(), fds.size(), 200);
+    if (rc < 0 && errno != EINTR) break;
+    if (rc <= 0) continue;
+    if (fds[0].revents & POLLIN) {
+      int cfd = accept(lfd, nullptr, nullptr);
+      if (cfd >= 0) clients.push_back(cfd);
+    }
+    for (size_t i = 1; i < fds.size(); i++) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP))) continue;
+      int cfd = fds[i].fd;
+      char buf[512];
+      ssize_t n = read(cfd, buf, sizeof buf - 1);
+      if (n <= 0) {
+        close(cfd);
+        clients.erase(std::remove(clients.begin(), clients.end(), cfd),
+                      clients.end());
+        // drop any waiter on this fd
+        for (size_t w = 0; w < waiters.size();) {
+          if (waiters[w].fd == cfd) waiters.erase(waiters.begin() + w);
+          else w++;
+        }
+        continue;
+      }
+      buf[n] = '\0';
+      char *nl = strchr(buf, '\n');
+      if (nl) *nl = '\0';
+      std::string cmd(buf);
+      if (cmd == "status") {
+        reply(cfd, status_line(task));
+      } else if (cmd.rfind("wait", 0) == 0) {
+        if (task.exited) reply(cfd, status_line(task));
+        else waiters.push_back({cfd});
+      } else if (cmd.rfind("signal ", 0) == 0) {
+        int sig = atoi(cmd.c_str() + 7);
+        if (task.exited) reply(cfd, "err task exited");
+        else if (kill(-task.pid, sig) == 0) reply(cfd, "ok");
+        else reply(cfd, std::string("err ") + strerror(errno));
+      } else if (cmd.rfind("stop", 0) == 0) {
+        long grace_ms = 5000;
+        int sig = SIGTERM;
+        sscanf(cmd.c_str(), "stop %ld %d", &grace_ms, &sig);
+        if (!task.exited) {
+          kill(-task.pid, sig);
+          pending.armed = true;
+          pending.deadline_ns = now_ns() + grace_ms * 1000000LL;
+        }
+        reply(cfd, "ok");
+      } else if (cmd == "stats") {
+        long long ut = 0, st = 0, rss = 0;
+        if (!task.exited) read_proc_stats(task.pid, ut, st, rss);
+        long long cg_mem = -1;
+        if (!spec.cgroup.empty()) {
+          FILE *f = fopen((spec.cgroup + "/memory.current").c_str(), "r");
+          if (f) {
+            if (fscanf(f, "%lld", &cg_mem) != 1) cg_mem = -1;
+            fclose(f);
+          }
+        }
+        char out[256];
+        snprintf(out, sizeof out,
+                 "ok utime_ticks=%lld stime_ticks=%lld rss_bytes=%lld "
+                 "cgroup_mem_bytes=%lld hz=%ld",
+                 ut, st, rss, cg_mem, sysconf(_SC_CLK_TCK));
+        reply(cfd, out);
+      } else if (cmd == "shutdown") {
+        reply(cfd, "ok");
+        shutdown_req = true;
+      } else {
+        reply(cfd, "err unknown command");
+      }
+    }
+  }
+  unlink(spec.socket_path.c_str());
+  if (!spec.pidfile.empty()) unlink(spec.pidfile.c_str());
+  if (!spec.cgroup.empty()) rmdir(spec.cgroup.c_str());
+  return 0;
+}

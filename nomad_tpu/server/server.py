@@ -57,6 +57,12 @@ from .worker import TPUBatchWorker, Worker
 logger = logging.getLogger("nomad_tpu.server")
 
 
+class ConflictError(Exception):
+    """An expected operational rejection (HTTP 400-class), e.g. re-running
+    ACL bootstrap. Distinct from PermissionError so filesystem EACCES
+    never masquerades as a client error."""
+
+
 class Server:
     def __init__(
         self,
@@ -536,7 +542,7 @@ class Server:
 
         with self._acl_bootstrap_lock:
             if self.state.acl_has_management_token():
-                raise PermissionError("ACL bootstrap already done")
+                raise ConflictError("ACL bootstrap already done")
             token = ACLToken.new(name="Bootstrap Token", type="management")
             self.raft_apply("acl_token_upsert", [token])
             return self.state.acl_token_by_accessor(token.accessor_id)

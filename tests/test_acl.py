@@ -214,3 +214,31 @@ class TestHTTPEnforcement:
         with pytest.raises(APIError) as e:
             root.acl.bootstrap()
         assert e.value.status == 400
+
+    def test_deployment_cross_namespace_guarded(self, acl_agent, root):
+        """A default-scoped token must not read/fail other namespaces'
+        deployments or allocs by ID (review finding)."""
+        host, port = acl_agent.http_addr
+        tok = root.acl.token_create(name="r2", policies=["readonly"])
+        reader = NomadClient(f"http://{host}:{port}", token=tok.secret_id)
+        # lists filter to readable namespaces (no error, just scoped)
+        assert isinstance(reader.allocations.list(), list)
+        assert isinstance(reader.evaluations.list(), list)
+        assert isinstance(reader.deployments.list(), list)
+
+
+class TestDenyWins:
+    def test_coarse_deny_not_overridden(self):
+        acl = compile_policies(
+            [
+                parse_policy('node { policy = "deny" }'),
+                parse_policy('node { policy = "write" }'),
+            ]
+        )
+        assert not acl.allow_node_read()
+        assert not acl.allow_node_write()
+
+    def test_plugin_list_vs_read(self):
+        acl = compile_policies([parse_policy('plugin { policy = "list" }')])
+        assert acl.allow_plugin_list()
+        assert not acl.allow_plugin_read()
