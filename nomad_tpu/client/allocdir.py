@@ -1,0 +1,72 @@
+"""Per-allocation directory tree.
+
+Reference: client/allocdir/ (~1,500 LoC) — the shared alloc dir
+(SharedAllocDir: alloc/data, alloc/logs, alloc/tmp) plus per-task dirs
+(TaskDir: local, secrets, tmp, private). Chroot building for the exec
+driver is host-dependent and intentionally out of scope; the exec
+driver's isolation comes from the native executor's cgroup placement.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat
+
+
+SHARED_ALLOC_NAME = "alloc"
+
+
+class AllocDir:
+    def __init__(self, base_dir: str, alloc_id: str) -> None:
+        self.alloc_dir = os.path.join(base_dir, "allocs", alloc_id)
+        self.shared_dir = os.path.join(self.alloc_dir, SHARED_ALLOC_NAME)
+
+    # shared paths
+    @property
+    def logs_dir(self) -> str:
+        return os.path.join(self.shared_dir, "logs")
+
+    @property
+    def data_dir(self) -> str:
+        return os.path.join(self.shared_dir, "data")
+
+    @property
+    def tmp_dir(self) -> str:
+        return os.path.join(self.shared_dir, "tmp")
+
+    def build(self) -> None:
+        for d in (self.logs_dir, self.data_dir, self.tmp_dir):
+            os.makedirs(d, exist_ok=True)
+
+    def task_dir(self, task_name: str) -> "TaskDir":
+        return TaskDir(self.alloc_dir, task_name)
+
+    def build_task_dir(self, task_name: str) -> "TaskDir":
+        td = self.task_dir(task_name)
+        td.build()
+        return td
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.alloc_dir, ignore_errors=True)
+
+    def stdout_path(self, task_name: str) -> str:
+        return os.path.join(self.logs_dir, f"{task_name}.stdout.0")
+
+    def stderr_path(self, task_name: str) -> str:
+        return os.path.join(self.logs_dir, f"{task_name}.stderr.0")
+
+
+class TaskDir:
+    def __init__(self, alloc_dir: str, task_name: str) -> None:
+        self.dir = os.path.join(alloc_dir, task_name)
+        self.local_dir = os.path.join(self.dir, "local")
+        self.secrets_dir = os.path.join(self.dir, "secrets")
+        self.tmp_dir = os.path.join(self.dir, "tmp")
+
+    def build(self) -> None:
+        os.makedirs(self.local_dir, exist_ok=True)
+        os.makedirs(self.tmp_dir, exist_ok=True)
+        os.makedirs(self.secrets_dir, exist_ok=True)
+        # secrets are owner-only (reference: tmpfs mount 0700 when root)
+        os.chmod(self.secrets_dir, stat.S_IRWXU)

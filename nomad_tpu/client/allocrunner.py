@@ -23,6 +23,7 @@ from ..structs.structs import (
     JOB_TYPE_BATCH,
     JOB_TYPE_SYSBATCH,
 )
+from .allocdir import AllocDir
 from .allochealth import HealthTracker, new_deployment_status
 from .taskrunner import TaskRunner
 
@@ -36,11 +37,18 @@ class AllocRunner:
         drivers: dict[str, Driver],
         data_dir: str,
         on_update: Callable[[Allocation], None],
+        node=None,
+        state_db=None,
+        restore: bool = False,
     ) -> None:
         self.alloc = alloc.copy()
         self.drivers = drivers
-        self.alloc_dir = os.path.join(data_dir, "allocs", alloc.id)
+        self.allocdir = AllocDir(data_dir, alloc.id)
+        self.alloc_dir = self.allocdir.alloc_dir
         self.on_update = on_update
+        self.node = node
+        self.state_db = state_db  # persists task handles for reattach
+        self.restore = restore
         self.task_runners: dict[str, TaskRunner] = {}
         self._lock = threading.Lock()
         self._destroyed = False
@@ -48,8 +56,12 @@ class AllocRunner:
 
     # ------------------------------------------------------------------
 
+    def _on_handle(self, task_name: str, handle: dict) -> None:
+        if self.state_db is not None:
+            self.state_db.put_task_handle(self.alloc.id, task_name, handle)
+
     def run(self) -> None:
-        os.makedirs(self.alloc_dir, exist_ok=True)
+        self.allocdir.build()
         job = self.alloc.job
         tg = job.lookup_task_group(self.alloc.task_group) if job else None
         if tg is None:
@@ -58,6 +70,11 @@ class AllocRunner:
             self.on_update(self.alloc)
             return
         batch = job.type in (JOB_TYPE_BATCH, JOB_TYPE_SYSBATCH)
+        restored_states = (
+            self.state_db.get_task_states(self.alloc.id)
+            if (self.restore and self.state_db is not None)
+            else {}
+        )
         for task in tg.tasks:
             driver = self.drivers.get(task.driver)
             if driver is None:
@@ -67,13 +84,22 @@ class AllocRunner:
                 )
                 self.on_update(self.alloc)
                 return
+            restore_handle = None
+            if self.restore and self.state_db is not None:
+                restore_handle = self.state_db.get_task_handle(
+                    self.alloc.id, task.name
+                )
             tr = TaskRunner(
                 self.alloc,
                 task,
                 driver,
-                self.alloc_dir,
+                self.allocdir,
                 self._task_state_updated,
                 batch=batch,
+                node=self.node,
+                on_handle=self._on_handle,
+                restore_handle=restore_handle,
+                restore_state=restored_states.get(task.name),
             )
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():
@@ -102,6 +128,9 @@ class AllocRunner:
         with self._lock:
             states = {name: tr.state for name, tr in self.task_runners.items()}
             self.alloc.task_states = {k: v.copy() for k, v in states.items()}
+            if self.state_db is not None:
+                for name, st in states.items():
+                    self.state_db.put_task_state(self.alloc.id, name, st)
             failed = any(s.failed for s in states.values())
             all_dead = all(s.state == "dead" for s in states.values()) and states
             any_running = any(s.state == "running" for s in states.values())
@@ -153,6 +182,8 @@ class AllocRunner:
     def destroy(self) -> None:
         self._destroyed = True
         self.stop()
+        if self.state_db is not None:
+            self.state_db.delete_alloc(self.alloc.id)
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:
         return all(tr.wait(timeout_s) for tr in self.task_runners.values())

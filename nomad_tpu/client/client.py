@@ -73,6 +73,18 @@ class Client:
 
         self.node.computed_class = compute_node_class(self.node)
 
+        # Local persistence: allocs, task state, driver handles — so a
+        # restarted agent reattaches to live tasks (reference
+        # client/state/state_database.go; restore path client.go:325).
+        from .state_db import StateDB
+
+        self.state_db = StateDB(data_dir)
+        prev_node_id = self.state_db.get_meta("node_id")
+        if node is None and prev_node_id:
+            # keep our identity across restarts (reference: node ID file)
+            self.node.id = prev_node_id
+        self.state_db.put_meta("node_id", self.node.id)
+
         self.alloc_runners: dict[str, AllocRunner] = {}
         self._pending_updates: dict[str, Allocation] = {}
         self._lock = threading.Lock()
@@ -84,6 +96,7 @@ class Client:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
+        self._restore()
         # Registration happens ON the heartbeat thread with retries
         # (reference registerAndHeartbeat runs in a goroutine): agent boot
         # must not block on servers that are still electing a leader.
@@ -96,10 +109,16 @@ class Client:
             t.start()
             self._threads.append(t)
 
-    def shutdown(self) -> None:
+    def shutdown(self, kill_allocs: bool = True) -> None:
+        """kill_allocs=False = agent restart semantics: leave tasks
+        running under their executors and keep local state for the next
+        incarnation's restore (the reference's default — tasks outlive
+        the agent process)."""
         self._shutdown.set()
-        for ar in list(self.alloc_runners.values()):
-            ar.destroy()
+        if kill_allocs:
+            for ar in list(self.alloc_runners.values()):
+                ar.destroy()
+        self.state_db.close()
 
     # -- loops ---------------------------------------------------------
 
@@ -110,6 +129,11 @@ class Client:
         while not self._shutdown.is_set() and not self._registered.is_set():
             try:
                 self.heartbeat_ttl = self.rpc.register(self.node)
+                # Fingerprinting is already done, so promote to ready NOW
+                # (reference: updateNodeStatus(ready) right after the
+                # batched fingerprint completes) instead of letting the
+                # node sit `initializing` until the first TTL/2 beat.
+                self.heartbeat_ttl = self.rpc.heartbeat(self.node.id)
                 self._registered.set()
             except Exception:
                 logger.debug("registration failed; retrying")
@@ -158,15 +182,42 @@ class Client:
                     alloc.desired_status == ALLOC_DESIRED_STATUS_RUN
                     and not alloc.client_terminal_status()
                 ):
+                    self.state_db.put_alloc(alloc)
                     runner = AllocRunner(
-                        alloc, self.drivers, self.data_dir, self._alloc_updated
+                        alloc,
+                        self.drivers,
+                        self.data_dir,
+                        self._alloc_updated,
+                        node=self.node,
+                        state_db=self.state_db,
                     )
                     with self._lock:
                         self.alloc_runners[alloc_id] = runner
                     runner.run()
             else:
                 if alloc.modify_index > runner.alloc.modify_index:
+                    self.state_db.put_alloc(alloc)
                     runner.update(alloc)
+
+    def _restore(self) -> None:
+        """Recreate runners for persisted allocs, reattaching to live
+        tasks (reference client.go restore → allocRunner.Restore)."""
+        for alloc in self.state_db.get_allocs():
+            if alloc.client_terminal_status():
+                continue
+            runner = AllocRunner(
+                alloc,
+                self.drivers,
+                self.data_dir,
+                self._alloc_updated,
+                node=self.node,
+                state_db=self.state_db,
+                restore=True,
+            )
+            with self._lock:
+                self.alloc_runners[alloc.id] = runner
+            runner.run()
+            logger.info("restored alloc %s", alloc.id[:8])
 
     def _alloc_updated(self, alloc: Allocation) -> None:
         """AllocRunner reported a state change; queue for batched sync."""

@@ -1,0 +1,104 @@
+"""Task environment construction + interpolation.
+
+Reference: client/taskenv/ (~1,200 LoC) — env.go Builder assembles the
+NOMAD_* environment from alloc/task/node state; taskenv.ReplaceEnv
+interpolates ``${...}`` references in task config, constraints, and
+templates. Same surface here: `build_env` and `interpolate`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from ..structs import Allocation, Node, Task
+
+_VAR_RE = re.compile(r"\$\{([^}]+)\}")
+
+
+def build_env(
+    alloc: Allocation,
+    task: Task,
+    node: Optional[Node] = None,
+    alloc_dir: str = "",
+    task_dir: str = "",
+    secrets_dir: str = "",
+) -> dict[str, str]:
+    job = alloc.job
+    env: dict[str, str] = {
+        "NOMAD_ALLOC_ID": alloc.id,
+        "NOMAD_ALLOC_NAME": alloc.name,
+        "NOMAD_ALLOC_INDEX": str(alloc.index()),
+        "NOMAD_TASK_NAME": task.name,
+        "NOMAD_GROUP_NAME": alloc.task_group,
+        "NOMAD_JOB_ID": alloc.job_id,
+        "NOMAD_JOB_NAME": job.name if job else "",
+        "NOMAD_JOB_PARENT_ID": job.parent_id if job else "",
+        "NOMAD_NAMESPACE": alloc.namespace,
+        "NOMAD_REGION": job.region if job else "",
+        "NOMAD_CPU_LIMIT": str(task.resources.cpu),
+        "NOMAD_MEMORY_LIMIT": str(task.resources.memory_mb),
+    }
+    if alloc_dir:
+        env["NOMAD_ALLOC_DIR"] = alloc_dir
+    if task_dir:
+        env["NOMAD_TASK_DIR"] = task_dir
+    if secrets_dir:
+        env["NOMAD_SECRETS_DIR"] = secrets_dir
+    if node is not None:
+        env["NOMAD_DC"] = node.datacenter
+        env["node.unique.id"] = node.id
+        env["node.datacenter"] = node.datacenter
+        env["node.unique.name"] = node.name
+        env["node.class"] = node.node_class
+        for k, v in node.attributes.items():
+            env[f"attr.{k}"] = str(v)
+        for k, v in node.meta.items():
+            env[f"meta.{k}"] = str(v)
+    # merged meta: job < group < task (reference CombinedTaskMeta)
+    meta: dict[str, str] = {}
+    if job is not None:
+        meta.update(job.meta)
+        tg = job.lookup_task_group(alloc.task_group)
+        if tg is not None:
+            meta.update(tg.meta)
+    meta.update(task.meta)
+    for k, v in meta.items():
+        env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = v
+        env[f"NOMAD_META_{k}"] = v
+    # network ports (reference: NOMAD_PORT_<label> / NOMAD_ADDR_<label>)
+    if alloc.resources is not None:
+        tr = alloc.resources.tasks.get(task.name)
+        if tr is not None:
+            for net in tr.networks:
+                for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                    env[f"NOMAD_PORT_{p.label}"] = str(p.value)
+                    env[f"NOMAD_IP_{p.label}"] = net.ip
+                    env[f"NOMAD_ADDR_{p.label}"] = f"{net.ip}:{p.value}"
+                    if p.to:
+                        env[f"NOMAD_HOST_PORT_{p.label}"] = str(p.value)
+    for k, v in task.env.items():
+        env[k] = interpolate(v, env)
+    return env
+
+
+def interpolate(value: Any, env: dict[str, str]) -> Any:
+    """Replace ``${...}`` references with env values, recursively through
+    lists/dicts (reference taskenv.ReplaceEnv). Unknown references stay
+    literal, matching the reference's pass-through behavior."""
+    if isinstance(value, str):
+
+        def sub(m: re.Match) -> str:
+            key = m.group(1).strip()
+            if key in env:
+                return env[key]
+            if key.startswith("env."):
+                return env.get(key[4:], m.group(0))
+            return m.group(0)
+
+        return _VAR_RE.sub(sub, value)
+    if isinstance(value, list):
+        return [interpolate(v, env) for v in value]
+    if isinstance(value, dict):
+        return {k: interpolate(v, env) for k, v in value.items()}
+    return value

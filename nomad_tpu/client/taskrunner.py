@@ -1,26 +1,35 @@
 """Task runner: one task's lifecycle state machine.
 
 Reference: client/allocrunner/taskrunner/task_runner.go — the MAIN loop
-:516 (hooks → dispatch driver → wait → restart tracker → repeat), task
-event recording, kill handling. Round-1 hooks: task directory + env
-construction inline; artifact/template/logmon land with their subsystems.
+:516 (restore → hooks → dispatch driver → wait → restart tracker →
+repeat), task event recording, kill handling. Hook pipeline
+(task_runner_hooks.go:63-159 subset): task dir → env build → artifacts →
+templates → logmon → driver dispatch, with the driver handle persisted
+for reattach (Restore :1065).
 """
 
 from __future__ import annotations
 
 import logging
-import os
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 from ..drivers import Driver, DriverError, TaskConfig
-from ..structs import Allocation, Task, TaskState, now_ns
+from ..drivers.base import TaskHandle
+from ..structs import Allocation, Node, Task, TaskState, now_ns
+from .allocdir import AllocDir
+from .getter import ArtifactError, fetch_artifact
+from .logmon import LogRotator
 from .restarts import DECISION_RESTART, RestartTracker
+from .taskenv import build_env, interpolate
+from .template import TemplateError, render_template
 
 logger = logging.getLogger("nomad_tpu.taskrunner")
 
 EVENT_RECEIVED = "Received"
 EVENT_TASK_SETUP = "Task Setup"
+EVENT_ARTIFACTS = "Downloading Artifacts"
+EVENT_TEMPLATES = "Rendering Templates"
 EVENT_STARTED = "Started"
 EVENT_TERMINATED = "Terminated"
 EVENT_RESTARTING = "Restarting"
@@ -28,6 +37,8 @@ EVENT_NOT_RESTARTING = "Not Restarting"
 EVENT_KILLING = "Killing"
 EVENT_KILLED = "Killed"
 EVENT_DRIVER_FAILURE = "Driver Failure"
+EVENT_SETUP_FAILURE = "Setup Failure"
+EVENT_RESTORED = "Restored"
 
 
 class TaskRunner:
@@ -36,9 +47,13 @@ class TaskRunner:
         alloc: Allocation,
         task: Task,
         driver: Driver,
-        alloc_dir: str,
+        alloc_dir: AllocDir,
         on_state_change,
         batch: bool = False,
+        node: Optional[Node] = None,
+        on_handle: Optional[Callable[[str, dict], None]] = None,
+        restore_handle: Optional[dict] = None,
+        restore_state: Optional[TaskState] = None,
     ) -> None:
         self.alloc = alloc
         self.task = task
@@ -46,12 +61,16 @@ class TaskRunner:
         self.alloc_dir = alloc_dir
         self.on_state_change = on_state_change
         self.batch = batch
+        self.node = node
+        self.on_handle = on_handle  # persist driver handles (state db)
+        self.restore_handle = restore_handle
         self.task_id = f"{alloc.id[:8]}/{task.name}"
-        self.state = TaskState(state="pending")
+        self.state = restore_state or TaskState(state="pending")
         self.restart_tracker = RestartTracker(self._restart_policy())
         self._kill = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._rotators: list[LogRotator] = []
 
     def _restart_policy(self):
         from ..structs import RestartPolicy
@@ -69,38 +88,75 @@ class TaskRunner:
 
     def run(self) -> None:
         """The MAIN loop (reference task_runner.go:516)."""
+        try:
+            self._run()
+        finally:
+            for r in self._rotators:
+                r.stop()
+
+    def _run(self) -> None:
         self._event(EVENT_RECEIVED)
-        task_dir = os.path.join(self.alloc_dir, self.task.name)
-        os.makedirs(os.path.join(task_dir, "local"), exist_ok=True)
-        os.makedirs(os.path.join(task_dir, "secrets"), exist_ok=True)
+        task_dir = self.alloc_dir.build_task_dir(self.task.name)
+        env = build_env(
+            self.alloc,
+            self.task,
+            node=self.node,
+            alloc_dir=self.alloc_dir.shared_dir,
+            task_dir=task_dir.local_dir,
+            secrets_dir=task_dir.secrets_dir,
+        )
         self._event(EVENT_TASK_SETUP)
 
-        while not self._kill.is_set():
+        # Restore path: reattach to a live task instead of starting a new
+        # one (reference Restore :1065 → driver RecoverTask).
+        restored = False
+        if self.restore_handle is not None:
             try:
-                handle = self.driver.start_task(self._task_config(task_dir))
+                self.driver.recover_task(TaskHandle.from_dict(self.restore_handle))
+                restored = True
+                self._event(EVENT_RESTORED)
+                self.state.state = "running"
+                self.on_state_change()
             except DriverError as e:
-                self._event(EVENT_DRIVER_FAILURE, str(e))
-                decision, delay = self.restart_tracker.next_restart(
-                    exit_success=False, batch=self.batch
+                logger.info(
+                    "task %s: reattach failed (%s); restarting", self.task_id, e
                 )
-                if decision == DECISION_RESTART:
-                    self._kill.wait(delay)
-                    if not self._kill.is_set():
-                        self._event(EVENT_RESTARTING)
-                        continue
-                    break  # killed during backoff: killed, not failed
-                self._fail(f"driver failure: {e}")
-                return
 
-            self.state.state = "running"
-            self.state.started_at_ns = now_ns()
-            self._event(EVENT_STARTED)
-            self.on_state_change()
+        while not self._kill.is_set():
+            if not restored:
+                # prestart hooks: artifacts then templates
+                try:
+                    self._prestart(task_dir, env)
+                except (ArtifactError, TemplateError) as e:
+                    self._event(EVENT_SETUP_FAILURE, str(e))
+                    if not self._maybe_restart(success=False):
+                        return
+                    continue
+                try:
+                    handle = self.driver.start_task(
+                        self._task_config(task_dir, env)
+                    )
+                    if self.on_handle is not None:
+                        self.on_handle(self.task.name, handle.to_dict())
+                except DriverError as e:
+                    self._event(EVENT_DRIVER_FAILURE, str(e))
+                    if not self._maybe_restart(success=False):
+                        return
+                    continue
+                self.state.state = "running"
+                self.state.started_at_ns = now_ns()
+                self._event(EVENT_STARTED)
+                self.on_state_change()
+                self._start_logmon()
+            restored = False
 
             # wait for exit OR kill
             result = None
             while result is None and not self._kill.is_set():
-                result = self.driver.wait_task(self.task_id, timeout_s=0.2)
+                try:
+                    result = self.driver.wait_task(self.task_id, timeout_s=0.2)
+                except DriverError:
+                    break
             if self._kill.is_set():
                 self._event(EVENT_KILLING)
                 try:
@@ -114,6 +170,12 @@ class TaskRunner:
                 self.on_state_change()
                 self._done.set()
                 return
+            if result is None:
+                # driver lost track of the task (e.g. reattach went stale)
+                self._event(EVENT_DRIVER_FAILURE, "task lost")
+                if not self._maybe_restart(success=False):
+                    return
+                continue
 
             success = result.successful()
             self._event(
@@ -133,28 +195,8 @@ class TaskRunner:
                 self._done.set()
                 return
 
-            decision, delay = self.restart_tracker.next_restart(
-                exit_success=success, batch=self.batch
-            )
-            if decision == DECISION_RESTART:
-                self.state.restarts += 1
-                self.state.last_restart_ns = now_ns()
-                self._event(EVENT_RESTARTING, f"in {delay:.1f}s")
-                self.on_state_change()
-                self._kill.wait(delay)
-                continue  # outer loop re-checks the kill flag
-            # no more restarts
-            if success:
-                self.state.state = "dead"
-                self.state.failed = False
-            else:
-                self._event(EVENT_NOT_RESTARTING)
-                self.state.failed = True
-                self.state.state = "dead"
-            self.state.finished_at_ns = now_ns()
-            self.on_state_change()
-            self._done.set()
-            return
+            if not self._maybe_restart(success=success):
+                return
         # Killed while between runs (e.g. during a restart delay).
         if self.state.state != "dead":
             self.state.state = "dead"
@@ -163,62 +205,79 @@ class TaskRunner:
             self.on_state_change()
         self._done.set()
 
+    # -- hooks ---------------------------------------------------------
+
+    def _prestart(self, task_dir, env: dict[str, str]) -> None:
+        if self.task.artifacts:
+            self._event(EVENT_ARTIFACTS)
+            for artifact in self.task.artifacts:
+                fetch_artifact(artifact, task_dir.dir, env)
+        if self.task.templates:
+            self._event(EVENT_TEMPLATES)
+            for tmpl in self.task.templates:
+                render_template(tmpl, task_dir.dir, env)
+
+    def _start_logmon(self) -> None:
+        for r in self._rotators:
+            r.stop()
+        self._rotators = []
+        lc = self.task.log_config
+        for path in (
+            self.alloc_dir.stdout_path(self.task.name),
+            self.alloc_dir.stderr_path(self.task.name),
+        ):
+            rot = LogRotator(
+                path,
+                max_files=lc.max_files,
+                max_file_size_mb=lc.max_file_size_mb,
+            )
+            rot.start()
+            self._rotators.append(rot)
+
+    def _maybe_restart(self, success: bool) -> bool:
+        """Consult the restart tracker. False ⇒ terminal (caller returns)."""
+        decision, delay = self.restart_tracker.next_restart(
+            exit_success=success, batch=self.batch
+        )
+        if decision == DECISION_RESTART:
+            self.state.restarts += 1
+            self.state.last_restart_ns = now_ns()
+            self._event(EVENT_RESTARTING, f"in {delay:.1f}s")
+            self.on_state_change()
+            self._kill.wait(delay)
+            return True
+        if success:
+            self.state.state = "dead"
+            self.state.failed = False
+        else:
+            self._event(EVENT_NOT_RESTARTING)
+            self.state.failed = True
+            self.state.state = "dead"
+        self.state.finished_at_ns = now_ns()
+        self.on_state_change()
+        self._done.set()
+        return False
+
     def kill(self) -> None:
         self._kill.set()
 
     def wait(self, timeout_s: Optional[float] = None) -> bool:
         return self._done.wait(timeout_s)
 
-    def _fail(self, reason: str) -> None:
-        self.state.state = "dead"
-        self.state.failed = True
-        self.state.finished_at_ns = now_ns()
-        self.on_state_change()
-        self._done.set()
-
-    def _task_config(self, task_dir: str) -> TaskConfig:
-        env = dict(self.task.env)
-        env.update(self._nomad_env())
+    def _task_config(self, task_dir, env: dict[str, str]) -> TaskConfig:
         return TaskConfig(
             id=self.task_id,
             name=self.task.name,
             alloc_id=self.alloc.id,
             env=env,
-            config=dict(self.task.config),
+            config=interpolate(dict(self.task.config), env),
             resources_cpu=self.task.resources.cpu,
             resources_memory_mb=self.task.resources.memory_mb,
-            task_dir=task_dir,
-            stdout_path=os.path.join(task_dir, f"{self.task.name}.stdout.log"),
-            stderr_path=os.path.join(task_dir, f"{self.task.name}.stderr.log"),
+            task_dir=task_dir.dir,
+            stdout_path=self.alloc_dir.stdout_path(self.task.name),
+            stderr_path=self.alloc_dir.stderr_path(self.task.name),
             user=self.task.user,
         )
-
-    def _nomad_env(self) -> dict[str, str]:
-        """NOMAD_* task environment (reference client/taskenv)."""
-        alloc = self.alloc
-        env = {
-            "NOMAD_ALLOC_ID": alloc.id,
-            "NOMAD_ALLOC_NAME": alloc.name,
-            "NOMAD_ALLOC_INDEX": str(alloc.index()),
-            "NOMAD_TASK_NAME": self.task.name,
-            "NOMAD_GROUP_NAME": alloc.task_group,
-            "NOMAD_JOB_ID": alloc.job_id,
-            "NOMAD_JOB_NAME": alloc.job.name if alloc.job else "",
-            "NOMAD_NAMESPACE": alloc.namespace,
-            "NOMAD_DC": "",
-            "NOMAD_CPU_LIMIT": str(self.task.resources.cpu),
-            "NOMAD_MEMORY_LIMIT": str(self.task.resources.memory_mb),
-        }
-        if alloc.resources is not None:
-            tr = alloc.resources.tasks.get(self.task.name)
-            if tr is not None:
-                for net in tr.networks:
-                    for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                        env[f"NOMAD_PORT_{p.label}"] = str(p.value)
-                        env[f"NOMAD_IP_{p.label}"] = net.ip
-        for k, v in self.task.meta.items():
-            env[f"NOMAD_META_{k.upper()}"] = v
-        return env
 
     def _event(self, etype: str, details: str = "") -> None:
         self.state.events.append(

@@ -1,0 +1,74 @@
+"""Template rendering.
+
+Reference: client/allocrunner/taskrunner/template/template.go (759 LoC,
+consul-template). Without Consul/Vault in the tree, the supported
+function set is the env-shaped subset real jobspecs rely on:
+
+    {{ env "NOMAD_ALLOC_ID" }}
+    {{ key "path" }}          -> empty string (no Consul KV)
+    {{ meta "k" }}            -> NOMAD_META_k
+    ${NOMAD_...}              -> plain interpolation
+
+change_mode restart/signal/noop is honored by the task runner on
+re-render; templates render once before task start (the reference's
+initial render gate — prestart blocks until all templates render).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from ..structs.structs import Template
+
+_FUNC_RE = re.compile(r"\{\{\s*(env|key|meta)\s+\"([^\"]+)\"\s*\}\}")
+
+
+class TemplateError(Exception):
+    pass
+
+
+def render_template(
+    tmpl: Template, task_dir: str, env: dict[str, str]
+) -> str:
+    """Render to task_dir/<dest_path>; returns the destination path."""
+    from .taskenv import interpolate
+
+    if tmpl.embedded_tmpl:
+        src = tmpl.embedded_tmpl
+    elif tmpl.source_path:
+        path = interpolate(tmpl.source_path, env)
+        if not os.path.isabs(path):
+            path = os.path.join(task_dir, path)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError as e:
+            raise TemplateError(f"template source: {e}") from e
+    else:
+        raise TemplateError("template has neither data nor source")
+
+    def repl(m: re.Match) -> str:
+        fn, arg = m.group(1), m.group(2)
+        if fn == "env":
+            return env.get(arg, "")
+        if fn == "meta":
+            return env.get(f"NOMAD_META_{arg}", env.get(f"meta.{arg}", ""))
+        return ""  # key: no Consul KV backend
+
+    rendered = _FUNC_RE.sub(repl, src)
+    rendered = interpolate(rendered, env)
+
+    dest = interpolate(tmpl.dest_path, env)
+    if not dest:
+        raise TemplateError("template missing destination")
+    if not os.path.isabs(dest):
+        dest = os.path.join(task_dir, dest)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    with open(dest, "w") as f:
+        f.write(rendered)
+    try:
+        os.chmod(dest, int(tmpl.perms or "0644", 8))
+    except ValueError:
+        pass
+    return dest
