@@ -68,6 +68,9 @@ class AgentConfig:
     server_join: list = field(default_factory=list)
     # acl stanza
     acl_enabled: bool = False
+    # cluster shared secret authenticating the RPC fabric (rpc/server.py
+    # trust-boundary note); empty ⇒ dev-mode trust-the-network
+    rpc_secret: str = ""
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -98,6 +101,7 @@ class Agent:
                 use_tpu_batch_worker=config.use_tpu_batch_worker,
                 region=config.region,
                 bootstrap_expect=expect,
+                rpc_secret=config.rpc_secret,
             )
         if config.client_enabled:
             if self.server is not None:
@@ -110,7 +114,10 @@ class Agent:
             else:
                 if not config.client_servers:
                     raise ValueError("client agent needs `servers` addresses")
-                rpc = ClusterRPC([tuple(a) for a in config.client_servers])
+                rpc = ClusterRPC(
+                    [tuple(a) for a in config.client_servers],
+                    rpc_secret=config.rpc_secret,
+                )
             self.client = Client(
                 rpc,
                 data_dir=config.data_dir,

@@ -314,7 +314,11 @@ class HTTPAgentServer:
             return e
 
         def eval_allocs(p, q, body, tok):
-            return srv.state.allocs_by_eval(p["id"])
+            # Filter by each alloc's own namespace: a token scoped to one
+            # namespace must not enumerate another namespace's allocs.
+            return self._ns_filter(
+                tok, srv.state.allocs_by_eval(p["id"]), "read-job"
+            )
 
         route("GET", "/v1/allocations", allocs_list)
         route("GET", "/v1/allocation/(?P<id>[^/]+)", alloc_get)

@@ -145,7 +145,14 @@ def _load_agent_config(path: str):
         return cfg
     body = parse_hcl(src)
     a = body.attrs()
-    for k in ("region", "datacenter", "data_dir", "bind_addr", "node_name"):
+    for k in (
+        "region",
+        "datacenter",
+        "data_dir",
+        "bind_addr",
+        "node_name",
+        "rpc_secret",
+    ):
         if k in a:
             setattr(cfg, k, a[k])
     sb = body.block("server")

@@ -17,6 +17,33 @@ import stat
 SHARED_ALLOC_NAME = "alloc"
 
 
+class EscapeError(Exception):
+    """A job-controlled path tried to escape its sandbox."""
+
+
+def alloc_sandbox(task_dir: str) -> str:
+    """The confinement root for a task's job-controlled paths: the alloc
+    dir (its task dirs and the shared alloc/ dir all live under it)."""
+    return os.path.dirname(os.path.realpath(task_dir))
+
+
+def confine(base_dir: str, path: str) -> str:
+    """Resolve `path` and require it to stay inside `base_dir`.
+
+    Job-controlled paths (template dest/source, artifact dests) must not
+    reach outside the alloc dir — the reference sandboxes the same way
+    (go-getter dest + consul-template path escapes were upstream CVEs).
+    Symlinks are resolved before the containment check.
+    """
+    base = os.path.realpath(base_dir)
+    resolved = os.path.realpath(
+        path if os.path.isabs(path) else os.path.join(base, path)
+    )
+    if resolved != base and not resolved.startswith(base + os.sep):
+        raise EscapeError(f"path {path!r} escapes alloc dir {base_dir!r}")
+    return resolved
+
+
 class AllocDir:
     def __init__(self, base_dir: str, alloc_id: str) -> None:
         self.alloc_dir = os.path.join(base_dir, "allocs", alloc_id)

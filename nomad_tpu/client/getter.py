@@ -25,20 +25,40 @@ class ArtifactError(Exception):
     pass
 
 
+def _file_artifacts_allowed() -> bool:
+    """file:// and bare-path artifact sources read host files as the
+    agent user; operators can disable them (the reference gates
+    filesystem isolation per-agent the same way)."""
+    return os.environ.get("NOMAD_TPU_ARTIFACT_ALLOW_FILE", "1") != "0"
+
+
 def fetch_artifact(
-    artifact: TaskArtifact, task_dir: str, env: dict[str, str] | None = None
+    artifact: TaskArtifact,
+    task_dir: str,
+    env: dict[str, str] | None = None,
+    allow_file: bool | None = None,
 ) -> str:
     """Fetch into task_dir/<relative_dest>; returns the destination."""
+    from .allocdir import EscapeError, alloc_sandbox, confine
     from .taskenv import interpolate
 
     env = env or {}
     source = interpolate(artifact.getter_source, env)
     dest_rel = interpolate(artifact.relative_dest or "local/", env)
-    dest = os.path.join(task_dir, dest_rel)
+    # Job-controlled dest must stay inside the alloc dir.
+    sandbox = alloc_sandbox(task_dir)
+    try:
+        dest = confine(sandbox, os.path.join(task_dir, dest_rel))
+    except EscapeError as e:
+        raise ArtifactError(str(e)) from e
     os.makedirs(dest, exist_ok=True)
 
     parsed = urllib.parse.urlparse(source)
     if parsed.scheme in ("", "file"):
+        if not (_file_artifacts_allowed() if allow_file is None else allow_file):
+            raise ArtifactError(
+                "file artifacts disabled (NOMAD_TPU_ARTIFACT_ALLOW_FILE=0)"
+            )
         local = parsed.path if parsed.scheme == "file" else source
         if not os.path.exists(local):
             raise ArtifactError(f"artifact not found: {local}")
@@ -66,9 +86,20 @@ def fetch_artifact(
 
     mode = artifact.getter_mode or "any"
     if mode in ("any", "dir") and fetched.endswith(ARCHIVE_EXTS):
+        import tarfile
+
         try:
-            shutil.unpack_archive(fetched, dest)
+            if fetched.endswith(".zip"):
+                # zipfile sanitizes member paths itself; tar needs the
+                # 'data' filter to block ../-traversal and device nodes.
+                shutil.unpack_archive(fetched, dest)
+            else:
+                shutil.unpack_archive(fetched, dest, filter="data")
             os.unlink(fetched)
+        except tarfile.FilterError as e:
+            # A traversal attempt is an error in EVERY mode, never a
+            # silently-ignored "not an archive".
+            raise ArtifactError(f"unsafe archive {fetched}: {e}") from e
         except (shutil.ReadError, ValueError) as e:
             if mode == "dir":
                 raise ArtifactError(f"unpack {fetched}: {e}") from e

@@ -32,7 +32,10 @@ def render_template(
     tmpl: Template, task_dir: str, env: dict[str, str]
 ) -> str:
     """Render to task_dir/<dest_path>; returns the destination path."""
+    from .allocdir import EscapeError, alloc_sandbox, confine
     from .taskenv import interpolate
+
+    sandbox = alloc_sandbox(task_dir)
 
     if tmpl.embedded_tmpl:
         src = tmpl.embedded_tmpl
@@ -40,6 +43,10 @@ def render_template(
         path = interpolate(tmpl.source_path, env)
         if not os.path.isabs(path):
             path = os.path.join(task_dir, path)
+        try:
+            path = confine(sandbox, path)
+        except EscapeError as e:
+            raise TemplateError(str(e)) from e
         try:
             with open(path) as f:
                 src = f.read()
@@ -64,6 +71,10 @@ def render_template(
         raise TemplateError("template missing destination")
     if not os.path.isabs(dest):
         dest = os.path.join(task_dir, dest)
+    try:
+        dest = confine(sandbox, dest)
+    except EscapeError as e:
+        raise TemplateError(str(e)) from e
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     with open(dest, "w") as f:
         f.write(rendered)

@@ -128,6 +128,57 @@ class ExecutorHandle:
             return False
 
 
+# AF_UNIX sun_path is 108 bytes on Linux; leave headroom.
+_SUN_PATH_MAX = 100
+
+
+def _socket_path(task_dir: str) -> str:
+    """Short, stable control-socket path for a task.
+
+    The socket can NOT live under the task dir: pytest tmp_paths (and
+    real data_dirs) routinely push the alloc-dir path past the 108-byte
+    sun_path limit and bind() fails.  Key a short /tmp path by task-dir
+    hash instead — deterministic, so a restarted agent recomputes the
+    same path even if its state record predates this scheme.
+    """
+    run_root = os.environ.get("NOMAD_TPU_RUN_DIR")
+    if not run_root:
+        run_root = f"/tmp/nomadx-{os.getuid()}"
+    os.makedirs(run_root, mode=0o700, exist_ok=True)
+    # /tmp is a shared namespace: refuse a squatted dir (pre-created by
+    # another user, or loosened perms) the same way sshd treats its run
+    # dir — otherwise a local user could hijack root's control sockets.
+    st = os.stat(run_root)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o077):
+        raise ExecutorError(
+            f"run dir {run_root} has unsafe owner/mode "
+            f"(uid={st.st_uid}, mode={oct(st.st_mode & 0o777)})"
+        )
+    tag = hashlib.sha256(os.path.abspath(task_dir).encode()).hexdigest()[:16]
+    sock = os.path.join(run_root, f"{tag}.sock")
+    if len(sock) > _SUN_PATH_MAX:
+        raise ExecutorError(
+            f"socket path too long ({len(sock)} > {_SUN_PATH_MAX}): {sock}"
+        )
+    return sock
+
+
+def _esc(val: str) -> str:
+    """Escape a spec value for the executor's line/tab-framed format.
+
+    Spec values are job-controlled (env vars, args); a raw newline or
+    tab would inject spec directives into the C++ parser (user, stdout,
+    ... — privilege escalation when the agent runs as root).  The
+    executor unescapes symmetrically.
+    """
+    return (
+        val.replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace("\r", "\\r")
+        .replace("\t", "\\t")
+    )
+
+
 def launch_executor(
     task_dir: str,
     command: str,
@@ -146,28 +197,48 @@ def launch_executor(
     binary = executor_binary(cache_dir)
     ctl_dir = Path(task_dir)
     ctl_dir.mkdir(parents=True, exist_ok=True)
-    sock = str(ctl_dir / "executor.sock")
+    sock = _socket_path(task_dir)
     spec_path = str(ctl_dir / "executor.spec")
-    lines = [f"command\t{command}"]
-    lines += [f"arg\t{a}" for a in args]
-    lines += [f"env\t{k}={v}" for k, v in env.items()]
+    for k in env:
+        if "=" in k:
+            raise ExecutorError(f"invalid env key {k!r}")
+    lines = [f"command\t{_esc(command)}"]
+    lines += [f"arg\t{_esc(a)}" for a in args]
+    lines += [f"env\t{_esc(f'{k}={v}')}" for k, v in env.items()]
     if cwd:
-        lines.append(f"cwd\t{cwd}")
+        lines.append(f"cwd\t{_esc(cwd)}")
     if stdout_path:
-        lines.append(f"stdout\t{stdout_path}")
+        lines.append(f"stdout\t{_esc(stdout_path)}")
     if stderr_path:
-        lines.append(f"stderr\t{stderr_path}")
-    lines.append(f"socket\t{sock}")
-    lines.append(f"pidfile\t{ctl_dir / 'executor.pid'}")
+        lines.append(f"stderr\t{_esc(stderr_path)}")
+    lines.append(f"socket\t{_esc(sock)}")
+    lines.append(f"pidfile\t{_esc(str(ctl_dir / 'executor.pid'))}")
     if user:
-        lines.append(f"user\t{user}")
+        lines.append(f"user\t{_esc(user)}")
     if cgroup:
-        lines.append(f"cgroup\t{cgroup}")
+        lines.append(f"cgroup\t{_esc(cgroup)}")
         if memory_max_bytes:
             lines.append(f"memory_max\t{memory_max_bytes}")
         if cpu_weight:
             lines.append(f"cpu_weight\t{cpu_weight}")
     Path(spec_path).write_text("\n".join(lines) + "\n")
+
+    # Stdout/stderr are opened AFTER the setuid drop in the executor
+    # (so an injected path could never be opened as root); pre-create
+    # and chown them here so an unprivileged task user can still append.
+    if user and os.geteuid() == 0:
+        import pwd
+
+        try:
+            pw = pwd.getpwnam(user)
+        except KeyError:
+            pw = None
+        if pw is not None:
+            for p in (stdout_path, stderr_path):
+                if not p:
+                    continue
+                Path(p).touch(exist_ok=True)
+                os.chown(p, pw.pw_uid, pw.pw_gid)
 
     proc = subprocess.run(
         [binary, spec_path], capture_output=True, text=True, timeout=30

@@ -54,6 +54,28 @@ struct Spec {
   std::string user;
 };
 
+// Values are backslash-escaped by the launcher (\\ \n \r \t) so that
+// job-controlled strings (env, args) can never inject spec directives.
+static std::string unescape(const std::string &in) {
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); i++) {
+    if (in[i] != '\\' || i + 1 >= in.size()) {
+      out.push_back(in[i]);
+      continue;
+    }
+    char c = in[++i];
+    switch (c) {
+      case 'n': out.push_back('\n'); break;
+      case 'r': out.push_back('\r'); break;
+      case 't': out.push_back('\t'); break;
+      case '\\': out.push_back('\\'); break;
+      default: out.push_back(c); break;
+    }
+  }
+  return out;
+}
+
 static bool read_spec(const char *path, Spec &s) {
   FILE *f = fopen(path, "r");
   if (!f) return false;
@@ -65,7 +87,7 @@ static bool read_spec(const char *path, Spec &s) {
     char *tab = strchr(line, '\t');
     if (!tab) continue;
     *tab = '\0';
-    std::string key = line, val = tab + 1;
+    std::string key = line, val = unescape(tab + 1);
     if (key == "command") s.command = val;
     else if (key == "arg") s.args.push_back(val);
     else if (key == "env") s.env.push_back(val);
@@ -135,14 +157,6 @@ static pid_t spawn_task(const Spec &s, bool join_cgroup) {
       close(fd);
     }
   }
-  if (!s.stdout_path.empty()) {
-    int fd = open(s.stdout_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
-    if (fd >= 0) { dup2(fd, 1); close(fd); }
-  }
-  if (!s.stderr_path.empty()) {
-    int fd = open(s.stderr_path.c_str(), O_WRONLY | O_CREAT | O_APPEND, 0644);
-    if (fd >= 0) { dup2(fd, 2); close(fd); }
-  }
   if (!s.cwd.empty() && chdir(s.cwd.c_str()) != 0) _exit(126);
   if (!s.user.empty() && getuid() == 0) {
     struct passwd *pw = getpwnam(s.user.c_str());
@@ -151,6 +165,19 @@ static pid_t spawn_task(const Spec &s, bool join_cgroup) {
           setgid(pw->pw_gid) != 0 || setuid(pw->pw_uid) != 0)
         _exit(126);
     }
+  }
+  // Open log sinks only AFTER the privilege drop: a hostile stdout path
+  // must never be opened with root credentials (the launcher pre-creates
+  // and chowns the real log files so the task user can append).
+  if (!s.stdout_path.empty()) {
+    int fd = open(s.stdout_path.c_str(),
+                  O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0644);
+    if (fd >= 0) { dup2(fd, 1); close(fd); }
+  }
+  if (!s.stderr_path.empty()) {
+    int fd = open(s.stderr_path.c_str(),
+                  O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0644);
+    if (fd >= 0) { dup2(fd, 2); close(fd); }
   }
   std::vector<char *> argv;
   argv.push_back(const_cast<char *>(s.command.c_str()));

@@ -29,11 +29,15 @@ class _Conn:
     """One pipelined connection: writer = any caller thread (locked),
     reader = dedicated thread demuxing responses by seq."""
 
-    def __init__(self, addr: tuple[str, int], connect_timeout_s: float) -> None:
+    def __init__(
+        self, addr: tuple[str, int], connect_timeout_s: float, secret: str = ""
+    ) -> None:
         self.sock = socket.create_connection(addr, timeout=connect_timeout_s)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
         self.sock.sendall(bytes([BYTE_RPC]))
+        if secret:
+            send_frame(self.sock, secret.encode())
         self._wlock = threading.Lock()
         self._seq = itertools.count()
         self._pending: dict[int, dict] = {}
@@ -110,10 +114,11 @@ class _Conn:
 class ConnPool:
     """Pooled RPC connections keyed by address (reference helper/pool)."""
 
-    def __init__(self, connect_timeout_s: float = 5.0) -> None:
+    def __init__(self, connect_timeout_s: float = 5.0, secret: str = "") -> None:
         self._conns: dict[tuple[str, int], _Conn] = {}
         self._lock = threading.Lock()
         self._connect_timeout_s = connect_timeout_s
+        self.secret = secret
 
     def call(
         self,
@@ -145,6 +150,8 @@ class ConnPool:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
         sock.sendall(bytes([BYTE_STREAMING]))
+        if self.secret:
+            send_frame(sock, self.secret.encode())
         session = StreamSession(sock)
         hdr = dict(header or {})
         hdr["method"] = method
@@ -160,7 +167,7 @@ class ConnPool:
             conn = self._conns.get(addr)
             if conn is not None and not conn.dead:
                 return conn
-            conn = _Conn(addr, self._connect_timeout_s)
+            conn = _Conn(addr, self._connect_timeout_s, self.secret)
             self._conns[addr] = conn
             return conn
 

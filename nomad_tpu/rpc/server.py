@@ -12,6 +12,14 @@ connection (net/rpc semantics — responses may arrive out of order, matched
 by seq). Streaming connections hand the raw socket to the registered
 stream handler. Raft connections are dispatched to the raft transport
 handler installed by the replication layer.
+
+Trust boundary: the fabric authenticates PEERS, not requests — when a
+cluster `secret` is configured every connection (RPC, streaming, raft)
+must present it in a preamble frame right after the protocol byte, or
+it is dropped. This is the reference's mTLS-on-the-fabric posture in
+shared-secret form: any authenticated peer (server or client agent) may
+invoke any endpoint; per-request ACL capability checks happen at the
+HTTP layer. Without a secret the fabric trusts the network (dev mode).
 """
 
 from __future__ import annotations
@@ -59,7 +67,9 @@ class RPCServer:
         host: str = "127.0.0.1",
         port: int = 0,
         num_workers: int = 8,
+        secret: str = "",
     ) -> None:
+        self.secret = secret
         self._endpoints: dict[str, object] = {}
         self._stream_handlers: dict[str, Callable[[StreamSession, dict], None]] = {}
         self.raft_handler: Optional[Callable[[StreamSession], None]] = None
@@ -162,12 +172,33 @@ class RPCServer:
         except OSError:
             pass
 
+    def _authenticate(self, conn: socket.socket) -> bool:
+        """When a cluster secret is configured, require the auth
+        preamble frame before serving any protocol."""
+        if not self.secret:
+            return True
+        import hmac
+
+        conn.settimeout(10.0)
+        try:
+            presented = recv_frame(conn)
+        except (ConnectionError, OSError):
+            return False
+        finally:
+            conn.settimeout(None)
+        if not hmac.compare_digest(presented, self.secret.encode()):
+            logger.warning("rpc connection rejected: bad cluster secret")
+            return False
+        return True
+
     def _handle_conn(self, conn: socket.socket) -> None:
         try:
             first = conn.recv(1)
             if not first:
                 return
             proto = first[0]
+            if not self._authenticate(conn):
+                return
             if proto == BYTE_RPC:
                 self._handle_rpc_conn(conn)
             elif proto == BYTE_STREAMING:

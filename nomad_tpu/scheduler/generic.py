@@ -118,6 +118,7 @@ class GenericScheduler:
             # so the job eventually retries (reference: generic_sched.go:161).
             if self.eval.status != "blocked":
                 follow = self.eval.create_blocked_eval({}, True, "", self.failed_tg_allocs)
+                follow.snapshot_index = self.state.index
                 follow.triggered_by = EVAL_TRIGGER_MAX_PLANS
                 follow.status_description = BLOCKED_EVAL_MAX_PLAN_DESC
                 self.planner.create_eval(follow)
@@ -326,6 +327,9 @@ class GenericScheduler:
             self.ctx.eligibility.quota_reached,
             self.failed_tg_allocs,
         )
+        # The snapshot this placement failed against: blocked_evals uses
+        # it to detect capacity that appeared while we were scheduling.
+        e.snapshot_index = self.state.index
         e.status_description = BLOCKED_EVAL_FAILED_PLACEMENTS
         self.planner.create_eval(e)
         self.blocked = e

@@ -26,6 +26,12 @@ class BlockedEvals:
         self._escaped: dict[str, Evaluation] = {}
         # (ns, job) -> blocked eval id (one blocked eval per job)
         self._by_job: dict[tuple[str, str], str] = {}
+        # computed class -> state index of the last capacity change for
+        # that class (reference unblockIndexes): closes the lost-wakeup
+        # race where capacity appears BETWEEN the scheduler's snapshot
+        # and the eval landing here.
+        self._unblock_indexes: dict[str, int] = {}
+        self._global_unblock_index = 0
         self.stats = {"total_blocked": 0, "total_escaped": 0, "unblocks": 0}
 
     def set_enabled(self, enabled: bool) -> None:
@@ -36,9 +42,31 @@ class BlockedEvals:
                 self._escaped.clear()
                 self._by_job.clear()
 
+    def _missed_unblock(self, ev: Evaluation) -> bool:
+        """Did a capacity change land after this eval's snapshot?
+        (reference blocked_evals.go missedUnblock)"""
+        if ev.escaped_computed_class or not ev.class_eligibility:
+            return self._global_unblock_index > ev.snapshot_index
+        for cls, index in self._unblock_indexes.items():
+            if index <= ev.snapshot_index:
+                continue
+            elig = ev.class_eligibility.get(cls)
+            if elig is None or elig:
+                return True
+        return False
+
     def block(self, ev: Evaluation) -> None:
         with self._lock:
             if not self._enabled:
+                return
+            if self._missed_unblock(ev):
+                # Don't park it — the capacity it failed to find already
+                # appeared. Hand it straight back to the broker.
+                self.stats["unblocks"] += 1
+                requeued = ev.copy()
+                requeued.status = "pending"
+                requeued.triggered_by = "queued-allocs"
+                self.enqueue_fn(requeued)
                 return
             key = (ev.namespace, ev.job_id)
             # newest blocked eval per job wins (the state store cancels the
@@ -65,12 +93,21 @@ class BlockedEvals:
 
     # -- unblock triggers ---------------------------------------------
 
-    def unblock(self, computed_class: str) -> None:
-        """Capacity freed/added on nodes of this class (reference Unblock)."""
+    def unblock(self, computed_class: str, index: int = 0) -> None:
+        """Capacity freed/added on nodes of this class (reference Unblock).
+        `index` is the state index of the capacity change; future blocks
+        with an older snapshot are re-enqueued immediately."""
         to_run: list[Evaluation] = []
         with self._lock:
             if not self._enabled:
                 return
+            if index:
+                self._unblock_indexes[computed_class] = max(
+                    self._unblock_indexes.get(computed_class, 0), index
+                )
+                self._global_unblock_index = max(
+                    self._global_unblock_index, index
+                )
             for eid in list(self._escaped):
                 to_run.append(self._escaped.pop(eid))
             for eid, ev in list(self._captured.items()):

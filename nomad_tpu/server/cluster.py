@@ -326,12 +326,13 @@ class ClusterServer:
         use_tpu_batch_worker: bool = False,
         region: str = "global",
         bootstrap_expect: Optional[int] = None,
+        rpc_secret: str = "",
         **raft_kw,
     ) -> None:
         self.node_id = node_id
         self.region = region
-        self.rpc = RPCServer(host=host, port=port)
-        self.pool = ConnPool()
+        self.rpc = RPCServer(host=host, port=port, secret=rpc_secret)
+        self.pool = ConnPool(secret=rpc_secret)
         self.server = Server(
             num_workers=num_workers, use_tpu_batch_worker=use_tpu_batch_worker
         )
@@ -499,9 +500,14 @@ class ClusterRPC:
     ServerRPC shim (client/client.py).
     """
 
-    def __init__(self, addrs: list[tuple[str, int]], pool: Optional[ConnPool] = None):
+    def __init__(
+        self,
+        addrs: list[tuple[str, int]],
+        pool: Optional[ConnPool] = None,
+        rpc_secret: str = "",
+    ):
         self.addrs = [tuple(a) for a in addrs]
-        self.pool = pool or ConnPool()
+        self.pool = pool or ConnPool(secret=rpc_secret)
         # The client's heartbeat and watch threads share this object;
         # rotation must be atomic or concurrent failures double-rotate
         # past live servers.

@@ -702,10 +702,17 @@ class RaftNode:
                 if idx == self._last_log_index() + 1:
                     self._log.append(LogEntry(idx, eterm, msg_type, payload))
             if args["leader_commit"] > self.commit_index:
-                self.commit_index = min(
-                    args["leader_commit"], self._last_log_index()
+                # §5.3: clamp to the index of the last entry COVERED BY
+                # THIS REQUEST, not our last log index — we may hold
+                # stale divergent entries beyond the appended batch that
+                # must not be marked committed before truncation.
+                last_new = (
+                    args["entries"][-1][0] if args["entries"] else prev_idx
                 )
-                self._commit_cv.notify_all()
+                new_commit = min(args["leader_commit"], last_new)
+                if new_commit > self.commit_index:
+                    self.commit_index = new_commit
+                    self._commit_cv.notify_all()
             return {"term": self.current_term, "success": True}
 
     def _handle_install_snapshot(self, args):

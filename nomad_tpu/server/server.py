@@ -227,7 +227,9 @@ class Server:
             return
         # capacity may have appeared: unblock evals for this class
         if node.status == NODE_STATUS_READY:
-            self.blocked_evals.unblock(node.computed_class)
+            self.blocked_evals.unblock(
+                node.computed_class, self.state.latest_index()
+            )
 
     def _on_alloc_client_update(self, allocs) -> None:
         if not self._leader:
@@ -237,7 +239,9 @@ class Server:
             if alloc.client_terminal_status():
                 node = self.state.node_by_id(alloc.node_id)
                 if node is not None:
-                    self.blocked_evals.unblock(node.computed_class)
+                    self.blocked_evals.unblock(
+                        node.computed_class, self.state.latest_index()
+                    )
 
     def _requeue_unblocked(self, ev: Evaluation) -> None:
         """Write an unblocked eval back to pending.

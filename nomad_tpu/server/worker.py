@@ -221,6 +221,7 @@ class TPUBatchWorker:
                     continue
             if failed:
                 blocked = ev.create_blocked_eval({}, True, "", failed)
+                blocked.snapshot_index = snapshot.index
                 blocked.status_description = "created to place remaining allocations"
                 self.planner.create_eval(blocked)
             done = ev.copy()

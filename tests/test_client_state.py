@@ -18,7 +18,7 @@ from nomad_tpu.client.getter import ArtifactError, fetch_artifact
 from nomad_tpu.client.logmon import LogRotator
 from nomad_tpu.client.state_db import StateDB
 from nomad_tpu.client.taskenv import build_env, interpolate
-from nomad_tpu.client.template import render_template
+from nomad_tpu.client.template import TemplateError, render_template
 from nomad_tpu.server import Server
 from nomad_tpu.structs import TaskState
 from nomad_tpu.structs.structs import TaskArtifact, Template
@@ -246,3 +246,105 @@ class TestRestartReattach:
         ), "task must have reattached, not restarted"
         c2.shutdown()  # kills the task this time
         server.shutdown()
+
+
+class TestSandbox:
+    """Job-controlled paths are confined to the alloc dir (upstream had
+    CVEs for both template path escapes and go-getter dest escapes)."""
+
+    def _tree(self, tmp_path):
+        alloc_dir = tmp_path / "allocs" / "a1"
+        task_dir = alloc_dir / "web"
+        task_dir.mkdir(parents=True)
+        return alloc_dir, task_dir
+
+    def test_template_dest_escape_rejected(self, tmp_path):
+        _, task_dir = self._tree(tmp_path)
+        victim = tmp_path / "victim.txt"
+        for dest in (str(victim), "../../victim.txt"):
+            tmpl = Template(embedded_tmpl="owned", dest_path=dest)
+            with pytest.raises(TemplateError, match="escapes"):
+                render_template(tmpl, str(task_dir), {})
+        assert not victim.exists()
+
+    def test_template_source_escape_rejected(self, tmp_path):
+        _, task_dir = self._tree(tmp_path)
+        secret = tmp_path / "host-secret"
+        secret.write_text("root:*")
+        tmpl = Template(
+            source_path="../../host-secret", dest_path="local/out"
+        )
+        with pytest.raises(TemplateError, match="escapes"):
+            render_template(tmpl, str(task_dir), {})
+
+    def test_template_shared_alloc_dir_allowed(self, tmp_path):
+        alloc_dir, task_dir = self._tree(tmp_path)
+        tmpl = Template(embedded_tmpl="ok", dest_path="../alloc/data/x")
+        (alloc_dir / "alloc" / "data").mkdir(parents=True)
+        dest = render_template(tmpl, str(task_dir), {})
+        assert open(dest).read() == "ok"
+
+    def test_artifact_dest_escape_rejected(self, tmp_path):
+        _, task_dir = self._tree(tmp_path)
+        src = tmp_path / "p.txt"
+        src.write_text("x")
+        art = TaskArtifact(
+            getter_source=str(src), relative_dest="../../escaped/"
+        )
+        with pytest.raises(ArtifactError, match="escapes"):
+            fetch_artifact(art, str(task_dir))
+
+    def test_file_artifacts_gated(self, tmp_path, monkeypatch):
+        _, task_dir = self._tree(tmp_path)
+        src = tmp_path / "p.txt"
+        src.write_text("x")
+        art = TaskArtifact(getter_source=str(src), relative_dest="local/")
+        monkeypatch.setenv("NOMAD_TPU_ARTIFACT_ALLOW_FILE", "0")
+        with pytest.raises(ArtifactError, match="disabled"):
+            fetch_artifact(art, str(task_dir))
+
+    def test_tar_traversal_blocked(self, tmp_path):
+        import io
+        import tarfile
+
+        _, task_dir = self._tree(tmp_path)
+        evil = tmp_path / "evil.tar.gz"
+        with tarfile.open(evil, "w:gz") as tf:
+            info = tarfile.TarInfo("../../../../pwned.txt")
+            data = b"owned"
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        art = TaskArtifact(getter_source=str(evil), relative_dest="local/")
+        with pytest.raises(ArtifactError, match="unsafe archive"):
+            fetch_artifact(art, str(task_dir))
+        assert not (tmp_path / "pwned.txt").exists()
+
+
+class TestSpecEscaping:
+    """Executor spec values are job-controlled; newlines/tabs must not
+    inject spec directives (drivers/executor.py _esc)."""
+
+    def test_env_newline_does_not_inject(self, tmp_path):
+        from nomad_tpu.drivers.executor import launch_executor
+
+        task_dir = tmp_path / "t"
+        out = tmp_path / "out.txt"
+        evil_dest = tmp_path / "injected.txt"
+        h = launch_executor(
+            task_dir=str(task_dir),
+            command="/bin/sh",
+            args=["-c", "printf '%s' \"$EVIL\" > " + str(out)],
+            env={"EVIL": f"x\nstdout\t{evil_dest}"},
+        )
+        res = h.wait(timeout_s=10)
+        assert res is not None and res.get("exit_code") == 0
+        h.shutdown()
+        assert not evil_dest.exists()
+        assert out.read_text() == f"x\nstdout\t{evil_dest}"
+
+    def test_socket_path_short_under_deep_tmp(self, tmp_path):
+        from nomad_tpu.drivers.executor import _socket_path
+
+        deep = tmp_path / ("d" * 50) / ("e" * 50) / ("f" * 50)
+        sock = _socket_path(str(deep))
+        assert len(sock) <= 100

@@ -210,3 +210,27 @@ class TestCodecEscaping:
         """Reserved-tag collision: user data with $-keys must survive."""
         v = {"$b64": "hello", "$t": "NotAType", "normal": 1}
         assert codec.unpack(codec.pack(v)) == v
+
+
+class TestRPCSecret:
+    """Cluster shared-secret preamble on the fabric (trust boundary in
+    rpc/server.py): unauthenticated peers can't invoke any endpoint."""
+
+    def test_secret_required(self):
+        server = RPCServer(secret="s3cret")
+        server.register("Echo", Echo())
+        server.start()
+        try:
+            good = ConnPool(secret="s3cret")
+            assert good.call(server.addr, "Echo.echo", {"x": 1}) == {"x": 1}
+            good.shutdown()
+            bad = ConnPool(secret="wrong")
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                bad.call(server.addr, "Echo.echo", {"x": 1}, timeout_s=3)
+            bad.shutdown()
+            none = ConnPool()
+            with pytest.raises((ConnectionError, OSError, TimeoutError)):
+                none.call(server.addr, "Echo.echo", {"x": 1}, timeout_s=3)
+            none.shutdown()
+        finally:
+            server.shutdown()

@@ -307,3 +307,51 @@ def test_server_tpu_batch_worker():
             assert len(live) == 4, job.id
     finally:
         s.shutdown()
+
+
+def test_blocked_evals_missed_unblock():
+    """Capacity that appears BETWEEN the scheduler snapshot and the
+    block() call must re-enqueue immediately (reference
+    blocked_evals.go missedUnblock — the lost-wakeup race)."""
+    from nomad_tpu.server.blocked_evals import BlockedEvals
+    from nomad_tpu.structs import Evaluation, generate_uuid
+
+    requeued = []
+    be = BlockedEvals(requeued.append)
+    be.set_enabled(True)
+
+    def mk_eval(snapshot_index, classes=None, escaped=False):
+        return Evaluation(
+            id=generate_uuid(),
+            namespace="default",
+            job_id="j1",
+            type="service",
+            status="blocked",
+            snapshot_index=snapshot_index,
+            class_eligibility=classes or {},
+            escaped_computed_class=escaped,
+        )
+
+    # Node of class c1 became ready at index 10.
+    be.unblock("c1", index=10)
+    assert requeued == []  # nothing was blocked yet
+
+    # Eval snapshotted at index 5 (before the capacity change): missed.
+    be.block(mk_eval(5, {"c1": True}))
+    assert len(requeued) == 1 and requeued[0].status == "pending"
+
+    # Eval snapshotted at index 15 (after): genuinely blocked.
+    be.block(mk_eval(15, {"c1": True}))
+    assert len(requeued) == 1
+    assert be.blocked_count() == 1
+
+    # Escaped eval with an old snapshot: any capacity change counts.
+    be.untrack("default", "j1")
+    be.block(mk_eval(5, escaped=True))
+    assert len(requeued) == 2
+
+    # Ineligible class does not count as missed capacity.
+    be.untrack("default", "j1")
+    be.block(mk_eval(5, {"c1": False}))
+    assert len(requeued) == 2
+    assert be.blocked_count() == 1
