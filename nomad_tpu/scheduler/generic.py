@@ -257,6 +257,15 @@ class GenericScheduler:
             start = now_ns()
             penalty = {req.penalty_node} if req.penalty_node else None
             option = self.stack.select(tg, penalty_nodes=penalty, metrics=metric)
+            if option is None and self.ctx.scheduler_config.preemption_enabled(
+                job.type
+            ):
+                # Second pass with eviction enabled (reference
+                # generic_sched.go:773 selectNextOption → :786 re-run
+                # with preemption).
+                option = self.stack.select(
+                    tg, penalty_nodes=penalty, metrics=metric, evict=True
+                )
             metric.allocation_time_ns = now_ns() - start
             metric.nodes_evaluated = self.ctx.metrics_nodes_evaluated
 
@@ -291,6 +300,16 @@ class GenericScheduler:
                     dstate.placed_allocs += 1
             elif job.type == "service" and active_deployment is not None:
                 alloc.deployment_id = active_deployment.id
+
+            if option.preempted_allocs:
+                # Reference generic_sched.go:795 handlePreemptions: the
+                # evictions ride the plan; the applier re-verifies and
+                # the FSM flips them to desired=evict.
+                alloc.preempted_allocations = [
+                    p.id for p in option.preempted_allocs
+                ]
+                for p in option.preempted_allocs:
+                    self.plan.append_preempted_alloc(p, alloc.id)
 
             prev = req.previous_alloc
             if prev is not None:

@@ -1,0 +1,224 @@
+"""Preemption: evict lower-priority allocations to make room.
+
+Reference: scheduler/preemption.go — Preemptor :96, PreemptForTaskGroup
+:198, basicResourceDistance :608, scoreForTaskGroup :640,
+filterAndGroupPreemptibleAllocs :663, filterSuperset :702.
+
+Candidate rules (same contract as the reference):
+  * only allocs whose job priority is AT LEAST 10 below the placing
+    job's priority are preemptible (the "delta 10" rule — reference
+    preemption.go:672 skips `jobPriority - allocPriority < 10`);
+  * candidates are consumed lowest-priority-tier first;
+  * within a tier, pick the alloc whose resources are CLOSEST to the
+    remaining need (normalized cpu/memory/disk euclidean distance), with
+    a penalty for preempting many allocs of one job past its migrate
+    max_parallel;
+  * a final superset pass drops preemptions made redundant by later,
+    larger picks.
+
+The TPU backend reaches the same decisions tensor-wise: allocs are
+lowered into per-priority-tier usage tensors and the solver frees tiers
+cheapest-first (scheduler/tpu/lower.py, solver.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ..structs import Allocation, Node, Resources
+
+# Applied per already-preempted alloc of the same job/group beyond its
+# migrate max_parallel (reference preemption.go:13 maxParallelPenalty).
+MAX_PARALLEL_PENALTY = 50.0
+
+# Minimum priority gap between the placing job and a preemptible alloc.
+PRIORITY_DELTA = 10
+
+
+def basic_resource_distance(ask: Resources, used: Resources) -> float:
+    """Normalized euclidean distance between an ask and an alloc's usage
+    (reference :608). Lower = closer match = better preemption pick."""
+    cpu_coord = mem_coord = disk_coord = 0.0
+    if ask.cpu > 0:
+        cpu_coord = (ask.cpu - used.cpu) / ask.cpu
+    if ask.memory_mb > 0:
+        mem_coord = (ask.memory_mb - used.memory_mb) / ask.memory_mb
+    if ask.disk_mb > 0:
+        disk_coord = (ask.disk_mb - used.disk_mb) / ask.disk_mb
+    return math.sqrt(cpu_coord**2 + mem_coord**2 + disk_coord**2)
+
+
+def score_for_task_group(
+    ask: Resources, used: Resources, max_parallel: int, num_preempted: int
+) -> float:
+    penalty = 0.0
+    if max_parallel > 0 and num_preempted >= max_parallel:
+        penalty = (num_preempted + 1 - max_parallel) * MAX_PARALLEL_PENALTY
+    return basic_resource_distance(ask, used) + penalty
+
+
+def _superset(avail: Resources, need: Resources) -> bool:
+    return (
+        avail.cpu >= need.cpu
+        and avail.memory_mb >= need.memory_mb
+        and avail.disk_mb >= need.disk_mb
+    )
+
+
+def _add(into: Resources, r: Resources) -> None:
+    into.cpu += r.cpu
+    into.memory_mb += r.memory_mb
+    into.disk_mb += r.disk_mb
+
+
+def _sub(into: Resources, r: Resources) -> None:
+    into.cpu -= r.cpu
+    into.memory_mb -= r.memory_mb
+    into.disk_mb -= r.disk_mb
+
+
+class Preemptor:
+    """Finds allocations on one node to preempt for a placement."""
+
+    def __init__(
+        self,
+        job_priority: int,
+        namespace: str,
+        job_id: str,
+        plan=None,
+    ) -> None:
+        self.job_priority = job_priority
+        self.namespace = namespace
+        self.job_id = job_id
+        # (ns, job_id, tg) -> count of allocs already being preempted in
+        # this plan, feeding the max_parallel penalty.
+        self._current_preemptions: dict[tuple[str, str, str], int] = {}
+        if plan is not None:
+            for allocs in plan.node_preemptions.values():
+                for a in allocs:
+                    key = (a.namespace, a.job_id, a.task_group)
+                    self._current_preemptions[key] = (
+                        self._current_preemptions.get(key, 0) + 1
+                    )
+        self._node_remaining: Optional[Resources] = None
+        self._candidates: list[Allocation] = []
+        self._details: dict[str, tuple[int, Resources]] = {}
+        self._total_usage = Resources(cpu=0, memory_mb=0, disk_mb=0)
+
+    def set_node(self, node: Node) -> None:
+        avail = node.available_resources()
+        self._node_remaining = Resources(
+            cpu=avail.cpu, memory_mb=avail.memory_mb, disk_mb=avail.disk_mb
+        )
+
+    def set_candidates(self, allocs: list[Allocation]) -> None:
+        self._candidates = []
+        self._details = {}
+        # usage of ALL allocs on the node — non-candidates (e.g. the
+        # placing job's own allocs) still consume capacity and must be
+        # subtracted from node-remaining, or the picker stops early
+        self._total_usage = Resources(cpu=0, memory_mb=0, disk_mb=0)
+        for alloc in allocs:
+            _add(self._total_usage, alloc.comparable_resources())
+            # never preempt the job being placed (its own old versions
+            # are handled by the reconciler as stops, not preemptions)
+            if alloc.job_id == self.job_id and alloc.namespace == self.namespace:
+                continue
+            max_parallel = 0
+            job = alloc.job
+            tg = job.lookup_task_group(alloc.task_group) if job else None
+            if tg is not None and tg.migrate is not None:
+                max_parallel = tg.migrate.max_parallel
+            self._details[alloc.id] = (max_parallel, alloc.comparable_resources())
+            self._candidates.append(alloc)
+
+    def _num_preempted(self, alloc: Allocation) -> int:
+        return self._current_preemptions.get(
+            (alloc.namespace, alloc.job_id, alloc.task_group), 0
+        )
+
+    def preempt_for_task_group(
+        self, ask: Resources
+    ) -> Optional[list[Allocation]]:
+        """Pick allocations to evict so `ask` fits; None if impossible
+        (reference PreemptForTaskGroup :198)."""
+        if self._node_remaining is None:
+            return None
+        remaining = Resources(
+            cpu=self._node_remaining.cpu,
+            memory_mb=self._node_remaining.memory_mb,
+            disk_mb=self._node_remaining.disk_mb,
+        )
+        _sub(remaining, self._total_usage)
+
+        # Group preemptible candidates by priority tier, lowest first.
+        tiers: dict[int, list[Allocation]] = {}
+        for alloc in self._candidates:
+            prio = alloc.job.priority if alloc.job else 50
+            if self.job_priority - prio < PRIORITY_DELTA:
+                continue
+            tiers.setdefault(prio, []).append(alloc)
+        if not tiers:
+            return None
+
+        need = Resources(cpu=ask.cpu, memory_mb=ask.memory_mb, disk_mb=ask.disk_mb)
+        available = Resources(
+            cpu=remaining.cpu,
+            memory_mb=remaining.memory_mb,
+            disk_mb=remaining.disk_mb,
+        )
+        best: list[Allocation] = []
+        met = False
+        for prio in sorted(tiers):
+            group = list(tiers[prio])
+            while group and not met:
+                # pick the candidate closest to the remaining need
+                best_idx, best_dist = -1, math.inf
+                for i, alloc in enumerate(group):
+                    max_parallel, used = self._details[alloc.id]
+                    dist = score_for_task_group(
+                        need, used, max_parallel, self._num_preempted(alloc)
+                    )
+                    if dist < best_dist:
+                        best_dist, best_idx = dist, i
+                chosen = group.pop(best_idx)
+                used = self._details[chosen.id][1]
+                _add(available, used)
+                _sub(need, used)
+                best.append(chosen)
+                met = _superset(available, ask)
+            if met:
+                break
+        if not met:
+            return None
+        return self._filter_superset(best, remaining, ask)
+
+    def _filter_superset(
+        self,
+        chosen: list[Allocation],
+        node_remaining: Resources,
+        ask: Resources,
+    ) -> list[Allocation]:
+        """Drop picks made redundant by later, larger ones: keep the
+        biggest-first prefix that still covers the ask (reference
+        filterSuperset :702 sorts descending by distance-from-need and
+        re-walks)."""
+        chosen = sorted(
+            chosen,
+            key=lambda a: basic_resource_distance(
+                ask, self._details[a.id][1]
+            ),
+        )
+        kept: list[Allocation] = []
+        available = Resources(
+            cpu=node_remaining.cpu,
+            memory_mb=node_remaining.memory_mb,
+            disk_mb=node_remaining.disk_mb,
+        )
+        for alloc in chosen:
+            if _superset(available, ask):
+                break
+            _add(available, self._details[alloc.id][1])
+            kept.append(alloc)
+        return kept

@@ -42,6 +42,9 @@ class RankedNode:
     task_resources: dict[str, AllocatedTaskResources] = field(default_factory=dict)
     alloc_resources: Optional[AllocatedResources] = None
     proposed_allocs: Optional[list] = None
+    # allocs that must be evicted for this placement to fit
+    # (reference rank.go:33 PreemptedAllocs)
+    preempted_allocs: Optional[list] = None
 
     def add_score(self, name: str, value: float) -> None:
         self.scores[name] = value
@@ -53,24 +56,65 @@ def binpack_rank(
     tg: TaskGroup,
     metrics=None,
     algorithm: Optional[str] = None,
+    evict: bool = False,
+    job=None,
 ) -> Iterator[RankedNode]:
     """Fit-check + score each candidate node for the task group.
 
     Per node: proposed utilization (existing − stops + placements), per-task
     network/device assignment, cumulative fit, ScoreFit. Infeasible nodes are
     recorded as exhausted and skipped. Reference: rank.go BinPackIterator.
+
+    With evict=True (the scheduler's second pass after normal placement
+    fails), a node that doesn't fit runs the Preemptor (reference
+    rank.go:233): lower-priority allocs are chosen for eviction and the
+    fit re-checked without them; picks land on RankedNode.preempted_allocs.
+    Scope matches PreemptForTaskGroup (cpu/mem/disk); the network/device
+    preemption paths are not implemented.
     """
     algo = algorithm or ctx.scheduler_config.algorithm
     for node in candidates:
         proposed = ctx.proposed_allocs(node.id)
         available = node.available_resources()
+        total_ask = tg.combined_resources()
 
-        util = Resources(cpu=0, memory_mb=0, disk_mb=0)
-        for alloc in proposed:
-            r = alloc.comparable_resources()
-            util.cpu += r.cpu
-            util.memory_mb += r.memory_mb
-            util.disk_mb += r.disk_mb
+        def _utilization(allocs):
+            util = Resources(
+                cpu=total_ask.cpu,
+                memory_mb=total_ask.memory_mb,
+                disk_mb=total_ask.disk_mb,
+            )
+            for alloc in allocs:
+                r = alloc.comparable_resources()
+                util.cpu += r.cpu
+                util.memory_mb += r.memory_mb
+                util.disk_mb += r.disk_mb
+            return util
+
+        util = _utilization(proposed)
+        preempted_allocs = None
+        ok, dim = available.superset(util)
+        if not ok and evict and job is not None:
+            from .preemption import Preemptor
+
+            preemptor = Preemptor(
+                job.priority, job.namespace, job.id, ctx.plan
+            )
+            preemptor.set_node(node)
+            preemptor.set_candidates(proposed)
+            picks = preemptor.preempt_for_task_group(total_ask)
+            if picks:
+                picked_ids = {a.id for a in picks}
+                without = [a for a in proposed if a.id not in picked_ids]
+                util = _utilization(without)
+                ok, dim = available.superset(util)
+                if ok:
+                    preempted_allocs = picks
+                    proposed = without
+        if not ok:
+            if metrics is not None:
+                metrics.exhausted_node(node, dim)
+            continue
 
         net_idx = NetworkIndex()
         net_idx.set_node(node)
@@ -78,17 +122,6 @@ def binpack_rank(
 
         dev_alloc = DeviceAllocator(ctx, node)
         dev_alloc.add_allocs(proposed)
-
-        total_ask = tg.combined_resources()
-        util.cpu += total_ask.cpu
-        util.memory_mb += total_ask.memory_mb
-        util.disk_mb += total_ask.disk_mb
-
-        ok, dim = available.superset(util)
-        if not ok:
-            if metrics is not None:
-                metrics.exhausted_node(node, dim)
-            continue
 
         # Per-task port/bandwidth + device assignment.
         task_resources: dict[str, AllocatedTaskResources] = {}
@@ -152,6 +185,7 @@ def binpack_rank(
                 shared_networks=shared_networks,
             ),
             proposed_allocs=proposed,
+            preempted_allocs=preempted_allocs,
         )
         ranked.add_score(BINPACK_SCORER, normalized)
         if metrics is not None:

@@ -100,8 +100,10 @@ class GenericStack:
         penalty_nodes: Optional[set[str]] = None,
         metrics=None,
         selected_nodes: Optional[list[Node]] = None,
+        evict: bool = False,
     ) -> Optional[RankedNode]:
-        """Pick the best node for one instance of the task group."""
+        """Pick the best node for one instance of the task group.
+        evict=True enables the preemption pass in binpack ranking."""
         job = self.job
         assert job is not None, "set_job must be called first"
         source: Iterable[Node] = (
@@ -159,7 +161,9 @@ class GenericStack:
 
             feasible = _post_filter(feasible)
 
-        options = binpack_rank(self.ctx, feasible, tg, metrics)
+        options = binpack_rank(
+            self.ctx, feasible, tg, metrics, evict=evict, job=job
+        )
         options = job_anti_affinity_rank(
             self.ctx, options, job.id, tg.name, tg.count, metrics
         )
@@ -196,7 +200,9 @@ class SystemStack:
         self.job = job
         self.ctx.eligibility.set_job(job)
 
-    def select(self, tg: TaskGroup, node: Node, metrics=None) -> Optional[RankedNode]:
+    def select(
+        self, tg: TaskGroup, node: Node, metrics=None, evict: bool = False
+    ) -> Optional[RankedNode]:
         """Fit one instance of tg on one specific node."""
         job = self.job
         assert job is not None
@@ -214,7 +220,9 @@ class SystemStack:
         feasible = feasibility_pipeline(
             self.ctx, [node], job_checkers, tg_checkers, tg.name, metrics
         )
-        options = binpack_rank(self.ctx, feasible, tg, metrics)
+        options = binpack_rank(
+            self.ctx, feasible, tg, metrics, evict=evict, job=job
+        )
         options = score_normalization(options, metrics)
         got = list(options)
         return got[0] if got else None

@@ -121,6 +121,8 @@ class SystemScheduler:
             metric = AllocMetric(nodes_available=dict(self._dc_counts))
             start = now_ns()
             option = stack.select(tg, node, metrics=metric)
+            if option is None and self.config.preemption_enabled(job.type):
+                option = stack.select(tg, node, metrics=metric, evict=True)
             metric.allocation_time_ns = now_ns() - start
             if option is None:
                 existing = self.failed_tg_allocs.get(tg.name)
@@ -143,6 +145,12 @@ class SystemScheduler:
                 resources=option.alloc_resources,
                 metrics=metric,
             )
+            if option.preempted_allocs:
+                alloc.preempted_allocations = [
+                    p.id for p in option.preempted_allocs
+                ]
+                for p in option.preempted_allocs:
+                    self.plan.append_preempted_alloc(p, alloc.id)
             self.plan.append_alloc(alloc, job)
         self.queued_allocs = queued
         eval_obj.queued_allocations = queued

@@ -75,11 +75,8 @@ def _score_nodes(cap_f, used_f, ask_f, bias_g):
     return score + bias_g
 
 
-def _place_group(cap, carry, xs):
-    """One lax.scan step: place count_g instances of one group."""
-    used = carry
-    ask, count, feas_g, bias_g, ucap = xs
-    free = cap - used  # [N, R] i32
+def _units_for(free, ask, ucap, feas_g, count):
+    """How many instances fit per node given free capacity + caps."""
     per_res = jnp.where(
         ask[None, :] > 0,
         free // jnp.maximum(ask[None, :], 1),
@@ -90,18 +87,27 @@ def _place_group(cap, carry, xs):
     units = jnp.where(feas_g, units, 0)
     # Clip to the group's count: keeps the cumsum far from int32 overflow
     # and changes nothing (a node can never take more than count instances).
-    units = jnp.clip(units, 0, count)
+    return jnp.clip(units, 0, count)
 
-    score = _score_nodes(cap.astype(jnp.float32), used.astype(jnp.float32),
-                         ask.astype(jnp.float32), bias_g)
-    score = jnp.where(units > 0, score, NEG_INF)
 
+def _waterfill(score, units, count):
+    """Fill the score-sorted node axis until `count` instances placed."""
     order = jnp.argsort(-score)  # best first
     su = units[order]
     prior = jnp.cumsum(su) - su
     take_sorted = jnp.clip(count - prior, 0, su)
-    take = jnp.zeros_like(units).at[order].set(take_sorted)
+    return jnp.zeros_like(units).at[order].set(take_sorted)
 
+
+def _place_group(cap, carry, xs):
+    """One lax.scan step: place count_g instances of one group."""
+    used = carry
+    ask, count, feas_g, bias_g, ucap = xs
+    units = _units_for(cap - used, ask, ucap, feas_g, count)
+    score = _score_nodes(cap.astype(jnp.float32), used.astype(jnp.float32),
+                         ask.astype(jnp.float32), bias_g)
+    score = jnp.where(units > 0, score, NEG_INF)
+    take = _waterfill(score, units, count)
     used = used + take[:, None] * ask[None, :]
     return used, take
 
@@ -117,6 +123,96 @@ def solve_placement(cap, used, asks, counts, feas, bias, units_cap):
     step = functools.partial(_place_group, cap)
     used, takes = lax.scan(step, used, (asks, counts, feas, bias, units_cap))
     return takes, used
+
+
+# ---------------------------------------------------------------------------
+# Preemption-aware variant: per-priority-tier usage tensors
+# ---------------------------------------------------------------------------
+
+
+def _place_group_preempt(cap, used_exist, prefix_used, carry, xs):
+    """Two-phase scan step (reference analog: generic_sched.go:773
+    selectNextOption's run-again-with-preemption + preemption.go's
+    priority-tier candidate grouping, tensorized):
+
+      phase 1: normal waterfill against remaining real capacity;
+      phase 2: the unplaced remainder retries with capacity EXPANDED by
+        the usage of preemptible priority tiers (tiers strictly more
+        than PRIORITY_DELTA below the group's job priority — `klim`
+        indexes the cumulative tier-usage prefix).
+
+    The carry tracks `freed` — preemptible usage already claimed by
+    earlier (higher-priority) groups in this batch — so two groups can
+    never double-spend the same victim capacity. Phase-2 placements are
+    returned separately (`take2`): the host picks exact victim allocs
+    per node and emits plan.node_preemptions.
+    """
+    used_new, freed = carry
+    ask, count, feas_g, bias_g, ucap, klim = xs
+
+    avail_exist = used_exist - freed  # existing usage still standing
+    used_total = avail_exist + used_new
+
+    # phase 1: normal placement
+    units1 = _units_for(cap - used_total, ask, ucap, feas_g, count)
+    score1 = _score_nodes(
+        cap.astype(jnp.float32),
+        used_total.astype(jnp.float32),
+        ask.astype(jnp.float32),
+        bias_g,
+    )
+    score1 = jnp.where(units1 > 0, score1, NEG_INF)
+    take1 = _waterfill(score1, units1, count)
+    used_new = used_new + take1[:, None] * ask[None, :]
+    used_total = used_total + take1[:, None] * ask[None, :]
+    remaining = count - jnp.sum(take1)
+
+    # phase 2: preemptible capacity (klim = 0 → prefix is all-zero)
+    preemptible = jnp.maximum(
+        lax.dynamic_index_in_dim(prefix_used, klim, 0, keepdims=False) - freed,
+        0,
+    )  # [N, R]
+    normal_free = cap - used_total
+    units2 = _units_for(
+        normal_free + preemptible, ask, ucap - take1, feas_g, remaining
+    )
+    score2 = _score_nodes(
+        cap.astype(jnp.float32),
+        jnp.maximum(used_total - preemptible, 0).astype(jnp.float32),
+        ask.astype(jnp.float32),
+        bias_g,
+    )
+    score2 = jnp.where(units2 > 0, score2, NEG_INF)
+    take2 = _waterfill(score2, units2, remaining)
+
+    # How much of phase 2 actually eats into victims (vs leftover free).
+    overflow = jnp.maximum(
+        take2[:, None] * ask[None, :] - jnp.maximum(normal_free, 0), 0
+    )
+    freed = freed + jnp.minimum(overflow, preemptible)
+    used_new = used_new + take2[:, None] * ask[None, :]
+    return (used_new, freed), (take1 + take2, take2)
+
+
+@jax.jit
+def solve_placement_preempt(
+    cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap, tier_limit
+):
+    """Place all groups with preemption tiers.
+
+    cap, used_exist: [N, R] i32; prefix_used: [T+1, N, R] i32 cumulative
+    usage of the T priority tiers (ascending priority; prefix_used[k] =
+    usage of the k lowest tiers); tier_limit: [G] i32 — how many tiers
+    each group may preempt (0 = none). Returns
+    (assign [G, N], assign_evict [G, N], used' [N, R]).
+    """
+    n = cap.shape[0]
+    zeros = jnp.zeros((n, cap.shape[1]), dtype=cap.dtype)
+    step = functools.partial(_place_group_preempt, cap, used_exist, prefix_used)
+    (used_new, freed), (takes, takes_evict) = lax.scan(
+        step, (zeros, zeros), (asks, counts, feas, bias, units_cap, tier_limit)
+    )
+    return takes, takes_evict, used_exist - freed + used_new
 
 
 # ---------------------------------------------------------------------------

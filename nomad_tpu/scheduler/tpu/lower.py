@@ -45,6 +45,10 @@ class NodeTable:
     used: np.ndarray  # [N, NUM_RES] int64 — live alloc utilization
     datacenters: np.ndarray  # [N] int32 codes
     dc_values: list[str]
+    # preemption tiers: distinct job priorities of live allocs, ascending,
+    # and each tier's usage — feeds the preemption kernel's prefix sums
+    tier_prios: list[int] = field(default_factory=list)
+    tier_used: Optional[np.ndarray] = None  # [T, N, NUM_RES] int64
     # lazily built per-attribute interning: ltarget -> (codes [N] int32, values)
     _attr_cache: dict[str, tuple[np.ndarray, list[str], np.ndarray]] = field(
         default_factory=dict
@@ -140,6 +144,8 @@ def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
     dc_code: dict[str, int] = {}
     dcs = np.zeros(n, dtype=np.int32)
     index_of: dict[str, int] = {}
+    # usage bucketed by the owning job's priority → preemption tiers
+    by_prio: dict[int, np.ndarray] = {}
     for i, node in enumerate(nodes):
         index_of[node.id] = i
         avail = node.available_resources()
@@ -152,7 +158,19 @@ def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
         dcs[i] = code
         for alloc in allocs_by_node(node.id):
             r = alloc.comparable_resources()
-            used[i] += (r.cpu, r.memory_mb, r.disk_mb)
+            vec = (r.cpu, r.memory_mb, r.disk_mb)
+            used[i] += vec
+            prio = alloc.job.priority if alloc.job is not None else 50
+            tier = by_prio.get(prio)
+            if tier is None:
+                tier = by_prio[prio] = np.zeros((n, NUM_RES), dtype=np.int64)
+            tier[i] += vec
+    tier_prios = sorted(by_prio)
+    tier_used = (
+        np.stack([by_prio[p] for p in tier_prios])
+        if tier_prios
+        else np.zeros((0, n, NUM_RES), dtype=np.int64)
+    )
     table = NodeTable(
         nodes=nodes,
         index_of=index_of,
@@ -160,6 +178,8 @@ def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
         used=used,
         datacenters=dcs,
         dc_values=dc_values,
+        tier_prios=tier_prios,
+        tier_used=tier_used,
     )
     table._allocs_by_node = allocs_by_node
     return table

@@ -39,8 +39,9 @@ from ...structs import (
 from ..context import EvalContext, SchedulerConfig
 from ..reconcile import PlacementRequest
 from ..util import ready_nodes_in_dcs
+from ..preemption import PRIORITY_DELTA
 from .lower import LoweredGroup, build_node_table, lower_group
-from .kernels import pad_g, pad_n, solve_placement
+from .kernels import pad_g, pad_n, solve_placement, solve_placement_preempt
 
 logger = logging.getLogger("nomad_tpu.scheduler.tpu")
 
@@ -62,6 +63,11 @@ class SolveOutcome:
     placements: dict[str, list[Allocation]] = field(default_factory=dict)
     # eval_id -> {tg_name: AllocMetric} for failed asks
     failures: dict[str, dict[str, AllocMetric]] = field(default_factory=dict)
+    # eval_id -> [(victim alloc, preempting alloc id)] — the caller turns
+    # these into plan.node_preemptions entries
+    preemptions: dict[str, list[tuple[Allocation, str]]] = field(
+        default_factory=dict
+    )
     groups: int = 0
     solve_ns: int = 0
 
@@ -139,10 +145,11 @@ class BatchSolver:
 
         n = table.n
         self._free = table.cap - table.used  # exact-repair ledger, per solve
+        self._victimized: set[str] = set()
         used = np.clip(table.used, 0, 2**31 - 1).astype(np.int32)
         t0 = now_ns()
-        assign, used_out = self._run_kernel(table, groups, used)
-        leftovers = self._materialize(table, groups, assign)
+        assign, assign_evict, used_out = self._run_kernel(table, groups, used)
+        leftovers = self._materialize(table, groups, assign, assign_evict)
 
         # Fallback pass: spread is a soft preference — requests a
         # value-restricted sub-group could not place retry against the
@@ -168,8 +175,13 @@ class BatchSolver:
                 prev = final_unplaced.get(key)
                 final_unplaced[key] = (grp, (prev[1] if prev else []) + reqs)
         if retry:
-            assign2, _ = self._run_kernel(table, retry, np.asarray(used_out)[:n])
-            leftovers2 = self._materialize(table, retry, assign2)
+            # Spread-relaxation retry runs WITHOUT preemption: the tier
+            # prefix tensors describe pre-solve usage and a second
+            # preemption pass could double-claim the same victims.
+            assign2, _, _ = self._run_kernel(
+                table, retry, np.asarray(used_out)[:n], allow_preempt=False
+            )
+            leftovers2 = self._materialize(table, retry, assign2, None)
             for gi, reqs in leftovers2.items():
                 grp = retry[gi]
                 key = (grp.key[0], grp.tg.name)
@@ -185,7 +197,28 @@ class BatchSolver:
         out.solve_ns = now_ns() - t0
         return out
 
-    def _run_kernel(self, table, groups: list[LoweredGroup], used_n: np.ndarray):
+    def _tier_limit(self, table, grp: LoweredGroup) -> int:
+        """How many of the node table's (ascending) priority tiers this
+        group may preempt: tiers more than PRIORITY_DELTA below the
+        job's priority, when the operator enabled preemption for the
+        job's scheduler type."""
+        if not self.config.preemption_enabled(grp.job.type):
+            return 0
+        k = 0
+        for p in table.tier_prios:
+            if grp.priority - p >= PRIORITY_DELTA:
+                k += 1
+            else:
+                break  # ascending order: no later tier qualifies
+        return k
+
+    def _run_kernel(
+        self,
+        table,
+        groups: list[LoweredGroup],
+        used_n: np.ndarray,
+        allow_preempt: bool = True,
+    ):
         n, g = table.n, len(groups)
         np_, gp = pad_n(n), pad_g(g)
         cap = np.zeros((np_, 3), dtype=np.int32)
@@ -197,16 +230,46 @@ class BatchSolver:
         feas = np.zeros((gp, np_), dtype=bool)
         bias = np.zeros((gp, np_), dtype=np.float32)
         ucap = np.zeros((gp, np_), dtype=np.int32)
+        tier_limit = np.zeros(gp, dtype=np.int32)
         for i, grp in enumerate(groups):
             asks_arr[i] = grp.ask
             counts[i] = grp.count
             feas[i, :n] = grp.feasible
             bias[i, :n] = grp.bias
             ucap[i, :n] = np.clip(grp.units_cap, 0, 2**31 - 1)
+            if allow_preempt:
+                tier_limit[i] = self._tier_limit(table, grp)
+        use_preempt = (
+            allow_preempt
+            and tier_limit.any()
+            # custom solve_fns (e.g. the mesh-sharded solver) implement
+            # the plain contract only; preemption falls back to it
+            and self.solve_fn is solve_placement
+        )
+        if use_preempt:
+            t = len(table.tier_prios)
+            # Pad the tier axis to a bucket (like pad_n/pad_g): the jit
+            # kernel must not recompile every time the number of
+            # distinct alloc priorities in the cluster changes.
+            tp = max(4, -(-(t + 1) // 4) * 4)
+            prefix = np.zeros((tp, np_, 3), dtype=np.int32)
+            if t:
+                cum = np.cumsum(
+                    np.clip(table.tier_used, 0, 2**31 - 1), axis=0
+                )
+                prefix[1 : t + 1, :n] = cum.astype(np.int32)
+                # padded tail repeats the full sum so any (unused)
+                # out-of-range index still reads a valid prefix
+                prefix[t + 1 :, :n] = cum[-1].astype(np.int32)
+            assign, assign_evict, used_out = solve_placement_preempt(
+                cap, used, prefix, asks_arr, counts, feas, bias, ucap,
+                tier_limit,
+            )
+            return np.asarray(assign), np.asarray(assign_evict), used_out
         assign, used_out = self.solve_fn(
             cap, used, asks_arr, counts, feas, bias, ucap
         )
-        return np.asarray(assign), used_out
+        return np.asarray(assign), None, used_out
 
     # ------------------------------------------------------------------
 
@@ -275,6 +338,7 @@ class BatchSolver:
         table,
         groups: list[LoweredGroup],
         assign: np.ndarray,
+        assign_evict: Optional[np.ndarray] = None,
     ) -> dict[int, list]:
         """Turn [G, N] counts into Allocations; verify + repair per node.
 
@@ -282,7 +346,13 @@ class BatchSolver:
         aggregates failures after all passes. Host-side exact capacity
         verification replays the solver's placements with integer math and
         drops overflow (the kernel is integer too, so this only fires when
-        two passes race the same capacity)."""
+        two passes race the same capacity).
+
+        assign_evict marks placements the kernel made on PREEMPTIBLE
+        capacity: for those, exact victim allocs are picked here
+        (lowest priority tier first, then closest resource distance —
+        the host Preemptor's rules) and reported on outcome.preemptions.
+        """
         n = table.n
         free = self._free
         out = self._outcome
@@ -296,23 +366,84 @@ class BatchSolver:
             for ni in node_indices:
                 node = table.nodes[ni]
                 take = int(assign[gi, ni])
+                evict_budget = (
+                    int(assign_evict[gi, ni]) if assign_evict is not None else 0
+                )
                 for _ in range(take):
                     req = next(req_iter, None)
                     if req is None:
                         break
+                    victims: list = []
                     if np.any(free[ni] < grp.ask):
-                        unplaced.append(req)  # repair: out of exact capacity
-                        continue
+                        if evict_budget > 0:
+                            victims = self._pick_victims(table, ni, grp) or []
+                        if not victims:
+                            unplaced.append(req)  # out of exact capacity
+                            continue
                     alloc = self._build_alloc(table, grp, node, req)
                     if alloc is None:
                         unplaced.append(req)  # port assignment failed
                         continue
+                    if victims:
+                        evict_budget -= 1
+                        alloc.preempted_allocations = [v.id for v in victims]
+                        pre = out.preemptions.setdefault(eval_id, [])
+                        for v in victims:
+                            self._victimized.add(v.id)
+                            r = v.comparable_resources()
+                            free[ni] += (r.cpu, r.memory_mb, r.disk_mb)
+                            pre.append((v, alloc.id))
                     free[ni] -= grp.ask
                     placements.append(alloc)
             unplaced.extend(req_iter)  # instances the kernel never placed
             if unplaced:
                 leftovers[gi] = unplaced
         return leftovers
+
+    def _pick_victims(self, table, ni: int, grp: LoweredGroup):
+        """Exact victim selection for one instance on one node: free
+        enough for grp.ask from preemptible allocs, lowest priority tier
+        first, closest resource distance within a tier (the Preemptor's
+        scoring, reference preemption.go:198)."""
+        from ...structs import Resources
+        from ..preemption import PRIORITY_DELTA, basic_resource_distance
+
+        shortage = np.maximum(grp.ask - self._free[ni], 0)
+        need = Resources(
+            cpu=int(shortage[0]),
+            memory_mb=int(shortage[1]),
+            disk_mb=int(shortage[2]),
+        )
+        cands = []
+        for a in table._allocs_by_node(table.nodes[ni].id):
+            if a.id in self._victimized:
+                continue
+            if (
+                a.job_id == grp.job.id
+                and a.namespace == grp.job.namespace
+            ):
+                continue
+            prio = a.job.priority if a.job is not None else 50
+            if grp.priority - prio < PRIORITY_DELTA:
+                continue
+            cands.append((prio, a))
+        if not cands:
+            return None
+        cands.sort(
+            key=lambda pa: (
+                pa[0],
+                basic_resource_distance(need, pa[1].comparable_resources()),
+            )
+        )
+        freed = np.zeros(3, dtype=np.int64)
+        picks = []
+        for _, a in cands:
+            r = a.comparable_resources()
+            freed += (r.cpu, r.memory_mb, r.disk_mb)
+            picks.append(a)
+            if np.all(freed >= shortage):
+                return picks
+        return None
 
     def _build_alloc(
         self, table, grp: LoweredGroup, node, req: PlacementRequest

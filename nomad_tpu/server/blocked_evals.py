@@ -56,6 +56,7 @@ class BlockedEvals:
         return False
 
     def block(self, ev: Evaluation) -> None:
+        requeued = None
         with self._lock:
             if not self._enabled:
                 return
@@ -66,22 +67,27 @@ class BlockedEvals:
                 requeued = ev.copy()
                 requeued.status = "pending"
                 requeued.triggered_by = "queued-allocs"
-                self.enqueue_fn(requeued)
-                return
-            key = (ev.namespace, ev.job_id)
-            # newest blocked eval per job wins (the state store cancels the
-            # older one on upsert — mirror that here)
-            old_id = self._by_job.get(key)
-            if old_id:
-                self._captured.pop(old_id, None)
-                self._escaped.pop(old_id, None)
-            self._by_job[key] = ev.id
-            if ev.escaped_computed_class or not ev.class_eligibility:
-                self._escaped[ev.id] = ev
-                self.stats["total_escaped"] = len(self._escaped)
             else:
-                self._captured[ev.id] = ev
-            self.stats["total_blocked"] = len(self._captured) + len(self._escaped)
+                self._block_locked(ev)
+        # enqueue outside the lock, like unblock()/unblock_all()
+        if requeued is not None:
+            self.enqueue_fn(requeued)
+
+    def _block_locked(self, ev: Evaluation) -> None:
+        key = (ev.namespace, ev.job_id)
+        # newest blocked eval per job wins (the state store cancels the
+        # older one on upsert — mirror that here)
+        old_id = self._by_job.get(key)
+        if old_id:
+            self._captured.pop(old_id, None)
+            self._escaped.pop(old_id, None)
+        self._by_job[key] = ev.id
+        if ev.escaped_computed_class or not ev.class_eligibility:
+            self._escaped[ev.id] = ev
+            self.stats["total_escaped"] = len(self._escaped)
+        else:
+            self._captured[ev.id] = ev
+        self.stats["total_blocked"] = len(self._captured) + len(self._escaped)
 
     def untrack(self, namespace: str, job_id: str) -> None:
         """Job deregistered: drop its blocked eval."""

@@ -65,6 +65,10 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
             result.node_allocation[node_id] = plan.node_allocation[node_id]
         else:
             rejected = True
+            # A rejected placement must not still evict its victims:
+            # preemptions free capacity FOR that node's placements and
+            # are meaningless without them.
+            result.node_preemptions.pop(node_id, None)
             logger.debug("plan for node %s rejected: %s", node_id, reason)
     if rejected:
         if plan.all_at_once:
@@ -121,6 +125,40 @@ class PlanApplier:
         result = evaluate_plan(snapshot, plan)
         if result.is_no_op():
             return result
+        result.preemption_evals = self._preemption_evals(result)
         index = self.raft_apply("apply_plan_results", result)
         result.alloc_index = index
         return result
+
+    def _preemption_evals(self, result: PlanResult):
+        """One follow-up eval per job losing allocs to preemption, so the
+        preempted work reschedules elsewhere (reference plan_apply.go:278)."""
+        from ..structs import Evaluation, generate_uuid
+        from ..structs.structs import (
+            EVAL_STATUS_PENDING,
+            EVAL_TRIGGER_PREEMPTION,
+            now_ns,
+        )
+
+        seen: set[tuple[str, str]] = set()
+        for allocs in result.node_preemptions.values():
+            for a in allocs:
+                seen.add((a.namespace, a.job_id))
+        evals = []
+        for ns, job_id in seen:
+            # preempted plan rows carry job=None; resolve from state
+            job = self.state.job_by_id(ns, job_id)
+            evals.append(
+                Evaluation(
+                    id=generate_uuid(),
+                    namespace=ns,
+                    priority=job.priority if job else 50,
+                    type=job.type if job else "service",
+                    triggered_by=EVAL_TRIGGER_PREEMPTION,
+                    job_id=job_id,
+                    status=EVAL_STATUS_PENDING,
+                    create_time=now_ns(),
+                    modify_time=now_ns(),
+                )
+            )
+        return evals

@@ -159,6 +159,10 @@ class FSM:
 
     def _apply_plan_results(self, index: int, result: PlanResult) -> None:
         self.state.upsert_plan_results(index, result)
+        # Preempted jobs reschedule via their follow-up evals
+        # (reference fsm.go ApplyPlanResults → upsertEvals side channel).
+        if result.preemption_evals and self.on_eval_update:
+            self.on_eval_update(result.preemption_evals)
 
     def _apply_deployment_upsert(self, index: int, deployment: Deployment) -> None:
         self.state.upsert_deployment(index, deployment)

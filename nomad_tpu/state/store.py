@@ -881,6 +881,9 @@ class StateStore(_ReadMixin):
                 self._put_alloc(merged, existing)
                 committed.append(merged)
             committed.extend(self._upsert_allocs_txn(index, allocs_to_upsert))
+            if result.preemption_evals:
+                self._upsert_evals_txn(index, result.preemption_evals)
+                self._stamp(index, TABLE_EVALS)
             tables = [TABLE_ALLOCS, TABLE_JOB_SUMMARIES]
             if result.deployment is not None or result.deployment_updates:
                 tables.append(TABLE_DEPLOYMENTS)

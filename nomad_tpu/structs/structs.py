@@ -1725,6 +1725,9 @@ class PlanResult:
     node_preemptions: dict[str, list[Allocation]] = field(default_factory=dict)
     deployment: Optional["Deployment"] = None
     deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
+    # follow-up evals for the jobs whose allocs were preempted, so they
+    # reschedule elsewhere (reference plan_apply.go PreemptionEvals)
+    preemption_evals: list["Evaluation"] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
 

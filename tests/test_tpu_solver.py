@@ -288,3 +288,134 @@ def test_batch_solve_many_evals_one_kernel():
     assert total == 20
     for job in jobs:
         assert len(live(h, job)) == 4
+
+
+# ---------------------------------------------------------------------------
+# Preemption (differential vs host Preemptor path)
+# ---------------------------------------------------------------------------
+
+
+def _low_alloc_on(h, node, priority=10, cpu=3600, memory_mb=7000):
+    low_job = mock.job(priority=priority)
+    t = low_job.task_groups[0].tasks[0]
+    t.resources.cpu = cpu
+    t.resources.memory_mb = memory_mb
+    low_job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), low_job)
+    la = mock.alloc(job_=low_job, node_=node)
+    la.resources.tasks["web"].cpu = cpu
+    la.resources.tasks["web"].memory_mb = memory_mb
+    la.client_status = "running"
+    h.state.upsert_allocs(h.next_index(), [la])
+    return low_job, la
+
+
+def test_diff_preemption_evicts_lower_priority():
+    """Full node + high-priority job: BOTH backends must place by
+    preempting the low-priority alloc and emit plan.node_preemptions."""
+
+    def setup(h):
+        (node,) = fill_nodes(h, 1)
+        node.reserved.cpu = 0
+        node.reserved.memory_mb = 0
+        h.state.upsert_node(h.next_index(), node)
+        h._low = _low_alloc_on(h, node)
+        job = mock.job(priority=70)
+        job.task_groups[0].count = 1
+        t = job.task_groups[0].tasks[0]
+        t.resources.cpu = 2000
+        t.resources.memory_mb = 4000
+        h.state.upsert_job(h.next_index(), job)
+        return job
+
+    res = _run_both(setup)
+    for backend, (h, job) in res.items():
+        allocs = live(h, job)
+        assert len(allocs) == 1, f"{backend}: high-pri job not placed"
+        low_job, low_alloc = h._low
+        preempted = [
+            a
+            for p in h.plans
+            for allocs_ in p.node_preemptions.values()
+            for a in allocs_
+        ]
+        assert [a.id for a in preempted] == [low_alloc.id], backend
+        assert preempted[0].desired_status == "evict", backend
+        assert allocs[0].preempted_allocations == [low_alloc.id], backend
+
+
+def test_diff_preemption_respects_priority_delta():
+    """An alloc within 10 priority of the new job is NOT preemptible —
+    the placement must fail on both backends."""
+
+    def setup(h):
+        (node,) = fill_nodes(h, 1)
+        node.reserved.cpu = 0
+        node.reserved.memory_mb = 0
+        h.state.upsert_node(h.next_index(), node)
+        h._low = _low_alloc_on(h, node, priority=65)
+        job = mock.job(priority=70)
+        job.task_groups[0].count = 1
+        t = job.task_groups[0].tasks[0]
+        t.resources.cpu = 2000
+        t.resources.memory_mb = 4000
+        h.state.upsert_job(h.next_index(), job)
+        return job
+
+    res = _run_both(setup)
+    for backend, (h, job) in res.items():
+        assert live(h, job) == [], backend
+        preempted = [
+            a
+            for p in h.plans
+            for allocs_ in p.node_preemptions.values()
+            for a in allocs_
+        ]
+        assert preempted == [], backend
+
+
+def test_tpu_batch_preemption_many_nodes():
+    """Batched TPU path: a fleet of full nodes, a high-priority job that
+    needs them — victims picked per node, capacity never exceeded."""
+    from nomad_tpu.scheduler.tpu.scheduler import solve_eval_batch
+
+    h = Harness()
+    nodes = fill_nodes(h, 8)
+    lows = []
+    for n in nodes:
+        n.reserved.cpu = 0
+        n.reserved.memory_mb = 0
+        h.state.upsert_node(h.next_index(), n)
+        lows.append(_low_alloc_on(h, n, cpu=3000, memory_mb=6000))
+
+    job = mock.job(priority=70)
+    job.task_groups[0].count = 8
+    t = job.task_groups[0].tasks[0]
+    t.resources.cpu = 3000
+    t.resources.memory_mb = 5000
+    h.state.upsert_job(h.next_index(), job)
+
+    ev = mock.eval_for_job(job)
+    plans = solve_eval_batch(
+        h.state.snapshot(), h, [ev], SchedulerConfig(backend="tpu")
+    )
+    plan = plans[ev.id]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 8
+    preempted = [
+        a for allocs in plan.node_preemptions.values() for a in allocs
+    ]
+    assert len(preempted) == 8  # one victim per node
+    assert {a.id for a in preempted} == {la.id for _, la in lows}
+    # per-node exact capacity after evictions
+    for node in nodes:
+        keep = [
+            a
+            for a in h.state.allocs_by_node_terminal(node.id, False)
+            if a.id not in {p.id for p in preempted}
+        ]
+        new = plan.node_allocation.get(node.id, [])
+        total_cpu = sum(
+            a.comparable_resources().cpu for a in keep + new
+        )
+        assert total_cpu <= node.resources.cpu
