@@ -50,6 +50,13 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
 _NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]
 _NODE_WRITE = [("PUT", re.compile(r"^/v1/node/.*$")), ("POST", re.compile(r"^/v1/node/.*$"))]
 _AGENT_READ = [("GET", re.compile(r"^/v1/agent/.*$"))]
+# reference: raft list-peers / snapshot save need operator:read; snapshot
+# restore needs operator:write (nomad/operator_endpoint.go)
+_OPERATOR_READ = [("GET", re.compile(r"^/v1/operator/.*$"))]
+_OPERATOR_WRITE = [
+    ("PUT", re.compile(r"^/v1/operator/.*$")),
+    ("POST", re.compile(r"^/v1/operator/.*$")),
+]
 
 
 def make_http_resolver(server, enabled: bool = True):
@@ -124,6 +131,16 @@ def make_http_resolver(server, enabled: bool = True):
             if m == method and pat.match(path):
                 if not acl.allow_agent_read():
                     raise AuthError(403, "agent read denied")
+                return
+        for m, pat in _OPERATOR_WRITE:
+            if m == method and pat.match(path):
+                if not acl.allow_operator_write():
+                    raise AuthError(403, "operator write denied")
+                return
+        for m, pat in _OPERATOR_READ:
+            if m == method and pat.match(path):
+                if not acl.allow_operator_read():
+                    raise AuthError(403, "operator read denied")
                 return
         # Unmapped route under enforcement: require management (safe
         # default — new routes must be classified to be non-management).

@@ -71,12 +71,14 @@ class AgentConfig:
     # cluster shared secret authenticating the RPC fabric (rpc/server.py
     # trust-boundary note); empty ⇒ dev-mode trust-the-network
     rpc_secret: str = ""
+    # dev mode: in-memory raft (the reference's -dev is ephemeral too)
+    dev_mode: bool = False
 
     @staticmethod
     def dev() -> "AgentConfig":
         """-dev mode: server + client in one process (reference
         DevConfig, command.go)."""
-        return AgentConfig(server_enabled=True, client_enabled=True)
+        return AgentConfig(server_enabled=True, client_enabled=True, dev_mode=True)
 
 
 class Agent:
@@ -93,8 +95,27 @@ class Agent:
             expect = config.bootstrap_expect
             if config.server_join and expect <= 1:
                 expect = 0
+            # A durable server needs a STABLE identity across restarts
+            # (the raft log/vote belongs to a node id) — persist the
+            # generated name like the client persists its node id.
+            name = config.node_name
+            if not name and not config.dev_mode and config.data_dir:
+                import os
+                import uuid
+
+                name_file = os.path.join(config.data_dir, "server", "node-name")
+                try:
+                    with open(name_file) as f:
+                        name = f.read().strip()
+                except OSError:
+                    pass
+                if not name:
+                    name = f"server-{uuid.uuid4().hex[:8]}"
+                    os.makedirs(os.path.dirname(name_file), exist_ok=True)
+                    with open(name_file, "w") as f:
+                        f.write(name)
             self.server = ClusterServer(
-                config.node_name or f"server-{id(self) & 0xFFFF:x}",
+                name or f"server-{id(self) & 0xFFFF:x}",
                 host=config.bind_addr,
                 port=config.rpc_port,
                 num_workers=config.num_schedulers,
@@ -102,6 +123,7 @@ class Agent:
                 region=config.region,
                 bootstrap_expect=expect,
                 rpc_secret=config.rpc_secret,
+                data_dir=None if config.dev_mode else config.data_dir,
             )
         if config.client_enabled:
             if self.server is not None:

@@ -480,6 +480,29 @@ class HTTPAgentServer:
         route("GET", "/v1/acl/token/(?P<id>[^/]+)", acl_token_get)
         route("DELETE", "/v1/acl/token/(?P<id>[^/]+)", acl_token_delete)
 
+        # -- operator --------------------------------------------------
+        def operator_snapshot_save(p, q, body, tok):
+            import base64
+
+            resp = self.cluster.rpc_self("Operator.snapshot_save", {})
+            return {"Snapshot": base64.b64encode(resp["snapshot"]).decode()}
+
+        def operator_snapshot_restore(p, q, body, tok):
+            import base64
+
+            data = base64.b64decode(body["Snapshot"])
+            return self.cluster.rpc_self(
+                "Operator.snapshot_restore", {"data": data}
+            )
+
+        def operator_raft_config(p, q, body, tok):
+            return self.cluster.rpc_self("Operator.raft_configuration", {})
+
+        route("GET", "/v1/operator/snapshot", operator_snapshot_save)
+        route("PUT", "/v1/operator/snapshot", operator_snapshot_restore)
+        route("POST", "/v1/operator/snapshot", operator_snapshot_restore)
+        route("GET", "/v1/operator/raft/configuration", operator_raft_config)
+
         route("GET", "/v1/status/leader", status_leader)
         route("GET", "/v1/status/peers", status_peers)
         route("GET", "/v1/agent/members", agent_members)

@@ -46,6 +46,7 @@ class NomadClient:
         self.agent = AgentAPI(self)
         self.status = Status(self)
         self.acl = ACLAPI(self)
+        self.operator = Operator(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -263,6 +264,25 @@ class Deployments(_Resource):
 
     def fail(self, deployment_id: str):
         return self.c.put(f"/v1/deployment/fail/{deployment_id}")
+
+
+class Operator(_Resource):
+    def snapshot_save(self) -> bytes:
+        import base64
+
+        resp = self.c.get("/v1/operator/snapshot")
+        return base64.b64decode(resp["Snapshot"])
+
+    def snapshot_restore(self, data: bytes):
+        import base64
+
+        return self.c.put(
+            "/v1/operator/snapshot",
+            body={"Snapshot": base64.b64encode(data).decode()},
+        )
+
+    def raft_configuration(self):
+        return self.c.get("/v1/operator/raft/configuration")
 
 
 class AgentAPI(_Resource):

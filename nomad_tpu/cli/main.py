@@ -83,6 +83,7 @@ def cmd_agent(args) -> int:
     if args.dev:
         cfg.server_enabled = True
         cfg.client_enabled = True
+        cfg.dev_mode = True  # ephemeral raft, like the reference's -dev
     if args.server:
         cfg.server_enabled = True
     if args.client:
@@ -134,7 +135,10 @@ def _addr(s: str) -> tuple[str, int]:
 
 def _load_agent_config(path: str):
     from ..agent import AgentConfig
-    from ..jobspec import parse as parse_hcl
+
+    # NB: `from ..jobspec import parse` would bind the parse SUBMODULE
+    # (import machinery rebinds the package attr), not the hcl function.
+    from ..jobspec.hcl import parse as parse_hcl
 
     with open(path) as f:
         src = f.read()
@@ -692,6 +696,46 @@ def _find_by_prefix_attr(items, attr: str, prefix: str):
     return matches[0]
 
 
+def cmd_operator_snapshot_save(args) -> int:
+    """Reference: command/operator_snapshot_save.go."""
+    api = _client(args)
+    data = api.operator.snapshot_save()
+    with open(args.file, "wb") as f:
+        f.write(data)
+    print(f"State file written to {args.file} ({len(data)} bytes)")
+    return 0
+
+
+def cmd_operator_snapshot_restore(args) -> int:
+    """Reference: command/operator_snapshot_restore.go."""
+    api = _client(args)
+    with open(args.file, "rb") as f:
+        data = f.read()
+    api.operator.snapshot_restore(data)
+    print("Snapshot restored")
+    return 0
+
+
+def cmd_operator_raft_list_peers(args) -> int:
+    """Reference: command/operator_raft_list.go."""
+    api = _client(args)
+    peers = api.operator.raft_configuration()
+    print(
+        _fmt_table(
+            [
+                [
+                    p["id"],
+                    f"{p['address'][0]}:{p['address'][1]}",
+                    "leader" if p["leader"] else "follower",
+                ]
+                for p in peers
+            ],
+            ["Node", "Address", "State"],
+        )
+    )
+    return 0
+
+
 def cmd_server_members(args) -> int:
     api = _client(args)
     members = api.agent.members()
@@ -868,6 +912,21 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = srv.add_subparsers(dest="subcmd")
     sm = ssub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+
+    op = sub.add_parser("operator", help="operator commands")
+    opsub = op.add_subparsers(dest="subcmd")
+    opsnap = opsub.add_parser("snapshot")
+    opsnapsub = opsnap.add_subparsers(dest="subsubcmd")
+    opss = opsnapsub.add_parser("save")
+    opss.add_argument("file")
+    opss.set_defaults(fn=cmd_operator_snapshot_save)
+    opsr = opsnapsub.add_parser("restore")
+    opsr.add_argument("file")
+    opsr.set_defaults(fn=cmd_operator_snapshot_restore)
+    opraft = opsub.add_parser("raft")
+    opraftsub = opraft.add_subparsers(dest="subsubcmd")
+    oplp = opraftsub.add_parser("list-peers")
+    oplp.set_defaults(fn=cmd_operator_raft_list_peers)
 
     st = sub.add_parser("status", help="list jobs")
     st.add_argument("job_id", nargs="?")

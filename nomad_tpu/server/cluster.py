@@ -60,6 +60,39 @@ class _Forwarder:
         return self.cs.pool.call(addr, method, args, timeout_s=30.0)
 
 
+class OperatorEndpoint(_Forwarder):
+    """Reference: nomad/operator_endpoint.go + helper/snapshot — state
+    snapshot save/restore and raft introspection for operators."""
+
+    def snapshot_save(self, args):
+        # any server can serve its own (possibly slightly stale) state
+        return {"snapshot": self.cs.server.state.serialize()}
+
+    def snapshot_restore(self, args):
+        return self._forward(
+            "Operator.snapshot_restore",
+            args,
+            lambda a: self.cs.server.raft_apply("snapshot_restore", a["data"]),
+        )
+
+    def raft_configuration(self, args):
+        out = [
+            {
+                "id": self.cs.node_id,
+                "address": list(self.cs.rpc.addr),
+                "leader": self.cs.raft.is_leader(),
+            }
+        ]
+        with self.cs.raft._lock:
+            peers = dict(self.cs.raft.peers)
+        leader = self.cs.raft.leader_id
+        for pid, addr in peers.items():
+            out.append(
+                {"id": pid, "address": list(addr), "leader": pid == leader}
+            )
+        return out
+
+
 class JobEndpoint(_Forwarder):
     def register(self, args):
         return self._forward(
@@ -327,6 +360,7 @@ class ClusterServer:
         region: str = "global",
         bootstrap_expect: Optional[int] = None,
         rpc_secret: str = "",
+        data_dir: Optional[str] = None,
         **raft_kw,
     ) -> None:
         self.node_id = node_id
@@ -350,6 +384,18 @@ class ClusterServer:
         raft_kw.setdefault("bootstrap_expect", bootstrap_expect)
         self._bootstrap_expect = bootstrap_expect
         self._bootstrapped = bool(peers) or bootstrap_expect <= 1
+        # Durable raft storage (reference: raft-boltdb + FSM snapshots,
+        # nomad/server.go:1210): with a data_dir, term/vote/log/snapshot
+        # survive a full-cluster restart.
+        self.raft_store = None
+        if data_dir:
+            import os
+
+            from .raft_store import RaftLogStore
+
+            self.raft_store = RaftLogStore(
+                os.path.join(data_dir, "server", "raft.db")
+            )
         self.raft = RaftNode(
             node_id,
             self.server.fsm,
@@ -359,6 +405,7 @@ class ClusterServer:
             snapshot_fn=self.server.state.serialize,
             restore_fn=self.server.state.restore_from,
             on_leader_change=self._on_leader_change,
+            store=self.raft_store,
             **raft_kw,
         )
         self.server.set_raft_applier(self._raft_apply)
@@ -371,6 +418,7 @@ class ClusterServer:
             ("Deployment", DeploymentEndpoint(self)),
             ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),
+            ("Operator", OperatorEndpoint(self)),
         ):
             self.rpc.register(name, ep)
         # Gossip membership (reference setupSerf): server-role tagged,
@@ -489,6 +537,8 @@ class ClusterServer:
         self.server.shutdown()
         self.rpc.shutdown()
         self.pool.shutdown()
+        if self.raft_store is not None:
+            self.raft_store.close()
 
 
 class ClusterRPC:

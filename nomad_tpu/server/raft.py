@@ -45,6 +45,11 @@ class FSM:
         self.on_job_upsert: Optional[Callable] = None  # periodic tracking
         self._handlers = {
             "noop": lambda index, payload: None,  # leader election barrier
+            # operator snapshot restore rides the log so every replica
+            # swaps state at the same point (reference SnapshotRestore);
+            # indexes rebase to the log entry's index so monotonicity
+            # holds regardless of where the snapshot came from
+            "snapshot_restore": self._apply_snapshot_restore,
             "node_register": self._apply_node_register,
             "node_deregister": self._apply_node_deregister,
             "node_update_status": self._apply_node_status,
@@ -156,6 +161,10 @@ class FSM:
         self.state.update_alloc_desired_transition(index, transitions, evals)
         if evals and self.on_eval_update:
             self.on_eval_update(evals)
+
+    def _apply_snapshot_restore(self, index: int, data: bytes) -> None:
+        self.state.restore_from(data)
+        self.state.rebase_indexes(index)
 
     def _apply_plan_results(self, index: int, result: PlanResult) -> None:
         self.state.upsert_plan_results(index, result)

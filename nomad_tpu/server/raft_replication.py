@@ -92,6 +92,7 @@ class RaftNode:
         snapshot_fn: Optional[Callable[[], bytes]] = None,
         restore_fn: Optional[Callable[[bytes], None]] = None,
         on_leader_change: Optional[Callable[[bool], None]] = None,
+        store=None,
     ) -> None:
         self.node_id = node_id
         self.fsm = fsm
@@ -113,18 +114,37 @@ class RaftNode:
 
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
-        # Persistent state (in-memory for in-process clusters; the
-        # snapshot/restore path in snapshot.py provides durability).
+        # Persistent state. With a `store` (raft_store.RaftLogStore,
+        # SQLite — the reference's raft-boltdb analog) the term/vote/log/
+        # snapshot survive restarts per §5.1; without one (in-process
+        # test clusters) everything is memory-only.
+        self.store = store
         self.current_term = 0
         self.voted_for: Optional[str] = None
         self._log: list[LogEntry] = []  # log[i] has index snapshot_index+i+1
         self._snap_last_index = 0
         self._snap_last_term = 0
         self._snap_bytes: Optional[bytes] = None
+        if store is not None:
+            self.current_term, self.voted_for = store.get_state()
+            snap = store.load_snapshot()
+            if snap is not None:
+                self._snap_bytes, self._snap_last_index, self._snap_last_term = snap
+            self._log = store.load_log()
+            # Drop any stale prefix a crash may have left behind the
+            # persisted snapshot.
+            self._log = [e for e in self._log if e.index > self._snap_last_index]
         # Volatile state
         self.state = FOLLOWER
         self.commit_index = 0
         self.last_applied = 0
+        if store is not None and self._snap_bytes is not None:
+            # Rebuild the FSM from the persisted snapshot; the log tail
+            # replays once the next leader re-commits it (no-op barrier).
+            if restore_fn is not None:
+                restore_fn(self._snap_bytes)
+            self.commit_index = self._snap_last_index
+            self.last_applied = self._snap_last_index
         self.leader_id: Optional[str] = None
         self._last_heartbeat = time.monotonic()
         self._votes: set[str] = set()
@@ -149,6 +169,10 @@ class RaftNode:
 
     # ------------------------------------------------------------------
     # log helpers (all under lock)
+
+    def _persist_state_locked(self) -> None:
+        if self.store is not None:
+            self.store.set_state(self.current_term, self.voted_for)
 
     def _last_log_index(self) -> int:
         return self._log[-1].index if self._log else self._snap_last_index
@@ -234,6 +258,8 @@ class RaftNode:
             term = self.current_term
             entry = LogEntry(index, term, msg_type, payload)
             self._log.append(entry)
+            if self.store is not None:
+                self.store.append([entry])
             self._match_index[self.node_id] = index
             for ev in self._repl_wake.values():
                 ev.set()
@@ -359,6 +385,7 @@ class RaftNode:
             self.current_term += 1
             term = self.current_term
             self.voted_for = self.node_id
+            self._persist_state_locked()
             self._votes = {self.node_id}
             self.leader_id = None
             self._last_heartbeat = time.monotonic()
@@ -418,9 +445,12 @@ class RaftNode:
         # Barrier no-op in our own term: commit can only count current-term
         # entries (§5.4.2), so without this a fresh leader would sit on
         # fully-replicated prior-term entries until the next real write.
-        self._log.append(
-            LogEntry(self._last_log_index() + 1, self.current_term, "noop", None)
+        barrier = LogEntry(
+            self._last_log_index() + 1, self.current_term, "noop", None
         )
+        self._log.append(barrier)
+        if self.store is not None:
+            self.store.append([barrier])
         last = self._last_log_index()
         self._next_index = {p: last + 1 for p in self.peers}
         self._match_index = {p: 0 for p in self.peers}
@@ -444,6 +474,7 @@ class RaftNode:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
+            self._persist_state_locked()
         self.state = FOLLOWER
         # Forget the old leader until an AppendEntries names the new one —
         # a deposed leader keeping itself as the hint would make forwards
@@ -629,6 +660,9 @@ class RaftNode:
         self._snap_last_index = idx
         self._snap_last_term = term
         self._log = [e for e in self._log if e.index > idx]
+        if self.store is not None:
+            # store_snapshot also compacts the persisted log prefix
+            self.store.store_snapshot(self._snap_bytes, idx, term)
         logger.info("%s: snapshot at index %d", self.node_id, idx)
 
     def _maybe_compact_locked(self) -> None:
@@ -655,6 +689,10 @@ class RaftNode:
             )
             if up_to_date and self.voted_for in (None, args["candidate_id"]):
                 self.voted_for = args["candidate_id"]
+                # The vote MUST hit disk before the reply (§5.1): a
+                # rebooted node that forgot its vote could vote twice
+                # in one term and elect two leaders.
+                self._persist_state_locked()
                 self._last_heartbeat = time.monotonic()
                 return {"term": self.current_term, "granted": True}
             return {"term": self.current_term, "granted": False}
@@ -690,6 +728,7 @@ class RaftNode:
                     "success": False,
                     "conflict_index": ci,
                 }
+            appended: list[LogEntry] = []
             for raw in args["entries"]:
                 idx, eterm, msg_type, payload = raw
                 existing = self._entry_at(idx)
@@ -699,8 +738,16 @@ class RaftNode:
                     # conflict: truncate from idx on
                     keep = idx - self._snap_last_index - 1
                     self._log = self._log[:keep]
+                    if self.store is not None:
+                        self.store.truncate_from(idx)
                 if idx == self._last_log_index() + 1:
-                    self._log.append(LogEntry(idx, eterm, msg_type, payload))
+                    entry = LogEntry(idx, eterm, msg_type, payload)
+                    self._log.append(entry)
+                    appended.append(entry)
+            if appended and self.store is not None:
+                # Persist before acking: success tells the leader these
+                # entries are stable on this follower.
+                self.store.append(appended)
             if args["leader_commit"] > self.commit_index:
                 # §5.3: clamp to the index of the last entry COVERED BY
                 # THIS REQUEST, not our last log index — we may hold
@@ -740,6 +787,8 @@ class RaftNode:
             self._snap_last_index = last_idx
             self._snap_last_term = last_term
             self._log = [e for e in self._log if e.index > last_idx]
+            if self.store is not None and args["data"] is not None:
+                self.store.store_snapshot(args["data"], last_idx, last_term)
             self.commit_index = max(self.commit_index, last_idx)
             self.last_applied = max(self.last_applied, last_idx)
             self._commit_cv.notify_all()

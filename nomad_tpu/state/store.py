@@ -415,6 +415,23 @@ class StateStore(_ReadMixin):
             self._shared = set()
             self._cv.notify_all()
 
+    def rebase_indexes(self, index: int) -> None:
+        """Re-stamp every table index to `index` after an operator
+        snapshot restore.
+
+        The snapshot carries the indexes of the CLUSTER IT WAS SAVED
+        FROM; the restoring cluster's raft log continues from its own
+        position. Without rebasing, a snapshot saved at index 5000
+        restored into a cluster at index 4 leaves _latest_index=5000
+        while new writes stamp 5,6,... — wait_for_index goes stale and
+        blocking queries hang (the reference avoids this by resetting
+        raft itself to a post-snapshot index in helper/snapshot)."""
+        with self._cv:
+            for t in self._indexes:
+                self._indexes[t] = index
+            self._latest_index = index
+            self._cv.notify_all()
+
     # -- write plumbing ------------------------------------------------
 
     def _wtable(self, table: str) -> dict:
