@@ -16,7 +16,9 @@ from .acl import ACL
 from .policy import (
     CAP_DISPATCH_JOB,
     CAP_LIST_JOBS,
+    CAP_READ_FS,
     CAP_READ_JOB,
+    CAP_READ_LOGS,
     CAP_SUBMIT_JOB,
 )
 
@@ -45,6 +47,11 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v1/deployment/.*$"), CAP_READ_JOB),
     ("PUT", re.compile(r"^/v1/deployment/.*$"), CAP_SUBMIT_JOB),
     ("GET", re.compile(r"^/v1/event/stream$"), CAP_READ_JOB),
+    # streaming alloc surface (handlers re-check against the alloc's
+    # own namespace via _ns_guard; exec rides the RPC fabric and is
+    # checked in ClusterServer._handle_exec_stream with CAP_ALLOC_EXEC)
+    ("GET", re.compile(r"^/v1/client/fs/logs/.*$"), CAP_READ_LOGS),
+    ("GET", re.compile(r"^/v1/client/fs/(ls|cat|stat)/.*$"), CAP_READ_FS),
 ]
 
 _NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]

@@ -124,6 +124,7 @@ class Agent:
                 bootstrap_expect=expect,
                 rpc_secret=config.rpc_secret,
                 data_dir=None if config.dev_mode else config.data_dir,
+                acl_enforce=config.acl_enabled,
             )
         if config.client_enabled:
             if self.server is not None:
@@ -145,6 +146,8 @@ class Agent:
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,
+                rpc_secret=config.rpc_secret,
+                advertise_host=config.bind_addr,
             )
         if self.server is not None:
             from .http import HTTPAgentServer

@@ -398,6 +398,8 @@ class HTTPAgentServer:
         def agent_self(p, q, body, tok):
             return {
                 "member": self.cluster.serf.local.to_wire(),
+                # the fabric address: SDK/CLI exec dials this directly
+                "rpc_addr": list(self.cluster.rpc.addr),
                 "stats": {
                     "leader": self.cluster.is_leader(),
                     "raft_last_index": self.cluster.raft.last_index,
@@ -479,6 +481,26 @@ class HTTPAgentServer:
         route("GET", "/v1/acl/token/self", acl_token_self)
         route("GET", "/v1/acl/token/(?P<id>[^/]+)", acl_token_get)
         route("DELETE", "/v1/acl/token/(?P<id>[^/]+)", acl_token_delete)
+
+        # -- client fs (non-streaming halves) --------------------------
+        def client_fs_ls(p, q, body, tok):
+            alloc = self._resolve_alloc(p["id"])
+            self._ns_guard(tok, alloc.namespace, "read-fs")
+            msg = self._client_roundtrip(
+                alloc, "FS.ls", {"path": q.get("path", [""])[0]}
+            )
+            return msg.get("entries", [])
+
+        def client_fs_stat(p, q, body, tok):
+            alloc = self._resolve_alloc(p["id"])
+            self._ns_guard(tok, alloc.namespace, "read-fs")
+            msg = self._client_roundtrip(
+                alloc, "FS.stat", {"path": q.get("path", [""])[0]}
+            )
+            return msg.get("stat")
+
+        route("GET", "/v1/client/fs/ls/(?P<id>[^/]+)", client_fs_ls)
+        route("GET", "/v1/client/fs/stat/(?P<id>[^/]+)", client_fs_stat)
 
         # -- operator --------------------------------------------------
         def operator_snapshot_save(p, q, body, tok):
@@ -576,6 +598,85 @@ class HTTPAgentServer:
             except OSError:
                 pass
 
+    # -- client fs/logs streaming (reference client_fs_endpoint.go) ----
+
+    def _resolve_alloc(self, alloc_id: str):
+        try:
+            alloc, _ = self.cluster.find_alloc_client(alloc_id)
+        except LookupError as e:
+            raise HTTPError(
+                400 if "ambiguous" in str(e) else 404, str(e)
+            ) from e
+        return alloc
+
+    def _client_session(self, alloc, method: str, header: dict):
+        """Dial the alloc's client agent (advertised node attr) and open
+        a stream — the server half of the 4-boundary streaming path."""
+        try:
+            _, addr = self.cluster.find_alloc_client(alloc.id)
+        except LookupError as e:
+            raise HTTPError(404, str(e)) from e
+        header = dict(header)
+        header["alloc_id"] = alloc.id
+        try:
+            return self.cluster.pool.stream(addr, method, header)
+        except (ConnectionError, OSError) as e:
+            raise HTTPError(502, f"client agent unreachable: {e}")
+
+    def _client_roundtrip(self, alloc, method: str, header: dict) -> dict:
+        session = self._client_session(alloc, method, header)
+        try:
+            msg = session.recv(timeout_s=30)
+        finally:
+            session.close()
+        if msg.get("error"):
+            raise HTTPError(500, msg["error"])
+        return msg
+
+    def _serve_fs_raw(self, handler, alloc_id: str, method: str, header: dict):
+        """Relay a client byte stream as a chunked HTTP response
+        (logs/cat; follow=true keeps the connection open)."""
+        alloc = self._resolve_alloc(alloc_id)
+        session = self._client_session(alloc, method, header)
+        started = False
+        try:
+            while True:
+                try:
+                    msg = session.recv(timeout_s=60)
+                except (TimeoutError, ConnectionError, OSError):
+                    break
+                if msg.get("error"):
+                    if not started:
+                        raise HTTPError(500, msg["error"])
+                    break
+                if not started:
+                    handler.send_response(200)
+                    handler.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    handler.send_header("Transfer-Encoding", "chunked")
+                    handler.end_headers()
+                    started = True
+                data = msg.get("data")
+                if data:
+                    handler.wfile.write(f"{len(data):x}\r\n".encode())
+                    handler.wfile.write(data + b"\r\n")
+                    handler.wfile.flush()
+                if msg.get("eof"):
+                    break
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            session.close()
+            if started:
+                try:
+                    handler.wfile.write(b"0\r\n\r\n")
+                    handler.wfile.flush()
+                except OSError:
+                    pass
+        if not started:
+            raise HTTPError(502, "no data from client agent")
+
     # -- the handler class ---------------------------------------------
 
     def _make_handler(self):
@@ -610,6 +711,28 @@ class HTTPAgentServer:
                             raise HTTPError(ae.status, ae.message)
                     if parsed.path == "/v1/event/stream":
                         outer._serve_event_stream(self, query)
+                        return
+                    fs_m = re.match(
+                        r"^/v1/client/fs/(logs|cat)/(?P<id>[^/]+)$",
+                        parsed.path,
+                    )
+                    if method == "GET" and fs_m:
+                        alloc = outer._resolve_alloc(fs_m.group("id"))
+                        if fs_m.group(1) == "logs":
+                            outer._ns_guard(token, alloc.namespace, "read-logs")
+                            hdr = {
+                                "task": query.get("task", [""])[0],
+                                "type": query.get("type", ["stdout"])[0],
+                                "follow": query.get("follow", ["false"])[0]
+                                == "true",
+                                "origin": query.get("origin", ["start"])[0],
+                                "offset": int(query.get("offset", ["0"])[0]),
+                            }
+                            outer._serve_fs_raw(self, alloc.id, "FS.logs", hdr)
+                        else:
+                            outer._ns_guard(token, alloc.namespace, "read-fs")
+                            hdr = {"path": query.get("path", [""])[0]}
+                            outer._serve_fs_raw(self, alloc.id, "FS.cat", hdr)
                         return
                     for m, pattern, fn in outer._routes:
                         if m != method:

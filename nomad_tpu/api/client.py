@@ -229,6 +229,117 @@ class Allocations(_Resource):
     def get(self, alloc_id: str):
         return self.c.get(f"/v1/allocation/{alloc_id}")
 
+    # -- streaming alloc surface (reference api/fs.go, allocations_exec) --
+
+    def logs(
+        self,
+        alloc_id: str,
+        task: str = "",
+        log_type: str = "stdout",
+        follow: bool = False,
+        origin: str = "start",
+        offset: int = 0,
+    ):
+        """Yields raw log chunks; with follow=True, blocks for more."""
+        resp = self.c.get(
+            f"/v1/client/fs/logs/{alloc_id}",
+            params={
+                "task": task,
+                "type": log_type,
+                "follow": "true" if follow else "false",
+                "origin": origin,
+                "offset": offset or None,
+            },
+            raw=True,
+            timeout_s=None if follow else 30,
+        )
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                return
+            yield chunk
+
+    def fs_ls(self, alloc_id: str, path: str = ""):
+        return self.c.get(
+            f"/v1/client/fs/ls/{alloc_id}", params={"path": path}
+        )
+
+    def fs_stat(self, alloc_id: str, path: str = ""):
+        return self.c.get(
+            f"/v1/client/fs/stat/{alloc_id}", params={"path": path}
+        )
+
+    def fs_cat(self, alloc_id: str, path: str) -> bytes:
+        resp = self.c.get(
+            f"/v1/client/fs/cat/{alloc_id}", params={"path": path}, raw=True
+        )
+        return resp.read()
+
+    def exec_session(
+        self,
+        alloc_id: str,
+        cmd: list,
+        task: str = "",
+        tty: bool = False,
+        rpc_secret: str = "",
+    ):
+        """Open an interactive exec session over the RPC fabric.
+
+        Returns an ExecSession: .recv() yields output frames, .send_stdin()
+        writes input, .close() ends it. The fabric address comes from
+        /v1/agent/self; a cluster rpc_secret must be supplied when the
+        fabric requires one.
+        """
+        from ..rpc import ConnPool
+
+        info = self.c.get("/v1/agent/self")
+        host, port = info["rpc_addr"]
+        pool = ConnPool(secret=rpc_secret)
+        session = pool.stream(
+            (host, int(port)),
+            "ClientExec.exec",
+            {
+                "alloc_id": alloc_id,
+                "task": task,
+                "cmd": cmd,
+                "tty": tty,
+                "token": self.c.token,
+            },
+        )
+        first = session.recv(timeout_s=30)
+        if first.get("error"):
+            session.close()
+            pool.shutdown()
+            raise APIError(500, first["error"])
+        return ExecSession(session, pool)
+
+
+class ExecSession:
+    """Client half of an interactive exec (reference api/allocations_exec)."""
+
+    def __init__(self, session, pool) -> None:
+        self._session = session
+        self._pool = pool
+
+    def recv(self, timeout_s=None):
+        """Next output frame: {'data': bytes} | {'eof': True} |
+        {'error': str}; None on timeout."""
+        try:
+            return self._session.recv(timeout_s=timeout_s)
+        except TimeoutError:
+            return None
+
+    def send_stdin(self, data: bytes) -> None:
+        self._session.send({"stdin": data})
+
+    def close(self) -> None:
+        try:
+            self._session.send({"eof": True})
+        except (ConnectionError, OSError):
+            pass
+        self._session.close()
+        self._pool.shutdown()
+
 
 class Evaluations(_Resource):
     def list(self):

@@ -483,6 +483,104 @@ def cmd_node_eligibility(args) -> int:
     return 0
 
 
+def cmd_alloc_logs(args) -> int:
+    """Reference: command/alloc_logs.go."""
+    import sys as _sys
+
+    api = _client(args)
+    alloc = _find_by_prefix(api.allocations.list(), args.alloc_id)
+    task = args.task
+    if not task:
+        # single-task groups don't need -task
+        a = api.allocations.get(alloc.id)
+        tasks = list(a.task_states) or [a.task_group]
+        task = tasks[0]
+    try:
+        for chunk in api.allocations.logs(
+            alloc.id,
+            task=task,
+            log_type="stderr" if args.stderr else "stdout",
+            follow=args.follow,
+        ):
+            _sys.stdout.buffer.write(chunk)
+            _sys.stdout.buffer.flush()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_alloc_fs(args) -> int:
+    """Reference: command/alloc_fs.go — ls when the path is a directory,
+    cat when it is a file."""
+    import sys as _sys
+
+    api = _client(args)
+    alloc = _find_by_prefix(api.allocations.list(), args.alloc_id)
+    path = args.path or ""
+    st = api.allocations.fs_stat(alloc.id, path)
+    if st and st.get("is_dir"):
+        entries = api.allocations.fs_ls(alloc.id, path)
+        rows = [
+            [
+                "dir" if e["is_dir"] else "file",
+                str(e["size"]),
+                e["name"],
+            ]
+            for e in entries
+        ]
+        print(_fmt_table(rows, ["Type", "Size", "Name"]))
+    else:
+        _sys.stdout.buffer.write(api.allocations.fs_cat(alloc.id, path))
+    return 0
+
+
+def cmd_alloc_exec(args) -> int:
+    """Reference: command/alloc_exec.go — interactive exec into a task."""
+    import os as _os
+    import sys as _sys
+    import threading as _threading
+
+    api = _client(args)
+    alloc = _find_by_prefix(api.allocations.list(), args.alloc_id)
+    secret = args.rpc_secret or _os.environ.get("NOMAD_TPU_RPC_SECRET", "")
+    session = api.allocations.exec_session(
+        alloc.id, args.cmd, task=args.task, tty=args.tty, rpc_secret=secret
+    )
+    stop = _threading.Event()
+
+    def pump_stdin() -> None:
+        try:
+            while not stop.is_set():
+                data = _sys.stdin.buffer.raw.read(4096)
+                if not data:
+                    break
+                session.send_stdin(data)
+        except (OSError, ValueError):
+            pass
+
+    t = _threading.Thread(target=pump_stdin, daemon=True)
+    t.start()
+    try:
+        while True:
+            msg = session.recv(timeout_s=0.5)
+            if msg is None:
+                continue
+            if msg.get("error"):
+                print(f"exec error: {msg['error']}", file=_sys.stderr)
+                return 1
+            data = msg.get("data")
+            if data:
+                _sys.stdout.buffer.write(data)
+                _sys.stdout.buffer.flush()
+            if msg.get("eof"):
+                return 0
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        stop.set()
+        session.close()
+
+
 def cmd_alloc_status(args) -> int:
     api = _client(args)
     alloc = _find_by_prefix(api.allocations.list(), args.alloc_id)
@@ -851,6 +949,25 @@ def build_parser() -> argparse.ArgumentParser:
     ast = asub.add_parser("status")
     ast.add_argument("alloc_id")
     ast.set_defaults(fn=cmd_alloc_status)
+    alg = asub.add_parser("logs")
+    alg.add_argument("-f", "-follow", dest="follow", action="store_true")
+    alg.add_argument("-stderr", action="store_true")
+    alg.add_argument("-task", default="")
+    alg.add_argument("alloc_id")
+    alg.set_defaults(fn=cmd_alloc_logs)
+    afs = asub.add_parser("fs")
+    afs.add_argument("alloc_id")
+    afs.add_argument("path", nargs="?", default="")
+    afs.set_defaults(fn=cmd_alloc_fs)
+    aex = asub.add_parser("exec")
+    aex.add_argument("-t", "-tty", dest="tty", action="store_true")
+    aex.add_argument("-task", default="")
+    aex.add_argument("-rpc-secret", dest="rpc_secret", default="")
+    aex.add_argument("alloc_id")
+    # REMAINDER: everything after the alloc id belongs to the command,
+    # including its own dashed flags (nomad alloc exec <id> sh -c ...)
+    aex.add_argument("cmd", nargs=argparse.REMAINDER)
+    aex.set_defaults(fn=cmd_alloc_exec)
 
     ev = sub.add_parser("eval", help="eval commands")
     esub = ev.add_subparsers(dest="subcmd")

@@ -56,12 +56,25 @@ class Client:
         node_class: str = "",
         node: Optional[Node] = None,
         drivers: Optional[dict[str, Driver]] = None,
+        rpc_secret: str = "",
+        advertise_host: str = "127.0.0.1",
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
         self.node = node or fingerprint_node(
             datacenter=datacenter, node_class=node_class, data_dir="/tmp"
         )
+        # Streaming fs/logs/exec listener; its address is advertised as a
+        # node attribute so servers can dial back (client/endpoints.py).
+        # advertise_host must be reachable FROM the servers (the agent
+        # passes its bind_addr; loopback only works single-host).
+        from .endpoints import ClientEndpoints
+
+        self.endpoints = ClientEndpoints(
+            self, host=advertise_host, secret=rpc_secret
+        )
+        host, port = self.endpoints.addr
+        self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
         self.drivers = drivers or {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
         for name, driver in self.drivers.items():
             fp = driver.fingerprint()
@@ -96,6 +109,7 @@ class Client:
     # -- lifecycle -----------------------------------------------------
 
     def start(self) -> None:
+        self.endpoints.start()
         self._restore()
         # Registration happens ON the heartbeat thread with retries
         # (reference registerAndHeartbeat runs in a goroutine): agent boot
@@ -115,6 +129,7 @@ class Client:
         incarnation's restore (the reference's default — tasks outlive
         the agent process)."""
         self._shutdown.set()
+        self.endpoints.stop()
         if kill_allocs:
             for ar in list(self.alloc_runners.values()):
                 ar.destroy()

@@ -1,0 +1,308 @@
+"""Client-agent RPC surface: streaming fs/logs/exec.
+
+Reference: the client half of the 4-boundary streaming path (SURVEY
+§3.5) — client/fs_endpoint.go (Logs/Stream/List/Stat), client
+/alloc_endpoint.go (exec → driver ExecTaskStreaming). The reference
+reverse-dials over pooled yamux sessions (nomad/client_rpc.go); here the
+client agent runs a small listener on the shared fabric and advertises
+its address as the node attribute `unique.client.rpc` — servers dial it
+directly to splice streams through to API consumers.
+
+Stream wire format (msgpack frames over a fabric StreamSession):
+  {"data": bytes}            — payload chunk (fs/logs: file bytes;
+                                exec: process output)
+  {"stdin": bytes}           — exec input (consumer → client)
+  {"eof": True}              — end of stream
+  {"error": str}             — terminal failure
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from ..rpc.server import RPCServer, StreamSession
+
+logger = logging.getLogger("nomad_tpu.client.endpoints")
+
+CHUNK = 64 * 1024
+
+
+class ClientEndpoints:
+    """Owns the client agent's listener and its stream handlers."""
+
+    def __init__(self, client, host: str = "127.0.0.1", secret: str = "") -> None:
+        self.client = client
+        self.rpc = RPCServer(host=host, port=0, secret=secret)
+        self.rpc.register_stream("FS.logs", self._fs_logs)
+        self.rpc.register_stream("FS.ls", self._fs_ls)
+        self.rpc.register_stream("FS.cat", self._fs_cat)
+        self.rpc.register_stream("FS.stat", self._fs_stat)
+        self.rpc.register_stream("Exec.exec", self._exec)
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self.rpc.addr
+
+    def start(self) -> None:
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.rpc.shutdown()
+
+    # -- helpers --------------------------------------------------------
+
+    def _alloc_dir(self, alloc_id: str):
+        runner = self.client.alloc_runners.get(alloc_id)
+        if runner is None:
+            return None
+        return runner.allocdir
+
+    def _resolve(self, alloc_dir, rel_path: str) -> str:
+        """Confine a user path to the alloc dir (same rule as templates)."""
+        from .allocdir import confine
+
+        return confine(alloc_dir.alloc_dir, rel_path or ".")
+
+    # -- fs -------------------------------------------------------------
+
+    def _fs_logs(self, session: StreamSession, header: dict) -> None:
+        """Stream a task's stdout/stderr log, optionally following
+        (reference client/fs_endpoint.go Logs)."""
+        try:
+            alloc_id = header.get("alloc_id", "")
+            adir = self._alloc_dir(alloc_id)
+            runner = self.client.alloc_runners.get(alloc_id)
+            if adir is None or runner is None:
+                session.send({"error": "unknown allocation"})
+                return
+            task = header.get("task", "")
+            # The task name is caller-controlled: a path-shaped value
+            # would escape the alloc dir through stdout_path's join.
+            if task not in runner.task_runners:
+                session.send({"error": f"unknown task {task!r}"})
+                return
+            log_type = header.get("type", "stdout")
+            if log_type not in ("stdout", "stderr"):
+                session.send({"error": f"bad log type {log_type!r}"})
+                return
+            path = (
+                adir.stdout_path(task)
+                if log_type == "stdout"
+                else adir.stderr_path(task)
+            )
+            follow = bool(header.get("follow"))
+            offset = int(header.get("offset", 0))
+            origin = header.get("origin", "start")
+            try:
+                f = open(path, "rb")
+            except OSError as e:
+                session.send({"error": f"open log: {e}"})
+                return
+            with f:
+                if origin == "end":
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - offset))
+                elif offset:
+                    f.seek(offset)
+                idle = 0.0
+                while True:
+                    chunk = f.read(CHUNK)
+                    if chunk:
+                        idle = 0.0
+                        session.send({"data": chunk})
+                        continue
+                    if not follow:
+                        session.send({"eof": True})
+                        return
+                    # follow: wait for growth; detect copy-truncate
+                    # rotation (logmon) by the file shrinking under us
+                    time.sleep(0.2)
+                    idle += 0.2
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        size = 0
+                    if size < f.tell():
+                        f.seek(0)
+                    if idle > 5.0:
+                        # heartbeat keeps half-open connections detected
+                        session.send({"data": b""})
+                        idle = 0.0
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            session.close()
+
+    def _fs_ls(self, session: StreamSession, header: dict) -> None:
+        from .allocdir import EscapeError
+
+        try:
+            adir = self._alloc_dir(header.get("alloc_id", ""))
+            if adir is None:
+                session.send({"error": "unknown allocation"})
+                return
+            try:
+                path = self._resolve(adir, header.get("path", ""))
+            except EscapeError as e:
+                session.send({"error": str(e)})
+                return
+            entries = []
+            try:
+                for name in sorted(os.listdir(path)):
+                    full = os.path.join(path, name)
+                    st = os.stat(full)
+                    entries.append(
+                        {
+                            "name": name,
+                            "is_dir": os.path.isdir(full),
+                            "size": st.st_size,
+                            "mtime_ns": st.st_mtime_ns,
+                        }
+                    )
+            except OSError as e:
+                session.send({"error": f"ls: {e}"})
+                return
+            session.send({"entries": entries, "eof": True})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            session.close()
+
+    def _fs_stat(self, session: StreamSession, header: dict) -> None:
+        from .allocdir import EscapeError
+
+        try:
+            adir = self._alloc_dir(header.get("alloc_id", ""))
+            if adir is None:
+                session.send({"error": "unknown allocation"})
+                return
+            try:
+                path = self._resolve(adir, header.get("path", ""))
+                st = os.stat(path)
+            except (EscapeError, OSError) as e:
+                session.send({"error": str(e)})
+                return
+            session.send(
+                {
+                    "stat": {
+                        "name": os.path.basename(path),
+                        "is_dir": os.path.isdir(path),
+                        "size": st.st_size,
+                        "mtime_ns": st.st_mtime_ns,
+                    },
+                    "eof": True,
+                }
+            )
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            session.close()
+
+    def _fs_cat(self, session: StreamSession, header: dict) -> None:
+        from .allocdir import EscapeError
+
+        try:
+            adir = self._alloc_dir(header.get("alloc_id", ""))
+            if adir is None:
+                session.send({"error": "unknown allocation"})
+                return
+            try:
+                path = self._resolve(adir, header.get("path", ""))
+                f = open(path, "rb")
+            except (EscapeError, OSError) as e:
+                session.send({"error": str(e)})
+                return
+            with f:
+                while True:
+                    chunk = f.read(CHUNK)
+                    if not chunk:
+                        break
+                    session.send({"data": chunk})
+            session.send({"eof": True})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            session.close()
+
+    # -- exec -----------------------------------------------------------
+
+    def _exec(self, session: StreamSession, header: dict) -> None:
+        """Interactive exec into a running task: splice the fabric
+        session onto the driver's exec socket (reference
+        client/alloc_endpoint.go exec → ExecTaskStreaming)."""
+        sock = None
+        try:
+            alloc_id = header.get("alloc_id", "")
+            task_name = header.get("task", "")
+            cmd = list(header.get("cmd") or [])
+            runner = self.client.alloc_runners.get(alloc_id)
+            if runner is None:
+                session.send({"error": "unknown allocation"})
+                return
+            tr = runner.task_runners.get(task_name)
+            if tr is None:
+                names = list(runner.task_runners)
+                if len(names) == 1 and not task_name:
+                    tr = runner.task_runners[names[0]]
+                else:
+                    session.send({"error": f"unknown task {task_name!r}"})
+                    return
+            if not cmd:
+                session.send({"error": "exec needs a command"})
+                return
+            try:
+                sock = tr.driver.exec_task_streaming(
+                    tr.task_id, cmd, tty=bool(header.get("tty"))
+                )
+            except Exception as e:
+                session.send({"error": f"exec: {e}"})
+                return
+            session.send({"ok": True})
+            done = threading.Event()
+
+            def pump_out() -> None:
+                try:
+                    while True:
+                        data = sock.recv(CHUNK)
+                        if not data:
+                            break
+                        session.send({"data": data})
+                    session.send({"eof": True})
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=pump_out, daemon=True)
+            t.start()
+            while not done.is_set():
+                try:
+                    msg = session.recv(timeout_s=0.5)
+                except TimeoutError:
+                    continue
+                except (ConnectionError, OSError):
+                    break
+                if msg is None or msg.get("eof"):
+                    try:
+                        sock.shutdown(2)
+                    except OSError:
+                        pass
+                    break
+                stdin = msg.get("stdin")
+                if stdin:
+                    try:
+                        sock.sendall(stdin)
+                    except OSError:
+                        break
+            done.wait(timeout=5)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if sock is not None:
+                sock.close()
+            session.close()

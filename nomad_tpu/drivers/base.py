@@ -122,5 +122,11 @@ class Driver:
     def exec_task(self, task_id: str, cmd: list[str], timeout_s: float = 30.0) -> tuple[bytes, int]:
         raise DriverError(f"driver {self.name} does not support exec")
 
+    def exec_task_streaming(self, task_id: str, cmd: list[str], tty: bool = False):
+        """Interactive exec: returns a connected socket bridging the
+        exec'd process's stdio (reference ExecTaskStreaming,
+        plugins/drivers/execstreaming.go)."""
+        raise DriverError(f"driver {self.name} does not support exec")
+
     def recover_task(self, handle: TaskHandle) -> None:
         raise DriverError(f"driver {self.name} cannot recover tasks")

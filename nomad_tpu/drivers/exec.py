@@ -190,6 +190,52 @@ class ExecDriver(Driver):
             raise DriverError(f"unknown signal {signal}")
         task.handle.signal(int(sig))
 
+    def exec_task_streaming(self, task_id: str, cmd: list[str], tty: bool = False):
+        task = self._get(task_id)
+        try:
+            return task.handle.exec_stream(cmd, tty=tty)
+        except (ExecutorError, OSError) as e:
+            raise DriverError(f"exec: {e}") from e
+
+    def exec_task(
+        self, task_id: str, cmd: list[str], timeout_s: float = 30.0
+    ) -> tuple[bytes, int]:
+        """One-shot exec: run, collect output until EOF.
+
+        The raw bridge carries no exit-status trailer, so the command is
+        wrapped to append one (stripped before returning)."""
+        import re as _re
+        import shlex as _shlex
+        import time as _time
+
+        wrapped = [
+            "/bin/sh",
+            "-c",
+            _shlex.join(cmd) + '; printf "\\n__NOMAD_EXIT:%d\\n" $?',
+        ]
+        sock = self.exec_task_streaming(task_id, wrapped, tty=False)
+        out = b""
+        sock.settimeout(timeout_s)
+        deadline = _time.monotonic() + timeout_s
+        timed_out = True
+        try:
+            while _time.monotonic() < deadline:
+                try:
+                    chunk = sock.recv(65536)
+                except OSError:
+                    timed_out = False
+                    break
+                if not chunk:
+                    timed_out = False
+                    break
+                out += chunk
+        finally:
+            sock.close()
+        m = _re.search(rb"\n__NOMAD_EXIT:(\d+)\n", out)
+        if m:
+            return out[: m.start()], int(m.group(1))
+        return out, 124 if timed_out else -1
+
     def recover_task(self, handle: TaskHandle) -> None:
         """Reconnect to the surviving executor daemon."""
         sock = handle.state.get("socket_path")

@@ -53,6 +53,13 @@ def executor_binary(cache_dir: Optional[str] = None) -> str:
             text=True,
         )
         if proc.returncode != 0:
+            # pre-2.34 glibc keeps forkpty in libutil
+            proc = subprocess.run(
+                [gxx, "-O2", "-std=c++17", "-o", tmp, str(_SRC), "-lutil"],
+                capture_output=True,
+                text=True,
+            )
+        if proc.returncode != 0:
             raise ExecutorError(f"executor build failed:\n{proc.stderr}")
         os.replace(tmp, out)
     return str(out)
@@ -113,6 +120,35 @@ class ExecutorHandle:
 
     def stats(self) -> dict:
         return self._cmd("stats")
+
+    def exec_stream(self, args: list[str], tty: bool = False) -> socket.socket:
+        """Spawn a process inside the task's containment and return the
+        raw bridge socket (pty master or socketpair on the other side).
+        Caller owns the socket; closing it kills the exec'd process."""
+        if not args:
+            raise ExecutorError("exec needs argv")
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(5.0)
+        try:
+            conn.connect(self.socket_path)
+            fields = ["exec", "1" if tty else "0"] + list(args)
+            line = "\t".join(_esc(f) for f in fields)
+            conn.sendall(line.encode() + b"\n")
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = conn.recv(256)
+                if not chunk:
+                    raise ExecutorError("executor connection closed")
+                buf += chunk
+        except Exception:
+            conn.close()
+            raise
+        text = buf.decode().strip()
+        if text.startswith("err"):
+            conn.close()
+            raise ExecutorError(text[4:] or "exec failed")
+        conn.settimeout(None)
+        return conn
 
     def shutdown(self) -> None:
         try:

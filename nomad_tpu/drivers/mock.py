@@ -141,6 +141,33 @@ class MockDriver(Driver):
         if task.cfg.config.get("signal_error"):
             raise DriverError(str(task.cfg.config["signal_error"]))
 
+    def exec_task(self, task_id: str, cmd: list[str], timeout_s: float = 30.0) -> tuple[bytes, int]:
+        self._get(task_id)
+        return (" ".join(cmd)).encode() + b"\n", 0
+
+    def exec_task_streaming(self, task_id: str, cmd: list[str], tty: bool = False):
+        """Echo server standing in for a real exec session (tests)."""
+        import socket as _socket
+
+        self._get(task_id)
+        parent, inner = _socket.socketpair()
+
+        def _echo():
+            try:
+                inner.sendall((" ".join(cmd)).encode() + b"\n")
+                while True:
+                    data = inner.recv(4096)
+                    if not data:
+                        break
+                    inner.sendall(data)
+            except OSError:
+                pass
+            finally:
+                inner.close()
+
+        threading.Thread(target=_echo, daemon=True).start()
+        return parent
+
     def recover_task(self, handle: TaskHandle) -> None:
         with self._lock:
             if handle.task_id in self.tasks:

@@ -12,11 +12,17 @@
 //     optional setuid/setgid;
 //   * serves a line protocol on a unix socket: status / wait / signal /
 //     stop <grace_ms> / stats / shutdown — the Python driver reconnects
-//     to the same socket after a client restart (RecoverTask).
+//     to the same socket after a client restart (RecoverTask);
+//   * "exec <tty> <arg>..." (args backslash-escaped like the spec)
+//     spawns a NEW process in the task's cgroup/credentials with a pty
+//     (tty=1) or socketpair (tty=0) and switches that connection into a
+//     raw byte bridge — the native half of the reference's
+//     ExecTaskStreaming (plugins/drivers/execstreaming.go).
 //
 // Protocol responses are single lines: "ok k=v k=v ..." or "err <msg>".
 // Single-threaded poll(2) loop; "wait" parks the connection until the
-// task exits (deferred response), so no threads are needed.
+// task exits (deferred response) and exec bridges join the same loop,
+// so no threads are needed.
 
 #include <algorithm>
 #include <cerrno>
@@ -27,6 +33,7 @@
 #include <fcntl.h>
 #include <grp.h>
 #include <poll.h>
+#include <pty.h>
 #include <pwd.h>
 #include <signal.h>
 #include <string>
@@ -219,6 +226,118 @@ static bool read_proc_stats(pid_t pid, long long &utime, long long &stime,
 struct Waiter { int fd; };
 struct PendingKill { bool armed = false; long long deadline_ns = 0; };
 
+// One interactive exec session: the control connection becomes a raw
+// bridge between the peer and the exec'd child's pty/socketpair.
+// Both fds are NONBLOCKING with bounded in-flight buffers: a stalled
+// consumer must never block the single poll loop (which also reaps the
+// task and enforces stop-grace kills).
+struct ExecSession {
+  int conn = -1;   // unix-socket connection (raw bytes after "ok")
+  int io = -1;     // pty master or socketpair end
+  pid_t pid = -1;
+  bool child_exited = false;
+  bool io_eof = false;        // child side closed; flush to_conn then end
+  std::string to_conn;        // child output awaiting the peer
+  std::string to_io;          // peer input awaiting the child
+};
+
+static const size_t EXEC_BUF_CAP = 1 << 20;
+
+static void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  if (fl >= 0) fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+// Write as much of buf as the fd accepts; false on hard error.
+static bool drain_into(int fd, std::string &buf) {
+  while (!buf.empty()) {
+    ssize_t w = write(fd, buf.data(), buf.size());
+    if (w > 0) {
+      buf.erase(0, (size_t)w);
+      continue;
+    }
+    if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return true;
+    return false;
+  }
+  return true;
+}
+
+// Split an exec command line into backslash-unescaped fields.
+static std::vector<std::string> split_fields(const std::string &line) {
+  std::vector<std::string> out;
+  std::string cur;
+  for (size_t i = 0; i < line.size(); i++) {
+    if (line[i] == '\t') {
+      out.push_back(unescape(cur));
+      cur.clear();
+    } else {
+      cur.push_back(line[i]);
+    }
+  }
+  out.push_back(unescape(cur));
+  return out;
+}
+
+// Spawn an exec child sharing the task's cgroup + credentials.
+// Returns pid, with *io set to the parent's end (pty master or
+// socketpair); -1 on failure.
+static pid_t spawn_exec(const Spec &s, const std::vector<std::string> &argv_s,
+                        bool tty, int *io) {
+  int master = -1, sv[2] = {-1, -1};
+  pid_t pid;
+  if (tty) {
+    pid = forkpty(&master, nullptr, nullptr, nullptr);
+  } else {
+    if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) return -1;
+    pid = fork();
+  }
+  if (pid < 0) return -1;
+  if (pid == 0) {
+    // exec child: same containment as the task (cgroup, cwd, user)
+    if (!tty) {
+      setsid();
+      dup2(sv[1], 0);
+      dup2(sv[1], 1);
+      dup2(sv[1], 2);
+      close(sv[0]);
+      close(sv[1]);
+    }
+    if (!s.cgroup.empty()) {
+      std::string procs = s.cgroup + "/cgroup.procs";
+      int fd = open(procs.c_str(), O_WRONLY);
+      if (fd >= 0) {
+        ssize_t r = write(fd, "0", 1);
+        (void)r;
+        close(fd);
+      }
+    }
+    if (!s.cwd.empty() && chdir(s.cwd.c_str()) != 0) _exit(126);
+    if (!s.user.empty() && getuid() == 0) {
+      struct passwd *pw = getpwnam(s.user.c_str());
+      if (pw) {
+        if (initgroups(pw->pw_name, pw->pw_gid) != 0 ||
+            setgid(pw->pw_gid) != 0 || setuid(pw->pw_uid) != 0)
+          _exit(126);
+      }
+    }
+    std::vector<char *> argv;
+    for (auto &a : argv_s) argv.push_back(const_cast<char *>(a.c_str()));
+    argv.push_back(nullptr);
+    std::vector<char *> envp;
+    for (auto &e : s.env) envp.push_back(const_cast<char *>(e.c_str()));
+    envp.push_back(nullptr);
+    execvpe(argv_s[0].c_str(), argv.data(), envp.data());
+    _exit(127);
+  }
+  if (tty) {
+    *io = master;
+  } else {
+    close(sv[1]);
+    *io = sv[0];
+  }
+  return pid;
+}
+
 static void reply(int fd, const std::string &line) {
   std::string out = line + "\n";
   ssize_t r = write(fd, out.c_str(), out.size());
@@ -289,15 +408,23 @@ int main(int argc, char **argv) {
   std::vector<struct pollfd> fds;
   std::vector<Waiter> waiters;
   std::vector<int> clients;
+  std::vector<ExecSession> execs;
   PendingKill pending;
   bool shutdown_req = false;
 
+  auto close_exec = [&](ExecSession &es) {
+    if (es.io >= 0) close(es.io);
+    if (es.conn >= 0) close(es.conn);
+    if (es.pid > 0 && !es.child_exited) kill(es.pid, SIGKILL);
+    es.io = es.conn = -1;
+  };
+
   while (true) {
-    // reap
-    if (!task.exited) {
-      int st;
-      pid_t r = waitpid(task.pid, &st, WNOHANG);
-      if (r == task.pid) {
+    // reap the task and any exec children
+    int st;
+    pid_t r;
+    while ((r = waitpid(-1, &st, WNOHANG)) > 0) {
+      if (r == task.pid && !task.exited) {
         task.exited = true;
         task.end_ns = now_ns();
         if (WIFEXITED(st)) task.exit_code = WEXITSTATUS(st);
@@ -307,6 +434,10 @@ int main(int argc, char **argv) {
         }
         for (auto &w : waiters) { reply(w.fd, status_line(task)); }
         waiters.clear();
+      } else {
+        for (auto &es : execs) {
+          if (es.pid == r) es.child_exited = true;
+        }
       }
     }
     if (pending.armed && !task.exited && now_ns() >= pending.deadline_ns) {
@@ -315,9 +446,25 @@ int main(int argc, char **argv) {
     }
     if (shutdown_req && task.exited && waiters.empty()) break;
 
+    // drop finished exec sessions
+    execs.erase(
+        std::remove_if(execs.begin(), execs.end(),
+                       [](const ExecSession &e) { return e.conn < 0; }),
+        execs.end());
+
     fds.clear();
     fds.push_back({lfd, POLLIN, 0});
     for (int cfd : clients) fds.push_back({cfd, POLLIN, 0});
+    size_t exec_base = fds.size();
+    for (auto &es : execs) {
+      short conn_ev = 0, io_ev = 0;
+      if (es.to_io.size() < EXEC_BUF_CAP) conn_ev |= POLLIN;
+      if (!es.to_conn.empty()) conn_ev |= POLLOUT;
+      if (!es.io_eof && es.to_conn.size() < EXEC_BUF_CAP) io_ev |= POLLIN;
+      if (!es.to_io.empty()) io_ev |= POLLOUT;
+      fds.push_back({es.conn, conn_ev, 0});
+      fds.push_back({es.io, io_ev, 0});
+    }
     int rc = poll(fds.data(), fds.size(), 200);
     if (rc < 0 && errno != EINTR) break;
     if (rc <= 0) continue;
@@ -325,10 +472,37 @@ int main(int argc, char **argv) {
       int cfd = accept(lfd, nullptr, nullptr);
       if (cfd >= 0) clients.push_back(cfd);
     }
-    for (size_t i = 1; i < fds.size(); i++) {
+    // exec bridges: peer <-> child via bounded nonblocking buffers
+    for (size_t e = 0; e < execs.size(); e++) {
+      ExecSession &es = execs[e];
+      struct pollfd &pc = fds[exec_base + 2 * e];
+      struct pollfd &pio = fds[exec_base + 2 * e + 1];
+      char bb[4096];
+      bool dead = false;
+      if (pc.revents & POLLIN) {
+        ssize_t n = read(es.conn, bb, sizeof bb);
+        if (n > 0) es.to_io.append(bb, (size_t)n);
+        else if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK))
+          dead = true;  // peer hung up
+      } else if (pc.revents & (POLLHUP | POLLERR)) {
+        dead = true;
+      }
+      if (!dead && !es.io_eof && (pio.revents & (POLLIN | POLLHUP | POLLERR))) {
+        ssize_t n = read(es.io, bb, sizeof bb);
+        if (n > 0) es.to_conn.append(bb, (size_t)n);
+        else if (n == 0 || (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK))
+          es.io_eof = true;  // child closed (pty: EIO after exit)
+      }
+      if (!dead && !es.to_io.empty() && !es.io_eof)
+        if (!drain_into(es.io, es.to_io)) es.io_eof = true;
+      if (!dead && !es.to_conn.empty())
+        if (!drain_into(es.conn, es.to_conn)) dead = true;
+      if (dead || (es.io_eof && es.to_conn.empty())) close_exec(es);
+    }
+    for (size_t i = 1; i < exec_base; i++) {
       if (!(fds[i].revents & (POLLIN | POLLHUP))) continue;
       int cfd = fds[i].fd;
-      char buf[512];
+      char buf[4096];
       ssize_t n = read(cfd, buf, sizeof buf - 1);
       if (n <= 0) {
         close(cfd);
@@ -385,11 +559,39 @@ int main(int argc, char **argv) {
       } else if (cmd == "shutdown") {
         reply(cfd, "ok");
         shutdown_req = true;
+      } else if (cmd.rfind("exec\t", 0) == 0) {
+        std::vector<std::string> fields = split_fields(cmd.substr(5));
+        if (fields.size() < 2) {
+          reply(cfd, "err exec needs argv");
+        } else {
+          bool tty = fields[0] == "1";
+          std::vector<std::string> argvs(fields.begin() + 1, fields.end());
+          int io = -1;
+          pid_t pid = spawn_exec(spec, argvs, tty, &io);
+          if (pid < 0) {
+            reply(cfd, "err exec spawn failed");
+          } else {
+            char ok[64];
+            snprintf(ok, sizeof ok, "ok pid=%d", pid);
+            reply(cfd, ok);
+            ExecSession es;
+            es.conn = cfd;
+            es.io = io;
+            es.pid = pid;
+            set_nonblock(es.conn);
+            set_nonblock(es.io);
+            execs.push_back(es);
+            // the connection is a raw bridge now, not a command client
+            clients.erase(std::remove(clients.begin(), clients.end(), cfd),
+                          clients.end());
+          }
+        }
       } else {
         reply(cfd, "err unknown command");
       }
     }
   }
+  for (auto &es : execs) close_exec(es);
   unlink(spec.socket_path.c_str());
   if (!spec.pidfile.empty()) unlink(spec.pidfile.c_str());
   if (!spec.cgroup.empty()) rmdir(spec.cgroup.c_str());

@@ -52,6 +52,79 @@ class _RawTask:
         self.done.set()
 
 
+def _spawn_streaming(cmd: list[str], tty: bool):
+    """Un-contained streaming exec: subprocess over a socketpair (or a
+    pty when tty=True); returns the caller's socket end."""
+    import socket as _socket
+
+    if tty:
+        import pty as _pty
+
+        pid, master = _pty.fork()
+        if pid == 0:
+            try:
+                os.execvp(cmd[0], cmd)
+            finally:
+                os._exit(127)
+        # a pty master is not a socket: bridge it onto a socketpair
+        parent, inner = _socket.socketpair()
+
+        def _pump_out():
+            try:
+                while True:
+                    data = os.read(master, 4096)
+                    if not data:
+                        break
+                    inner.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    inner.shutdown(_socket.SHUT_WR)
+                except OSError:
+                    pass
+                try:
+                    os.waitpid(pid, 0)  # reap: no zombie per exec
+                except OSError:
+                    pass
+
+        def _pump_in():
+            try:
+                while True:
+                    data = inner.recv(4096)
+                    if not data:
+                        break
+                    os.write(master, data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    os.close(master)
+                except OSError:
+                    pass
+
+        threading.Thread(target=_pump_out, daemon=True).start()
+        threading.Thread(target=_pump_in, daemon=True).start()
+        return parent
+    parent, child = _socket.socketpair()
+    try:
+        proc = subprocess.Popen(
+            cmd,
+            stdin=child,
+            stdout=child,
+            stderr=child,
+            start_new_session=True,
+        )
+    except OSError as e:
+        parent.close()
+        raise DriverError(f"exec spawn: {e}") from e
+    finally:
+        child.close()
+    # reap in the background so exec children never pile up as zombies
+    threading.Thread(target=proc.wait, daemon=True).start()
+    return parent
+
+
 class RawExecDriver(Driver):
     name = "rawexec"
 
@@ -149,6 +222,10 @@ class RawExecDriver(Driver):
             cmd, capture_output=True, timeout=timeout_s
         )
         return out.stdout + out.stderr, out.returncode
+
+    def exec_task_streaming(self, task_id: str, cmd: list[str], tty: bool = False):
+        self._get(task_id)  # validate the task exists
+        return _spawn_streaming(cmd, tty)
 
     def recover_task(self, handle: TaskHandle) -> None:
         pid = handle.state.get("pid")

@@ -361,10 +361,12 @@ class ClusterServer:
         bootstrap_expect: Optional[int] = None,
         rpc_secret: str = "",
         data_dir: Optional[str] = None,
+        acl_enforce: bool = False,
         **raft_kw,
     ) -> None:
         self.node_id = node_id
         self.region = region
+        self.acl_enforce = acl_enforce
         self.rpc = RPCServer(host=host, port=port, secret=rpc_secret)
         self.pool = ConnPool(secret=rpc_secret)
         self.server = Server(
@@ -421,6 +423,10 @@ class ClusterServer:
             ("Operator", OperatorEndpoint(self)),
         ):
             self.rpc.register(name, ep)
+        # Streaming exec splice: API consumer ↔ this server ↔ the
+        # alloc's client agent ↔ driver pty (reference streaming path,
+        # SURVEY §3.5 — 4 process boundaries).
+        self.rpc.register_stream("ClientExec.exec", self._handle_exec_stream)
         # Gossip membership (reference setupSerf): server-role tagged,
         # events drive leader-side raft peer reconciliation.
         self.serf = Membership(
@@ -443,6 +449,103 @@ class ClusterServer:
         self._reconciler.start()
 
     # -- wiring --------------------------------------------------------
+
+    def find_alloc_client(self, alloc_id: str):
+        """Resolve an alloc (exact id or unique prefix) and its client
+        agent's advertised streaming address. Raises LookupError with a
+        human message — the single source of truth for both the HTTP fs
+        handlers and the fabric exec splice."""
+        state = self.server.state
+        alloc = state.alloc_by_id(alloc_id)
+        if alloc is None:
+            matches = [a for a in state.allocs() if a.id.startswith(alloc_id)]
+            if len(matches) > 1:
+                raise LookupError(f"alloc id prefix {alloc_id!r} ambiguous")
+            alloc = matches[0] if matches else None
+        if alloc is None:
+            raise LookupError(f"allocation {alloc_id!r} not found")
+        node = state.node_by_id(alloc.node_id)
+        addr_s = (node.attributes.get("unique.client.rpc", "") if node else "")
+        if not addr_s:
+            raise LookupError(
+                "allocation's node does not advertise a client endpoint"
+            )
+        host, _, port = addr_s.rpartition(":")
+        return alloc, (host, int(port))
+
+    def _handle_exec_stream(self, session, header: dict) -> None:
+        """Splice an exec session through to the alloc's client agent."""
+        down = None
+        try:
+            try:
+                alloc, addr = self.find_alloc_client(header.get("alloc_id", ""))
+            except LookupError as e:
+                session.send({"error": str(e)})
+                return
+            # ACL: exec grants a shell inside the task — when enforcement
+            # is on, require alloc-exec on the alloc's namespace
+            # (reference nomad/client_alloc_endpoint.go exec).
+            if self.acl_enforce:
+                try:
+                    acl = self.server.resolve_token(header.get("token", ""))
+                except PermissionError:
+                    session.send({"error": "ACL token not found"})
+                    return
+                if acl is None:
+                    session.send({"error": "missing ACL token"})
+                    return
+                if not acl.is_management() and not acl.allow_namespace_op(
+                    alloc.namespace, "alloc-exec"
+                ):
+                    session.send(
+                        {"error": "missing 'alloc-exec' capability"}
+                    )
+                    return
+            hdr = dict(header)
+            hdr.pop("token", None)
+            hdr["alloc_id"] = alloc.id
+            try:
+                down = self.pool.stream(addr, "Exec.exec", hdr)
+            except (ConnectionError, OSError) as e:
+                session.send({"error": f"client agent unreachable: {e}"})
+                return
+
+            done = threading.Event()
+
+            def pump_down_to_up() -> None:
+                try:
+                    while True:
+                        msg = down.recv(timeout_s=None)
+                        session.send(msg)
+                        if msg.get("eof") or msg.get("error"):
+                            break
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    done.set()
+
+            t = threading.Thread(target=pump_down_to_up, daemon=True)
+            t.start()
+            while not done.is_set():
+                try:
+                    msg = session.recv(timeout_s=0.5)
+                except TimeoutError:
+                    continue
+                except (ConnectionError, OSError):
+                    break
+                try:
+                    down.send(msg)
+                except (ConnectionError, OSError):
+                    break
+                if msg.get("eof"):
+                    break
+            done.wait(timeout=5)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if down is not None:
+                down.close()
+            session.close()
 
     def _raft_apply(self, msg_type: str, payload) -> int:
         return self.raft.apply(msg_type, payload)
