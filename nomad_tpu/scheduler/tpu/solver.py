@@ -94,10 +94,16 @@ class BatchSolver:
         # (mirrors the eval broker's priority dequeue).
         asks = sorted(asks, key=lambda a: -a.job.priority)
 
-        # One node universe per batch. Union of the jobs' datacenters.
+        # One node universe per batch. Union of the jobs' datacenters,
+        # scanning the node table once per DISTINCT dc set, not per ask.
         all_nodes = {}
+        dc_cache: dict[tuple, list] = {}
         for ask in asks:
-            nodes, _ = ready_nodes_in_dcs(self.state, ask.job.datacenters)
+            key = tuple(ask.job.datacenters)
+            nodes = dc_cache.get(key)
+            if nodes is None:
+                nodes, _ = ready_nodes_in_dcs(self.state, ask.job.datacenters)
+                dc_cache[key] = nodes
             for node in nodes:
                 all_nodes[node.id] = node
         nodes = list(all_nodes.values())
@@ -144,7 +150,12 @@ class BatchSolver:
         out.groups = len(groups)
 
         n = table.n
-        self._free = table.cap - table.used  # exact-repair ledger, per solve
+        # Exact-repair ledger as plain Python ints: it is touched once
+        # per PLACED INSTANCE (100k+ per c2m batch) where small-array
+        # numpy ops cost ~10x an int compare.
+        self._free = [
+            [int(c) for c in row] for row in (table.cap - table.used)
+        ]
         self._victimized: set[str] = set()
         used = np.clip(table.used, 0, 2**31 - 1).astype(np.int32)
         t0 = now_ns()
@@ -265,11 +276,17 @@ class BatchSolver:
                 cap, used, prefix, asks_arr, counts, feas, bias, ucap,
                 tier_limit,
             )
-            return np.asarray(assign), np.asarray(assign_evict), used_out
+            # slice on-device before the host transfer: the pad region
+            # is zeros and the tunnel to the chip is the slow link
+            return (
+                np.asarray(assign[:g, :n]),
+                np.asarray(assign_evict[:g, :n]),
+                used_out,
+            )
         assign, used_out = self.solve_fn(
             cap, used, asks_arr, counts, feas, bias, ucap
         )
-        return np.asarray(assign), None, used_out
+        return np.asarray(assign[:g, :n]), None, used_out
 
     # ------------------------------------------------------------------
 
@@ -362,6 +379,7 @@ class BatchSolver:
             placements = out.placements.setdefault(eval_id, [])
             req_iter = iter(grp.requests)
             unplaced: list = []
+            a0, a1, a2 = (int(grp.ask[0]), int(grp.ask[1]), int(grp.ask[2]))
             node_indices = np.nonzero(assign[gi, :n])[0]
             for ni in node_indices:
                 node = table.nodes[ni]
@@ -369,12 +387,13 @@ class BatchSolver:
                 evict_budget = (
                     int(assign_evict[gi, ni]) if assign_evict is not None else 0
                 )
+                row = free[ni]
                 for _ in range(take):
                     req = next(req_iter, None)
                     if req is None:
                         break
                     victims: list = []
-                    if np.any(free[ni] < grp.ask):
+                    if row[0] < a0 or row[1] < a1 or row[2] < a2:
                         if evict_budget > 0:
                             victims = self._pick_victims(table, ni, grp) or []
                         if not victims:
@@ -391,9 +410,13 @@ class BatchSolver:
                         for v in victims:
                             self._victimized.add(v.id)
                             r = v.comparable_resources()
-                            free[ni] += (r.cpu, r.memory_mb, r.disk_mb)
+                            row[0] += r.cpu
+                            row[1] += r.memory_mb
+                            row[2] += r.disk_mb
                             pre.append((v, alloc.id))
-                    free[ni] -= grp.ask
+                    row[0] -= a0
+                    row[1] -= a1
+                    row[2] -= a2
                     placements.append(alloc)
             unplaced.extend(req_iter)  # instances the kernel never placed
             if unplaced:
@@ -408,11 +431,10 @@ class BatchSolver:
         from ...structs import Resources
         from ..preemption import PRIORITY_DELTA, basic_resource_distance
 
-        shortage = np.maximum(grp.ask - self._free[ni], 0)
+        row = self._free[ni]
+        shortage = [max(int(grp.ask[i]) - row[i], 0) for i in range(3)]
         need = Resources(
-            cpu=int(shortage[0]),
-            memory_mb=int(shortage[1]),
-            disk_mb=int(shortage[2]),
+            cpu=shortage[0], memory_mb=shortage[1], disk_mb=shortage[2]
         )
         cands = []
         for a in table._allocs_by_node(table.nodes[ni].id):
@@ -435,13 +457,19 @@ class BatchSolver:
                 basic_resource_distance(need, pa[1].comparable_resources()),
             )
         )
-        freed = np.zeros(3, dtype=np.int64)
+        freed = [0, 0, 0]
         picks = []
         for _, a in cands:
             r = a.comparable_resources()
-            freed += (r.cpu, r.memory_mb, r.disk_mb)
+            freed[0] += r.cpu
+            freed[1] += r.memory_mb
+            freed[2] += r.disk_mb
             picks.append(a)
-            if np.all(freed >= shortage):
+            if (
+                freed[0] >= shortage[0]
+                and freed[1] >= shortage[1]
+                and freed[2] >= shortage[2]
+            ):
                 return picks
         return None
 

@@ -28,10 +28,19 @@ def ready_nodes_in_dcs(state, datacenters: list[str]) -> tuple[list[Node], dict[
     """
     out: list[Node] = []
     dc_counts: dict[str, int] = {}
+    # Glob-match once per DISTINCT datacenter, not once per node — a
+    # 10k-node cluster has a handful of DCs but this is on the hot path.
+    dc_ok: dict[str, bool] = {}
     for node in state.nodes():
         if not node.ready():
             continue
-        if not any(fnmatch.fnmatchcase(node.datacenter, dc) for dc in datacenters):
+        ok = dc_ok.get(node.datacenter)
+        if ok is None:
+            ok = any(
+                fnmatch.fnmatchcase(node.datacenter, dc) for dc in datacenters
+            )
+            dc_ok[node.datacenter] = ok
+        if not ok:
             continue
         out.append(node)
         dc_counts[node.datacenter] = dc_counts.get(node.datacenter, 0) + 1
