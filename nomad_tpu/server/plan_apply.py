@@ -126,6 +126,15 @@ class PlanApplier:
         if result.is_no_op():
             return result
         result.preemption_evals = self._preemption_evals(result)
+        # Normalize before the log encodes the payload: embedded Job copies
+        # would serialize once PER ALLOCATION (a c2m-scale plan would pack
+        # ~100k Jobs). The job is derivable — the FSM's state store
+        # rehydrates alloc.job from the jobs table on apply, exactly as it
+        # already does for stops/preemptions (reference: structs.go
+        # Plan.NormalizeAllocations, applied at RPC boundaries).
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                a.job = None
         index = self.raft_apply("apply_plan_results", result)
         result.alloc_index = index
         return result

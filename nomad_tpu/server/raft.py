@@ -229,12 +229,20 @@ class InmemLog:
             return self._index
 
     def apply(self, msg_type: str, payload) -> int:
-        """Append + apply. Returns the entry's index."""
+        """Append + apply. Returns the entry's index.
+
+        The log keeps an encoded copy and the FSM applies a fresh decode,
+        matching the replicated log's contract: applied structs belong to
+        the state store outright (it stamps them in place), so the log must
+        never alias them."""
+        from .. import codec
+
+        raw = codec.pack(payload)
         with self._lock:
             self._index += 1
             index = self._index
-            self._entries.append((index, msg_type, payload))
-        self.fsm.apply(index, msg_type, payload)
+            self._entries.append((index, msg_type, raw))
+        self.fsm.apply(index, msg_type, codec.unpack(raw))
         return index
 
     def entries_since(self, index: int) -> list[tuple[int, str, object]]:

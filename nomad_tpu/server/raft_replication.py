@@ -30,6 +30,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from .. import codec
 from ..rpc import ConnPool
 from .raft import FSM
 
@@ -48,10 +49,17 @@ class NotLeaderError(Exception):
 
 @dataclass
 class LogEntry:
+    """payload is the msgpack-ENCODED command, packed once on the leader at
+    append time. Storing bytes (not live objects) means (a) the FSM decodes
+    a fresh object graph per apply, so the state store can take ownership of
+    applied structs without aliasing the log, (b) replication sends the same
+    bytes to every follower instead of re-packing per peer per send, and
+    (c) the durable store writes them verbatim."""
+
     index: int
     term: int
     msg_type: str
-    payload: object
+    payload: bytes
 
 
 class RaftEndpoint:
@@ -251,12 +259,16 @@ class RaftNode:
     def apply(self, msg_type: str, payload, timeout_s: float = 10.0):
         """Append on the leader, replicate, block until committed AND
         applied locally. Returns the entry index."""
+        # Encode OUTSIDE the lock: packing a large plan payload under
+        # _lock would stall the replication loops' heartbeats and get the
+        # leader deposed. The bytes depend only on the payload.
+        raw = codec.pack(payload)
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_addr())
             index = self._last_log_index() + 1
             term = self.current_term
-            entry = LogEntry(index, term, msg_type, payload)
+            entry = LogEntry(index, term, msg_type, raw)
             self._log.append(entry)
             if self.store is not None:
                 self.store.append([entry])
@@ -446,7 +458,8 @@ class RaftNode:
         # entries (§5.4.2), so without this a fresh leader would sit on
         # fully-replicated prior-term entries until the next real write.
         barrier = LogEntry(
-            self._last_log_index() + 1, self.current_term, "noop", None
+            self._last_log_index() + 1, self.current_term, "noop",
+            codec.pack(None),
         )
         self._log.append(barrier)
         if self.store is not None:
@@ -632,13 +645,19 @@ class RaftNode:
                     # Raft-level config change: needs _lock, not the FSM
                     # mutex (taking _lock under _fsm_mutex would deadlock
                     # against InstallSnapshot's _lock → _fsm_mutex order).
-                    self._apply_peer_change(e.msg_type, e.payload, epoch)
+                    self._apply_peer_change(
+                        e.msg_type, codec.unpack(e.payload), epoch
+                    )
                     continue
                 with self._fsm_mutex:
                     if self._restore_epoch != epoch:
                         break
                     try:
-                        self.fsm.apply(e.index, e.msg_type, e.payload)
+                        # Decode fresh per apply: the FSM (and through it the
+                        # state store) owns the decoded structs outright.
+                        self.fsm.apply(
+                            e.index, e.msg_type, codec.unpack(e.payload)
+                        )
                     except Exception:
                         logger.exception(
                             "%s: FSM apply failed at %d", self.node_id, e.index

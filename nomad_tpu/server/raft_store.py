@@ -29,7 +29,6 @@ import sqlite3
 import threading
 from typing import Optional
 
-from .. import codec
 from .raft_replication import LogEntry
 
 
@@ -112,10 +111,9 @@ class RaftLogStore:
             self._db.executemany(
                 "INSERT OR REPLACE INTO log(idx, term, msg_type, payload) "
                 "VALUES (?, ?, ?, ?)",
-                [
-                    (e.index, e.term, e.msg_type, codec.pack(e.payload))
-                    for e in entries
-                ],
+                # e.payload is already the packed command bytes
+                # (LogEntry contract) — written verbatim.
+                [(e.index, e.term, e.msg_type, e.payload) for e in entries],
             )
             self._db.commit()
 
@@ -158,7 +156,7 @@ class RaftLogStore:
                 "SELECT idx, term, msg_type, payload FROM log ORDER BY idx"
             ).fetchall()
         return [
-            LogEntry(idx, term, msg_type, codec.unpack(payload))
+            LogEntry(idx, term, msg_type, payload)
             for idx, term, msg_type, payload in rows
         ]
 

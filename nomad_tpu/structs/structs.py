@@ -123,6 +123,16 @@ def generate_uuid() -> str:
     return f"{b[:8]}-{b[8:12]}-{b[12:16]}-{b[16:20]}-{b[20:]}"
 
 
+def generate_uuids(k: int) -> list[str]:
+    """Bulk uuid4-shaped ids: one urandom syscall + one hex pass for the
+    whole batch (the batched solver mints 100k+ allocation ids per solve)."""
+    h = os.urandom(16 * k).hex()
+    return [
+        f"{b[:8]}-{b[8:12]}-{b[12:16]}-{b[16:20]}-{b[20:]}"
+        for b in (h[i : i + 32] for i in range(0, 32 * k, 32))
+    ]
+
+
 def now_ns() -> int:
     return time.time_ns()
 

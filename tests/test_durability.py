@@ -29,12 +29,14 @@ def wait_until(fn, timeout_s=20.0, interval=0.05):
 
 class TestRaftLogStore:
     def test_log_roundtrip(self, tmp_path):
+        from nomad_tpu import codec
+
         store = RaftLogStore(str(tmp_path / "raft.db"))
         job = mock.job()
         store.append(
             [
-                LogEntry(1, 1, "noop", None),
-                LogEntry(2, 1, "job_register", (job, None)),
+                LogEntry(1, 1, "noop", codec.pack(None)),
+                LogEntry(2, 1, "job_register", codec.pack((job, None))),
             ]
         )
         store.close()
@@ -42,7 +44,7 @@ class TestRaftLogStore:
         store2 = RaftLogStore(str(tmp_path / "raft.db"))
         log = store2.load_log()
         assert [e.index for e in log] == [1, 2]
-        assert log[1].payload[0].id == job.id
+        assert codec.unpack(log[1].payload)[0].id == job.id
         store2.close()
 
     def test_stable_state(self, tmp_path):
