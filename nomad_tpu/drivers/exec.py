@@ -222,6 +222,11 @@ class ExecDriver(Driver):
             while _time.monotonic() < deadline:
                 try:
                     chunk = sock.recv(65536)
+                except TimeoutError:
+                    # socket.timeout: the deadline elapsed mid-recv. Must
+                    # stay timed_out=True — it is an OSError subclass, and
+                    # catching it below misreported timeouts as exit -1.
+                    break
                 except OSError:
                     timed_out = False
                     break

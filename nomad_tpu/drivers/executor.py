@@ -134,9 +134,13 @@ class ExecutorHandle:
             fields = ["exec", "1" if tty else "0"] + list(args)
             line = "\t".join(_esc(f) for f in fields)
             conn.sendall(line.encode() + b"\n")
+            # Consume EXACTLY the first line: raw bridge bytes follow the
+            # "ok" handshake immediately, and a fast-exiting child's output
+            # (and exit trailer) can share the wire with it — recv'ing in
+            # chunks "until the buffer ends with newline" swallowed them.
             buf = b""
             while not buf.endswith(b"\n"):
-                chunk = conn.recv(256)
+                chunk = conn.recv(1)
                 if not chunk:
                     raise ExecutorError("executor connection closed")
                 buf += chunk
