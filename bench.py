@@ -1,19 +1,25 @@
-"""C2M-style scheduler benchmark (BASELINE.md configs).
+"""C2M-style scheduler benchmark — all five BASELINE.md configs.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "evals/sec", "vs_baseline": N}
+Prints ONE JSON line whose headline is the c2m config:
+  {"metric": "c2m_scheduler_throughput", "value": N, "unit": "evals/sec",
+   "vs_baseline": N, "configs": {...per-config results...}, "caveats": [...]}
 
 vs_baseline = TPU-batch evals/sec ÷ host-oracle evals/sec on the same
 cluster/job shapes. The host oracle is this repo's faithful reimplementation
-of the reference's per-eval iterator scheduler (scheduler/generic_sched.go)
-— the Go binary itself is not runnable here, so the oracle stands in as the
-baseline denominator; BASELINE.md's target is ≥20x at ≤1% worse packing
-density (density is asserted and reported on stderr).
+of the reference's per-eval iterator scheduler (scheduler/generic_sched.go).
+The Go binary itself is not runnable here, so the oracle stands in as the
+baseline denominator — see the "caveats" field: Go is typically much faster
+than equivalent Python, so these ratios overstate the margin vs the actual
+reference. Density parity (the ≤1% BASELINE criterion) is measured at EQUAL
+placed load: the host sample's jobs are re-solved by the TPU backend on an
+identical fresh cluster and allocs-per-touched-node is compared directly.
 
-Configs (BENCH_CONFIG env):
+Configs (BASELINE.md "configs"; BENCH_CONFIG env selects one, default all):
   smoke   — 10 nodes, 1 job (TestServiceSched_JobRegister analog)
   c1k     — 1k nodes / 5k allocs, cpu+mem only (pure ScoreFit)
-  c2m     — 10k nodes / 100k allocs with constraint+spread load  [default]
+  c2m     — 10k nodes / 100k allocs with constraint+spread load
+  preempt — 90%-full cluster, high-priority wave preempting a low tier
+  drain   — service+system placed, then 10% of nodes drain (re-solve churn)
 """
 
 from __future__ import annotations
@@ -28,7 +34,23 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def build_cluster(n_nodes: int, n_jobs: int, count: int, constrained: bool):
+CAVEATS = [
+    "host oracle is this repo's Python reimplementation of the reference "
+    "GenericScheduler; the Go reference is typically 30-100x faster than "
+    "equivalent Python, so vs_baseline overstates the margin vs Go by "
+    "roughly that factor",
+    "smoke measures single-eval latency, where the TPU device round-trip "
+    "(~0.15s here, through a tunnel) dominates; the TPU backend is a "
+    "batch-throughput design",
+    "drain config: system/sysbatch evals run the host scheduler even under "
+    "the TPU backend (documented fallback); the TPU column covers the "
+    "service evals plus that host-side system work",
+]
+
+
+def build_cluster(n_nodes: int, n_jobs: int, count: int, constrained: bool,
+                  priority: int = 50, job_prefix: str = "bench",
+                  cpu: int = 250, mem: int = 128):
     from nomad_tpu import mock
     from nomad_tpu.structs import Constraint, Spread
     from nomad_tpu.structs.node_class import compute_node_class
@@ -39,19 +61,30 @@ def build_cluster(n_nodes: int, n_jobs: int, count: int, constrained: bool):
     for i in range(n_nodes):
         n = mock.node()
         n.datacenter = dcs[i % len(dcs)]
-        # 16 instances of the bench task per node (cpu-bound)
         n.resources.cpu = 4000
         n.resources.memory_mb = 8192
         n.computed_class = compute_node_class(n)
         h.state.upsert_node(h.next_index(), n)
+    jobs = add_jobs(h, n_jobs, count, constrained, priority, job_prefix,
+                    cpu, mem)
+    return h, jobs
+
+
+def add_jobs(h, n_jobs, count, constrained, priority=50, job_prefix="bench",
+             cpu=250, mem=128):
+    from nomad_tpu import mock
+    from nomad_tpu.structs import Constraint, Spread
+
+    dcs = ["dc1", "dc2", "dc3", "dc4"]
     jobs = []
     for j in range(n_jobs):
-        job = mock.job(id=f"bench-{j}")
+        job = mock.job(id=f"{job_prefix}-{j}")
         job.datacenters = dcs
+        job.priority = priority
         tg = job.task_groups[0]
         tg.count = count
-        tg.tasks[0].resources.cpu = 250
-        tg.tasks[0].resources.memory_mb = 128
+        tg.tasks[0].resources.cpu = cpu
+        tg.tasks[0].resources.memory_mb = mem
         tg.tasks[0].resources.networks = []
         if constrained:
             job.constraints.append(
@@ -60,11 +93,11 @@ def build_cluster(n_nodes: int, n_jobs: int, count: int, constrained: bool):
             job.spreads = [Spread(attribute="${node.datacenter}", weight=50)]
         h.state.upsert_job(h.next_index(), job)
         jobs.append(job)
-    return h, jobs
+    return jobs
 
 
 def density(h, jobs) -> tuple[int, int]:
-    """(total placed, nodes touched)."""
+    """(total live placed, nodes touched)."""
     nodes = set()
     placed = 0
     for job in jobs:
@@ -75,86 +108,264 @@ def density(h, jobs) -> tuple[int, int]:
     return placed, len(nodes)
 
 
-def run_host(n_nodes, n_jobs, count, constrained, sample):
-    from nomad_tpu import mock
-
-    h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
-    sample_jobs = jobs[:sample]
-    t0 = time.perf_counter()
-    for job in sample_jobs:
-        h.process(job.type, mock.eval_for_job(job))
-    dt = time.perf_counter() - t0
-    placed, nodes_used = density(h, sample_jobs)
-    return len(sample_jobs) / dt, placed, nodes_used, dt
-
-
-def run_tpu(n_nodes, n_jobs, count, constrained):
+def tpu_place(h, jobs, config=None, warm=True):
+    """Solve + submit all jobs' evals in one batch; returns (dt, plans)."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.tpu import solve_eval_batch
 
-    h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
     snap = h.snapshot()
-
-    # Warm the jit cache at the exact padded shapes of the measured run —
-    # steady-state scheduling is the metric; compiles amortize across the
-    # server's lifetime.
-    warm_evals = [mock.eval_for_job(job) for job in jobs]
-    solve_eval_batch(snap, h, warm_evals)
-
+    if warm:
+        # Warm the jit cache at the exact padded shapes of the measured
+        # run — steady-state scheduling is the metric; compiles amortize
+        # across the server's lifetime.
+        solve_eval_batch(snap, h, [mock.eval_for_job(j) for j in jobs], config)
     evals = [mock.eval_for_job(job) for job in jobs]
     t0 = time.perf_counter()
-    plans = solve_eval_batch(snap, h, evals)
+    plans = solve_eval_batch(snap, h, evals, config)
     for ev in evals:
         h.submit_plan(plans[ev.id])
     dt = time.perf_counter() - t0
-    placed, nodes_used = density(h, jobs)
-    return len(evals) / dt, placed, nodes_used, dt
+    return dt, plans
 
 
-CONFIGS = {
-    # name: (nodes, jobs, count/job, constrained, host_sample)
+def host_place(h, jobs, config=None, scheduler="service"):
+    from nomad_tpu import mock
+
+    t0 = time.perf_counter()
+    for job in jobs:
+        h.process(scheduler, mock.eval_for_job(job), config)
+    return time.perf_counter() - t0
+
+
+def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample):
+    log(f"[{name}] {n_nodes} nodes, {n_jobs} jobs x {count} allocs")
+    # full-load TPU throughput
+    h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
+    tpu_dt, _ = tpu_place(h, jobs)
+    tpu_rate = len(jobs) / tpu_dt
+    tpu_placed, tpu_nodes = density(h, jobs)
+
+    # host oracle on a sample (to completion)
+    hh, hjobs = build_cluster(n_nodes, host_sample, count, constrained)
+    host_dt = host_place(hh, hjobs)
+    host_rate = len(hjobs) / host_dt
+    host_placed, host_nodes = density(hh, hjobs)
+
+    # density parity at EQUAL placed load: TPU solves the SAME sample-sized
+    # problem on an identical fresh cluster (this is the ≤1% criterion)
+    eh, ejobs = build_cluster(n_nodes, host_sample, count, constrained)
+    tpu_place(eh, ejobs, warm=False)
+    eq_placed, eq_nodes = density(eh, ejobs)
+
+    host_density = host_placed / max(1, host_nodes)
+    eq_density = eq_placed / max(1, eq_nodes)
+    ratio = eq_density / max(host_density, 1e-9)
+    log(
+        f"[{name}] tpu {tpu_rate:.2f} evals/s ({tpu_dt:.2f}s, "
+        f"{tpu_placed} placed); host {host_rate:.2f} evals/s over "
+        f"{host_sample} evals ({host_placed} placed); equal-load density "
+        f"tpu {eq_density:.2f} vs host {host_density:.2f} "
+        f"allocs/node (ratio {ratio:.3f}, pass={ratio >= 0.99})"
+    )
+    return {
+        "tpu_evals_per_s": round(tpu_rate, 2),
+        "host_evals_per_s": round(host_rate, 2),
+        "host_sample_evals": host_sample,
+        "vs_host": round(tpu_rate / host_rate, 2),
+        "tpu_placed": tpu_placed,
+        "host_placed": host_placed,
+        "equal_load_density_tpu": round(eq_density, 3),
+        "equal_load_density_host": round(host_density, 3),
+        "equal_load_density_ratio": round(ratio, 4),
+        "density_within_1pct": ratio >= 0.99,
+    }
+
+
+def run_preempt_config():
+    """BASELINE config 4: oversubscription → preemption across tiers."""
+    from nomad_tpu.scheduler.context import SchedulerConfig
+
+    n_nodes, fill_jobs, fill_count = 500, 25, 180
+    hi_jobs, hi_count = 20, 50
+    log(
+        f"[preempt] {n_nodes} nodes, fill {fill_jobs}x{fill_count} @prio20, "
+        f"wave {hi_jobs}x{hi_count} @prio70"
+    )
+    cfg = SchedulerConfig(preemption_service=True)
+
+    def build():
+        h, fills = build_cluster(
+            n_nodes, fill_jobs, fill_count, False, priority=20,
+            job_prefix="fill", cpu=400, mem=800,
+        )
+        tpu_place(h, fills, warm=False)  # setup, not measured
+        his = add_jobs(h, hi_jobs, hi_count, False, priority=70,
+                       job_prefix="hi", cpu=400, mem=800)
+        return h, fills, his
+
+    # TPU: one batched preemption solve (priority-tier kernel)
+    h, fills, his = build()
+    tpu_dt, plans = tpu_place(h, his, cfg)
+    tpu_rate = len(his) / tpu_dt
+    tpu_placed, _ = density(h, his)
+    tpu_preempted = sum(
+        len(v) for p in plans.values() for v in p.node_preemptions.values()
+    )
+
+    # host oracle: per-eval preemption scoring, all 20 evals
+    hh, _, hhis = build()
+    host_dt = host_place(hh, hhis, cfg)
+    host_rate = len(hhis) / host_dt
+    host_placed, _ = density(hh, hhis)
+    host_preempted = sum(
+        1
+        for p in hh.plans
+        for allocs in p.node_preemptions.values()
+        for _ in allocs
+    )
+    log(
+        f"[preempt] tpu {tpu_rate:.2f} evals/s, placed {tpu_placed}, "
+        f"preempted {tpu_preempted}; host {host_rate:.2f} evals/s, placed "
+        f"{host_placed}, preempted {host_preempted}"
+    )
+    return {
+        "tpu_evals_per_s": round(tpu_rate, 2),
+        "host_evals_per_s": round(host_rate, 2),
+        "host_sample_evals": len(hhis),
+        "vs_host": round(tpu_rate / host_rate, 2),
+        "tpu_placed": tpu_placed,
+        "host_placed": host_placed,
+        "tpu_preempted": tpu_preempted,
+        "host_preempted": host_preempted,
+    }
+
+
+def run_drain_config():
+    """BASELINE config 5: mixed service+system under node-drain churn."""
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.structs import DrainStrategy
+
+    n_nodes, svc_jobs, svc_count, drain_n = 1000, 20, 100, 100
+    log(
+        f"[drain] {n_nodes} nodes, {svc_jobs}x{svc_count} service + 1 system "
+        f"job, drain {drain_n} nodes"
+    )
+
+    def build():
+        h, svcs = build_cluster(n_nodes, svc_jobs, svc_count, False)
+        tpu_place(h, svcs, warm=False)
+        sysjob = mock.system_job(id="bench-sys")
+        sysjob.datacenters = ["dc1", "dc2", "dc3", "dc4"]
+        sysjob.task_groups[0].tasks[0].resources.cpu = 100
+        sysjob.task_groups[0].tasks[0].resources.memory_mb = 64
+        sysjob.task_groups[0].tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), sysjob)
+        h.process("system", mock.eval_for_job(sysjob))
+        return h, svcs, sysjob
+
+    def drain_nodes(h):
+        nodes = h.state.nodes()[:drain_n]
+        for n in nodes:
+            h.state.update_node_drain(
+                h.next_index(), n.id, DrainStrategy(deadline_s=300)
+            )
+        return {n.id for n in nodes}
+
+    def drain_evals(h, svcs, sysjob, drained):
+        from nomad_tpu import mock as m
+
+        evs = []
+        for job in svcs:
+            if any(
+                a.node_id in drained and not a.terminal_status()
+                for a in h.state.allocs_by_job(job.namespace, job.id)
+            ):
+                evs.append(m.eval_for_job(job, triggered_by="node-update"))
+        return evs, m.eval_for_job(sysjob, triggered_by="node-update")
+
+    # TPU path (system eval runs the host SystemScheduler — see caveats)
+    h, svcs, sysjob = build()
+    drained = drain_nodes(h)
+    evs, sysev = drain_evals(h, svcs, sysjob, drained)
+    # warm at post-drain shapes against a throwaway snapshot
+    solve_eval_batch(h.snapshot(), h, [mock.eval_for_job(j) for j in svcs])
+    t0 = time.perf_counter()
+    plans = solve_eval_batch(h.snapshot(), h, evs)
+    for ev in evs:
+        h.submit_plan(plans[ev.id])
+    h.process("system", sysev)
+    tpu_dt = time.perf_counter() - t0
+    n_evals = len(evs) + 1
+    tpu_rate = n_evals / tpu_dt
+    tpu_placed, _ = density(h, svcs)
+
+    # host path: identical cluster, same drain, host scheduler throughout
+    hh, hsvcs, hsysjob = build()
+    hdrained = drain_nodes(hh)
+    hevs, hsysev = drain_evals(hh, hsvcs, hsysjob, hdrained)
+    t0 = time.perf_counter()
+    for ev in hevs:
+        hh.process("service", ev)
+    hh.process("system", hsysev)
+    host_dt = time.perf_counter() - t0
+    host_rate = (len(hevs) + 1) / host_dt
+    host_placed, _ = density(hh, hsvcs)
+    log(
+        f"[drain] {n_evals} drain evals: tpu {tpu_rate:.2f} evals/s "
+        f"({tpu_placed} live), host {host_rate:.2f} evals/s "
+        f"({host_placed} live)"
+    )
+    return {
+        "tpu_evals_per_s": round(tpu_rate, 2),
+        "host_evals_per_s": round(host_rate, 2),
+        "host_sample_evals": len(hevs) + 1,
+        "vs_host": round(tpu_rate / host_rate, 2),
+        "drain_evals": n_evals,
+        "tpu_live_after_drain": tpu_placed,
+        "host_live_after_drain": host_placed,
+    }
+
+
+SERVICE_CONFIGS = {
+    # name: (nodes, jobs, count/job, constrained, host_sample >= 20
+    #        except smoke, which has a single job by definition)
     "smoke": (10, 1, 10, False, 1),
-    "c1k": (1000, 50, 100, False, 10),
-    "c2m": (10000, 100, 1000, True, 5),
+    "c1k": (1000, 50, 100, False, 20),
+    "c2m": (10000, 100, 1000, True, 20),
 }
 
 
 def main():
-    name = os.environ.get("BENCH_CONFIG", "c2m")
-    n_nodes, n_jobs, count, constrained, host_sample = CONFIGS[name]
-    log(f"bench config={name}: {n_nodes} nodes, {n_jobs} jobs x {count} allocs")
+    sel = os.environ.get("BENCH_CONFIG", "all")
+    names = (
+        ["smoke", "c1k", "c2m", "preempt", "drain"] if sel == "all" else [sel]
+    )
+    results = {}
+    for name in names:
+        if name in SERVICE_CONFIGS:
+            n_nodes, n_jobs, count, constrained, sample = SERVICE_CONFIGS[name]
+            results[name] = run_service_config(
+                name, n_nodes, n_jobs, count, constrained, sample
+            )
+        elif name == "preempt":
+            results[name] = run_preempt_config()
+        elif name == "drain":
+            results[name] = run_drain_config()
+        else:
+            raise SystemExit(f"unknown BENCH_CONFIG {name}")
 
-    tpu_rate, tpu_placed, tpu_nodes, tpu_dt = run_tpu(
-        n_nodes, n_jobs, count, constrained
-    )
-    log(
-        f"tpu:  {tpu_rate:.2f} evals/s ({tpu_dt:.2f}s), placed {tpu_placed}, "
-        f"nodes used {tpu_nodes}"
-    )
-
-    host_rate, host_placed, host_nodes, host_dt = run_host(
-        n_nodes, n_jobs, count, constrained, host_sample
-    )
-    log(
-        f"host: {host_rate:.2f} evals/s ({host_dt:.2f}s over {host_sample} evals), "
-        f"placed {host_placed}, nodes used {host_nodes}"
-    )
-
-    # Packing-density parity: allocs per touched node, normalized.
-    tpu_density = tpu_placed / max(1, tpu_nodes)
-    host_density = host_placed / max(1, host_nodes)
-    log(
-        f"density: tpu {tpu_density:.2f} allocs/node vs host {host_density:.2f} "
-        f"(ratio {tpu_density / max(host_density, 1e-9):.3f})"
-    )
-
+    headline = "c2m" if "c2m" in results else names[0]
+    hl = results[headline]
     print(
         json.dumps(
             {
-                "metric": f"{name}_scheduler_throughput",
-                "value": round(tpu_rate, 2),
+                "metric": f"{headline}_scheduler_throughput",
+                "value": hl["tpu_evals_per_s"],
                 "unit": "evals/sec",
-                "vs_baseline": round(tpu_rate / host_rate, 2),
+                "vs_baseline": hl["vs_host"],
+                "configs": results,
+                "caveats": CAVEATS,
             }
         )
     )

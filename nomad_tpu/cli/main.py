@@ -1072,6 +1072,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 127
     try:
         return fn(args)
+    except BrokenPipeError:
+        # stdout consumer (a pager, `head`) closed early — exit quietly
+        # like standard unix tools; suppress the interpreter's flush error.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
     except APIError as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1

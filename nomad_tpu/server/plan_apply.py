@@ -128,13 +128,19 @@ class PlanApplier:
         result.preemption_evals = self._preemption_evals(result)
         # Normalize before the log encodes the payload: embedded Job copies
         # would serialize once PER ALLOCATION (a c2m-scale plan would pack
-        # ~100k Jobs). The job is derivable — the FSM's state store
-        # rehydrates alloc.job from the jobs table on apply, exactly as it
-        # already does for stops/preemptions (reference: structs.go
-        # Plan.NormalizeAllocations, applied at RPC boundaries).
-        for allocs in result.node_allocation.values():
-            for a in allocs:
-                a.job = None
+        # ~100k Jobs). The scheduled job version rides ONCE on the result
+        # and the FSM re-attaches it to every alloc that referenced it —
+        # NOT the jobs table's current version, which may have moved while
+        # the plan sat in the queue, and NOT the stored alloc's old
+        # version, which would silently revert in-place updates. Allocs
+        # referencing some OTHER version (e.g. followup-eval annotations
+        # of old allocs) keep their job embedded.
+        result.job = plan.job
+        if result.job is not None:
+            for allocs in result.node_allocation.values():
+                for a in allocs:
+                    if a.job is result.job:
+                        a.job = None
         index = self.raft_apply("apply_plan_results", result)
         result.alloc_index = index
         return result

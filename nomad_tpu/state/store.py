@@ -786,7 +786,11 @@ class StateStore(_ReadMixin):
             self._publish(index, TABLE_ALLOCS, stored, "AllocationUpdated")
 
     def _upsert_allocs_txn(
-        self, index: int, allocs: list[Allocation], owned: bool = False
+        self,
+        index: int,
+        allocs: list[Allocation],
+        owned: bool = False,
+        default_job: Optional[Job] = None,
     ) -> list[Allocation]:
         """owned=True transfers ownership of the alloc objects to the store:
         no defensive copy is made and index/time fields are stamped in
@@ -830,6 +834,17 @@ class StateStore(_ReadMixin):
             existing = t.get(alloc.id)
             if not owned:
                 alloc = alloc.copy()
+            # Plan payloads are denormalized: allocs scheduled against the
+            # plan's job version carry job=None and re-attach to it here —
+            # BEFORE the existing-alloc fallback, which holds the OLD
+            # version and would revert in-place updates.
+            if (
+                alloc.job is None
+                and default_job is not None
+                and alloc.job_id == default_job.id
+                and alloc.namespace == default_job.namespace
+            ):
+                alloc.job = default_job
             if existing is not None:
                 alloc.create_index = existing.create_index
                 alloc.create_time = existing.create_time
@@ -1038,7 +1053,10 @@ class StateStore(_ReadMixin):
             # append_* methods copy), so the store takes them without the
             # per-alloc defensive copy.
             committed.extend(
-                self._upsert_allocs_txn(index, allocs_to_upsert, owned=True)
+                self._upsert_allocs_txn(
+                    index, allocs_to_upsert, owned=True,
+                    default_job=result.job,
+                )
             )
             if result.preemption_evals:
                 self._upsert_evals_txn(index, result.preemption_evals)

@@ -1736,6 +1736,10 @@ class PlanResult:
     node_update: dict[str, list[Allocation]] = field(default_factory=dict)
     node_allocation: dict[str, list[Allocation]] = field(default_factory=dict)
     node_preemptions: dict[str, list[Allocation]] = field(default_factory=dict)
+    # The job version this plan was scheduled against, carried ONCE: allocs
+    # in node_allocation with job=None re-attach to it on apply (denormalized
+    # payload — see PlanApplier.apply_one).
+    job: Optional[Job] = None
     deployment: Optional["Deployment"] = None
     deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
     # follow-up evals for the jobs whose allocs were preempted, so they

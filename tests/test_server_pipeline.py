@@ -355,3 +355,34 @@ def test_blocked_evals_missed_unblock():
     be.block(mk_eval(5, {"c1": False}))
     assert len(requeued) == 2
     assert be.blocked_count() == 1
+
+
+def test_server_inplace_update_keeps_new_job_version(server):
+    """Plan payloads are denormalized (alloc.job stripped, re-attached on
+    apply): an in-place update must store the NEW job version, not revert
+    to the existing alloc's old one (regression: plan normalization)."""
+    for _ in range(3):
+        server.node_register(mock.node())
+    job = mock.job()
+    job.task_groups[0].count = 3
+    server.job_register(job)
+    assert server.wait_for_evals(10)
+    v0 = server.state.job_by_id(job.namespace, job.id).version
+
+    update = job.copy()
+    update.priority = job.priority + 10  # non-destructive: in-place update
+    server.job_register(update)
+    assert server.wait_for_evals(10)
+    stored_job = server.state.job_by_id(job.namespace, job.id)
+    assert stored_job.version == v0 + 1
+    allocs = [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(allocs) == 3
+    for a in allocs:
+        assert a.job is not None
+        assert a.job.version == stored_job.version, (
+            f"alloc {a.id} reverted to job version {a.job.version}"
+        )
