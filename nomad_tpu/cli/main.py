@@ -1071,7 +1071,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         parser.print_help()
         return 127
     try:
-        return fn(args)
+        ret = fn(args)
+        # Flush inside the try: small outputs sit in the stdio buffer until
+        # interpreter exit, where an EPIPE would bypass this handler.
+        sys.stdout.flush()
+        return ret
     except BrokenPipeError:
         # stdout consumer (a pager, `head`) closed early — exit quietly
         # like standard unix tools; suppress the interpreter's flush error.

@@ -1,4 +1,10 @@
-from .kernels import make_sharded_solver, pad_g, pad_n, solve_placement
+from .kernels import (
+    make_sharded_solver,
+    make_sharded_solver_preempt,
+    pad_g,
+    pad_n,
+    solve_placement,
+)
 from .lower import build_node_table, lower_group
 from .scheduler import TPUBatchScheduler, TPUGenericScheduler, solve_eval_batch
 from .solver import BatchSolver, GroupAsk

@@ -300,6 +300,134 @@ def solve_placement_preempt(
 # ---------------------------------------------------------------------------
 
 
+def _sharded_waterfill(score_loc, units_loc, count, axis, my, n_local):
+    """Replicated waterfill decision from node-sharded score/unit vectors.
+
+    All-gathers the [N/D] local vectors to the full [N] (identical on every
+    device — the decision is deterministic and replicated), fills in score
+    order, and returns this device's slice of the take vector. The gathered
+    vectors are exactly the unsharded kernel's, so placements match the
+    single-chip solver bit for bit.
+    """
+    score = lax.all_gather(score_loc, axis, tiled=True)  # [N]
+    units = lax.all_gather(units_loc, axis, tiled=True)  # [N]
+    order = jnp.argsort(-score)
+    su = units[order]
+    prior = jnp.cumsum(su) - su
+    take_sorted = jnp.clip(count - prior, 0, su)
+    take = jnp.zeros_like(units).at[order].set(take_sorted)
+    return lax.dynamic_slice(take, (my * n_local,), (n_local,))
+
+
+def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
+    """Node-sharded variant of solve_placement_preempt.
+
+    Same contract: (cap, used_exist, prefix_used, asks, counts, feas, bias,
+    units_cap, tier_limit) -> (assign [G,N], assign_evict [G,N], used').
+    The tier prefix tensors are sharded over the node axis alongside
+    cap/used (each device owns its nodes' preemptible-capacity prefixes);
+    per phase, only the [N] score and unit vectors ride ICI. The two-phase
+    math mirrors _place_group_preempt exactly, so single-chip and sharded
+    solves are equivalence-tested against each other
+    (tests/test_tpu_solver.py).
+    """
+    from jax.experimental.shard_map import shard_map
+
+    def sharded_solve(
+        cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap,
+        tier_limit,
+    ):
+        def body(cap_l, usede_l, prefix_l, asks_l, counts_l, feas_l, bias_l,
+                 ucap_l, tl_l):
+            my = lax.axis_index(axis)
+            n_local = cap_l.shape[0]
+
+            def step(carry, xs):
+                used_new, freed = carry
+                ask, count, feas_g, bias_g, ucap, klim = xs
+                avail_exist = usede_l - freed
+                used_total = avail_exist + used_new
+
+                # phase 1: normal placement on remaining real capacity
+                units1 = _units_for(cap_l - used_total, ask, ucap, feas_g, count)
+                score1 = _score_nodes(
+                    cap_l.astype(jnp.float32),
+                    used_total.astype(jnp.float32),
+                    ask.astype(jnp.float32),
+                    bias_g,
+                )
+                score1 = jnp.where(units1 > 0, score1, NEG_INF)
+                take1 = _sharded_waterfill(
+                    score1, units1, count, axis, my, n_local
+                )
+                used_new = used_new + take1[:, None] * ask[None, :]
+                used_total = used_total + take1[:, None] * ask[None, :]
+                # remaining must be the GLOBAL remainder: sum local takes
+                placed1 = lax.psum(jnp.sum(take1), axis)
+                remaining = count - placed1
+
+                # phase 2: retry the remainder on preemptible-tier capacity
+                preemptible = jnp.maximum(
+                    lax.dynamic_index_in_dim(prefix_l, klim, 0, keepdims=False)
+                    - freed,
+                    0,
+                )
+                normal_free = cap_l - used_total
+                units2 = _units_for(
+                    normal_free + preemptible, ask, ucap - take1, feas_g,
+                    remaining,
+                )
+                score2 = _score_nodes(
+                    cap_l.astype(jnp.float32),
+                    jnp.maximum(used_total - preemptible, 0).astype(
+                        jnp.float32
+                    ),
+                    ask.astype(jnp.float32),
+                    bias_g,
+                )
+                score2 = jnp.where(units2 > 0, score2, NEG_INF)
+                take2 = _sharded_waterfill(
+                    score2, units2, remaining, axis, my, n_local
+                )
+
+                overflow = jnp.maximum(
+                    take2[:, None] * ask[None, :]
+                    - jnp.maximum(normal_free, 0),
+                    0,
+                )
+                freed = freed + jnp.minimum(overflow, preemptible)
+                used_new = used_new + take2[:, None] * ask[None, :]
+                return (used_new, freed), (take1 + take2, take2)
+
+            zeros = jnp.zeros_like(cap_l)
+            (used_new, freed), (takes, takes_evict) = lax.scan(
+                step, (zeros, zeros),
+                (asks_l, counts_l, feas_l, bias_l, ucap_l, tl_l),
+            )
+            return takes, takes_evict, usede_l - freed + used_new
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(axis, None),        # cap
+                P(axis, None),        # used_exist
+                P(None, axis, None),  # prefix_used [T+1, N, R]
+                P(),                  # asks
+                P(),                  # counts
+                P(None, axis),        # feas
+                P(None, axis),        # bias
+                P(None, axis),        # units_cap
+                P(),                  # tier_limit
+            ),
+            out_specs=(P(None, axis), P(None, axis), P(axis, None)),
+            check_rep=False,
+        )(cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap,
+          tier_limit)
+
+    return jax.jit(sharded_solve)
+
+
 def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
     """Build a pjit'd solver with the node axis sharded over `mesh`.
 
@@ -321,15 +449,9 @@ def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
 
             def step(used_loc, xs):
                 ask, count, feas_g, bias_g, ucap = xs
-                free = cap_l - used_loc
-                per_res = jnp.where(
-                    ask[None, :] > 0,
-                    free // jnp.maximum(ask[None, :], 1),
-                    jnp.int32(1 << 30),
+                units_loc = _units_for(
+                    cap_l - used_loc, ask, ucap, feas_g, count
                 )
-                units_loc = jnp.clip(jnp.min(per_res, axis=1), 0, ucap)
-                units_loc = jnp.where(feas_g, units_loc, 0)
-                units_loc = jnp.clip(units_loc, 0, count)
                 score_loc = _score_nodes(
                     cap_l.astype(jnp.float32),
                     used_loc.astype(jnp.float32),
@@ -337,16 +459,9 @@ def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
                     bias_g,
                 )
                 score_loc = jnp.where(units_loc > 0, score_loc, NEG_INF)
-                # Gather the full score/unit vectors (small) to decide
-                # placement globally; result identical on every device.
-                score = lax.all_gather(score_loc, axis, tiled=True)  # [N]
-                units = lax.all_gather(units_loc, axis, tiled=True)  # [N]
-                order = jnp.argsort(-score)
-                su = units[order]
-                prior = jnp.cumsum(su) - su
-                take_sorted = jnp.clip(count - prior, 0, su)
-                take = jnp.zeros_like(units).at[order].set(take_sorted)
-                take_loc = lax.dynamic_slice(take, (my * n_local,), (n_local,))
+                take_loc = _sharded_waterfill(
+                    score_loc, units_loc, count, axis, my, n_local
+                )
                 used_loc = used_loc + take_loc[:, None] * ask[None, :]
                 return used_loc, take_loc
 

@@ -45,6 +45,7 @@ class TPUGenericScheduler(GenericScheduler):
 
     scheduler_type = "service"
     solve_fn = None  # overridable: e.g. a mesh-sharded solver
+    solve_preempt_fn = None  # its preemption variant (sharded: make_sharded_solver_preempt)
 
     def _compute_job_allocs(self, job) -> bool:
         eval_obj = self.eval
@@ -113,7 +114,10 @@ class TPUGenericScheduler(GenericScheduler):
         by_group: dict[str, list] = {}
         for req in place_requests:
             by_group.setdefault(req.task_group.name, []).append(req)
-        solver = BatchSolver(self.state, self.config, solve_fn=self.solve_fn)
+        solver = BatchSolver(
+            self.state, self.config, solve_fn=self.solve_fn,
+            solve_preempt_fn=self.solve_preempt_fn,
+        )
         asks = [
             GroupAsk(eval_obj, job, tg_name, reqs, plan=self.plan)
             for tg_name, reqs in by_group.items()
@@ -159,6 +163,7 @@ def solve_eval_batch(
     evals: list[Evaluation],
     config: Optional[SchedulerConfig] = None,
     solve_fn=None,
+    solve_preempt_fn=None,
 ) -> dict[str, Plan]:
     """High-throughput path: reconcile every pending eval, solve ALL their
     placements in one kernel invocation, and emit one plan per eval.
@@ -221,7 +226,9 @@ def solve_eval_batch(
         for tg_name, reqs in by_group.items():
             asks.append(GroupAsk(ev, job, tg_name, reqs, plan=plan))
 
-    solver = BatchSolver(state, config, solve_fn=solve_fn)
+    solver = BatchSolver(
+        state, config, solve_fn=solve_fn, solve_preempt_fn=solve_preempt_fn
+    )
     outcome = solver.solve(asks)
     for ev in evals:
         plan = plans[ev.id]

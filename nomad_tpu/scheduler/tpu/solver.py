@@ -84,11 +84,21 @@ class BatchSolver:
     """Solves placement for a batch of evaluations against one snapshot."""
 
     def __init__(self, state, config: Optional[SchedulerConfig] = None,
-                 solve_fn=None) -> None:
+                 solve_fn=None, solve_preempt_fn=None) -> None:
         self.state = state
         self.config = config or SchedulerConfig()
         self.ctx = EvalContext(state, None, logger, self.config)
         self.solve_fn = solve_fn or solve_placement
+        # Preemption kernel seam: defaults to the single-chip tier kernel
+        # when the plain kernel is the default; a custom solve_fn (e.g. a
+        # mesh-sharded solver) must bring its own preempt variant
+        # (make_sharded_solver_preempt) or preemption is disabled for it.
+        if solve_preempt_fn is not None:
+            self.solve_preempt_fn = solve_preempt_fn
+        elif solve_fn is None:
+            self.solve_preempt_fn = solve_placement_preempt
+        else:
+            self.solve_preempt_fn = None
         # Port-accounting index per node, shared across the whole batch so
         # placements in this solve see each other's port reservations.
         self._net_cache: dict[str, NetworkIndex] = {}
@@ -164,10 +174,12 @@ class BatchSolver:
         tier_limit = np.zeros(len(groups), dtype=np.int32)
         for i, grp in enumerate(groups):
             tier_limit[i] = self._tier_limit(table, grp)
-        use_preempt = bool(tier_limit.any()) and self.solve_fn is solve_placement
+        use_preempt = (
+            bool(tier_limit.any()) and self.solve_preempt_fn is not None
+        )
         # The compact readback path only exists on the default kernel;
         # custom solve_fns (e.g. the mesh-sharded solver) and the
-        # preemption kernel return the dense [G, N] assignment.
+        # preemption kernels return the dense [G, N] assignment.
         compact = not use_preempt and self.solve_fn is solve_placement
 
         t0 = now_ns()
@@ -420,7 +432,7 @@ class BatchSolver:
                 # padded tail repeats the full sum so any (unused)
                 # out-of-range index still reads a valid prefix
                 prefix[t + 1 :, :n] = cum[-1].astype(np.int32)
-            assign, assign_evict, used_out = solve_placement_preempt(
+            assign, assign_evict, used_out = self.solve_preempt_fn(
                 cap, used, prefix, asks_arr, counts, feas, bias, ucap,
                 tier_limit,
             )

@@ -419,3 +419,126 @@ def test_tpu_batch_preemption_many_nodes():
             a.comparable_resources().cpu for a in keep + new
         )
         assert total_cpu <= node.resources.cpu
+
+
+# ---------------------------------------------------------------------------
+# Sharded-vs-single-chip kernel equivalence (8-device CPU mesh, c1k shapes)
+# ---------------------------------------------------------------------------
+
+
+def _mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets them up)")
+    return Mesh(devs, axis_names=("nodes",))
+
+
+def _c1k_problem(rng, n=1024, g=48, tiers=0):
+    """Random-but-reproducible padded problem at c1k scale. With tiers>0,
+    also builds the cumulative tier-usage prefix + per-group tier limits."""
+    cap = rng.integers(2000, 8000, size=(n, 3)).astype(np.int32)
+    used = (cap * rng.uniform(0.0, 0.5, size=(n, 3))).astype(np.int32)
+    asks = rng.integers(100, 600, size=(g, 3)).astype(np.int32)
+    counts = rng.integers(1, 120, size=g).astype(np.int32)
+    feas = rng.random((g, n)) > 0.15
+    bias = (rng.random((g, n)) * 0.2).astype(np.float32)
+    ucap = np.full((g, n), 1 << 30, dtype=np.int32)
+    if not tiers:
+        return cap, used, asks, counts, feas, bias, ucap
+    # Preempt variant: nearly-full nodes whose usage is mostly low-tier,
+    # so phase 1 starves and phase 2 must eat into preemptible capacity.
+    used = (cap * rng.uniform(0.75, 0.95, size=(n, 3))).astype(np.int32)
+    counts = rng.integers(40, 200, size=g).astype(np.int32)
+    shares = rng.dirichlet(np.ones(tiers), size=n)[:, :, None]  # [n,T,1]
+    tier_usage = (
+        used[:, None, :] * 0.9 * shares
+    ).astype(np.int32).transpose(1, 0, 2)  # [T, n, 3]
+    prefix = np.zeros((tiers + 1, n, 3), dtype=np.int32)
+    prefix[1:] = np.cumsum(tier_usage, axis=0)
+    tier_limit = rng.integers(0, tiers + 1, size=g).astype(np.int32)
+    return cap, used, asks, counts, feas, bias, ucap, prefix, tier_limit
+
+
+def test_sharded_solver_matches_single_chip_c1k():
+    from nomad_tpu.scheduler.tpu.kernels import (
+        make_sharded_solver,
+        solve_placement,
+    )
+
+    rng = np.random.default_rng(7)
+    cap, used, asks, counts, feas, bias, ucap = _c1k_problem(rng)
+    a_ref, u_ref = solve_placement(cap, used, asks, counts, feas, bias, ucap)
+    solver = make_sharded_solver(_mesh8(), axis="nodes")
+    a_sh, u_sh = solver(cap, used, asks, counts, feas, bias, ucap)
+    np.testing.assert_array_equal(np.asarray(a_ref), np.asarray(a_sh))
+    np.testing.assert_array_equal(np.asarray(u_ref), np.asarray(u_sh))
+
+
+def test_sharded_preempt_matches_single_chip_c1k():
+    from nomad_tpu.scheduler.tpu.kernels import (
+        make_sharded_solver_preempt,
+        solve_placement_preempt,
+    )
+
+    rng = np.random.default_rng(11)
+    cap, used, asks, counts, feas, bias, ucap, prefix, tl = _c1k_problem(
+        rng, tiers=3
+    )
+    a_ref, e_ref, u_ref = solve_placement_preempt(
+        cap, used, prefix, asks, counts, feas, bias, ucap, tl
+    )
+    solver = make_sharded_solver_preempt(_mesh8(), axis="nodes")
+    a_sh, e_sh, u_sh = solver(
+        cap, used, prefix, asks, counts, feas, bias, ucap, tl
+    )
+    assert int(np.asarray(e_ref).sum()) > 0, "problem must exercise phase 2"
+    np.testing.assert_array_equal(np.asarray(a_ref), np.asarray(a_sh))
+    np.testing.assert_array_equal(np.asarray(e_ref), np.asarray(e_sh))
+    np.testing.assert_array_equal(np.asarray(u_ref), np.asarray(u_sh))
+
+
+def test_sharded_preempt_end_to_end_solver():
+    """The full BatchSolver path with a sharded preempt kernel: low-prio
+    fill, high-prio wave, preemptions reported on the sharded path too."""
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.scheduler.tpu.kernels import (
+        make_sharded_solver,
+        make_sharded_solver_preempt,
+    )
+
+    mesh = _mesh8()
+    h = Harness()
+    fill_nodes(h, 16)  # default 4000 cpu / 8192 mb per node
+    lo = mock.job(id="lo", priority=10)
+    lo.task_groups[0].count = 64  # 4 per node: fills every node's cpu
+    lo.task_groups[0].tasks[0].resources.cpu = 1000
+    lo.task_groups[0].tasks[0].resources.memory_mb = 256
+    lo.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), lo)
+    plans = solve_eval_batch(
+        h.snapshot(), h, [mock.eval_for_job(lo)],
+        solve_fn=make_sharded_solver(mesh),
+        solve_preempt_fn=make_sharded_solver_preempt(mesh),
+    )
+    h.submit_plan(plans[next(iter(plans))])
+    assert len(live(h, lo)) == 64
+
+    hi = mock.job(id="hi", priority=80)
+    hi.task_groups[0].count = 8
+    hi.task_groups[0].tasks[0].resources.cpu = 1000
+    hi.task_groups[0].tasks[0].resources.memory_mb = 256
+    hi.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), hi)
+    plans = solve_eval_batch(
+        h.snapshot(), h, [mock.eval_for_job(hi)],
+        solve_fn=make_sharded_solver(mesh),
+        solve_preempt_fn=make_sharded_solver_preempt(mesh),
+    )
+    plan = plans[next(iter(plans))]
+    preempted = sum(len(v) for v in plan.node_preemptions.values())
+    h.submit_plan(plan)
+    assert len(live(h, hi)) == 8
+    assert preempted == 8, f"expected 8 preemptions, got {preempted}"
