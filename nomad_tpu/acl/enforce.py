@@ -56,7 +56,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
 
 _NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]
 _NODE_WRITE = [("PUT", re.compile(r"^/v1/node/.*$")), ("POST", re.compile(r"^/v1/node/.*$"))]
-_AGENT_READ = [("GET", re.compile(r"^/v1/agent/.*$"))]
+_AGENT_READ = [
+    ("GET", re.compile(r"^/v1/agent/.*$")),
+    ("GET", re.compile(r"^/v1/metrics$")),
+]
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)
 _OPERATOR_READ = [("GET", re.compile(r"^/v1/operator/.*$"))]

@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
-from .. import codec
+from .. import codec, metrics
 from ..server.server import ConflictError
 from ..state.store import (
     TABLE_ALLOCS,
@@ -64,6 +64,8 @@ class HTTPAgentServer:
         self.cluster = cluster
         self.client = client
         self.acl_resolver = acl_resolver
+        self._relay_lock = threading.Lock()
+        self._relay_active = 0
         self._routes: list[tuple[str, re.Pattern, Callable]] = []
         self._register_routes()
         handler = self._make_handler()
@@ -190,6 +192,16 @@ class HTTPAgentServer:
             ns = q.get("namespace", ["default"])[0]
             return srv.state.job_versions(ns, p["id"])
 
+        def job_plan(p, q, body, tok):
+            job = codec.from_wire(body["Job"])
+            self._ns_guard(tok, job.namespace, "submit-job")
+            if job.id != p["id"]:
+                raise HTTPError(400, "job id does not match URL")
+            return self.cluster.rpc_self(
+                "Job.plan",
+                {"job": job, "diff": bool(body.get("Diff", True))},
+            )
+
         def job_revert(p, q, body, tok):
             ns = body.get("Namespace", "default")
             self._ns_guard(tok, ns, "submit-job")
@@ -228,6 +240,8 @@ class HTTPAgentServer:
         route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
         route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
         route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        route("PUT", "/v1/job/(?P<id>[^/]+)/plan", job_plan)
+        route("POST", "/v1/job/(?P<id>[^/]+)/plan", job_plan)
         route("PUT", "/v1/job/(?P<id>[^/]+)/revert", job_revert)
         route("PUT", "/v1/job/(?P<id>[^/]+)/dispatch", job_dispatch)
         route("POST", "/v1/job/(?P<id>[^/]+)/dispatch", job_dispatch)
@@ -392,6 +406,11 @@ class HTTPAgentServer:
         def status_peers(p, q, body, tok):
             return self.cluster.rpc_self("Status.peers", {})
 
+        def agent_metrics(p, q, body, tok):
+            # reference: /v1/metrics (command/agent/http.go MetricsRequest,
+            # behind agent:read / AgentReadACL)
+            return metrics.snapshot()
+
         def agent_members(p, q, body, tok):
             return [m.to_wire() for m in self.cluster.serf.members()]
 
@@ -527,6 +546,7 @@ class HTTPAgentServer:
 
         route("GET", "/v1/status/leader", status_leader)
         route("GET", "/v1/status/peers", status_peers)
+        route("GET", "/v1/metrics", agent_metrics)
         route("GET", "/v1/agent/members", agent_members)
         route("GET", "/v1/agent/self", agent_self)
         route("GET", "/v1/agent/health", agent_health)
@@ -619,9 +639,32 @@ class HTTPAgentServer:
         header = dict(header)
         header["alloc_id"] = alloc.id
         try:
-            return self.cluster.pool.stream(addr, method, header)
+            session = self.cluster.pool.stream(addr, method, header)
         except (ConnectionError, OSError) as e:
             raise HTTPError(502, f"client agent unreachable: {e}")
+        # Track live relay sessions (telemetry + the /v1/metrics gauge):
+        # wrap close() so every exit path decrements exactly once.
+        with self._relay_lock:
+            self._relay_active += 1
+            metrics.set_gauge(
+                "nomad.http.relay_sessions_active", self._relay_active
+            )
+        metrics.incr("nomad.http.relay_sessions_total")
+        orig_close = session.close
+        closed = [False]
+
+        def tracked_close():
+            with self._relay_lock:
+                if not closed[0]:
+                    closed[0] = True
+                    self._relay_active -= 1
+                    metrics.set_gauge(
+                        "nomad.http.relay_sessions_active", self._relay_active
+                    )
+            orig_close()
+
+        session.close = tracked_close
+        return session
 
     def _client_roundtrip(self, alloc, method: str, header: dict) -> dict:
         session = self._client_session(alloc, method, header)

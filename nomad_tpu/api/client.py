@@ -123,6 +123,14 @@ class Jobs(_Resource):
         """Returns the eval id (reference api/jobs.go Register)."""
         return self.c.put("/v1/jobs", body={"Job": codec.to_wire(job)})
 
+    def plan(self, job, diff: bool = True):
+        """Server-side dry-run: scheduler annotations + structural diff +
+        placement failures, nothing committed (reference api/jobs.go Plan)."""
+        return self.c.put(
+            f"/v1/job/{job.id}/plan",
+            body={"Job": codec.to_wire(job), "Diff": diff},
+        )
+
     def get(self, job_id: str, namespace: Optional[str] = None):
         return self.c.get(
             f"/v1/job/{job_id}",
@@ -399,6 +407,10 @@ class Operator(_Resource):
 class AgentAPI(_Resource):
     def members(self):
         return self.c.get("/v1/agent/members")
+
+    def metrics(self):
+        """Telemetry snapshot (reference api/operator_metrics.go)."""
+        return self.c.get("/v1/metrics")
 
     def self(self):
         return self.c.get("/v1/agent/self")

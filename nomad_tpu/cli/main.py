@@ -225,38 +225,61 @@ def cmd_job_run(args) -> int:
     return 0
 
 
+_DIFF_MARK = {"Added": "+", "Deleted": "-", "Edited": "~", "None": " "}
+
+
+def _render_diff(d, indent=0) -> None:
+    if not d or d.get("Type") == "None":
+        return
+    pad = " " * indent
+    mark = _DIFF_MARK.get(d.get("Type", "Edited"), "~")
+    print(f"{pad}{mark} {d.get('Name', '')}")
+    for f in d.get("Fields") or []:
+        fm = _DIFF_MARK.get(f.get("Type", "Edited"), "~")
+        old, new = f.get("Old", ""), f.get("New", "")
+        if f["Type"] == "Added":
+            print(f'{pad}  {fm} {f["Name"]}: "{new}"')
+        elif f["Type"] == "Deleted":
+            print(f'{pad}  {fm} {f["Name"]}: "{old}"')
+        else:
+            print(f'{pad}  {fm} {f["Name"]}: "{old}" => "{new}"')
+    for o in d.get("Objects") or []:
+        _render_diff(o, indent + 2)
+
+
 def cmd_job_plan(args) -> int:
+    """Server-side dry-run (reference command/job_plan.go): the REAL
+    scheduler runs against a snapshot without committing; the CLI renders
+    its per-group annotations and structural diff. Exit codes match the
+    reference: 0 no changes, 1 changes, 255 error."""
     api = _client(args)
     try:
         job = _load_jobfile(args.jobfile, _parse_vars(args.var))
-        try:
-            existing = api.jobs.get(job.id)
-        except APIError:
-            existing = None
-        if existing is None:
-            print(f'+ Job: "{job.id}" (new)')
-            for tg in job.task_groups:
-                print(f'+   Task Group: "{tg.name}" ({tg.count} create)')
-            return 1
-        changes = 0
-        for tg in job.task_groups:
-            old = next(
-                (g for g in existing.task_groups if g.name == tg.name), None
-            )
-            if old is None:
-                print(f'+   Task Group: "{tg.name}" ({tg.count} create)')
-                changes += 1
-            elif old.count != tg.count:
-                print(
-                    f'~   Task Group: "{tg.name}" '
-                    f"({old.count} -> {tg.count})"
-                )
-                changes += 1
-        for g in existing.task_groups:
-            if not any(t.name == g.name for t in job.task_groups):
-                print(f'-   Task Group: "{g.name}" (destroy)')
-                changes += 1
-        if changes == 0:
+        resp = api.jobs.plan(job)
+        _render_diff(resp.get("Diff"))
+        updates = resp.get("Annotations", {}).get("DesiredTGUpdates", {})
+        for tg, s in sorted(updates.items()):
+            parts = []
+            for key, label in (
+                ("place", "create"),
+                ("destructive", "create/destroy update"),
+                ("in_place", "in-place update"),
+                ("migrate", "migrate"),
+                ("stop", "destroy"),
+                ("canary", "canary"),
+                ("ignore", "ignore"),
+            ):
+                n = s.get(key, 0)
+                if n:
+                    parts.append(f"{n} {label}")
+            if parts:
+                print(f'Task Group: "{tg}" ({", ".join(parts)})')
+        failed = resp.get("FailedTGAllocs") or {}
+        for tg, metric in failed.items():
+            print(f'! Task Group "{tg}": placement would fail')
+        if resp.get("JobModifyIndex") is not None:
+            print(f"Job Modify Index: {resp['JobModifyIndex']}")
+        if not resp.get("Changes"):
             print("No changes. Job is up to date.")
             return 0
         return 1
@@ -814,6 +837,34 @@ def cmd_operator_snapshot_restore(args) -> int:
     return 0
 
 
+def cmd_operator_metrics(args) -> int:
+    """Reference: command/operator_metrics.go — dump agent telemetry."""
+    import json as _json
+
+    api = _client(args)
+    snap = api.agent.metrics()
+    if args.as_json:
+        print(_json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    print(f"Uptime: {snap.get('uptime_seconds', 0):.0f}s")
+    for section in ("counters", "gauges"):
+        vals = snap.get(section) or {}
+        if vals:
+            print(f"\n{section.capitalize()}:")
+            for k in sorted(vals):
+                print(f"  {k} = {vals[k]}")
+    samples = snap.get("samples") or {}
+    if samples:
+        print("\nSamples (count/mean/max):")
+        for k in sorted(samples):
+            s = samples[k]
+            print(
+                f"  {k} = {int(s['count'])} / {s['mean']:.6f} / "
+                f"{s['max']:.6f}"
+            )
+    return 0
+
+
 def cmd_operator_raft_list_peers(args) -> int:
     """Reference: command/operator_raft_list.go."""
     api = _client(args)
@@ -1044,6 +1095,9 @@ def build_parser() -> argparse.ArgumentParser:
     opraftsub = opraft.add_subparsers(dest="subsubcmd")
     oplp = opraftsub.add_parser("list-peers")
     oplp.set_defaults(fn=cmd_operator_raft_list_peers)
+    opmet = opsub.add_parser("metrics")
+    opmet.add_argument("-json", action="store_true", dest="as_json")
+    opmet.set_defaults(fn=cmd_operator_metrics)
 
     st = sub.add_parser("status", help="list jobs")
     st.add_argument("job_id", nargs="?")

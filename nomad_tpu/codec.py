@@ -143,11 +143,16 @@ def from_wire(data: Any) -> Any:
 
 
 def json_default(o):
-    """json.dumps default for wire payloads: bytes ride base64-tagged."""
+    """json.dumps default for wire payloads: bytes ride base64-tagged and
+    registered structs lower through to_wire — handlers may return structs
+    nested anywhere in a plain dict (e.g. Job.Plan's FailedTGAllocs), and
+    on forwarded RPCs the fabric rehydrates them before the HTTP encode."""
     if isinstance(o, bytes):
         import base64
 
         return {_BYTES_KEY: base64.b64encode(o).decode()}
+    if dataclasses.is_dataclass(o) and not isinstance(o, type):
+        return to_wire(o)
     raise TypeError(f"not JSON serializable: {type(o).__name__}")
 
 

@@ -1,0 +1,150 @@
+"""In-process metrics registry + /v1/metrics surface.
+
+Reference: command/agent/command.go:979 setupTelemetry (go-metrics
+InmemSink behind /v1/metrics) and the server gauges published from
+nomad/server.go:444-450 (broker ready/unacked, plan-queue depth) plus the
+per-eval invoke latencies emitted by the workers.
+
+Design: one process-global registry with three primitives —
+
+  * counters   (monotonic; incr)
+  * gauges     (last value; set_gauge, or a registered PROVIDER callback
+                sampled at snapshot time, which is how subsystems that
+                already keep live stats — the eval broker, the plan
+                queue — are surfaced without double bookkeeping)
+  * samples    (observe: count/sum/min/max/last — enough for rates and
+                latencies without a histogram dependency)
+
+Everything is threadsafe and cheap enough for hot paths (a dict update
+under a lock); the snapshot is what the HTTP endpoint serves.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+_START = time.time()
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._samples: dict[str, dict[str, float]] = {}
+        # name -> stack of (handle, fn): multiple instances (in-process
+        # test clusters) may register the same name; the newest wins the
+        # snapshot and unregistering by handle restores the previous one
+        # instead of deleting a survivor's provider.
+        self._providers: dict[str, list[tuple[object, Callable]]] = {}
+
+    # -- write side ----------------------------------------------------
+
+    def incr(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample (e.g. a latency in seconds)."""
+        with self._lock:
+            s = self._samples.get(name)
+            if s is None:
+                self._samples[name] = {
+                    "count": 1, "sum": value, "min": value,
+                    "max": value, "last": value,
+                }
+            else:
+                s["count"] += 1
+                s["sum"] += value
+                s["min"] = min(s["min"], value)
+                s["max"] = max(s["max"], value)
+                s["last"] = value
+
+    def time_ns(self, name: str, ns: int) -> None:
+        self.observe(name, ns / 1e9)
+
+    def register_provider(
+        self, name: str, fn: Callable[[], dict[str, float]]
+    ) -> object:
+        """Sample a subsystem's live stats at snapshot time. The callback
+        returns {suffix: value}; published as gauges under name.suffix.
+        Returns a handle for unregister_provider."""
+        handle = object()
+        with self._lock:
+            self._providers.setdefault(name, []).append((handle, fn))
+        return handle
+
+    def unregister_provider(self, name: str, handle: object = None) -> None:
+        """Remove a provider. With a handle, removes exactly that
+        registration (other instances under the same name survive);
+        without one, removes the newest."""
+        with self._lock:
+            stack = self._providers.get(name)
+            if not stack:
+                return
+            if handle is None:
+                stack.pop()
+            else:
+                self._providers[name] = [
+                    (h, f) for h, f in stack if h is not handle
+                ]
+            if not self._providers[name]:
+                del self._providers[name]
+
+    # -- read side -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            samples = {k: dict(v) for k, v in self._samples.items()}
+            providers = {
+                name: stack[-1][1]
+                for name, stack in self._providers.items()
+                if stack
+            }
+        for name, fn in providers.items():
+            try:
+                for suffix, value in (fn() or {}).items():
+                    gauges[f"{name}.{suffix}"] = value
+            except Exception:
+                gauges[f"{name}.error"] = 1
+        for s in samples.values():
+            s["mean"] = s["sum"] / s["count"] if s["count"] else 0.0
+        return {
+            "uptime_seconds": round(time.time() - _START, 3),
+            "counters": counters,
+            "gauges": gauges,
+            "samples": samples,
+        }
+
+    def reset(self) -> None:
+        """Test helper: forget everything (providers included)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._samples.clear()
+            self._providers.clear()
+
+
+_global = Registry()
+
+
+def registry() -> Registry:
+    return _global
+
+
+# Module-level conveniences: the hot paths call these directly.
+incr = _global.incr
+set_gauge = _global.set_gauge
+observe = _global.observe
+time_ns = _global.time_ns
+register_provider = _global.register_provider
+unregister_provider = _global.unregister_provider
+snapshot = _global.snapshot

@@ -184,6 +184,20 @@ class GenericScheduler:
 
     # ------------------------------------------------------------------
 
+    def _annotate_plan(self, results) -> None:
+        """Plan dry-run annotations (reference scheduler/annotate.go:38):
+        per-group create/destroy/in-place/destructive/migrate counts.
+        Shared by the host and TPU schedulers so their plan output cannot
+        drift."""
+        import dataclasses as _dc
+
+        self.plan.annotations = {
+            "DesiredTGUpdates": {
+                tg: _dc.asdict(s)
+                for tg, s in results.desired_tg_updates.items()
+            }
+        }
+
     def _compute_job_allocs(self, job) -> bool:
         eval_obj = self.eval
         allocs = self.state.allocs_by_job(eval_obj.namespace, eval_obj.job_id)
@@ -208,6 +222,9 @@ class GenericScheduler:
             batch=self.batch,
         )
         results = reconciler.compute()
+
+        if eval_obj.annotate_plan:
+            self._annotate_plan(results)
 
         self.followup_evals = results.followup_evals
         if results.deployment is not None:

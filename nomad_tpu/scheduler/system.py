@@ -96,6 +96,36 @@ class SystemScheduler:
 
         diff = diff_system_allocs(job, nodes, tainted, allocs, terminal_by_node)
 
+        if eval_obj.annotate_plan:
+            # Plan dry-run annotations (reference scheduler/annotate.go) —
+            # computed from the raw diff BEFORE destructive updates are
+            # folded into diff.place below, so they count once.
+            import dataclasses as _dc
+
+            from .reconcile import GroupSummary
+
+            summaries: dict[str, GroupSummary] = {}
+
+            def _s(name: str) -> GroupSummary:
+                return summaries.setdefault(name, GroupSummary())
+
+            for tg, node, _terminal in diff.place:
+                if node is not None:
+                    _s(tg.name).place += 1
+            for alloc, _reason in diff.stop:
+                _s(alloc.task_group).stop += 1
+            for alloc in diff.lost:
+                _s(alloc.task_group).stop += 1
+            for alloc, tg in diff.update:
+                _s(tg.name).destructive += 1
+            for alloc in diff.ignore:
+                _s(alloc.task_group).ignore += 1
+            self.plan.annotations = {
+                "DesiredTGUpdates": {
+                    k: _dc.asdict(v) for k, v in summaries.items()
+                }
+            }
+
         for alloc, reason in diff.stop:
             self.plan.append_stopped_alloc(alloc, reason, "")
         for alloc in diff.lost:

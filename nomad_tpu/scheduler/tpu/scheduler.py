@@ -71,6 +71,8 @@ class TPUGenericScheduler(GenericScheduler):
             batch=self.batch,
         )
         results = reconciler.compute()
+        if eval_obj.annotate_plan:
+            self._annotate_plan(results)
         self.followup_evals = results.followup_evals
         if results.deployment is not None:
             self.plan.deployment = results.deployment

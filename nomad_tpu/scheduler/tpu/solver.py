@@ -253,6 +253,10 @@ class BatchSolver:
             metric.coalesced_failures = len(reqs) - 1
             out.failures.setdefault(eval_id, {})[tg_name] = metric
         out.solve_ns = now_ns() - t0
+        from ... import metrics
+
+        metrics.time_ns("nomad.tpu.solve_seconds", out.solve_ns)
+        metrics.observe("nomad.tpu.solve_groups", out.groups)
         return out
 
     def _tier_limit(self, table, grp: LoweredGroup) -> int:

@@ -154,6 +154,17 @@ class JobEndpoint(_Forwarder):
             ),
         )
 
+    def plan(self, args):
+        # Dry-run: leader-forwarded so the plan sees the freshest state,
+        # but nothing is committed (reference job_endpoint.go:521).
+        return self._forward(
+            "Job.plan",
+            args,
+            lambda a: self.cs.server.job_plan(
+                a["job"], diff=a.get("diff", True)
+            ),
+        )
+
 
 class NodeEndpoint(_Forwarder):
     def register(self, args):

@@ -16,6 +16,7 @@ import logging
 import threading
 from typing import Optional
 
+from .. import metrics
 from ..scheduler.context import SchedulerConfig
 from ..state import StateStore
 from ..state.events import wire_events
@@ -81,6 +82,17 @@ class Server:
 
         self.eval_broker = EvalBroker()
         self.plan_queue = PlanQueue()
+        # Telemetry providers: live subsystem stats sampled at /v1/metrics
+        # snapshot time (reference nomad/server.go:444-450 publishes the
+        # same broker/plan-queue gauges on a timer).
+        self._metric_handles = [
+            ("nomad.broker", metrics.register_provider(
+                "nomad.broker", lambda: dict(self.eval_broker.stats)
+            )),
+            ("nomad.plan_queue", metrics.register_provider(
+                "nomad.plan_queue", lambda: {"depth": self.plan_queue.depth()}
+            )),
+        ]
         self.plan_applier = PlanApplier(self.plan_queue, self.state, self.raft_apply)
         self.blocked_evals = BlockedEvals(self._requeue_unblocked)
         self.heartbeaters = HeartbeatTimers(self._invalidate_heartbeat)
@@ -185,6 +197,8 @@ class Server:
         self.heartbeaters.set_enabled(False)
 
     def shutdown(self) -> None:
+        for name, handle in self._metric_handles:
+            metrics.unregister_provider(name, handle)
         self.revoke_leadership()
         self._unblock_q.put(None)
 
@@ -307,6 +321,14 @@ class Server:
             )
         self.raft_apply("job_register", (job, ev))
         return ev.id if ev else ""
+
+    def job_plan(self, job: Job, diff: bool = True) -> dict:
+        """Dry-run the candidate job: run the real scheduler against a
+        snapshot without committing; return annotations + diff + failures
+        (reference job_endpoint.go:521 + scheduler/annotate.go)."""
+        from .job_plan import plan_job
+
+        return plan_job(self.state, job, diff, self.scheduler_config)
 
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:
         job = self.state.job_by_id(namespace, job_id)

@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
+from .. import metrics
 from ..scheduler import new_scheduler
 from ..scheduler.context import SchedulerConfig
 from ..structs import Evaluation, Plan, PlanResult
@@ -94,15 +96,22 @@ class Worker:
             ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
             if ev is None:
                 continue
+            t0 = time.perf_counter()
             try:
                 self._process(ev)
             except Exception:
                 logger.exception("%s: eval %s failed", self.name, ev.id)
+                metrics.incr("nomad.worker.invoke.failed")
                 try:
                     broker.nack(ev.id, token)
                 except ValueError:
                     pass
                 continue
+            # reference telemetry: nomad.worker.invoke_scheduler.<type>
+            metrics.observe(
+                f"nomad.worker.invoke_seconds.{ev.type}",
+                time.perf_counter() - t0,
+            )
             try:
                 broker.ack(ev.id, token)
             except ValueError:
@@ -203,7 +212,12 @@ class TPUBatchWorker:
             max(ev.snapshot_index for ev in evals),
         )
         snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        t0 = time.perf_counter()
         plans = solve_eval_batch(snapshot, self.planner, evals, self.config)
+        metrics.observe("nomad.tpu.batch_evals", len(evals))
+        metrics.observe(
+            "nomad.tpu.batch_solve_seconds", time.perf_counter() - t0
+        )
         updates: list[Evaluation] = []
         for ev in evals:
             plan = plans[ev.id]

@@ -1,0 +1,113 @@
+"""Telemetry tests: registry primitives + the /v1/metrics surface fed by
+the live server (reference command/agent/command.go:979 setupTelemetry,
+nomad/server.go:444-450 broker/plan-queue gauges)."""
+
+import threading
+
+import pytest
+
+from nomad_tpu import metrics, mock
+from nomad_tpu.metrics import Registry
+
+
+def test_registry_primitives():
+    r = Registry()
+    r.incr("a")
+    r.incr("a", 2)
+    r.set_gauge("g", 7)
+    r.observe("lat", 0.5)
+    r.observe("lat", 1.5)
+    snap = r.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == 7
+    s = snap["samples"]["lat"]
+    assert s["count"] == 2 and s["min"] == 0.5 and s["max"] == 1.5
+    assert s["mean"] == 1.0
+
+
+def test_registry_provider_sampled_at_snapshot():
+    r = Registry()
+    live = {"depth": 0}
+    r.register_provider("q", lambda: dict(live))
+    live["depth"] = 9
+    assert r.snapshot()["gauges"]["q.depth"] == 9
+    r.unregister_provider("q")
+    assert "q.depth" not in r.snapshot()["gauges"]
+
+
+def test_registry_provider_errors_do_not_break_snapshot():
+    r = Registry()
+    r.register_provider("bad", lambda: 1 / 0)
+    snap = r.snapshot()
+    assert snap["gauges"]["bad.error"] == 1
+
+
+def test_registry_threadsafe_observe():
+    r = Registry()
+
+    def hammer():
+        for _ in range(2000):
+            r.observe("x", 1.0)
+            r.incr("c")
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = r.snapshot()
+    assert snap["samples"]["x"]["count"] == 8000
+    assert snap["counters"]["c"] == 8000
+
+
+def test_server_publishes_metrics_end_to_end(tmp_path):
+    """Scheduling work shows up in /v1/metrics: broker gauges, worker
+    invoke latency, and (with the TPU worker) solver timings."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        srv = agent.server.server
+        for _ in range(3):
+            srv.node_register(mock.node())
+        job = mock.job()
+        srv.job_register(job)
+        assert srv.wait_for_evals(10)
+
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        snap = api.agent.metrics()
+        assert snap["uptime_seconds"] >= 0
+        gauges = snap["gauges"]
+        assert "nomad.broker.total_ready" in gauges
+        assert "nomad.plan_queue.depth" in gauges
+        samples = snap["samples"]
+        svc = samples.get("nomad.worker.invoke_seconds.service")
+        assert svc and svc["count"] >= 1
+    finally:
+        agent.shutdown()
+
+
+def test_tpu_solver_records_timings():
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.testing import Harness
+
+    before = metrics.snapshot()["samples"].get(
+        "nomad.tpu.solve_seconds", {"count": 0}
+    )["count"]
+    h = Harness()
+    for _ in range(4):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    plans = solve_eval_batch(h.snapshot(), h, [mock.eval_for_job(job)])
+    h.submit_plan(plans[next(iter(plans))])
+    after = metrics.snapshot()["samples"]["nomad.tpu.solve_seconds"]["count"]
+    assert after == before + 1
