@@ -13,15 +13,17 @@ update_allocs, deregister.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Optional
 
 from ..drivers import BUILTIN_DRIVERS, Driver
+from ..drivers.base import HEALTH_STATE_HEALTHY, HEALTH_STATE_UNDETECTED
 from ..structs import Allocation, Node
 from ..structs.structs import ALLOC_DESIRED_STATUS_RUN, DriverInfo, now_ns
 from .allocrunner import AllocRunner
-from .fingerprint import fingerprint_node
+from .fingerprint import dynamic_attributes, fingerprint_node
 
 logger = logging.getLogger("nomad_tpu.client")
 
@@ -61,8 +63,12 @@ class Client:
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        # Fingerprint against the REAL data dir: the periodic loop
+        # recomputes storage attributes from it, and a mismatched initial
+        # value would force a spurious re-register on the first tick.
         self.node = node or fingerprint_node(
-            datacenter=datacenter, node_class=node_class, data_dir="/tmp"
+            datacenter=datacenter, node_class=node_class, data_dir=data_dir
         )
         # Streaming fs/logs/exec listener; its address is advertised as a
         # node attribute so servers can dial back (client/endpoints.py).
@@ -76,12 +82,7 @@ class Client:
         host, port = self.endpoints.addr
         self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
         self.drivers = drivers or {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
-        for name, driver in self.drivers.items():
-            fp = driver.fingerprint()
-            self.node.attributes.update(fp.attributes)
-            self.node.drivers[name] = DriverInfo(
-                attributes=fp.attributes, detected=True, healthy=True
-            )
+        self._fingerprint_drivers()
         from ..structs.node_class import compute_node_class
 
         self.node.computed_class = compute_node_class(self.node)
@@ -105,6 +106,10 @@ class Client:
         self._registered = threading.Event()
         self._threads: list[threading.Thread] = []
         self.heartbeat_ttl = 10.0
+        # Periodic re-fingerprint cadence (reference fingerprint.go:31
+        # runs each fingerprinter on its own period; one loop suffices
+        # here). Tests shrink it to exercise the update path.
+        self.fingerprint_interval_s = 30.0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -118,6 +123,7 @@ class Client:
             (self._heartbeat_loop, "client-heartbeat"),
             (self._watch_allocs, "client-watch"),
             (self._alloc_sync, "client-allocsync"),
+            (self._fingerprint_loop, "client-fingerprint"),
         ):
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
@@ -139,6 +145,69 @@ class Client:
 
     def wait_registered(self, timeout_s: float = 15.0) -> bool:
         return self._registered.wait(timeout_s)
+
+    def _fingerprint_drivers(self) -> bool:
+        """Run every driver's fingerprint and fold the results into the
+        node. Honors each driver's verdict — an undetected driver (e.g.
+        docker with no daemon) must not advertise as schedulable or the
+        feasibility mask places jobs this node cannot run. Returns True
+        when anything observable changed."""
+        changed = False
+        for name, driver in self.drivers.items():
+            try:
+                fp = driver.fingerprint()
+            except Exception:
+                logger.exception("fingerprint of driver %s failed", name)
+                continue
+            info = DriverInfo(
+                attributes=fp.attributes,
+                detected=fp.health != HEALTH_STATE_UNDETECTED,
+                healthy=fp.health == HEALTH_STATE_HEALTHY,
+                health_description=fp.health_description,
+                update_time_ns=now_ns(),
+            )
+            prev = self.node.drivers.get(name)
+            if (
+                prev is None
+                or prev.detected != info.detected
+                or prev.healthy != info.healthy
+                or prev.attributes != info.attributes
+            ):
+                changed = True
+                self.node.drivers[name] = info
+                # drop attributes a now-undetected driver used to claim
+                if prev is not None:
+                    for k in prev.attributes:
+                        if k not in fp.attributes:
+                            self.node.attributes.pop(k, None)
+            self.node.attributes.update(fp.attributes)
+        return changed
+
+    def _fingerprint_loop(self) -> None:
+        """Periodic re-fingerprint (reference fingerprint.go:31-48 —
+        periodic fingerprinters push node updates): drivers can appear
+        (dockerd started after the agent) or die; dynamic host attributes
+        (free disk) drift. On change, re-register so the schedulers see
+        the new truth."""
+        while not self._shutdown.is_set():
+            self._shutdown.wait(self.fingerprint_interval_s)
+            if self._shutdown.is_set():
+                return
+            changed = self._fingerprint_drivers()
+            dyn = dynamic_attributes(self.data_dir)
+            for k, v in dyn.items():
+                if self.node.attributes.get(k) != v:
+                    self.node.attributes[k] = v
+                    changed = True
+            if not changed or not self._registered.is_set():
+                continue
+            from ..structs.node_class import compute_node_class
+
+            self.node.computed_class = compute_node_class(self.node)
+            try:
+                self.rpc.register(self.node)
+            except Exception:
+                logger.exception("node update after re-fingerprint failed")
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set() and not self._registered.is_set():

@@ -41,6 +41,51 @@ def _cpu_mhz_total() -> int:
     return int(cores * mhz)
 
 
+def _cpu_model() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    return line.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    return ""
+
+
+def _default_ip() -> str:
+    """The host's outbound IP (no packets are sent by a UDP connect)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 9))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+# Dynamic attributes drift at runtime; granularity keeps jitter (a few MB
+# of disk churn) from re-registering the node every fingerprint period.
+_STORAGE_GRANULARITY_MB = 1024
+
+
+def dynamic_attributes(data_dir: str = "/tmp") -> dict[str, str]:
+    """Attributes the periodic re-fingerprint refreshes (reference:
+    client/fingerprint/storage.go is a periodic fingerprinter)."""
+    try:
+        disk = shutil.disk_usage(data_dir)
+        free_mb = (disk.free // (1024 * 1024)) // _STORAGE_GRANULARITY_MB
+        free_mb *= _STORAGE_GRANULARITY_MB
+        total_mb = disk.total // (1024 * 1024)
+    except OSError:
+        return {}
+    return {
+        "unique.storage.bytesfree": str(free_mb * 1024 * 1024),
+        "unique.storage.bytestotal": str(total_mb * 1024 * 1024),
+    }
+
+
 def fingerprint_node(
     node_id: str = "",
     datacenter: str = "dc1",
@@ -59,12 +104,17 @@ def fingerprint_node(
             "kernel.version": platform.release(),
             "arch": platform.machine(),
             "os.name": platform.system().lower(),
+            "os.version": platform.version(),
             "cpu.numcores": str(cores),
             "cpu.totalcompute": str(_cpu_mhz_total()),
+            "cpu.arch": platform.machine(),
+            "cpu.modelname": _cpu_model(),
             "memory.totalbytes": str(_total_memory_mb() * 1024 * 1024),
             "unique.hostname": socket.gethostname(),
             "unique.storage.volume": data_dir,
+            "unique.network.ip-address": _default_ip(),
             "nomad.version": "0.1.0",
+            **dynamic_attributes(data_dir),
         },
         resources=NodeResources(
             cpu=_cpu_mhz_total(),

@@ -7,6 +7,7 @@ from .base import (
     TaskHandle,
     TaskStatus,
 )
+from .docker import DockerDriver
 from .exec import ExecDriver
 from .mock import MockDriver
 from .rawexec import RawExecDriver
@@ -15,6 +16,7 @@ BUILTIN_DRIVERS = {
     "mock": MockDriver,
     "rawexec": RawExecDriver,
     "exec": ExecDriver,
+    "docker": DockerDriver,
 }
 
 

@@ -557,6 +557,16 @@ class StateStore(_ReadMixin):
             node = node.copy()
             if existing is not None:
                 node.create_index = existing.create_index
+                # Server-owned lifecycle state survives client
+                # re-registration (reference state_store.go UpsertNode:
+                # "Retain node events... transfer the drain/eligibility"):
+                # a periodic re-fingerprint must not erase an operator's
+                # drain or flip a ready node back to initializing.
+                node.drain_strategy = existing.drain_strategy
+                node.scheduling_eligibility = existing.scheduling_eligibility
+                if existing.status:
+                    node.status = existing.status
+                    node.status_updated_at = existing.status_updated_at
             else:
                 node.create_index = index
             node.modify_index = index

@@ -1,0 +1,399 @@
+"""A fake Docker Engine API daemon for driver tests.
+
+Serves the subset of the Engine REST API the DockerDriver speaks, on a
+unix socket, with "containers" backed by REAL local processes (the
+container's Cmd runs directly) — so lifecycle, logs, exit codes, signals,
+and exec are all meaningful without dockerd. The real-daemon e2e test runs
+separately when /var/run/docker.sock exists.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import signal as _signal
+import socket
+import socketserver
+import struct
+import subprocess
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler
+from urllib.parse import parse_qs, urlparse
+
+
+def _killpg(proc, sig) -> None:
+    """Signal the container's whole process group (start_new_session
+    gives each 'container' its own) — docker kills every process in the
+    container, and an orphaned grandchild would otherwise hold the log
+    pipe open past the parent's death."""
+    import os
+
+    try:
+        os.killpg(proc.pid, sig)
+    except (ProcessLookupError, PermissionError):
+        try:
+            proc.send_signal(sig)
+        except ProcessLookupError:
+            pass
+
+
+def mux_frame(kind: int, payload: bytes) -> bytes:
+    return bytes([kind, 0, 0, 0]) + struct.pack(">I", len(payload)) + payload
+
+
+class _Container:
+    def __init__(self, name: str, spec: dict) -> None:
+        self.id = uuid.uuid4().hex
+        self.name = name
+        self.spec = spec
+        self.proc: subprocess.Popen | None = None
+        self.exit_code: int | None = None
+        self.oom = False
+        self.removed = False
+
+
+class _Exec:
+    def __init__(self, container: _Container, cmd: list[str], tty: bool):
+        self.id = uuid.uuid4().hex
+        self.container = container
+        self.cmd = cmd
+        self.tty = tty
+        self.exit_code: int | None = None
+        self.running = False
+
+
+class FakeDockerDaemon:
+    def __init__(self, socket_path: str, pull_delay_s: float = 0.0) -> None:
+        self.socket_path = socket_path
+        self.pull_delay_s = pull_delay_s
+        self.images: set[str] = set()
+        self.pull_count: dict[str, int] = {}
+        self.containers: dict[str, _Container] = {}
+        self.execs: dict[str, _Exec] = {}
+        self.lock = threading.Lock()
+        self._server: socketserver.ThreadingUnixStreamServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status: int, obj) -> None:
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw else {}
+
+            def do_GET(self):
+                daemon.handle(self, "GET")
+
+            def do_POST(self):
+                daemon.handle(self, "POST")
+
+            def do_DELETE(self):
+                daemon.handle(self, "DELETE")
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            # BaseHTTPRequestHandler wants a (host, port) client address
+            def get_request(self):
+                request, _ = super().get_request()
+                return request, ("local", 0)
+
+        self._server = Server(self.socket_path, Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="fake-docker"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+        for c in list(self.containers.values()):
+            if c.proc and c.proc.poll() is None:
+                _killpg(c.proc, _signal.SIGKILL)
+
+    # -- request routing ------------------------------------------------
+
+    def handle(self, h, method: str) -> None:
+        u = urlparse(h.path)
+        path = re.sub(r"^/v1\.\d+", "", u.path)
+        q = parse_qs(u.query)
+        try:
+            self._route(h, method, path, q)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # surface as a daemon error
+            try:
+                h._json(500, {"message": str(e)})
+            except Exception:
+                pass
+
+    def _route(self, h, method: str, path: str, q: dict) -> None:
+        if path == "/_ping":
+            h.send_response(200)
+            h.send_header("Content-Length", "2")
+            h.end_headers()
+            h.wfile.write(b"OK")
+            return
+        if path == "/version":
+            h._json(200, {"Version": "fake-24.0"})
+            return
+
+        m = re.match(r"^/images/(.+)/json$", path)
+        if m:
+            ref = m.group(1)
+            if ref in self.images:
+                h._json(200, {"Id": "sha256:" + ref})
+            else:
+                h._json(404, {"message": f"No such image: {ref}"})
+            return
+        if path == "/images/create":
+            image = q.get("fromImage", [""])[0]
+            tag = q.get("tag", ["latest"])[0]
+            ref = f"{image}:{tag}" if ":" not in image.rsplit("/", 1)[-1] else image
+            if self.pull_delay_s:
+                time.sleep(self.pull_delay_s)
+            with self.lock:
+                self.pull_count[ref] = self.pull_count.get(ref, 0) + 1
+                if "missing" in image:
+                    h._json(
+                        200, {"error": f"manifest for {ref} not found"}
+                    )
+                    return
+                self.images.add(ref)
+                # plain ref too, so inspect by either name hits
+                self.images.add(image)
+            h._json(200, {"status": "Pull complete"})
+            return
+
+        if path == "/containers/create" and method == "POST":
+            spec = h._body()
+            name = q.get("name", [uuid.uuid4().hex])[0]
+            c = _Container(name, spec)
+            with self.lock:
+                if any(
+                    x.name == name and not x.removed
+                    for x in self.containers.values()
+                ):
+                    h._json(409, {"message": f"name {name} in use"})
+                    return
+                self.containers[c.id] = c
+            h._json(201, {"Id": c.id})
+            return
+
+        m = re.match(r"^/containers/([^/]+)(/.*)?$", path)
+        if m:
+            c = self._find_container(m.group(1))
+            if c is None:
+                h._json(404, {"message": "No such container"})
+                return
+            sub = m.group(2) or ""
+            if sub == "/start":
+                cmd = list(c.spec.get("Entrypoint") or []) + list(
+                    c.spec.get("Cmd") or []
+                )
+                env = dict(
+                    kv.split("=", 1) for kv in c.spec.get("Env") or []
+                )
+                c.proc = subprocess.Popen(
+                    cmd,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    env={**env, "PATH": "/usr/bin:/bin"},
+                    start_new_session=True,
+                )
+                h._json(204, {})
+                return
+            if sub == "/wait":
+                rc = c.proc.wait() if c.proc else -1
+                c.exit_code = rc
+                h._json(200, {"StatusCode": rc})
+                return
+            if sub == "/json":
+                running = c.proc is not None and c.proc.poll() is None
+                h._json(
+                    200,
+                    {
+                        "Id": c.id,
+                        "State": {
+                            "Running": running,
+                            "ExitCode": c.proc.poll() if c.proc else -1,
+                            "OOMKilled": c.oom,
+                        },
+                    },
+                )
+                return
+            if sub == "/stop":
+                if c.proc and c.proc.poll() is None:
+                    _killpg(c.proc, _signal.SIGTERM)
+                    t = float(q.get("t", ["10"])[0])
+                    deadline = time.monotonic() + t
+                    while time.monotonic() < deadline:
+                        if c.proc.poll() is not None:
+                            break
+                        time.sleep(0.02)
+                    if c.proc.poll() is None:
+                        _killpg(c.proc, _signal.SIGKILL)
+                    c.proc.wait()
+                h._json(204, {})
+                return
+            if sub == "/kill":
+                sig = q.get("signal", ["SIGKILL"])[0]
+                signum = getattr(
+                    _signal, sig if sig.startswith("SIG") else f"SIG{sig}",
+                    _signal.SIGKILL,
+                )
+                if c.proc and c.proc.poll() is None:
+                    _killpg(c.proc, int(signum))
+                h._json(204, {})
+                return
+            if sub == "" and method == "DELETE":
+                if c.proc and c.proc.poll() is None:
+                    _killpg(c.proc, _signal.SIGKILL)
+                    c.proc.wait()
+                c.removed = True
+                with self.lock:
+                    self.containers.pop(c.id, None)
+                h._json(204, {})
+                return
+            if sub.startswith("/stats"):
+                h._json(
+                    200,
+                    {
+                        "cpu_stats": {
+                            "cpu_usage": {
+                                "usage_in_usermode": 1_000_000_000,
+                                "usage_in_kernelmode": 500_000_000,
+                            }
+                        },
+                        "memory_stats": {"usage": 1 << 20, "limit": 1 << 30},
+                    },
+                )
+                return
+            if sub.startswith("/logs"):
+                self._serve_logs(h, c)
+                return
+            if sub == "/exec":
+                body = h._body()
+                e = _Exec(c, body.get("Cmd") or [], bool(body.get("Tty")))
+                with self.lock:
+                    self.execs[e.id] = e
+                h._json(201, {"Id": e.id})
+                return
+
+        m = re.match(r"^/exec/([^/]+)/(start|json)$", path)
+        if m:
+            e = self.execs.get(m.group(1))
+            if e is None:
+                h._json(404, {"message": "no such exec"})
+                return
+            if m.group(2) == "json":
+                h._json(
+                    200, {"Running": e.running, "ExitCode": e.exit_code or 0}
+                )
+                return
+            self._serve_exec(h, e)
+            return
+
+        h._json(404, {"message": f"unknown route {method} {path}"})
+
+    def _find_container(self, ref: str):
+        with self.lock:
+            c = self.containers.get(ref)
+            if c is not None:
+                return c
+            for x in self.containers.values():
+                if x.name == ref:
+                    return x
+        return None
+
+    def _serve_logs(self, h, c: _Container) -> None:
+        """Stream the process's stdout/stderr as multiplexed frames until
+        exit (chunked so http.client can incrementally read)."""
+        h.send_response(200)
+        h.send_header("Content-Type", "application/vnd.docker.raw-stream")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def send(frame: bytes) -> None:
+            h.wfile.write(f"{len(frame):x}\r\n".encode() + frame + b"\r\n")
+            h.wfile.flush()
+
+        proc = c.proc
+        if proc is None:
+            h.wfile.write(b"0\r\n\r\n")
+            return
+        streams = [(1, proc.stdout), (2, proc.stderr)]
+        done = threading.Event()
+        out_lock = threading.Lock()
+
+        def pump(kind, fp):
+            while True:
+                data = fp.read1(4096) if hasattr(fp, "read1") else fp.read(4096)
+                if not data:
+                    return
+                with out_lock:
+                    send(mux_frame(kind, data))
+
+        threads = [
+            threading.Thread(target=pump, args=s, daemon=True) for s in streams
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        done.set()
+        try:
+            h.wfile.write(b"0\r\n\r\n")
+            h.wfile.flush()
+        except OSError:
+            pass
+
+    def _serve_exec(self, h, e: _Exec) -> None:
+        """Hijacked exec: headers then a raw (mux'd) byte stream."""
+        e.running = True
+        h.send_response(200)
+        h.send_header("Content-Type", "application/vnd.docker.raw-stream")
+        h.end_headers()
+        proc = subprocess.Popen(
+            e.cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+        )
+        try:
+            while True:
+                data = proc.stdout.read(4096)
+                if not data:
+                    break
+                payload = data if e.tty else mux_frame(1, data)
+                h.wfile.write(payload)
+                h.wfile.flush()
+        except OSError:
+            proc.kill()
+        rc = proc.wait()
+        e.exit_code = rc
+        e.running = False
+        try:
+            h.wfile.flush()
+            h.connection.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
