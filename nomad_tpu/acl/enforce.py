@@ -52,6 +52,13 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     # checked in ClusterServer._handle_exec_stream with CAP_ALLOC_EXEC)
     ("GET", re.compile(r"^/v1/client/fs/logs/.*$"), CAP_READ_LOGS),
     ("GET", re.compile(r"^/v1/client/fs/(ls|cat|stat)/.*$"), CAP_READ_FS),
+    # volumes ride the job caps (the reference gates host volumes with
+    # namespace host_volume policies; submit-job is this tree's write cap)
+    ("GET", re.compile(r"^/v1/volumes$"), CAP_READ_JOB),
+    ("PUT", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
+    ("POST", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
+    ("GET", re.compile(r"^/v1/volume/.*$"), CAP_READ_JOB),
+    ("DELETE", re.compile(r"^/v1/volume/.*$"), CAP_SUBMIT_JOB),
 ]
 
 _NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]
@@ -114,6 +121,15 @@ def make_http_resolver(server, enabled: bool = True):
             try:
                 job = _json.loads(body).get("Job") or {}
                 ns = job.get("namespace") or ns
+            except Exception:
+                pass
+        # Volume registration: same body-namespace rule as job register.
+        if path == "/v1/volumes" and method in ("PUT", "POST") and body:
+            import json as _json
+
+            try:
+                vol = _json.loads(body).get("Volume") or {}
+                ns = vol.get("namespace") or ns
             except Exception:
                 pass
         if path == "/v1/event/stream":

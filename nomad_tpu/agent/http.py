@@ -192,6 +192,48 @@ class HTTPAgentServer:
             ns = q.get("namespace", ["default"])[0]
             return srv.state.job_versions(ns, p["id"])
 
+        def volumes_list(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return self.cluster.rpc_self("Volume.list", {"namespace": ns})
+
+        def volume_register(p, q, body, tok):
+            vol = codec.from_wire(body["Volume"])
+            self._ns_guard(tok, vol.namespace, "submit-job")
+            return self.cluster.rpc_self("Volume.register", {"volume": vol})
+
+        def volume_get(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            vol = self.cluster.rpc_self(
+                "Volume.get", {"namespace": ns, "volume_id": p["id"]}
+            )
+            if vol is None:
+                raise HTTPError(404, f"volume {p['id']} not found")
+            return vol
+
+        def volume_delete(p, q, body, tok):
+            from ..rpc.client import RPCError
+
+            ns = q.get("namespace", ["default"])[0]
+            self._ns_guard(tok, ns, "submit-job")
+            try:
+                return self.cluster.rpc_self(
+                    "Volume.deregister",
+                    {"namespace": ns, "volume_id": p["id"]},
+                )
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+            except ValueError as e:
+                raise HTTPError(409, str(e))
+            except RPCError as e:
+                # leader-forwarded errors arrive as strings; keep the
+                # status mapping callers rely on
+                msg = str(e)
+                if "not found" in msg:
+                    raise HTTPError(404, msg)
+                if "active claims" in msg:
+                    raise HTTPError(409, msg)
+                raise
+
         def job_plan(p, q, body, tok):
             job = codec.from_wire(body["Job"])
             self._ns_guard(tok, job.namespace, "submit-job")
@@ -240,6 +282,11 @@ class HTTPAgentServer:
         route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
         route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
         route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        route("GET", "/v1/volumes", volumes_list)
+        route("PUT", "/v1/volumes", volume_register)
+        route("POST", "/v1/volumes", volume_register)
+        route("GET", "/v1/volume/(?P<id>[^/]+)", volume_get)
+        route("DELETE", "/v1/volume/(?P<id>[^/]+)", volume_delete)
         route("PUT", "/v1/job/(?P<id>[^/]+)/plan", job_plan)
         route("POST", "/v1/job/(?P<id>[^/]+)/plan", job_plan)
         route("PUT", "/v1/job/(?P<id>[^/]+)/revert", job_revert)

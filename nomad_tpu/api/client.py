@@ -47,6 +47,7 @@ class NomadClient:
         self.status = Status(self)
         self.acl = ACLAPI(self)
         self.operator = Operator(self)
+        self.volumes = Volumes(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -383,6 +384,29 @@ class Deployments(_Resource):
 
     def fail(self, deployment_id: str):
         return self.c.put(f"/v1/deployment/fail/{deployment_id}")
+
+
+class Volumes(_Resource):
+    def list(self, namespace: Optional[str] = None):
+        return self.c.get(
+            "/v1/volumes",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def register(self, volume):
+        return self.c.put("/v1/volumes", body={"Volume": codec.to_wire(volume)})
+
+    def get(self, vol_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/volume/{vol_id}",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def deregister(self, vol_id: str, namespace: Optional[str] = None):
+        return self.c.delete(
+            f"/v1/volume/{vol_id}",
+            params={"namespace": namespace or self.c.namespace},
+        )
 
 
 class Operator(_Resource):

@@ -837,6 +837,62 @@ def cmd_operator_snapshot_restore(args) -> int:
     return 0
 
 
+def cmd_volume_register(args) -> int:
+    """Reference: command/volume_register.go (host-volume shape)."""
+    from ..structs.structs import Volume
+
+    api = _client(args)
+    vol = Volume(
+        id=args.id,
+        namespace=args.namespace or "default",
+        name=args.name or args.id,
+        type="host",
+        node_id=args.node or "",
+        path=args.path or "",
+        access_mode=args.access_mode,
+    )
+    api.volumes.register(vol)
+    print(f'Volume "{vol.id}" registered')
+    return 0
+
+
+def cmd_volume_status(args) -> int:
+    api = _client(args)
+    if args.id:
+        vol = api.volumes.get(args.id, namespace=args.namespace)
+        print(f"ID          = {vol.id}")
+        print(f"Name        = {vol.name}")
+        print(f"Namespace   = {vol.namespace}")
+        print(f"Type        = {vol.type}")
+        print(f"Access Mode = {vol.access_mode}")
+        print(f"Claims      = {len(vol.claims)}")
+        for c in vol.claims.values():
+            mode = "read" if c.read_only else "write"
+            print(f"  alloc {c.alloc_id[:8]} on {c.node_id[:8]} ({mode})")
+        return 0
+    vols = api.volumes.list(namespace=args.namespace)
+    if not vols:
+        print("No volumes")
+        return 0
+    print(
+        _fmt_table(
+            [
+                [v.id, v.name, v.type, v.access_mode, str(len(v.claims))]
+                for v in sorted(vols, key=lambda v: v.id)
+            ],
+            header=["ID", "Name", "Type", "Access Mode", "Claims"],
+        )
+    )
+    return 0
+
+
+def cmd_volume_deregister(args) -> int:
+    api = _client(args)
+    api.volumes.deregister(args.id, namespace=args.namespace)
+    print(f'Volume "{args.id}" deregistered')
+    return 0
+
+
 def cmd_operator_metrics(args) -> int:
     """Reference: command/operator_metrics.go — dump agent telemetry."""
     import json as _json
@@ -1080,6 +1136,27 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = srv.add_subparsers(dest="subcmd")
     sm = ssub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+
+    vol = sub.add_parser("volume", help="volume commands")
+    volsub = vol.add_subparsers(dest="subcmd")
+    vreg = volsub.add_parser("register")
+    vreg.add_argument("id")
+    vreg.add_argument("-name", default="")
+    vreg.add_argument("-namespace", default="default")
+    vreg.add_argument("-node", default="")
+    vreg.add_argument("-path", default="")
+    vreg.add_argument(
+        "-access-mode", dest="access_mode", default="multi-node-multi-writer"
+    )
+    vreg.set_defaults(fn=cmd_volume_register)
+    vstat = volsub.add_parser("status")
+    vstat.add_argument("id", nargs="?")
+    vstat.add_argument("-namespace", default="default")
+    vstat.set_defaults(fn=cmd_volume_status)
+    vdereg = volsub.add_parser("deregister")
+    vdereg.add_argument("id")
+    vdereg.add_argument("-namespace", default="default")
+    vdereg.set_defaults(fn=cmd_volume_deregister)
 
     op = sub.add_parser("operator", help="operator commands")
     opsub = op.add_subparsers(dest="subcmd")

@@ -39,6 +39,7 @@ EVENT_KILLED = "Killed"
 EVENT_DRIVER_FAILURE = "Driver Failure"
 EVENT_SETUP_FAILURE = "Setup Failure"
 EVENT_RESTORED = "Restored"
+EVENT_SIGNALING = "Signaling"
 
 
 class TaskRunner:
@@ -71,6 +72,15 @@ class TaskRunner:
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._rotators: list[LogRotator] = []
+        self._template_restart = threading.Event()
+        self._tmpl_watcher = None
+        # template re-render poll cadence (env knob so tests can shrink it
+        # through the full client stack)
+        import os as _os
+
+        self.template_poll_interval_s = float(
+            _os.environ.get("NOMAD_TEMPLATE_POLL_INTERVAL", "2.0")
+        )
 
     def _restart_policy(self):
         from ..structs import RestartPolicy
@@ -93,6 +103,7 @@ class TaskRunner:
         finally:
             for r in self._rotators:
                 r.stop()
+            self._stop_template_watcher()
 
     def _run(self) -> None:
         self._event(EVENT_RECEIVED)
@@ -148,15 +159,33 @@ class TaskRunner:
                 self._event(EVENT_STARTED)
                 self.on_state_change()
                 self._start_logmon()
+                self._start_template_watcher(task_dir, env)
             restored = False
 
-            # wait for exit OR kill
+            # wait for exit OR kill OR a template-triggered restart
             result = None
             while result is None and not self._kill.is_set():
+                if self._template_restart.is_set():
+                    break
                 try:
                     result = self.driver.wait_task(self.task_id, timeout_s=0.2)
                 except DriverError:
                     break
+            if self._template_restart.is_set() and result is None:
+                # change_mode=restart fired: bounce the task WITHOUT
+                # consuming the restart policy's budget (reference
+                # restarts.go SetRestartTriggered).
+                self._template_restart.clear()
+                self._event(EVENT_RESTARTING, "template re-rendered")
+                try:
+                    self.driver.stop_task(self.task_id, self.task.kill_timeout_s)
+                    self.driver.destroy_task(self.task_id, force=True)
+                except DriverError:
+                    pass
+                self.state.restarts += 1
+                self.state.last_restart_ns = now_ns()
+                self.on_state_change()
+                continue
             if self._kill.is_set():
                 self._event(EVENT_KILLING)
                 try:
@@ -216,6 +245,47 @@ class TaskRunner:
             self._event(EVENT_TEMPLATES)
             for tmpl in self.task.templates:
                 render_template(tmpl, task_dir.dir, env)
+
+    def _start_template_watcher(self, task_dir, env: dict[str, str]) -> None:
+        """change_mode lives here: the watcher re-renders and fires
+        signal/restart (reference template.go runner + task runner's
+        template hook)."""
+        from .template import TemplateWatcher
+
+        self._stop_template_watcher()  # joins: no straggler set() after
+        self._template_restart.clear()
+        if not self.task.templates:
+            return
+        dynamic = [
+            t for t in self.task.templates
+            if (t.change_mode or "restart") != "noop"
+        ]
+        if not dynamic:
+            return
+
+        def signal_fn(sig: str) -> None:
+            try:
+                self.driver.signal_task(self.task_id, sig)
+                self._event(EVENT_SIGNALING, f"template re-rendered: {sig}")
+            except DriverError as e:
+                logger.warning("template signal failed: %s", e)
+
+        watcher = TemplateWatcher(
+            dynamic,
+            task_dir.dir,
+            env,
+            signal_fn=signal_fn,
+            restart_fn=self._template_restart.set,
+            poll_interval_s=self.template_poll_interval_s,
+        )
+        watcher.prime()
+        watcher.start()
+        self._tmpl_watcher = watcher
+
+    def _stop_template_watcher(self) -> None:
+        if self._tmpl_watcher is not None:
+            self._tmpl_watcher.stop()
+            self._tmpl_watcher = None
 
     def _start_logmon(self) -> None:
         for r in self._rotators:

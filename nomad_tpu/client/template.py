@@ -28,10 +28,10 @@ class TemplateError(Exception):
     pass
 
 
-def render_template(
+def compute_template(
     tmpl: Template, task_dir: str, env: dict[str, str]
-) -> str:
-    """Render to task_dir/<dest_path>; returns the destination path."""
+) -> tuple[str, str]:
+    """Render without writing: (confined destination path, content)."""
     from .allocdir import EscapeError, alloc_sandbox, confine
     from .taskenv import interpolate
 
@@ -75,11 +75,121 @@ def render_template(
         dest = confine(sandbox, dest)
     except EscapeError as e:
         raise TemplateError(str(e)) from e
+    return dest, rendered
+
+
+def write_template(tmpl: Template, dest: str, content: str) -> None:
     os.makedirs(os.path.dirname(dest), exist_ok=True)
     with open(dest, "w") as f:
-        f.write(rendered)
+        f.write(content)
     try:
         os.chmod(dest, int(tmpl.perms or "0644", 8))
     except ValueError:
         pass
+
+
+def render_template(
+    tmpl: Template, task_dir: str, env: dict[str, str]
+) -> str:
+    """Render to task_dir/<dest_path>; returns the destination path."""
+    dest, content = compute_template(tmpl, task_dir, env)
+    write_template(tmpl, dest, content)
     return dest
+
+
+class TemplateWatcher:
+    """The re-render loop (reference template.go's runner): poll each
+    template's inputs, and when the rendered content changes, rewrite the
+    destination and fire change_mode — signal via the driver, restart via
+    the task runner's template-restart hook (which does NOT consume the
+    restart policy's budget, matching the reference's
+    SetRestartTriggered).
+
+    Dynamic inputs here are source files (artifacts refreshed on disk)
+    and any env drift; without Consul/Vault in the tree there is no KV
+    watch, so polling the rendered output is the honest equivalent.
+    """
+
+    def __init__(
+        self,
+        templates,
+        task_dir: str,
+        env: dict[str, str],
+        signal_fn,  # (signal_name) -> None
+        restart_fn,  # () -> None
+        poll_interval_s: float = 2.0,
+    ) -> None:
+        import threading
+
+        self.templates = list(templates)
+        self.task_dir = task_dir
+        self.env = env
+        self.signal_fn = signal_fn
+        self.restart_fn = restart_fn
+        self.poll_interval_s = poll_interval_s
+        self._last: dict[int, str] = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    def prime(self) -> None:
+        """Record current rendered contents as the baseline (call after
+        the initial prestart render)."""
+        for i, tmpl in enumerate(self.templates):
+            try:
+                _, content = compute_template(tmpl, self.task_dir, self.env)
+                self._last[i] = content
+            except TemplateError:
+                pass
+
+    def start(self) -> None:
+        import threading
+
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(self._stop,), daemon=True,
+            name="template-watcher",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop AND join: after return, no callback can fire — the task
+        runner clears its restart event right after this, and a straggler
+        set() would bounce the fresh task for no reason."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+
+    def _run(self, stop) -> None:
+        while not stop.wait(self.poll_interval_s):
+            restart = False
+            signals: list[str] = []
+            for i, tmpl in enumerate(self.templates):
+                try:
+                    dest, content = compute_template(
+                        tmpl, self.task_dir, self.env
+                    )
+                except TemplateError:
+                    continue
+                if content == self._last.get(i):
+                    continue
+                if tmpl.splay_s > 0 and stop.wait(
+                    min(tmpl.splay_s, self.poll_interval_s)
+                ):
+                    return
+                write_template(tmpl, dest, content)
+                self._last[i] = content
+                mode = tmpl.change_mode or "restart"
+                if mode == "restart":
+                    restart = True
+                elif mode == "signal":
+                    signals.append(tmpl.change_signal or "SIGHUP")
+            if stop.is_set():
+                return
+            # coalesce: one restart beats any number of signals
+            if restart:
+                self.restart_fn()
+            else:
+                for sig in signals:
+                    self.signal_fn(sig)

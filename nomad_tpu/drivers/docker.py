@@ -449,7 +449,14 @@ class DockerDriver(Driver):
         if cfg.user:
             create["User"] = cfg.user
 
-        cname = "nomad-" + _NAME_RE.sub("-", cfg.id)[-63+6:]
+        # Keep the FRONT of the id (the alloc uuid that makes it unique)
+        # and add a digest suffix: tail-truncation could collide two
+        # allocs of a long-named task and the 409 retry would then
+        # force-remove a healthy container.
+        import hashlib
+
+        digest = hashlib.sha256(cfg.id.encode()).hexdigest()[:8]
+        cname = f"nomad-{_NAME_RE.sub('-', cfg.id)[:46]}-{digest}"
         try:
             cid = self.api.container_create(cname, create)
         except DockerAPIError as e:

@@ -306,13 +306,30 @@ class DriverChecker(FeasibilityChecker):
 
 
 class HostVolumeChecker(FeasibilityChecker):
-    """Node must expose every requested host volume (reference :132)."""
+    """Node must expose every requested host volume (reference :132).
 
-    def __init__(self, ctx: EvalContext, volumes: dict[str, VolumeRequest]) -> None:
+    When a matching volume is REGISTERED in the cluster volume table, its
+    access mode also gates placement: a single-node-writer volume with a
+    live write claim rejects further writers anywhere (the claim itself
+    attaches at plan apply; the volume watcher releases it when the
+    claiming alloc terminates)."""
+
+    def __init__(self, ctx: EvalContext, volumes: dict[str, VolumeRequest],
+                 namespace: str = "default") -> None:
         self.ctx = ctx
+        self.namespace = namespace
         self.asks = [
             v for v in volumes.values() if v.type in ("", "host")
         ]
+        # registered volumes per ask (node screening happens per node:
+        # a pinned volume only serves allocs on its node)
+        self._registered: dict[str, list] = {}
+        state = getattr(ctx, "state", None)
+        if state is not None and hasattr(state, "volumes_by_name"):
+            for ask in self.asks:
+                vols = state.volumes_by_name(namespace, ask.source)
+                if vols:
+                    self._registered[ask.source] = vols
 
     def feasible(self, node: Node) -> tuple[bool, str]:
         for ask in self.asks:
@@ -321,6 +338,15 @@ class HostVolumeChecker(FeasibilityChecker):
                 return False, FILTER_CONSTRAINT_HOST_VOLUMES
             if vol.read_only and not ask.read_only:
                 return False, FILTER_CONSTRAINT_HOST_VOLUMES
+            registered = self._registered.get(ask.source)
+            if registered:
+                usable = [
+                    v for v in registered if v.node_id in ("", node.id)
+                ]
+                if usable and not any(
+                    v.claimable(ask.read_only)[0] for v in usable
+                ):
+                    return False, FILTER_CONSTRAINT_HOST_VOLUMES
         return True, ""
 
 

@@ -119,7 +119,7 @@ class GenericStack:
         tg_checkers: list[FeasibilityChecker] = [
             DriverChecker(self.ctx, _tg_drivers(tg)),
             ConstraintChecker(self.ctx, all_constraints),
-            HostVolumeChecker(self.ctx, tg.volumes),
+            HostVolumeChecker(self.ctx, tg.volumes, namespace=job.namespace),
             NetworkChecker(self.ctx, tg),
             DeviceChecker(self.ctx, tg),
         ]
@@ -213,7 +213,7 @@ class SystemStack:
         tg_checkers = [
             DriverChecker(self.ctx, _tg_drivers(tg)),
             ConstraintChecker(self.ctx, all_constraints),
-            HostVolumeChecker(self.ctx, tg.volumes),
+            HostVolumeChecker(self.ctx, tg.volumes, namespace=job.namespace),
             NetworkChecker(self.ctx, tg),
             DeviceChecker(self.ctx, tg),
         ]

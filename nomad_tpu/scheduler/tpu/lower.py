@@ -264,6 +264,35 @@ def lower_group(
             mask = mask & exists
         feas &= mask
 
+    # Host volumes (mirrors feasible.py HostVolumeChecker): per-node
+    # membership/writability, plus the registered-volume access screen
+    # (node-independent: a claimed single-writer volume zeroes the mask).
+    vol_asks = [
+        v for v in tg.volumes.values() if v.type in ("", "host")
+    ]
+    if vol_asks:
+        state = getattr(ctx, "state", None)
+        for ask in vol_asks:
+            registered = (
+                state.volumes_by_name(job.namespace, ask.source)
+                if state is not None and hasattr(state, "volumes_by_name")
+                else []
+            )
+            vol_ok = np.zeros(n, dtype=bool)
+            for i, node in enumerate(table.nodes):
+                hv = node.host_volumes.get(ask.source)
+                if hv is None or (hv.read_only and not ask.read_only):
+                    continue
+                usable = [
+                    v for v in registered if v.node_id in ("", node.id)
+                ]
+                if usable and not any(
+                    v.claimable(ask.read_only)[0] for v in usable
+                ):
+                    continue  # claimed single-writer: node unusable
+                vol_ok[i] = True
+            feas &= vol_ok
+
     # Network: static-port / bandwidth screens stay host-side but cheap —
     # mbits capacity folds into feasibility; a static-port ask caps the
     # group at one instance per node and excludes nodes already holding

@@ -166,6 +166,34 @@ class JobEndpoint(_Forwarder):
         )
 
 
+class VolumeEndpoint(_Forwarder):
+    """Reference: nomad/csi_endpoint.go reshaped for host volumes."""
+
+    def register(self, args):
+        return self._forward(
+            "Volume.register",
+            args,
+            lambda a: self.cs.server.volume_register(a["volume"]),
+        )
+
+    def deregister(self, args):
+        return self._forward(
+            "Volume.deregister",
+            args,
+            lambda a: self.cs.server.volume_deregister(
+                a["namespace"], a["volume_id"]
+            ),
+        )
+
+    def get(self, args):
+        return self.cs.server.state.volume_by_id(
+            args["namespace"], args["volume_id"]
+        )
+
+    def list(self, args):
+        return self.cs.server.state.volumes(args.get("namespace"))
+
+
 class NodeEndpoint(_Forwarder):
     def register(self, args):
         return self._forward(
@@ -428,6 +456,7 @@ class ClusterServer:
             ("Node", NodeEndpoint(self)),
             ("Eval", EvalEndpoint(self)),
             ("Alloc", AllocEndpoint(self)),
+            ("Volume", VolumeEndpoint(self)),
             ("Deployment", DeploymentEndpoint(self)),
             ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),

@@ -43,6 +43,7 @@ class FSM:
         self.on_node_update: Optional[Callable] = None
         self.on_alloc_client_update: Optional[Callable] = None
         self.on_job_upsert: Optional[Callable] = None  # periodic tracking
+        self.on_volume_release: Optional[Callable] = None  # blocked-eval poke
         self._handlers = {
             "noop": lambda index, payload: None,  # leader election barrier
             # operator snapshot restore rides the log so every replica
@@ -73,6 +74,11 @@ class FSM:
             "acl_policy_delete": lambda i, p: self.state.delete_acl_policies(i, p),
             "acl_token_upsert": lambda i, p: self.state.upsert_acl_tokens(i, p),
             "acl_token_delete": lambda i, p: self.state.delete_acl_tokens(i, p),
+            "volume_register": lambda i, p: self.state.upsert_volume(i, p),
+            "volume_deregister": lambda i, p: self.state.delete_volume(
+                i, p[0], p[1]
+            ),
+            "volume_claim_release": self._apply_volume_release,
         }
 
     def apply(self, index: int, msg_type: str, payload) -> object:
@@ -205,6 +211,13 @@ class FSM:
         ev = payload.get("eval")
         if ev is not None and self.on_eval_update:
             self.on_eval_update([ev])
+
+    def _apply_volume_release(self, index: int, payload) -> None:
+        released = self.state.release_volume_claims(index, list(payload))
+        if released and self.on_volume_release:
+            # A freed claim can make a blocked single-writer job feasible
+            # again; the leader re-runs blocked evals.
+            self.on_volume_release()
 
     def _apply_batch_drain(self, index: int, payload) -> None:
         # {node_id: DrainStrategy|None}

@@ -53,6 +53,7 @@ from .periodic import PeriodicDispatch
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .raft import FSM, InmemLog
+from .volume_watcher import VolumeWatcher
 from .worker import TPUBatchWorker, Worker
 
 logger = logging.getLogger("nomad_tpu.server")
@@ -99,6 +100,7 @@ class Server:
         self.heartbeaters.node_count_fn = lambda: len(self.state.nodes())
         self.deployment_watcher = DeploymentsWatcher(self.state, self.raft_apply)
         self.drainer = NodeDrainer(self.state, self.raft_apply)
+        self.volume_watcher = VolumeWatcher(self.state, self.raft_apply)
         self.periodic = PeriodicDispatch(self.state, self.raft_apply)
         # Threshold GC cadence (reference leader.go schedulePeriodic: one
         # timer per GC kind, 5m default).
@@ -146,6 +148,7 @@ class Server:
         self.fsm.on_node_update = self._on_node_update
         self.fsm.on_alloc_client_update = self._on_alloc_client_update
         self.fsm.on_job_upsert = self._on_job_upsert
+        self.fsm.on_volume_release = self.blocked_evals.unblock_all
         self._leader = False
 
     # -- lifecycle -----------------------------------------------------
@@ -163,6 +166,7 @@ class Server:
             self.tpu_worker.start()
         self.deployment_watcher.start()
         self.drainer.start()
+        self.volume_watcher.start()
         self.periodic.start()
         # Fresh Event per incarnation (see Worker.start): a thread that
         # outlives join(timeout) polls its own event and still exits.
@@ -183,6 +187,7 @@ class Server:
             self._gc_thread = None
         self.deployment_watcher.stop()
         self.drainer.stop()
+        self.volume_watcher.stop()
         self.periodic.stop()
         for w in self.workers:
             w.stop()
@@ -322,6 +327,25 @@ class Server:
         self.raft_apply("job_register", (job, ev))
         return ev.id if ev else ""
 
+    # -- volume endpoint -----------------------------------------------
+
+    def volume_register(self, vol) -> None:
+        """Register (or update) a volume; claims survive updates
+        (reference csi_endpoint.go Register, reshaped for host volumes)."""
+        if not vol.id or not vol.name:
+            raise ValueError("volume requires id and name")
+        self.raft_apply("volume_register", vol)
+
+    def volume_deregister(self, namespace: str, vol_id: str) -> None:
+        vol = self.state.volume_by_id(namespace, vol_id)
+        if vol is None:
+            raise KeyError(f"volume {vol_id} not found")
+        if vol.claims:
+            raise ValueError(
+                f"volume {vol_id} has {len(vol.claims)} active claims"
+            )
+        self.raft_apply("volume_deregister", (namespace, vol_id))
+
     def job_plan(self, job: Job, diff: bool = True) -> dict:
         """Dry-run the candidate job: run the real scheduler against a
         snapshot without committing; return annotations + diff + failures
@@ -372,10 +396,24 @@ class Server:
         return self.heartbeaters.reset(node_id)
 
     def node_update_status(self, node_id: str, status: str) -> None:
+        prev = self.state.node_by_id(node_id)
+        prev_status = prev.status if prev is not None else ""
         self.raft_apply("node_update_status", (node_id, status))
         if status == NODE_STATUS_DOWN:
             self.heartbeaters.clear(node_id)
             self._create_node_evals(node_id)
+        elif status == NODE_STATUS_READY and prev_status != NODE_STATUS_READY:
+            # A recovered node (down -> ready via heartbeat) needs its
+            # system jobs re-placed and class-blocked evals re-run —
+            # re-registration preserves the stored status, so this
+            # transition is where the evals must come from (reference
+            # node_endpoint.go UpdateStatus -> createNodeEvals).
+            self._create_node_evals(node_id)
+            node = self.state.node_by_id(node_id)
+            if node is not None:
+                self.blocked_evals.unblock(
+                    node.computed_class, self.state.latest_index()
+                )
 
     def node_update_drain(
         self, node_id: str, drain: Optional[DrainStrategy], mark_eligible: bool = False

@@ -65,6 +65,7 @@ TABLE_ALLOCS = "allocs"
 TABLE_DEPLOYMENTS = "deployment"
 TABLE_ACL_POLICIES = "acl_policy"
 TABLE_ACL_TOKENS = "acl_token"
+TABLE_VOLUMES = "volumes"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -75,6 +76,7 @@ ALL_TABLES = (
     TABLE_DEPLOYMENTS,
     TABLE_ACL_POLICIES,
     TABLE_ACL_TOKENS,
+    TABLE_VOLUMES,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -254,6 +256,29 @@ class _ReadMixin:
             a
             for a in self._tables[TABLE_ALLOCS].values()
             if a.deployment_id == deployment_id
+        ]
+
+    # volumes ----------------------------------------------------------
+    def volume_by_id(self, namespace: str, vol_id: str):
+        return self._tables[TABLE_VOLUMES].get((namespace, vol_id))
+
+    @_locked_on_live
+    def volumes(self, namespace: Optional[str] = None) -> list:
+        if namespace is None:
+            return list(self._tables[TABLE_VOLUMES].values())
+        return [
+            v
+            for (ns, _), v in self._tables[TABLE_VOLUMES].items()
+            if ns == namespace
+        ]
+
+    @_locked_on_live
+    def volumes_by_name(self, namespace: str, name: str) -> list:
+        """Volumes satisfying a group volume.source ask."""
+        return [
+            v
+            for (ns, _), v in self._tables[TABLE_VOLUMES].items()
+            if ns == namespace and v.name == name
         ]
 
     # deployments ------------------------------------------------------
@@ -452,6 +477,12 @@ class StateStore(_ReadMixin):
         from .. import codec
 
         data = codec.unpack(raw)
+        # Forward compatibility: snapshots from before a table existed
+        # restore with that table empty instead of KeyError-ing later.
+        for t in ALL_TABLES + INDEX_TABLES:
+            data["tables"].setdefault(t, {})
+        for t in ALL_TABLES:
+            data["indexes"].setdefault(t, 0)
         with self._cv:
             self._tables = data["tables"]
             self._indexes = data["indexes"]
@@ -1007,6 +1038,148 @@ class StateStore(_ReadMixin):
             if evals:
                 self._publish(index, TABLE_EVALS, stored_evals, "EvaluationUpdated")
 
+    # -- volumes -------------------------------------------------------
+
+    def upsert_volume(self, index: int, vol) -> None:
+        """Register/update a volume. Claims survive re-registration
+        (reference: CSIVolumeRegister keeps claim state)."""
+        with self._lock:
+            t = self._wtable(TABLE_VOLUMES)
+            key = (vol.namespace, vol.id)
+            existing = t.get(key)
+            vol = vol.copy()
+            if existing is not None:
+                vol.create_index = existing.create_index
+                vol.claims = {
+                    k: c for k, c in existing.claims.items()
+                }
+            else:
+                vol.create_index = index
+            vol.modify_index = index
+            t[key] = vol
+            self._stamp(index, TABLE_VOLUMES)
+            self._publish(index, TABLE_VOLUMES, [vol], "VolumeRegistered")
+
+    def delete_volume(self, index: int, namespace: str, vol_id: str) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_VOLUMES)
+            vol = t.get((namespace, vol_id))
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            if vol.claims:
+                raise ValueError(
+                    f"volume {vol_id} has {len(vol.claims)} active claims"
+                )
+            del t[(namespace, vol_id)]
+            self._stamp(index, TABLE_VOLUMES)
+            self._publish(index, TABLE_VOLUMES, [vol], "VolumeDeregistered")
+
+    def claim_volume(
+        self,
+        index: int,
+        namespace: str,
+        vol_id: str,
+        alloc_id: str,
+        node_id: str,
+        read_only: bool,
+    ) -> None:
+        """Attach an alloc's claim; raises on access-mode conflict
+        (reference: CSIVolumeClaim)."""
+        from ..structs.structs import VolumeClaim
+
+        with self._lock:
+            t = self._wtable(TABLE_VOLUMES)
+            vol = t.get((namespace, vol_id))
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            if alloc_id in vol.claims:
+                return
+            ok, why = vol.claimable(read_only)
+            if not ok:
+                raise ValueError(f"volume {vol_id}: {why}")
+            vol = vol.copy()
+            vol.claims[alloc_id] = VolumeClaim(
+                alloc_id=alloc_id,
+                node_id=node_id,
+                read_only=read_only,
+                create_index=index,
+            )
+            vol.modify_index = index
+            t[(namespace, vol_id)] = vol
+            self._stamp(index, TABLE_VOLUMES)
+            self._publish(index, TABLE_VOLUMES, [vol], "VolumeClaimed")
+
+    def _claim_volumes_txn(self, index: int, allocs: list[Allocation]) -> None:
+        """Best-effort claims for freshly placed allocs whose group asks
+        for volumes that are REGISTERED (unregistered host volumes keep
+        the config-only semantics). Conflicts are logged, not fatal —
+        feasibility screened them; a race loses gracefully."""
+        vt = self._tables[TABLE_VOLUMES]
+        if not vt:
+            return
+        import logging
+
+        log = logging.getLogger("nomad_tpu.state")
+        for alloc in allocs:
+            if alloc.terminal_status() or alloc.job is None:
+                continue
+            tg = alloc.job.lookup_task_group(alloc.task_group)
+            if tg is None or not tg.volumes:
+                continue
+            for req in tg.volumes.values():
+                # A node-pinned volume only serves allocs on its node;
+                # prefer the pinned match over an unpinned (any-node) one.
+                matches = [
+                    vol
+                    for vol in vt.values()
+                    if vol.namespace == alloc.namespace
+                    and vol.name == req.source
+                    and vol.node_id in ("", alloc.node_id)
+                ]
+                matches.sort(key=lambda v: v.node_id == "", )
+                if not matches:
+                    continue
+                vol = matches[0]
+                try:
+                    self.claim_volume(
+                        index,
+                        vol.namespace,
+                        vol.id,
+                        alloc.id,
+                        alloc.node_id,
+                        req.read_only,
+                    )
+                except (KeyError, ValueError) as e:
+                    log.warning(
+                        "volume claim for alloc %s: %s", alloc.id, e
+                    )
+
+    def release_volume_claims(self, index: int, alloc_ids: list[str]) -> int:
+        """Drop the given allocs' claims everywhere; returns how many
+        claims were released (the volume watcher's write)."""
+        drop = set(alloc_ids)
+        released = 0
+        with self._lock:
+            t = self._wtable(TABLE_VOLUMES)
+            changed: list = []
+            for key, vol in list(t.items()):
+                hits = drop & vol.claims.keys()
+                if not hits:
+                    continue
+                vol = vol.copy()
+                for aid in hits:
+                    del vol.claims[aid]
+                    released += 1
+                vol.modify_index = index
+                t[key] = vol
+                changed.append(vol)
+            if changed:
+                self._stamp(index, TABLE_VOLUMES)
+                self._publish(
+                    index, TABLE_VOLUMES, changed, "VolumeClaimReleased"
+                )
+        return released
+
     # -- plan results (the serialization point) ------------------------
 
     def upsert_plan_results(self, index: int, result: PlanResult) -> None:
@@ -1062,12 +1235,19 @@ class StateStore(_ReadMixin):
             # freshly minted by the scheduler or a plan-owned copy (Plan's
             # append_* methods copy), so the store takes them without the
             # per-alloc defensive copy.
+            fresh_allocs = [
+                a for a in allocs_to_upsert if a.id not in t
+            ]
             committed.extend(
                 self._upsert_allocs_txn(
                     index, allocs_to_upsert, owned=True,
                     default_job=result.job,
                 )
             )
+            # Volume claims attach atomically with the placements that
+            # need them (reference: the CSI claim RPC; here the plan
+            # apply IS the claim point for registered volumes).
+            self._claim_volumes_txn(index, fresh_allocs)
             if result.preemption_evals:
                 self._upsert_evals_txn(index, result.preemption_evals)
                 self._stamp(index, TABLE_EVALS)

@@ -998,6 +998,60 @@ class HostVolumeConfig:
     read_only: bool = False
 
 
+VOLUME_ACCESS_SINGLE_WRITER = "single-node-writer"
+VOLUME_ACCESS_MULTI_WRITER = "multi-node-multi-writer"
+VOLUME_ACCESS_READ_ONLY = "multi-node-reader-only"
+
+
+@dataclass(slots=True)
+class VolumeClaim:
+    """One alloc's hold on a registered volume."""
+
+    alloc_id: str = ""
+    node_id: str = ""
+    read_only: bool = False
+    create_index: int = 0
+
+
+@dataclass(slots=True)
+class Volume:
+    """A cluster-registered volume (reference: the CSIVolume table,
+    nomad/structs/csi.go, reshaped for host volumes — the claim/release
+    lifecycle is the part that matters for parity; see
+    nomad/volumewatcher/volumes_watcher.go)."""
+
+    id: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    name: str = ""  # the group volume.source this volume satisfies
+    type: str = "host"
+    node_id: str = ""  # host volumes live on one node ("" = any)
+    path: str = ""
+    access_mode: str = VOLUME_ACCESS_MULTI_WRITER
+    claims: dict[str, VolumeClaim] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "Volume":
+        c = dataclasses.replace(self)
+        c.claims = {k: dataclasses.replace(v) for k, v in self.claims.items()}
+        return c
+
+    def write_claims(self) -> list[VolumeClaim]:
+        return [c for c in self.claims.values() if not c.read_only]
+
+    def claimable(self, read_only: bool) -> tuple[bool, str]:
+        """May a new claim of the given mode attach?"""
+        if self.access_mode == VOLUME_ACCESS_READ_ONLY and not read_only:
+            return False, "volume is read-only"
+        if (
+            self.access_mode == VOLUME_ACCESS_SINGLE_WRITER
+            and not read_only
+            and self.write_claims()
+        ):
+            return False, "volume has an active writer"
+        return True, ""
+
+
 @dataclass(slots=True)
 class Node:
     """A fingerprinted machine (reference: structs.go Node :1812)."""
