@@ -70,9 +70,20 @@ _AGENT_READ = [
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)
 _OPERATOR_READ = [("GET", re.compile(r"^/v1/operator/.*$"))]
+# Any VALID token may read these (the reference filters the namespace
+# list to ones the token can use; every token can at least resolve names).
+_ANY_TOKEN_READ = [
+    ("GET", re.compile(r"^/v1/namespaces$")),
+    ("GET", re.compile(r"^/v1/namespace/.*$")),
+]
 _OPERATOR_WRITE = [
     ("PUT", re.compile(r"^/v1/operator/.*$")),
     ("POST", re.compile(r"^/v1/operator/.*$")),
+    # namespace CRUD is an operator action (reference
+    # namespace_endpoint.go requires management)
+    ("PUT", re.compile(r"^/v1/namespaces$")),
+    ("POST", re.compile(r"^/v1/namespaces$")),
+    ("DELETE", re.compile(r"^/v1/namespace/.*$")),
 ]
 
 
@@ -158,6 +169,9 @@ def make_http_resolver(server, enabled: bool = True):
                 if not acl.allow_agent_read():
                     raise AuthError(403, "agent read denied")
                 return
+        for m, pat in _ANY_TOKEN_READ:
+            if m == method and pat.match(path):
+                return  # token already resolved as valid above
         for m, pat in _OPERATOR_WRITE:
             if m == method and pat.match(path):
                 if not acl.allow_operator_write():

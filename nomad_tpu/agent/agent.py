@@ -25,6 +25,12 @@ class InProcessClusterRPC:
     def __init__(self, cluster: ClusterServer) -> None:
         self.cluster = cluster
 
+    def reverse_addrs(self) -> list:
+        """The co-located server's fabric addr: reverse sessions parked
+        there serve streams even when the advertised forward-dial
+        address is unreachable."""
+        return [tuple(self.cluster.rpc.addr)]
+
     def register(self, node) -> float:
         return self.cluster.rpc_self("Node.register", {"node": node})
 

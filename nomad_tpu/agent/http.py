@@ -192,6 +192,40 @@ class HTTPAgentServer:
             ns = q.get("namespace", ["default"])[0]
             return srv.state.job_versions(ns, p["id"])
 
+        def namespaces_list(p, q, body, tok):
+            return self.cluster.rpc_self("Namespace.list", {})
+
+        def namespace_upsert(p, q, body, tok):
+            ns = codec.from_wire(body["Namespace"])
+            return self.cluster.rpc_self(
+                "Namespace.upsert", {"namespace": ns}
+            )
+
+        def namespace_get(p, q, body, tok):
+            ns = self.cluster.rpc_self("Namespace.get", {"name": p["name"]})
+            if ns is None:
+                raise HTTPError(404, f"namespace {p['name']} not found")
+            return ns
+
+        def namespace_delete(p, q, body, tok):
+            from ..rpc.client import RPCError
+
+            try:
+                return self.cluster.rpc_self(
+                    "Namespace.delete", {"name": p["name"]}
+                )
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+            except ValueError as e:
+                raise HTTPError(409, str(e))
+            except RPCError as e:
+                msg = str(e)
+                if "not found" in msg:
+                    raise HTTPError(404, msg)
+                if "jobs/volumes" in msg or "cannot be deleted" in msg:
+                    raise HTTPError(409, msg)
+                raise
+
         def volumes_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
             return self.cluster.rpc_self("Volume.list", {"namespace": ns})
@@ -282,6 +316,11 @@ class HTTPAgentServer:
         route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
         route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
         route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        route("GET", "/v1/namespaces", namespaces_list)
+        route("PUT", "/v1/namespaces", namespace_upsert)
+        route("POST", "/v1/namespaces", namespace_upsert)
+        route("GET", "/v1/namespace/(?P<name>[^/]+)", namespace_get)
+        route("DELETE", "/v1/namespace/(?P<name>[^/]+)", namespace_delete)
         route("GET", "/v1/volumes", volumes_list)
         route("PUT", "/v1/volumes", volume_register)
         route("POST", "/v1/volumes", volume_register)
@@ -688,7 +727,17 @@ class HTTPAgentServer:
         try:
             session = self.cluster.pool.stream(addr, method, header)
         except (ConnectionError, OSError) as e:
-            raise HTTPError(502, f"client agent unreachable: {e}")
+            # NAT/firewall fallback (reference client_rpc.go): open the
+            # stream over a connection the client parked on this server.
+            session = self.cluster.take_reverse_session(
+                alloc.node_id, method, header
+            )
+            if session is None:
+                raise HTTPError(
+                    502,
+                    f"client agent unreachable ({e}) and no reverse "
+                    f"session parked for node {alloc.node_id[:8]}",
+                )
         # Track live relay sessions (telemetry + the /v1/metrics gauge):
         # wrap close() so every exit path decrements exactly once.
         with self._relay_lock:

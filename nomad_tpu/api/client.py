@@ -48,6 +48,7 @@ class NomadClient:
         self.acl = ACLAPI(self)
         self.operator = Operator(self)
         self.volumes = Volumes(self)
+        self.namespaces = Namespaces(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -384,6 +385,22 @@ class Deployments(_Resource):
 
     def fail(self, deployment_id: str):
         return self.c.put(f"/v1/deployment/fail/{deployment_id}")
+
+
+class Namespaces(_Resource):
+    def list(self):
+        return self.c.get("/v1/namespaces")
+
+    def apply(self, namespace):
+        return self.c.put(
+            "/v1/namespaces", body={"Namespace": codec.to_wire(namespace)}
+        )
+
+    def get(self, name: str):
+        return self.c.get(f"/v1/namespace/{name}")
+
+    def delete(self, name: str):
+        return self.c.delete(f"/v1/namespace/{name}")
 
 
 class Volumes(_Resource):

@@ -837,6 +837,40 @@ def cmd_operator_snapshot_restore(args) -> int:
     return 0
 
 
+def cmd_namespace_list(args) -> int:
+    api = _client(args)
+    nss = api.namespaces.list()
+    if not nss:
+        print("No namespaces")
+        return 0
+    print(
+        _fmt_table(
+            [[n.name, n.description] for n in nss],
+            header=["Name", "Description"],
+        )
+    )
+    return 0
+
+
+def cmd_namespace_apply(args) -> int:
+    """Reference: command/namespace_apply.go."""
+    from ..structs.structs import Namespace
+
+    api = _client(args)
+    api.namespaces.apply(
+        Namespace(name=args.name, description=args.description or "")
+    )
+    print(f'Namespace "{args.name}" applied')
+    return 0
+
+
+def cmd_namespace_delete(args) -> int:
+    api = _client(args)
+    api.namespaces.delete(args.name)
+    print(f'Namespace "{args.name}" deleted')
+    return 0
+
+
 def cmd_volume_register(args) -> int:
     """Reference: command/volume_register.go (host-volume shape)."""
     from ..structs.structs import Volume
@@ -1136,6 +1170,18 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = srv.add_subparsers(dest="subcmd")
     sm = ssub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+
+    nsp = sub.add_parser("namespace", help="namespace commands")
+    nssub = nsp.add_subparsers(dest="subcmd")
+    nsl = nssub.add_parser("list")
+    nsl.set_defaults(fn=cmd_namespace_list)
+    nsa = nssub.add_parser("apply")
+    nsa.add_argument("name")
+    nsa.add_argument("-description", default="")
+    nsa.set_defaults(fn=cmd_namespace_apply)
+    nsd = nssub.add_parser("delete")
+    nsd.add_argument("name")
+    nsd.set_defaults(fn=cmd_namespace_delete)
 
     vol = sub.add_parser("volume", help="volume commands")
     volsub = vol.add_subparsers(dest="subcmd")

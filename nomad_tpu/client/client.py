@@ -115,6 +115,19 @@ class Client:
 
     def start(self) -> None:
         self.endpoints.start()
+        # Reverse-dial fallback (reference client_rpc.go): park sessions
+        # on the servers so they can reach us even when forward-dial to
+        # our advertised address fails (NAT/firewall). Enabled whenever
+        # the rpc shim can name server fabric addresses.
+        addrs_fn = getattr(self.rpc, "reverse_addrs", None)
+        if addrs_fn is not None and addrs_fn():
+            from .endpoints import ReverseDialer
+
+            self._reverse = ReverseDialer(
+                self, self.endpoints, addrs_fn,
+                secret=self.endpoints.rpc.secret,
+            )
+            self._reverse.start()
         self._restore()
         # Registration happens ON the heartbeat thread with retries
         # (reference registerAndHeartbeat runs in a goroutine): agent boot
@@ -135,6 +148,8 @@ class Client:
         incarnation's restore (the reference's default — tasks outlive
         the agent process)."""
         self._shutdown.set()
+        if getattr(self, "_reverse", None) is not None:
+            self._reverse.stop()
         self.endpoints.stop()
         if kill_allocs:
             for ar in list(self.alloc_runners.values()):

@@ -306,3 +306,112 @@ class ClientEndpoints:
             if sock is not None:
                 sock.close()
             session.close()
+
+
+class ReverseDialer:
+    """Reverse-dial fallback for NAT'd clients (reference
+    nomad/client_rpc.go: servers open streams over yamux sessions the
+    CLIENT established).
+
+    Keeps `idle_target` connections parked on a server's fabric: each
+    registers with our node id, then blocks waiting for the server to
+    send a stream request header. On receipt the request is dispatched to
+    the SAME handlers the forward-dial listener uses, then the connection
+    is consumed and a fresh one parked in its place.
+    """
+
+    def __init__(
+        self,
+        client,
+        endpoints: ClientEndpoints,
+        addrs_fn,  # () -> list[(host, port)] of server fabric addrs
+        idle_target: int = 2,
+        secret: str = "",
+        retry_s: float = 2.0,
+    ) -> None:
+        from ..rpc import ConnPool
+
+        self.client = client
+        self.endpoints = endpoints
+        self.addrs_fn = addrs_fn
+        self.idle_target = idle_target
+        self.retry_s = retry_s
+        self.pool = ConnPool(secret=secret)
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._parked: list[StreamSession] = []
+
+    def start(self) -> None:
+        self._stop = threading.Event()
+        # One parker per known server (at least idle_target threads):
+        # the relay only finds sessions parked on the SERVER IT RUNS ON,
+        # so every server needs coverage, not just addrs[0].
+        n = max(self.idle_target, len(self.addrs_fn() or []))
+        for i in range(n):
+            t = threading.Thread(
+                target=self._run, args=(self._stop, i), daemon=True,
+                name=f"reverse-dial-{i}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            parked = list(self._parked)
+            self._parked.clear()
+        for s in parked:
+            s.close()  # unblocks the recv below
+
+    def _run(self, stop: threading.Event, base: int = 0) -> None:
+        rotate = 0
+        while not stop.is_set():
+            addrs = list(self.addrs_fn() or [])
+            if not addrs:
+                stop.wait(self.retry_s)
+                continue
+            # thread i pins to server i (mod n); rotate only on failure
+            addr = tuple(addrs[(base + rotate) % len(addrs)])
+            try:
+                session = self.pool.stream(
+                    addr,
+                    "ClientReverse.register",
+                    {"node_id": self.client.node.id},
+                )
+            except Exception:
+                rotate += 1
+                stop.wait(self.retry_s)
+                continue
+            with self._lock:
+                self._parked.append(session)
+            try:
+                req = session.recv(timeout_s=None)  # park until needed
+            except Exception:
+                with self._lock:
+                    if session in self._parked:
+                        self._parked.remove(session)
+                session.close()
+                stop.wait(self.retry_s if not stop.is_set() else 0)
+                continue
+            with self._lock:
+                if session in self._parked:
+                    self._parked.remove(session)
+            if stop.is_set():
+                session.close()
+                return
+            method = (req or {}).get("method", "")
+            handler = self.endpoints.rpc._stream_handlers.get(method)
+            if handler is None:
+                try:
+                    session.send({"error": f"unknown stream method {method!r}"})
+                finally:
+                    session.close()
+                continue
+            try:
+                session.send({"ok": True})
+                handler(session, req)
+            except Exception:
+                logger.exception("reverse stream %s failed", method)
+            finally:
+                session.close()

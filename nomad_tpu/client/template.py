@@ -174,10 +174,13 @@ class TemplateWatcher:
                     continue
                 if content == self._last.get(i):
                     continue
-                if tmpl.splay_s > 0 and stop.wait(
-                    min(tmpl.splay_s, self.poll_interval_s)
-                ):
-                    return
+                if tmpl.splay_s > 0:
+                    # randomized, NOT capped: splay exists to stagger a
+                    # fleet's restarts when a shared input changes
+                    import random
+
+                    if stop.wait(random.uniform(0, tmpl.splay_s)):
+                        return
                 write_template(tmpl, dest, content)
                 self._last[i] = content
                 mode = tmpl.change_mode or "restart"

@@ -166,6 +166,32 @@ class JobEndpoint(_Forwarder):
         )
 
 
+class NamespaceEndpoint(_Forwarder):
+    """Reference: nomad/namespace_endpoint.go."""
+
+    def upsert(self, args):
+        return self._forward(
+            "Namespace.upsert",
+            args,
+            lambda a: self.cs.server.namespace_upsert(a["namespace"]),
+        )
+
+    def delete(self, args):
+        return self._forward(
+            "Namespace.delete",
+            args,
+            lambda a: self.cs.server.namespace_delete(a["name"]),
+        )
+
+    def get(self, args):
+        return self.cs.server.state.namespace_by_name(args["name"])
+
+    def list(self, args):
+        return sorted(
+            self.cs.server.state.namespaces(), key=lambda n: n.name
+        )
+
+
 class VolumeEndpoint(_Forwarder):
     """Reference: nomad/csi_endpoint.go reshaped for host volumes."""
 
@@ -457,6 +483,7 @@ class ClusterServer:
             ("Eval", EvalEndpoint(self)),
             ("Alloc", AllocEndpoint(self)),
             ("Volume", VolumeEndpoint(self)),
+            ("Namespace", NamespaceEndpoint(self)),
             ("Deployment", DeploymentEndpoint(self)),
             ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),
@@ -467,6 +494,14 @@ class ClusterServer:
         # alloc's client agent ↔ driver pty (reference streaming path,
         # SURVEY §3.5 — 4 process boundaries).
         self.rpc.register_stream("ClientExec.exec", self._handle_exec_stream)
+        # Reverse-dial registry: NAT'd clients park connections here that
+        # the server can open streams over when forward-dial fails
+        # (reference nomad/client_rpc.go yamux session reuse).
+        self._reverse_lock = threading.Lock()
+        self._reverse: dict[str, list[tuple]] = {}
+        self.rpc.register_stream(
+            "ClientReverse.register", self._handle_reverse_register
+        )
         # Gossip membership (reference setupSerf): server-role tagged,
         # events drive leader-side raft peer reconciliation.
         self.serf = Membership(
@@ -513,6 +548,70 @@ class ClusterServer:
         host, _, port = addr_s.rpartition(":")
         return alloc, (host, int(port))
 
+    def _handle_reverse_register(self, session, header: dict) -> None:
+        """Park a client-initiated connection until a relay consumes it.
+
+        The dispatch thread owns the socket and closes it on return, so
+        the handler blocks on the session's done-event; the consumer sets
+        it from the session's wrapped close()."""
+        import threading as _t
+
+        node_id = header.get("node_id", "")
+        if not node_id:
+            session.send({"error": "node_id required"})
+            return
+        done = _t.Event()
+        with self._reverse_lock:
+            self._reverse.setdefault(node_id, []).append((session, done))
+        done.wait()
+
+    def take_reverse_session(self, node_id: str, method: str, header: dict):
+        """Open a stream over a connection the client dialed (the NAT
+        fallback). Returns a ready session or None when the node has no
+        parked connections on THIS server. Dead parked sessions (client
+        went away) are skimmed off until one answers."""
+        while True:
+            with self._reverse_lock:
+                stack = self._reverse.get(node_id)
+                if not stack:
+                    return None
+                session, done = stack.pop()
+                if not stack:
+                    del self._reverse[node_id]
+            hdr = dict(header)
+            hdr["method"] = method
+            try:
+                session.send(hdr)
+                ack = session.recv(timeout_s=10)
+            except (ConnectionError, OSError, TimeoutError):
+                done.set()
+                session.close()
+                continue
+            if not ack.get("ok"):
+                done.set()
+                session.close()
+                if ack.get("error"):
+                    raise RPCError(ack["error"])
+                continue
+            orig_close = session.close
+
+            def tracked_close(done=done, orig_close=orig_close):
+                done.set()
+                orig_close()
+
+            session.close = tracked_close
+            return session
+
+    def _close_reverse_sessions(self) -> None:
+        with self._reverse_lock:
+            parked = [
+                pair for stack in self._reverse.values() for pair in stack
+            ]
+            self._reverse.clear()
+        for session, done in parked:
+            done.set()
+            session.close()
+
     def _handle_exec_stream(self, session, header: dict) -> None:
         """Splice an exec session through to the alloc's client agent."""
         down = None
@@ -547,8 +646,15 @@ class ClusterServer:
             try:
                 down = self.pool.stream(addr, "Exec.exec", hdr)
             except (ConnectionError, OSError) as e:
-                session.send({"error": f"client agent unreachable: {e}"})
-                return
+                # same NAT fallback as the fs/logs relay
+                down = self.take_reverse_session(
+                    alloc.node_id, "Exec.exec", hdr
+                )
+                if down is None:
+                    session.send(
+                        {"error": f"client agent unreachable: {e}"}
+                    )
+                    return
 
             done = threading.Event()
 
@@ -672,6 +778,7 @@ class ClusterServer:
 
     def shutdown(self) -> None:
         was_leader = self.raft.is_leader()
+        self._close_reverse_sessions()
         self.serf.stop()
         self._reconcile_q.put(None)
         self.raft.stop()
@@ -705,6 +812,11 @@ class ClusterRPC:
         # rotation must be atomic or concurrent failures double-rotate
         # past live servers.
         self._lock = threading.Lock()
+
+    def reverse_addrs(self) -> list:
+        """Server fabric addrs the ReverseDialer parks sessions on."""
+        with self._lock:
+            return list(self.addrs)
 
     def _call(self, method: str, args, timeout_s: float = 30.0):
         last: Optional[Exception] = None

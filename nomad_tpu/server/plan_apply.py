@@ -48,6 +48,48 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
     return True, ""
 
 
+def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
+    """Nodes whose placements would exceed a registered volume's write
+    capacity, counting claims already committed AND earlier placements in
+    this same plan (first-come order by node id for determinism)."""
+    if not hasattr(snapshot, "volumes_by_name"):
+        return set()
+    writers: dict[tuple[str, str], int] = {}  # (ns, vol_id) -> new writers
+    bad: set[str] = set()
+    for node_id in sorted(plan.node_allocation):
+        for alloc in plan.node_allocation[node_id]:
+            job = alloc.job or plan.job
+            if job is None:
+                continue
+            tg = job.lookup_task_group(alloc.task_group)
+            if tg is None or not tg.volumes:
+                continue
+            for req in tg.volumes.values():
+                if req.read_only or req.type not in ("", "host"):
+                    continue
+                for vol in snapshot.volumes_by_name(
+                    alloc.namespace, req.source
+                ):
+                    if vol.node_id not in ("", node_id):
+                        continue
+                    key = (vol.namespace, vol.id)
+                    pending = writers.get(key, 0)
+                    from ..structs.structs import (
+                        VOLUME_ACCESS_READ_ONLY,
+                        VOLUME_ACCESS_SINGLE_WRITER,
+                    )
+
+                    if vol.access_mode == VOLUME_ACCESS_READ_ONLY or (
+                        vol.access_mode == VOLUME_ACCESS_SINGLE_WRITER
+                        and (len(vol.write_claims()) + pending) >= 1
+                    ):
+                        bad.add(node_id)
+                    else:
+                        writers[key] = pending + 1
+                    break
+    return bad
+
+
 def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     """Re-verify the whole plan; return the committable subset
     (reference :400)."""
@@ -58,9 +100,19 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
         deployment=plan.deployment,
         deployment_updates=list(plan.deployment_updates),
     )
+    # Volume single-writer admission across the WHOLE plan: the
+    # feasibility screen saw committed state only, so two writers placed
+    # in one plan would both pass it — count in-plan write claims here
+    # and reject the overflowing node (reference: the CSI claim RPC
+    # serializes this per volume; our claim point is plan apply).
+    vol_rejected = _volume_overcommitted_nodes(snapshot, plan)
     rejected = False
     for node_id in plan.node_allocation:
-        ok, reason = evaluate_node_plan(snapshot, plan, node_id)
+        ok, reason = (
+            (False, "volume write-claim conflict")
+            if node_id in vol_rejected
+            else evaluate_node_plan(snapshot, plan, node_id)
+        )
         if ok:
             result.node_allocation[node_id] = plan.node_allocation[node_id]
         else:

@@ -74,6 +74,8 @@ class FSM:
             "acl_policy_delete": lambda i, p: self.state.delete_acl_policies(i, p),
             "acl_token_upsert": lambda i, p: self.state.upsert_acl_tokens(i, p),
             "acl_token_delete": lambda i, p: self.state.delete_acl_tokens(i, p),
+            "namespace_upsert": lambda i, p: self.state.upsert_namespace(i, p),
+            "namespace_delete": lambda i, p: self.state.delete_namespace(i, p),
             "volume_register": lambda i, p: self.state.upsert_volume(i, p),
             "volume_deregister": lambda i, p: self.state.delete_volume(
                 i, p[0], p[1]

@@ -31,6 +31,7 @@ from ..structs import (
 )
 from ..structs.structs import (
     ALLOC_CLIENT_STATUS_FAILED,
+    DEFAULT_NAMESPACE,
     EVAL_STATUS_BLOCKED,
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_JOB_DEREGISTER,
@@ -178,6 +179,12 @@ class Server:
         self._gc_thread.start()
         self._leader = True
         self._restore_evals()
+        # Bootstrap the default namespace (reference leader.go
+        # establishLeadership creates it so it always lists).
+        try:
+            self._ensure_namespace(DEFAULT_NAMESPACE)
+        except Exception:
+            logger.exception("default namespace bootstrap failed")
 
     def revoke_leadership(self) -> None:
         self._leader = False
@@ -303,6 +310,7 @@ class Server:
         job = job.copy()
         job.canonicalize()
         job.validate()
+        self._ensure_namespace(job.namespace)
         if job.is_periodic():
             # A malformed cron spec must be rejected at the API, not fire
             # wild from the dispatcher (reference periodic.go Add validates).
@@ -327,6 +335,45 @@ class Server:
         self.raft_apply("job_register", (job, ev))
         return ev.id if ev else ""
 
+    # -- namespace endpoint --------------------------------------------
+
+    def namespace_upsert(self, ns) -> None:
+        """Reference: nomad/namespace_endpoint.go UpsertNamespaces."""
+        ns.validate()
+        self.raft_apply("namespace_upsert", ns)
+
+    def namespace_delete(self, name: str) -> None:
+        # pre-validate against current state for a friendly error; the
+        # FSM re-checks under the raft serialization point
+        if name == DEFAULT_NAMESPACE:
+            raise ValueError("the default namespace cannot be deleted")
+        if self.state.namespace_by_name(name) is None:
+            raise KeyError(f"namespace {name} not found")
+        # The replicated apply loop logs-and-continues on FSM errors, so
+        # the user-facing in-use refusal must happen here; the store
+        # re-checks authoritatively under the raft serialization point.
+        in_use = len(self.state.jobs(name)) + len(self.state.volumes(name))
+        if in_use:
+            raise ValueError(f"namespace {name} has {in_use} jobs/volumes")
+        self.raft_apply("namespace_delete", name)
+
+    def _ensure_namespace(self, namespace: str) -> None:
+        """Writes into a namespace require it to exist (reference
+        job_endpoint.go Register's namespace check). 'default' always
+        exists — bootstrapped on first use."""
+        if namespace == DEFAULT_NAMESPACE:
+            if self.state.namespace_by_name(namespace) is None:
+                from ..structs.structs import Namespace
+
+                self.raft_apply(
+                    "namespace_upsert",
+                    Namespace(name=DEFAULT_NAMESPACE,
+                              description="Default shared namespace"),
+                )
+            return
+        if self.state.namespace_by_name(namespace) is None:
+            raise ValueError(f"namespace {namespace!r} does not exist")
+
     # -- volume endpoint -----------------------------------------------
 
     def volume_register(self, vol) -> None:
@@ -334,6 +381,24 @@ class Server:
         (reference csi_endpoint.go Register, reshaped for host volumes)."""
         if not vol.id or not vol.name:
             raise ValueError("volume requires id and name")
+        from ..structs.structs import (
+            VOLUME_ACCESS_MULTI_WRITER,
+            VOLUME_ACCESS_READ_ONLY,
+            VOLUME_ACCESS_SINGLE_WRITER,
+        )
+
+        valid_modes = (
+            VOLUME_ACCESS_SINGLE_WRITER,
+            VOLUME_ACCESS_MULTI_WRITER,
+            VOLUME_ACCESS_READ_ONLY,
+        )
+        if vol.access_mode not in valid_modes:
+            # a typo'd mode would silently behave as multi-writer
+            raise ValueError(
+                f"invalid access_mode {vol.access_mode!r}; "
+                f"one of {', '.join(valid_modes)}"
+            )
+        self._ensure_namespace(vol.namespace)
         self.raft_apply("volume_register", vol)
 
     def volume_deregister(self, namespace: str, vol_id: str) -> None:

@@ -66,6 +66,7 @@ TABLE_DEPLOYMENTS = "deployment"
 TABLE_ACL_POLICIES = "acl_policy"
 TABLE_ACL_TOKENS = "acl_token"
 TABLE_VOLUMES = "volumes"
+TABLE_NAMESPACES = "namespaces"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -77,6 +78,7 @@ ALL_TABLES = (
     TABLE_ACL_POLICIES,
     TABLE_ACL_TOKENS,
     TABLE_VOLUMES,
+    TABLE_NAMESPACES,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -257,6 +259,13 @@ class _ReadMixin:
             for a in self._tables[TABLE_ALLOCS].values()
             if a.deployment_id == deployment_id
         ]
+
+    # namespaces -------------------------------------------------------
+    def namespace_by_name(self, name: str):
+        return self._tables[TABLE_NAMESPACES].get(name)
+
+    def namespaces(self) -> list:
+        return list(self._tables[TABLE_NAMESPACES].values())
 
     # volumes ----------------------------------------------------------
     def volume_by_id(self, namespace: str, vol_id: str):
@@ -1037,6 +1046,47 @@ class StateStore(_ReadMixin):
                 )
             if evals:
                 self._publish(index, TABLE_EVALS, stored_evals, "EvaluationUpdated")
+
+    # -- namespaces ----------------------------------------------------
+
+    def upsert_namespace(self, index: int, ns) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_NAMESPACES)
+            existing = t.get(ns.name)
+            ns = ns.copy()
+            ns.create_index = existing.create_index if existing else index
+            ns.modify_index = index
+            t[ns.name] = ns
+            self._stamp(index, TABLE_NAMESPACES)
+            self._publish(index, TABLE_NAMESPACES, [ns], "NamespaceUpserted")
+
+    def delete_namespace(self, index: int, name: str) -> None:
+        """Refuses while the namespace holds jobs or volumes (reference
+        namespace_endpoint.go DeleteNamespaces nonTerminal check)."""
+        if name == "default":
+            raise ValueError("the default namespace cannot be deleted")
+        with self._lock:
+            t = self._wtable(TABLE_NAMESPACES)
+            ns = t.get(name)
+            if ns is None:
+                raise KeyError(f"namespace {name} not found")
+            # Only NON-TERMINAL jobs block deletion (reference
+            # namespace_endpoint.go nonTerminal check): dead jobs pending
+            # GC should not wedge the namespace for minutes.
+            in_use = sum(
+                1
+                for (jns, _), j in self._tables[TABLE_JOBS].items()
+                if jns == name and not (j.stop or j.status == JOB_STATUS_DEAD)
+            ) + sum(
+                1 for (vns, _) in self._tables[TABLE_VOLUMES] if vns == name
+            )
+            if in_use:
+                raise ValueError(
+                    f"namespace {name} has {in_use} jobs/volumes"
+                )
+            del t[name]
+            self._stamp(index, TABLE_NAMESPACES)
+            self._publish(index, TABLE_NAMESPACES, [ns], "NamespaceDeleted")
 
     # -- volumes -------------------------------------------------------
 

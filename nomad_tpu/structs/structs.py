@@ -998,6 +998,30 @@ class HostVolumeConfig:
     read_only: bool = False
 
 
+@dataclass(slots=True)
+class Namespace:
+    """A namespace record (reference: structs.go Namespace :5971 — OSS
+    since 1.0; jobs/volumes register INTO one and ACL policies scope
+    capabilities BY one)."""
+
+    name: str = ""
+    description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "Namespace":
+        return dataclasses.replace(self)
+
+    def validate(self) -> None:
+        import re as _re
+
+        if not _re.fullmatch(r"[a-zA-Z0-9-]{1,128}", self.name or ""):
+            raise ValueError(
+                f"invalid namespace name {self.name!r} "
+                "(alphanumeric and dashes, 1-128 chars)"
+            )
+
+
 VOLUME_ACCESS_SINGLE_WRITER = "single-node-writer"
 VOLUME_ACCESS_MULTI_WRITER = "multi-node-multi-writer"
 VOLUME_ACCESS_READ_ONLY = "multi-node-reader-only"

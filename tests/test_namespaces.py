@@ -1,0 +1,95 @@
+"""Namespace CRUD tests (reference nomad/namespace_endpoint.go +
+state_store namespace tables): lifecycle, registration gating, ACL."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server
+from nomad_tpu.structs.structs import Namespace
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=1)
+    s.establish_leadership()
+    yield s
+    s.shutdown()
+
+
+def test_namespace_crud(server):
+    server.namespace_upsert(Namespace(name="prod", description="production"))
+    ns = server.state.namespace_by_name("prod")
+    assert ns is not None and ns.description == "production"
+
+    # update keeps create_index
+    ci = ns.create_index
+    server.namespace_upsert(Namespace(name="prod", description="prod v2"))
+    ns = server.state.namespace_by_name("prod")
+    assert ns.description == "prod v2" and ns.create_index == ci
+
+    server.namespace_delete("prod")
+    assert server.state.namespace_by_name("prod") is None
+
+
+def test_namespace_name_validated(server):
+    with pytest.raises(ValueError):
+        server.namespace_upsert(Namespace(name="bad name!"))
+
+
+def test_job_register_requires_namespace(server):
+    server.node_register(mock.node())
+    job = mock.job()
+    job.namespace = "nonexistent"
+    with pytest.raises(ValueError, match="does not exist"):
+        server.job_register(job)
+    # default is bootstrapped on first use
+    ok = mock.job()
+    server.job_register(ok)
+    assert server.state.namespace_by_name("default") is not None
+
+
+def test_namespace_delete_refuses_in_use(server):
+    server.node_register(mock.node())
+    server.namespace_upsert(Namespace(name="busy"))
+    job = mock.job()
+    job.namespace = "busy"
+    server.job_register(job)
+    with pytest.raises(ValueError, match="jobs/volumes"):
+        server.namespace_delete("busy")
+    with pytest.raises(ValueError, match="cannot be deleted"):
+        server.namespace_delete("default")
+
+
+def test_namespace_http_surface(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import APIError, NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        api.namespaces.apply(Namespace(name="team-a", description="a"))
+        names = [n.name for n in api.namespaces.list()]
+        assert "team-a" in names
+        got = api.namespaces.get("team-a")
+        assert got.description == "a"
+        # registering a job into it now works end to end
+        srv = agent.server.server
+        srv.node_register(mock.node())
+        job = mock.job()
+        job.namespace = "team-a"
+        api.jobs.register(job)
+        with pytest.raises(APIError) as e:
+            api.namespaces.delete("team-a")
+        assert e.value.status == 409
+        with pytest.raises(APIError) as e:
+            api.namespaces.get("nope")
+        assert e.value.status == 404
+    finally:
+        agent.shutdown()

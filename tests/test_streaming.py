@@ -222,3 +222,74 @@ def test_exec_task_exit_code(streaming_cluster):
     assert code == 7
     out, code = tr.driver.exec_task(tr.task_id, ["echo", "hi"])
     assert code == 0 and b"hi" in out
+
+
+def test_reverse_dial_fallback_when_forward_unreachable(tmp_path):
+    """NAT'd client: the advertised forward-dial address is dead, but the
+    client parked reverse sessions on the server — logs still stream
+    (reference nomad/client_rpc.go's server->client session reuse)."""
+    from nomad_tpu.agent.http import HTTPAgentServer
+
+    server = ClusterServer("rev0", port=0, num_workers=2)
+    server.start()
+    assert wait_until(lambda: server.is_leader())
+    http = HTTPAgentServer(server, host="127.0.0.1", port=0)
+    http.start()
+    client = None
+    try:
+        client = Client(
+            ClusterRPC([server.addr]), data_dir=str(tmp_path / "client")
+        )
+        client.start()
+        assert client.wait_registered(10)
+        # the reverse dialer parks sessions on the server
+        assert wait_until(
+            lambda: server._reverse.get(client.node.id), 10
+        ), "reverse sessions should park"
+
+        job = mock.job(id="rev-job")
+        job.datacenters = [client.node.datacenter]
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0] = Task(
+            name="web",
+            driver="rawexec",
+            config={
+                "command": "/bin/sh",
+                "args": ["-c", "echo reverse-hello; sleep 60"],
+            },
+            resources=Resources(cpu=100, memory_mb=64),
+        )
+        pool = ConnPool()
+        pool.call(server.addr, "Job.register", {"job": job})
+        assert wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in server.server.state.allocs_by_job("default", job.id)
+            ),
+            30,
+        )
+        alloc = next(
+            a
+            for a in server.server.state.allocs_by_job("default", job.id)
+            if a.client_status == "running"
+        )
+
+        # Simulate NAT: re-advertise a dead forward-dial address. The
+        # store preserves server-owned fields, so re-registering with the
+        # poisoned attribute is exactly what a NAT'd client would do.
+        poisoned = client.node.copy()
+        poisoned.attributes["unique.client.rpc"] = "127.0.0.1:1"
+        pool.call(server.addr, "Node.register", {"node": poisoned})
+        stored = server.server.state.node_by_id(client.node.id)
+        assert stored.attributes["unique.client.rpc"] == "127.0.0.1:1"
+
+        api = NomadClient(f"http://{http.addr[0]}:{http.addr[1]}")
+        data = b"".join(api.allocations.logs(alloc.id, task="web"))
+        assert b"reverse-hello" in data
+        pool.shutdown()
+    finally:
+        if client is not None:
+            client.shutdown()
+        http.shutdown()
+        server.shutdown()

@@ -351,3 +351,30 @@ def test_claim_matches_the_allocs_node_volume(server):
     assert len(
         server.state.volume_by_id("default", "data-this-node").claims
     ) == 1
+
+
+def test_single_writer_enforced_within_one_plan(server):
+    """Two writers placed in the SAME plan must not both commit: the
+    feasibility screen only sees committed claims, so the plan applier's
+    volume admission is the backstop."""
+    server.node_register(_vol_node())
+    server.node_register(_vol_node())
+    server.volume_register(_vol(access=VOLUME_ACCESS_SINGLE_WRITER))
+    server.job_register(_vol_job("double-writer", count=2))
+    server.wait_for_evals(10)
+
+    vol = server.state.volume_by_id("default", "shared-data")
+    assert len(vol.write_claims()) == 1, (
+        f"exactly one writer may claim, got {len(vol.write_claims())}"
+    )
+    live = [
+        a
+        for a in server.state.allocs_by_job("default", "double-writer")
+        if not a.terminal_status()
+    ]
+    assert len(live) == 1
+
+
+def test_volume_register_rejects_bad_access_mode(server):
+    with pytest.raises(ValueError, match="invalid access_mode"):
+        server.volume_register(_vol(access="single-node-writer-typo"))
