@@ -99,7 +99,7 @@ def make_http_resolver(server, enabled: bool = True):
             return
         # Status endpoints stay open (cluster plumbing, like the
         # reference's unauthenticated Status.Ping/Leader).
-        if path.startswith("/v1/status/"):
+        if path.startswith("/v1/status/") or path == "/v1/regions":
             return
         # Bootstrap is the chicken-and-egg exception.
         if path == "/v1/acl/bootstrap":

@@ -34,6 +34,10 @@ class InProcessClusterRPC:
     def register(self, node) -> float:
         return self.cluster.rpc_self("Node.register", {"node": node})
 
+    def alloc_client_addr(self, alloc_id: str):
+        out = self.cluster.rpc_self("Alloc.client_addr", {"alloc_id": alloc_id})
+        return tuple(out) if out else (None, None)
+
     def heartbeat(self, node_id: str) -> float:
         return self.cluster.rpc_self("Node.heartbeat", {"node_id": node_id})
 

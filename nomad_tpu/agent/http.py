@@ -66,6 +66,10 @@ class HTTPAgentServer:
         self.acl_resolver = acl_resolver
         self._relay_lock = threading.Lock()
         self._relay_active = 0
+        # Cap concurrent client-relay sessions: each one ties up an HTTP
+        # worker thread against a possibly-slow client agent; unbounded,
+        # a burst of follow-streams starves every other route.
+        self._relay_max = 64
         self._routes: list[tuple[str, re.Pattern, Callable]] = []
         self._register_routes()
         handler = self._make_handler()
@@ -492,6 +496,9 @@ class HTTPAgentServer:
         def status_peers(p, q, body, tok):
             return self.cluster.rpc_self("Status.peers", {})
 
+        def regions_list(p, q, body, tok):
+            return self.cluster.rpc_self("Status.regions", {})
+
         def agent_metrics(p, q, body, tok):
             # reference: /v1/metrics (command/agent/http.go MetricsRequest,
             # behind agent:read / AgentReadACL)
@@ -632,6 +639,7 @@ class HTTPAgentServer:
 
         route("GET", "/v1/status/leader", status_leader)
         route("GET", "/v1/status/peers", status_peers)
+        route("GET", "/v1/regions", regions_list)
         route("GET", "/v1/metrics", agent_metrics)
         route("GET", "/v1/agent/members", agent_members)
         route("GET", "/v1/agent/self", agent_self)
@@ -741,6 +749,13 @@ class HTTPAgentServer:
         # Track live relay sessions (telemetry + the /v1/metrics gauge):
         # wrap close() so every exit path decrements exactly once.
         with self._relay_lock:
+            if self._relay_active >= self._relay_max:
+                session.close()
+                raise HTTPError(
+                    429,
+                    f"too many concurrent client streams "
+                    f"({self._relay_max}); retry shortly",
+                )
             self._relay_active += 1
             metrics.set_gauge(
                 "nomad.http.relay_sessions_active", self._relay_active
@@ -765,7 +780,11 @@ class HTTPAgentServer:
     def _client_roundtrip(self, alloc, method: str, header: dict) -> dict:
         session = self._client_session(alloc, method, header)
         try:
-            msg = session.recv(timeout_s=30)
+            # short: a one-shot ls/stat against a local file — a slow
+            # client agent must not pin an HTTP worker for 30s
+            msg = session.recv(timeout_s=10)
+        except TimeoutError:
+            raise HTTPError(504, "client agent timed out")
         finally:
             session.close()
         if msg.get("error"):

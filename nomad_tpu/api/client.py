@@ -467,6 +467,9 @@ class Status(_Resource):
     def peers(self):
         return self.c.get("/v1/status/peers")
 
+    def regions(self):
+        return self.c.get("/v1/regions")
+
 
 class ACLAPI(_Resource):
     def bootstrap(self):

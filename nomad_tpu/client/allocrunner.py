@@ -40,7 +40,9 @@ class AllocRunner:
         node=None,
         state_db=None,
         restore: bool = False,
+        client=None,  # the owning Client: prev-alloc lookups + rpc
     ) -> None:
+        self._client = client
         self.alloc = alloc.copy()
         self.drivers = drivers
         self.allocdir = AllocDir(data_dir, alloc.id)
@@ -69,6 +71,25 @@ class AllocRunner:
             self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
             self.on_update(self.alloc)
             return
+        # Sticky/migrate ephemeral disk: inherit the previous alloc's
+        # shared data before any task starts (reference allocwatcher;
+        # restored allocs already own their dir).
+        if (
+            not self.restore
+            and self.alloc.previous_allocation
+            and self._client is not None
+            and (tg.ephemeral_disk.sticky or tg.ephemeral_disk.migrate)
+        ):
+            from .allocwatcher import PrevAllocMigrator
+
+            PrevAllocMigrator(
+                self.alloc,
+                tg,
+                self.allocdir,
+                lambda aid: self._client.alloc_runners.get(aid),
+                rpc=self._client.rpc,
+                secret=self._client.endpoints.rpc.secret,
+            ).run()
         batch = job.type in (JOB_TYPE_BATCH, JOB_TYPE_SYSBATCH)
         restored_states = (
             self.state_db.get_task_states(self.alloc.id)
@@ -100,6 +121,11 @@ class AllocRunner:
                 on_handle=self._on_handle,
                 restore_handle=restore_handle,
                 restore_state=restored_states.get(task.name),
+                device_manager=(
+                    self._client.device_manager
+                    if self._client is not None
+                    else None
+                ),
             )
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():

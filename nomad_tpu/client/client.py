@@ -48,6 +48,16 @@ class ServerRPC:
     def update_allocs(self, allocs: list[Allocation]) -> None:
         self.server.update_allocs_from_client(allocs)
 
+    def alloc_client_addr(self, alloc_id: str):
+        """(alloc, 'host:port' of its node's client fabric) or (None, None)
+        — the prev-alloc migrator's cross-node lookup."""
+        alloc = self.server.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            return None, None
+        node = self.server.state.node_by_id(alloc.node_id)
+        addr = node.attributes.get("unique.client.rpc") if node else None
+        return alloc, addr
+
 
 class Client:
     def __init__(
@@ -82,7 +92,13 @@ class Client:
         host, port = self.endpoints.addr
         self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
         self.drivers = drivers or {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+        # Device plugins: accelerators fingerprint onto the node so the
+        # scheduler's DeviceAllocator has real instances to assign.
+        from .devicemanager import DeviceManager
+
+        self.device_manager = DeviceManager()
         self._fingerprint_drivers()
+        self._fingerprint_devices()
         from ..structs.node_class import compute_node_class
 
         self.node.computed_class = compute_node_class(self.node)
@@ -198,6 +214,20 @@ class Client:
             self.node.attributes.update(fp.attributes)
         return changed
 
+    def _fingerprint_devices(self) -> bool:
+        """Refresh node.resources.devices from the device plugins;
+        True when the device set changed."""
+        devices = self.device_manager.fingerprint()
+        prev = {
+            d.id_string(): [i.id for i in d.instances]
+            for d in self.node.resources.devices
+        }
+        cur = {d.id_string(): [i.id for i in d.instances] for d in devices}
+        if prev == cur:
+            return False
+        self.node.resources.devices = devices
+        return True
+
     def _fingerprint_loop(self) -> None:
         """Periodic re-fingerprint (reference fingerprint.go:31-48 —
         periodic fingerprinters push node updates): drivers can appear
@@ -209,6 +239,7 @@ class Client:
             if self._shutdown.is_set():
                 return
             changed = self._fingerprint_drivers()
+            changed = self._fingerprint_devices() or changed
             dyn = dynamic_attributes(self.data_dir)
             for k, v in dyn.items():
                 if self.node.attributes.get(k) != v:
@@ -289,6 +320,7 @@ class Client:
                         self._alloc_updated,
                         node=self.node,
                         state_db=self.state_db,
+                        client=self,
                     )
                     with self._lock:
                         self.alloc_runners[alloc_id] = runner
@@ -312,6 +344,7 @@ class Client:
                 node=self.node,
                 state_db=self.state_db,
                 restore=True,
+                client=self,
             )
             with self._lock:
                 self.alloc_runners[alloc.id] = runner

@@ -1,0 +1,156 @@
+"""Client-side device plugin framework.
+
+Reference: client/devicemanager/ + plugins/device/ — device plugins
+fingerprint accelerator groups onto the node so the scheduler's
+DeviceAllocator (scheduler/device.py) has real instances to assign, and
+the task runner turns assigned instance ids into the visibility env vars
+the workload expects.
+
+Builtin plugins:
+  * tpu    — detects TPU chips by their /dev/accel* (or /dev/vfio) device
+             files, the tpu-native analog of the reference's nvidia plugin
+  * nvidia — nvidia-smi when present (reference drivers/../nvidia)
+
+The interface is the same Fingerprint/Reserve split as the reference's
+device plugin API; out-of-process plugins can slot in behind it later.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import subprocess
+from typing import Optional
+
+from ..structs.structs import NodeDeviceInstance, NodeDeviceResource
+
+logger = logging.getLogger("nomad_tpu.devicemanager")
+
+
+class DevicePlugin:
+    """One device family's detector (reference plugins/device/device.go)."""
+
+    name = "base"
+
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        raise NotImplementedError
+
+    def env_var(self) -> str:
+        """The visibility variable workloads read for this device type."""
+        return f"NOMAD_DEVICE_{self.name.upper()}"
+
+
+class TPUDevicePlugin(DevicePlugin):
+    """TPU chips appear as /dev/accel<N> (PCI) or /dev/vfio devices."""
+
+    name = "tpu"
+
+    def __init__(self, dev_glob: str = "/dev/accel*") -> None:
+        self.dev_glob = dev_glob
+
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        paths = sorted(glob.glob(self.dev_glob))
+        if not paths:
+            return []
+        instances = [
+            NodeDeviceInstance(id=os.path.basename(p), healthy=True)
+            for p in paths
+        ]
+        return [
+            NodeDeviceResource(
+                vendor="google",
+                type="tpu",
+                name="tpu",
+                instances=instances,
+                attributes={"count": len(instances)},
+            )
+        ]
+
+    def env_var(self) -> str:
+        return "TPU_VISIBLE_DEVICES"
+
+
+class NvidiaDevicePlugin(DevicePlugin):
+    name = "nvidia"
+
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        try:
+            out = subprocess.run(
+                [
+                    "nvidia-smi",
+                    "--query-gpu=uuid,name",
+                    "--format=csv,noheader",
+                ],
+                capture_output=True,
+                timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if out.returncode != 0:
+            return []
+        by_model: dict[str, list[NodeDeviceInstance]] = {}
+        for line in out.stdout.decode(errors="replace").splitlines():
+            parts = [p.strip() for p in line.split(",", 1)]
+            if len(parts) != 2 or not parts[0]:
+                continue
+            by_model.setdefault(parts[1], []).append(
+                NodeDeviceInstance(id=parts[0], healthy=True)
+            )
+        return [
+            NodeDeviceResource(
+                vendor="nvidia", type="gpu", name=model, instances=insts
+            )
+            for model, insts in by_model.items()
+        ]
+
+    def env_var(self) -> str:
+        return "CUDA_VISIBLE_DEVICES"
+
+
+class DeviceManager:
+    """Aggregates plugins for node fingerprinting and task env wiring
+    (reference client/devicemanager/manager.go)."""
+
+    def __init__(self, plugins: Optional[list[DevicePlugin]] = None) -> None:
+        self.plugins = (
+            plugins
+            if plugins is not None
+            else [TPUDevicePlugin(), NvidiaDevicePlugin()]
+        )
+
+    def fingerprint(self) -> list[NodeDeviceResource]:
+        out: list[NodeDeviceResource] = []
+        for plugin in self.plugins:
+            try:
+                out.extend(plugin.fingerprint())
+            except Exception:
+                logger.exception("device plugin %s failed", plugin.name)
+        return out
+
+    def task_env(self, task_resources) -> dict[str, str]:
+        """Visibility env vars for a task's ASSIGNED device instances
+        (the scheduler's DeviceAllocator picked the ids; reference:
+        the nvidia plugin's Reserve returns CUDA_VISIBLE_DEVICES)."""
+        env: dict[str, str] = {}
+        if task_resources is None:
+            return env
+        by_type: dict[str, list[str]] = {}
+        for dev in getattr(task_resources, "devices", []) or []:
+            dev_id = dev.get("id", "")  # vendor/type/name
+            parts = dev_id.split("/")
+            dtype = parts[1] if len(parts) > 1 else dev_id
+            by_type.setdefault(dtype, []).extend(dev.get("device_ids", []))
+        for dtype, ids in by_type.items():
+            plugin = next(
+                (
+                    p
+                    for p in self.plugins
+                    if dtype in (p.name, getattr(p, "type", None))
+                    or (dtype == "gpu" and p.name == "nvidia")
+                ),
+                None,
+            )
+            var = plugin.env_var() if plugin else f"NOMAD_DEVICE_{dtype.upper()}"
+            env[var] = ",".join(ids)
+        return env

@@ -55,7 +55,9 @@ class TaskRunner:
         on_handle: Optional[Callable[[str, dict], None]] = None,
         restore_handle: Optional[dict] = None,
         restore_state: Optional[TaskState] = None,
+        device_manager=None,  # the client's configured DeviceManager
     ) -> None:
+        self.device_manager = device_manager
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -116,6 +118,17 @@ class TaskRunner:
             task_dir=task_dir.local_dir,
             secrets_dir=task_dir.secrets_dir,
         )
+        # Assigned device instances → visibility env vars (the scheduler
+        # picked the ids; reference: device plugin Reserve response).
+        if self.alloc.resources is not None:
+            tr_res = self.alloc.resources.tasks.get(self.task.name)
+            if tr_res is not None and getattr(tr_res, "devices", None):
+                dm = self.device_manager
+                if dm is None:
+                    from .devicemanager import DeviceManager
+
+                    dm = DeviceManager()
+                env.update(dm.task_env(tr_res))
         self._event(EVENT_TASK_SETUP)
 
         # Restore path: reattach to a live task instead of starting a new

@@ -102,6 +102,9 @@ class BatchSolver:
         # Port-accounting index per node, shared across the whole batch so
         # placements in this solve see each other's port reservations.
         self._net_cache: dict[str, NetworkIndex] = {}
+        # Per-node device allocator, shared across the batch (like the
+        # port index above) so placements see each other's reservations.
+        self._dev_cache: dict[str, object] = {}
 
     def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
         out = SolveOutcome()
@@ -575,6 +578,7 @@ class BatchSolver:
             slow = (
                 bool(tg.networks)
                 or any(t.resources.networks for t in tg.tasks)
+                or any(t.resources.devices for t in tg.tasks)
                 or any(r.previous_alloc is not None for r in reqs)
             )
             if slow:
@@ -766,6 +770,35 @@ class BatchSolver:
             net_idx.add_allocs(self.state.allocs_by_node_terminal(node.id, False))
             self._net_cache[node.id] = net_idx
 
+        # Device instance assignment (mirrors rank.py's DeviceAllocator
+        # use on the host path): instances already claimed by live allocs
+        # AND by this batch's placements on the node are excluded.
+        dev_alloc = None
+        if any(t.resources.devices for t in tg.tasks):
+            from ..device import DeviceAllocator
+
+            dev_alloc = self._dev_cache.get(node.id)
+            if dev_alloc is None:
+                dev_alloc = DeviceAllocator(self.ctx, node)
+                dev_alloc.add_allocs(
+                    self.state.allocs_by_node_terminal(node.id, False)
+                )
+                self._dev_cache[node.id] = dev_alloc
+
+        # Track reservations for rollback: the shared per-node caches
+        # outlive this call, so a half-built placement that fails a later
+        # ask must return everything it grabbed or subsequent groups see
+        # phantom usage.
+        granted_offers: list = []
+        granted_devs: list = []
+
+        def _rollback():
+            for offer in granted_offers:
+                net_idx.remove_reserved(offer)
+            if dev_alloc is not None:
+                for got in granted_devs:
+                    dev_alloc.free[got["id"]].update(got["device_ids"])
+
         task_resources: dict[str, AllocatedTaskResources] = {}
         for task in tg.tasks:
             tr = AllocatedTaskResources(
@@ -774,16 +807,30 @@ class BatchSolver:
             for ask in task.resources.networks:
                 offer = net_idx.assign_network(ask)
                 if offer is None:
+                    _rollback()
                     return None
                 net_idx.add_reserved(offer)
+                granted_offers.append(offer)
                 tr.networks.append(offer)
+            for dev_ask in task.resources.devices:
+                # assign() removes the picked ids from the free set, so
+                # the shared per-node allocator naturally serializes the
+                # batch's placements
+                got = dev_alloc.assign(dev_ask) if dev_alloc else None
+                if got is None:
+                    _rollback()
+                    return None  # instances exhausted on this node
+                granted_devs.append(got)
+                tr.devices.append(got)
             task_resources[task.name] = tr
         shared_networks = []
         for ask in tg.networks:
             offer = net_idx.assign_network(ask)
             if offer is None:
+                _rollback()
                 return None
             net_idx.add_reserved(offer)
+            granted_offers.append(offer)
             shared_networks.append(offer)
 
         alloc = Allocation(

@@ -304,6 +304,17 @@ class AllocEndpoint(_Forwarder):
     def list_by_node(self, args):
         return self.cs.server.state.allocs_by_node(args["node_id"])
 
+    def client_addr(self, args):
+        """(alloc, 'host:port' of its node's client fabric) — the
+        prev-alloc migrator's cross-node lookup."""
+        st = self.cs.server.state
+        alloc = st.alloc_by_id(args["alloc_id"])
+        if alloc is None:
+            return None, None
+        node = st.node_by_id(alloc.node_id)
+        addr = node.attributes.get("unique.client.rpc") if node else None
+        return alloc, addr
+
 
 class DeploymentEndpoint(_Forwarder):
     def get(self, args):
@@ -395,6 +406,16 @@ class StatusEndpoint(_Forwarder):
     def leader(self, args):
         addr = self.cs.raft.leader_addr()
         return {"leader": list(addr) if addr else None}
+
+    def regions(self, args):
+        """Distinct regions known via gossip (reference
+        nomad/regions_endpoint.go — federation membership rides serf)."""
+        regions = {self.cs.region}
+        for m in self.cs.serf.members():
+            r = (m.tags or {}).get("region")
+            if r:
+                regions.add(r)
+        return sorted(regions)
 
     def peers(self, args):
         out = [
@@ -552,18 +573,46 @@ class ClusterServer:
         """Park a client-initiated connection until a relay consumes it.
 
         The dispatch thread owns the socket and closes it on return, so
-        the handler blocks on the session's done-event; the consumer sets
-        it from the session's wrapped close()."""
+        while parked it polls the socket for liveness: a readable socket
+        before the entry is CLAIMED means the client hung up (it sends
+        nothing while parked) — prune the entry instead of leaking a
+        thread + fd per reconnect of a flapping client. Once claimed, the
+        handler waits for the relay's close (done)."""
+        import select as _select
         import threading as _t
 
         node_id = header.get("node_id", "")
         if not node_id:
             session.send({"error": "node_id required"})
             return
-        done = _t.Event()
+        entry = {
+            "session": session,
+            "claimed": _t.Event(),
+            "done": _t.Event(),
+        }
         with self._reverse_lock:
-            self._reverse.setdefault(node_id, []).append((session, done))
-        done.wait()
+            self._reverse.setdefault(node_id, []).append(entry)
+        sock = session._sock
+        while not entry["claimed"].is_set():
+            try:
+                readable, _, _ = _select.select([sock], [], [], 0.5)
+            except (OSError, ValueError):
+                readable = [sock]
+            if entry["claimed"].is_set():
+                break  # readable bytes belong to the consumer's exchange
+            if readable:
+                # EOF (or protocol violation) while parked: dead client
+                with self._reverse_lock:
+                    stack = self._reverse.get(node_id)
+                    if stack and entry in stack:
+                        stack.remove(entry)
+                        if not stack:
+                            del self._reverse[node_id]
+                    elif entry["claimed"].is_set():
+                        break  # consumer raced us; let it run
+                session.close()
+                return
+        entry["done"].wait()
 
     def take_reverse_session(self, node_id: str, method: str, header: dict):
         """Open a stream over a connection the client dialed (the NAT
@@ -575,9 +624,13 @@ class ClusterServer:
                 stack = self._reverse.get(node_id)
                 if not stack:
                     return None
-                session, done = stack.pop()
+                entry = stack.pop()
                 if not stack:
                     del self._reverse[node_id]
+                # claim under the lock: the parker's liveness poll must
+                # not mistake the upcoming ack bytes for a dead client
+                entry["claimed"].set()
+            session, done = entry["session"], entry["done"]
             hdr = dict(header)
             hdr["method"] = method
             try:
@@ -605,12 +658,13 @@ class ClusterServer:
     def _close_reverse_sessions(self) -> None:
         with self._reverse_lock:
             parked = [
-                pair for stack in self._reverse.values() for pair in stack
+                entry for stack in self._reverse.values() for entry in stack
             ]
             self._reverse.clear()
-        for session, done in parked:
-            done.set()
-            session.close()
+        for entry in parked:
+            entry["claimed"].set()
+            entry["done"].set()
+            entry["session"].close()
 
     def _handle_exec_stream(self, session, header: dict) -> None:
         """Splice an exec session through to the alloc's client agent."""
@@ -850,3 +904,7 @@ class ClusterRPC:
 
     def update_allocs(self, allocs: list[Allocation]) -> None:
         self._call("Node.update_allocs", {"allocs": allocs})
+
+    def alloc_client_addr(self, alloc_id: str):
+        out = self._call("Alloc.client_addr", {"alloc_id": alloc_id})
+        return tuple(out) if out else (None, None)

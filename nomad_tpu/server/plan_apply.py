@@ -54,6 +54,17 @@ def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
     this same plan (first-come order by node id for determinism)."""
     if not hasattr(snapshot, "volumes_by_name"):
         return set()
+    # Claims held by allocs this plan stops/evicts/replaces don't count
+    # against the new placements (same rule evaluate_node_plan applies to
+    # resource fit): a destructive update of the single writer must not
+    # conflict with its own predecessor.
+    removed: set[str] = set()
+    for allocs in plan.node_update.values():
+        removed.update(a.id for a in allocs)
+    for allocs in plan.node_preemptions.values():
+        removed.update(a.id for a in allocs)
+    for allocs in plan.node_allocation.values():
+        removed.update(a.id for a in allocs)  # in-place updates of selves
     writers: dict[tuple[str, str], int] = {}  # (ns, vol_id) -> new writers
     bad: set[str] = set()
     for node_id in sorted(plan.node_allocation):
@@ -79,9 +90,14 @@ def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
                         VOLUME_ACCESS_SINGLE_WRITER,
                     )
 
+                    live_writers = sum(
+                        1
+                        for c in vol.write_claims()
+                        if c.alloc_id not in removed
+                    )
                     if vol.access_mode == VOLUME_ACCESS_READ_ONLY or (
                         vol.access_mode == VOLUME_ACCESS_SINGLE_WRITER
-                        and (len(vol.write_claims()) + pending) >= 1
+                        and (live_writers + pending) >= 1
                     ):
                         bad.add(node_id)
                     else:

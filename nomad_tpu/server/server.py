@@ -352,7 +352,14 @@ class Server:
         # The replicated apply loop logs-and-continues on FSM errors, so
         # the user-facing in-use refusal must happen here; the store
         # re-checks authoritatively under the raft serialization point.
-        in_use = len(self.state.jobs(name)) + len(self.state.volumes(name))
+        # Terminal jobs pending GC don't count (same rule as the store).
+        from ..structs.structs import JOB_STATUS_DEAD
+
+        in_use = sum(
+            1
+            for j in self.state.jobs(name)
+            if not (j.stop or j.status == JOB_STATUS_DEAD)
+        ) + len(self.state.volumes(name))
         if in_use:
             raise ValueError(f"namespace {name} has {in_use} jobs/volumes")
         self.raft_apply("namespace_delete", name)

@@ -66,6 +66,18 @@ class NetworkIndex:
             )
         return collide
 
+    def remove_reserved(self, net: NetworkResource) -> None:
+        """Undo add_reserved — rollback for a partially-built placement
+        whose later asks failed (the batch solver shares one index per
+        node across the whole solve)."""
+        for port in list(net.reserved_ports) + list(net.dynamic_ports):
+            if port.value:
+                self.used_ports.get(net.ip, set()).discard(port.value)
+        if net.device:
+            self.used_bandwidth[net.device] = max(
+                0, self.used_bandwidth.get(net.device, 0) - net.mbits
+            )
+
     def _add_reserved_port(self, ip: str, port: int) -> bool:
         used = self.used_ports.setdefault(ip, set())
         if port in used:

@@ -1,0 +1,147 @@
+"""Previous-alloc ephemeral disk migration (reference client/allocwatcher):
+sticky data survives same-node replacement; migrate=true streams it across
+nodes over the client fabric."""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ServerRPC
+from nomad_tpu.server import Server
+from nomad_tpu.structs import DrainStrategy
+from nomad_tpu.structs.structs import Resources, Task
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _disk_job(job_id, marker):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.ephemeral_disk.sticky = True
+    tg.ephemeral_disk.migrate = True
+    tg.tasks = [
+        Task(
+            name="keeper",
+            driver="rawexec",
+            config={
+                "command": "/bin/sh",
+                "args": [
+                    "-c",
+                    f"echo {marker} > ${{NOMAD_ALLOC_DIR}}/data/state.txt; "
+                    "sleep 120",
+                ],
+            },
+            resources=Resources(cpu=100, memory_mb=64),
+        )
+    ]
+    return job
+
+
+def _running(server, job):
+    return [
+        a
+        for a in server.state.allocs_by_job(job.namespace, job.id)
+        if a.client_status == "running"
+    ]
+
+
+def test_sticky_disk_survives_destructive_update(tmp_path):
+    """Same-node replacement: the new alloc inherits alloc/data by local
+    move before its tasks start."""
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    client = None
+    try:
+        client = Client(ServerRPC(server), data_dir=str(tmp_path / "c0"))
+        client.start()
+        job = _disk_job("sticky-job", "generation-one")
+        job.datacenters = [client.node.datacenter]
+        server.job_register(job)
+        assert wait_until(lambda: _running(server, job), 20)
+        first = _running(server, job)[0]
+        first_dir = client.alloc_runners[first.id].allocdir.data_dir
+        assert wait_until(
+            lambda: os.path.exists(os.path.join(first_dir, "state.txt")), 10
+        )
+
+        # destructive update (env change): replacement carries
+        # previous_allocation and must inherit the data dir
+        update = job.copy()
+        update.task_groups[0].tasks[0].env = {"GEN": "two"}
+        server.job_register(update)
+        assert wait_until(
+            lambda: any(
+                a.id != first.id and a.previous_allocation == first.id
+                for a in _running(server, job)
+            ),
+            25,
+        ), "replacement alloc should run with previous_allocation set"
+        repl = next(a for a in _running(server, job) if a.id != first.id)
+        new_dir = client.alloc_runners[repl.id].allocdir.data_dir
+        inherited = os.path.join(new_dir, "state.txt")
+        assert os.path.exists(inherited), "sticky data not migrated"
+        assert "generation-one" in open(inherited).read()
+    finally:
+        if client is not None:
+            client.shutdown()
+        server.shutdown()
+
+
+def test_migrate_streams_data_across_nodes(tmp_path):
+    """Drain the first node: the replacement on the second node pulls
+    alloc/data over the client fabric (FS.ls/FS.cat)."""
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    c1 = c2 = None
+    try:
+        c1 = Client(ServerRPC(server), data_dir=str(tmp_path / "c1"))
+        c1.start()
+        assert c1.wait_registered(10)
+        job = _disk_job("migrate-job", "cross-node-data")
+        job.datacenters = [c1.node.datacenter]
+        server.job_register(job)
+        assert wait_until(lambda: _running(server, job), 20)
+        first = _running(server, job)[0]
+        assert first.node_id == c1.node.id
+        first_dir = c1.alloc_runners[first.id].allocdir.data_dir
+        assert wait_until(
+            lambda: os.path.exists(os.path.join(first_dir, "state.txt")), 10
+        )
+
+        c2 = Client(ServerRPC(server), data_dir=str(tmp_path / "c2"))
+        c2.start()
+        assert c2.wait_registered(10)
+
+        server.node_update_drain(
+            c1.node.id, DrainStrategy(deadline_s=60)
+        )
+        assert wait_until(
+            lambda: any(
+                a.node_id == c2.node.id and a.previous_allocation == first.id
+                for a in _running(server, job)
+            ),
+            30,
+        ), "replacement should land on the second node"
+        repl = next(a for a in _running(server, job) if a.node_id == c2.node.id)
+        inherited = os.path.join(
+            c2.alloc_runners[repl.id].allocdir.data_dir, "state.txt"
+        )
+        assert wait_until(lambda: os.path.exists(inherited), 10), (
+            "migrated data not streamed across nodes"
+        )
+        assert "cross-node-data" in open(inherited).read()
+    finally:
+        for c in (c1, c2):
+            if c is not None:
+                c.shutdown()
+        server.shutdown()

@@ -1,0 +1,181 @@
+"""Device plugin framework tests (reference client/devicemanager +
+plugins/device): fingerprint onto the node, schedule instances on both
+backends, and surface visibility env vars to the task."""
+
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.devicemanager import (
+    DeviceManager,
+    DevicePlugin,
+    TPUDevicePlugin,
+)
+from nomad_tpu.structs.structs import (
+    NodeDeviceInstance,
+    NodeDeviceResource,
+    RequestedDevice,
+)
+
+
+class FakeAccelPlugin(DevicePlugin):
+    name = "tpu"
+
+    def __init__(self, n=4):
+        self.n = n
+
+    def fingerprint(self):
+        return [
+            NodeDeviceResource(
+                vendor="google",
+                type="tpu",
+                name="tpu",
+                instances=[
+                    NodeDeviceInstance(id=f"accel{i}", healthy=True)
+                    for i in range(self.n)
+                ],
+            )
+        ]
+
+    def env_var(self):
+        return "TPU_VISIBLE_DEVICES"
+
+
+def test_tpu_plugin_fingerprints_dev_files(tmp_path):
+    for i in range(3):
+        (tmp_path / f"accel{i}").touch()
+    plugin = TPUDevicePlugin(dev_glob=str(tmp_path / "accel*"))
+    groups = plugin.fingerprint()
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.id_string() == "google/tpu/tpu"
+    assert [i.id for i in g.instances] == ["accel0", "accel1", "accel2"]
+
+
+def test_manager_task_env_maps_assigned_ids():
+    from nomad_tpu.structs.structs import AllocatedTaskResources
+
+    mgr = DeviceManager(plugins=[FakeAccelPlugin()])
+    tr = AllocatedTaskResources(
+        cpu=100,
+        memory_mb=64,
+        devices=[{"id": "google/tpu/tpu", "device_ids": ["accel1", "accel3"]}],
+    )
+    env = mgr.task_env(tr)
+    assert env["TPU_VISIBLE_DEVICES"] == "accel1,accel3"
+
+
+def _device_node():
+    n = mock.node()
+    n.resources.devices = FakeAccelPlugin(4).fingerprint()
+    return n
+
+
+def _device_job(job_id, count=1, device_count=2):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.networks = []
+    tg.tasks[0].resources.devices = [
+        RequestedDevice(name="tpu", count=device_count)
+    ]
+    return job
+
+
+@pytest.mark.parametrize("backend", ["host", "tpu"])
+def test_scheduler_assigns_device_instances(backend):
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.testing import Harness
+
+    h = Harness()
+    h.state.upsert_node(h.next_index(), _device_node())
+    job = _device_job("dev-job", count=2, device_count=2)
+    h.state.upsert_job(h.next_index(), job)
+    cfg = SchedulerConfig(backend=backend)
+    h.process(job.type, mock.eval_for_job(job), cfg)
+
+    allocs = [
+        a
+        for a in h.state.allocs_by_job(job.namespace, job.id)
+        if not a.terminal_status()
+    ]
+    assert len(allocs) == 2
+    seen: set[str] = set()
+    for a in allocs:
+        devs = a.resources.tasks["web"].devices
+        assert len(devs) == 1 and len(devs[0]["device_ids"]) == 2
+        ids = set(devs[0]["device_ids"])
+        assert not (ids & seen), "instances double-assigned"
+        seen |= ids
+    assert len(seen) == 4
+
+
+def test_device_env_reaches_task(tmp_path):
+    """Full stack: device job through server + client with a fake device
+    plugin; the task sees TPU_VISIBLE_DEVICES."""
+    import os
+
+    from nomad_tpu.client import Client, ServerRPC
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs.structs import Resources, Task
+
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    client = None
+    try:
+        client = Client(ServerRPC(server), data_dir=str(tmp_path / "c0"))
+        client.device_manager = DeviceManager(plugins=[FakeAccelPlugin(2)])
+        assert client._fingerprint_devices()
+        client.start()
+        assert client.wait_registered(10)
+        node = server.state.node_by_id(client.node.id)
+        assert node.resources.devices, "devices should fingerprint"
+
+        job = _device_job("env-dev", count=1, device_count=2)
+        job.datacenters = [client.node.datacenter]
+        job.task_groups[0].tasks = [
+            Task(
+                name="web",
+                driver="rawexec",
+                config={
+                    "command": "/bin/sh",
+                    "args": [
+                        "-c",
+                        "echo DEVS=$TPU_VISIBLE_DEVICES > "
+                        "${NOMAD_ALLOC_DIR}/data/devs.txt; sleep 60",
+                    ],
+                },
+                resources=Resources(
+                    cpu=100,
+                    memory_mb=64,
+                    devices=[RequestedDevice(name="tpu", count=2)],
+                ),
+            )
+        ]
+        server.job_register(job)
+
+        def running():
+            return [
+                a
+                for a in server.state.allocs_by_job(job.namespace, job.id)
+                if a.client_status == "running"
+            ]
+
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not running():
+            time.sleep(0.1)
+        assert running(), "device job should run"
+        alloc = running()[0]
+        out = os.path.join(
+            client.alloc_runners[alloc.id].allocdir.data_dir, "devs.txt"
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not os.path.exists(out):
+            time.sleep(0.1)
+        content = open(out).read()
+        assert "DEVS=accel0,accel1" in content, content
+    finally:
+        if client is not None:
+            client.shutdown()
+        server.shutdown()

@@ -93,3 +93,22 @@ def test_namespace_http_surface(tmp_path):
         assert e.value.status == 404
     finally:
         agent.shutdown()
+
+
+def test_regions_endpoint(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        assert api.status.regions() == ["global"]
+    finally:
+        agent.shutdown()
