@@ -63,6 +63,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
 
 _NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]
 _NODE_WRITE = [("PUT", re.compile(r"^/v1/node/.*$")), ("POST", re.compile(r"^/v1/node/.*$"))]
+# pprof dumps internal state and can occupy handler threads for seconds:
+# agent:write, like the reference (command/agent/agent_endpoint.go
+# AgentPprofRequest). Checked BEFORE the broader agent-read rule.
+_AGENT_WRITE = [("GET", re.compile(r"^/v1/agent/pprof/.*$"))]
 _AGENT_READ = [
     ("GET", re.compile(r"^/v1/agent/.*$")),
     ("GET", re.compile(r"^/v1/metrics$")),
@@ -163,6 +167,11 @@ def make_http_resolver(server, enabled: bool = True):
             if m == method and pat.match(path):
                 if not acl.allow_node_read():
                     raise AuthError(403, "node read denied")
+                return
+        for m, pat in _AGENT_WRITE:
+            if m == method and pat.match(path):
+                if not acl.allow_agent_write():
+                    raise AuthError(403, "agent write denied")
                 return
         for m, pat in _AGENT_READ:
             if m == method and pat.match(path):

@@ -83,6 +83,8 @@ class AgentConfig:
     rpc_secret: str = ""
     # dev mode: in-memory raft (the reference's -dev is ephemeral too)
     dev_mode: bool = False
+    # pprof surface (reference enable_debug: off unless dev mode)
+    enable_debug: bool = False
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -173,6 +175,7 @@ class Agent:
                 host=config.bind_addr,
                 port=config.http_port,
                 acl_resolver=resolver,
+                enable_debug=config.enable_debug or config.dev_mode,
             )
 
     def start(self) -> None:

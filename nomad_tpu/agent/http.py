@@ -60,10 +60,12 @@ class HTTPAgentServer:
         host: str = "127.0.0.1",
         port: int = 0,
         acl_resolver=None,  # installed by the ACL layer (nomad_tpu/acl)
+        enable_debug: bool = True,
     ) -> None:
         self.cluster = cluster
         self.client = client
         self.acl_resolver = acl_resolver
+        self.enable_debug = enable_debug
         self._relay_lock = threading.Lock()
         self._relay_active = 0
         # Cap concurrent client-relay sessions: each one ties up an HTTP
@@ -499,6 +501,33 @@ class HTTPAgentServer:
         def regions_list(p, q, body, tok):
             return self.cluster.rpc_self("Status.regions", {})
 
+        def _debug_gate():
+            # reference: pprof 404s unless enable_debug (agent http.go)
+            if not self.enable_debug:
+                raise HTTPError(404, "debug endpoints disabled")
+
+        def pprof_goroutine(p, q, body, tok):
+            from . import debug as _debug
+
+            _debug_gate()
+            return {"profile": _debug.thread_dump()}
+
+        def pprof_profile(p, q, body, tok):
+            from . import debug as _debug
+
+            _debug_gate()
+            try:
+                seconds = float(q.get("seconds", ["2"])[0])
+            except ValueError:
+                raise HTTPError(400, "seconds must be a number")
+            return {"profile": _debug.cpu_profile(seconds)}
+
+        def pprof_heap(p, q, body, tok):
+            from . import debug as _debug
+
+            _debug_gate()
+            return _debug.heap_summary()
+
         def agent_metrics(p, q, body, tok):
             # reference: /v1/metrics (command/agent/http.go MetricsRequest,
             # behind agent:read / AgentReadACL)
@@ -641,6 +670,11 @@ class HTTPAgentServer:
         route("GET", "/v1/status/peers", status_peers)
         route("GET", "/v1/regions", regions_list)
         route("GET", "/v1/metrics", agent_metrics)
+        # pprof analogs (reference command/agent/pprof, behind agent:read
+        # via the /v1/agent/ ACL prefix)
+        route("GET", "/v1/agent/pprof/goroutine", pprof_goroutine)
+        route("GET", "/v1/agent/pprof/profile", pprof_profile)
+        route("GET", "/v1/agent/pprof/heap", pprof_heap)
         route("GET", "/v1/agent/members", agent_members)
         route("GET", "/v1/agent/self", agent_self)
         route("GET", "/v1/agent/health", agent_health)

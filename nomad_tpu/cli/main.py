@@ -927,6 +927,33 @@ def cmd_volume_deregister(args) -> int:
     return 0
 
 
+def cmd_operator_debug(args) -> int:
+    """Reference: command/operator_debug.go — capture a support bundle
+    (cluster state, metrics, thread dumps) into an archive."""
+    import json as _json
+    import tarfile
+    import time as _time
+
+    from .. import codec
+    from ..agent.debug import debug_bundle
+
+    api = _client(args)
+    bundle = debug_bundle(api)
+    out = args.output or f"nomad-debug-{_time.strftime('%Y%m%d-%H%M%S')}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        for name, payload in bundle.items():
+            data = _json.dumps(
+                codec.to_wire(payload), indent=2, default=codec.json_default
+            ).encode()
+            info = tarfile.TarInfo(name=f"debug/{name}.json")
+            info.size = len(data)
+            import io as _io
+
+            tar.addfile(info, _io.BytesIO(data))
+    print(f"Debug capture written to {out}")
+    return 0
+
+
 def cmd_operator_metrics(args) -> int:
     """Reference: command/operator_metrics.go — dump agent telemetry."""
     import json as _json
@@ -1221,6 +1248,9 @@ def build_parser() -> argparse.ArgumentParser:
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)
+    opdbg = opsub.add_parser("debug")
+    opdbg.add_argument("-output", default="")
+    opdbg.set_defaults(fn=cmd_operator_debug)
 
     st = sub.add_parser("status", help="list jobs")
     st.add_argument("job_id", nargs="?")

@@ -9,6 +9,7 @@ from .base import (
 )
 from .docker import DockerDriver
 from .exec import ExecDriver
+from .java import JavaDriver
 from .mock import MockDriver
 from .rawexec import RawExecDriver
 
@@ -17,6 +18,7 @@ BUILTIN_DRIVERS = {
     "rawexec": RawExecDriver,
     "exec": ExecDriver,
     "docker": DockerDriver,
+    "java": JavaDriver,
 }
 
 

@@ -112,3 +112,81 @@ def test_regions_endpoint(tmp_path):
         assert api.status.regions() == ["global"]
     finally:
         agent.shutdown()
+
+
+def test_pprof_and_debug_surface(tmp_path):
+    """pprof analogs + operator debug bundle (reference command/agent/
+    pprof + operator_debug.go)."""
+    import json
+    import tarfile
+    from types import SimpleNamespace
+
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.agent.debug import debug_bundle
+    from nomad_tpu.api.client import NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        threads = api.get("/v1/agent/pprof/goroutine")["profile"]
+        assert "rpc" in threads  # the fabric's worker threads show up
+        heap = api.get("/v1/agent/pprof/heap")
+        assert heap["gc_objects"] > 0 and heap["threads"] > 1
+        prof = api.get("/v1/agent/pprof/profile", params={"seconds": "0.2"})
+        assert "cumulative" in prof["profile"]
+
+        bundle = debug_bundle(api)
+        for key in ("agent_self", "metrics", "nodes", "threads", "heap"):
+            assert key in bundle, f"bundle missing {key}"
+            assert not (
+                isinstance(bundle[key], dict) and "error" in bundle[key]
+            ), f"bundle {key} errored: {bundle[key]}"
+
+        # the CLI path: archive assembly + wire-lowering of every payload
+        from nomad_tpu.cli.main import cmd_operator_debug
+
+        out = str(tmp_path / "bundle.tar.gz")
+        rc = cmd_operator_debug(
+            SimpleNamespace(
+                address=f"http://127.0.0.1:{agent.http_addr[1]}",
+                token="",
+                output=out,
+            )
+        )
+        assert rc == 0
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+            assert "debug/metrics.json" in names
+            data = json.load(tar.extractfile("debug/metrics.json"))
+            assert "gauges" in data
+    finally:
+        agent.shutdown()
+
+
+def test_pprof_disabled_outside_debug_mode(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import APIError, NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = False
+    cfg.enable_debug = False
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        with pytest.raises(APIError) as e:
+            api.get("/v1/agent/pprof/goroutine")
+        assert e.value.status == 404
+    finally:
+        agent.shutdown()
