@@ -42,9 +42,9 @@ CAVEATS = [
     "smoke measures single-eval latency, where the TPU device round-trip "
     "(~0.15s here, through a tunnel) dominates; the TPU backend is a "
     "batch-throughput design",
-    "drain config: system/sysbatch evals run the host scheduler even under "
-    "the TPU backend (documented fallback); the TPU column covers the "
-    "service evals plus that host-side system work",
+    "drain config: service evals run the batched solver; the system eval "
+    "runs the TPU backend's vectorized system scheduler (one lowered "
+    "feasibility+capacity pass, per-node fallback for ports/devices)",
 ]
 
 
@@ -296,7 +296,10 @@ def run_drain_config():
                 evs.append(m.eval_for_job(job, triggered_by="node-update"))
         return evs, m.eval_for_job(sysjob, triggered_by="node-update")
 
-    # TPU path (system eval runs the host SystemScheduler — see caveats)
+    # TPU path: batched solve for services, vectorized system scheduler
+    from nomad_tpu.scheduler.context import SchedulerConfig
+
+    tpu_cfg = SchedulerConfig(backend="tpu")
     h, svcs, sysjob = build()
     drained = drain_nodes(h)
     evs, sysev = drain_evals(h, svcs, sysjob, drained)
@@ -306,7 +309,7 @@ def run_drain_config():
     plans = solve_eval_batch(h.snapshot(), h, evs)
     for ev in evs:
         h.submit_plan(plans[ev.id])
-    h.process("system", sysev)
+    h.process("system", sysev, tpu_cfg)
     tpu_dt = time.perf_counter() - t0
     n_evals = len(evs) + 1
     tpu_rate = n_evals / tpu_dt

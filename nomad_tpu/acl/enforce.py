@@ -59,6 +59,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
     ("GET", re.compile(r"^/v1/volume/.*$"), CAP_READ_JOB),
     ("DELETE", re.compile(r"^/v1/volume/.*$"), CAP_SUBMIT_JOB),
+    # search reads cluster objects (reference search_endpoint ACL: the
+    # per-context capability; read-job is the broadest gate here)
+    ("PUT", re.compile(r"^/v1/search(/fuzzy)?$"), CAP_READ_JOB),
+    ("POST", re.compile(r"^/v1/search(/fuzzy)?$"), CAP_READ_JOB),
 ]
 
 _NODE_READ = [("GET", re.compile(r"^/v1/nodes$")), ("GET", re.compile(r"^/v1/node/.*$"))]
@@ -136,6 +140,14 @@ def make_http_resolver(server, enabled: bool = True):
             try:
                 job = _json.loads(body).get("Job") or {}
                 ns = job.get("namespace") or ns
+            except Exception:
+                pass
+        # Search: the body names the namespace being searched.
+        if path.startswith("/v1/search") and method in ("PUT", "POST") and body:
+            import json as _json
+
+            try:
+                ns = _json.loads(body).get("Namespace") or ns
             except Exception:
                 pass
         # Volume registration: same body-namespace rule as job register.

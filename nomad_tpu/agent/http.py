@@ -60,7 +60,7 @@ class HTTPAgentServer:
         host: str = "127.0.0.1",
         port: int = 0,
         acl_resolver=None,  # installed by the ACL layer (nomad_tpu/acl)
-        enable_debug: bool = True,
+        enable_debug: bool = False,  # pprof off unless opted in (reference)
     ) -> None:
         self.cluster = cluster
         self.client = client
@@ -198,6 +198,67 @@ class HTTPAgentServer:
             ns = q.get("namespace", ["default"])[0]
             return srv.state.job_versions(ns, p["id"])
 
+        def _search_ns(q, body) -> str:
+            # MUST mirror the ACL resolver's derivation (body wins, then
+            # query): authorizing one namespace and searching another
+            # would leak ids.
+            return (
+                body.get("Namespace")
+                or q.get("namespace", ["default"])[0]
+            )
+
+        def _filter_search(result, tok):
+            """Cluster-scoped contexts need their own capabilities
+            (reference search_endpoint.go sufficientSearchPerms): nodes
+            require node:read; the namespaces list shrinks to ones the
+            token holds any job capability on."""
+            acl = self._acl_for(tok)
+            if acl is None:  # enforcement off or management token
+                return result
+            matches = result.get("Matches") or {}
+            if not acl.allow_node_read():
+                matches.pop("nodes", None)
+                result.get("Truncations", {}).pop("nodes", None)
+            if "namespaces" in matches:
+                def visible(name):
+                    n = name["ID"] if isinstance(name, dict) else name
+                    return acl.allow_namespace_op(
+                        n, "list-jobs"
+                    ) or acl.allow_namespace_op(n, "read-job")
+
+                kept = [n for n in matches["namespaces"] if visible(n)]
+                if kept:
+                    matches["namespaces"] = kept
+                else:
+                    matches.pop("namespaces", None)
+            return result
+
+        def search(p, q, body, tok):
+            return _filter_search(
+                self.cluster.rpc_self(
+                    "Search.prefix",
+                    {
+                        "prefix": body.get("Prefix", ""),
+                        "context": body.get("Context", "all"),
+                        "namespace": _search_ns(q, body),
+                    },
+                ),
+                tok,
+            )
+
+        def search_fuzzy(p, q, body, tok):
+            return _filter_search(
+                self.cluster.rpc_self(
+                    "Search.fuzzy",
+                    {
+                        "text": body.get("Text", ""),
+                        "context": body.get("Context", "all"),
+                        "namespace": _search_ns(q, body),
+                    },
+                ),
+                tok,
+            )
+
         def namespaces_list(p, q, body, tok):
             return self.cluster.rpc_self("Namespace.list", {})
 
@@ -322,6 +383,10 @@ class HTTPAgentServer:
         route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
         route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
         route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        route("PUT", "/v1/search", search)
+        route("POST", "/v1/search", search)
+        route("PUT", "/v1/search/fuzzy", search_fuzzy)
+        route("POST", "/v1/search/fuzzy", search_fuzzy)
         route("GET", "/v1/namespaces", namespaces_list)
         route("PUT", "/v1/namespaces", namespace_upsert)
         route("POST", "/v1/namespaces", namespace_upsert)

@@ -49,6 +49,7 @@ class NomadClient:
         self.operator = Operator(self)
         self.volumes = Volumes(self)
         self.namespaces = Namespaces(self)
+        self.search = Search(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -385,6 +386,30 @@ class Deployments(_Resource):
 
     def fail(self, deployment_id: str):
         return self.c.put(f"/v1/deployment/fail/{deployment_id}")
+
+
+class Search(_Resource):
+    def prefix(self, prefix: str, context: str = "all",
+               namespace: Optional[str] = None):
+        return self.c.put(
+            "/v1/search",
+            body={
+                "Prefix": prefix,
+                "Context": context,
+                "Namespace": namespace or self.c.namespace,
+            },
+        )
+
+    def fuzzy(self, text: str, context: str = "all",
+              namespace: Optional[str] = None):
+        return self.c.put(
+            "/v1/search/fuzzy",
+            body={
+                "Text": text,
+                "Context": context,
+                "Namespace": namespace or self.c.namespace,
+            },
+        )
 
 
 class Namespaces(_Resource):

@@ -1023,7 +1023,40 @@ def cmd_server_members(args) -> int:
 
 
 def cmd_status(args) -> int:
-    return cmd_job_status(args)
+    """Reference command/status.go: a bare id resolves by prefix search
+    across every context; unambiguous hits print the object's status."""
+    if not args.job_id:
+        return cmd_job_status(args)
+    api = _client(args)
+    try:
+        result = api.search.prefix(args.job_id)
+    except APIError:
+        return cmd_job_status(args)
+    matches = result.get("Matches") or {}
+    flat = [(ctx, i) for ctx, ids in matches.items() for i in ids]
+    if not flat:
+        print(f'No matches for "{args.job_id}"')
+        return 1
+    if len(flat) > 1:
+        print(f'Multiple matches for "{args.job_id}":\n')
+        for ctx, ident in flat:
+            print(f"  {ctx[:-1] if ctx.endswith('s') else ctx}: {ident}")
+        return 1
+    ctx, ident = flat[0]
+    args.job_id = ident
+    if ctx == "jobs":
+        return cmd_job_status(args)
+    if ctx == "nodes":
+        args.node_id = ident
+        return cmd_node_status(args)
+    if ctx == "allocs":
+        args.alloc_id = ident
+        return cmd_alloc_status(args)
+    if ctx == "evals":
+        args.eval_id = ident
+        return cmd_eval_status(args)
+    print(f"{ctx[:-1]}: {ident}")
+    return 0
 
 
 def cmd_version(args) -> int:

@@ -32,15 +32,17 @@ def _tpu_factories():
     # Imported lazily so the control plane never pays the jax import unless
     # the TPU backend is actually selected.
     from .tpu import TPUBatchScheduler, TPUGenericScheduler
+    from .tpu.system import TPUSysbatchScheduler, TPUSystemScheduler
 
     return {
         "service": TPUGenericScheduler,
         "batch": TPUBatchScheduler,
-        # system/sysbatch place per node, not per count — the host path is
-        # already O(nodes); they keep the host implementation under the TPU
-        # backend (same decision as the reference's per-type scheduler split).
-        "system": SystemScheduler,
-        "sysbatch": SysBatchScheduler,
+        # system/sysbatch vectorize the per-node walk into one lowered
+        # feasibility + capacity pass (falling back per node only for
+        # ports/devices/preemption) — drain-churn loads no longer run
+        # half host-bound under the TPU backend.
+        "system": TPUSystemScheduler,
+        "sysbatch": TPUSysbatchScheduler,
     }
 
 

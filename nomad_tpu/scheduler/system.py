@@ -137,6 +137,9 @@ class SystemScheduler:
             diff.place.append((tg, self.state.node_by_id(alloc.node_id), None))
 
         queued: dict[str, int] = {tg.name: 0 for tg in job.task_groups}
+        # group the per-node placements by task group: the TPU subclass
+        # vectorizes each group across its nodes in one pass
+        by_tg: dict[str, tuple] = {}
         for tg, node, terminal in diff.place:
             if node is None:
                 continue
@@ -148,43 +151,58 @@ class SystemScheduler:
                 and terminal.job.version == job.version
             ):
                 continue  # already ran to completion on this node
-            metric = AllocMetric(nodes_available=dict(self._dc_counts))
-            start = now_ns()
-            option = stack.select(tg, node, metrics=metric)
-            if option is None and self.config.preemption_enabled(job.type):
-                option = stack.select(tg, node, metrics=metric, evict=True)
-            metric.allocation_time_ns = now_ns() - start
-            if option is None:
-                existing = self.failed_tg_allocs.get(tg.name)
-                if existing is not None:
-                    existing.coalesced_failures += 1
-                else:
-                    self.failed_tg_allocs[tg.name] = metric
-                queued[tg.name] = queued.get(tg.name, 0) + 1
-                continue
-            alloc = Allocation(
-                id=generate_uuid(),
-                namespace=eval_obj.namespace,
-                eval_id=eval_obj.id,
-                name=f"{job.id}.{tg.name}[0]",
-                node_id=node.id,
-                node_name=node.name,
-                job_id=job.id,
-                job=job,
-                task_group=tg.name,
-                resources=option.alloc_resources,
-                metrics=metric,
-            )
-            if option.preempted_allocs:
-                alloc.preempted_allocations = [
-                    p.id for p in option.preempted_allocs
-                ]
-                for p in option.preempted_allocs:
-                    self.plan.append_preempted_alloc(p, alloc.id)
-            self.plan.append_alloc(alloc, job)
+            entry = by_tg.setdefault(tg.name, (tg, []))
+            entry[1].append(node)
+        for tg, nodes in by_tg.values():
+            self._place_group(job, eval_obj, stack, tg, nodes, queued)
         self.queued_allocs = queued
         eval_obj.queued_allocations = queued
         return self._finish()
+
+    def _place_group(self, job, eval_obj, stack, tg, nodes, queued) -> None:
+        """Place one instance of tg on each node (per-node iterator walk;
+        the TPU backend overrides this with a vectorized pass)."""
+        for node in nodes:
+            self._place_one(job, eval_obj, stack, tg, node, queued)
+
+    def _place_one(self, job, eval_obj, stack, tg, node, queued) -> None:
+        metric = AllocMetric(nodes_available=dict(self._dc_counts))
+        start = now_ns()
+        option = stack.select(tg, node, metrics=metric)
+        if option is None and self.config.preemption_enabled(job.type):
+            option = stack.select(tg, node, metrics=metric, evict=True)
+        metric.allocation_time_ns = now_ns() - start
+        if option is None:
+            self._record_failure(tg, metric, queued)
+            return
+        alloc = Allocation(
+            id=generate_uuid(),
+            namespace=eval_obj.namespace,
+            eval_id=eval_obj.id,
+            name=f"{job.id}.{tg.name}[0]",
+            node_id=node.id,
+            node_name=node.name,
+            job_id=job.id,
+            job=job,
+            task_group=tg.name,
+            resources=option.alloc_resources,
+            metrics=metric,
+        )
+        if option.preempted_allocs:
+            alloc.preempted_allocations = [
+                p.id for p in option.preempted_allocs
+            ]
+            for p in option.preempted_allocs:
+                self.plan.append_preempted_alloc(p, alloc.id)
+        self.plan.append_alloc(alloc, job)
+
+    def _record_failure(self, tg, metric, queued) -> None:
+        existing = self.failed_tg_allocs.get(tg.name)
+        if existing is not None:
+            existing.coalesced_failures += 1
+        else:
+            self.failed_tg_allocs[tg.name] = metric
+        queued[tg.name] = queued.get(tg.name, 0) + 1
 
     def _finish(self) -> tuple[bool, object]:
         if self.plan.is_no_op():

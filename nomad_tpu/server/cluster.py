@@ -166,6 +166,30 @@ class JobEndpoint(_Forwarder):
         )
 
 
+class SearchEndpoint(_Forwarder):
+    """Reference: nomad/search_endpoint.go."""
+
+    def prefix(self, args):
+        from .search import prefix_search
+
+        return prefix_search(
+            self.cs.server.state,
+            args.get("prefix", ""),
+            args.get("context", "all"),
+            args.get("namespace", "default"),
+        )
+
+    def fuzzy(self, args):
+        from .search import fuzzy_search
+
+        return fuzzy_search(
+            self.cs.server.state,
+            args.get("text", ""),
+            args.get("context", "all"),
+            args.get("namespace", "default"),
+        )
+
+
 class NamespaceEndpoint(_Forwarder):
     """Reference: nomad/namespace_endpoint.go."""
 
@@ -505,6 +529,7 @@ class ClusterServer:
             ("Alloc", AllocEndpoint(self)),
             ("Volume", VolumeEndpoint(self)),
             ("Namespace", NamespaceEndpoint(self)),
+            ("Search", SearchEndpoint(self)),
             ("Deployment", DeploymentEndpoint(self)),
             ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),

@@ -127,7 +127,8 @@ class TestClusterRestart:
                 )
                 == 3
                 if _leader(servers)
-                else False
+                else False,
+                30,  # full-suite load can slow elections + placement
             ), "allocs never placed"
         finally:
             pool.shutdown()

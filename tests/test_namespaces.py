@@ -190,3 +190,83 @@ def test_pprof_disabled_outside_debug_mode(tmp_path):
         assert e.value.status == 404
     finally:
         agent.shutdown()
+
+
+def test_search_endpoints(tmp_path):
+    """Prefix + fuzzy search (reference nomad/search_endpoint.go)."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        srv = agent.server.server
+        for _ in range(2):
+            srv.node_register(mock.node())
+        job = mock.job(id="search-target")
+        srv.job_register(job)
+        srv.wait_for_evals(10)
+
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        out = api.search.prefix("search-")
+        assert out["Matches"]["jobs"] == ["search-target"]
+        # alloc ids are uuids; nothing prefix-matches "search-"
+        assert "allocs" not in out["Matches"]
+
+        out = api.search.prefix("search-", context="jobs")
+        assert list(out["Matches"].keys()) == ["jobs"]
+
+        fz = api.search.fuzzy("web")  # the mock job's group/task name
+        hits = fz["Matches"]["jobs"]
+        scopes = {tuple(h["Scope"]) for h in hits}
+        assert ("default", "search-target") in scopes
+    finally:
+        agent.shutdown()
+
+
+def test_search_is_namespace_scoped(tmp_path):
+    """Search must not leak other namespaces' eval/alloc ids (reference
+    search_endpoint.go per-namespace filtering)."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import NomadClient
+    from nomad_tpu.structs.structs import Namespace
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        srv = agent.server.server
+        srv.node_register(mock.node())
+        srv.namespace_upsert(Namespace(name="other"))
+        job = mock.job(id="scoped-job")
+        job.namespace = "other"
+        srv.job_register(job)
+        srv.wait_for_evals(10)
+        other_allocs = srv.state.allocs_by_job("other", job.id)
+        assert other_allocs
+
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        # searching the DEFAULT namespace with an empty prefix must not
+        # surface other-namespace evals/allocs/jobs
+        out = api.search.prefix("", namespace="default")
+        assert "scoped-job" not in out["Matches"].get("jobs", [])
+        leaked = set(out["Matches"].get("allocs", [])) & {
+            a.id for a in other_allocs
+        }
+        assert not leaked
+        # but searching the right namespace finds them
+        out = api.search.prefix("scoped-", namespace="other")
+        assert out["Matches"]["jobs"] == ["scoped-job"]
+    finally:
+        agent.shutdown()

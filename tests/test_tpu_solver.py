@@ -542,3 +542,85 @@ def test_sharded_preempt_end_to_end_solver():
     h.submit_plan(plan)
     assert len(live(h, hi)) == 8
     assert preempted == 8, f"expected 8 preemptions, got {preempted}"
+
+
+def test_diff_system_scheduler_matches_host():
+    """TPU system scheduler (vectorized feasibility+capacity pass) places
+    the same node set as the host per-node walk."""
+    from nomad_tpu.structs import Constraint
+
+    def build(h):
+        # a third of the nodes fail a constraint, a third are full
+        for i in range(24):
+            n = mock.node()
+            if i % 3 == 1:
+                n.attributes["role"] = "excluded"
+                n.computed_class = compute_node_class(n)
+            h.state.upsert_node(h.next_index(), n)
+            if i % 3 == 2:
+                filler = mock.alloc(node_=n)
+                filler.resources.tasks["web"].cpu = n.resources.cpu
+                h.state.upsert_allocs(h.next_index(), [filler])
+        job = mock.system_job(id="sysdiff")
+        job.constraints.append(Constraint("${attr.role}", "excluded", "!="))
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.cpu = 500
+        tg.tasks[0].resources.memory_mb = 64
+        tg.tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        return job
+
+    placed = {}
+    for backend in ("host", "tpu"):
+        h = Harness()
+        job = build(h)
+        h.process("system", mock.eval_for_job(job), SchedulerConfig(backend=backend))
+        placed[backend] = {
+            h.state.node_by_id(a.node_id).attributes.get("role", "")
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        }, len(
+            [
+                a
+                for a in h.state.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            ]
+        )
+    assert placed["host"] == placed["tpu"]
+    assert placed["tpu"][1] > 0
+    assert "excluded" not in placed["tpu"][0]
+
+
+def test_tpu_system_two_groups_share_capacity():
+    """A second task group of the same system eval must see the first
+    group's in-plan placements (regression: plan-blind node table made
+    both groups claim the same capacity and the applier rejected all)."""
+    results = {}
+    for backend in ("host", "tpu"):
+        h = Harness()
+        fill_nodes(h, 12)  # 4000 cpu each
+        job = mock.system_job(id="two-groups")
+        tg1 = job.task_groups[0]
+        tg1.tasks[0].resources.cpu = 2500
+        tg1.tasks[0].resources.memory_mb = 64
+        tg1.tasks[0].resources.networks = []
+        tg2 = tg1.copy()
+        tg2.name = "second"
+        tg2.tasks[0].name = "second-task"
+        job.task_groups.append(tg2)
+        h.state.upsert_job(h.next_index(), job)
+        h.process("system", mock.eval_for_job(job),
+                  SchedulerConfig(backend=backend))
+        live_allocs = [
+            a
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        per_group = {}
+        for a in live_allocs:
+            per_group[a.task_group] = per_group.get(a.task_group, 0) + 1
+        results[backend] = per_group
+    # only one 2500-cpu group fits per 4000-cpu node; one group fills all
+    # 12 nodes, the other places nowhere — and backends agree
+    assert results["host"] == results["tpu"], results
+    assert sorted(results["tpu"].values()) == [12], results
