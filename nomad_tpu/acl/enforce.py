@@ -59,6 +59,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
     ("GET", re.compile(r"^/v1/volume/.*$"), CAP_READ_JOB),
     ("DELETE", re.compile(r"^/v1/volume/.*$"), CAP_SUBMIT_JOB),
+    # CSI plugin health rides the volume read gate (reference
+    # csi_endpoint.go: plugin list/read allowed with namespace read)
+    ("GET", re.compile(r"^/v1/plugins$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/plugin/csi/.*$"), CAP_READ_JOB),
     # search reads cluster objects (reference search_endpoint ACL: the
     # per-context capability; read-job is the broadest gate here)
     ("PUT", re.compile(r"^/v1/search(/fuzzy)?$"), CAP_READ_JOB),

@@ -51,6 +51,11 @@ class InProcessClusterRPC:
     def update_allocs(self, allocs) -> None:
         self.cluster.rpc_self("Node.update_allocs", {"allocs": allocs})
 
+    def volumes_for_alloc(self, alloc_id: str) -> list:
+        return self.cluster.rpc_self(
+            "Volume.for_alloc", {"alloc_id": alloc_id}
+        )
+
 
 @dataclass
 class AgentConfig:
@@ -69,6 +74,8 @@ class AgentConfig:
     client_enabled: bool = False
     client_servers: list = field(default_factory=list)  # [(host, port)]
     node_class: str = ""
+    # CSI plugins: plugin_id -> builtin catalog name | "module:Class" ref
+    csi_plugins: dict = field(default_factory=dict)
     # http
     http_port: int = 0  # reference default 4646
     # scheduler
@@ -160,6 +167,7 @@ class Agent:
                 node_class=config.node_class,
                 rpc_secret=config.rpc_secret,
                 advertise_host=config.bind_addr,
+                csi_plugins=config.csi_plugins,
             )
         if self.server is not None:
             from .http import HTTPAgentServer

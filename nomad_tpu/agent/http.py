@@ -392,6 +392,18 @@ class HTTPAgentServer:
         route("POST", "/v1/namespaces", namespace_upsert)
         route("GET", "/v1/namespace/(?P<name>[^/]+)", namespace_get)
         route("DELETE", "/v1/namespace/(?P<name>[^/]+)", namespace_delete)
+        def plugins_list(p, q, body, tok):
+            plugins = self.cluster.rpc_self("Volume.plugins", {})
+            return sorted(plugins.values(), key=lambda x: x["id"])
+
+        def plugin_get(p, q, body, tok):
+            plugins = self.cluster.rpc_self("Volume.plugins", {})
+            if p["id"] not in plugins:
+                raise HTTPError(404, f"plugin {p['id']} not found")
+            return plugins[p["id"]]
+
+        route("GET", "/v1/plugins", plugins_list)
+        route("GET", "/v1/plugin/csi/(?P<id>[^/]+)", plugin_get)
         route("GET", "/v1/volumes", volumes_list)
         route("PUT", "/v1/volumes", volume_register)
         route("POST", "/v1/volumes", volume_register)

@@ -48,6 +48,7 @@ class NomadClient:
         self.acl = ACLAPI(self)
         self.operator = Operator(self)
         self.volumes = Volumes(self)
+        self.plugins = Plugins(self)
         self.namespaces = Namespaces(self)
         self.search = Search(self)
 
@@ -449,6 +450,16 @@ class Volumes(_Resource):
             f"/v1/volume/{vol_id}",
             params={"namespace": namespace or self.c.namespace},
         )
+
+
+class Plugins(_Resource):
+    """CSI plugin health aggregation (reference: api/csi.go CSIPlugins)."""
+
+    def list(self):
+        return self.c.get("/v1/plugins")
+
+    def get(self, plugin_id: str):
+        return self.c.get(f"/v1/plugin/csi/{plugin_id}")
 
 
 class Operator(_Resource):

@@ -171,6 +171,7 @@ def _load_agent_config(path: str):
         cfg.client_enabled = bool(ca.get("enabled", True))
         cfg.client_servers = [_addr(s) for s in ca.get("servers", [])]
         cfg.node_class = ca.get("node_class", "")
+        cfg.csi_plugins = dict(ca.get("csi_plugins", {}))
     pb = body.block("ports")
     if pb is not None:
         pa = pb.body.attrs()
@@ -191,6 +192,7 @@ def _apply_config_dict(cfg, data: dict) -> None:
         elif k == "client" and isinstance(v, dict):
             cfg.client_enabled = v.get("enabled", True)
             cfg.client_servers = [_addr(s) for s in v.get("servers", [])]
+            cfg.csi_plugins = dict(v.get("csi_plugins", {}))
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)
@@ -880,10 +882,12 @@ def cmd_volume_register(args) -> int:
         id=args.id,
         namespace=args.namespace or "default",
         name=args.name or args.id,
-        type="host",
+        type=args.type,
         node_id=args.node or "",
         path=args.path or "",
         access_mode=args.access_mode,
+        plugin_id=args.plugin or "",
+        external_id=args.external_id or "",
     )
     api.volumes.register(vol)
     print(f'Volume "{vol.id}" registered')
@@ -924,6 +928,43 @@ def cmd_volume_deregister(args) -> int:
     api = _client(args)
     api.volumes.deregister(args.id, namespace=args.namespace)
     print(f'Volume "{args.id}" deregistered')
+    return 0
+
+
+def cmd_plugin_status(args) -> int:
+    """Reference: command/plugin_status.go (CSI plugin health)."""
+    api = _client(args)
+    if args.id:
+        p = api.plugins.get(args.id)
+        print(f"ID                   = {p['id']}")
+        print(f"Version              = {p.get('version', '')}")
+        print(
+            f"Controllers Healthy  = "
+            f"{p['controllers_healthy']}/{p['controllers_expected']}"
+        )
+        print(
+            f"Nodes Healthy        = "
+            f"{p['nodes_healthy']}/{p['nodes_expected']}"
+        )
+        return 0
+    plugins = api.plugins.list()
+    if not plugins:
+        print("No CSI plugins")
+        return 0
+    print(
+        _fmt_table(
+            [
+                [
+                    p["id"],
+                    p.get("version", ""),
+                    f"{p['controllers_healthy']}/{p['controllers_expected']}",
+                    f"{p['nodes_healthy']}/{p['nodes_expected']}",
+                ]
+                for p in plugins
+            ],
+            header=["ID", "Version", "Controllers Healthy", "Nodes Healthy"],
+        )
+    )
     return 0
 
 
@@ -1254,6 +1295,9 @@ def build_parser() -> argparse.ArgumentParser:
     vreg.add_argument(
         "-access-mode", dest="access_mode", default="multi-node-multi-writer"
     )
+    vreg.add_argument("-type", default="host", choices=["host", "csi"])
+    vreg.add_argument("-plugin", default="")
+    vreg.add_argument("-external-id", dest="external_id", default="")
     vreg.set_defaults(fn=cmd_volume_register)
     vstat = volsub.add_parser("status")
     vstat.add_argument("id", nargs="?")
@@ -1263,6 +1307,12 @@ def build_parser() -> argparse.ArgumentParser:
     vdereg.add_argument("id")
     vdereg.add_argument("-namespace", default="default")
     vdereg.set_defaults(fn=cmd_volume_deregister)
+
+    plug = sub.add_parser("plugin", help="CSI plugin commands")
+    plugsub = plug.add_subparsers(dest="subcmd")
+    pstat = plugsub.add_parser("status")
+    pstat.add_argument("id", nargs="?")
+    pstat.set_defaults(fn=cmd_plugin_status)
 
     op = sub.add_parser("operator", help="operator commands")
     opsub = op.add_subparsers(dest="subcmd")

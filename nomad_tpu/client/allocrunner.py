@@ -71,6 +71,21 @@ class AllocRunner:
             self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
             self.on_update(self.alloc)
             return
+        # Volume hook (reference alloc_runner_hooks.go csi_hook/volume_hook):
+        # resolve group volume asks to host paths — host volumes from the
+        # node fingerprint, CSI volumes via claim fetch + plugin mount —
+        # before any task starts. Failure fails the alloc, not the node.
+        try:
+            volume_paths = self._resolve_volumes(tg)
+        except Exception as e:
+            logger.error("alloc %s: volume setup failed: %s", self.alloc.id, e)
+            self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+            for task in tg.tasks:
+                self.alloc.task_states[task.name] = TaskState(
+                    state="dead", failed=True
+                )
+            self.on_update(self.alloc)
+            return
         # Sticky/migrate ephemeral disk: inherit the previous alloc's
         # shared data before any task starts (reference allocwatcher;
         # restored allocs already own their dir).
@@ -126,6 +141,7 @@ class AllocRunner:
                     if self._client is not None
                     else None
                 ),
+                volume_paths=volume_paths,
             )
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():
@@ -138,6 +154,56 @@ class AllocRunner:
             )
             self._health.start()
         self._task_state_updated()
+
+    def _resolve_volumes(self, tg) -> dict[str, tuple[str, bool]]:
+        """Group volume name -> (host path, read_only).
+
+        Host volumes come straight from the node fingerprint; CSI volumes
+        are fetched by claim (Volume.for_alloc) and mounted through the
+        node's CSI plugin. An unsatisfiable CSI ask raises — feasibility
+        screened nodes, so this means the plugin died since placement."""
+        paths: dict[str, tuple[str, bool]] = {}
+        mounted = {vm.volume for t in tg.tasks for vm in t.volume_mounts}
+        csi_vols = None
+        for name, req in tg.volumes.items():
+            if req.type in ("", "host"):
+                hv = self.node.host_volumes.get(req.source) if self.node else None
+                if hv is not None and hv.path:
+                    paths[name] = (hv.path, hv.read_only or req.read_only)
+                elif name in mounted:
+                    # Feasibility placed us here because the fingerprint
+                    # advertised the volume; if it's gone (or pathless)
+                    # by run time, a task mount can't be satisfied —
+                    # fail the alloc, not a per-task restart loop.
+                    raise RuntimeError(
+                        f"volume {name}: host volume {req.source!r} "
+                        f"not present on this node"
+                    )
+            elif req.type == "csi":
+                if self._client is None:
+                    raise RuntimeError(
+                        f"volume {name}: CSI mounts need a client context"
+                    )
+                if csi_vols is None:
+                    csi_vols = self._client.rpc.volumes_for_alloc(self.alloc.id)
+                match = next(
+                    (
+                        v
+                        for v in csi_vols
+                        if v.name == req.source and v.type == "csi"
+                    ),
+                    None,
+                )
+                if match is None:
+                    raise RuntimeError(
+                        f"volume {name}: no claimed CSI volume for source "
+                        f"{req.source!r}"
+                    )
+                target = self._client.csi_manager.mount_volume(
+                    match, self.alloc.id, req.read_only
+                )
+                paths[name] = (target, req.read_only)
+        return paths
 
     def _task_states(self) -> dict:
         with self._lock:
@@ -208,6 +274,14 @@ class AllocRunner:
     def destroy(self) -> None:
         self._destroyed = True
         self.stop()
+        if self._client is not None:
+            # unwind CSI publishes (reference: csi_hook Postrun)
+            try:
+                self._client.csi_manager.unmount_alloc(self.alloc.id)
+            except Exception:
+                logger.exception(
+                    "alloc %s: CSI unmount failed", self.alloc.id
+                )
         if self.state_db is not None:
             self.state_db.delete_alloc(self.alloc.id)
 

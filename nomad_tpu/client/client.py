@@ -48,6 +48,9 @@ class ServerRPC:
     def update_allocs(self, allocs: list[Allocation]) -> None:
         self.server.update_allocs_from_client(allocs)
 
+    def volumes_for_alloc(self, alloc_id: str) -> list:
+        return self.server.state.volumes_for_alloc(alloc_id)
+
     def alloc_client_addr(self, alloc_id: str):
         """(alloc, 'host:port' of its node's client fabric) or (None, None)
         — the prev-alloc migrator's cross-node lookup."""
@@ -70,6 +73,7 @@ class Client:
         drivers: Optional[dict[str, Driver]] = None,
         rpc_secret: str = "",
         advertise_host: str = "127.0.0.1",
+        csi_plugins: Optional[dict] = None,
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
@@ -97,8 +101,15 @@ class Client:
         from .devicemanager import DeviceManager
 
         self.device_manager = DeviceManager()
+        # CSI plugins (reference: client/pluginmanager/csimanager) — config
+        # maps plugin_id -> builtin catalog name | "module:Class" ref.
+        from .csimanager import CSIManager
+
+        self.csi_manager = CSIManager(data_dir, node_id=self.node.id)
+        self.csi_manager.register_from_config(csi_plugins or {})
         self._fingerprint_drivers()
         self._fingerprint_devices()
+        self._fingerprint_csi()
         from ..structs.node_class import compute_node_class
 
         self.node.computed_class = compute_node_class(self.node)
@@ -170,6 +181,7 @@ class Client:
         if kill_allocs:
             for ar in list(self.alloc_runners.values()):
                 ar.destroy()
+        self.csi_manager.shutdown()
         self.state_db.close()
 
     # -- loops ---------------------------------------------------------
@@ -228,6 +240,14 @@ class Client:
         self.node.resources.devices = devices
         return True
 
+    def _fingerprint_csi(self) -> bool:
+        """Refresh node.csi_plugins from the CSI manager; True on change."""
+        cur = self.csi_manager.fingerprint()
+        if cur == self.node.csi_plugins:
+            return False
+        self.node.csi_plugins = cur
+        return True
+
     def _fingerprint_loop(self) -> None:
         """Periodic re-fingerprint (reference fingerprint.go:31-48 —
         periodic fingerprinters push node updates): drivers can appear
@@ -240,6 +260,7 @@ class Client:
                 return
             changed = self._fingerprint_drivers()
             changed = self._fingerprint_devices() or changed
+            changed = self._fingerprint_csi() or changed
             dyn = dynamic_attributes(self.data_dir)
             for k, v in dyn.items():
                 if self.node.attributes.get(k) != v:

@@ -11,6 +11,7 @@ for reattach (Restore :1065).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Callable, Optional
 
@@ -56,8 +57,10 @@ class TaskRunner:
         restore_handle: Optional[dict] = None,
         restore_state: Optional[TaskState] = None,
         device_manager=None,  # the client's configured DeviceManager
+        volume_paths: Optional[dict] = None,  # volume name -> (path, ro)
     ) -> None:
         self.device_manager = device_manager
+        self.volume_paths = volume_paths or {}
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -347,6 +350,52 @@ class TaskRunner:
     def wait(self, timeout_s: Optional[float] = None) -> bool:
         return self._done.wait(timeout_s)
 
+    def _setup_volume_mounts(self, task_dir) -> list[dict]:
+        """Materialize task.volume_mounts (reference: volume_hook.go).
+
+        Each mount's destination gets a symlink inside the task dir so
+        filesystem drivers (exec/rawexec/java) see the volume; the mount
+        list also rides TaskConfig.mounts for drivers that bind-mount
+        (docker). Destinations are confined to the task dir."""
+        from .allocdir import EscapeError, confine
+
+        mounts: list[dict] = []
+        for vm in self.task.volume_mounts:
+            vp = self.volume_paths.get(vm.volume)
+            if vp is None:
+                raise DriverError(
+                    f"volume_mount {vm.volume!r}: no such group volume "
+                    f"resolved on this node"
+                )
+            host_path, vol_ro = vp
+            dest = vm.destination or vm.volume
+            try:
+                link = confine(task_dir.dir, dest.lstrip("/"))
+            except EscapeError as e:
+                raise DriverError(str(e)) from None
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            if not os.path.lexists(link):
+                os.symlink(host_path, link)
+            if (vm.read_only or vol_ro) and not getattr(
+                self.driver, "bind_mounts", False
+            ):
+                # Filesystem drivers see the volume through a symlink,
+                # which cannot enforce read-only (the reference's exec
+                # driver uses real ro bind mounts via libcontainer;
+                # raw_exec doesn't support volume_mounts at all). Surface
+                # the advisory gap instead of silently dropping it.
+                logger.warning(
+                    "task %s: read_only mount %r is advisory under driver "
+                    "%s (no bind-mount isolation)",
+                    self.task_id, vm.volume, self.task.driver,
+                )
+            mounts.append({
+                "host_path": host_path,
+                "task_path": dest,
+                "read_only": vm.read_only or vol_ro,
+            })
+        return mounts
+
     def _task_config(self, task_dir, env: dict[str, str]) -> TaskConfig:
         return TaskConfig(
             id=self.task_id,
@@ -360,6 +409,7 @@ class TaskRunner:
             stdout_path=self.alloc_dir.stdout_path(self.task.name),
             stderr_path=self.alloc_dir.stderr_path(self.task.name),
             user=self.task.user,
+            mounts=self._setup_volume_mounts(task_dir),
         )
 
     def _event(self, etype: str, details: str = "") -> None:

@@ -45,6 +45,10 @@ class TaskConfig:
     stdout_path: str = ""
     stderr_path: str = ""
     user: str = ""
+    # volume mounts: [{"host_path", "task_path", "read_only"}] —
+    # bind-mounting drivers (docker) consume these; filesystem drivers
+    # get a symlink placed by the task runner (reference: TaskConfig.Mounts)
+    mounts: list = field(default_factory=list)
 
 
 @dataclass

@@ -370,6 +370,9 @@ class DockerDriver(Driver):
     """Reference parity: drivers/docker/driver.go StartTask :370,
     pull dedup via coordinator.go, docklog via the logs follow stream."""
 
+    # volume_mounts become real (ro-capable) binds, not symlinks
+    bind_mounts = True
+
     name = "docker"
 
     def __init__(self, socket_path: Optional[str] = None) -> None:
@@ -419,6 +422,10 @@ class DockerDriver(Driver):
         if cfg.task_dir:
             # the task dir rides at /local like the reference's task mounts
             binds.append(f"{cfg.task_dir}:/local")
+        # group-volume mounts resolved by the task runner (host + CSI)
+        for m in getattr(cfg, "mounts", None) or []:
+            mode = ":ro" if m.get("read_only") else ""
+            binds.append(f"{m['host_path']}:{m['task_path']}{mode}")
         host_config: dict[str, Any] = {
             "Binds": binds,
             "Memory": int(cfg.resources_memory_mb) * 1024 * 1024,

@@ -32,6 +32,7 @@ from ..structs.structs import (
     TaskLifecycleConfig,
     Template,
     UpdateStrategy,
+    VolumeMount,
     VolumeRequest,
     RequestedDevice,
 )
@@ -137,6 +138,8 @@ def _group(b: Block, job: Job) -> TaskGroup:
             type=va.get("type", "host"),
             source=va.get("source", ""),
             read_only=bool(va.get("read_only", False)),
+            access_mode=va.get("access_mode", ""),
+            attachment_mode=va.get("attachment_mode", ""),
             per_alloc=bool(va.get("per_alloc", False)),
         )
     for sb2 in b.body.blocks("service"):
@@ -172,6 +175,16 @@ def _task(b: Block) -> Task:
         task.resources = _resources(rb)
     task.constraints = [_constraint(c) for c in b.body.blocks("constraint")]
     task.affinities = [_affinity(c) for c in b.body.blocks("affinity")]
+    for vm in b.body.blocks("volume_mount"):
+        vma = vm.body.attrs()
+        task.volume_mounts.append(
+            VolumeMount(
+                volume=vma.get("volume", ""),
+                destination=vma.get("destination", ""),
+                read_only=bool(vma.get("read_only", False)),
+                propagation_mode=vma.get("propagation_mode", "private"),
+            )
+        )
     for ab in b.body.blocks("artifact"):
         aa = ab.body.attrs()
         opts = {}

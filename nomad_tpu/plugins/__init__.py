@@ -1,0 +1,23 @@
+"""Plugin interfaces beyond task drivers.
+
+Reference: plugins/ — base handshake (plugins/base), driver wrappers
+(plugins/drivers, implemented in nomad_tpu/drivers/plugin.py), device
+plugins (plugins/device, implemented in nomad_tpu/client/devicemanager.py),
+and the CSI client (plugins/csi) implemented here in csi.py.
+"""
+
+from .csi import (
+    CSIError,
+    CSIPlugin,
+    ExternalCSIPlugin,
+    FakeCSIPlugin,
+    serve_csi_plugin,
+)
+
+__all__ = [
+    "CSIError",
+    "CSIPlugin",
+    "ExternalCSIPlugin",
+    "FakeCSIPlugin",
+    "serve_csi_plugin",
+]

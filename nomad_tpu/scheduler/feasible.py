@@ -350,6 +350,45 @@ class HostVolumeChecker(FeasibilityChecker):
         return True, ""
 
 
+FILTER_CONSTRAINT_CSI_VOLUMES = "missing CSI volume/plugin"
+
+
+class CSIVolumeChecker(FeasibilityChecker):
+    """CSI-type volume asks (reference: feasible.go CSIVolumeChecker :213):
+    the node must run a healthy node-capable instance of the volume's
+    plugin, and the registered volume must admit another claim of the
+    requested mode."""
+
+    def __init__(self, ctx: EvalContext, volumes: dict[str, VolumeRequest],
+                 namespace: str = "default") -> None:
+        self.asks = [v for v in volumes.values() if v.type == "csi"]
+        self._registered: dict[str, list] = {}
+        state = getattr(ctx, "state", None)
+        if state is not None and hasattr(state, "volumes_by_name"):
+            for ask in self.asks:
+                self._registered[ask.source] = [
+                    v
+                    for v in state.volumes_by_name(namespace, ask.source)
+                    if v.type == "csi"
+                ]
+
+    def feasible(self, node: Node) -> tuple[bool, str]:
+        for ask in self.asks:
+            vols = self._registered.get(ask.source) or []
+            ok = False
+            for vol in vols:
+                info = node.csi_plugins.get(vol.plugin_id)
+                if not info or not info.get("healthy") \
+                        or not info.get("node", True):
+                    continue
+                if vol.claimable(ask.read_only)[0]:
+                    ok = True
+                    break
+            if not ok:
+                return False, FILTER_CONSTRAINT_CSI_VOLUMES
+        return True, ""
+
+
 class NetworkChecker(FeasibilityChecker):
     """Node must be able to satisfy static port + bandwidth asks
     (reference: feasible.go NetworkChecker :341)."""

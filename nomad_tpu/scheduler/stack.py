@@ -24,6 +24,7 @@ from .feasible import (
     DriverChecker,
     FeasibilityChecker,
     HostVolumeChecker,
+    CSIVolumeChecker,
     NetworkChecker,
     feasibility_pipeline,
 )
@@ -120,6 +121,7 @@ class GenericStack:
             DriverChecker(self.ctx, _tg_drivers(tg)),
             ConstraintChecker(self.ctx, all_constraints),
             HostVolumeChecker(self.ctx, tg.volumes, namespace=job.namespace),
+            CSIVolumeChecker(self.ctx, tg.volumes, namespace=job.namespace),
             NetworkChecker(self.ctx, tg),
             DeviceChecker(self.ctx, tg),
         ]
@@ -214,6 +216,7 @@ class SystemStack:
             DriverChecker(self.ctx, _tg_drivers(tg)),
             ConstraintChecker(self.ctx, all_constraints),
             HostVolumeChecker(self.ctx, tg.volumes, namespace=job.namespace),
+            CSIVolumeChecker(self.ctx, tg.volumes, namespace=job.namespace),
             NetworkChecker(self.ctx, tg),
             DeviceChecker(self.ctx, tg),
         ]

@@ -293,6 +293,38 @@ def lower_group(
                 vol_ok[i] = True
             feas &= vol_ok
 
+    # CSI volumes (mirrors feasible.py CSIVolumeChecker): node must run a
+    # healthy node-capable instance of some registered, claimable volume's
+    # plugin for every csi-type ask.
+    csi_asks = [v for v in tg.volumes.values() if v.type == "csi"]
+    if csi_asks:
+        state = getattr(ctx, "state", None)
+        for ask in csi_asks:
+            vols = [
+                v
+                for v in (
+                    state.volumes_by_name(job.namespace, ask.source)
+                    if state is not None
+                    and hasattr(state, "volumes_by_name")
+                    else []
+                )
+                if v.type == "csi" and v.claimable(ask.read_only)[0]
+            ]
+            plugin_ids = {v.plugin_id for v in vols}
+            csi_ok = np.array(
+                [
+                    any(
+                        (info := node.csi_plugins.get(pid)) is not None
+                        and info.get("healthy")
+                        and info.get("node", True)
+                        for pid in plugin_ids
+                    )
+                    for node in table.nodes
+                ],
+                dtype=bool,
+            )
+            feas &= csi_ok
+
     # Network: static-port / bandwidth screens stay host-side but cheap —
     # mbits capacity folds into feasibility; a static-port ask caps the
     # group at one instance per node and excludes nodes already holding

@@ -41,11 +41,22 @@ class TPUSystemScheduler(SystemScheduler):
 
     def _place_group(self, job, eval_obj, stack, tg, nodes, queued) -> None:
         # Per-node paths the vectorized mint can't cover: dynamic port
-        # selection and exact device instance picks.
+        # selection, exact device instance picks, and distinct_property
+        # budgets (a SHARED per-value cap — the one-shot mask can't stop
+        # the Nth node of a value once N-1 placed in the same pass).
+        from ...structs.structs import CONSTRAINT_DISTINCT_PROPERTY
+
+        all_constraints = list(job.constraints) + list(tg.constraints)
+        for t in tg.tasks:
+            all_constraints.extend(t.constraints)
         needs_per_node = (
             bool(tg.networks)
             or any(t.resources.networks for t in tg.tasks)
             or any(t.resources.devices for t in tg.tasks)
+            or any(
+                c.operand == CONSTRAINT_DISTINCT_PROPERTY
+                for c in all_constraints
+            )
         )
         if needs_per_node or len(nodes) < 8:
             # tiny batches aren't worth the lowering overhead
@@ -81,7 +92,9 @@ class TPUSystemScheduler(SystemScheduler):
         ask = np.asarray(grp.ask, dtype=np.int64)
         free = table.cap - table.used
         fits = np.all(free >= ask[None, :], axis=1)
-        ok = grp.feasible & fits
+        # units_cap: distinct_hosts folds to a 0/1 per-node budget here
+        # (distinct_property already routed to the host walk above).
+        ok = grp.feasible & fits & (grp.units_cap >= 1)
 
         ok_idx = np.nonzero(ok)[0].tolist()
         shared_metric = AllocMetric(

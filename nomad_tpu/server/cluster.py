@@ -243,6 +243,12 @@ class VolumeEndpoint(_Forwarder):
     def list(self, args):
         return self.cs.server.state.volumes(args.get("namespace"))
 
+    def for_alloc(self, args):
+        return self.cs.server.state.volumes_for_alloc(args["alloc_id"])
+
+    def plugins(self, args):
+        return self.cs.server.state.csi_plugins()
+
 
 class NodeEndpoint(_Forwarder):
     def register(self, args):
@@ -933,3 +939,6 @@ class ClusterRPC:
     def alloc_client_addr(self, alloc_id: str):
         out = self._call("Alloc.client_addr", {"alloc_id": alloc_id})
         return tuple(out) if out else (None, None)
+
+    def volumes_for_alloc(self, alloc_id: str) -> list:
+        return self._call("Volume.for_alloc", {"alloc_id": alloc_id})

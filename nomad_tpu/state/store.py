@@ -290,6 +290,43 @@ class _ReadMixin:
             if ns == namespace and v.name == name
         ]
 
+    @_locked_on_live
+    def volumes_for_alloc(self, alloc_id: str) -> list:
+        """Volumes holding a claim by this alloc (the client's mount hook
+        fetches these; reference: CSIVolume.Get per claimed volume)."""
+        return [
+            v
+            for v in self._tables[TABLE_VOLUMES].values()
+            if alloc_id in v.claims
+        ]
+
+    @_locked_on_live
+    def csi_plugins(self) -> dict[str, dict]:
+        """Aggregate CSI plugin health across nodes (reference: the
+        CSIPlugin table nomad/state/state_store.go maintains on node
+        updates; here computed at read time from the nodes table)."""
+        out: dict[str, dict] = {}
+        for node in self._tables[TABLE_NODES].values():
+            for plugin_id, info in node.csi_plugins.items():
+                agg = out.setdefault(plugin_id, {
+                    "id": plugin_id,
+                    "version": info.get("version", ""),
+                    "controllers_healthy": 0,
+                    "controllers_expected": 0,
+                    "nodes_healthy": 0,
+                    "nodes_expected": 0,
+                })
+                healthy = bool(info.get("healthy"))
+                if info.get("controller"):
+                    agg["controllers_expected"] += 1
+                    agg["controllers_healthy"] += int(healthy)
+                if info.get("node", True):
+                    agg["nodes_expected"] += 1
+                    agg["nodes_healthy"] += int(healthy)
+                if info.get("version"):
+                    agg["version"] = info["version"]
+        return out
+
     # deployments ------------------------------------------------------
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
         return self._tables[TABLE_DEPLOYMENTS].get(deployment_id)

@@ -44,6 +44,8 @@ def compute_node_class(node: Node) -> str:
     for name in sorted(node.host_volumes):
         hv = node.host_volumes[name]
         put("hostvol", name, hv.read_only)
+    for pid in sorted(node.csi_plugins):
+        put("csiplugin", pid)
     for k in sorted(node.attributes):
         if not _escaped(k):
             put("attr", k, node.attributes[k])

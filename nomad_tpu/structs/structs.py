@@ -558,6 +558,20 @@ class VolumeRequest:
 
 
 @dataclass(slots=True)
+class VolumeMount:
+    """Task-level mount of a group volume into the task filesystem
+    (reference: structs.go VolumeMount :7263)."""
+
+    volume: str = ""
+    destination: str = ""
+    read_only: bool = False
+    propagation_mode: str = "private"
+
+    def copy(self) -> "VolumeMount":
+        return dataclasses.replace(self)
+
+
+@dataclass(slots=True)
 class Service:
     """Service registration (reference: structs.go Service :7582)."""
 
@@ -649,6 +663,7 @@ class Task:
     artifacts: list[TaskArtifact] = field(default_factory=list)
     templates: list[Template] = field(default_factory=list)
     log_config: LogConfig = field(default_factory=LogConfig)
+    volume_mounts: list[VolumeMount] = field(default_factory=list)
     kill_timeout_s: float = 5.0
     kill_signal: str = ""
     leader: bool = False
@@ -670,6 +685,7 @@ class Task:
             artifacts=[a.copy() for a in self.artifacts],
             templates=[t.copy() for t in self.templates],
             log_config=self.log_config.copy(),
+            volume_mounts=[m.copy() for m in self.volume_mounts],
             kill_timeout_s=self.kill_timeout_s,
             kill_signal=self.kill_signal,
             leader=self.leader,
@@ -1047,16 +1063,22 @@ class Volume:
     id: str = ""
     namespace: str = DEFAULT_NAMESPACE
     name: str = ""  # the group volume.source this volume satisfies
-    type: str = "host"
+    type: str = "host"  # host | csi
     node_id: str = ""  # host volumes live on one node ("" = any)
     path: str = ""
     access_mode: str = VOLUME_ACCESS_MULTI_WRITER
+    # CSI-only fields (reference: nomad/structs/csi.go CSIVolume)
+    plugin_id: str = ""
+    external_id: str = ""
+    attachment_mode: str = "file-system"
+    context: dict[str, str] = field(default_factory=dict)
     claims: dict[str, VolumeClaim] = field(default_factory=dict)
     create_index: int = 0
     modify_index: int = 0
 
     def copy(self) -> "Volume":
         c = dataclasses.replace(self)
+        c.context = dict(self.context)
         c.claims = {k: dataclasses.replace(v) for k, v in self.claims.items()}
         return c
 
@@ -1089,6 +1111,9 @@ class Node:
     resources: NodeResources = field(default_factory=NodeResources)
     reserved: NodeReservedResources = field(default_factory=NodeReservedResources)
     host_volumes: dict[str, HostVolumeConfig] = field(default_factory=dict)
+    # CSI plugins fingerprinted on this node: plugin_id -> info dict
+    # (version/healthy/controller/node; reference: Node.CSINodePlugins)
+    csi_plugins: dict[str, dict] = field(default_factory=dict)
     links: dict[str, str] = field(default_factory=dict)
     drivers: dict[str, "DriverInfo"] = field(default_factory=dict)
     status: str = NODE_STATUS_INIT
@@ -1114,6 +1139,7 @@ class Node:
             resources=self.resources.copy(),
             reserved=self.reserved.copy(),
             host_volumes={k: dataclasses.replace(v) for k, v in self.host_volumes.items()},
+            csi_plugins={k: dict(v) for k, v in self.csi_plugins.items()},
             links=dict(self.links),
             drivers={k: v.copy() for k, v in self.drivers.items()},
             status=self.status,

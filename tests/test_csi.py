@@ -1,0 +1,317 @@
+"""CSI plugin framework tests.
+
+Reference intent: plugins/csi/ (client + fake), client/pluginmanager/
+csimanager/ (stage/publish refcounts), scheduler/feasible.go
+CSIVolumeChecker, nomad/state CSIPlugin aggregation.
+"""
+
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.csimanager import CSIManager
+from nomad_tpu.plugins.csi import CSIError, FakeCSIPlugin
+from nomad_tpu.structs.structs import (
+    VOLUME_ACCESS_SINGLE_WRITER,
+    Volume,
+    VolumeClaim,
+    VolumeMount,
+    VolumeRequest,
+)
+
+
+def wait_until(fn, timeout_s=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _csi_vol(vol_id="csivol", plugin="hostpath", name=None, access=None):
+    return Volume(
+        id=vol_id,
+        name=name or vol_id,
+        type="csi",
+        plugin_id=plugin,
+        external_id=f"ext-{vol_id}",
+        access_mode=access or "multi-node-multi-writer",
+    )
+
+
+class TestCSIManager:
+    def _mgr(self, tmp_path):
+        mgr = CSIManager(str(tmp_path / "client"), node_id="n1")
+        plugin = FakeCSIPlugin(backing_dir=str(tmp_path / "backing"))
+        mgr.register("hostpath", plugin)
+        return mgr, plugin
+
+    def test_fingerprint_shape(self, tmp_path):
+        mgr, _ = self._mgr(tmp_path)
+        fp = mgr.fingerprint()
+        assert fp["hostpath"]["healthy"] is True
+        assert fp["hostpath"]["controller"] is True
+        assert fp["hostpath"]["node"] is True
+        assert fp["hostpath"]["version"] == "1.0.0"
+
+    def test_unhealthy_plugin_fingerprints_unhealthy(self, tmp_path):
+        mgr, plugin = self._mgr(tmp_path)
+        plugin.healthy = False
+        assert mgr.fingerprint()["hostpath"]["healthy"] is False
+
+    def test_mount_publish_write_roundtrip(self, tmp_path):
+        mgr, plugin = self._mgr(tmp_path)
+        vol = _csi_vol()
+        target = mgr.mount_volume(vol, "alloc-1", read_only=False)
+        assert os.path.islink(target)
+        # a write through the published path lands in the backing store
+        with open(os.path.join(target, "hello.txt"), "w") as f:
+            f.write("hi")
+        backing = os.path.join(
+            str(tmp_path / "backing"), vol.external_id, "hello.txt"
+        )
+        assert open(backing).read() == "hi"
+        # controller saw the attach
+        assert "n1" in plugin.attached[vol.external_id]
+
+    def test_stage_refcount_across_allocs(self, tmp_path):
+        mgr, plugin = self._mgr(tmp_path)
+        vol = _csi_vol()
+        t1 = mgr.mount_volume(vol, "alloc-1", read_only=False)
+        t2 = mgr.mount_volume(vol, "alloc-2", read_only=False)
+        assert t1 != t2
+        assert len(plugin.staged) == 1, "one staging per volume per node"
+        mgr.unmount_alloc("alloc-1")
+        assert len(plugin.staged) == 1, "still one user left"
+        assert not os.path.lexists(t1)
+        mgr.unmount_alloc("alloc-2")
+        assert len(plugin.staged) == 0, "last user unstages"
+        assert plugin.attached[vol.external_id] == set()
+
+    def test_missing_plugin_raises(self, tmp_path):
+        mgr, _ = self._mgr(tmp_path)
+        vol = _csi_vol(plugin="ebs")
+        with pytest.raises(CSIError):
+            mgr.mount_volume(vol, "alloc-1", read_only=False)
+
+    def test_publish_failure_rolls_back_refcount(self, tmp_path):
+        mgr, plugin = self._mgr(tmp_path)
+        vol = _csi_vol()
+
+        def boom(ctx):
+            raise CSIError("no")
+
+        plugin.node_publish = boom
+        with pytest.raises(CSIError):
+            mgr.mount_volume(vol, "alloc-1", read_only=False)
+        assert mgr._stage_users.get(vol.id) == set()
+
+
+def test_external_csi_plugin_roundtrip():
+    """The plugin-process transport: handshake + identity verbs
+    (mirrors drivers/plugin.py's out-of-proc boundary)."""
+    from nomad_tpu.plugins.csi import ExternalCSIPlugin
+
+    ext = ExternalCSIPlugin("fake", "nomad_tpu.plugins.csi:FakeCSIPlugin")
+    try:
+        info = ext.plugin_info()
+        assert info.name == "hostpath"
+        assert info.version == "1.0.0"
+        assert ext.probe() is True
+        assert ext.node_get_info()["node_id"].startswith("fake-")
+        pub = ext.controller_publish("v1", "ext-v1", "n1", False)
+        assert pub == {"attached_on": "n1"}
+    finally:
+        ext.shutdown_plugin()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler feasibility
+# ---------------------------------------------------------------------------
+
+
+class TestCSIFeasibility:
+    def _ctx_with_vol(self, vol):
+        from nomad_tpu.scheduler.context import EvalContext
+        from nomad_tpu.state.store import StateStore
+
+        state = StateStore()
+        state.upsert_volume(10, vol)
+        return EvalContext(state=state)
+
+    def test_node_without_plugin_infeasible(self):
+        from nomad_tpu.scheduler.feasible import CSIVolumeChecker
+
+        ctx = self._ctx_with_vol(_csi_vol())
+        asks = {"v": VolumeRequest(name="v", type="csi", source="csivol")}
+        checker = CSIVolumeChecker(ctx, asks)
+        bare = mock.node()
+        ok, why = checker.feasible(bare)
+        assert not ok
+        with_plugin = mock.node()
+        with_plugin.csi_plugins["hostpath"] = {
+            "healthy": True, "node": True, "controller": True,
+        }
+        ok, _ = checker.feasible(with_plugin)
+        assert ok
+
+    def test_unhealthy_plugin_infeasible(self):
+        from nomad_tpu.scheduler.feasible import CSIVolumeChecker
+
+        ctx = self._ctx_with_vol(_csi_vol())
+        asks = {"v": VolumeRequest(name="v", type="csi", source="csivol")}
+        checker = CSIVolumeChecker(ctx, asks)
+        n = mock.node()
+        n.csi_plugins["hostpath"] = {"healthy": False, "node": True}
+        ok, _ = checker.feasible(n)
+        assert not ok
+
+    def test_claimed_single_writer_blocks_new_writer(self):
+        from nomad_tpu.scheduler.feasible import CSIVolumeChecker
+
+        vol = _csi_vol(access=VOLUME_ACCESS_SINGLE_WRITER)
+        vol.claims["a1"] = VolumeClaim(alloc_id="a1", read_only=False)
+        ctx = self._ctx_with_vol(vol)
+        asks = {"v": VolumeRequest(name="v", type="csi", source="csivol")}
+        checker = CSIVolumeChecker(ctx, asks)
+        n = mock.node()
+        n.csi_plugins["hostpath"] = {"healthy": True, "node": True}
+        ok, _ = checker.feasible(n)
+        assert not ok, "single-writer volume with live writer must reject"
+        ro_asks = {
+            "v": VolumeRequest(
+                name="v", type="csi", source="csivol", read_only=True
+            )
+        }
+        ok, _ = CSIVolumeChecker(ctx, ro_asks).feasible(n)
+        assert ok, "readers still welcome"
+
+
+# ---------------------------------------------------------------------------
+# State aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_csi_plugin_state_aggregation():
+    from nomad_tpu.state.store import StateStore
+
+    state = StateStore()
+    n1 = mock.node()
+    n1.csi_plugins["hostpath"] = {
+        "version": "1.0.0", "healthy": True, "controller": True, "node": True,
+    }
+    n2 = mock.node()
+    n2.csi_plugins["hostpath"] = {
+        "version": "1.0.0", "healthy": False, "controller": False,
+        "node": True,
+    }
+    state.upsert_node(10, n1)
+    state.upsert_node(11, n2)
+    agg = state.csi_plugins()
+    assert agg["hostpath"]["controllers_expected"] == 1
+    assert agg["hostpath"]["controllers_healthy"] == 1
+    assert agg["hostpath"]["nodes_expected"] == 2
+    assert agg["hostpath"]["nodes_healthy"] == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: schedule, claim, mount, run
+# ---------------------------------------------------------------------------
+
+
+def test_csi_volume_e2e(tmp_path):
+    """A csi-type group volume schedules only onto plugin-bearing nodes,
+    gets claimed at plan apply, mounts through the node plugin, and the
+    task's volume_mount symlink lands in the task dir."""
+    from nomad_tpu.client import Client, ServerRPC
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs.node_class import compute_node_class
+
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    client = None
+    try:
+        server.volume_register(_csi_vol())
+        client = Client(ServerRPC(server), data_dir=str(tmp_path / "c0"))
+        client.csi_manager.register(
+            "hostpath", FakeCSIPlugin(backing_dir=str(tmp_path / "backing"))
+        )
+        client._fingerprint_csi()
+        client.node.computed_class = compute_node_class(client.node)
+        client.start()
+        assert client.wait_registered(10)
+
+        job = mock.job(id="csi-job")
+        job.datacenters = [client.node.datacenter]
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {
+            "data": VolumeRequest(name="data", type="csi", source="csivol")
+        }
+        task = tg.tasks[0]
+        task.driver = "mock"
+        task.config = {}
+        task.volume_mounts = [
+            VolumeMount(volume="data", destination="data")
+        ]
+        server.job_register(job)
+
+        def running():
+            return [
+                a
+                for a in server.state.allocs_by_job(job.namespace, job.id)
+                if a.client_status == "running"
+            ]
+
+        assert wait_until(lambda: running(), 15)
+        alloc = running()[0]
+        # claim attached at plan apply
+        vol = server.state.volume_by_id("default", "csivol")
+        assert alloc.id in vol.claims
+        # the volume_mount symlink is inside the task dir and writable
+        runner = client.alloc_runners[alloc.id]
+        link = os.path.join(runner.alloc_dir, task.name, "data")
+        assert wait_until(lambda: os.path.islink(link), 5)
+        with open(os.path.join(link, "out.txt"), "w") as f:
+            f.write("written-through-csi")
+        assert (
+            (tmp_path / "backing" / "ext-csivol" / "out.txt").read_text()
+            == "written-through-csi"
+        )
+        # /v1-level aggregation sees the node plugin
+        agg = server.state.csi_plugins()
+        assert agg["hostpath"]["nodes_healthy"] == 1
+    finally:
+        if client is not None:
+            client.shutdown()
+        server.shutdown()
+
+
+def test_csi_job_does_not_place_without_plugin(tmp_path):
+    """Nodes lacking the plugin are screened by feasibility: the eval
+    blocks instead of placing."""
+    from nomad_tpu.server import Server
+
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    try:
+        server.volume_register(_csi_vol())
+        n = mock.node()  # no csi plugins
+        server.node_register(n)
+        server.node_heartbeat(n.id)
+        job = mock.job(id="csi-blocked")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {
+            "data": VolumeRequest(name="data", type="csi", source="csivol")
+        }
+        server.job_register(job)
+        time.sleep(1.0)
+        allocs = server.state.allocs_by_job(job.namespace, job.id)
+        live = [a for a in allocs if not a.terminal_status()]
+        assert live == [], "no plugin on any node: nothing may place"
+    finally:
+        server.shutdown()
