@@ -63,6 +63,12 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     # csi_endpoint.go: plugin list/read allowed with namespace read)
     ("GET", re.compile(r"^/v1/plugins$"), CAP_READ_JOB),
     ("GET", re.compile(r"^/v1/plugin/csi/.*$"), CAP_READ_JOB),
+    # native service discovery (reference
+    # service_registration_endpoint.go: read-job to list, submit-job to
+    # delete a registration)
+    ("GET", re.compile(r"^/v1/services$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/service/[^/]+$"), CAP_READ_JOB),
+    ("DELETE", re.compile(r"^/v1/service/[^/]+/[^/]+$"), CAP_SUBMIT_JOB),
     # search reads cluster objects (reference search_endpoint ACL: the
     # per-context capability; read-job is the broadest gate here)
     ("PUT", re.compile(r"^/v1/search(/fuzzy)?$"), CAP_READ_JOB),

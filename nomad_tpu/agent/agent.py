@@ -56,6 +56,19 @@ class InProcessClusterRPC:
             "Volume.for_alloc", {"alloc_id": alloc_id}
         )
 
+    def services_register(self, regs: list) -> None:
+        self.cluster.rpc_self("Service.register", {"regs": regs})
+
+    def services_deregister_alloc(self, alloc_id: str) -> None:
+        self.cluster.rpc_self(
+            "Service.deregister_alloc", {"alloc_id": alloc_id}
+        )
+
+    def service_lookup(self, namespace: str, name: str) -> list:
+        return self.cluster.rpc_self(
+            "Service.get", {"namespace": namespace, "name": name}
+        )
+
 
 @dataclass
 class AgentConfig:

@@ -392,6 +392,47 @@ class HTTPAgentServer:
         route("POST", "/v1/namespaces", namespace_upsert)
         route("GET", "/v1/namespace/(?P<name>[^/]+)", namespace_get)
         route("DELETE", "/v1/namespace/(?P<name>[^/]+)", namespace_delete)
+        def services_list(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return self.cluster.rpc_self("Service.list", {"namespace": ns})
+
+        def service_get(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            regs = self.cluster.rpc_self(
+                "Service.get", {"namespace": ns, "name": p["name"]}
+            )
+            if not regs:
+                raise HTTPError(404, f"service {p['name']} not found")
+            return regs
+
+        def service_delete(p, q, body, tok):
+            # Scope the delete to the ACL-checked namespace + the named
+            # service: ids are guessable, so an id-only delete would let
+            # a default-namespace token deregister another namespace's
+            # instances.
+            ns = q.get("namespace", ["default"])[0]
+            regs = self.cluster.rpc_self(
+                "Service.get", {"namespace": ns, "name": p["name"]}
+            )
+            if not any(r.id == p["id"] for r in regs):
+                raise HTTPError(
+                    404,
+                    f"registration {p['id']} not found for service "
+                    f"{p['name']} in namespace {ns}",
+                )
+            n = self.cluster.rpc_self(
+                "Service.deregister", {"ids": [p["id"]]}
+            )
+            return {"Deregistered": n}
+
+        route("GET", "/v1/services", services_list)
+        route("GET", "/v1/service/(?P<name>[^/]+)", service_get)
+        route(
+            "DELETE",
+            "/v1/service/(?P<name>[^/]+)/(?P<id>[^/]+)",
+            service_delete,
+        )
+
         def plugins_list(p, q, body, tok):
             plugins = self.cluster.rpc_self("Volume.plugins", {})
             return sorted(plugins.values(), key=lambda x: x["id"])

@@ -49,6 +49,7 @@ class NomadClient:
         self.operator = Operator(self)
         self.volumes = Volumes(self)
         self.plugins = Plugins(self)
+        self.services = Services(self)
         self.namespaces = Namespaces(self)
         self.search = Search(self)
 
@@ -450,6 +451,25 @@ class Volumes(_Resource):
             f"/v1/volume/{vol_id}",
             params={"namespace": namespace or self.c.namespace},
         )
+
+
+class Services(_Resource):
+    """Native service discovery (reference: api/services.go)."""
+
+    def list(self, namespace: Optional[str] = None):
+        return self.c.get(
+            "/v1/services",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def get(self, name: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/service/{name}",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def delete(self, name: str, reg_id: str):
+        return self.c.delete(f"/v1/service/{name}/{reg_id}")
 
 
 class Plugins(_Resource):

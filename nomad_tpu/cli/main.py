@@ -931,6 +931,51 @@ def cmd_volume_deregister(args) -> int:
     return 0
 
 
+def cmd_service_list(args) -> int:
+    """Reference: command/service_list.go."""
+    api = _client(args)
+    rows = api.services.list(namespace=args.namespace)
+    if not rows:
+        print("No services")
+        return 0
+    print(
+        _fmt_table(
+            [
+                [r["service_name"], ",".join(r["tags"]), str(r["instances"])]
+                for r in rows
+            ],
+            header=["Service Name", "Tags", "Instances"],
+        )
+    )
+    return 0
+
+
+def cmd_service_info(args) -> int:
+    """Reference: command/service_info.go."""
+    api = _client(args)
+    try:
+        regs = api.services.get(args.name, namespace=args.namespace)
+    except Exception as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    print(
+        _fmt_table(
+            [
+                [
+                    f"{r.address}:{r.port}",
+                    r.status or "-",
+                    r.alloc_id[:8],
+                    r.node_id[:8],
+                    ",".join(r.tags),
+                ]
+                for r in regs
+            ],
+            header=["Address", "Status", "Alloc ID", "Node ID", "Tags"],
+        )
+    )
+    return 0
+
+
 def cmd_plugin_status(args) -> int:
     """Reference: command/plugin_status.go (CSI plugin health)."""
     api = _client(args)
@@ -1307,6 +1352,16 @@ def build_parser() -> argparse.ArgumentParser:
     vdereg.add_argument("id")
     vdereg.add_argument("-namespace", default="default")
     vdereg.set_defaults(fn=cmd_volume_deregister)
+
+    svc = sub.add_parser("service", help="service discovery commands")
+    svcsub = svc.add_subparsers(dest="subcmd")
+    slist = svcsub.add_parser("list")
+    slist.add_argument("-namespace", default="default")
+    slist.set_defaults(fn=cmd_service_list)
+    sinfo = svcsub.add_parser("info")
+    sinfo.add_argument("name")
+    sinfo.add_argument("-namespace", default="default")
+    sinfo.set_defaults(fn=cmd_service_info)
 
     plug = sub.add_parser("plugin", help="CSI plugin commands")
     plugsub = plug.add_subparsers(dest="subcmd")

@@ -55,6 +55,7 @@ class AllocRunner:
         self._lock = threading.Lock()
         self._destroyed = False
         self._health: Optional[HealthTracker] = None
+        self._services = None
 
     # ------------------------------------------------------------------
 
@@ -142,10 +143,29 @@ class AllocRunner:
                     else None
                 ),
                 volume_paths=volume_paths,
+                service_fn=(
+                    (
+                        lambda name: self._client.rpc.service_lookup(
+                            self.alloc.namespace, name
+                        )
+                    )
+                    if self._client is not None
+                    else None
+                ),
             )
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():
             tr.start()
+        # Service registration + checks (reference: the group/task
+        # services hook via client/serviceregistration; catalog rows ride
+        # raft into the cluster's own services table)
+        if self._client is not None:
+            from .serviceregistration import ServiceWatcher
+
+            self._services = ServiceWatcher(
+                self.alloc, self.node, self._client.rpc
+            )
+            self._services.start()
         # Deployment allocs get a health watcher (reference
         # alloc_runner_hooks.go: allocHealthWatcherHook → client/allochealth)
         if self.alloc.deployment_id and self.alloc.deployment_status is None:
@@ -248,6 +268,16 @@ class AllocRunner:
                 for name, tr in self.task_runners.items():
                     if name != leader:
                         tr.kill()
+            # tasks exited on their own (batch completion, failure):
+            # deregister services and stop the check loop — the catalog
+            # must not advertise a dead instance
+            services = None
+            if status in (
+                ALLOC_CLIENT_STATUS_COMPLETE, ALLOC_CLIENT_STATUS_FAILED
+            ):
+                services, self._services = self._services, None
+        if services is not None:
+            services.stop()
         # Always sync: task_states changed even when status didn't, and the
         # client's alloc-sync loop batches/dedups by alloc id anyway.
         self.on_update(self.alloc)
@@ -268,6 +298,9 @@ class AllocRunner:
         # reporting a killed (dead, not failed) alloc as healthy.
         if self._health is not None:
             self._health.stop()
+        if self._services is not None:
+            self._services.stop()
+            self._services = None
         for tr in self.task_runners.values():
             tr.kill()
 

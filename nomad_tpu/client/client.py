@@ -51,6 +51,15 @@ class ServerRPC:
     def volumes_for_alloc(self, alloc_id: str) -> list:
         return self.server.state.volumes_for_alloc(alloc_id)
 
+    def services_register(self, regs: list) -> None:
+        self.server.services_register(regs)
+
+    def services_deregister_alloc(self, alloc_id: str) -> None:
+        self.server.services_deregister_alloc(alloc_id)
+
+    def service_lookup(self, namespace: str, name: str) -> list:
+        return self.server.state.service_registrations(namespace, name)
+
     def alloc_client_addr(self, alloc_id: str):
         """(alloc, 'host:port' of its node's client fabric) or (None, None)
         — the prev-alloc migrator's cross-node lookup."""

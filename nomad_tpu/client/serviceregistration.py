@@ -1,0 +1,217 @@
+"""Client-side service registration + health checking.
+
+Reference: client/serviceregistration/ (the workload-services hook,
+nsd/ provider) and command/agent/consul/check_watcher.go — the consul
+sync's check scheduling, rebuilt against the cluster's OWN catalog (the
+native-service-discovery design): registrations ride raft into the
+services table, and this watcher pushes aggregate check status updates
+the same way the consul agent would flip a check to critical.
+
+One ServiceWatcher per alloc covers group services and every task's
+services. Checks supported: ``http`` (2xx = passing) and ``tcp``
+(connect = passing); intervals honor the check's ``interval``/``timeout``
+(defaults 10s/2s, floors 1s/0.1s).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import urllib.request
+from typing import Optional
+
+from ..structs.structs import ServiceRegistration
+
+logger = logging.getLogger("nomad_tpu.services")
+
+
+def _parse_secs(v, default: float) -> float:
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return float(v)
+    from ..jobspec.hcl import parse_duration
+
+    try:
+        return parse_duration(str(v))
+    except Exception:
+        return default
+
+
+def build_registrations(alloc, node, with_services: bool = False):
+    """Materialize the alloc's service stanzas into catalog rows.
+
+    Address selection (reference serviceregistration.GetAddress): the
+    node's advertised IP; port from the alloc's allocated network ports
+    by label, falling back to a literal numeric port_label.
+
+    with_services=True also returns the source Service stanza per row
+    (parallel list) so callers can pair checks without re-deriving the
+    mapping."""
+    job = alloc.job
+    tg = job.lookup_task_group(alloc.task_group) if job else None
+    if tg is None:
+        return ([], []) if with_services else []
+    address = ""
+    if node is not None:
+        address = node.attributes.get("unique.network.ip-address", "")
+        if not address and node.http_addr:
+            address = node.http_addr.rsplit(":", 1)[0]
+
+    # label -> allocated port value across all task network asks
+    ports: dict[str, int] = {}
+    if alloc.resources is not None:
+        for tr in alloc.resources.tasks.values():
+            for net in tr.networks:
+                for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                    ports[p.label] = p.value
+
+    def port_for(label: str) -> int:
+        if label in ports:
+            return ports[label]
+        try:
+            return int(label)
+        except (TypeError, ValueError):
+            return 0
+
+    regs: list[ServiceRegistration] = []
+    sources: list = []
+
+    def add(svc, task_name: str) -> None:
+        sources.append(svc)
+        regs.append(
+            ServiceRegistration(
+                id=(
+                    f"_nomad-{alloc.id[:8]}-{task_name or 'group'}-"
+                    f"{svc.name}-{svc.port_label}"
+                ),
+                service_name=svc.name,
+                namespace=alloc.namespace,
+                node_id=node.id if node is not None else "",
+                datacenter=node.datacenter if node is not None else "",
+                job_id=alloc.job_id,
+                alloc_id=alloc.id,
+                task_name=task_name,
+                tags=list(svc.tags),
+                address=address,
+                port=port_for(svc.port_label),
+            )
+        )
+
+    for svc in tg.services:
+        if svc.name:
+            add(svc, "")
+    for task in tg.tasks:
+        for svc in task.services:
+            if svc.name:
+                add(svc, task.name)
+    return (regs, sources) if with_services else regs
+
+
+class ServiceWatcher:
+    """Registers an alloc's services, keeps their check status fresh,
+    deregisters on stop."""
+
+    def __init__(self, alloc, node, rpc,
+                 poll_interval_s: Optional[float] = None) -> None:
+        import os
+
+        self.alloc = alloc
+        self.node = node
+        self.rpc = rpc
+        self.regs, sources = build_registrations(
+            alloc, node, with_services=True
+        )
+        # reg.id -> its source stanza's check dicts, paired by
+        # construction (a name registered on two ports keeps its own
+        # checks; a key-based lookup couldn't tell them apart)
+        self._checks = {
+            reg.id: list(svc.checks)
+            for reg, svc in zip(self.regs, sources)
+        }
+        self.poll_interval_s = (
+            poll_interval_s
+            if poll_interval_s is not None
+            else float(os.environ.get("NOMAD_CHECK_POLL_INTERVAL", "10.0"))
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        if not self.regs:
+            return
+        self._register(initial=True)
+        if any(self._checks.values()):
+            self._thread = threading.Thread(
+                target=self._check_loop, daemon=True,
+                name=f"svc-checks-{self.alloc.id[:8]}",
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self.regs:
+            try:
+                self.rpc.services_deregister_alloc(self.alloc.id)
+            except Exception:
+                logger.exception(
+                    "service deregister for alloc %s failed", self.alloc.id
+                )
+
+    # -- internals -----------------------------------------------------
+
+    def _register(self, initial: bool = False) -> None:
+        try:
+            self.rpc.services_register(self.regs)
+        except Exception:
+            if initial:
+                logger.exception(
+                    "service register for alloc %s failed", self.alloc.id
+                )
+
+    def _run_check(self, reg: ServiceRegistration, check: dict) -> bool:
+        ctype = check.get("type", "tcp")
+        timeout = _parse_secs(check.get("timeout"), 2.0)
+        timeout = max(timeout, 0.1)
+        addr = check.get("address") or reg.address or "127.0.0.1"
+        port = reg.port
+        if check.get("port"):
+            try:
+                port = int(check["port"])
+            except (TypeError, ValueError):
+                pass
+        try:
+            if ctype == "http":
+                path = check.get("path", "/")
+                proto = check.get("protocol", "http")
+                url = f"{proto}://{addr}:{port}{path}"
+                with urllib.request.urlopen(url, timeout=timeout) as resp:
+                    return 200 <= resp.status < 300
+            if ctype == "tcp":
+                with socket.create_connection((addr, port), timeout=timeout):
+                    return True
+        except Exception:
+            return False
+        logger.warning("unsupported check type %r: marking critical", ctype)
+        return False
+
+    def _check_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            changed = False
+            for reg in self.regs:
+                checks = self._checks.get(reg.id) or []
+                if not checks:
+                    continue
+                passing = all(self._run_check(reg, c) for c in checks)
+                status = "passing" if passing else "critical"
+                if reg.status != status:
+                    reg.status = status
+                    changed = True
+            if changed and not self._stop.is_set():
+                self._register()

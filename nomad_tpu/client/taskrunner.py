@@ -58,9 +58,11 @@ class TaskRunner:
         restore_state: Optional[TaskState] = None,
         device_manager=None,  # the client's configured DeviceManager
         volume_paths: Optional[dict] = None,  # volume name -> (path, ro)
+        service_fn=None,  # (name) -> [ServiceRegistration] (native SD)
     ) -> None:
         self.device_manager = device_manager
         self.volume_paths = volume_paths or {}
+        self.service_fn = service_fn
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -260,7 +262,7 @@ class TaskRunner:
         if self.task.templates:
             self._event(EVENT_TEMPLATES)
             for tmpl in self.task.templates:
-                render_template(tmpl, task_dir.dir, env)
+                render_template(tmpl, task_dir.dir, env, self.service_fn)
 
     def _start_template_watcher(self, task_dir, env: dict[str, str]) -> None:
         """change_mode lives here: the watcher re-renders and fires
@@ -293,6 +295,7 @@ class TaskRunner:
             signal_fn=signal_fn,
             restart_fn=self._template_restart.set,
             poll_interval_s=self.template_poll_interval_s,
+            service_fn=self.service_fn,
         )
         watcher.prime()
         watcher.start()

@@ -21,7 +21,7 @@ import re
 
 from ..structs.structs import Template
 
-_FUNC_RE = re.compile(r"\{\{\s*(env|key|meta)\s+\"([^\"]+)\"\s*\}\}")
+_FUNC_RE = re.compile(r"\{\{\s*(env|key|meta|service)\s+\"([^\"]+)\"\s*\}\}")
 
 
 class TemplateError(Exception):
@@ -29,7 +29,7 @@ class TemplateError(Exception):
 
 
 def compute_template(
-    tmpl: Template, task_dir: str, env: dict[str, str]
+    tmpl: Template, task_dir: str, env: dict[str, str], service_fn=None
 ) -> tuple[str, str]:
     """Render without writing: (confined destination path, content)."""
     from .allocdir import EscapeError, alloc_sandbox, confine
@@ -61,6 +61,19 @@ def compute_template(
             return env.get(arg, "")
         if fn == "meta":
             return env.get(f"NOMAD_META_{arg}", env.get(f"meta.{arg}", ""))
+        if fn == "service":
+            # native service discovery: one "address:port" per line
+            # (consul-template's {{ range service }} collapsed to the
+            # address list jobs actually template in)
+            if service_fn is None:
+                return ""
+            try:
+                regs = service_fn(arg) or []
+            except Exception:
+                return ""
+            return "\n".join(
+                f"{r.address}:{r.port}" for r in regs
+            )
         return ""  # key: no Consul KV backend
 
     rendered = _FUNC_RE.sub(repl, src)
@@ -89,10 +102,10 @@ def write_template(tmpl: Template, dest: str, content: str) -> None:
 
 
 def render_template(
-    tmpl: Template, task_dir: str, env: dict[str, str]
+    tmpl: Template, task_dir: str, env: dict[str, str], service_fn=None
 ) -> str:
     """Render to task_dir/<dest_path>; returns the destination path."""
-    dest, content = compute_template(tmpl, task_dir, env)
+    dest, content = compute_template(tmpl, task_dir, env, service_fn)
     write_template(tmpl, dest, content)
     return dest
 
@@ -118,9 +131,11 @@ class TemplateWatcher:
         signal_fn,  # (signal_name) -> None
         restart_fn,  # () -> None
         poll_interval_s: float = 2.0,
+        service_fn=None,  # (name) -> [ServiceRegistration] (native SD)
     ) -> None:
         import threading
 
+        self.service_fn = service_fn
         self.templates = list(templates)
         self.task_dir = task_dir
         self.env = env
@@ -136,7 +151,9 @@ class TemplateWatcher:
         the initial prestart render)."""
         for i, tmpl in enumerate(self.templates):
             try:
-                _, content = compute_template(tmpl, self.task_dir, self.env)
+                _, content = compute_template(
+                    tmpl, self.task_dir, self.env, self.service_fn
+                )
                 self._last[i] = content
             except TemplateError:
                 pass
@@ -168,7 +185,7 @@ class TemplateWatcher:
             for i, tmpl in enumerate(self.templates):
                 try:
                     dest, content = compute_template(
-                        tmpl, self.task_dir, self.env
+                        tmpl, self.task_dir, self.env, self.service_fn
                     )
                 except TemplateError:
                     continue

@@ -422,10 +422,16 @@ class DockerDriver(Driver):
         if cfg.task_dir:
             # the task dir rides at /local like the reference's task mounts
             binds.append(f"{cfg.task_dir}:/local")
-        # group-volume mounts resolved by the task runner (host + CSI)
+        # group-volume mounts resolved by the task runner (host + CSI);
+        # container paths must be absolute for the Docker API, so a
+        # relative destination roots at / (the filesystem drivers root
+        # theirs at the task dir)
         for m in getattr(cfg, "mounts", None) or []:
             mode = ":ro" if m.get("read_only") else ""
-            binds.append(f"{m['host_path']}:{m['task_path']}{mode}")
+            dest = m["task_path"]
+            if not dest.startswith("/"):
+                dest = "/" + dest
+            binds.append(f"{m['host_path']}:{dest}{mode}")
         host_config: dict[str, Any] = {
             "Binds": binds,
             "Memory": int(cfg.resources_memory_mb) * 1024 * 1024,

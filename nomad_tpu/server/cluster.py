@@ -250,6 +250,40 @@ class VolumeEndpoint(_Forwarder):
         return self.cs.server.state.csi_plugins()
 
 
+class ServiceEndpoint(_Forwarder):
+    """Native service discovery (reference:
+    nomad/service_registration_endpoint.go)."""
+
+    def register(self, args):
+        return self._forward(
+            "Service.register",
+            args,
+            lambda a: self.cs.server.services_register(a["regs"]),
+        )
+
+    def deregister_alloc(self, args):
+        return self._forward(
+            "Service.deregister_alloc",
+            args,
+            lambda a: self.cs.server.services_deregister_alloc(a["alloc_id"]),
+        )
+
+    def deregister(self, args):
+        return self._forward(
+            "Service.deregister",
+            args,
+            lambda a: self.cs.server.services_deregister(a["ids"]),
+        )
+
+    def list(self, args):
+        return self.cs.server.state.service_names(args.get("namespace"))
+
+    def get(self, args):
+        return self.cs.server.state.service_registrations(
+            args.get("namespace", "default"), args["name"]
+        )
+
+
 class NodeEndpoint(_Forwarder):
     def register(self, args):
         return self._forward(
@@ -534,6 +568,7 @@ class ClusterServer:
             ("Eval", EvalEndpoint(self)),
             ("Alloc", AllocEndpoint(self)),
             ("Volume", VolumeEndpoint(self)),
+            ("Service", ServiceEndpoint(self)),
             ("Namespace", NamespaceEndpoint(self)),
             ("Search", SearchEndpoint(self)),
             ("Deployment", DeploymentEndpoint(self)),
@@ -942,3 +977,14 @@ class ClusterRPC:
 
     def volumes_for_alloc(self, alloc_id: str) -> list:
         return self._call("Volume.for_alloc", {"alloc_id": alloc_id})
+
+    def services_register(self, regs: list) -> None:
+        self._call("Service.register", {"regs": regs})
+
+    def services_deregister_alloc(self, alloc_id: str) -> None:
+        self._call("Service.deregister_alloc", {"alloc_id": alloc_id})
+
+    def service_lookup(self, namespace: str, name: str) -> list:
+        return self._call(
+            "Service.get", {"namespace": namespace, "name": name}
+        )

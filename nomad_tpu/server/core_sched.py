@@ -25,6 +25,7 @@ CORE_JOB_EVAL_GC = "eval-gc"
 CORE_JOB_JOB_GC = "job-gc"
 CORE_JOB_NODE_GC = "node-gc"
 CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
+CORE_JOB_SERVICE_GC = "service-gc"
 CORE_JOB_FORCE_GC = "force-gc"
 
 # Reference defaults (nomad/config.go): EvalGCThreshold 1h, JobGCThreshold
@@ -69,11 +70,14 @@ class CoreScheduler:
             self.node_gc()
         elif kind == CORE_JOB_DEPLOYMENT_GC:
             self.deployment_gc()
+        elif kind == CORE_JOB_SERVICE_GC:
+            self.service_gc()
         elif kind == CORE_JOB_FORCE_GC:
             self.eval_gc(force=True)
             self.job_gc(force=True)
             self.deployment_gc(force=True)
             self.node_gc(force=True)
+            self.service_gc()
         else:
             raise ValueError(f"unknown core job {ev.job_id!r}")
 
@@ -177,3 +181,20 @@ class CoreScheduler:
         if gc:
             self.server.raft_apply("deployment_delete", gc)
         return len(gc)
+
+    def service_gc(self) -> int:
+        """Drop service registrations whose alloc is terminal or gone —
+        the sweep behind client-side deregistration for clients that died
+        without deregistering (reference: the native-SD analog of
+        core_sched's one-shot cleanups)."""
+        orphaned: list[str] = []
+        for ns_row in self.snapshot.service_names():
+            for reg in self.snapshot.service_registrations(
+                ns_row["namespace"], ns_row["service_name"]
+            ):
+                alloc = self.snapshot.alloc_by_id(reg.alloc_id)
+                if alloc is None or alloc.terminal_status():
+                    orphaned.append(reg.id)
+        if orphaned:
+            self.server.raft_apply("service_delete", orphaned)
+        return len(orphaned)

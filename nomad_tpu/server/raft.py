@@ -81,6 +81,15 @@ class FSM:
                 i, p[0], p[1]
             ),
             "volume_claim_release": self._apply_volume_release,
+            "service_upsert": lambda i, p: (
+                self.state.upsert_service_registrations(i, p)
+            ),
+            "service_delete": lambda i, p: (
+                self.state.delete_service_registrations(i, p)
+            ),
+            "service_delete_alloc": lambda i, p: (
+                self.state.delete_services_by_alloc(i, p)
+            ),
         }
 
     def apply(self, index: int, msg_type: str, payload) -> object:

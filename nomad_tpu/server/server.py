@@ -418,6 +418,31 @@ class Server:
             )
         self.raft_apply("volume_deregister", (namespace, vol_id))
 
+    def services_register(self, regs: list) -> None:
+        """Upsert service registrations (reference:
+        service_registration_endpoint.go Upsert). The owning alloc must
+        exist — a late register from a restarting client for a GC'd alloc
+        would otherwise resurrect a ghost instance."""
+        for reg in regs:
+            if not reg.id or not reg.service_name or not reg.alloc_id:
+                raise ValueError(
+                    "service registration requires id, service_name, alloc_id"
+                )
+            alloc = self.state.alloc_by_id(reg.alloc_id)
+            if alloc is None:
+                raise KeyError(f"alloc {reg.alloc_id} not found")
+            if alloc.terminal_status():
+                # a late check-status upsert must not resurrect rows the
+                # service GC just swept
+                raise ValueError(f"alloc {reg.alloc_id} is terminal")
+        self.raft_apply("service_upsert", regs)
+
+    def services_deregister_alloc(self, alloc_id: str) -> int:
+        return self.raft_apply("service_delete_alloc", [alloc_id])
+
+    def services_deregister(self, ids: list[str]) -> int:
+        return self.raft_apply("service_delete", ids)
+
     def job_plan(self, job: Job, diff: bool = True) -> dict:
         """Dry-run the candidate job: run the real scheduler against a
         snapshot without committing; return annotations + diff + failures
@@ -744,7 +769,9 @@ class Server:
     def _gc_loop(self, stop: threading.Event) -> None:
         """Periodic threshold GC (reference leader.go schedulePeriodic)."""
         while not stop.wait(self.gc_interval_s):
-            for kind in ("eval-gc", "job-gc", "node-gc", "deployment-gc"):
+            for kind in (
+                "eval-gc", "job-gc", "node-gc", "deployment-gc", "service-gc",
+            ):
                 self.eval_broker.enqueue(core_eval(kind))
 
     # -- client alloc updates -----------------------------------------

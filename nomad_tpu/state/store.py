@@ -67,6 +67,7 @@ TABLE_ACL_POLICIES = "acl_policy"
 TABLE_ACL_TOKENS = "acl_token"
 TABLE_VOLUMES = "volumes"
 TABLE_NAMESPACES = "namespaces"
+TABLE_SERVICES = "services"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -79,6 +80,7 @@ ALL_TABLES = (
     TABLE_ACL_TOKENS,
     TABLE_VOLUMES,
     TABLE_NAMESPACES,
+    TABLE_SERVICES,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -288,6 +290,54 @@ class _ReadMixin:
             v
             for (ns, _), v in self._tables[TABLE_VOLUMES].items()
             if ns == namespace and v.name == name
+        ]
+
+    # services ---------------------------------------------------------
+    @_locked_on_live
+    def service_names(self, namespace: Optional[str] = None) -> list[dict]:
+        """Catalog summary: one row per service name (reference:
+        ServiceRegistrationsByNamespace)."""
+        agg: dict[tuple[str, str], dict] = {}
+        for reg in self._tables[TABLE_SERVICES].values():
+            if namespace is not None and reg.namespace != namespace:
+                continue
+            row = agg.setdefault(
+                (reg.namespace, reg.service_name),
+                {
+                    "namespace": reg.namespace,
+                    "service_name": reg.service_name,
+                    "tags": set(),
+                    "instances": 0,
+                },
+            )
+            row["tags"].update(reg.tags)
+            row["instances"] += 1
+        out = [
+            {**r, "tags": sorted(r["tags"])}
+            for r in agg.values()
+        ]
+        out.sort(key=lambda r: (r["namespace"], r["service_name"]))
+        return out
+
+    @_locked_on_live
+    def service_registrations(self, namespace: str, name: str) -> list:
+        out = [
+            r
+            for r in self._tables[TABLE_SERVICES].values()
+            if r.namespace == namespace and r.service_name == name
+        ]
+        out.sort(key=lambda r: r.id)
+        return out
+
+    def service_registration_by_id(self, reg_id: str):
+        return self._tables[TABLE_SERVICES].get(reg_id)
+
+    @_locked_on_live
+    def services_by_alloc(self, alloc_id: str) -> list:
+        return [
+            r
+            for r in self._tables[TABLE_SERVICES].values()
+            if r.alloc_id == alloc_id
         ]
 
     @_locked_on_live
@@ -1240,6 +1290,56 @@ class StateStore(_ReadMixin):
                     log.warning(
                         "volume claim for alloc %s: %s", alloc.id, e
                     )
+
+    # -- services ------------------------------------------------------
+
+    def upsert_service_registrations(self, index: int, regs: list) -> None:
+        """Register/update service instances (reference:
+        state_store_service_registration.go UpsertServiceRegistrations)."""
+        with self._lock:
+            t = self._wtable(TABLE_SERVICES)
+            stored = []
+            for reg in regs:
+                reg = reg.copy()
+                existing = t.get(reg.id)
+                reg.create_index = (
+                    existing.create_index if existing else index
+                )
+                reg.modify_index = index
+                t[reg.id] = reg
+                stored.append(reg)
+            if stored:
+                self._stamp(index, TABLE_SERVICES)
+                self._publish(
+                    index, TABLE_SERVICES, stored, "ServiceRegistration"
+                )
+
+    def delete_service_registrations(self, index: int, ids: list[str]) -> int:
+        with self._lock:
+            t = self._wtable(TABLE_SERVICES)
+            gone = [t.pop(i) for i in ids if i in t]
+            if gone:
+                self._stamp(index, TABLE_SERVICES)
+                self._publish(
+                    index, TABLE_SERVICES, gone, "ServiceDeregistration"
+                )
+            return len(gone)
+
+    def delete_services_by_alloc(self, index: int, alloc_ids) -> int:
+        """Drop every registration owned by the given allocs (client
+        deregister on task stop + the GC sweep for lost clients)."""
+        drop = set(alloc_ids)
+        with self._lock:
+            t = self._wtable(TABLE_SERVICES)
+            gone = [r for r in t.values() if r.alloc_id in drop]
+            for r in gone:
+                del t[r.id]
+            if gone:
+                self._stamp(index, TABLE_SERVICES)
+                self._publish(
+                    index, TABLE_SERVICES, gone, "ServiceDeregistration"
+                )
+            return len(gone)
 
     def release_volume_claims(self, index: int, alloc_ids: list[str]) -> int:
         """Drop the given allocs' claims everywhere; returns how many

@@ -45,7 +45,15 @@ def compute_node_class(node: Node) -> str:
         hv = node.host_volumes[name]
         put("hostvol", name, hv.read_only)
     for pid in sorted(node.csi_plugins):
-        put("csiplugin", pid)
+        info = node.csi_plugins[pid]
+        # health/capability must be part of the class: feasibility is
+        # memoized per computed_class, and CSIVolumeChecker reads these
+        put(
+            "csiplugin", pid,
+            bool(info.get("healthy")),
+            bool(info.get("controller")),
+            bool(info.get("node", True)),
+        )
     for k in sorted(node.attributes):
         if not _escaped(k):
             put("attr", k, node.attributes[k])

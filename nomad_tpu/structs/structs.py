@@ -594,6 +594,36 @@ class Service:
 
 
 @dataclass(slots=True)
+class ServiceRegistration:
+    """One task/group service instance registered in the cluster catalog
+    (reference: structs/service_registration.go — the native
+    service-discovery provider; the tree's consul sync is the external
+    analog, command/agent/consul/service_client.go)."""
+
+    id: str = ""
+    service_name: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    node_id: str = ""
+    datacenter: str = ""
+    job_id: str = ""
+    alloc_id: str = ""
+    task_name: str = ""
+    tags: list[str] = field(default_factory=list)
+    address: str = ""
+    port: int = 0
+    # aggregate check verdict pushed by the owning client's check watcher
+    # ("passing" | "critical" | "" when the service has no checks)
+    status: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "ServiceRegistration":
+        c = dataclasses.replace(self)
+        c.tags = list(self.tags)
+        return c
+
+
+@dataclass(slots=True)
 class LogConfig:
     max_files: int = 10
     max_file_size_mb: int = 10

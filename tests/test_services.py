@@ -1,0 +1,289 @@
+"""Native service discovery tests.
+
+Reference intent: client/serviceregistration/ + nomad/
+service_registration_endpoint.go + command/agent/consul/check_watcher.go
+(check scheduling), rebuilt against the cluster's own catalog.
+"""
+
+import http.server
+import os
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.serviceregistration import (
+    ServiceWatcher,
+    build_registrations,
+)
+from nomad_tpu.server import Server
+from nomad_tpu.structs.structs import (
+    AllocatedResources,
+    AllocatedTaskResources,
+    NetworkResource,
+    Port,
+    Service,
+    ServiceRegistration,
+)
+
+
+def wait_until(fn, timeout_s=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _alloc_with_services():
+    job = mock.job(id="svc-job")
+    tg = job.task_groups[0]
+    tg.services = [Service(name="web-lb", port_label="http", tags=["lb"])]
+    task = tg.tasks[0]
+    task.services = [Service(name="web", port_label="http", tags=["v1"])]
+    alloc = mock.alloc(job=job)
+    alloc.resources = AllocatedResources(
+        tasks={
+            task.name: AllocatedTaskResources(
+                cpu=100,
+                memory_mb=64,
+                networks=[
+                    NetworkResource(
+                        ip="127.0.0.1",
+                        dynamic_ports=[Port(label="http", value=23456)],
+                    )
+                ],
+            )
+        }
+    )
+    return alloc
+
+
+class TestBuildRegistrations:
+    def test_group_and_task_services(self):
+        alloc = _alloc_with_services()
+        node = mock.node()
+        node.attributes["unique.network.ip-address"] = "10.0.0.7"
+        regs = build_registrations(alloc, node)
+        assert {r.service_name for r in regs} == {"web-lb", "web"}
+        for r in regs:
+            assert r.address == "10.0.0.7"
+            assert r.port == 23456, "port resolved from allocated ports"
+            assert r.alloc_id == alloc.id
+            assert r.node_id == node.id
+        task_reg = next(r for r in regs if r.service_name == "web")
+        assert task_reg.task_name == "web"
+
+    def test_numeric_port_label(self):
+        alloc = _alloc_with_services()
+        alloc.job.task_groups[0].services = [
+            Service(name="static", port_label="8300")
+        ]
+        alloc.job.task_groups[0].tasks[0].services = []
+        regs = build_registrations(alloc, mock.node())
+        assert regs[0].port == 8300
+
+
+class TestStateStore:
+    def test_upsert_list_delete(self):
+        from nomad_tpu.state.store import StateStore
+
+        state = StateStore()
+        regs = [
+            ServiceRegistration(
+                id=f"r{i}", service_name="web", alloc_id=f"a{i}",
+                tags=["v1"], address="10.0.0.1", port=8000 + i,
+            )
+            for i in range(3)
+        ]
+        state.upsert_service_registrations(10, regs)
+        names = state.service_names("default")
+        assert names == [
+            {
+                "namespace": "default", "service_name": "web",
+                "tags": ["v1"], "instances": 3,
+            }
+        ]
+        got = state.service_registrations("default", "web")
+        assert [r.id for r in got] == ["r0", "r1", "r2"]
+        assert got[0].create_index == 10
+        # status update keeps create_index
+        regs[0].status = "critical"
+        state.upsert_service_registrations(11, [regs[0]])
+        got = state.service_registrations("default", "web")
+        assert got[0].status == "critical" and got[0].create_index == 10
+        # delete by alloc
+        n = state.delete_services_by_alloc(12, ["a0", "a2"])
+        assert n == 2
+        assert len(state.service_registrations("default", "web")) == 1
+
+
+@pytest.fixture
+def server():
+    s = Server(num_workers=2)
+    s.establish_leadership()
+    yield s
+    s.shutdown()
+
+
+def test_service_gc_reaps_orphans(server):
+    """Registrations whose alloc is gone/terminal are swept
+    (core_sched service-gc)."""
+    from nomad_tpu.server.core_sched import CoreScheduler
+
+    n = mock.node()
+    server.node_register(n)
+    server.node_heartbeat(n.id)
+    job = mock.job(id="gc-svc")
+    server.job_register(job)
+    assert wait_until(
+        lambda: server.state.allocs_by_job("default", "gc-svc"), 10
+    )
+    alloc = server.state.allocs_by_job("default", "gc-svc")[0]
+    live = ServiceRegistration(
+        id="live", service_name="web", alloc_id=alloc.id
+    )
+    orphan = ServiceRegistration(
+        id="orphan", service_name="web", alloc_id="no-such-alloc"
+    )
+    server.state.upsert_service_registrations(
+        server.state.latest_index() + 1, [live, orphan]
+    )
+    CoreScheduler(server, server.state.snapshot()).service_gc()
+    ids = {r.id for r in server.state.service_registrations("default", "web")}
+    assert ids == {"live"}
+
+
+def test_service_registration_e2e(tmp_path, monkeypatch):
+    """Full stack: a job's services register on run, resolve through the
+    template {{ service }} function, and deregister on stop."""
+    monkeypatch.setenv("NOMAD_CHECK_POLL_INTERVAL", "0.2")
+    from nomad_tpu.client import Client, ServerRPC
+
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    client = None
+    try:
+        client = Client(ServerRPC(server), data_dir=str(tmp_path / "c0"))
+        client.start()
+        assert client.wait_registered(10)
+
+        job = mock.job(id="svc-e2e")
+        job.datacenters = [client.node.datacenter]
+        tg = job.task_groups[0]
+        tg.count = 1
+        task = tg.tasks[0]
+        task.driver = "mock"
+        task.config = {}
+        task.services = [Service(name="db", port_label="5432")]
+        server.job_register(job)
+
+        assert wait_until(
+            lambda: server.state.service_registrations("default", "db"), 15
+        )
+        regs = server.state.service_registrations("default", "db")
+        assert len(regs) == 1
+        assert regs[0].port == 5432
+        assert regs[0].job_id == "svc-e2e"
+
+        # the template engine resolves {{ service "db" }}
+        from nomad_tpu.client.template import compute_template
+        from nomad_tpu.structs.structs import Template
+
+        tmpl = Template(
+            embedded_tmpl='upstream {{ service "db" }}',
+            dest_path="local/out.conf",
+        )
+        _, content = compute_template(
+            tmpl, str(tmp_path / "c0"), {},
+            service_fn=lambda n: client.rpc.service_lookup("default", n),
+        )
+        assert content == f"upstream {regs[0].address}:5432"
+
+        # stop the job: the watcher deregisters
+        server.job_deregister("default", "svc-e2e", purge=False)
+        assert wait_until(
+            lambda: not server.state.service_registrations("default", "db"),
+            15,
+        )
+    finally:
+        if client is not None:
+            client.shutdown()
+        server.shutdown()
+
+
+def test_check_watcher_flips_status(tmp_path):
+    """An http check marks the registration passing while the endpoint
+    answers 2xx and critical when it dies."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    try:
+        job = mock.job(id="checked")
+        tg = job.task_groups[0]
+        svc = Service(name="checked-web", port_label=str(port))
+        svc.checks = [{"name": "up", "type": "http", "path": "/"}]
+        tg.tasks[0].services = [svc]
+        alloc = mock.alloc(job=job)
+        server.state.upsert_allocs(
+            server.state.latest_index() + 1, [alloc]
+        )
+        node = mock.node()
+        node.attributes["unique.network.ip-address"] = "127.0.0.1"
+
+        class RPC:
+            def services_register(self, regs):
+                server.state.upsert_service_registrations(
+                    server.state.latest_index() + 1, regs
+                )
+
+            def services_deregister_alloc(self, alloc_id):
+                server.state.delete_services_by_alloc(
+                    server.state.latest_index() + 1, [alloc_id]
+                )
+
+        w = ServiceWatcher(alloc, node, RPC(), poll_interval_s=0.1)
+        w.start()
+        try:
+            assert wait_until(
+                lambda: any(
+                    r.status == "passing"
+                    for r in server.state.service_registrations(
+                        "default", "checked-web"
+                    )
+                ),
+                5,
+            ), "live endpoint should report passing"
+            httpd.shutdown()
+            httpd.server_close()
+            assert wait_until(
+                lambda: any(
+                    r.status == "critical"
+                    for r in server.state.service_registrations(
+                        "default", "checked-web"
+                    )
+                ),
+                5,
+            ), "dead endpoint should report critical"
+        finally:
+            w.stop()
+        assert server.state.service_registrations(
+            "default", "checked-web"
+        ) == [], "stop deregisters"
+    finally:
+        server.shutdown()
