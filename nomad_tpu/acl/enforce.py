@@ -19,7 +19,9 @@ from .policy import (
     CAP_READ_FS,
     CAP_READ_JOB,
     CAP_READ_LOGS,
+    CAP_READ_SECRET,
     CAP_SUBMIT_JOB,
+    CAP_WRITE_SECRET,
 )
 
 
@@ -63,6 +65,13 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     # csi_endpoint.go: plugin list/read allowed with namespace read)
     ("GET", re.compile(r"^/v1/plugins$"), CAP_READ_JOB),
     ("GET", re.compile(r"^/v1/plugin/csi/.*$"), CAP_READ_JOB),
+    # embedded secrets store: explicit capabilities, never implied by
+    # namespace read (values are sensitive)
+    ("GET", re.compile(r"^/v1/secrets$"), CAP_READ_SECRET),
+    ("GET", re.compile(r"^/v1/secret/.*$"), CAP_READ_SECRET),
+    ("PUT", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
+    ("POST", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
+    ("DELETE", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
     # native service discovery (reference
     # service_registration_endpoint.go: read-job to list, submit-job to
     # delete a registration)

@@ -36,6 +36,11 @@ CAP_ALLOC_EXEC = "alloc-exec"
 CAP_ALLOC_LIFECYCLE = "alloc-lifecycle"
 CAP_SCALE_JOB = "scale-job"
 CAP_ALLOC_NODE_EXEC = "alloc-node-exec"
+# embedded secrets store (the Vault-analog; reference: Nomad variables
+# ACL + vault policy scoping). NOT granted by the "read" shorthand — a
+# read-only token must not see secret values unless explicitly given.
+CAP_READ_SECRET = "read-secret"
+CAP_WRITE_SECRET = "write-secret"
 
 NAMESPACE_CAPABILITIES = [
     CAP_DENY,
@@ -49,6 +54,8 @@ NAMESPACE_CAPABILITIES = [
     CAP_ALLOC_LIFECYCLE,
     CAP_SCALE_JOB,
     CAP_ALLOC_NODE_EXEC,
+    CAP_READ_SECRET,
+    CAP_WRITE_SECRET,
 ]
 
 _READ_CAPS = [CAP_LIST_JOBS, CAP_READ_JOB]
@@ -60,6 +67,8 @@ _WRITE_CAPS = _READ_CAPS + [
     CAP_ALLOC_EXEC,
     CAP_ALLOC_LIFECYCLE,
     CAP_SCALE_JOB,
+    CAP_READ_SECRET,
+    CAP_WRITE_SECRET,
 ]
 
 POLICY_DENY = "deny"

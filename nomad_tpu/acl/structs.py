@@ -47,6 +47,10 @@ class ACLToken:
     policies: list[str] = field(default_factory=list)
     global_: bool = False
     create_time_ns: int = 0
+    # 0 = never expires; task-derived tokens carry a TTL and ride the
+    # client's renewal loop (reference: 1.4 token expiration +
+    # client/vaultclient renewal)
+    expiration_time_ns: int = 0
     create_index: int = 0
     modify_index: int = 0
 
@@ -74,6 +78,7 @@ class ACLToken:
             policies=list(self.policies),
             global_=self.global_,
             create_time_ns=self.create_time_ns,
+            expiration_time_ns=self.expiration_time_ns,
             create_index=self.create_index,
             modify_index=self.modify_index,
         )

@@ -69,6 +69,27 @@ class InProcessClusterRPC:
             "Service.get", {"namespace": namespace, "name": name}
         )
 
+    def secret_read(self, namespace: str, path: str):
+        return self.cluster.rpc_self(
+            "Secrets.read", {"namespace": namespace, "path": path}
+        )
+
+    def derive_token(self, alloc_id: str, task_name: str) -> dict:
+        return self.cluster.rpc_self(
+            "Secrets.derive_token",
+            {"alloc_id": alloc_id, "task_name": task_name},
+        )
+
+    def renew_token(self, accessor_id: str) -> float:
+        return self.cluster.rpc_self(
+            "Secrets.renew_token", {"accessor_id": accessor_id}
+        )
+
+    def revoke_token(self, accessor_id: str) -> None:
+        self.cluster.rpc_self(
+            "Secrets.revoke_token", {"accessor_id": accessor_id}
+        )
+
 
 @dataclass
 class AgentConfig:
@@ -105,6 +126,9 @@ class AgentConfig:
     dev_mode: bool = False
     # pprof surface (reference enable_debug: off unless dev mode)
     enable_debug: bool = False
+    # vault stanza: operator allowlist for task-derivable secret-token
+    # policies (None = unrestricted, the reference default)
+    vault_allowed_policies: Optional[list] = None
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -157,6 +181,11 @@ class Agent:
                 rpc_secret=config.rpc_secret,
                 data_dir=None if config.dev_mode else config.data_dir,
                 acl_enforce=config.acl_enabled,
+            )
+            self.server.server.vault_allowed_policies = (
+                list(config.vault_allowed_policies)
+                if config.vault_allowed_policies is not None
+                else None
             )
         if config.client_enabled:
             if self.server is not None:

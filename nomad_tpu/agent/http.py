@@ -392,6 +392,47 @@ class HTTPAgentServer:
         route("POST", "/v1/namespaces", namespace_upsert)
         route("GET", "/v1/namespace/(?P<name>[^/]+)", namespace_get)
         route("DELETE", "/v1/namespace/(?P<name>[^/]+)", namespace_delete)
+        def secrets_list(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return self.cluster.rpc_self("Secrets.list", {"namespace": ns})
+
+        def secret_get(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            entry = self.cluster.rpc_self(
+                "Secrets.read", {"namespace": ns, "path": p["path"]}
+            )
+            if entry is None:
+                raise HTTPError(404, f"secret {p['path']} not found")
+            return entry
+
+        def secret_put(p, q, body, tok):
+            from ..structs.structs import SecretEntry
+
+            ns = q.get("namespace", ["default"])[0]
+            items = (body or {}).get("Items") or {}
+            if not isinstance(items, dict):
+                raise HTTPError(400, "Items must be an object")
+            entry = SecretEntry(
+                path=p["path"], namespace=ns,
+                items={str(k): str(v) for k, v in items.items()},
+            )
+            return self.cluster.rpc_self("Secrets.upsert", {"entry": entry})
+
+        def secret_delete(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            try:
+                return self.cluster.rpc_self(
+                    "Secrets.delete", {"namespace": ns, "path": p["path"]}
+                )
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+
+        route("GET", "/v1/secrets", secrets_list)
+        route("GET", "/v1/secret/(?P<path>.+)", secret_get)
+        route("PUT", "/v1/secret/(?P<path>.+)", secret_put)
+        route("POST", "/v1/secret/(?P<path>.+)", secret_put)
+        route("DELETE", "/v1/secret/(?P<path>.+)", secret_delete)
+
         def services_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
             return self.cluster.rpc_self("Service.list", {"namespace": ns})

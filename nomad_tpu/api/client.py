@@ -50,6 +50,7 @@ class NomadClient:
         self.volumes = Volumes(self)
         self.plugins = Plugins(self)
         self.services = Services(self)
+        self.secrets = Secrets(self)
         self.namespaces = Namespaces(self)
         self.search = Search(self)
 
@@ -449,6 +450,35 @@ class Volumes(_Resource):
     def deregister(self, vol_id: str, namespace: Optional[str] = None):
         return self.c.delete(
             f"/v1/volume/{vol_id}",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+
+class Secrets(_Resource):
+    """Embedded secrets store (the Vault-analog surface)."""
+
+    def list(self, namespace: Optional[str] = None):
+        return self.c.get(
+            "/v1/secrets",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def get(self, path: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/secret/{path}",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def put(self, path: str, items: dict, namespace: Optional[str] = None):
+        return self.c.put(
+            f"/v1/secret/{path}",
+            params={"namespace": namespace or self.c.namespace},
+            body={"Items": items},
+        )
+
+    def delete(self, path: str, namespace: Optional[str] = None):
+        return self.c.delete(
+            f"/v1/secret/{path}",
             params={"namespace": namespace or self.c.namespace},
         )
 

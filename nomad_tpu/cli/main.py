@@ -180,6 +180,13 @@ def _load_agent_config(path: str):
     ab = body.block("acl")
     if ab is not None:
         cfg.acl_enabled = bool(ab.body.attrs().get("enabled", False))
+    vb = body.block("vault")
+    if vb is not None:
+        va = vb.body.attrs()
+        if "allowed_policies" in va:
+            cfg.vault_allowed_policies = [
+                str(x) for x in va["allowed_policies"]
+            ]
     return cfg
 
 
@@ -931,6 +938,50 @@ def cmd_volume_deregister(args) -> int:
     return 0
 
 
+def cmd_secret_put(args) -> int:
+    api = _client(args)
+    items = {}
+    for kv in args.items:
+        if "=" not in kv:
+            print(f"Error: item {kv!r} must be key=value", file=sys.stderr)
+            return 1
+        k, _, v = kv.partition("=")
+        items[k] = v
+    api.secrets.put(args.path, items, namespace=args.namespace)
+    print(f'Secret "{args.path}" written ({len(items)} keys)')
+    return 0
+
+
+def cmd_secret_get(args) -> int:
+    api = _client(args)
+    entry = api.secrets.get(args.path, namespace=args.namespace)
+    for k in sorted(entry.items):
+        print(f"{k} = {entry.items[k]}")
+    return 0
+
+
+def cmd_secret_list(args) -> int:
+    api = _client(args)
+    rows = api.secrets.list(namespace=args.namespace)
+    if not rows:
+        print("No secrets")
+        return 0
+    print(
+        _fmt_table(
+            [[r["path"], ",".join(r["keys"])] for r in rows],
+            header=["Path", "Keys"],
+        )
+    )
+    return 0
+
+
+def cmd_secret_delete(args) -> int:
+    api = _client(args)
+    api.secrets.delete(args.path, namespace=args.namespace)
+    print(f'Secret "{args.path}" deleted')
+    return 0
+
+
 def cmd_service_list(args) -> int:
     """Reference: command/service_list.go."""
     api = _client(args)
@@ -1352,6 +1403,25 @@ def build_parser() -> argparse.ArgumentParser:
     vdereg.add_argument("id")
     vdereg.add_argument("-namespace", default="default")
     vdereg.set_defaults(fn=cmd_volume_deregister)
+
+    sec = sub.add_parser("secret", help="embedded secrets store commands")
+    secsub = sec.add_subparsers(dest="subcmd")
+    sput = secsub.add_parser("put")
+    sput.add_argument("path")
+    sput.add_argument("items", nargs="+", help="key=value ...")
+    sput.add_argument("-namespace", default="default")
+    sput.set_defaults(fn=cmd_secret_put)
+    sget = secsub.add_parser("get")
+    sget.add_argument("path")
+    sget.add_argument("-namespace", default="default")
+    sget.set_defaults(fn=cmd_secret_get)
+    sls = secsub.add_parser("list")
+    sls.add_argument("-namespace", default="default")
+    sls.set_defaults(fn=cmd_secret_list)
+    sdel = secsub.add_parser("delete")
+    sdel.add_argument("path")
+    sdel.add_argument("-namespace", default="default")
+    sdel.set_defaults(fn=cmd_secret_delete)
 
     svc = sub.add_parser("service", help="service discovery commands")
     svcsub = svc.add_subparsers(dest="subcmd")

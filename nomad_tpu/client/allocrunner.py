@@ -152,6 +152,20 @@ class AllocRunner:
                     if self._client is not None
                     else None
                 ),
+                secret_fn=(
+                    (
+                        lambda path: self._client.rpc.secret_read(
+                            self.alloc.namespace, path
+                        )
+                    )
+                    if self._client is not None
+                    else None
+                ),
+                vault_client=(
+                    self._client.vault_client
+                    if self._client is not None
+                    else None
+                ),
             )
             self.task_runners[task.name] = tr
         for tr in self.task_runners.values():

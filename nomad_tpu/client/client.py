@@ -60,6 +60,18 @@ class ServerRPC:
     def service_lookup(self, namespace: str, name: str) -> list:
         return self.server.state.service_registrations(namespace, name)
 
+    def secret_read(self, namespace: str, path: str):
+        return self.server.state.secret_by_path(namespace, path)
+
+    def derive_token(self, alloc_id: str, task_name: str) -> dict:
+        return self.server.derive_task_token(alloc_id, task_name)
+
+    def renew_token(self, accessor_id: str) -> float:
+        return self.server.renew_task_token(accessor_id)
+
+    def revoke_token(self, accessor_id: str) -> None:
+        self.server.acl_token_delete([accessor_id])
+
     def alloc_client_addr(self, alloc_id: str):
         """(alloc, 'host:port' of its node's client fabric) or (None, None)
         — the prev-alloc migrator's cross-node lookup."""
@@ -116,6 +128,11 @@ class Client:
 
         self.csi_manager = CSIManager(data_dir, node_id=self.node.id)
         self.csi_manager.register_from_config(csi_plugins or {})
+        # Task secrets-token derivation + renewal (reference
+        # client/vaultclient; the server mints TTL'd cluster tokens).
+        from .vaultclient import VaultClient
+
+        self.vault_client = VaultClient(rpc)
         self._fingerprint_drivers()
         self._fingerprint_devices()
         self._fingerprint_csi()
@@ -151,6 +168,7 @@ class Client:
 
     def start(self) -> None:
         self.endpoints.start()
+        self.vault_client.start()
         # Reverse-dial fallback (reference client_rpc.go): park sessions
         # on the servers so they can reach us even when forward-dial to
         # our advertised address fails (NAT/firewall). Enabled whenever
@@ -190,6 +208,7 @@ class Client:
         if kill_allocs:
             for ar in list(self.alloc_runners.values()):
                 ar.destroy()
+        self.vault_client.stop()
         self.csi_manager.shutdown()
         self.state_db.close()
 

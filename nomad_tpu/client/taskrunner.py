@@ -24,6 +24,7 @@ from .logmon import LogRotator
 from .restarts import DECISION_RESTART, RestartTracker
 from .taskenv import build_env, interpolate
 from .template import TemplateError, render_template
+from .vaultclient import VaultClientError
 
 logger = logging.getLogger("nomad_tpu.taskrunner")
 
@@ -59,10 +60,15 @@ class TaskRunner:
         device_manager=None,  # the client's configured DeviceManager
         volume_paths: Optional[dict] = None,  # volume name -> (path, ro)
         service_fn=None,  # (name) -> [ServiceRegistration] (native SD)
+        secret_fn=None,  # (path) -> SecretEntry | None (embedded Vault)
+        vault_client=None,  # the client's VaultClient (token lifecycle)
     ) -> None:
         self.device_manager = device_manager
         self.volume_paths = volume_paths or {}
         self.service_fn = service_fn
+        self.secret_fn = secret_fn
+        self.vault_client = vault_client
+        self._vault_accessor: Optional[str] = None
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -111,6 +117,11 @@ class TaskRunner:
             for r in self._rotators:
                 r.stop()
             self._stop_template_watcher()
+            if self._vault_accessor and self.vault_client is not None:
+                # task is done for good: stop renewing + revoke the
+                # derived token (reference task_runner vault_hook)
+                self.vault_client.stop_renew(self._vault_accessor)
+                self._vault_accessor = None
 
     def _run(self) -> None:
         self._event(EVENT_RECEIVED)
@@ -156,7 +167,7 @@ class TaskRunner:
                 # prestart hooks: artifacts then templates
                 try:
                     self._prestart(task_dir, env)
-                except (ArtifactError, TemplateError) as e:
+                except (ArtifactError, TemplateError, VaultClientError) as e:
                     self._event(EVENT_SETUP_FAILURE, str(e))
                     if not self._maybe_restart(success=False):
                         return
@@ -255,6 +266,25 @@ class TaskRunner:
     # -- hooks ---------------------------------------------------------
 
     def _prestart(self, task_dir, env: dict[str, str]) -> None:
+        if self.task.vault and self.vault_client is not None \
+                and self._vault_accessor is None:
+            # derive the task's secrets token (reference vault_hook
+            # Prestart: block task start until the token exists)
+            from .vaultclient import VaultClientError
+
+            try:
+                tok = self.vault_client.derive_token(
+                    self.alloc.id, self.task.name
+                )
+            except Exception as e:
+                raise VaultClientError(f"deriving task token: {e}") from e
+            self._vault_accessor = tok["accessor_id"]
+            token_path = os.path.join(task_dir.secrets_dir, "vault_token")
+            with open(token_path, "w") as f:
+                f.write(tok["secret_id"])
+            os.chmod(token_path, 0o600)
+            if self.task.vault.get("env", True):
+                env["VAULT_TOKEN"] = tok["secret_id"]
         if self.task.artifacts:
             self._event(EVENT_ARTIFACTS)
             for artifact in self.task.artifacts:
@@ -262,7 +292,9 @@ class TaskRunner:
         if self.task.templates:
             self._event(EVENT_TEMPLATES)
             for tmpl in self.task.templates:
-                render_template(tmpl, task_dir.dir, env, self.service_fn)
+                render_template(
+                    tmpl, task_dir.dir, env, self.service_fn, self.secret_fn
+                )
 
     def _start_template_watcher(self, task_dir, env: dict[str, str]) -> None:
         """change_mode lives here: the watcher re-renders and fires
@@ -296,6 +328,7 @@ class TaskRunner:
             restart_fn=self._template_restart.set,
             poll_interval_s=self.template_poll_interval_s,
             service_fn=self.service_fn,
+            secret_fn=self.secret_fn,
         )
         watcher.prime()
         watcher.start()

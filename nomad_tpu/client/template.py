@@ -21,7 +21,9 @@ import re
 
 from ..structs.structs import Template
 
-_FUNC_RE = re.compile(r"\{\{\s*(env|key|meta|service)\s+\"([^\"]+)\"\s*\}\}")
+_FUNC_RE = re.compile(
+    r"\{\{\s*(env|key|meta|service|secret)\s+\"([^\"]+)\"\s*\}\}"
+)
 
 
 class TemplateError(Exception):
@@ -29,7 +31,8 @@ class TemplateError(Exception):
 
 
 def compute_template(
-    tmpl: Template, task_dir: str, env: dict[str, str], service_fn=None
+    tmpl: Template, task_dir: str, env: dict[str, str], service_fn=None,
+    secret_fn=None,
 ) -> tuple[str, str]:
     """Render without writing: (confined destination path, content)."""
     from .allocdir import EscapeError, alloc_sandbox, confine
@@ -74,6 +77,29 @@ def compute_template(
             return "\n".join(
                 f"{r.address}:{r.port}" for r in regs
             )
+        if fn == "secret":
+            # {{ secret "path:key" }} reads the embedded secrets store
+            # (the consul-template vault function collapsed to one
+            # path:key lookup; values never transit the event stream)
+            if secret_fn is None:
+                return ""
+            path, _, key = arg.partition(":")
+            try:
+                entry = secret_fn(path)
+            except Exception as e:
+                # transient lookup failure must FAIL the render, not
+                # render an empty credential (prestart then retries; the
+                # watcher skips the poll instead of flip-flopping)
+                raise TemplateError(
+                    f"secret lookup {path!r} failed: {e}"
+                ) from e
+            if entry is None:
+                return ""
+            if key:
+                return entry.items.get(key, "")
+            return "\n".join(
+                f"{k}={v}" for k, v in sorted(entry.items.items())
+            )
         return ""  # key: no Consul KV backend
 
     rendered = _FUNC_RE.sub(repl, src)
@@ -102,10 +128,13 @@ def write_template(tmpl: Template, dest: str, content: str) -> None:
 
 
 def render_template(
-    tmpl: Template, task_dir: str, env: dict[str, str], service_fn=None
+    tmpl: Template, task_dir: str, env: dict[str, str], service_fn=None,
+    secret_fn=None,
 ) -> str:
     """Render to task_dir/<dest_path>; returns the destination path."""
-    dest, content = compute_template(tmpl, task_dir, env, service_fn)
+    dest, content = compute_template(
+        tmpl, task_dir, env, service_fn, secret_fn
+    )
     write_template(tmpl, dest, content)
     return dest
 
@@ -132,10 +161,12 @@ class TemplateWatcher:
         restart_fn,  # () -> None
         poll_interval_s: float = 2.0,
         service_fn=None,  # (name) -> [ServiceRegistration] (native SD)
+        secret_fn=None,  # (path) -> SecretEntry | None
     ) -> None:
         import threading
 
         self.service_fn = service_fn
+        self.secret_fn = secret_fn
         self.templates = list(templates)
         self.task_dir = task_dir
         self.env = env
@@ -152,7 +183,8 @@ class TemplateWatcher:
         for i, tmpl in enumerate(self.templates):
             try:
                 _, content = compute_template(
-                    tmpl, self.task_dir, self.env, self.service_fn
+                    tmpl, self.task_dir, self.env, self.service_fn,
+                    self.secret_fn,
                 )
                 self._last[i] = content
             except TemplateError:
@@ -185,7 +217,8 @@ class TemplateWatcher:
             for i, tmpl in enumerate(self.templates):
                 try:
                     dest, content = compute_template(
-                        tmpl, self.task_dir, self.env, self.service_fn
+                        tmpl, self.task_dir, self.env, self.service_fn,
+                        self.secret_fn,
                     )
                 except TemplateError:
                     continue

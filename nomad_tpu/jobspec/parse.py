@@ -175,6 +175,13 @@ def _task(b: Block) -> Task:
         task.resources = _resources(rb)
     task.constraints = [_constraint(c) for c in b.body.blocks("constraint")]
     task.affinities = [_affinity(c) for c in b.body.blocks("affinity")]
+    vb = b.body.block("vault")
+    if vb is not None:
+        va = vb.body.attrs()
+        task.vault = {
+            "policies": [str(x) for x in va.get("policies", [])],
+            "env": bool(va.get("env", True)),
+        }
     for vm in b.body.blocks("volume_mount"):
         vma = vm.body.attrs()
         task.volume_mounts.append(

@@ -250,6 +250,68 @@ class VolumeEndpoint(_Forwarder):
         return self.cs.server.state.csi_plugins()
 
 
+class SecretsEndpoint(_Forwarder):
+    """Embedded secrets store + task-token derivation (the Vault-analog
+    server side; reference nomad/vault.go + client/vaultclient)."""
+
+    def upsert(self, args):
+        return self._forward(
+            "Secrets.upsert",
+            args,
+            lambda a: self.cs.server.secret_upsert(a["entry"]),
+        )
+
+    def delete(self, args):
+        return self._forward(
+            "Secrets.delete",
+            args,
+            lambda a: self.cs.server.secret_delete(
+                a["namespace"], a["path"]
+            ),
+        )
+
+    def read(self, args):
+        return self.cs.server.state.secret_by_path(
+            args.get("namespace", "default"), args["path"]
+        )
+
+    def list(self, args):
+        # redact values in listings — only `read` of a named path
+        # returns items
+        out = []
+        for e in self.cs.server.state.secrets(args.get("namespace")):
+            out.append({
+                "path": e.path,
+                "namespace": e.namespace,
+                "keys": sorted(e.items),
+                "modify_index": e.modify_index,
+            })
+        return out
+
+    def derive_token(self, args):
+        return self._forward(
+            "Secrets.derive_token",
+            args,
+            lambda a: self.cs.server.derive_task_token(
+                a["alloc_id"], a["task_name"]
+            ),
+        )
+
+    def renew_token(self, args):
+        return self._forward(
+            "Secrets.renew_token",
+            args,
+            lambda a: self.cs.server.renew_task_token(a["accessor_id"]),
+        )
+
+    def revoke_token(self, args):
+        return self._forward(
+            "Secrets.revoke_token",
+            args,
+            lambda a: self.cs.server.acl_token_delete([a["accessor_id"]]),
+        )
+
+
 class ServiceEndpoint(_Forwarder):
     """Native service discovery (reference:
     nomad/service_registration_endpoint.go)."""
@@ -569,6 +631,7 @@ class ClusterServer:
             ("Alloc", AllocEndpoint(self)),
             ("Volume", VolumeEndpoint(self)),
             ("Service", ServiceEndpoint(self)),
+            ("Secrets", SecretsEndpoint(self)),
             ("Namespace", NamespaceEndpoint(self)),
             ("Search", SearchEndpoint(self)),
             ("Deployment", DeploymentEndpoint(self)),
@@ -988,3 +1051,22 @@ class ClusterRPC:
         return self._call(
             "Service.get", {"namespace": namespace, "name": name}
         )
+
+    def secret_read(self, namespace: str, path: str):
+        return self._call(
+            "Secrets.read", {"namespace": namespace, "path": path}
+        )
+
+    def derive_token(self, alloc_id: str, task_name: str) -> dict:
+        return self._call(
+            "Secrets.derive_token",
+            {"alloc_id": alloc_id, "task_name": task_name},
+        )
+
+    def renew_token(self, accessor_id: str) -> float:
+        return self._call(
+            "Secrets.renew_token", {"accessor_id": accessor_id}
+        )
+
+    def revoke_token(self, accessor_id: str) -> None:
+        self._call("Secrets.revoke_token", {"accessor_id": accessor_id})

@@ -26,6 +26,7 @@ CORE_JOB_JOB_GC = "job-gc"
 CORE_JOB_NODE_GC = "node-gc"
 CORE_JOB_DEPLOYMENT_GC = "deployment-gc"
 CORE_JOB_SERVICE_GC = "service-gc"
+CORE_JOB_TOKEN_GC = "token-gc"
 CORE_JOB_FORCE_GC = "force-gc"
 
 # Reference defaults (nomad/config.go): EvalGCThreshold 1h, JobGCThreshold
@@ -72,12 +73,15 @@ class CoreScheduler:
             self.deployment_gc()
         elif kind == CORE_JOB_SERVICE_GC:
             self.service_gc()
+        elif kind == CORE_JOB_TOKEN_GC:
+            self.token_gc()
         elif kind == CORE_JOB_FORCE_GC:
             self.eval_gc(force=True)
             self.job_gc(force=True)
             self.deployment_gc(force=True)
             self.node_gc(force=True)
             self.service_gc()
+            self.token_gc()
         else:
             raise ValueError(f"unknown core job {ev.job_id!r}")
 
@@ -181,6 +185,18 @@ class CoreScheduler:
         if gc:
             self.server.raft_apply("deployment_delete", gc)
         return len(gc)
+
+    def token_gc(self) -> int:
+        """Delete expired ACL tokens (reference: 1.4's
+        ExpiredACLTokenGC; ours come from task-derived secrets tokens)."""
+        from ..structs import now_ns as _now
+
+        expired = self.snapshot.expired_acl_tokens(_now())
+        if expired:
+            self.server.raft_apply(
+                "acl_token_delete", [t.accessor_id for t in expired]
+            )
+        return len(expired)
 
     def service_gc(self) -> int:
         """Drop service registrations whose alloc is terminal or gone —

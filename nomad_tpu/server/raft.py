@@ -90,6 +90,10 @@ class FSM:
             "service_delete_alloc": lambda i, p: (
                 self.state.delete_services_by_alloc(i, p)
             ),
+            "secret_upsert": lambda i, p: self.state.upsert_secret(i, p),
+            "secret_delete": lambda i, p: self.state.delete_secret(
+                i, p[0], p[1]
+            ),
         }
 
     def apply(self, index: int, msg_type: str, payload) -> object:

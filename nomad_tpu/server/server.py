@@ -131,7 +131,7 @@ class Server:
 
         # Token→ACL resolution cache, invalidated by acl table index
         # (reference nomad/acl.go aclCache).
-        self._acl_cache: dict[str, tuple[int, object]] = {}
+        self._acl_cache: dict[str, tuple[int, object, int]] = {}
         self._acl_bootstrap_lock = threading.Lock()
 
         # Single writer draining unblocked-eval re-queues (see
@@ -310,6 +310,15 @@ class Server:
         job = job.copy()
         job.canonicalize()
         job.validate()
+        # Fail fast on vault policies outside the operator allowlist
+        # (reference job_endpoint.go Register → validateJob vault check);
+        # derive_task_token re-checks at mint time.
+        for tg in job.task_groups:
+            for task in tg.tasks:
+                if task.vault:
+                    self._check_vault_policies(
+                        list(task.vault.get("policies", []))
+                    )
         self._ensure_namespace(job.namespace)
         if job.is_periodic():
             # A malformed cron spec must be rejected at the API, not fire
@@ -417,6 +426,86 @@ class Server:
                 f"volume {vol_id} has {len(vol.claims)} active claims"
             )
         self.raft_apply("volume_deregister", (namespace, vol_id))
+
+    # -- secrets (the embedded Vault analog) ---------------------------
+
+    def secret_upsert(self, entry) -> None:
+        if not entry.path or not entry.path.strip("/"):
+            raise ValueError("secret requires a path")
+        self.raft_apply("secret_upsert", entry)
+
+    def secret_delete(self, namespace: str, path: str) -> None:
+        if self.state.secret_by_path(namespace, path) is None:
+            raise KeyError(f"secret {path} not found")
+        self.raft_apply("secret_delete", (namespace, path))
+
+    DERIVED_TOKEN_TTL_S = 3600.0
+    # Operator allowlist for task-derivable policies (reference:
+    # vault stanza allowed_policies validation in nomad/vault.go — a job
+    # may only ask for policies the operator pre-approved; None = no
+    # restriction, matching the reference's default). Without this a
+    # submit-job token could mint itself any policy via a vault stanza.
+    vault_allowed_policies: Optional[list[str]] = None
+
+    def _check_vault_policies(self, policies: list[str]) -> None:
+        if self.vault_allowed_policies is None:
+            return
+        denied = [
+            p for p in policies if p not in self.vault_allowed_policies
+        ]
+        if denied:
+            raise PermissionError(
+                f"vault policies not in the operator allowlist: {denied}"
+            )
+
+    def derive_task_token(self, alloc_id: str, task_name: str) -> dict:
+        """Mint a TTL'd ACL token scoped to the task's vault.policies
+        (reference nomad/vault.go DeriveVaultToken via the Vault server;
+        here the token is a first-class cluster token the client renews).
+        Returns {"secret_id", "accessor_id", "ttl_s"}."""
+        from ..acl.structs import ACLToken
+
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id} not found")
+        if alloc.terminal_status():
+            raise ValueError(f"alloc {alloc_id} is terminal")
+        job = alloc.job or self.state.job_by_id(alloc.namespace, alloc.job_id)
+        tg = job.lookup_task_group(alloc.task_group) if job else None
+        task = tg.lookup_task(task_name) if tg else None
+        if task is None:
+            raise KeyError(f"task {task_name} not in alloc {alloc_id}")
+        policies = list((task.vault or {}).get("policies", []))
+        self._check_vault_policies(policies)
+        token = ACLToken.new(
+            name=f"task-{alloc_id[:8]}-{task_name}", policies=policies
+        )
+        token.expiration_time_ns = now_ns() + int(
+            self.DERIVED_TOKEN_TTL_S * 1e9
+        )
+        self.raft_apply("acl_token_upsert", [token])
+        return {
+            "secret_id": token.secret_id,
+            "accessor_id": token.accessor_id,
+            "ttl_s": self.DERIVED_TOKEN_TTL_S,
+        }
+
+    def renew_task_token(self, accessor_id: str) -> float:
+        """Extend a derived token's TTL (reference vaultclient
+        RenewToken → Vault lease renewal)."""
+        token = self.state.acl_token_by_accessor(accessor_id)
+        if token is None:
+            raise KeyError("token not found")
+        if not token.expiration_time_ns:
+            raise ValueError("token has no TTL")
+        if token.expiration_time_ns < now_ns():
+            raise ValueError("token already expired")
+        renewed = token.copy()
+        renewed.expiration_time_ns = now_ns() + int(
+            self.DERIVED_TOKEN_TTL_S * 1e9
+        )
+        self.raft_apply("acl_token_upsert", [renewed])
+        return self.DERIVED_TOKEN_TTL_S
 
     def services_register(self, regs: list) -> None:
         """Upsert service registrations (reference:
@@ -744,10 +833,18 @@ class Server:
         idx = self.state.table_index(TABLE_ACL_POLICIES, TABLE_ACL_TOKENS)
         cached = self._acl_cache.get(secret_id)
         if cached is not None and cached[0] == idx:
+            # Expiry is wall-clock, not table-index: check it from the
+            # cached entry so hits stay O(1) (the by-secret lookup scans
+            # the token table) without letting a compile outlive its TTL.
+            exp = cached[2]
+            if exp and exp < now_ns():
+                raise PermissionError("token expired")
             return cached[1]
         token = self.state.acl_token_by_secret(secret_id)
         if token is None:
             raise PermissionError("token not found")
+        if token.expiration_time_ns and token.expiration_time_ns < now_ns():
+            raise PermissionError("token expired")
         if token.is_management():
             acl = MANAGEMENT_ACL
         else:
@@ -759,7 +856,7 @@ class Server:
             acl = compile_policies(policies)
         if len(self._acl_cache) > 512:
             self._acl_cache.clear()
-        self._acl_cache[secret_id] = (idx, acl)
+        self._acl_cache[secret_id] = (idx, acl, token.expiration_time_ns)
         return acl
 
     def force_gc(self) -> None:
@@ -770,7 +867,8 @@ class Server:
         """Periodic threshold GC (reference leader.go schedulePeriodic)."""
         while not stop.wait(self.gc_interval_s):
             for kind in (
-                "eval-gc", "job-gc", "node-gc", "deployment-gc", "service-gc",
+                "eval-gc", "job-gc", "node-gc", "deployment-gc",
+                "service-gc", "token-gc",
             ):
                 self.eval_broker.enqueue(core_eval(kind))
 

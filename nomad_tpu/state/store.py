@@ -68,6 +68,7 @@ TABLE_ACL_TOKENS = "acl_token"
 TABLE_VOLUMES = "volumes"
 TABLE_NAMESPACES = "namespaces"
 TABLE_SERVICES = "services"
+TABLE_SECRETS = "secrets"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -81,6 +82,7 @@ ALL_TABLES = (
     TABLE_VOLUMES,
     TABLE_NAMESPACES,
     TABLE_SERVICES,
+    TABLE_SECRETS,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -331,6 +333,30 @@ class _ReadMixin:
 
     def service_registration_by_id(self, reg_id: str):
         return self._tables[TABLE_SERVICES].get(reg_id)
+
+    # secrets ----------------------------------------------------------
+    def secret_by_path(self, namespace: str, path: str):
+        return self._tables[TABLE_SECRETS].get((namespace, path))
+
+    @_locked_on_live
+    def secrets(self, namespace: Optional[str] = None) -> list:
+        if namespace is None:
+            return list(self._tables[TABLE_SECRETS].values())
+        return [
+            e
+            for (ns, _), e in self._tables[TABLE_SECRETS].items()
+            if ns == namespace
+        ]
+
+    @_locked_on_live
+    def expired_acl_tokens(self, now_ns_: int) -> list:
+        """Tokens past their expiration (the token-gc sweep's read;
+        reference: 1.4 ExpiredACLTokenGC)."""
+        return [
+            t
+            for t in self._tables[TABLE_ACL_TOKENS].values()
+            if t.expiration_time_ns and t.expiration_time_ns < now_ns_
+        ]
 
     @_locked_on_live
     def services_by_alloc(self, alloc_id: str) -> list:
@@ -1290,6 +1316,41 @@ class StateStore(_ReadMixin):
                     log.warning(
                         "volume claim for alloc %s: %s", alloc.id, e
                     )
+
+    # -- secrets -------------------------------------------------------
+
+    def upsert_secret(self, index: int, entry) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_SECRETS)
+            key = (entry.namespace, entry.path)
+            entry = entry.copy()
+            existing = t.get(key)
+            entry.create_index = existing.create_index if existing else index
+            entry.modify_index = index
+            t[key] = entry
+            self._stamp(index, TABLE_SECRETS)
+            # event subscribers must never see secret VALUES — publish a
+            # redacted row (path/namespace only)
+            self._publish(
+                index,
+                TABLE_SECRETS,
+                [dataclasses.replace(entry, items={})],
+                "SecretUpserted",
+            )
+
+    def delete_secret(self, index: int, namespace: str, path: str) -> None:
+        with self._lock:
+            t = self._wtable(TABLE_SECRETS)
+            entry = t.pop((namespace, path), None)
+            if entry is None:
+                raise KeyError(f"secret {path} not found")
+            self._stamp(index, TABLE_SECRETS)
+            self._publish(
+                index,
+                TABLE_SECRETS,
+                [dataclasses.replace(entry, items={})],
+                "SecretDeleted",
+            )
 
     # -- services ------------------------------------------------------
 

@@ -594,6 +594,26 @@ class Service:
 
 
 @dataclass(slots=True)
+class SecretEntry:
+    """A namespaced secret document in the cluster's embedded secrets
+    store (the tpu-native stand-in for the reference's external Vault:
+    nomad/vault.go talks to a Vault server; here the KV rides raft and
+    task tokens are scoped ACL tokens — same derive/renew/revoke
+    lifecycle, no external daemon)."""
+
+    path: str = ""
+    namespace: str = DEFAULT_NAMESPACE
+    items: dict[str, str] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "SecretEntry":
+        c = dataclasses.replace(self)
+        c.items = dict(self.items)
+        return c
+
+
+@dataclass(slots=True)
 class ServiceRegistration:
     """One task/group service instance registered in the cluster catalog
     (reference: structs/service_registration.go — the native
@@ -694,6 +714,9 @@ class Task:
     templates: list[Template] = field(default_factory=list)
     log_config: LogConfig = field(default_factory=LogConfig)
     volume_mounts: list[VolumeMount] = field(default_factory=list)
+    # vault stanza analog (reference structs.go Vault :7800): policies
+    # scope the task's derived secrets token; env controls VAULT_TOKEN
+    vault: Optional[dict] = None
     kill_timeout_s: float = 5.0
     kill_signal: str = ""
     leader: bool = False
@@ -716,6 +739,7 @@ class Task:
             templates=[t.copy() for t in self.templates],
             log_config=self.log_config.copy(),
             volume_mounts=[m.copy() for m in self.volume_mounts],
+            vault=dict(self.vault) if self.vault else None,
             kill_timeout_s=self.kill_timeout_s,
             kill_signal=self.kill_signal,
             leader=self.leader,
