@@ -69,9 +69,10 @@ class InProcessClusterRPC:
             "Service.get", {"namespace": namespace, "name": name}
         )
 
-    def secret_read(self, namespace: str, path: str):
+    def secret_read(self, namespace: str, path: str, token: str = ""):
         return self.cluster.rpc_self(
-            "Secrets.read", {"namespace": namespace, "path": path}
+            "Secrets.read",
+            {"namespace": namespace, "path": path, "token": token},
         )
 
     def derive_token(self, alloc_id: str, task_name: str) -> dict:

@@ -154,8 +154,8 @@ class AllocRunner:
                 ),
                 secret_fn=(
                     (
-                        lambda path: self._client.rpc.secret_read(
-                            self.alloc.namespace, path
+                        lambda path, token="": self._client.rpc.secret_read(
+                            self.alloc.namespace, path, token
                         )
                     )
                     if self._client is not None

@@ -60,7 +60,9 @@ class ServerRPC:
     def service_lookup(self, namespace: str, name: str) -> list:
         return self.server.state.service_registrations(namespace, name)
 
-    def secret_read(self, namespace: str, path: str):
+    def secret_read(self, namespace: str, path: str, token: str = ""):
+        # in-process dev shim: no ACL enforcement (the fabric endpoint
+        # enforces read-secret when the cluster runs with ACLs on)
         return self.server.state.secret_by_path(namespace, path)
 
     def derive_token(self, alloc_id: str, task_name: str) -> dict:

@@ -69,6 +69,7 @@ class TaskRunner:
         self.secret_fn = secret_fn
         self.vault_client = vault_client
         self._vault_accessor: Optional[str] = None
+        self._vault_secret: str = ""
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -154,6 +155,7 @@ class TaskRunner:
             try:
                 self.driver.recover_task(TaskHandle.from_dict(self.restore_handle))
                 restored = True
+                self._resume_vault_token(task_dir)
                 self._event(EVENT_RESTORED)
                 self.state.state = "running"
                 self.on_state_change()
@@ -265,13 +267,40 @@ class TaskRunner:
 
     # -- hooks ---------------------------------------------------------
 
+    def _secret_lookup(self, path: str):
+        """Template {{ secret }} reads authenticate with the TASK'S
+        derived token — a task without a vault stanza has no token and
+        (under ACL enforcement) reads nothing."""
+        if self.secret_fn is None:
+            return None
+        return self.secret_fn(path, self._vault_secret)
+
+    def _resume_vault_token(self, task_dir) -> None:
+        """Client-restart restore: re-enroll the persisted token for
+        renewal so it doesn't silently expire mid-run (reference: vault
+        tokens ride the client state db and resume renewal on restore)."""
+        if not self.task.vault or self.vault_client is None:
+            return
+        try:
+            with open(
+                os.path.join(task_dir.secrets_dir, ".vault_accessor")
+            ) as f:
+                accessor = f.read().strip()
+            with open(
+                os.path.join(task_dir.secrets_dir, "vault_token")
+            ) as f:
+                self._vault_secret = f.read().strip()
+        except OSError:
+            return
+        if accessor:
+            self._vault_accessor = accessor
+            self.vault_client.track(accessor)
+
     def _prestart(self, task_dir, env: dict[str, str]) -> None:
         if self.task.vault and self.vault_client is not None \
                 and self._vault_accessor is None:
             # derive the task's secrets token (reference vault_hook
             # Prestart: block task start until the token exists)
-            from .vaultclient import VaultClientError
-
             try:
                 tok = self.vault_client.derive_token(
                     self.alloc.id, self.task.name
@@ -279,10 +308,17 @@ class TaskRunner:
             except Exception as e:
                 raise VaultClientError(f"deriving task token: {e}") from e
             self._vault_accessor = tok["accessor_id"]
+            self._vault_secret = tok["secret_id"]
             token_path = os.path.join(task_dir.secrets_dir, "vault_token")
             with open(token_path, "w") as f:
                 f.write(tok["secret_id"])
             os.chmod(token_path, 0o600)
+            # accessor persisted beside the token: a restarted client
+            # resumes renewal instead of letting the token expire
+            acc_path = os.path.join(task_dir.secrets_dir, ".vault_accessor")
+            with open(acc_path, "w") as f:
+                f.write(tok["accessor_id"])
+            os.chmod(acc_path, 0o600)
             if self.task.vault.get("env", True):
                 env["VAULT_TOKEN"] = tok["secret_id"]
         if self.task.artifacts:
@@ -293,7 +329,8 @@ class TaskRunner:
             self._event(EVENT_TEMPLATES)
             for tmpl in self.task.templates:
                 render_template(
-                    tmpl, task_dir.dir, env, self.service_fn, self.secret_fn
+                    tmpl, task_dir.dir, env, self.service_fn,
+                    self._secret_lookup,
                 )
 
     def _start_template_watcher(self, task_dir, env: dict[str, str]) -> None:
@@ -328,7 +365,7 @@ class TaskRunner:
             restart_fn=self._template_restart.set,
             poll_interval_s=self.template_poll_interval_s,
             service_fn=self.service_fn,
-            secret_fn=self.secret_fn,
+            secret_fn=self._secret_lookup,
         )
         watcher.prime()
         watcher.start()

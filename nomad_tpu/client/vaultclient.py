@@ -69,6 +69,11 @@ class VaultClient:
         self._track(out["accessor_id"], float(out["ttl_s"]))
         return out
 
+    def track(self, accessor_id: str, ttl_s: float = 3600.0) -> None:
+        """Enroll an existing token for renewal (the client-restart
+        restore path: the accessor was persisted beside the token)."""
+        self._track(accessor_id, ttl_s)
+
     def stop_renew(self, accessor_id: str, revoke: bool = True) -> None:
         """Stop renewing; optionally revoke server-side (reference
         StopRenewToken + the server's token revocation on task death)."""

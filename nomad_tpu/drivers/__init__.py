@@ -11,6 +11,7 @@ from .docker import DockerDriver
 from .exec import ExecDriver
 from .java import JavaDriver
 from .mock import MockDriver
+from .qemu import QemuDriver
 from .rawexec import RawExecDriver
 
 BUILTIN_DRIVERS = {
@@ -19,6 +20,7 @@ BUILTIN_DRIVERS = {
     "exec": ExecDriver,
     "docker": DockerDriver,
     "java": JavaDriver,
+    "qemu": QemuDriver,
 }
 
 

@@ -410,7 +410,9 @@ class DockerDriver(Driver):
     # -- lifecycle ------------------------------------------------------
 
     def start_task(self, cfg: TaskConfig) -> TaskHandle:
-        conf = cfg.config or {}
+        from .configspec import DOCKER_SPEC
+
+        conf = DOCKER_SPEC.validate(cfg.config, "docker")
         image = conf.get("image")
         if not image:
             raise DriverError("docker config requires 'image'")

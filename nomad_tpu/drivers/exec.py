@@ -72,12 +72,15 @@ class ExecDriver(Driver):
         )
 
     def start_task(self, cfg: TaskConfig) -> TaskHandle:
-        command = cfg.config.get("command")
+        from .configspec import EXEC_SPEC
+
+        conf = EXEC_SPEC.validate(cfg.config, "exec")
+        command = conf.get("command")
         if not command:
             raise DriverError("exec: missing 'command' in task config")
-        args = [str(a) for a in cfg.config.get("args", [])]
+        args = [str(a) for a in conf.get("args", [])]
         cgroup = ""
-        if cfg.config.get("cgroup_v2", True) and _cgroup_available():
+        if conf.get("cgroup_v2", True) and _cgroup_available():
             cgroup = f"{CGROUP_ROOT}/nomad-tpu-{cfg.id.replace('/', '-')}"
         try:
             handle = launch_executor(

@@ -56,7 +56,9 @@ class JavaDriver(RawExecDriver):
         )
 
     def start_task(self, cfg: TaskConfig) -> TaskHandle:
-        conf = cfg.config or {}
+        from .configspec import JAVA_SPEC
+
+        conf = JAVA_SPEC.validate(cfg.config, "java")
         jar = conf.get("jar_path")
         main_class = conf.get("class")
         if not jar and not main_class:

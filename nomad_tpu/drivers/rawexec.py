@@ -136,10 +136,13 @@ class RawExecDriver(Driver):
         return Fingerprint(attributes={"driver.rawexec": "1"})
 
     def start_task(self, cfg: TaskConfig) -> TaskHandle:
-        command = cfg.config.get("command")
+        from .configspec import RAWEXEC_SPEC
+
+        conf = RAWEXEC_SPEC.validate(cfg.config, "rawexec")
+        command = conf.get("command")
         if not command:
             raise DriverError("rawexec: missing 'command' in task config")
-        args = [str(a) for a in cfg.config.get("args", [])]
+        args = [str(a) for a in conf.get("args", [])]
         stdout = open(cfg.stdout_path, "ab") if cfg.stdout_path else subprocess.DEVNULL
         stderr = open(cfg.stderr_path, "ab") if cfg.stderr_path else subprocess.DEVNULL
         env = dict(os.environ)

@@ -271,9 +271,26 @@ class SecretsEndpoint(_Forwarder):
         )
 
     def read(self, args):
-        return self.cs.server.state.secret_by_path(
-            args.get("namespace", "default"), args["path"]
-        )
+        ns = args.get("namespace", "default")
+        # Task template reads authenticate with the task's DERIVED token
+        # (the consul-template-with-vault-token model): when enforcement
+        # is on, the token's policies must grant read-secret in the
+        # namespace — a task without a vault stanza has no token and
+        # reads nothing.
+        if self.cs.acl_enforce:
+            try:
+                acl = self.cs.server.resolve_token(args.get("token", ""))
+            except PermissionError as e:
+                raise PermissionError(f"secret read: {e}") from None
+            if acl is None:
+                raise PermissionError("secret read: missing token")
+            if not acl.is_management() and not acl.allow_namespace_op(
+                ns, "read-secret"
+            ):
+                raise PermissionError(
+                    "secret read: missing 'read-secret' capability"
+                )
+        return self.cs.server.state.secret_by_path(ns, args["path"])
 
     def list(self, args):
         # redact values in listings — only `read` of a named path
@@ -1052,9 +1069,10 @@ class ClusterRPC:
             "Service.get", {"namespace": namespace, "name": name}
         )
 
-    def secret_read(self, namespace: str, path: str):
+    def secret_read(self, namespace: str, path: str, token: str = ""):
         return self._call(
-            "Secrets.read", {"namespace": namespace, "path": path}
+            "Secrets.read",
+            {"namespace": namespace, "path": path, "token": token},
         )
 
     def derive_token(self, alloc_id: str, task_name: str) -> dict:

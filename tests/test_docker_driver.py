@@ -99,7 +99,9 @@ def test_env_reaches_container(driver, tmp_path):
     assert driver.wait_task(cfg.id, timeout_s=10).exit_code == 0
     deadline = time.monotonic() + 5
     while time.monotonic() < deadline:
-        if b"VAL=from-nomad" in open(cfg.stdout_path, "rb").read():
+        if os.path.exists(cfg.stdout_path) and (
+            b"VAL=from-nomad" in open(cfg.stdout_path, "rb").read()
+        ):
             break
         time.sleep(0.05)
     assert b"VAL=from-nomad" in open(cfg.stdout_path, "rb").read()

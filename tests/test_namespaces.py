@@ -1,6 +1,8 @@
 """Namespace CRUD tests (reference nomad/namespace_endpoint.go +
 state_store namespace tables): lifecycle, registration gating, ACL."""
 
+import time
+
 import pytest
 
 from nomad_tpu import mock
@@ -247,13 +249,19 @@ def test_search_is_namespace_scoped(tmp_path):
     agent.start()
     try:
         srv = agent.server.server
-        srv.node_register(mock.node())
+        n = mock.node()
+        srv.node_register(n)
+        srv.node_heartbeat(n.id)
         srv.namespace_upsert(Namespace(name="other"))
         job = mock.job(id="scoped-job")
         job.namespace = "other"
         srv.job_register(job)
         srv.wait_for_evals(10)
+        deadline = time.monotonic() + 10
         other_allocs = srv.state.allocs_by_job("other", job.id)
+        while time.monotonic() < deadline and not other_allocs:
+            time.sleep(0.05)
+            other_allocs = srv.state.allocs_by_job("other", job.id)
         assert other_allocs
 
         api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
