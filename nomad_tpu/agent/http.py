@@ -399,7 +399,8 @@ class HTTPAgentServer:
         def secret_get(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
             entry = self.cluster.rpc_self(
-                "Secrets.read", {"namespace": ns, "path": p["path"]}
+                "Secrets.read",
+                {"namespace": ns, "path": p["path"], "token": tok or ""},
             )
             if entry is None:
                 raise HTTPError(404, f"secret {p['path']} not found")

@@ -155,7 +155,7 @@ class TaskRunner:
             try:
                 self.driver.recover_task(TaskHandle.from_dict(self.restore_handle))
                 restored = True
-                self._resume_vault_token(task_dir)
+                self._resume_vault_token(task_dir, env)
                 self._event(EVENT_RESTORED)
                 self.state.state = "running"
                 self.on_state_change()
@@ -275,10 +275,12 @@ class TaskRunner:
             return None
         return self.secret_fn(path, self._vault_secret)
 
-    def _resume_vault_token(self, task_dir) -> None:
+    def _resume_vault_token(self, task_dir, env: dict[str, str]) -> None:
         """Client-restart restore: re-enroll the persisted token for
         renewal so it doesn't silently expire mid-run (reference: vault
-        tokens ride the client state db and resume renewal on restore)."""
+        tokens ride the client state db and resume renewal on restore).
+        env gets VAULT_TOKEN back too, so a later restart of the restored
+        task starts its fresh process with the token."""
         if not self.task.vault or self.vault_client is None:
             return
         try:
@@ -295,6 +297,8 @@ class TaskRunner:
         if accessor:
             self._vault_accessor = accessor
             self.vault_client.track(accessor)
+            if self.task.vault.get("env", True) and self._vault_secret:
+                env["VAULT_TOKEN"] = self._vault_secret
 
     def _prestart(self, task_dir, env: dict[str, str]) -> None:
         if self.task.vault and self.vault_client is not None \

@@ -121,7 +121,6 @@ QEMU_SPEC = Spec([
     Attr("graceful_shutdown", "bool", default=False),
     Attr("args", "list", default=[]),
     Attr("port_map", "map", default={}),
-    Attr("command", "string"),
 ])
 
 DOCKER_SPEC = Spec([

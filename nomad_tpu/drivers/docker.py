@@ -413,9 +413,7 @@ class DockerDriver(Driver):
         from .configspec import DOCKER_SPEC
 
         conf = DOCKER_SPEC.validate(cfg.config, "docker")
-        image = conf.get("image")
-        if not image:
-            raise DriverError("docker config requires 'image'")
+        image = conf["image"]
         if conf.get("force_pull") or self.api.image_inspect(image) is None:
             self.coordinator.pull(image)
 

@@ -14,7 +14,9 @@ Config keys (same vocabulary):
   graceful_shutdown  bool — use the monitor socket for powerdown
   args               passthrough qemu arguments
   port_map           {label: guest_port} → hostfwd via user netdev
-  command            override the qemu binary (tests use a stub)
+
+The qemu binary itself is operator config (constructor), never jobspec
+config — a job-settable binary would be arbitrary host execution.
 """
 
 from __future__ import annotations
@@ -74,10 +76,12 @@ class _QemuTask:
 class QemuDriver(Driver):
     name = "qemu"
 
-    def __init__(self, image_paths: Optional[list[str]] = None) -> None:
+    def __init__(self, image_paths: Optional[list[str]] = None,
+                 qemu_binary: Optional[str] = None) -> None:
         # operator-allowed image dirs beyond the alloc dir (reference
-        # config image_paths)
+        # config image_paths) + optional binary override (tests stub it)
         self.image_paths = image_paths or []
+        self.qemu_binary = qemu_binary
         self.tasks: dict[str, _QemuTask] = {}
         self._lock = threading.Lock()
 
@@ -142,14 +146,15 @@ class QemuDriver(Driver):
         from .configspec import QEMU_SPEC
 
         conf = QEMU_SPEC.validate(cfg.config, "qemu")
-        image = conf.get("image_path")
-        if not image:
-            raise DriverError("qemu: image_path must be set")
+        image = conf["image_path"]
         if not os.path.isabs(image):
             image = os.path.join(cfg.task_dir, image)
         if not self._allowed_image(cfg.task_dir, image):
             raise DriverError("qemu: image_path is not in the allowed paths")
-        binary = conf.get("command") or shutil.which(QEMU_BINARY)
+        # binary override is OPERATOR config (constructor), never
+        # jobspec config — a job-settable binary would be arbitrary
+        # host execution, defeating the image allowlist
+        binary = self.qemu_binary or shutil.which(QEMU_BINARY)
         if not binary:
             raise DriverError(f"qemu: {QEMU_BINARY} not found")
         accelerator = conf.get("accelerator", "tcg")

@@ -1,0 +1,228 @@
+"""HCL2 expression-layer tests: functions, operators, conditionals,
+locals, dynamic blocks, variable precedence.
+
+Reference intent: jobspec2/ (hcl/v2 + custom functions, variables,
+dynamic blocks) — parse_test.go shapes.
+"""
+
+import pytest
+
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.jobspec.hcl import HCLParseError, parse
+
+
+def _attrs(src, variables=None):
+    return parse(src, variables).attrs()
+
+
+class TestExpressions:
+    def test_arithmetic_and_precedence(self):
+        a = _attrs("x = 2 + 3 * 4\ny = (2 + 3) * 4\nz = 10 / 4\nm = 7 % 3")
+        assert a["x"] == 14 and a["y"] == 20
+        assert a["z"] == 2.5 and a["m"] == 1
+
+    def test_unary(self):
+        a = _attrs("x = -5\ny = !true\nz = -(1 + 2)")
+        assert a["x"] == -5 and a["y"] is False and a["z"] == -3
+
+    def test_comparison_and_logic(self):
+        a = _attrs(
+            'x = 1 < 2 && 2 <= 2\ny = "a" == "b" || 3 != 4\nz = 2 > 3'
+        )
+        assert a["x"] is True and a["y"] is True and a["z"] is False
+
+    def test_conditional(self):
+        a = _attrs(
+            'variable "env" { default = "prod" }\n'
+            'count = var.env == "prod" ? 5 : 1'
+        )
+        assert a["count"] == 5
+
+    def test_index(self):
+        a = _attrs(
+            'variable "dcs" { default = ["dc1", "dc2"] }\n'
+            'variable "m" { default = { a = 1 } }\n'
+            'x = var.dcs[1]\ny = var.m["a"]'
+        )
+        assert a["x"] == "dc2" and a["y"] == 1
+
+    def test_functions(self):
+        a = _attrs(
+            'u = upper("abc")\n'
+            'j = join(",", ["a", "b"])\n'
+            's = split(",", "a,b,c")\n'
+            'l = length([1, 2, 3])\n'
+            'c = concat([1], [2, 3])\n'
+            'f = format("%s-%d", "web", 3)\n'
+            'mn = min(4, 2, 9)\n'
+            'r = range(3)\n'
+            'lk = lookup({ a = 1 }, "b", 42)\n'
+            'co = coalesce("", null, "x")\n'
+            'rp = replace("a.b.c", ".", "-")\n'
+        )
+        assert a["u"] == "ABC"
+        assert a["j"] == "a,b"
+        assert a["s"] == ["a", "b", "c"]
+        assert a["l"] == 3
+        assert a["c"] == [1, 2, 3]
+        assert a["f"] == "web-3"
+        assert a["mn"] == 2
+        assert a["r"] == [0, 1, 2]
+        assert a["lk"] == 42
+        assert a["co"] == "x"
+        assert a["rp"] == "a-b-c"
+
+    def test_unknown_function_errors(self):
+        with pytest.raises(HCLParseError, match="unknown function"):
+            _attrs("x = nope(1)")
+
+    def test_string_interpolation_with_expressions(self):
+        a = _attrs(
+            'variable "n" { default = 3 }\n'
+            'name = "web-${var.n * 2}"\n'
+            'flag = "${var.n > 1 ? \\"big\\" : \\"small\\"}"'
+        )
+        assert a["name"] == "web-6"
+        assert a["flag"] == "big"
+
+    def test_runtime_refs_still_pass_through(self):
+        a = _attrs('x = "${attr.kernel.name}"\ny = "${meta.rack}"')
+        assert a["x"] == "${attr.kernel.name}"
+        assert a["y"] == "${meta.rack}"
+
+
+class TestLocals:
+    def test_locals_reference_vars_and_locals(self):
+        a = _attrs(
+            'variable "base" { default = "api" }\n'
+            "locals {\n"
+            '  name = "${var.base}-svc"\n'
+            '  caps = upper(local.name)\n'
+            "}\n"
+            "x = local.name\ny = local.caps"
+        )
+        assert a["x"] == "api-svc"
+        assert a["y"] == "API-SVC"
+
+    def test_unknown_local_errors(self):
+        with pytest.raises(HCLParseError, match="unknown variable"):
+            _attrs("x = local.nope")
+
+
+class TestVariablePrecedence:
+    def test_env_overrides_default(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_VAR_region", "eu")
+        a = _attrs('variable "region" { default = "us" }\nx = var.region')
+        assert a["x"] == "eu"
+
+    def test_explicit_beats_env(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_VAR_region", "eu")
+        a = _attrs(
+            'variable "region" { default = "us" }\nx = var.region',
+            {"region": "ap"},
+        )
+        assert a["x"] == "ap"
+
+
+class TestDynamicBlocks:
+    def test_dynamic_expands_list(self):
+        body = parse(
+            'variable "ports" { default = [8080, 9090] }\n'
+            "group {\n"
+            '  dynamic "service" {\n'
+            "    for_each = var.ports\n"
+            '    labels   = ["svc-${service.key}"]\n'
+            "    content {\n"
+            "      port = service.value\n"
+            "    }\n"
+            "  }\n"
+            "}\n"
+        )
+        grp = body.block("group")
+        svcs = grp.body.blocks("service")
+        assert len(svcs) == 2
+        assert svcs[0].labels == ["svc-0"]
+        assert svcs[0].body.attrs()["port"] == 8080
+        assert svcs[1].body.attrs()["port"] == 9090
+
+    def test_dynamic_expands_map_with_iterator(self):
+        body = parse(
+            "outer {\n"
+            '  dynamic "volume" {\n'
+            '    for_each = { data = "/srv/data", logs = "/srv/logs" }\n'
+            "    iterator = v\n"
+            '    labels   = ["${v.key}"]\n'
+            "    content {\n"
+            "      source = v.value\n"
+            "    }\n"
+            "  }\n"
+            "}\n"
+        )
+        vols = body.block("outer").body.blocks("volume")
+        assert {b.labels[0]: b.body.attrs()["source"] for b in vols} == {
+            "data": "/srv/data",
+            "logs": "/srv/logs",
+        }
+
+    def test_dynamic_requires_for_each(self):
+        with pytest.raises(HCLParseError, match="for_each"):
+            parse('g { dynamic "x" { content { a = 1 } } }')
+
+
+def test_full_jobspec_with_hcl2_features():
+    """End to end: a jobspec exercising variables, locals, functions,
+    conditionals, and a dynamic group volume."""
+    src = """
+variable "env" { default = "prod" }
+variable "dcs" { default = ["dc1", "dc2"] }
+
+locals {
+  name = "web-${var.env}"
+}
+
+job "app" {
+  name        = upper(local.name)
+  datacenters = var.dcs
+  priority    = var.env == "prod" ? 80 : 50
+
+  group "g" {
+    count = length(var.dcs) * 2
+
+    dynamic "volume" {
+      for_each = ["a", "b"]
+      labels   = ["vol-${volume.value}"]
+      content {
+        type   = "host"
+        source = "src-${volume.value}"
+      }
+    }
+
+    task "t" {
+      driver = "mock"
+    }
+  }
+}
+"""
+    job = parse_job(src)
+    assert job.name == "WEB-PROD"
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.priority == 80
+    tg = job.task_groups[0]
+    assert tg.count == 4
+    assert set(tg.volumes) == {"vol-a", "vol-b"}
+    assert tg.volumes["vol-a"].source == "src-a"
+
+
+def test_var_override_string_coerced_to_default_type():
+    """CLI -var / NOMAD_VAR_ values arrive as strings; they convert to
+    the default's type (jobspec2 variable type conversion)."""
+    a = _attrs(
+        'variable "n" { default = 2 }\n'
+        'variable "on" { default = false }\n'
+        "x = var.n * 2\ny = var.on",
+        {"n": "5", "on": "true"},
+    )
+    assert a["x"] == 10
+    assert a["y"] is True
+    with pytest.raises(HCLParseError, match="cannot convert"):
+        _attrs('variable "n" { default = 2 }\nx = var.n', {"n": "abc"})

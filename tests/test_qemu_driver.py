@@ -63,7 +63,7 @@ def _cfg(tmp_path, stub, task_id="t1", **conf):
     task_dir.mkdir(parents=True, exist_ok=True)
     image = task_dir / "linux.img"
     image.write_bytes(b"fake-image")
-    base = {"image_path": str(image), "command": stub}
+    base = {"image_path": str(image)}
     base.update(conf)
     return TaskConfig(
         id=task_id,
@@ -94,7 +94,7 @@ def test_fingerprint_undetected_without_binary(monkeypatch):
 
 
 def test_arg_construction_and_graceful_shutdown(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub, graceful_shutdown=True,
                args=["-nodefaults"], accelerator="tcg")
     d.start_task(cfg)
@@ -123,7 +123,7 @@ def test_arg_construction_and_graceful_shutdown(tmp_path, stub):
 
 
 def test_port_map_builds_hostfwd(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub, port_map={"ssh": 22})
     cfg.env["NOMAD_HOST_PORT_ssh"] = "22000"
     d.start_task(cfg)
@@ -138,20 +138,20 @@ def test_port_map_builds_hostfwd(tmp_path, stub):
 
 
 def test_unknown_port_label_rejected(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub, port_map={"web": 80})
     with pytest.raises(DriverError, match="port label"):
         d.start_task(cfg)
 
 
 def test_image_path_escape_rejected(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub)
     cfg.config["image_path"] = "/etc/passwd"
     with pytest.raises(DriverError, match="allowed paths"):
         d.start_task(cfg)
     # but an operator-allowed root works
-    d2 = QemuDriver(image_paths=["/etc"])
+    d2 = QemuDriver(image_paths=["/etc"], qemu_binary=stub)
     cfg2 = _cfg(tmp_path, stub, task_id="t2")
     cfg2.config["image_path"] = "/etc/hostname"
     d2.start_task(cfg2)
@@ -160,7 +160,7 @@ def test_image_path_escape_rejected(tmp_path, stub):
 
 
 def test_memory_bounds(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub)
     cfg.resources_memory_mb = 64
     with pytest.raises(DriverError, match="memory"):
@@ -168,7 +168,7 @@ def test_memory_bounds(tmp_path, stub):
 
 
 def test_ungraceful_stop_kills(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub)  # no graceful_shutdown: no monitor
     d.start_task(cfg)
     d.stop_task("t1", timeout_s=2)
@@ -180,7 +180,7 @@ def test_ungraceful_stop_kills(tmp_path, stub):
 
 
 def test_recover_task(tmp_path, stub):
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub)
     handle = d.start_task(cfg)
     try:
@@ -195,7 +195,7 @@ def test_recover_task(tmp_path, stub):
 def test_config_spec_rejects_unknown_keys(tmp_path, stub):
     """hclspec analog: a typo'd stanza fails at dispatch
     (drivers/configspec.py)."""
-    d = QemuDriver()
+    d = QemuDriver(qemu_binary=stub)
     cfg = _cfg(tmp_path, stub, imge_path="typo")
     with pytest.raises(DriverError, match="unknown config keys"):
         d.start_task(cfg)
