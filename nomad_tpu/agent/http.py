@@ -1051,6 +1051,32 @@ class HTTPAgentServer:
                 length = int(self.headers.get("Content-Length") or 0)
                 if length:
                     raw_body = self.rfile.read(length)
+                # UI static shell (reference: http.go serves the Ember
+                # app at /ui with / redirecting there). No auth: the
+                # shell is public; every API call it makes carries the
+                # operator's token.
+                if method == "GET" and (
+                    parsed.path == "/"
+                    or parsed.path == "/ui"
+                    or parsed.path.startswith("/ui/")
+                ):
+                    if parsed.path == "/":
+                        self.send_response(307)
+                        self.send_header("Location", "/ui/")
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    from .ui import INDEX_HTML
+
+                    data = INDEX_HTML.encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/html; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 try:
                     if outer.acl_resolver is not None:
                         from ..acl.enforce import AuthError

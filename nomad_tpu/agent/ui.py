@@ -1,0 +1,357 @@
+"""Embedded web UI.
+
+Reference: ui/ (a 389-file Ember app). The tpu-native build ships a
+dependency-free single-page app embedded in the agent binary-equivalent:
+hash-routed views over the same JSON API the SDK uses (jobs, job detail
+with allocs/evals/deployments, nodes + node detail, allocations, evals,
+services, CSI plugins, topology, servers), 5s auto-refresh, ACL token
+entry stored in localStorage and sent as X-Nomad-Token. Served at /ui by
+agent/http.py (GET / redirects there, like the reference).
+"""
+
+INDEX_HTML = r"""<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+:root {
+  --bg: #0f1419; --panel: #161d26; --line: #233041; --fg: #d8e1ea;
+  --dim: #8496a8; --accent: #25ba81; --warn: #e0a458; --bad: #e06c75;
+  --info: #61afef;
+}
+* { box-sizing: border-box; }
+body { margin: 0; background: var(--bg); color: var(--fg);
+  font: 14px/1.5 -apple-system, "Segoe UI", Roboto, sans-serif; }
+header { display: flex; align-items: center; gap: 18px;
+  padding: 10px 20px; background: var(--panel);
+  border-bottom: 1px solid var(--line); position: sticky; top: 0; }
+header .logo { font-weight: 700; color: var(--accent);
+  letter-spacing: .5px; }
+nav a { color: var(--dim); text-decoration: none; margin-right: 14px; }
+nav a.active, nav a:hover { color: var(--fg); }
+#token { margin-left: auto; background: var(--bg);
+  border: 1px solid var(--line); color: var(--fg); padding: 4px 8px;
+  border-radius: 4px; width: 220px; }
+main { padding: 20px; max-width: 1200px; margin: 0 auto; }
+h1 { font-size: 18px; margin: 0 0 14px; }
+h2 { font-size: 15px; margin: 22px 0 8px; color: var(--dim); }
+table { width: 100%; border-collapse: collapse; background: var(--panel);
+  border: 1px solid var(--line); border-radius: 6px; overflow: hidden; }
+th, td { text-align: left; padding: 7px 12px;
+  border-bottom: 1px solid var(--line); }
+th { color: var(--dim); font-weight: 600; font-size: 12px;
+  text-transform: uppercase; letter-spacing: .4px; }
+tr:last-child td { border-bottom: none; }
+tbody tr:hover { background: #1b2430; }
+a { color: var(--info); text-decoration: none; }
+a:hover { text-decoration: underline; }
+.pill { display: inline-block; padding: 1px 9px; border-radius: 10px;
+  font-size: 12px; border: 1px solid var(--line); }
+.ok { color: var(--accent); border-color: var(--accent); }
+.warn { color: var(--warn); border-color: var(--warn); }
+.bad { color: var(--bad); border-color: var(--bad); }
+.dim { color: var(--dim); }
+.kv { display: grid; grid-template-columns: 220px 1fr; gap: 4px 16px;
+  background: var(--panel); border: 1px solid var(--line);
+  border-radius: 6px; padding: 12px 16px; }
+.kv dt { color: var(--dim); } .kv dd { margin: 0; word-break: break-all; }
+#err { color: var(--bad); margin-bottom: 10px; white-space: pre-wrap; }
+.bar { display: inline-block; height: 10px; background: var(--accent);
+  border-radius: 2px; vertical-align: middle; }
+.barbg { display: inline-block; width: 120px; height: 10px;
+  background: var(--line); border-radius: 2px; vertical-align: middle; }
+code { background: var(--bg); padding: 1px 5px; border-radius: 3px; }
+</style>
+</head>
+<body>
+<header>
+  <span class="logo">nomad-tpu</span>
+  <nav id="nav"></nav>
+  <input id="token" placeholder="ACL token" title="X-Nomad-Token">
+</header>
+<main>
+  <div id="err"></div>
+  <div id="view">loading…</div>
+</main>
+<script>
+"use strict";
+const $ = (s) => document.querySelector(s);
+const NAV = [
+  ["jobs", "Jobs"], ["nodes", "Clients"], ["allocs", "Allocations"],
+  ["evals", "Evaluations"], ["services", "Services"],
+  ["topology", "Topology"], ["servers", "Servers"],
+];
+$("#nav").innerHTML = NAV.map(([r, t]) =>
+  `<a href="#/${r}" data-route="${r}">${t}</a>`).join("");
+const tokenInput = $("#token");
+tokenInput.value = localStorage.getItem("nomad_token") || "";
+tokenInput.addEventListener("change", () => {
+  localStorage.setItem("nomad_token", tokenInput.value);
+  render();
+});
+
+async function api(path) {
+  const headers = {};
+  const tok = localStorage.getItem("nomad_token") || "";
+  if (tok) headers["X-Nomad-Token"] = tok;
+  const resp = await fetch(path, { headers });
+  const body = await resp.json().catch(() => ({}));
+  if (!resp.ok) throw new Error(`${path}: ${body.error || resp.status}`);
+  return body;
+}
+
+const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+const short = (id) => esc(String(id || "").slice(0, 8));
+function pill(status) {
+  const cls = {
+    running: "ok", ready: "ok", passing: "ok", complete: "ok",
+    successful: "ok", healthy: "ok", true: "ok",
+    pending: "warn", initializing: "warn", blocked: "warn",
+    paused: "warn", critical: "bad", failed: "bad", dead: "bad",
+    down: "bad", lost: "bad", false: "bad",
+  }[String(status)] || "dim";
+  return `<span class="pill ${cls}">${esc(status)}</span>`;
+}
+function table(headers, rows) {
+  if (!rows.length) return `<p class="dim">none</p>`;
+  return `<table><thead><tr>${headers.map((h) =>
+    `<th>${h}</th>`).join("")}</tr></thead><tbody>${rows.map((r) =>
+    `<tr>${r.map((c) => `<td>${c}</td>`).join("")}</tr>`).join("")
+  }</tbody></table>`;
+}
+function kv(pairs) {
+  return `<dl class="kv">${pairs.map(([k, v]) =>
+    `<dt>${esc(k)}</dt><dd>${v}</dd>`).join("")}</dl>`;
+}
+
+const views = {
+  async jobs() {
+    const jobs = await api("/v1/jobs?namespace=*");
+    return `<h1>Jobs</h1>` + table(
+      ["ID", "Namespace", "Type", "Priority", "Status"],
+      jobs.map((j) => [
+        `<a href="#/jobs/${esc(j.namespace)}/${esc(j.id)}">${esc(j.id)}</a>`,
+        esc(j.namespace), esc(j.type), j.priority, pill(j.status),
+      ]));
+  },
+
+  async job(ns, id) {
+    const [job, allocs, evals] = await Promise.all([
+      api(`/v1/job/${id}?namespace=${ns}`),
+      api(`/v1/job/${id}/allocations?namespace=${ns}`),
+      api(`/v1/job/${id}/evaluations?namespace=${ns}`),
+    ]);
+    let html = `<h1>${esc(job.name || job.id)} ${pill(job.status)}</h1>`;
+    html += kv([
+      ["ID", esc(job.id)], ["Namespace", esc(job.namespace)],
+      ["Type", esc(job.type)], ["Priority", job.priority],
+      ["Datacenters", esc((job.datacenters || []).join(", "))],
+      ["Version", job.version],
+    ]);
+    html += `<h2>Task Groups</h2>` + table(
+      ["Name", "Count", "Tasks", "Volumes"],
+      (job.task_groups || []).map((g) => [
+        esc(g.name), g.count,
+        esc((g.tasks || []).map((t) => `${t.name} (${t.driver})`)
+          .join(", ")),
+        esc(Object.keys(g.volumes || {}).join(", ") || "-"),
+      ]));
+    html += `<h2>Allocations</h2>` + table(
+      ["ID", "Group", "Node", "Desired", "Status"],
+      allocs.map((a) => [
+        `<a href="#/allocs/${esc(a.id)}">${short(a.id)}</a>`,
+        esc(a.task_group), short(a.node_id),
+        esc(a.desired_status), pill(a.client_status),
+      ]));
+    html += `<h2>Evaluations</h2>` + table(
+      ["ID", "Triggered By", "Status"],
+      evals.map((e) => [short(e.id), esc(e.triggered_by),
+        pill(e.status)]));
+    return html;
+  },
+
+  async nodes() {
+    const nodes = await api("/v1/nodes");
+    return `<h1>Clients</h1>` + table(
+      ["ID", "Name", "DC", "Class", "Drivers", "Eligibility", "Status"],
+      nodes.map((n) => [
+        `<a href="#/nodes/${esc(n.id)}">${short(n.id)}</a>`,
+        esc(n.name), esc(n.datacenter), esc(n.node_class || "-"),
+        esc(Object.keys(n.drivers || {}).join(", ")),
+        esc(n.scheduling_eligibility), pill(n.status),
+      ]));
+  },
+
+  async node(id) {
+    const [node, allocs] = await Promise.all([
+      api(`/v1/node/${id}`), api(`/v1/node/${id}/allocations`),
+    ]);
+    const res = node.resources || {};
+    let html = `<h1>${esc(node.name)} ${pill(node.status)}</h1>`;
+    html += kv([
+      ["ID", esc(node.id)], ["Datacenter", esc(node.datacenter)],
+      ["Class", esc(node.node_class || "-")],
+      ["CPU", `${res.cpu ?? "?"} MHz`],
+      ["Memory", `${res.memory_mb ?? "?"} MB`],
+      ["Disk", `${res.disk_mb ?? "?"} MB`],
+      ["Eligibility", esc(node.scheduling_eligibility)],
+      ["CSI plugins",
+        esc(Object.keys(node.csi_plugins || {}).join(", ") || "-")],
+    ]);
+    html += `<h2>Allocations</h2>` + table(
+      ["ID", "Job", "Group", "Desired", "Status"],
+      allocs.map((a) => [
+        `<a href="#/allocs/${esc(a.id)}">${short(a.id)}</a>`,
+        esc(a.job_id), esc(a.task_group), esc(a.desired_status),
+        pill(a.client_status),
+      ]));
+    html += `<h2>Attributes</h2>` + table(
+      ["Key", "Value"],
+      Object.entries(node.attributes || {}).sort()
+        .map(([k, v]) => [esc(k), esc(v)]));
+    return html;
+  },
+
+  async allocs() {
+    const allocs = await api("/v1/allocations?namespace=*");
+    return `<h1>Allocations</h1>` + table(
+      ["ID", "Job", "Group", "Node", "Desired", "Status"],
+      allocs.map((a) => [
+        `<a href="#/allocs/${esc(a.id)}">${short(a.id)}</a>`,
+        esc(a.job_id), esc(a.task_group), short(a.node_id),
+        esc(a.desired_status), pill(a.client_status),
+      ]));
+  },
+
+  async alloc(id) {
+    const a = await api(`/v1/allocation/${id}`);
+    let html = `<h1>Allocation ${short(a.id)} `
+      + `${pill(a.client_status)}</h1>`;
+    html += kv([
+      ["ID", esc(a.id)], ["Job", esc(a.job_id)],
+      ["Task Group", esc(a.task_group)], ["Node", esc(a.node_id)],
+      ["Desired", esc(a.desired_status)],
+      ["Eval", esc(a.eval_id)],
+    ]);
+    const states = a.task_states || {};
+    html += `<h2>Tasks</h2>` + table(
+      ["Task", "State", "Failed", "Restarts", "Last Event"],
+      Object.entries(states).map(([name, st]) => {
+        const ev = (st.events || []).slice(-1)[0] || {};
+        return [esc(name), pill(st.state), pill(!!st.failed),
+          st.restarts || 0,
+          esc(ev.type ? `${ev.type} ${ev.details || ""}` : "-")];
+      }));
+    return html;
+  },
+
+  async evals() {
+    const evals = await api("/v1/evaluations");
+    return `<h1>Evaluations</h1>` + table(
+      ["ID", "Job", "Type", "Triggered By", "Priority", "Status"],
+      evals.slice(0, 200).map((e) => [
+        short(e.id), esc(e.job_id), esc(e.type), esc(e.triggered_by),
+        e.priority, pill(e.status),
+      ]));
+  },
+
+  async services() {
+    const [svcs, plugins] = await Promise.all([
+      api("/v1/services"), api("/v1/plugins"),
+    ]);
+    let html = `<h1>Services</h1>` + table(
+      ["Name", "Namespace", "Tags", "Instances"],
+      svcs.map((s) => [
+        esc(s.service_name), esc(s.namespace),
+        esc((s.tags || []).join(", ")), s.instances,
+      ]));
+    html += `<h2>CSI Plugins</h2>` + table(
+      ["ID", "Controllers Healthy", "Nodes Healthy"],
+      plugins.map((p) => [
+        esc(p.id),
+        `${p.controllers_healthy}/${p.controllers_expected}`,
+        `${p.nodes_healthy}/${p.nodes_expected}`,
+      ]));
+    return html;
+  },
+
+  async topology() {
+    const [nodes, allocs] = await Promise.all([
+      api("/v1/nodes"), api("/v1/allocations?namespace=*"),
+    ]);
+    const byNode = {};
+    for (const a of allocs) {
+      if (a.client_status !== "running") continue;
+      (byNode[a.node_id] = byNode[a.node_id] || []).push(a);
+    }
+    const rows = nodes.map((n) => {
+      const na = byNode[n.id] || [];
+      const res = n.resources || {};
+      const used = na.reduce((s, a) => {
+        const tasks = (a.resources || {}).tasks || {};
+        return s + Object.values(tasks)
+          .reduce((t, r) => t + (r.cpu || 0), 0);
+      }, 0);
+      const cap = res.cpu || 1;
+      const pct = Math.min(100, Math.round(100 * used / cap));
+      return [
+        `<a href="#/nodes/${esc(n.id)}">${short(n.id)}</a>`,
+        esc(n.datacenter), pill(n.status), na.length,
+        `<span class="barbg"><span class="bar" style="width:${pct}%">` +
+        `</span></span> <span class="dim">${pct}% cpu</span>`,
+      ];
+    });
+    return `<h1>Topology</h1>` +
+      table(["Node", "DC", "Status", "Running Allocs", "CPU"], rows);
+  },
+
+  async servers() {
+    const [peers, leader] = await Promise.all([
+      api("/v1/operator/raft/configuration"),
+      api("/v1/status/leader").catch(() => "?"),
+    ]);
+    return `<h1>Servers</h1>` +
+      `<p class="dim">leader: <code>${esc(leader)}</code></p>` +
+      table(["ID", "Address", "Leader"],
+        peers.map((p) => [
+          esc(p.id), esc((p.address || []).join(":")),
+          pill(!!p.leader),
+        ]));
+  },
+};
+
+let refreshTimer = null;
+async function render() {
+  const hash = location.hash.replace(/^#\//, "") || "jobs";
+  const parts = hash.split("/").map(decodeURIComponent);
+  document.querySelectorAll("#nav a").forEach((a) =>
+    a.classList.toggle("active", a.dataset.route === parts[0]));
+  let fn, args;
+  if (parts[0] === "jobs" && parts.length === 3) {
+    fn = views.job; args = [parts[1], parts[2]];
+  } else if (parts[0] === "nodes" && parts.length === 2) {
+    fn = views.node; args = [parts[1]];
+  } else if (parts[0] === "allocs" && parts.length === 2) {
+    fn = views.alloc; args = [parts[1]];
+  } else {
+    fn = views[parts[0]] || views.jobs; args = [];
+  }
+  try {
+    const html = await fn(...args);
+    $("#err").textContent = "";
+    $("#view").innerHTML = html;
+  } catch (e) {
+    $("#err").textContent = String(e.message || e);
+  }
+  clearTimeout(refreshTimer);
+  refreshTimer = setTimeout(render, 5000);
+}
+window.addEventListener("hashchange", render);
+render();
+</script>
+</body>
+</html>
+"""

@@ -65,6 +65,13 @@ class Spec:
                         f"{who}: missing required config key "
                         f"{attr.name!r}"
                     )
+            elif attr.required and config[attr.name] in ("", None):
+                # an interpolation that resolved to empty must fail at
+                # dispatch, not as an opaque runtime error downstream
+                raise DriverError(
+                    f"{who}: required config key {attr.name!r} is empty"
+                )
+            if attr.name not in config:
                 if attr.default is not None:
                     config[attr.name] = (
                         list(attr.default)

@@ -525,9 +525,17 @@ def _resolve(value, variables: dict):
         left = _resolve(value.left, variables)
         _no_runtime([left], value.line)
         if value.op == "&&":
-            return bool(left) and bool(_resolve(value.right, variables))
+            if not left:
+                return False
+            right = _resolve(value.right, variables)
+            _no_runtime([right], value.line)
+            return bool(right)
         if value.op == "||":
-            return bool(left) or bool(_resolve(value.right, variables))
+            if left:
+                return True
+            right = _resolve(value.right, variables)
+            _no_runtime([right], value.line)
+            return bool(right)
         right = _resolve(value.right, variables)
         _no_runtime([right], value.line)
         try:
@@ -590,7 +598,15 @@ def _resolve(value, variables: dict):
 
 
 def _no_runtime(values, line: int) -> None:
+    """Deep-scan for runtime passthroughs (containers included: a list
+    element feeding join() is just as wrong as a direct operand)."""
     for v in values:
+        if isinstance(v, list):
+            _no_runtime(v, line)
+            continue
+        if isinstance(v, dict):
+            _no_runtime(list(v.values()), line)
+            continue
         if isinstance(v, RuntimePassthrough):
             raise HCLParseError(
                 f"runtime reference {v} cannot be used inside an "
@@ -627,8 +643,6 @@ def _lookup(path: str, variables: dict, line: int):
 
 def _format(fmt, *args):
     # Go-style verbs → Python: %s %d %f %q cover real jobspecs
-    import re as _re
-
     out = []
     i = 0
     ai = 0

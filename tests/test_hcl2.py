@@ -226,3 +226,19 @@ def test_var_override_string_coerced_to_default_type():
     assert a["y"] is True
     with pytest.raises(HCLParseError, match="cannot convert"):
         _attrs('variable "n" { default = 2 }\nx = var.n', {"n": "abc"})
+
+
+def test_runtime_refs_rejected_inside_expressions():
+    """A runtime ref in any expression position fails loudly instead of
+    computing on the literal '${...}' text."""
+    for src in (
+        'x = attr.cpu > 2',
+        'x = true && attr.foo',
+        'x = false || attr.foo',
+        'x = join(",", [attr.foo, "b"])',
+        'x = "${node.class == \\"gpu\\" ? 4 : 1}"',
+    ):
+        with pytest.raises(HCLParseError, match="runtime reference"):
+            _attrs(src)
+    # short-circuit keeps the guard lazy, like evaluation itself
+    assert _attrs('x = false && attr.foo')["x"] is False

@@ -1,0 +1,92 @@
+"""Web UI serving tests (reference: ui/ served by command/agent/http.go
+with / redirecting to /ui/)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+
+
+@pytest.fixture
+def agent(tmp_path):
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    a = Agent(cfg)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+def _get(agent, path, raw=False):
+    url = f"http://127.0.0.1:{agent.http_addr[1]}{path}"
+    with urllib.request.urlopen(url) as resp:
+        body = resp.read()
+        return resp.status, (body if raw else json.loads(body))
+
+
+def test_ui_serves_shell(agent):
+    url = f"http://127.0.0.1:{agent.http_addr[1]}/ui/"
+    with urllib.request.urlopen(url) as resp:
+        assert resp.status == 200
+        assert "text/html" in resp.headers["Content-Type"]
+        html = resp.read().decode()
+    assert "nomad-tpu" in html
+    assert "async jobs()" in html, "SPA script embedded"
+
+
+def test_root_redirects_to_ui(agent):
+    import urllib.error
+
+    url = f"http://127.0.0.1:{agent.http_addr[1]}/"
+    req = urllib.request.Request(url)
+
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    opener = urllib.request.build_opener(NoRedirect)
+    try:
+        resp = opener.open(req)
+        status, location = resp.status, resp.headers.get("Location")
+    except urllib.error.HTTPError as e:
+        status, location = e.code, e.headers.get("Location")
+    assert status == 307
+    assert location == "/ui/"
+
+
+def test_ui_api_contract(agent):
+    """Every endpoint the SPA consumes answers 200 with the shape the
+    JS reads (field names are load-bearing for the UI)."""
+    srv = agent.server.server
+    n = mock.node()
+    srv.node_register(n)
+    srv.node_heartbeat(n.id)
+    srv.job_register(mock.job(id="ui-job"))
+    srv.wait_for_evals(10)
+
+    status, jobs = _get(agent, "/v1/jobs?namespace=*")
+    assert status == 200 and jobs[0]["id"] == "ui-job"
+    assert {"namespace", "type", "priority", "status"} <= jobs[0].keys()
+
+    status, nodes = _get(agent, "/v1/nodes")
+    assert status == 200
+    assert {"id", "name", "datacenter", "status",
+            "scheduling_eligibility"} <= nodes[0].keys()
+
+    for ep in (
+        "/v1/allocations?namespace=*",
+        "/v1/evaluations",
+        "/v1/services",
+        "/v1/plugins",
+        "/v1/operator/raft/configuration",
+        "/v1/status/leader",
+    ):
+        status, _ = _get(agent, ep)
+        assert status == 200, ep
