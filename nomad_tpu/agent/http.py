@@ -14,6 +14,7 @@ and respond with the `X-Nomad-Index` header.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import re
@@ -34,6 +35,10 @@ from ..state.store import (
 from ..stream import SubscriptionClosedError
 
 logger = logging.getLogger("nomad_tpu.http")
+
+# per-request ?region= (reference: wrap() parses the region query param
+# and every RPC carries it for cross-region forwarding)
+_REQ_REGION = contextvars.ContextVar("nomad_http_region", default="")
 
 
 class HTTPError(Exception):
@@ -127,10 +132,25 @@ class HTTPAgentServer:
             if acl.allow_namespace_op(getattr(o, "namespace", "default"), cap)
         ]
 
+    def rpc_region(self, method: str, args):
+        """rpc_self with the request's ?region= attached, so any route
+        can address a federated region (reference: Region rides every
+        RPC's QueryOptions/WriteRequest)."""
+        region = _REQ_REGION.get()
+        if region and isinstance(args, dict) and "region" not in args:
+            args = {**args, "region": region}
+        return self.cluster.rpc_self(method, args)
+
     # -- routing -------------------------------------------------------
 
     def _register_routes(self) -> None:
         srv = self.cluster.server
+
+        def other_region():
+            """The request's ?region= when it names a DIFFERENT region
+            (local-state read handlers then forward over RPC instead)."""
+            region = _REQ_REGION.get()
+            return region if region and region != self.cluster.region else ""
 
         def route(method: str, pattern: str, fn: Callable) -> None:
             self._routes.append((method, re.compile(f"^{pattern}$"), fn))
@@ -149,6 +169,10 @@ class HTTPAgentServer:
         # -- jobs ------------------------------------------------------
         def jobs_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
+            if other_region():
+                return self.rpc_region(
+                    "Job.list", {"namespace": None if ns == "*" else ns}
+                )
             data, idx = blocking(
                 [TABLE_JOBS], q, lambda: srv.state.jobs(None if ns == "*" else ns)
             )
@@ -159,11 +183,16 @@ class HTTPAgentServer:
 
         def jobs_register(p, q, body, tok):
             job = codec.from_wire(body["Job"])
-            return self.cluster.rpc_self("Job.register", {"job": job})
+            return self.rpc_region("Job.register", {"job": job})
 
         def job_get(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            job = srv.state.job_by_id(ns, p["id"])
+            if other_region():
+                job = self.rpc_region(
+                    "Job.get", {"namespace": ns, "job_id": p["id"]}
+                )
+            else:
+                job = srv.state.job_by_id(ns, p["id"])
             if job is None:
                 raise HTTPError(404, f"job {p['id']} not found")
             return job
@@ -171,13 +200,17 @@ class HTTPAgentServer:
         def job_delete(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
             purge = q.get("purge", ["false"])[0] == "true"
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Job.deregister",
                 {"namespace": ns, "job_id": p["id"], "purge": purge},
             )
 
         def job_allocs(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
+            if other_region():
+                return self.rpc_region(
+                    "Job.allocs", {"namespace": ns, "job_id": p["id"]}
+                )
             data, idx = blocking(
                 [TABLE_ALLOCS], q, lambda: srv.state.allocs_by_job(ns, p["id"])
             )
@@ -185,17 +218,30 @@ class HTTPAgentServer:
 
         def job_evals(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
+            if other_region():
+                return self.rpc_region(
+                    "Job.evals", {"namespace": ns, "job_id": p["id"]}
+                )
             return srv.state.evals_by_job(ns, p["id"])
 
         def job_summary(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            s = srv.state.job_summary_by_id(ns, p["id"])
+            if other_region():
+                s = self.rpc_region(
+                    "Job.summary", {"namespace": ns, "job_id": p["id"]}
+                )
+            else:
+                s = srv.state.job_summary_by_id(ns, p["id"])
             if s is None:
                 raise HTTPError(404, "no summary")
             return s
 
         def job_versions(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
+            if other_region():
+                return self.rpc_region(
+                    "Job.versions", {"namespace": ns, "job_id": p["id"]}
+                )
             return srv.state.job_versions(ns, p["id"])
 
         def _search_ns(q, body) -> str:
@@ -235,7 +281,7 @@ class HTTPAgentServer:
 
         def search(p, q, body, tok):
             return _filter_search(
-                self.cluster.rpc_self(
+                self.rpc_region(
                     "Search.prefix",
                     {
                         "prefix": body.get("Prefix", ""),
@@ -248,7 +294,7 @@ class HTTPAgentServer:
 
         def search_fuzzy(p, q, body, tok):
             return _filter_search(
-                self.cluster.rpc_self(
+                self.rpc_region(
                     "Search.fuzzy",
                     {
                         "text": body.get("Text", ""),
@@ -260,16 +306,16 @@ class HTTPAgentServer:
             )
 
         def namespaces_list(p, q, body, tok):
-            return self.cluster.rpc_self("Namespace.list", {})
+            return self.rpc_region("Namespace.list", {})
 
         def namespace_upsert(p, q, body, tok):
             ns = codec.from_wire(body["Namespace"])
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Namespace.upsert", {"namespace": ns}
             )
 
         def namespace_get(p, q, body, tok):
-            ns = self.cluster.rpc_self("Namespace.get", {"name": p["name"]})
+            ns = self.rpc_region("Namespace.get", {"name": p["name"]})
             if ns is None:
                 raise HTTPError(404, f"namespace {p['name']} not found")
             return ns
@@ -278,7 +324,7 @@ class HTTPAgentServer:
             from ..rpc.client import RPCError
 
             try:
-                return self.cluster.rpc_self(
+                return self.rpc_region(
                     "Namespace.delete", {"name": p["name"]}
                 )
             except KeyError as e:
@@ -295,16 +341,16 @@ class HTTPAgentServer:
 
         def volumes_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return self.cluster.rpc_self("Volume.list", {"namespace": ns})
+            return self.rpc_region("Volume.list", {"namespace": ns})
 
         def volume_register(p, q, body, tok):
             vol = codec.from_wire(body["Volume"])
             self._ns_guard(tok, vol.namespace, "submit-job")
-            return self.cluster.rpc_self("Volume.register", {"volume": vol})
+            return self.rpc_region("Volume.register", {"volume": vol})
 
         def volume_get(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            vol = self.cluster.rpc_self(
+            vol = self.rpc_region(
                 "Volume.get", {"namespace": ns, "volume_id": p["id"]}
             )
             if vol is None:
@@ -317,7 +363,7 @@ class HTTPAgentServer:
             ns = q.get("namespace", ["default"])[0]
             self._ns_guard(tok, ns, "submit-job")
             try:
-                return self.cluster.rpc_self(
+                return self.rpc_region(
                     "Volume.deregister",
                     {"namespace": ns, "volume_id": p["id"]},
                 )
@@ -340,7 +386,7 @@ class HTTPAgentServer:
             self._ns_guard(tok, job.namespace, "submit-job")
             if job.id != p["id"]:
                 raise HTTPError(400, "job id does not match URL")
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Job.plan",
                 {"job": job, "diff": bool(body.get("Diff", True))},
             )
@@ -348,7 +394,7 @@ class HTTPAgentServer:
         def job_revert(p, q, body, tok):
             ns = body.get("Namespace", "default")
             self._ns_guard(tok, ns, "submit-job")
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Job.revert",
                 {"namespace": ns, "job_id": p["id"], "version": body["JobVersion"]},
             )
@@ -358,7 +404,7 @@ class HTTPAgentServer:
             payload = codec.from_wire(body.get("Payload"))
             if isinstance(payload, str):
                 payload = payload.encode()
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Job.dispatch",
                 {
                     "namespace": ns,
@@ -370,7 +416,7 @@ class HTTPAgentServer:
 
         def job_periodic_force(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Job.periodic_force", {"namespace": ns, "job_id": p["id"]}
             )
 
@@ -394,11 +440,11 @@ class HTTPAgentServer:
         route("DELETE", "/v1/namespace/(?P<name>[^/]+)", namespace_delete)
         def secrets_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return self.cluster.rpc_self("Secrets.list", {"namespace": ns})
+            return self.rpc_region("Secrets.list", {"namespace": ns})
 
         def secret_get(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            entry = self.cluster.rpc_self(
+            entry = self.rpc_region(
                 "Secrets.read",
                 {"namespace": ns, "path": p["path"], "token": tok or ""},
             )
@@ -417,12 +463,12 @@ class HTTPAgentServer:
                 path=p["path"], namespace=ns,
                 items={str(k): str(v) for k, v in items.items()},
             )
-            return self.cluster.rpc_self("Secrets.upsert", {"entry": entry})
+            return self.rpc_region("Secrets.upsert", {"entry": entry})
 
         def secret_delete(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
             try:
-                return self.cluster.rpc_self(
+                return self.rpc_region(
                     "Secrets.delete", {"namespace": ns, "path": p["path"]}
                 )
             except KeyError as e:
@@ -436,11 +482,14 @@ class HTTPAgentServer:
 
         def services_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return self.cluster.rpc_self("Service.list", {"namespace": ns})
+            return self.rpc_region(
+                "Service.list",
+                {"namespace": None if ns == "*" else ns},
+            )
 
         def service_get(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            regs = self.cluster.rpc_self(
+            regs = self.rpc_region(
                 "Service.get", {"namespace": ns, "name": p["name"]}
             )
             if not regs:
@@ -453,7 +502,7 @@ class HTTPAgentServer:
             # a default-namespace token deregister another namespace's
             # instances.
             ns = q.get("namespace", ["default"])[0]
-            regs = self.cluster.rpc_self(
+            regs = self.rpc_region(
                 "Service.get", {"namespace": ns, "name": p["name"]}
             )
             if not any(r.id == p["id"] for r in regs):
@@ -462,7 +511,7 @@ class HTTPAgentServer:
                     f"registration {p['id']} not found for service "
                     f"{p['name']} in namespace {ns}",
                 )
-            n = self.cluster.rpc_self(
+            n = self.rpc_region(
                 "Service.deregister", {"ids": [p["id"]]}
             )
             return {"Deregistered": n}
@@ -476,11 +525,11 @@ class HTTPAgentServer:
         )
 
         def plugins_list(p, q, body, tok):
-            plugins = self.cluster.rpc_self("Volume.plugins", {})
+            plugins = self.rpc_region("Volume.plugins", {})
             return sorted(plugins.values(), key=lambda x: x["id"])
 
         def plugin_get(p, q, body, tok):
-            plugins = self.cluster.rpc_self("Volume.plugins", {})
+            plugins = self.rpc_region("Volume.plugins", {})
             if p["id"] not in plugins:
                 raise HTTPError(404, f"plugin {p['id']} not found")
             return plugins[p["id"]]
@@ -503,6 +552,8 @@ class HTTPAgentServer:
 
         # -- nodes -----------------------------------------------------
         def nodes_list(p, q, body, tok):
+            if other_region():
+                return self.rpc_region("Node.list", {})
             data, idx = blocking([TABLE_NODES], q, srv.state.nodes)
             prefix = q.get("prefix", [""])[0]
             if prefix:
@@ -510,12 +561,21 @@ class HTTPAgentServer:
             return data, idx
 
         def node_get(p, q, body, tok):
+            if other_region():
+                node = self.rpc_region("Node.get", {"node_id": p["id"]})
+                if node is None:
+                    raise HTTPError(404, f"node {p['id']} not found")
+                return node
             node = srv.state.node_by_id(p["id"])
             if node is None:
                 raise HTTPError(404, f"node {p['id']} not found")
             return node
 
         def node_allocs(p, q, body, tok):
+            if other_region():
+                return self.rpc_region(
+                    "Alloc.list_by_node", {"node_id": p["id"]}
+                )
             data, idx = blocking(
                 [TABLE_ALLOCS], q, lambda: srv.state.allocs_by_node(p["id"])
             )
@@ -527,7 +587,7 @@ class HTTPAgentServer:
                 if body.get("DrainSpec") is not None
                 else None
             )
-            self.cluster.rpc_self(
+            self.rpc_region(
                 "Node.update_drain",
                 {
                     "node_id": p["id"],
@@ -535,17 +595,21 @@ class HTTPAgentServer:
                     "mark_eligible": body.get("MarkEligible", False),
                 },
             )
+            if other_region():
+                # the local index belongs to the wrong region's raft —
+                # a bogus value would poison blocking queries
+                return {"NodeModifyIndex": 0}
             return {"NodeModifyIndex": srv.state.latest_index()}
 
         def node_eligibility(p, q, body, tok):
-            self.cluster.rpc_self(
+            self.rpc_region(
                 "Node.update_eligibility",
                 {"node_id": p["id"], "eligibility": body["Eligibility"]},
             )
             return {}
 
         def node_purge(p, q, body, tok):
-            self.cluster.rpc_self("Node.purge", {"node_id": p["id"]})
+            self.rpc_region("Node.purge", {"node_id": p["id"]})
             return {}
 
         route("GET", "/v1/nodes", nodes_list)
@@ -558,22 +622,36 @@ class HTTPAgentServer:
 
         # -- allocs / evals -------------------------------------------
         def allocs_list(p, q, body, tok):
+            if other_region():
+                data = self.rpc_region("Alloc.list", {})
+                return self._ns_filter(tok, data, "read-job")
             data, idx = blocking([TABLE_ALLOCS], q, srv.state.allocs)
             return self._ns_filter(tok, data, "read-job"), idx
 
         def alloc_get(p, q, body, tok):
-            a = srv.state.alloc_by_id(p["id"])
+            a = (
+                self.rpc_region("Alloc.get", {"alloc_id": p["id"]})
+                if other_region()
+                else srv.state.alloc_by_id(p["id"])
+            )
             if a is None:
                 raise HTTPError(404, f"alloc {p['id']} not found")
             self._ns_guard(tok, a.namespace, "read-job")
             return a
 
         def evals_list(p, q, body, tok):
+            if other_region():
+                data = self.rpc_region("Eval.list", {})
+                return self._ns_filter(tok, data, "read-job")
             data, idx = blocking([TABLE_EVALS], q, srv.state.evals)
             return self._ns_filter(tok, data, "read-job"), idx
 
         def eval_get(p, q, body, tok):
-            e = srv.state.eval_by_id(p["id"])
+            e = (
+                self.rpc_region("Eval.get", {"eval_id": p["id"]})
+                if other_region()
+                else srv.state.eval_by_id(p["id"])
+            )
             if e is None:
                 raise HTTPError(404, f"eval {p['id']} not found")
             self._ns_guard(tok, e.namespace, "read-job")
@@ -582,9 +660,12 @@ class HTTPAgentServer:
         def eval_allocs(p, q, body, tok):
             # Filter by each alloc's own namespace: a token scoped to one
             # namespace must not enumerate another namespace's allocs.
-            return self._ns_filter(
-                tok, srv.state.allocs_by_eval(p["id"]), "read-job"
+            allocs = (
+                self.rpc_region("Eval.allocs", {"eval_id": p["id"]})
+                if other_region()
+                else srv.state.allocs_by_eval(p["id"])
             )
+            return self._ns_filter(tok, allocs, "read-job")
 
         route("GET", "/v1/allocations", allocs_list)
         route("GET", "/v1/allocation/(?P<id>[^/]+)", alloc_get)
@@ -594,11 +675,20 @@ class HTTPAgentServer:
 
         # -- deployments ----------------------------------------------
         def deployments_list(p, q, body, tok):
+            if other_region():
+                data = self.rpc_region("Deployment.list", {})
+                return self._ns_filter(tok, data, "read-job")
             data, idx = blocking([TABLE_DEPLOYMENTS], q, srv.state.deployments)
             return self._ns_filter(tok, data, "read-job"), idx
 
         def deployment_get(p, q, body, tok):
-            d = srv.state.deployment_by_id(p["id"])
+            d = (
+                self.rpc_region(
+                    "Deployment.get", {"deployment_id": p["id"]}
+                )
+                if other_region()
+                else srv.state.deployment_by_id(p["id"])
+            )
             if d is None:
                 raise HTTPError(404, f"deployment {p['id']} not found")
             self._ns_guard(tok, d.namespace, "read-job")
@@ -613,7 +703,7 @@ class HTTPAgentServer:
             d = srv.state.deployment_by_id(p["id"])
             if d is not None:
                 self._ns_guard(tok, d.namespace, "submit-job")
-            self.cluster.rpc_self(
+            self.rpc_region(
                 "Deployment.promote",
                 {
                     "deployment_id": p["id"],
@@ -626,7 +716,7 @@ class HTTPAgentServer:
             d = srv.state.deployment_by_id(p["id"])
             if d is not None:
                 self._ns_guard(tok, d.namespace, "submit-job")
-            self.cluster.rpc_self(
+            self.rpc_region(
                 "Deployment.pause",
                 {"deployment_id": p["id"], "pause": body.get("Pause", True)},
             )
@@ -636,7 +726,7 @@ class HTTPAgentServer:
             d = srv.state.deployment_by_id(p["id"])
             if d is not None:
                 self._ns_guard(tok, d.namespace, "submit-job")
-            self.cluster.rpc_self(
+            self.rpc_region(
                 "Deployment.fail", {"deployment_id": p["id"]}
             )
             return {}
@@ -652,14 +742,18 @@ class HTTPAgentServer:
 
         # -- status / agent -------------------------------------------
         def status_leader(p, q, body, tok):
+            if other_region():
+                out = self.rpc_region("Status.leader", {})
+                addr = (out or {}).get("leader")
+                return f"{addr[0]}:{addr[1]}" if addr else None
             addr = self.cluster.raft.leader_addr()
             return f"{addr[0]}:{addr[1]}" if addr else None
 
         def status_peers(p, q, body, tok):
-            return self.cluster.rpc_self("Status.peers", {})
+            return self.rpc_region("Status.peers", {})
 
         def regions_list(p, q, body, tok):
-            return self.cluster.rpc_self("Status.regions", {})
+            return self.rpc_region("Status.regions", {})
 
         def _debug_gate():
             # reference: pprof 404s unless enable_debug (agent http.go)
@@ -712,13 +806,13 @@ class HTTPAgentServer:
 
         # -- acl -------------------------------------------------------
         def acl_bootstrap(p, q, body, tok):
-            return self.cluster.rpc_self("ACL.bootstrap", {})
+            return self.rpc_region("ACL.bootstrap", {})
 
         def acl_policies(p, q, body, tok):
-            return self.cluster.rpc_self("ACL.policy_list", {})
+            return self.rpc_region("ACL.policy_list", {})
 
         def acl_policy_get(p, q, body, tok):
-            pol = self.cluster.rpc_self("ACL.policy_get", {"name": p["name"]})
+            pol = self.rpc_region("ACL.policy_get", {"name": p["name"]})
             if pol is None:
                 raise HTTPError(404, f"policy {p['name']} not found")
             return pol
@@ -731,15 +825,15 @@ class HTTPAgentServer:
                 description=body.get("Description", ""),
                 rules=body.get("Rules", ""),
             )
-            self.cluster.rpc_self("ACL.policy_upsert", {"policies": [pol]})
+            self.rpc_region("ACL.policy_upsert", {"policies": [pol]})
             return {}
 
         def acl_policy_delete(p, q, body, tok):
-            self.cluster.rpc_self("ACL.policy_delete", {"names": [p["name"]]})
+            self.rpc_region("ACL.policy_delete", {"names": [p["name"]]})
             return {}
 
         def acl_tokens(p, q, body, tok):
-            return self.cluster.rpc_self("ACL.token_list", {})
+            return self.rpc_region("ACL.token_list", {})
 
         def acl_token_put(p, q, body, tok):
             from ..acl import ACLToken
@@ -749,10 +843,10 @@ class HTTPAgentServer:
                 type=body.get("Type", "client"),
                 policies=body.get("Policies") or [],
             )
-            return self.cluster.rpc_self("ACL.token_create", {"token": t})
+            return self.rpc_region("ACL.token_create", {"token": t})
 
         def acl_token_get(p, q, body, tok):
-            t = self.cluster.rpc_self(
+            t = self.rpc_region(
                 "ACL.token_get", {"accessor_id": p["id"]}
             )
             if t is None:
@@ -760,7 +854,7 @@ class HTTPAgentServer:
             return t
 
         def acl_token_delete(p, q, body, tok):
-            self.cluster.rpc_self(
+            self.rpc_region(
                 "ACL.token_delete", {"accessor_ids": [p["id"]]}
             )
             return {}
@@ -807,19 +901,19 @@ class HTTPAgentServer:
         def operator_snapshot_save(p, q, body, tok):
             import base64
 
-            resp = self.cluster.rpc_self("Operator.snapshot_save", {})
+            resp = self.rpc_region("Operator.snapshot_save", {})
             return {"Snapshot": base64.b64encode(resp["snapshot"]).decode()}
 
         def operator_snapshot_restore(p, q, body, tok):
             import base64
 
             data = base64.b64decode(body["Snapshot"])
-            return self.cluster.rpc_self(
+            return self.rpc_region(
                 "Operator.snapshot_restore", {"data": data}
             )
 
         def operator_raft_config(p, q, body, tok):
-            return self.cluster.rpc_self("Operator.raft_configuration", {})
+            return self.rpc_region("Operator.raft_configuration", {})
 
         route("GET", "/v1/operator/snapshot", operator_snapshot_save)
         route("PUT", "/v1/operator/snapshot", operator_snapshot_restore)
@@ -1043,6 +1137,7 @@ class HTTPAgentServer:
             def _dispatch(self, method: str) -> None:
                 parsed = urlparse(self.path)
                 query = parse_qs(parsed.query)
+                _REQ_REGION.set(query.get("region", [""])[0])
                 token = self.headers.get("X-Nomad-Token", "")
                 # Drain the body up front: on keep-alive connections an
                 # unread body (404 path, ACL reject) would desync the

@@ -260,7 +260,7 @@ const views = {
 
   async services() {
     const [svcs, plugins] = await Promise.all([
-      api("/v1/services"), api("/v1/plugins"),
+      api("/v1/services?namespace=*"), api("/v1/plugins"),
     ]);
     let html = `<h1>Services</h1>` + table(
       ["Name", "Namespace", "Tags", "Instances"],
@@ -324,7 +324,9 @@ const views = {
 };
 
 let refreshTimer = null;
+let renderGen = 0;
 async function render() {
+  const gen = ++renderGen;
   const hash = location.hash.replace(/^#\//, "") || "jobs";
   const parts = hash.split("/").map(decodeURIComponent);
   document.querySelectorAll("#nav a").forEach((a) =>
@@ -341,9 +343,11 @@ async function render() {
   }
   try {
     const html = await fn(...args);
+    if (gen !== renderGen) return;  // a newer navigation won
     $("#err").textContent = "";
     $("#view").innerHTML = html;
   } catch (e) {
+    if (gen !== renderGen) return;
     $("#err").textContent = String(e.message || e);
   }
   clearTimeout(refreshTimer);

@@ -32,11 +32,13 @@ class NomadClient:
         address: str = "http://127.0.0.1:4646",
         token: str = "",
         namespace: str = "default",
+        region: str = "",
         timeout_s: float = 35.0,
     ) -> None:
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
+        self.region = region  # "" = the contacted server's own region
         self.timeout_s = timeout_s
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
@@ -67,6 +69,8 @@ class NomadClient:
         timeout_s: Optional[float] = None,
     ):
         params = {k: v for k, v in (params or {}).items() if v not in (None, "")}
+        if self.region and "region" not in params:
+            params["region"] = self.region
         url = self.address + path
         if params:
             url += "?" + urllib.parse.urlencode(params, doseq=True)

@@ -44,8 +44,9 @@ def _fmt_table(rows: list[list[str]], header: Optional[list[str]] = None) -> str
 
 def _client(args) -> NomadClient:
     addr = args.address or os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+    region = getattr(args, "region", "") or os.environ.get("NOMAD_REGION", "")
     token = args.token or os.environ.get("NOMAD_TOKEN", "")
-    return NomadClient(addr, token=token)
+    return NomadClient(addr, token=token, region=region)
 
 
 def _parse_vars(pairs: list[str]) -> dict:
@@ -1208,6 +1209,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
     p.add_argument("-address", default=None, help="HTTP API address")
     p.add_argument("-token", default=None, help="ACL token")
+    p.add_argument(
+        "-region", default=None,
+        help="federated region to address (default: the server's own)",
+    )
     sub = p.add_subparsers(dest="cmd")
 
     ag = sub.add_parser("agent", help="run an agent")

@@ -81,6 +81,12 @@ class Spec:
                         else attr.default
                     )
                 continue
+            if attr.required and config[attr.name] in ("", None):
+                # an interpolation that resolved to empty must fail at
+                # dispatch, not as an opaque runtime error downstream
+                raise DriverError(
+                    f"{who}: required config key {attr.name!r} is empty"
+                )
             want = _TYPES[attr.type]
             val = config[attr.name]
             if attr.type == "any":

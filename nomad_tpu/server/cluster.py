@@ -124,6 +124,16 @@ class JobEndpoint(_Forwarder):
             args["namespace"], args["job_id"]
         )
 
+    def evals(self, args):
+        return self.cs.server.state.evals_by_job(
+            args["namespace"], args["job_id"]
+        )
+
+    def versions(self, args):
+        return self.cs.server.state.job_versions(
+            args["namespace"], args["job_id"]
+        )
+
     def revert(self, args):
         return self._forward(
             "Job.revert",
@@ -436,6 +446,9 @@ class EvalEndpoint(_Forwarder):
     def get(self, args):
         return self.cs.server.state.eval_by_id(args["eval_id"])
 
+    def allocs(self, args):
+        return self.cs.server.state.allocs_by_eval(args["eval_id"])
+
     def list(self, args):
         return self.cs.server.state.evals()
 
@@ -443,6 +456,9 @@ class EvalEndpoint(_Forwarder):
 class AllocEndpoint(_Forwarder):
     def get(self, args):
         return self.cs.server.state.alloc_by_id(args["alloc_id"])
+
+    def list(self, args):
+        return self.cs.server.state.allocs()
 
     def list_by_node(self, args):
         return self.cs.server.state.allocs_by_node(args["node_id"])
@@ -911,8 +927,30 @@ class ClusterServer:
     def rpc_self(self, method: str, args):
         """In-process RPC dispatch (no socket hop): runs the endpoint
         locally, which itself forwards to the leader when needed — the
-        reference's server.RPC fast path."""
+        reference's server.RPC fast path. A request naming another
+        REGION forwards to a server there first (nomad/rpc.go
+        forwardRegion via serf WAN membership)."""
+        region = args.get("region") if isinstance(args, dict) else None
+        if region and region != self.region:
+            addr = self.region_server(region)
+            if addr is None:
+                raise RPCError(f"no known servers in region {region!r}")
+            return self.pool.call(addr, method, args, timeout_s=30.0)
         return self.rpc.dispatch_local(method, args)
+
+    def region_server(self, region: str):
+        """A live server's fabric addr in the named region, from gossip
+        (reference nomad/server.go forwardRegion picks a random member)."""
+        import random
+
+        candidates = [
+            tuple(m.addr)
+            for m in self.serf.members()
+            if m.tags.get("role") == "server"
+            and m.status == "alive"
+            and (m.tags.get("region") or "global") == region
+        ]
+        return random.choice(candidates) if candidates else None
 
     def is_leader(self) -> bool:
         return self.raft.is_leader()
@@ -930,6 +968,12 @@ class ClusterServer:
     def _on_member_event(self, kind: str, member) -> None:
         if member.tags.get("role") != "server":
             return
+        # Federation: one gossip ring can span regions (the reference's
+        # WAN serf), but raft is PER-REGION — a server in another region
+        # must never become a raft peer (nomad/serf.go keeps LAN serf
+        # per region; regions meet only at RPC forwarding).
+        if (member.tags.get("region") or "global") != self.region:
+            return
         # Initial bootstrap: once bootstrap_expect servers see each other,
         # every one of them derives the SAME peer map from gossip and raft
         # elections begin (reference serf.go maybeBootstrap). Cheap — runs
@@ -938,7 +982,9 @@ class ClusterServer:
             servers = {
                 m.id: tuple(m.addr)
                 for m in self.serf.members()
-                if m.tags.get("role") == "server" and m.status == "alive"
+                if m.tags.get("role") == "server"
+                and m.status == "alive"
+                and (m.tags.get("region") or "global") == self.region
             }
             servers[self.node_id] = self.rpc.addr
             if len(servers) >= self._bootstrap_expect:
