@@ -14,6 +14,7 @@ from typing import Optional
 
 from .acl import ACL
 from .policy import (
+    CAP_ALLOC_LIFECYCLE,
     CAP_DISPATCH_JOB,
     CAP_LIST_JOBS,
     CAP_READ_FS,
@@ -53,6 +54,15 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     # own namespace via _ns_guard; exec rides the RPC fabric and is
     # checked in ClusterServer._handle_exec_stream with CAP_ALLOC_EXEC)
     ("GET", re.compile(r"^/v1/client/fs/logs/.*$"), CAP_READ_LOGS),
+    # alloc lifecycle (handlers re-check against the alloc's own
+    # namespace via _ns_guard)
+    ("PUT", re.compile(r"^/v1/client/allocation/[^/]+/(restart|signal)$"),
+     CAP_ALLOC_LIFECYCLE),
+    ("POST", re.compile(r"^/v1/client/allocation/[^/]+/(restart|signal)$"),
+     CAP_ALLOC_LIFECYCLE),
+    ("PUT", re.compile(r"^/v1/allocation/[^/]+/stop$"), CAP_ALLOC_LIFECYCLE),
+    ("POST", re.compile(r"^/v1/allocation/[^/]+/stop$"),
+     CAP_ALLOC_LIFECYCLE),
     ("GET", re.compile(r"^/v1/client/fs/(ls|cat|stat)/.*$"), CAP_READ_FS),
     # volumes ride the job caps (the reference gates host volumes with
     # namespace host_volume policies; submit-job is this tree's write cap)
@@ -105,6 +115,10 @@ _ANY_TOKEN_READ = [
 ]
 _OPERATOR_WRITE = [
     ("PUT", re.compile(r"^/v1/operator/.*$")),
+    # system gc is an operator action (reference System.GarbageCollect
+    # requires management)
+    ("PUT", re.compile(r"^/v1/system/.*$")),
+    ("POST", re.compile(r"^/v1/system/.*$")),
     ("POST", re.compile(r"^/v1/operator/.*$")),
     # namespace CRUD is an operator action (reference
     # namespace_endpoint.go requires management)

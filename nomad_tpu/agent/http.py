@@ -39,6 +39,7 @@ logger = logging.getLogger("nomad_tpu.http")
 # per-request ?region= (reference: wrap() parses the region query param
 # and every RPC carries it for cross-region forwarding)
 _REQ_REGION = contextvars.ContextVar("nomad_http_region", default="")
+_REQ_TOKEN = contextvars.ContextVar("nomad_http_token", default="")
 
 
 class HTTPError(Exception):
@@ -135,10 +136,15 @@ class HTTPAgentServer:
     def rpc_region(self, method: str, args):
         """rpc_self with the request's ?region= attached, so any route
         can address a federated region (reference: Region rides every
-        RPC's QueryOptions/WriteRequest)."""
+        RPC's QueryOptions/WriteRequest). The caller's token rides along
+        so the TARGET region re-authorizes against its own ACL state."""
         region = _REQ_REGION.get()
         if region and isinstance(args, dict) and "region" not in args:
-            args = {**args, "region": region}
+            args = {
+                **args,
+                "region": region,
+                "__cross_region_token__": _REQ_TOKEN.get(),
+            }
         return self.cluster.rpc_self(method, args)
 
     # -- routing -------------------------------------------------------
@@ -897,7 +903,103 @@ class HTTPAgentServer:
         route("GET", "/v1/client/fs/ls/(?P<id>[^/]+)", client_fs_ls)
         route("GET", "/v1/client/fs/stat/(?P<id>[^/]+)", client_fs_stat)
 
+        # -- alloc lifecycle (reference client/alloc_endpoint.go + the
+        # server-side Stop in nomad/alloc_endpoint.go) ----------------
+        def alloc_restart(p, q, body, tok):
+            alloc = self._resolve_alloc(p["id"])
+            self._ns_guard(tok, alloc.namespace, "alloc-lifecycle")
+            msg = self._client_roundtrip(
+                alloc, "Alloc.restart",
+                {"task": (body or {}).get("TaskName", "")},
+            )
+            return {"ok": bool(msg.get("ok"))}
+
+        def alloc_signal(p, q, body, tok):
+            alloc = self._resolve_alloc(p["id"])
+            self._ns_guard(tok, alloc.namespace, "alloc-lifecycle")
+            msg = self._client_roundtrip(
+                alloc, "Alloc.signal",
+                {
+                    "task": (body or {}).get("TaskName", ""),
+                    "signal": (body or {}).get("Signal", "SIGTERM"),
+                },
+            )
+            return {"ok": bool(msg.get("ok"))}
+
+        def alloc_stop(p, q, body, tok):
+            # stop is a pure server-side raft op: resolve from STATE, not
+            # the client-streaming resolver — stopping an alloc off a
+            # dead/unreachable node is exactly when this gets used
+            if other_region():
+                eval_id = self.rpc_region(
+                    "Alloc.stop", {"alloc_id": p["id"]}
+                )
+                return {"EvalID": eval_id}
+            alloc = srv.state.alloc_by_id(p["id"])
+            if alloc is None:
+                matches = [
+                    a
+                    for a in srv.state.allocs()
+                    if a.id.startswith(p["id"])
+                ]
+                if len(matches) > 1:
+                    raise HTTPError(400, f"alloc prefix {p['id']!r} ambiguous")
+                alloc = matches[0] if matches else None
+            if alloc is None:
+                raise HTTPError(404, f"alloc {p['id']} not found")
+            self._ns_guard(tok, alloc.namespace, "alloc-lifecycle")
+            eval_id = self.rpc_region("Alloc.stop", {"alloc_id": alloc.id})
+            return {"EvalID": eval_id}
+
+        route(
+            "PUT", "/v1/client/allocation/(?P<id>[^/]+)/restart",
+            alloc_restart,
+        )
+        route(
+            "POST", "/v1/client/allocation/(?P<id>[^/]+)/restart",
+            alloc_restart,
+        )
+        route(
+            "PUT", "/v1/client/allocation/(?P<id>[^/]+)/signal",
+            alloc_signal,
+        )
+        route(
+            "POST", "/v1/client/allocation/(?P<id>[^/]+)/signal",
+            alloc_signal,
+        )
+        route("PUT", "/v1/allocation/(?P<id>[^/]+)/stop", alloc_stop)
+        route("POST", "/v1/allocation/(?P<id>[^/]+)/stop", alloc_stop)
+
+        # -- system ----------------------------------------------------
+        def system_gc(p, q, body, tok):
+            self.rpc_region("Operator.force_gc", {})
+            return None
+
+        route("PUT", "/v1/system/gc", system_gc)
+        route("POST", "/v1/system/gc", system_gc)
+
         # -- operator --------------------------------------------------
+        def scheduler_config_get(p, q, body, tok):
+            return self.rpc_region("Operator.scheduler_get_config", {})
+
+        def scheduler_config_set(p, q, body, tok):
+            return self.rpc_region(
+                "Operator.scheduler_set_config", {"config": body or {}}
+            )
+
+        route(
+            "GET", "/v1/operator/scheduler/configuration",
+            scheduler_config_get,
+        )
+        route(
+            "PUT", "/v1/operator/scheduler/configuration",
+            scheduler_config_set,
+        )
+        route(
+            "POST", "/v1/operator/scheduler/configuration",
+            scheduler_config_set,
+        )
+
         def operator_snapshot_save(p, q, body, tok):
             import base64
 
@@ -1139,6 +1241,7 @@ class HTTPAgentServer:
                 query = parse_qs(parsed.query)
                 _REQ_REGION.set(query.get("region", [""])[0])
                 token = self.headers.get("X-Nomad-Token", "")
+                _REQ_TOKEN.set(token)
                 # Drain the body up front: on keep-alive connections an
                 # unread body (404 path, ACL reject) would desync the
                 # next request on the same socket.

@@ -55,6 +55,7 @@ class NomadClient:
         self.secrets = Secrets(self)
         self.namespaces = Namespaces(self)
         self.search = Search(self)
+        self.system = SystemAPI(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -241,6 +242,21 @@ class Nodes(_Resource):
 
 
 class Allocations(_Resource):
+    def restart(self, alloc_id: str, task: str = ""):
+        return self.c.put(
+            f"/v1/client/allocation/{alloc_id}/restart",
+            body={"TaskName": task},
+        )
+
+    def signal(self, alloc_id: str, signal: str, task: str = ""):
+        return self.c.put(
+            f"/v1/client/allocation/{alloc_id}/signal",
+            body={"Signal": signal, "TaskName": task},
+        )
+
+    def stop(self, alloc_id: str):
+        return self.c.put(f"/v1/allocation/{alloc_id}/stop")
+
     def list(self):
         return self.c.get("/v1/allocations")
 
@@ -357,6 +373,11 @@ class ExecSession:
             pass
         self._session.close()
         self._pool.shutdown()
+
+
+class SystemAPI(_Resource):
+    def gc(self):
+        return self.c.put("/v1/system/gc")
 
 
 class Evaluations(_Resource):
@@ -517,6 +538,14 @@ class Plugins(_Resource):
 
 
 class Operator(_Resource):
+    def scheduler_configuration(self):
+        return self.c.get("/v1/operator/scheduler/configuration")
+
+    def scheduler_set_configuration(self, config: dict):
+        return self.c.put(
+            "/v1/operator/scheduler/configuration", body=config
+        )
+
     def snapshot_save(self) -> bytes:
         import base64
 

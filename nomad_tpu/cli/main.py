@@ -939,6 +939,153 @@ def cmd_volume_deregister(args) -> int:
     return 0
 
 
+def cmd_alloc_restart(args) -> int:
+    """Reference: command/alloc_restart.go."""
+    api = _client(args)
+    api.allocations.restart(args.alloc_id, task=args.task or "")
+    print(f"Allocation {args.alloc_id[:8]} restarted")
+    return 0
+
+
+def cmd_alloc_signal(args) -> int:
+    """Reference: command/alloc_signal.go."""
+    api = _client(args)
+    api.allocations.signal(args.alloc_id, args.signal, task=args.task or "")
+    print(f"Signalled allocation {args.alloc_id[:8]} with {args.signal}")
+    return 0
+
+
+def cmd_alloc_stop(args) -> int:
+    """Reference: command/alloc_stop.go — stop + reschedule."""
+    api = _client(args)
+    out = api.allocations.stop(args.alloc_id)
+    print(f"Allocation {args.alloc_id[:8]} stopping")
+    if out.get("EvalID"):
+        print(f"Evaluation ID: {out['EvalID']}")
+    return 0
+
+
+def cmd_system_gc(args) -> int:
+    """Reference: command/system_gc.go."""
+    api = _client(args)
+    api.system.gc()
+    print("System GC triggered")
+    return 0
+
+
+def cmd_operator_scheduler_get(args) -> int:
+    api = _client(args)
+    cfg = api.operator.scheduler_configuration()
+    print(f"Scheduler Algorithm          = {cfg['SchedulerAlgorithm']}")
+    pre = cfg["PreemptionConfig"]
+    print(f"Preemption Service Enabled   = {pre['ServiceSchedulerEnabled']}")
+    print(f"Preemption Batch Enabled     = {pre['BatchSchedulerEnabled']}")
+    print(f"Preemption System Enabled    = {pre['SystemSchedulerEnabled']}")
+    print(f"Preemption SysBatch Enabled  = {pre['SysBatchSchedulerEnabled']}")
+    print(
+        f"Memory Oversubscription      = "
+        f"{cfg['MemoryOversubscriptionEnabled']}"
+    )
+    print(f"Placement Backend            = {cfg.get('Backend', 'host')}")
+    return 0
+
+
+def cmd_operator_scheduler_set(args) -> int:
+    api = _client(args)
+    cfg: dict = {}
+    if args.scheduler_algorithm:
+        cfg["SchedulerAlgorithm"] = args.scheduler_algorithm
+    pre = {}
+    for flag, key in (
+        (args.preempt_service, "ServiceSchedulerEnabled"),
+        (args.preempt_batch, "BatchSchedulerEnabled"),
+        (args.preempt_system, "SystemSchedulerEnabled"),
+        (args.preempt_sysbatch, "SysBatchSchedulerEnabled"),
+    ):
+        if flag is not None:
+            pre[key] = flag == "true"
+    if pre:
+        cfg["PreemptionConfig"] = pre
+    if args.memory_oversubscription is not None:
+        cfg["MemoryOversubscriptionEnabled"] = (
+            args.memory_oversubscription == "true"
+        )
+    api.operator.scheduler_set_configuration(cfg)
+    print("Scheduler configuration updated!")
+    return 0
+
+
+def cmd_agent_info(args) -> int:
+    """Reference: command/agent_info.go."""
+    api = _client(args)
+    info = api.get("/v1/agent/self")
+    print(json.dumps(info, indent=2, default=codec.json_default))
+    return 0
+
+
+def cmd_job_validate(args) -> int:
+    """Reference: command/job_validate.go — parse + validate, no submit."""
+    try:
+        job = _load_jobfile(args.jobfile, _parse_vars(args.var))
+        job.canonicalize()
+        job.validate()
+    except Exception as e:
+        print(f"Job validation errors:\n  {e}", file=sys.stderr)
+        return 1
+    print("Job validation successful")
+    return 0
+
+
+_EXAMPLE_JOB = """\
+# Example jobspec (reference: command/job_init.go's example.nomad)
+job "example" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  group "cache" {
+    count = 1
+
+    task "redis" {
+      driver = "rawexec"
+
+      config {
+        command = "/bin/sleep"
+        args    = ["3600"]
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+      }
+    }
+  }
+}
+"""
+
+
+def cmd_job_init(args) -> int:
+    """Reference: command/job_init.go."""
+    path = args.filename or "example.nomad"
+    if os.path.exists(path):
+        print(f"Error: {path} already exists", file=sys.stderr)
+        return 1
+    with open(path, "w") as f:
+        f.write(_EXAMPLE_JOB)
+    print(f"Example job file written to {path}")
+    return 0
+
+
+def cmd_node_meta(args) -> int:
+    """Reference: command/node_meta_read.go."""
+    api = _client(args)
+    node = api.nodes.get(args.node_id)
+    for k in sorted(node.meta):
+        print(f"{k} = {node.meta[k]}")
+    if not node.meta:
+        print("No node metadata")
+    return 0
+
+
 def cmd_secret_put(args) -> int:
     api = _client(args)
     items = {}
@@ -1248,6 +1395,13 @@ def build_parser() -> argparse.ArgumentParser:
     jst.add_argument("job_id")
     jst.add_argument("-purge", action="store_true")
     jst.set_defaults(fn=cmd_job_stop)
+    jva = jsub.add_parser("validate")
+    jva.add_argument("jobfile")
+    jva.add_argument("-var", action="append", default=[])
+    jva.set_defaults(fn=cmd_job_validate)
+    jin = jsub.add_parser("init")
+    jin.add_argument("filename", nargs="?")
+    jin.set_defaults(fn=cmd_job_init)
     ji = jsub.add_parser("inspect")
     ji.add_argument("job_id")
     ji.set_defaults(fn=cmd_job_inspect)
@@ -1286,6 +1440,9 @@ def build_parser() -> argparse.ArgumentParser:
     ne.add_argument("-enable", action="store_true")
     ne.add_argument("-disable", action="store_true")
     ne.set_defaults(fn=lambda a: cmd_node_eligibility(_elig_fix(a)))
+    nm = nsub.add_parser("meta")
+    nm.add_argument("node_id")
+    nm.set_defaults(fn=cmd_node_meta)
 
     alloc = sub.add_parser("alloc", help="alloc commands")
     asub = alloc.add_subparsers(dest="subcmd")
@@ -1302,6 +1459,18 @@ def build_parser() -> argparse.ArgumentParser:
     afs.add_argument("alloc_id")
     afs.add_argument("path", nargs="?", default="")
     afs.set_defaults(fn=cmd_alloc_fs)
+    arst = asub.add_parser("restart")
+    arst.add_argument("alloc_id")
+    arst.add_argument("-task", default="")
+    arst.set_defaults(fn=cmd_alloc_restart)
+    asig = asub.add_parser("signal")
+    asig.add_argument("alloc_id")
+    asig.add_argument("-s", dest="signal", default="SIGTERM")
+    asig.add_argument("-task", default="")
+    asig.set_defaults(fn=cmd_alloc_signal)
+    astp = asub.add_parser("stop")
+    astp.add_argument("alloc_id")
+    astp.set_defaults(fn=cmd_alloc_stop)
     aex = asub.add_parser("exec")
     aex.add_argument("-t", "-tty", dest="tty", action="store_true")
     aex.add_argument("-task", default="")
@@ -1409,6 +1578,11 @@ def build_parser() -> argparse.ArgumentParser:
     vdereg.add_argument("-namespace", default="default")
     vdereg.set_defaults(fn=cmd_volume_deregister)
 
+    system = sub.add_parser("system", help="system maintenance commands")
+    syssub = system.add_subparsers(dest="subcmd")
+    sgc = syssub.add_parser("gc")
+    sgc.set_defaults(fn=cmd_system_gc)
+
     sec = sub.add_parser("secret", help="embedded secrets store commands")
     secsub = sec.add_subparsers(dest="subcmd")
     sput = secsub.add_parser("put")
@@ -1464,6 +1638,29 @@ def build_parser() -> argparse.ArgumentParser:
     opdbg = opsub.add_parser("debug")
     opdbg.add_argument("-output", default="")
     opdbg.set_defaults(fn=cmd_operator_debug)
+    opsch = opsub.add_parser("scheduler")
+    opschsub = opsch.add_subparsers(dest="subsubcmd")
+    opsg = opschsub.add_parser("get-config")
+    opsg.set_defaults(fn=cmd_operator_scheduler_get)
+    opss2 = opschsub.add_parser("set-config")
+    opss2.add_argument(
+        "-scheduler-algorithm", dest="scheduler_algorithm", default=None,
+        choices=["binpack", "spread"],
+    )
+    for flag, dest in (
+        ("-preempt-service-scheduler", "preempt_service"),
+        ("-preempt-batch-scheduler", "preempt_batch"),
+        ("-preempt-system-scheduler", "preempt_system"),
+        ("-preempt-sysbatch-scheduler", "preempt_sysbatch"),
+        ("-memory-oversubscription", "memory_oversubscription"),
+    ):
+        opss2.add_argument(
+            flag, dest=dest, default=None, choices=["true", "false"]
+        )
+    opss2.set_defaults(fn=cmd_operator_scheduler_set)
+
+    ai = sub.add_parser("agent-info", help="agent runtime info")
+    ai.set_defaults(fn=cmd_agent_info)
 
     st = sub.add_parser("status", help="list jobs")
     st.add_argument("job_id", nargs="?")

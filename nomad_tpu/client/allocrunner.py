@@ -298,6 +298,32 @@ class AllocRunner:
 
     # ------------------------------------------------------------------
 
+    def restart(self, task_name: str = "") -> None:
+        """Restart one task or every task (reference alloc_endpoint.go
+        Restart → task runner restart without budget)."""
+        with self._lock:
+            runners = dict(self.task_runners)
+        if task_name:
+            tr = runners.get(task_name)
+            if tr is None:
+                raise KeyError(f"task {task_name!r} not in alloc")
+            tr.trigger_restart()
+        else:
+            for tr in runners.values():
+                tr.trigger_restart()
+
+    def signal(self, sig: str, task_name: str = "") -> None:
+        with self._lock:
+            runners = dict(self.task_runners)
+        if task_name:
+            tr = runners.get(task_name)
+            if tr is None:
+                raise KeyError(f"task {task_name!r} not in alloc")
+            tr.signal(sig)
+        else:
+            for tr in runners.values():
+                tr.signal(sig)
+
     def update(self, updated: Allocation) -> None:
         """Server pushed a new version of this alloc (reference Update :802)."""
         with self._lock:

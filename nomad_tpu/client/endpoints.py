@@ -42,6 +42,8 @@ class ClientEndpoints:
         self.rpc.register_stream("FS.cat", self._fs_cat)
         self.rpc.register_stream("FS.stat", self._fs_stat)
         self.rpc.register_stream("Exec.exec", self._exec)
+        self.rpc.register_stream("Alloc.restart", self._alloc_restart)
+        self.rpc.register_stream("Alloc.signal", self._alloc_signal)
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -52,6 +54,35 @@ class ClientEndpoints:
 
     def stop(self) -> None:
         self.rpc.shutdown()
+
+    # -- alloc lifecycle (reference client/alloc_endpoint.go) -----------
+
+    def _alloc_lifecycle(self, session, header, verb) -> None:
+        runner = self.client.alloc_runners.get(header.get("alloc_id", ""))
+        if runner is None:
+            session.send({"error": "alloc not running on this client"})
+            return
+        try:
+            verb(runner)
+            session.send({"ok": True})
+        except KeyError as e:
+            session.send({"error": str(e)})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
+
+    def _alloc_restart(self, session, header) -> None:
+        self._alloc_lifecycle(
+            session, header,
+            lambda r: r.restart(header.get("task", "")),
+        )
+
+    def _alloc_signal(self, session, header) -> None:
+        self._alloc_lifecycle(
+            session, header,
+            lambda r: r.signal(
+                header.get("signal", "SIGTERM"), header.get("task", "")
+            ),
+        )
 
     # -- helpers --------------------------------------------------------
 

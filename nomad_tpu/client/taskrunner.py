@@ -96,6 +96,22 @@ class TaskRunner:
             _os.environ.get("NOMAD_TEMPLATE_POLL_INTERVAL", "2.0")
         )
 
+    def trigger_restart(self) -> None:
+        """Operator-initiated restart (reference alloc restart): bounces
+        the task WITHOUT consuming the restart policy budget — same path
+        a template change_mode=restart rides. A dead/backoff task has no
+        process to bounce (the reference returns "Task not running")."""
+        if self.state.state != "running":
+            raise RuntimeError(
+                f"task {self.task.name!r} is not running "
+                f"({self.state.state})"
+            )
+        self._template_restart.set()
+
+    def signal(self, sig: str) -> None:
+        """Operator-initiated signal (reference alloc signal)."""
+        self.driver.signal_task(self.task_id, sig)
+
     def _restart_policy(self):
         from ..structs import RestartPolicy
 

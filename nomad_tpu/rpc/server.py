@@ -244,10 +244,18 @@ class RPCServer:
         except (ConnectionError, OSError):
             pass
 
+    # Optional pre-dispatch hook: (method, args) -> None, raising to
+    # reject. The cluster layer uses it to re-authorize cross-region
+    # requests regardless of whether they arrive in-process or over the
+    # fabric socket.
+    precheck = None
+
     def dispatch_local(self, method: str, args):
         """Resolve `Endpoint.method` and invoke it (also used in-process to
         skip the socket for self-calls, like the reference's
         server.RPC fast path)."""
+        if self.precheck is not None:
+            self.precheck(method, args)
         try:
             name, meth = method.split(".", 1)
         except ValueError:

@@ -224,6 +224,15 @@ class AllocReconciler:
                 else:
                     stable.append(a)  # awaiting its drainer slot
                 continue
+            if (
+                a.desired_transition.should_migrate()
+                and not a.client_terminal_status()
+            ):
+                # `alloc stop` on a healthy node (reference
+                # reconcile_util.go filterByTainted: an untainted alloc
+                # with ShouldMigrate still migrates)
+                migrate.append(a)
+                continue
             if a.client_status == ALLOC_CLIENT_STATUS_FAILED:
                 if a.desired_transition.should_force_reschedule():
                     resched_now.append(a)

@@ -75,6 +75,64 @@ class OperatorEndpoint(_Forwarder):
             lambda a: self.cs.server.raft_apply("snapshot_restore", a["data"]),
         )
 
+    def force_gc(self, args):
+        return self._forward(
+            "Operator.force_gc",
+            args,
+            lambda a: self.cs.server.force_gc(),
+        )
+
+    def scheduler_get_config(self, args):
+        def local(a):
+            return self._scheduler_config_payload()
+
+        return self._forward("Operator.scheduler_get_config", args, local)
+
+    def _scheduler_config_payload(self):
+        c = self.cs.server.scheduler_config
+        return {
+            "SchedulerAlgorithm": c.algorithm,
+            "PreemptionConfig": {
+                "ServiceSchedulerEnabled": c.preemption_service,
+                "BatchSchedulerEnabled": c.preemption_batch,
+                "SystemSchedulerEnabled": c.preemption_system,
+                "SysBatchSchedulerEnabled": c.preemption_sysbatch,
+            },
+            "MemoryOversubscriptionEnabled": c.memory_oversubscription,
+            "Backend": c.backend,
+        }
+
+    def scheduler_set_config(self, args):
+        """Mutate the live scheduler knobs (reference
+        operator_endpoint.go SchedulerSetConfiguration; the reference
+        raft-replicates the config — here it is leader-local operator
+        state, re-set after failover)."""
+
+        def apply(a):
+            cfg = a.get("config") or {}
+            c = self.cs.server.scheduler_config
+            if "SchedulerAlgorithm" in cfg:
+                algo = cfg["SchedulerAlgorithm"]
+                if algo not in ("binpack", "spread"):
+                    raise ValueError(f"unknown algorithm {algo!r}")
+                c.algorithm = algo
+            pre = cfg.get("PreemptionConfig") or {}
+            for key, attr in (
+                ("ServiceSchedulerEnabled", "preemption_service"),
+                ("BatchSchedulerEnabled", "preemption_batch"),
+                ("SystemSchedulerEnabled", "preemption_system"),
+                ("SysBatchSchedulerEnabled", "preemption_sysbatch"),
+            ):
+                if key in pre:
+                    setattr(c, attr, bool(pre[key]))
+            if "MemoryOversubscriptionEnabled" in cfg:
+                c.memory_oversubscription = bool(
+                    cfg["MemoryOversubscriptionEnabled"]
+                )
+            return {"Updated": True}
+
+        return self._forward("Operator.scheduler_set_config", args, apply)
+
     def raft_configuration(self, args):
         out = [
             {
@@ -460,6 +518,13 @@ class AllocEndpoint(_Forwarder):
     def list(self, args):
         return self.cs.server.state.allocs()
 
+    def stop(self, args):
+        return self._forward(
+            "Alloc.stop",
+            args,
+            lambda a: self.cs.server.alloc_stop(a["alloc_id"]),
+        )
+
     def list_by_node(self, args):
         return self.cs.server.state.allocs_by_node(args["node_id"])
 
@@ -656,6 +721,7 @@ class ClusterServer:
             **raft_kw,
         )
         self.server.set_raft_applier(self._raft_apply)
+        self.rpc.precheck = self._rpc_precheck
         self.rpc.register("Raft", self.raft.endpoint)
         for name, ep in (
             ("Job", JobEndpoint(self)),
@@ -937,6 +1003,89 @@ class ClusterServer:
                 raise RPCError(f"no known servers in region {region!r}")
             return self.pool.call(addr, method, args, timeout_s=30.0)
         return self.rpc.dispatch_local(method, args)
+
+    def _rpc_precheck(self, method: str, args) -> None:
+        """Runs before EVERY dispatch (in-process and fabric-arriving):
+        a federated request landing in its target region carries the
+        caller's token — the sending region's HTTP-layer check used ITS
+        acl state, so re-authorize against OURS (the reference resolves
+        the forwarded token in the target region; non-replicated tokens
+        are region-local, like non-global tokens there)."""
+        if (
+            isinstance(args, dict)
+            and args.get("__cross_region_token__") is not None
+            and args.get("region") == self.region
+        ):
+            self._check_cross_region(method, args)
+
+    # RPC method → (kind, capability) for federated re-authorization.
+    # kind "ns": namespace capability against args' namespace;
+    # kind "read": any valid token; everything unlisted needs management.
+    _FEDERATED_CAPS = {
+        "Job.register": ("ns", "submit-job"),
+        "Job.deregister": ("ns", "submit-job"),
+        "Job.revert": ("ns", "submit-job"),
+        "Job.dispatch": ("ns", "dispatch-job"),
+        "Job.plan": ("ns", "submit-job"),
+        "Job.periodic_force": ("ns", "submit-job"),
+        "Job.get": ("ns", "read-job"),
+        "Job.list": ("read", None),
+        "Job.allocs": ("ns", "read-job"),
+        "Job.evals": ("ns", "read-job"),
+        "Job.summary": ("ns", "read-job"),
+        "Job.versions": ("ns", "read-job"),
+        "Node.list": ("read", None),
+        "Node.get": ("read", None),
+        "Alloc.get": ("read", None),
+        "Alloc.list": ("read", None),
+        "Alloc.list_by_node": ("read", None),
+        "Alloc.stop": ("read", None),  # + ns guard in the HTTP layer
+        "Eval.get": ("read", None),
+        "Eval.list": ("read", None),
+        "Eval.allocs": ("read", None),
+        "Deployment.get": ("read", None),
+        "Deployment.list": ("read", None),
+        "Service.list": ("read", None),
+        "Service.get": ("read", None),
+        "Volume.list": ("ns", "read-job"),
+        "Volume.get": ("ns", "read-job"),
+        "Volume.register": ("ns", "submit-job"),
+        "Status.regions": ("read", None),
+        "Status.leader": ("read", None),
+        "Status.peers": ("read", None),
+    }
+
+    def _check_cross_region(self, method: str, args: dict) -> None:
+        if not self.acl_enforce:
+            return
+        token = args.get("__cross_region_token__") or ""
+        try:
+            acl = self.server.resolve_token(token)
+        except PermissionError as e:
+            raise PermissionError(f"region {self.region!r}: {e}") from None
+        if acl is None:
+            raise PermissionError(
+                f"region {self.region!r}: missing ACL token"
+            )
+        if acl.is_management():
+            return
+        rule = self._FEDERATED_CAPS.get(method)
+        if rule is None:
+            raise PermissionError(
+                f"region {self.region!r}: {method} requires a management "
+                f"token across regions"
+            )
+        kind, cap = rule
+        if kind == "read":
+            return  # any valid local token may read
+        ns = args.get("namespace") or getattr(
+            args.get("job"), "namespace", None
+        ) or getattr(args.get("volume"), "namespace", None) or "default"
+        if not acl.allow_namespace_op(ns, cap):
+            raise PermissionError(
+                f"region {self.region!r}: missing {cap!r} on "
+                f"namespace {ns!r}"
+            )
 
     def region_server(self, region: str):
         """A live server's fabric addr in the named region, from gossip

@@ -532,6 +532,33 @@ class Server:
     def services_deregister(self, ids: list[str]) -> int:
         return self.raft_apply("service_delete", ids)
 
+    def alloc_stop(self, alloc_id: str) -> str:
+        """Stop one allocation and let the scheduler replace it
+        (reference alloc_endpoint.go Stop: DesiredTransition.Migrate +
+        an eval). Returns the eval id."""
+        from ..structs.structs import DesiredTransition
+
+        alloc = self.state.alloc_by_id(alloc_id)
+        if alloc is None:
+            raise KeyError(f"alloc {alloc_id} not found")
+        job = alloc.job or self.state.job_by_id(alloc.namespace, alloc.job_id)
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=alloc.namespace,
+            priority=job.priority if job else 50,
+            type=job.type if job else JOB_TYPE_SERVICE,
+            triggered_by="alloc-stop",
+            job_id=alloc.job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self.raft_apply(
+            "alloc_update_desired_transition",
+            ({alloc_id: DesiredTransition(migrate=True)}, [ev]),
+        )
+        return ev.id
+
     def job_plan(self, job: Job, diff: bool = True) -> dict:
         """Dry-run the candidate job: run the real scheduler against a
         snapshot without committing; return annotations + diff + failures

@@ -1,0 +1,173 @@
+"""Alloc lifecycle + operator/system CLI surface tests.
+
+Reference intent: command/alloc_restart.go, alloc_signal.go,
+alloc_stop.go, system_gc.go, operator_scheduler_*.go, job_validate.go,
+job_init.go, agent_info.go.
+"""
+
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api.client import NomadClient
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def agent(tmp_path):
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path / "agent")
+    a = Agent(cfg)
+    a.start()
+    assert a.client.wait_registered(10)
+    yield a
+    a.shutdown()
+
+
+def _api(agent):
+    return NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+
+
+def _run_job(agent, job_id="lifecycle", driver="mock", config=None):
+    srv = agent.server.server
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = 1
+    t = tg.tasks[0]
+    t.driver = driver
+    t.config = config if config is not None else {}
+    srv.job_register(job)
+
+    def running():
+        return [
+            a
+            for a in srv.state.allocs_by_job("default", job_id)
+            if a.client_status == "running"
+        ]
+
+    assert wait_until(lambda: running(), 15)
+    return running()[0]
+
+
+def test_alloc_restart_via_api(agent):
+    alloc = _run_job(agent)
+    api = _api(agent)
+    runner = agent.client.alloc_runners[alloc.id]
+    tr = runner.task_runners["web"]
+    before = tr.state.restarts
+    out = api.allocations.restart(alloc.id)
+    assert out["ok"] is True
+    assert wait_until(lambda: tr.state.restarts > before, 10), (
+        "restart must bounce the task"
+    )
+    assert wait_until(lambda: tr.state.state == "running", 10)
+
+
+def test_alloc_signal_via_api(agent, tmp_path):
+    sig_file = tmp_path / "sig.txt"
+    script = (
+        f"trap 'echo got >> {sig_file}' HUP; "
+        "while true; do sleep 0.1; done"
+    )
+    alloc = _run_job(
+        agent, job_id="sig-job", driver="rawexec",
+        config={"command": "/bin/sh", "args": ["-c", script]},
+    )
+    api = _api(agent)
+    # give the shell a beat to install the trap
+    time.sleep(0.5)
+    out = api.allocations.signal(alloc.id, "SIGHUP")
+    assert out["ok"] is True
+    assert wait_until(lambda: sig_file.exists(), 10), (
+        "SIGHUP must reach the task process"
+    )
+    agent.server.server.job_deregister("default", "sig-job", purge=False)
+
+
+def test_alloc_stop_reschedules(agent):
+    alloc = _run_job(agent, job_id="stopper")
+    api = _api(agent)
+    out = api.allocations.stop(alloc.id)
+    assert out["EvalID"]
+    srv = agent.server.server
+
+    def replaced():
+        allocs = srv.state.allocs_by_job("default", "stopper")
+        stopped = any(
+            a.id == alloc.id and a.desired_status == "stop" for a in allocs
+        )
+        replacement = any(
+            a.id != alloc.id and not a.terminal_status() for a in allocs
+        )
+        return stopped and replacement
+
+    assert wait_until(replaced, 15), (
+        "alloc stop must stop the alloc AND schedule a replacement"
+    )
+
+
+def test_unknown_task_restart_errors(agent):
+    alloc = _run_job(agent, job_id="task-miss")
+    api = _api(agent)
+    from nomad_tpu.api.client import APIError
+
+    with pytest.raises(APIError):
+        api.allocations.restart(alloc.id, task="nope")
+
+
+def test_system_gc(agent):
+    api = _api(agent)
+    api.system.gc()  # 200 = the force-gc core eval enqueued
+
+
+def test_scheduler_configuration_roundtrip(agent):
+    api = _api(agent)
+    cfg = api.operator.scheduler_configuration()
+    assert cfg["SchedulerAlgorithm"] == "binpack"
+    api.operator.scheduler_set_configuration(
+        {
+            "SchedulerAlgorithm": "spread",
+            "PreemptionConfig": {"ServiceSchedulerEnabled": False},
+        }
+    )
+    cfg = api.operator.scheduler_configuration()
+    assert cfg["SchedulerAlgorithm"] == "spread"
+    assert cfg["PreemptionConfig"]["ServiceSchedulerEnabled"] is False
+    # the live scheduler object changed too
+    assert agent.server.server.scheduler_config.algorithm == "spread"
+    from nomad_tpu.api.client import APIError
+
+    with pytest.raises(APIError):
+        api.operator.scheduler_set_configuration(
+            {"SchedulerAlgorithm": "nope"}
+        )
+
+
+def test_job_validate_and_init(tmp_path, monkeypatch):
+    from nomad_tpu.cli.main import cmd_job_init, cmd_job_validate
+
+    monkeypatch.chdir(tmp_path)
+    rc = cmd_job_init(SimpleNamespace(filename=None))
+    assert rc == 0 and os.path.exists("example.nomad")
+    rc = cmd_job_validate(
+        SimpleNamespace(jobfile="example.nomad", var=[])
+    )
+    assert rc == 0
+    # a second init refuses to clobber
+    assert cmd_job_init(SimpleNamespace(filename=None)) == 1
+    # invalid spec fails
+    bad = tmp_path / "bad.nomad"
+    bad.write_text('job "x" { group "g" { count = -2\n task "t" {} } }')
+    assert cmd_job_validate(SimpleNamespace(jobfile=str(bad), var=[])) == 1

@@ -115,3 +115,37 @@ def test_http_region_param_forwards(two_regions, tmp_path):
         assert api.status.regions() == ["eu", "us"]
     finally:
         http.shutdown()
+
+
+def test_cross_region_requires_target_region_token(two_regions):
+    """Federated ACL: the target region re-authorizes the forwarded
+    token against ITS OWN acl state (tokens are region-local unless the
+    operator creates them in both regions — the reference's non-global
+    token semantics)."""
+    us, eu = two_regions
+    eu.acl_enforce = True
+    # a request forwarded from us with no/unknown token must be denied
+    with pytest.raises(Exception, match="token"):
+        us.rpc_self(
+            "Job.register",
+            {
+                "job": mock.job(id="sneak"),
+                "region": "eu",
+                "__cross_region_token__": "",
+            },
+        )
+    assert eu.server.state.job_by_id("default", "sneak") is None
+    # a management token minted IN eu authorizes
+    from nomad_tpu.acl.structs import ACLToken
+
+    tok = ACLToken.new(name="eu-mgmt", type="management")
+    eu.server.raft_apply("acl_token_upsert", [tok])
+    us.rpc_self(
+        "Job.register",
+        {
+            "job": mock.job(id="legit"),
+            "region": "eu",
+            "__cross_region_token__": tok.secret_id,
+        },
+    )
+    assert eu.server.state.job_by_id("default", "legit") is not None
