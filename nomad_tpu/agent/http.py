@@ -139,7 +139,12 @@ class HTTPAgentServer:
         RPC's QueryOptions/WriteRequest). The caller's token rides along
         so the TARGET region re-authorizes against its own ACL state."""
         region = _REQ_REGION.get()
-        if region and isinstance(args, dict) and "region" not in args:
+        if (
+            region
+            and region != self.cluster.region
+            and isinstance(args, dict)
+            and "region" not in args
+        ):
             args = {
                 **args,
                 "region": region,
@@ -935,18 +940,10 @@ class HTTPAgentServer:
                     "Alloc.stop", {"alloc_id": p["id"]}
                 )
                 return {"EvalID": eval_id}
-            alloc = srv.state.alloc_by_id(p["id"])
-            if alloc is None:
-                matches = [
-                    a
-                    for a in srv.state.allocs()
-                    if a.id.startswith(p["id"])
-                ]
-                if len(matches) > 1:
-                    raise HTTPError(400, f"alloc prefix {p['id']!r} ambiguous")
-                alloc = matches[0] if matches else None
-            if alloc is None:
-                raise HTTPError(404, f"alloc {p['id']} not found")
+            try:
+                alloc = self.cluster.find_alloc(p["id"])
+            except LookupError as e:
+                raise HTTPError(404, str(e)) from None
             self._ns_guard(tok, alloc.namespace, "alloc-lifecycle")
             eval_id = self.rpc_region("Alloc.stop", {"alloc_id": alloc.id})
             return {"EvalID": eval_id}
@@ -1330,6 +1327,10 @@ class HTTPAgentServer:
                     # Expected operational rejections (e.g. re-running acl
                     # bootstrap): client error, not a 500.
                     self._reply(400, {"error": str(e)})
+                except PermissionError as e:
+                    # federated/endpoint-level ACL denials (e.g. the
+                    # target region's cross-region precheck)
+                    self._reply(403, {"error": str(e)})
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 except Exception as e:

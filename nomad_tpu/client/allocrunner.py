@@ -298,31 +298,34 @@ class AllocRunner:
 
     # ------------------------------------------------------------------
 
-    def restart(self, task_name: str = "") -> None:
-        """Restart one task or every task (reference alloc_endpoint.go
-        Restart → task runner restart without budget)."""
+    def _lifecycle_targets(self, task_name: str):
+        """Runners an operator lifecycle verb applies to: the named task
+        (must exist), or every RUNNING task — a dead prestart task must
+        not fail a whole-alloc restart (reference alloc restart only
+        errors for an explicitly named non-running task)."""
         with self._lock:
             runners = dict(self.task_runners)
         if task_name:
             tr = runners.get(task_name)
             if tr is None:
                 raise KeyError(f"task {task_name!r} not in alloc")
+            return [tr]
+        running = [
+            tr for tr in runners.values() if tr.state.state == "running"
+        ]
+        if not running:
+            raise RuntimeError("no running tasks in allocation")
+        return running
+
+    def restart(self, task_name: str = "") -> None:
+        """Restart one task or every running task (reference
+        alloc_endpoint.go Restart → task runner restart without budget)."""
+        for tr in self._lifecycle_targets(task_name):
             tr.trigger_restart()
-        else:
-            for tr in runners.values():
-                tr.trigger_restart()
 
     def signal(self, sig: str, task_name: str = "") -> None:
-        with self._lock:
-            runners = dict(self.task_runners)
-        if task_name:
-            tr = runners.get(task_name)
-            if tr is None:
-                raise KeyError(f"task {task_name!r} not in alloc")
+        for tr in self._lifecycle_targets(task_name):
             tr.signal(sig)
-        else:
-            for tr in runners.values():
-                tr.signal(sig)
 
     def update(self, updated: Allocation) -> None:
         """Server pushed a new version of this alloc (reference Update :802)."""

@@ -519,11 +519,14 @@ class AllocEndpoint(_Forwarder):
         return self.cs.server.state.allocs()
 
     def stop(self, args):
-        return self._forward(
-            "Alloc.stop",
-            args,
-            lambda a: self.cs.server.alloc_stop(a["alloc_id"]),
-        )
+        def local(a):
+            try:
+                alloc = self.cs.find_alloc(a["alloc_id"])
+            except LookupError as e:
+                raise KeyError(str(e)) from None
+            return self.cs.server.alloc_stop(alloc.id)
+
+        return self._forward("Alloc.stop", args, local)
 
     def list_by_node(self, args):
         return self.cs.server.state.allocs_by_node(args["node_id"])
@@ -774,11 +777,10 @@ class ClusterServer:
 
     # -- wiring --------------------------------------------------------
 
-    def find_alloc_client(self, alloc_id: str):
-        """Resolve an alloc (exact id or unique prefix) and its client
-        agent's advertised streaming address. Raises LookupError with a
-        human message — the single source of truth for both the HTTP fs
-        handlers and the fabric exec splice."""
+    def find_alloc(self, alloc_id: str):
+        """Resolve an alloc by exact id or unique prefix — the single
+        source of truth for id resolution (state only; raises
+        LookupError with a human message)."""
         state = self.server.state
         alloc = state.alloc_by_id(alloc_id)
         if alloc is None:
@@ -788,6 +790,13 @@ class ClusterServer:
             alloc = matches[0] if matches else None
         if alloc is None:
             raise LookupError(f"allocation {alloc_id!r} not found")
+        return alloc
+
+    def find_alloc_client(self, alloc_id: str):
+        """find_alloc plus the client agent's advertised streaming
+        address (the HTTP fs handlers and the fabric exec splice)."""
+        state = self.server.state
+        alloc = self.find_alloc(alloc_id)
         node = state.node_by_id(alloc.node_id)
         addr_s = (node.attributes.get("unique.client.rpc", "") if node else "")
         if not addr_s:
@@ -1039,7 +1048,7 @@ class ClusterServer:
         "Alloc.get": ("read", None),
         "Alloc.list": ("read", None),
         "Alloc.list_by_node": ("read", None),
-        "Alloc.stop": ("read", None),  # + ns guard in the HTTP layer
+        "Alloc.stop": ("alloc_ns", "alloc-lifecycle"),
         "Eval.get": ("read", None),
         "Eval.list": ("read", None),
         "Eval.allocs": ("read", None),
@@ -1078,6 +1087,19 @@ class ClusterServer:
         kind, cap = rule
         if kind == "read":
             return  # any valid local token may read
+        if kind == "alloc_ns":
+            # resolve the TARGET object's namespace here — the sending
+            # region's HTTP guard never saw this alloc
+            try:
+                alloc = self.find_alloc(args.get("alloc_id", ""))
+            except LookupError:
+                return  # the op itself will 404
+            if not acl.allow_namespace_op(alloc.namespace, cap):
+                raise PermissionError(
+                    f"region {self.region!r}: missing {cap!r} on "
+                    f"namespace {alloc.namespace!r}"
+                )
+            return
         ns = args.get("namespace") or getattr(
             args.get("job"), "namespace", None
         ) or getattr(args.get("volume"), "namespace", None) or "default"

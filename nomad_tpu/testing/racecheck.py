@@ -1,0 +1,172 @@
+"""Lock-order race detection.
+
+Reference intent: SURVEY §5.2 — the reference's CI runs `go test -race`;
+CPython has no data-race sanitizer, but the failure mode that actually
+bites a lock-disciplined Python codebase is LOCK-ORDER INVERSION
+(thread A holds L1 wanting L2 while thread B holds L2 wanting L1 —
+a deadlock waiting for load). This module is the repo's -race analog:
+
+  * ``install()`` replaces ``threading.Lock``/``threading.RLock`` with
+    tracked factories. Every lock is keyed by its ALLOCATION SITE
+    (file:line), so instances group into lock classes the way lock-order
+    checkers conventionally do.
+  * Each acquisition records held-before edges class→class. A cycle in
+    that graph is a potential deadlock; the offending edge is recorded
+    with both stacks.
+  * ``violations()`` returns what was found; ``uninstall()`` restores
+    the real primitives.
+
+Enabled in CI via ``NOMAD_RACECHECK=1`` (tests/test_racecheck.py runs a
+full server+client exercise under it in a subprocess); production code
+never imports this module.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+
+_state_lock = _real_lock()
+_edges: dict[tuple[str, str], str] = {}  # (from_class, to_class) -> stack
+_violations: list[dict] = []
+_holding = threading.local()
+_installed = False
+
+
+def _alloc_site() -> str:
+    # first frame outside THIS module (exact path — a substring match
+    # would skip a caller merely named *racecheck*) and threading.py
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        fn = frame.filename
+        if fn == __file__ or fn.endswith("threading.py"):
+            continue
+        return f"{fn}:{frame.lineno}"
+    return "unknown"
+
+
+def _held() -> list[str]:
+    if not hasattr(_holding, "stack"):
+        _holding.stack = []
+    return _holding.stack
+
+
+def _reachable(graph: dict, start: str, goal: str) -> bool:
+    seen = set()
+    work = [start]
+    while work:
+        cur = work.pop()
+        if cur == goal:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        work.extend(b for (a, b) in graph if a == cur)
+    return False
+
+
+def _record_acquire(cls: str) -> None:
+    held = _held()
+    for prior in held:
+        if prior == cls:
+            continue  # same class (e.g. two store instances) — skip,
+            # intra-class ordering needs instance identity to be sound
+        edge = (prior, cls)
+        with _state_lock:
+            if edge in _edges:
+                continue
+            # would cls→...→prior + prior→cls close a cycle?
+            if _reachable(_edges, cls, prior):
+                _violations.append({
+                    "classes": (prior, cls),
+                    "stack": "".join(traceback.format_stack(limit=12)),
+                    "first_seen": _edges.get((cls, prior), ""),
+                })
+            _edges[edge] = "".join(traceback.format_stack(limit=12))
+    held.append(cls)
+
+
+def _record_release(cls: str) -> None:
+    held = _held()
+    # remove the most recent matching entry (locks are not always
+    # released LIFO; Python allows it)
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == cls:
+            del held[i]
+            return
+
+
+class _TrackedLock:
+    """Wraps a real lock; tracks acquisition order by allocation-site
+    class. Unknown attributes delegate to the underlying primitive so
+    Condition's _release_save/_is_owned paths keep working (those
+    bypass tracking, which only costs coverage, not correctness)."""
+
+    def __init__(self, underlying) -> None:
+        self._lock = underlying
+        self._cls = _alloc_site()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _record_acquire(self._cls)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _record_release(self._cls)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._lock, name)
+
+
+def install() -> None:
+    global _installed
+    if _installed:
+        return
+    threading.Lock = lambda: _TrackedLock(_real_lock())  # type: ignore
+    threading.RLock = lambda: _TrackedLock(_real_rlock())  # type: ignore
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    threading.Lock = _real_lock  # type: ignore
+    threading.RLock = _real_rlock  # type: ignore
+    _installed = False
+
+
+def reset() -> None:
+    with _state_lock:
+        _edges.clear()
+        _violations.clear()
+
+
+def violations() -> list[dict]:
+    with _state_lock:
+        return list(_violations)
+
+
+def report() -> str:
+    out = []
+    for v in violations():
+        a, b = v["classes"]
+        out.append(
+            f"LOCK-ORDER INVERSION: {a} -> {b} conflicts with an "
+            f"existing {b} -> {a} ordering\n--- second acquisition "
+            f"stack ---\n{v['stack']}\n--- first ordering stack ---\n"
+            f"{v['first_seen']}"
+        )
+    return "\n\n".join(out)

@@ -1,0 +1,119 @@
+"""Race-detector (lock-order inversion) tests — SURVEY §5.2's -race
+analog. The e2e case runs the full server+client stack under the
+detector in a SUBPROCESS so the monkeypatched primitives never leak
+into the rest of the suite."""
+
+import subprocess
+import sys
+import textwrap
+import threading
+
+
+def test_detects_lock_order_inversion():
+    from nomad_tpu.testing import racecheck
+
+    racecheck.reset()
+    racecheck.install()
+    try:
+        l1 = threading.Lock()
+        l2 = threading.Lock()
+
+        def ab():
+            with l1:
+                with l2:
+                    pass
+
+        def ba():
+            with l2:
+                with l1:
+                    pass
+
+        t1 = threading.Thread(target=ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=ba)
+        t2.start()
+        t2.join()
+    finally:
+        racecheck.uninstall()
+    vs = racecheck.violations()
+    assert vs, "inverted acquisition order must be flagged"
+    assert "LOCK-ORDER INVERSION" in racecheck.report()
+    racecheck.reset()
+
+
+def test_consistent_order_is_clean():
+    from nomad_tpu.testing import racecheck
+
+    racecheck.reset()
+    racecheck.install()
+    try:
+        l1 = threading.Lock()
+        l2 = threading.Lock()
+        for _ in range(3):
+            with l1:
+                with l2:
+                    pass
+    finally:
+        racecheck.uninstall()
+    assert racecheck.violations() == []
+    racecheck.reset()
+
+
+def test_full_stack_is_inversion_free(tmp_path):
+    """The repo's own lock discipline holds under the detector: a real
+    server+client runs a job end to end with every Lock/RLock tracked.
+    This is the CI shape the reference gets from `go test -race`."""
+    script = textwrap.dedent(
+        """
+        import sys, time
+        sys.path.insert(0, %r)
+        from nomad_tpu.testing import racecheck
+        racecheck.install()  # BEFORE any nomad_tpu locks are created
+
+        from nomad_tpu.client import Client, ServerRPC
+        from nomad_tpu.server import Server
+        from nomad_tpu import mock
+
+        server = Server(num_workers=2)
+        server.establish_leadership()
+        client = Client(ServerRPC(server), data_dir=%r)
+        client.start()
+        assert client.wait_registered(15)
+        job = mock.job(id="race-e2e")
+        job.task_groups[0].count = 2
+        t = job.task_groups[0].tasks[0]
+        t.driver = "mock"; t.config = {}
+        server.job_register(job)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            allocs = [
+                a for a in server.state.allocs_by_job("default", "race-e2e")
+                if a.client_status == "running"
+            ]
+            if len(allocs) == 2:
+                break
+            time.sleep(0.1)
+        else:
+            raise SystemExit("allocs never ran")
+        server.job_deregister("default", "race-e2e", purge=False)
+        time.sleep(1.0)
+        client.shutdown()
+        server.shutdown()
+        vs = racecheck.violations()
+        if vs:
+            print(racecheck.report())
+            raise SystemExit(f"{len(vs)} lock-order inversions")
+        print("RACECHECK CLEAN")
+        """
+    ) % ("/root/repo", str(tmp_path / "c0"))
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, (
+        f"stdout:\n{out.stdout[-4000:]}\nstderr:\n{out.stderr[-2000:]}"
+    )
+    assert "RACECHECK CLEAN" in out.stdout
