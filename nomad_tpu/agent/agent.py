@@ -111,6 +111,10 @@ class AgentConfig:
     node_class: str = ""
     # CSI plugins: plugin_id -> builtin catalog name | "module:Class" ref
     csi_plugins: dict = field(default_factory=dict)
+    # external task-driver plugins: driver name -> "module:Class" factory
+    # ref, launched out-of-process over the plugin fabric (reference:
+    # the go-plugin catalog, plugins/serve.go + helper/pluginutils)
+    driver_plugins: dict = field(default_factory=dict)
     # http
     http_port: int = 0  # reference default 4646
     # scheduler
@@ -203,8 +207,19 @@ class Agent:
                     [tuple(a) for a in config.client_servers],
                     rpc_secret=config.rpc_secret,
                 )
+            drivers = None
+            if config.driver_plugins:
+                from ..drivers import BUILTIN_DRIVERS
+                from ..drivers.plugin import ExternalDriver
+
+                drivers = {
+                    name: cls() for name, cls in BUILTIN_DRIVERS.items()
+                }
+                for name, ref in config.driver_plugins.items():
+                    drivers[name] = ExternalDriver(name, ref)
             self.client = Client(
                 rpc,
+                drivers=drivers,
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,

@@ -188,6 +188,11 @@ def _load_agent_config(path: str):
             cfg.vault_allowed_policies = [
                 str(x) for x in va["allowed_policies"]
             ]
+    for plug in body.blocks("plugin"):
+        name = plug.labels[0] if plug.labels else ""
+        ref = plug.body.attrs().get("factory", "")
+        if name and ref:
+            cfg.driver_plugins[name] = str(ref)
     return cfg
 
 

@@ -171,3 +171,50 @@ def test_job_validate_and_init(tmp_path, monkeypatch):
     bad = tmp_path / "bad.nomad"
     bad.write_text('job "x" { group "g" { count = -2\n task "t" {} } }')
     assert cmd_job_validate(SimpleNamespace(jobfile=str(bad), var=[])) == 1
+
+
+def test_external_driver_plugin_catalog(tmp_path):
+    """Agent config `plugin "x" { factory = "mod:Class" }` launches the
+    driver out-of-process (reference: go-plugin catalog)."""
+    from nomad_tpu.cli.main import _load_agent_config
+
+    cfgfile = tmp_path / "agent.hcl"
+    cfgfile.write_text(
+        'plugin "xmock" { factory = "nomad_tpu.drivers.mock:MockDriver" }\n'
+        "client { enabled = true }\n"
+    )
+    cfg = _load_agent_config(str(cfgfile))
+    assert cfg.driver_plugins == {
+        "xmock": "nomad_tpu.drivers.mock:MockDriver"
+    }
+    cfg.server_enabled = True
+    cfg.dev_mode = True
+    cfg.data_dir = str(tmp_path / "data")
+    a = Agent(cfg)
+    a.start()
+    try:
+        assert a.client.wait_registered(10)
+        # the external driver fingerprinted onto the node via its own
+        # process over the plugin fabric
+        assert a.client.node.attributes.get("driver.mock") == "1"
+        assert "xmock" in a.client.drivers
+        from nomad_tpu.drivers.plugin import ExternalDriver
+
+        assert isinstance(a.client.drivers["xmock"], ExternalDriver)
+        srv = a.server.server
+        job = mock.job(id="ext-driven")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "xmock"
+        tg.tasks[0].config = {}
+        srv.job_register(job)
+        assert wait_until(
+            lambda: [
+                x
+                for x in srv.state.allocs_by_job("default", "ext-driven")
+                if x.client_status == "running"
+            ],
+            15,
+        ), "job must run on the out-of-process driver"
+    finally:
+        a.shutdown()
