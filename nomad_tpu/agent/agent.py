@@ -207,19 +207,9 @@ class Agent:
                     [tuple(a) for a in config.client_servers],
                     rpc_secret=config.rpc_secret,
                 )
-            drivers = None
-            if config.driver_plugins:
-                from ..drivers import BUILTIN_DRIVERS
-                from ..drivers.plugin import ExternalDriver
-
-                drivers = {
-                    name: cls() for name, cls in BUILTIN_DRIVERS.items()
-                }
-                for name, ref in config.driver_plugins.items():
-                    drivers[name] = ExternalDriver(name, ref)
             self.client = Client(
                 rpc,
-                drivers=drivers,
+                driver_plugins=config.driver_plugins,
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,

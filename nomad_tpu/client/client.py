@@ -97,6 +97,7 @@ class Client:
         rpc_secret: str = "",
         advertise_host: str = "127.0.0.1",
         csi_plugins: Optional[dict] = None,
+        driver_plugins: Optional[dict] = None,  # name -> "module:Class"
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
@@ -118,7 +119,17 @@ class Client:
         )
         host, port = self.endpoints.addr
         self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
-        self.drivers = drivers or {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+        self.drivers = drivers or {
+            name: cls() for name, cls in BUILTIN_DRIVERS.items()
+        }
+        # external driver plugins overlay the builtins (reference:
+        # go-plugin catalog); Client owns the merge so builtins are
+        # instantiated in exactly one place
+        if driver_plugins:
+            from ..drivers.plugin import ExternalDriver
+
+            for name, ref in driver_plugins.items():
+                self.drivers[name] = ExternalDriver(name, ref)
         # Device plugins: accelerators fingerprint onto the node so the
         # scheduler's DeviceAllocator has real instances to assign.
         from .devicemanager import DeviceManager
@@ -212,6 +223,14 @@ class Client:
                 ar.destroy()
         self.vault_client.stop()
         self.csi_manager.shutdown()
+        # out-of-process driver plugins die with us, not as orphans
+        for driver in self.drivers.values():
+            stop = getattr(driver, "shutdown_plugin", None)
+            if stop is not None:
+                try:
+                    stop()
+                except Exception:
+                    logger.exception("driver plugin shutdown failed")
         self.state_db.close()
 
     # -- loops ---------------------------------------------------------
