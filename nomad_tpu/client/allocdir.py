@@ -2,9 +2,11 @@
 
 Reference: client/allocdir/ (~1,500 LoC) — the shared alloc dir
 (SharedAllocDir: alloc/data, alloc/logs, alloc/tmp) plus per-task dirs
-(TaskDir: local, secrets, tmp, private). Chroot building for the exec
-driver is host-dependent and intentionally out of scope; the exec
-driver's isolation comes from the native executor's cgroup placement.
+(TaskDir: local, secrets, tmp, private), and the chroot builder the
+exec driver uses (fs_linux.go: the configured chroot_env map is
+materialized into the task dir, which then becomes the task's root).
+Hard links are used where the filesystem allows (free), falling back
+to copies — same economics as the reference's link-or-copy walk.
 """
 
 from __future__ import annotations
@@ -42,6 +44,49 @@ def confine(base_dir: str, path: str) -> str:
     if resolved != base and not resolved.startswith(base + os.sep):
         raise EscapeError(f"path {path!r} escapes alloc dir {base_dir!r}")
     return resolved
+
+
+def build_chroot(chroot_dir: str, chroot_env: dict[str, str]) -> None:
+    """Materialize ``{host_src: dst_in_chroot}`` under chroot_dir
+    (reference client/allocdir/fs_linux.go buildChroot). Missing
+    sources are skipped like the reference (the default map names
+    paths not every distro has)."""
+
+    def place(src: str, dst: str) -> None:
+        if os.path.islink(src):
+            target = os.readlink(src)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            if not os.path.lexists(dst):
+                os.symlink(target, dst)
+            return
+        if os.path.isdir(src):
+            try:
+                entries = os.listdir(src)
+            except OSError:
+                return
+            os.makedirs(dst, exist_ok=True)
+            for name in entries:
+                place(os.path.join(src, name), os.path.join(dst, name))
+            return
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.lexists(dst):
+            return
+        try:
+            os.link(src, dst)  # free when same filesystem
+        except OSError:
+            try:
+                shutil.copy2(src, dst)
+            except OSError:
+                pass  # unreadable/special file: skip, like the reference
+
+    os.makedirs(chroot_dir, exist_ok=True)
+    for src, dst in chroot_env.items():
+        if not os.path.lexists(src):
+            continue
+        # dst is JOB-controlled: a traversal like ../../etc/x would make
+        # this root-privileged walk write onto the host — confine it
+        target = confine(chroot_dir, dst.lstrip("/"))
+        place(src, target)
 
 
 class AllocDir:

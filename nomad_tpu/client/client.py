@@ -119,9 +119,11 @@ class Client:
         )
         host, port = self.endpoints.addr
         self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
-        self.drivers = drivers or {
-            name: cls() for name, cls in BUILTIN_DRIVERS.items()
-        }
+        self.drivers = (
+            dict(drivers)
+            if drivers is not None
+            else {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
+        )
         # external driver plugins overlay the builtins (reference:
         # go-plugin catalog); Client owns the merge so builtins are
         # instantiated in exactly one place

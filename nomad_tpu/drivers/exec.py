@@ -75,6 +75,15 @@ class ExecDriver(Driver):
         from .configspec import EXEC_SPEC
 
         conf = EXEC_SPEC.validate(cfg.config, "exec")
+        chroot = ""
+        if conf.get("chroot_env") and os.geteuid() == 0:
+            # materialize the task's root filesystem into the task dir
+            # (reference: exec always chroots via libcontainer; here it
+            # is opt-in per task config and requires root)
+            from ..client.allocdir import build_chroot
+
+            build_chroot(cfg.task_dir, conf["chroot_env"])
+            chroot = cfg.task_dir
         command = conf.get("command")
         if not command:
             raise DriverError("exec: missing 'command' in task config")
@@ -90,7 +99,8 @@ class ExecDriver(Driver):
                 env=cfg.env,
                 stdout_path=cfg.stdout_path,
                 stderr_path=cfg.stderr_path,
-                cwd=cfg.task_dir,
+                cwd="/" if chroot else cfg.task_dir,
+                chroot=chroot,
                 user=cfg.user,
                 cgroup=cgroup,
                 memory_max_bytes=cfg.resources_memory_mb * 1024 * 1024,

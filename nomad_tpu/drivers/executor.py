@@ -227,6 +227,7 @@ def launch_executor(
     stdout_path: str = "",
     stderr_path: str = "",
     cwd: str = "",
+    chroot: str = "",
     user: str = "",
     cgroup: str = "",
     memory_max_bytes: int = 0,
@@ -247,6 +248,8 @@ def launch_executor(
     lines += [f"env\t{_esc(f'{k}={v}')}" for k, v in env.items()]
     if cwd:
         lines.append(f"cwd\t{_esc(cwd)}")
+    if chroot:
+        lines.append(f"chroot\t{_esc(chroot)}")
     if stdout_path:
         lines.append(f"stdout\t{_esc(stdout_path)}")
     if stderr_path:

@@ -51,6 +51,8 @@ struct Spec {
   std::vector<std::string> args;
   std::vector<std::string> env;   // KEY=VAL
   std::string cwd;
+  std::string chroot_dir;        // chroot before exec (task filesystem
+                                 // isolation; reference libcontainer)
   std::string stdout_path;
   std::string stderr_path;
   std::string socket_path;
@@ -99,6 +101,7 @@ static bool read_spec(const char *path, Spec &s) {
     else if (key == "arg") s.args.push_back(val);
     else if (key == "env") s.env.push_back(val);
     else if (key == "cwd") s.cwd = val;
+    else if (key == "chroot") s.chroot_dir = val;
     else if (key == "stdout") s.stdout_path = val;
     else if (key == "stderr") s.stderr_path = val;
     else if (key == "socket") s.socket_path = val;
@@ -164,24 +167,54 @@ static pid_t spawn_task(const Spec &s, bool join_cgroup) {
       close(fd);
     }
   }
-  if (!s.cwd.empty() && chdir(s.cwd.c_str()) != 0) _exit(126);
+  // Resolve the target user from the HOST passwd database before any
+  // pivot: after chroot() getpwnam would consult the (job-controlled)
+  // chroot's /etc/passwd — a miss silently kept root, and a planted
+  // passwd could map any name to uid 0. A named user that does not
+  // resolve is fatal.
+  uid_t run_uid = 0;
+  gid_t run_gid = 0;
+  bool drop_user = false;
   if (!s.user.empty() && getuid() == 0) {
     struct passwd *pw = getpwnam(s.user.c_str());
-    if (pw) {
-      if (initgroups(pw->pw_name, pw->pw_gid) != 0 ||
-          setgid(pw->pw_gid) != 0 || setuid(pw->pw_uid) != 0)
-        _exit(126);
+    if (!pw) _exit(126);
+    run_uid = pw->pw_uid;
+    run_gid = pw->pw_gid;
+    if (initgroups(pw->pw_name, pw->pw_gid) != 0) _exit(126);
+    drop_user = true;
+  }
+  bool logs_opened = false;
+  if (!s.chroot_dir.empty()) {
+    // Log sinks must be opened BEFORE the pivot: the alloc log dir
+    // lives outside the new root. Paths here are launcher-controlled
+    // (the alloc dir), not job-controlled, so the root-open note below
+    // does not apply to this branch.
+    if (!s.stdout_path.empty()) {
+      int fd = open(s.stdout_path.c_str(),
+                    O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0644);
+      if (fd >= 0) { dup2(fd, 1); close(fd); }
     }
+    if (!s.stderr_path.empty()) {
+      int fd = open(s.stderr_path.c_str(),
+                    O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0644);
+      if (fd >= 0) { dup2(fd, 2); close(fd); }
+    }
+    logs_opened = true;
+    if (chroot(s.chroot_dir.c_str()) != 0 || chdir("/") != 0) _exit(126);
+  }
+  if (!s.cwd.empty() && chdir(s.cwd.c_str()) != 0) _exit(126);
+  if (drop_user) {
+    if (setgid(run_gid) != 0 || setuid(run_uid) != 0) _exit(126);
   }
   // Open log sinks only AFTER the privilege drop: a hostile stdout path
   // must never be opened with root credentials (the launcher pre-creates
   // and chowns the real log files so the task user can append).
-  if (!s.stdout_path.empty()) {
+  if (!logs_opened && !s.stdout_path.empty()) {
     int fd = open(s.stdout_path.c_str(),
                   O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0644);
     if (fd >= 0) { dup2(fd, 1); close(fd); }
   }
-  if (!s.stderr_path.empty()) {
+  if (!logs_opened && !s.stderr_path.empty()) {
     int fd = open(s.stderr_path.c_str(),
                   O_WRONLY | O_CREAT | O_APPEND | O_NOFOLLOW, 0644);
     if (fd >= 0) { dup2(fd, 2); close(fd); }
