@@ -115,6 +115,7 @@ _ANY_TOKEN_READ = [
 ]
 _OPERATOR_WRITE = [
     ("PUT", re.compile(r"^/v1/operator/.*$")),
+    ("DELETE", re.compile(r"^/v1/operator/.*$")),
     # system gc is an operator action (reference System.GarbageCollect
     # requires management)
     ("PUT", re.compile(r"^/v1/system/.*$")),
@@ -196,6 +197,19 @@ def make_http_resolver(server, enabled: bool = True):
             # "*" streams every namespace: management only.
             if ns == "*":
                 raise AuthError(403, "all-namespace stream requires management")
+        # job scale authorizes with EITHER scale-job or submit-job
+        # (reference Job.Scale) — the table below is single-capability
+        if method in ("PUT", "POST") and re.fullmatch(
+            r"/v1/job/[^/]+/scale", path
+        ):
+            if not (
+                acl.allow_namespace_op(ns, "scale-job")
+                or acl.allow_namespace_op(ns, "submit-job")
+            ):
+                raise AuthError(
+                    403, "missing namespace capability 'scale-job'"
+                )
+            return
         for m, pat, cap in _NS_ROUTES:
             if m == method and pat.match(path):
                 if not acl.allow_namespace_op(ns, cap):

@@ -111,6 +111,9 @@ class AgentConfig:
     node_class: str = ""
     # CSI plugins: plugin_id -> builtin catalog name | "module:Class" ref
     csi_plugins: dict = field(default_factory=dict)
+    # exec driver chroot map {host_src: dst_in_chroot} (reference:
+    # client config chroot_env — operator-owned, never jobspec)
+    chroot_env: dict = field(default_factory=dict)
     # external task-driver plugins: driver name -> "module:Class" factory
     # ref, launched out-of-process over the plugin fabric (reference:
     # the go-plugin catalog, plugins/serve.go + helper/pluginutils)
@@ -210,6 +213,7 @@ class Agent:
             self.client = Client(
                 rpc,
                 driver_plugins=config.driver_plugins,
+                chroot_env=config.chroot_env,
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,

@@ -78,6 +78,10 @@ class HTTPAgentServer:
         # worker thread against a possibly-slow client agent; unbounded,
         # a burst of follow-streams starves every other route.
         self._relay_max = 64
+        # /v1/agent/monitor level refcounting (see _serve_monitor)
+        self._monitor_lock = threading.Lock()
+        self._monitor_levels: list = []
+        self._monitor_base_level = 0
         self._routes: list[tuple[str, re.Pattern, Callable]] = []
         self._register_routes()
         handler = self._make_handler()
@@ -246,6 +250,47 @@ class HTTPAgentServer:
             if s is None:
                 raise HTTPError(404, "no summary")
             return s
+
+        def job_scale(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            # scale-job OR submit-job authorizes (reference Job.Scale)
+            acl = self._acl_for(tok)
+            if acl is not None and not (
+                acl.allow_namespace_op(ns, "scale-job")
+                or acl.allow_namespace_op(ns, "submit-job")
+            ):
+                raise HTTPError(
+                    403, f"missing 'scale-job' on namespace {ns!r}"
+                )
+            target = (body or {}).get("Target") or {}
+            group = target.get("Group", "")
+            count = (body or {}).get("Count")
+            if count is None or not group:
+                raise HTTPError(400, "Target.Group and Count are required")
+            try:
+                count = int(count)
+            except (TypeError, ValueError):
+                raise HTTPError(400, f"Count must be an integer, got {count!r}")
+            eval_id = self.rpc_region(
+                "Job.scale",
+                {
+                    "namespace": ns,
+                    "job_id": p["id"],
+                    "group": group,
+                    "count": count,
+                    "message": (body or {}).get("Message", ""),
+                },
+            )
+            return {"EvalID": eval_id}
+
+        def job_scale_status(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            out = self.rpc_region(
+                "Job.scale_status", {"namespace": ns, "job_id": p["id"]}
+            )
+            if out is None:
+                raise HTTPError(404, f"job {p['id']} not found")
+            return out
 
         def job_versions(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
@@ -440,6 +485,9 @@ class HTTPAgentServer:
         route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
         route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
         route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        route("POST", "/v1/job/(?P<id>[^/]+)/scale", job_scale)
+        route("PUT", "/v1/job/(?P<id>[^/]+)/scale", job_scale)
+        route("GET", "/v1/job/(?P<id>[^/]+)/scale", job_scale_status)
         route("PUT", "/v1/search", search)
         route("POST", "/v1/search", search)
         route("PUT", "/v1/search/fuzzy", search_fuzzy)
@@ -801,6 +849,10 @@ class HTTPAgentServer:
         def agent_members(p, q, body, tok):
             return [m.to_wire() for m in self.cluster.serf.members()]
 
+        def agent_monitor(p, q, body, tok):
+            # handled specially in _dispatch (streaming); never reached
+            raise HTTPError(500, "monitor is a streaming route")
+
         def agent_self(p, q, body, tok):
             return {
                 "member": self.cluster.serf.local.to_wire(),
@@ -1011,6 +1063,15 @@ class HTTPAgentServer:
                 "Operator.snapshot_restore", {"data": data}
             )
 
+        def operator_raft_remove_peer(p, q, body, tok):
+            peer = q.get("id", [""])[0] or (body or {}).get("ID", "")
+            if not peer:
+                raise HTTPError(400, "peer id required")
+            self.rpc_region(
+                "Operator.raft_remove_peer", {"peer_id": peer}
+            )
+            return None
+
         def operator_raft_config(p, q, body, tok):
             return self.rpc_region("Operator.raft_configuration", {})
 
@@ -1018,6 +1079,9 @@ class HTTPAgentServer:
         route("PUT", "/v1/operator/snapshot", operator_snapshot_restore)
         route("POST", "/v1/operator/snapshot", operator_snapshot_restore)
         route("GET", "/v1/operator/raft/configuration", operator_raft_config)
+        route(
+            "DELETE", "/v1/operator/raft/peer", operator_raft_remove_peer
+        )
 
         route("GET", "/v1/status/leader", status_leader)
         route("GET", "/v1/status/peers", status_peers)
@@ -1030,6 +1094,7 @@ class HTTPAgentServer:
         route("GET", "/v1/agent/pprof/heap", pprof_heap)
         route("GET", "/v1/agent/members", agent_members)
         route("GET", "/v1/agent/self", agent_self)
+        route("GET", "/v1/agent/monitor", agent_monitor)
         route("GET", "/v1/agent/health", agent_health)
 
     # -- event stream (long-lived NDJSON response) ---------------------
@@ -1164,6 +1229,77 @@ class HTTPAgentServer:
         session.close = tracked_close
         return session
 
+    def _serve_monitor(self, handler, query) -> None:
+        """Stream the agent's own log records as NDJSON (reference
+        command/agent/monitor: `nomad monitor` tails agent logs over
+        HTTP). A queue-backed logging.Handler attaches for the life of
+        the request; disconnect detaches it."""
+        import logging as _logging
+        import queue as _queue
+
+        level = getattr(
+            _logging,
+            query.get("log_level", ["INFO"])[0].upper(),
+            _logging.INFO,
+        )
+        q: "_queue.Queue" = _queue.Queue(maxsize=512)
+
+        class _QueueHandler(_logging.Handler):
+            def emit(self, record):
+                try:
+                    q.put_nowait({
+                        "Level": record.levelname,
+                        "Name": record.name,
+                        "Message": record.getMessage(),
+                        "Time": record.created,
+                    })
+                except _queue.Full:
+                    pass  # slow consumer: drop, never block the logger
+
+        qh = _QueueHandler(level=level)
+        root = _logging.getLogger()
+        # Concurrent monitors must not fight over the root level: keep a
+        # refcounted set of requested levels; the root runs at the min
+        # of (original, active requests) and restores the original only
+        # when the LAST monitor detaches.
+        with self._monitor_lock:
+            if not self._monitor_levels:
+                self._monitor_base_level = root.level
+            self._monitor_levels.append(level)
+            root.setLevel(min(self._monitor_base_level, *self._monitor_levels))
+        root.addHandler(qh)
+        try:
+            handler.send_response(200)
+            handler.send_header("Content-Type", "application/x-ndjson")
+            handler.send_header("Transfer-Encoding", "chunked")
+            handler.end_headers()
+
+            def chunk(data: bytes) -> None:
+                handler.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                )
+                handler.wfile.flush()
+
+            while True:
+                try:
+                    rec = q.get(timeout=10.0)
+                except _queue.Empty:
+                    chunk(b"{}\n")  # keepalive; detects dead consumers
+                    continue
+                chunk((json.dumps(rec) + "\n").encode())
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            root.removeHandler(qh)
+            with self._monitor_lock:
+                self._monitor_levels.remove(level)
+                if self._monitor_levels:
+                    root.setLevel(
+                        min(self._monitor_base_level, *self._monitor_levels)
+                    )
+                else:
+                    root.setLevel(self._monitor_base_level)
+
     def _client_roundtrip(self, alloc, method: str, header: dict) -> dict:
         session = self._client_session(alloc, method, header)
         try:
@@ -1284,6 +1420,9 @@ class HTTPAgentServer:
                             raise HTTPError(ae.status, ae.message)
                     if parsed.path == "/v1/event/stream":
                         outer._serve_event_stream(self, query)
+                        return
+                    if parsed.path == "/v1/agent/monitor":
+                        outer._serve_monitor(self, query)
                         return
                     fs_m = re.match(
                         r"^/v1/client/fs/(logs|cat)/(?P<id>[^/]+)$",

@@ -177,6 +177,24 @@ class Jobs(_Resource):
             params={"namespace": namespace or self.c.namespace},
         )
 
+    def scale(self, job_id: str, group: str, count: int,
+              message: str = "", namespace: Optional[str] = None):
+        return self.c.put(
+            f"/v1/job/{job_id}/scale",
+            params={"namespace": namespace or self.c.namespace},
+            body={
+                "Target": {"Group": group},
+                "Count": count,
+                "Message": message,
+            },
+        )
+
+    def scale_status(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/job/{job_id}/scale",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
     def versions(self, job_id: str, namespace: Optional[str] = None):
         return self.c.get(
             f"/v1/job/{job_id}/versions",
@@ -538,6 +556,11 @@ class Plugins(_Resource):
 
 
 class Operator(_Resource):
+    def raft_remove_peer(self, peer_id: str):
+        return self.c.delete(
+            "/v1/operator/raft/peer", params={"id": peer_id}
+        )
+
     def scheduler_configuration(self):
         return self.c.get("/v1/operator/scheduler/configuration")
 

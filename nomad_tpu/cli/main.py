@@ -42,10 +42,21 @@ def _fmt_table(rows: list[list[str]], header: Optional[list[str]] = None) -> str
     return "\n".join(lines)
 
 
-def _client(args) -> NomadClient:
-    addr = args.address or os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
-    region = getattr(args, "region", "") or os.environ.get("NOMAD_REGION", "")
+def _conn_opts(args) -> tuple[str, str, str]:
+    """(address, token, region) with env fallbacks — the single place
+    connection defaults are resolved."""
+    addr = args.address or os.environ.get(
+        "NOMAD_ADDR", "http://127.0.0.1:4646"
+    )
+    region = getattr(args, "region", "") or os.environ.get(
+        "NOMAD_REGION", ""
+    )
     token = args.token or os.environ.get("NOMAD_TOKEN", "")
+    return addr, token, region
+
+
+def _client(args) -> NomadClient:
+    addr, token, region = _conn_opts(args)
     return NomadClient(addr, token=token, region=region)
 
 
@@ -173,6 +184,11 @@ def _load_agent_config(path: str):
         cfg.client_servers = [_addr(s) for s in ca.get("servers", [])]
         cfg.node_class = ca.get("node_class", "")
         cfg.csi_plugins = dict(ca.get("csi_plugins", {}))
+        ce = cb.body.block("chroot_env")
+        if ce is not None:
+            cfg.chroot_env = {
+                str(k): str(v) for k, v in ce.body.attrs().items()
+            }
     pb = body.block("ports")
     if pb is not None:
         pa = pb.body.attrs()
@@ -944,6 +960,45 @@ def cmd_volume_deregister(args) -> int:
     return 0
 
 
+def cmd_job_scale(args) -> int:
+    """Reference: command/job_scale.go."""
+    api = _client(args)
+    out = api.jobs.scale(args.job_id, args.group, args.count)
+    print(f'Job "{args.job_id}" group "{args.group}" scaled to {args.count}')
+    if out.get("EvalID"):
+        print(f"Evaluation ID: {out['EvalID']}")
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Reference: command/monitor.go — tail the agent's logs."""
+    import urllib.request
+
+    addr, tok, _ = _conn_opts(args)
+    url = f"{addr}/v1/agent/monitor?log_level={args.log_level}"
+    req = urllib.request.Request(url)
+    if tok:
+        req.add_header("X-Nomad-Token", tok)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue
+                rec = json.loads(line)
+                print(f"[{rec['Level']}] {rec['Name']}: {rec['Message']}")
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_operator_raft_remove_peer(args) -> int:
+    api = _client(args)
+    api.operator.raft_remove_peer(args.peer_id)
+    print(f'Removed raft peer "{args.peer_id}"')
+    return 0
+
+
 def cmd_alloc_restart(args) -> int:
     """Reference: command/alloc_restart.go."""
     api = _client(args)
@@ -1400,6 +1455,11 @@ def build_parser() -> argparse.ArgumentParser:
     jst.add_argument("job_id")
     jst.add_argument("-purge", action="store_true")
     jst.set_defaults(fn=cmd_job_stop)
+    jsc = jsub.add_parser("scale")
+    jsc.add_argument("job_id")
+    jsc.add_argument("group")
+    jsc.add_argument("count", type=int)
+    jsc.set_defaults(fn=cmd_job_scale)
     jva = jsub.add_parser("validate")
     jva.add_argument("jobfile")
     jva.add_argument("-var", action="append", default=[])
@@ -1637,6 +1697,9 @@ def build_parser() -> argparse.ArgumentParser:
     opraftsub = opraft.add_subparsers(dest="subsubcmd")
     oplp = opraftsub.add_parser("list-peers")
     oplp.set_defaults(fn=cmd_operator_raft_list_peers)
+    oprm = opraftsub.add_parser("remove-peer")
+    oprm.add_argument("peer_id")
+    oprm.set_defaults(fn=cmd_operator_raft_remove_peer)
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)
@@ -1666,6 +1729,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     ai = sub.add_parser("agent-info", help="agent runtime info")
     ai.set_defaults(fn=cmd_agent_info)
+
+    mon = sub.add_parser("monitor", help="stream agent logs")
+    mon.add_argument("-log-level", dest="log_level", default="INFO")
+    mon.set_defaults(fn=cmd_monitor)
 
     st = sub.add_parser("status", help="list jobs")
     st.add_argument("job_id", nargs="?")

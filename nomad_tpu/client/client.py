@@ -98,6 +98,7 @@ class Client:
         advertise_host: str = "127.0.0.1",
         csi_plugins: Optional[dict] = None,
         driver_plugins: Optional[dict] = None,  # name -> "module:Class"
+        chroot_env: Optional[dict] = None,  # exec driver's chroot map
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
@@ -119,11 +120,16 @@ class Client:
         )
         host, port = self.endpoints.addr
         self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
-        self.drivers = (
-            dict(drivers)
-            if drivers is not None
-            else {name: cls() for name, cls in BUILTIN_DRIVERS.items()}
-        )
+        if drivers is not None:
+            self.drivers = dict(drivers)
+        else:
+            self.drivers = {
+                name: cls() for name, cls in BUILTIN_DRIVERS.items()
+            }
+            if chroot_env:
+                from ..drivers.exec import ExecDriver
+
+                self.drivers["exec"] = ExecDriver(chroot_env=chroot_env)
         # external driver plugins overlay the builtins (reference:
         # go-plugin catalog); Client owns the merge so builtins are
         # instantiated in exactly one place

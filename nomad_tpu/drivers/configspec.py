@@ -117,9 +117,6 @@ EXEC_SPEC = Spec([
     Attr("command", "string", required=True),
     Attr("args", "list", default=[]),
     Attr("cgroup_v2", "bool", default=True),
-    # {host_src: dst_in_chroot} — when set (and the agent runs as
-    # root) the task chroots into its task dir (reference chroot_env)
-    Attr("chroot_env", "map"),
 ])
 
 JAVA_SPEC = Spec([

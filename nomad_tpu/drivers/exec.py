@@ -51,7 +51,9 @@ class _ExecTask:
 class ExecDriver(Driver):
     name = "exec"
 
-    def __init__(self) -> None:
+    def __init__(self, chroot_env=None) -> None:
+        # operator-configured {host_src: dst} chroot map (agent config)
+        self.chroot_env = dict(chroot_env or {})
         self.tasks: dict[str, _ExecTask] = {}
         self._lock = threading.Lock()
 
@@ -76,13 +78,21 @@ class ExecDriver(Driver):
 
         conf = EXEC_SPEC.validate(cfg.config, "exec")
         chroot = ""
-        if conf.get("chroot_env") and os.geteuid() == 0:
-            # materialize the task's root filesystem into the task dir
-            # (reference: exec always chroots via libcontainer; here it
-            # is opt-in per task config and requires root)
+        if self.chroot_env:
+            # chroot sources are OPERATOR config (constructor), never
+            # jobspec config — a job-chosen source map would let any
+            # submitter hard-link arbitrary host files (/etc/shadow)
+            # into a root-owned chroot. Reference: chroot_env is client
+            # agent config for exactly this reason.
+            if os.geteuid() != 0:
+                raise DriverError(
+                    "exec: chroot_env is configured but the agent is "
+                    "not root — refusing to run without the requested "
+                    "isolation"
+                )
             from ..client.allocdir import build_chroot
 
-            build_chroot(cfg.task_dir, conf["chroot_env"])
+            build_chroot(cfg.task_dir, self.chroot_env)
             chroot = cfg.task_dir
         command = conf.get("command")
         if not command:

@@ -133,6 +133,16 @@ class OperatorEndpoint(_Forwarder):
 
         return self._forward("Operator.scheduler_set_config", args, apply)
 
+    def raft_remove_peer(self, args):
+        """Force-remove a raft peer (reference operator_endpoint.go
+        RaftRemovePeerByID — recovering a cluster whose dead member
+        can't leave gracefully)."""
+        return self._forward(
+            "Operator.raft_remove_peer",
+            args,
+            lambda a: self.cs.raft.remove_peer(a["peer_id"]),
+        )
+
     def raft_configuration(self, args):
         out = [
             {
@@ -219,6 +229,44 @@ class JobEndpoint(_Forwarder):
             args,
             lambda a: self.cs.server.periodic.force_launch(
                 a["namespace"], a["job_id"]
+            ),
+        )
+
+    def scale_status(self, args):
+        """Group-level desired/placed/running counts (reference
+        Job.ScaleStatus)."""
+        st = self.cs.server.state
+        job = st.job_by_id(args["namespace"], args["job_id"])
+        if job is None:
+            return None
+        allocs = st.allocs_by_job(args["namespace"], args["job_id"])
+        groups = {}
+        for tg in job.task_groups:
+            live = [
+                a
+                for a in allocs
+                if a.task_group == tg.name and not a.terminal_status()
+            ]
+            groups[tg.name] = {
+                "Desired": tg.count,
+                "Running": sum(
+                    1 for a in live if a.client_status == "running"
+                ),
+                "Placed": len(live),
+            }
+        return {
+            "JobID": job.id,
+            "JobStopped": job.stop,
+            "TaskGroups": groups,
+        }
+
+    def scale(self, args):
+        return self._forward(
+            "Job.scale",
+            args,
+            lambda a: self.cs.server.job_scale(
+                a["namespace"], a["job_id"], a["group"], a["count"],
+                a.get("message", ""),
             ),
         )
 
@@ -1036,6 +1084,8 @@ class ClusterServer:
         "Job.revert": ("ns", "submit-job"),
         "Job.dispatch": ("ns", "dispatch-job"),
         "Job.plan": ("ns", "submit-job"),
+        "Job.scale": ("ns_any", ("scale-job", "submit-job")),
+        "Job.scale_status": ("ns", "read-job"),
         "Job.periodic_force": ("ns", "submit-job"),
         "Job.get": ("ns", "read-job"),
         "Job.list": ("read", None),
@@ -1087,6 +1137,16 @@ class ClusterServer:
         kind, cap = rule
         if kind == "read":
             return  # any valid local token may read
+        if kind == "ns_any":
+            ns = args.get("namespace") or "default"
+            if not any(
+                acl.allow_namespace_op(ns, c) for c in cap
+            ):
+                raise PermissionError(
+                    f"region {self.region!r}: missing any of {cap} on "
+                    f"namespace {ns!r}"
+                )
+            return
         if kind == "alloc_ns":
             # resolve the TARGET object's namespace here — the sending
             # region's HTTP guard never saw this alloc

@@ -559,6 +559,25 @@ class Server:
         )
         return ev.id
 
+    def job_scale(self, namespace: str, job_id: str, group: str,
+                  count: int, message: str = "") -> str:
+        """Scale one task group (reference job_endpoint.go Scale :979:
+        count change re-registers the job, bumping its version and
+        producing an eval). Returns the eval id."""
+        if count < 0:
+            raise ValueError("count must be >= 0")
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id} not found")
+        job = job.copy()
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(
+                f"task group {group!r} does not exist in job {job_id}"
+            )
+        tg.count = count
+        return self.job_register(job)
+
     def job_plan(self, job: Job, diff: bool = True) -> dict:
         """Dry-run the candidate job: run the real scheduler against a
         snapshot without committing; return annotations + diff + failures

@@ -218,3 +218,55 @@ def test_external_driver_plugin_catalog(tmp_path):
         ), "job must run on the out-of-process driver"
     finally:
         a.shutdown()
+
+
+def test_job_scale_and_status(agent):
+    _run_job(agent, job_id="scaleme")
+    api = _api(agent)
+    out = api.jobs.scale("scaleme", "web", 3)
+    assert out["EvalID"]
+    srv = agent.server.server
+
+    def scaled():
+        st = api.jobs.scale_status("scaleme")
+        g = st["TaskGroups"]["web"]
+        return g["Desired"] == 3 and g["Running"] == 3
+
+    assert wait_until(scaled, 15), api.jobs.scale_status("scaleme")
+    # version bumped like a re-register (reference Scale semantics)
+    job = srv.state.job_by_id("default", "scaleme")
+    assert job.task_groups[0].count == 3 and job.version >= 1
+    from nomad_tpu.api.client import APIError
+
+    with pytest.raises(APIError):
+        api.jobs.scale("scaleme", "nope", 2)
+
+
+def test_agent_monitor_streams_logs(agent):
+    import json as _json
+    import logging
+    import threading
+    import urllib.request
+
+    url = (
+        f"http://127.0.0.1:{agent.http_addr[1]}"
+        "/v1/agent/monitor?log_level=INFO"
+    )
+    got = []
+
+    def reader():
+        with urllib.request.urlopen(url, timeout=15) as resp:
+            for line in resp:
+                line = line.strip()
+                if line and line != b"{}":
+                    got.append(_json.loads(line))
+                    return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.5)
+    logging.getLogger("nomad_tpu.test-probe").info("monitor-ping-123")
+    t.join(timeout=10)
+    assert got and any(
+        "monitor-ping-123" in r["Message"] for r in got
+    ), got

@@ -217,7 +217,8 @@ def test_chroot_env_isolates_filesystem(tmp_path):
     task_dir.mkdir()
     logs = tmp_path / "logs"
     logs.mkdir()
-    d = ExecDriver()
+    # chroot_env is OPERATOR config on the driver, never jobspec config
+    d = ExecDriver(chroot_env=chroot_env)
     cfg = TaskConfig(
         id="chroot1",
         name="t",
@@ -229,7 +230,6 @@ def test_chroot_env_isolates_filesystem(tmp_path):
                 "test -e /root && echo HOST-LEAK >> /result.txt; "
                 "echo done >> /result.txt",
             ],
-            "chroot_env": chroot_env,
         },
         task_dir=str(task_dir),
         stdout_path=str(logs / "out.log"),
