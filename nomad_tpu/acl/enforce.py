@@ -69,6 +69,8 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/v1/volumes$"), CAP_READ_JOB),
     ("PUT", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
     ("POST", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
+    ("PUT", re.compile(r"^/v1/volumes/create$"), CAP_SUBMIT_JOB),
+    ("POST", re.compile(r"^/v1/volumes/create$"), CAP_SUBMIT_JOB),
     ("GET", re.compile(r"^/v1/volume/.*$"), CAP_READ_JOB),
     ("DELETE", re.compile(r"^/v1/volume/.*$"), CAP_SUBMIT_JOB),
     # CSI plugin health rides the volume read gate (reference
@@ -184,8 +186,13 @@ def make_http_resolver(server, enabled: bool = True):
                 ns = _json.loads(body).get("Namespace") or ns
             except Exception:
                 pass
-        # Volume registration: same body-namespace rule as job register.
-        if path == "/v1/volumes" and method in ("PUT", "POST") and body:
+        # Volume registration/creation: same body-namespace rule as
+        # job register.
+        if (
+            path in ("/v1/volumes", "/v1/volumes/create")
+            and method in ("PUT", "POST")
+            and body
+        ):
             import json as _json
 
             try:

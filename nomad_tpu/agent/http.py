@@ -137,6 +137,9 @@ class HTTPAgentServer:
             if acl.allow_namespace_op(getattr(o, "namespace", "default"), cap)
         ]
 
+    def _job_scale_rpc(self, args):
+        return self.rpc_region("Job.scale", args)
+
     def rpc_region(self, method: str, args):
         """rpc_self with the request's ?region= attached, so any route
         can address a federated region (reference: Region rides every
@@ -252,16 +255,9 @@ class HTTPAgentServer:
             return s
 
         def job_scale(p, q, body, tok):
+            # ACL: the route resolver already enforces scale-job OR
+            # submit-job on this namespace (acl/enforce.py)
             ns = q.get("namespace", ["default"])[0]
-            # scale-job OR submit-job authorizes (reference Job.Scale)
-            acl = self._acl_for(tok)
-            if acl is not None and not (
-                acl.allow_namespace_op(ns, "scale-job")
-                or acl.allow_namespace_op(ns, "submit-job")
-            ):
-                raise HTTPError(
-                    403, f"missing 'scale-job' on namespace {ns!r}"
-                )
             target = (body or {}).get("Target") or {}
             group = target.get("Group", "")
             count = (body or {}).get("Count")
@@ -271,16 +267,19 @@ class HTTPAgentServer:
                 count = int(count)
             except (TypeError, ValueError):
                 raise HTTPError(400, f"Count must be an integer, got {count!r}")
-            eval_id = self.rpc_region(
-                "Job.scale",
+            try:
+                eval_id = self._job_scale_rpc(
                 {
                     "namespace": ns,
                     "job_id": p["id"],
                     "group": group,
                     "count": count,
                     "message": (body or {}).get("Message", ""),
-                },
-            )
+                })
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+            except ValueError as e:
+                raise HTTPError(400, str(e))
             return {"EvalID": eval_id}
 
         def job_scale_status(p, q, body, tok):
@@ -595,6 +594,35 @@ class HTTPAgentServer:
 
         route("GET", "/v1/plugins", plugins_list)
         route("GET", "/v1/plugin/csi/(?P<id>[^/]+)", plugin_get)
+        def volume_create(p, q, body, tok):
+            if not (body or {}).get("Volume"):
+                raise HTTPError(400, "Volume is required")
+            vol = codec.from_wire(body["Volume"])
+            self._ns_guard(tok, vol.namespace, "submit-job")
+            try:
+                return self.rpc_region("Volume.create", {"volume": vol})
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+
+        def volume_csi_delete(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            self._ns_guard(tok, ns, "submit-job")
+            try:
+                self.rpc_region(
+                    "Volume.delete",
+                    {"namespace": ns, "volume_id": p["id"]},
+                )
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+            except ValueError as e:
+                raise HTTPError(409, str(e))
+            return None
+
+        route("PUT", "/v1/volumes/create", volume_create)
+        route("POST", "/v1/volumes/create", volume_create)
+        route(
+            "DELETE", "/v1/volume/(?P<id>[^/]+)/delete", volume_csi_delete
+        )
         route("GET", "/v1/volumes", volumes_list)
         route("PUT", "/v1/volumes", volume_register)
         route("POST", "/v1/volumes", volume_register)

@@ -496,6 +496,20 @@ class Volumes(_Resource):
             params={"namespace": namespace or self.c.namespace},
         )
 
+    def create(self, volume):
+        """Provision through the CSI controller then register
+        (reference api/csi.go Create)."""
+        return self.c.put(
+            "/v1/volumes/create", body={"Volume": codec.to_wire(volume)}
+        )
+
+    def delete(self, vol_id: str, namespace: Optional[str] = None):
+        """Deregister + deprovision (reference api/csi.go Delete)."""
+        return self.c.delete(
+            f"/v1/volume/{vol_id}/delete",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
 
 class Secrets(_Resource):
     """Embedded secrets store (the Vault-analog surface)."""

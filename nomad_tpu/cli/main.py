@@ -923,6 +923,49 @@ def cmd_volume_register(args) -> int:
     return 0
 
 
+def cmd_volume_create(args) -> int:
+    """Reference: command/volume_create.go — provision via the CSI
+    controller from an HCL volume spec, then register."""
+    from ..jobspec.hcl import parse as parse_hcl
+    from ..structs.structs import Volume
+
+    with open(args.file) as f:
+        body = parse_hcl(f.read())
+    a = body.attrs()
+    params = {}
+    pb = body.block("parameters")
+    if pb is not None:
+        params = {k: str(v) for k, v in pb.body.attrs().items()}
+    vol = Volume(
+        id=a.get("id", ""),
+        name=a.get("name", a.get("id", "")),
+        namespace=a.get("namespace", args.namespace or "default"),
+        type="csi",
+        plugin_id=a.get("plugin_id", ""),
+        access_mode=a.get(
+            "access_mode", "multi-node-multi-writer"
+        ),
+        attachment_mode=a.get("attachment_mode", "file-system"),
+        context=params,
+    )
+    if not vol.id or not vol.plugin_id:
+        print("Error: volume spec requires id and plugin_id",
+              file=sys.stderr)
+        return 1
+    api = _client(args)
+    out = api.volumes.create(vol)
+    print(f'Volume "{vol.id}" created (external id '
+          f'"{getattr(out, "external_id", "")}")')
+    return 0
+
+
+def cmd_volume_delete(args) -> int:
+    api = _client(args)
+    api.volumes.delete(args.id, namespace=args.namespace)
+    print(f'Volume "{args.id}" deleted')
+    return 0
+
+
 def cmd_volume_status(args) -> int:
     api = _client(args)
     if args.id:
@@ -1638,6 +1681,14 @@ def build_parser() -> argparse.ArgumentParser:
     vstat.add_argument("id", nargs="?")
     vstat.add_argument("-namespace", default="default")
     vstat.set_defaults(fn=cmd_volume_status)
+    vcre = volsub.add_parser("create")
+    vcre.add_argument("file")
+    vcre.add_argument("-namespace", default="default")
+    vcre.set_defaults(fn=cmd_volume_create)
+    vdel = volsub.add_parser("delete")
+    vdel.add_argument("id")
+    vdel.add_argument("-namespace", default="default")
+    vdel.set_defaults(fn=cmd_volume_delete)
     vdereg = volsub.add_parser("deregister")
     vdereg.add_argument("id")
     vdereg.add_argument("-namespace", default="default")

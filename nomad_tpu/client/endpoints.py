@@ -44,6 +44,8 @@ class ClientEndpoints:
         self.rpc.register_stream("Exec.exec", self._exec)
         self.rpc.register_stream("Alloc.restart", self._alloc_restart)
         self.rpc.register_stream("Alloc.signal", self._alloc_signal)
+        self.rpc.register_stream("CSI.create", self._csi_create)
+        self.rpc.register_stream("CSI.delete", self._csi_delete)
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -54,6 +56,43 @@ class ClientEndpoints:
 
     def stop(self) -> None:
         self.rpc.shutdown()
+
+    # -- CSI controller relay (reference client/csi_endpoint.go: the
+    # server routes controller RPCs to a node running the plugin) ------
+
+    def _csi_plugin(self, session, header):
+        plugin = self.client.csi_manager.plugins.get(
+            header.get("plugin_id", "")
+        )
+        if plugin is None:
+            session.send({
+                "error": f"plugin {header.get('plugin_id')!r} not on "
+                f"this client"
+            })
+            return None
+        return plugin
+
+    def _csi_create(self, session, header) -> None:
+        plugin = self._csi_plugin(session, header)
+        if plugin is None:
+            return
+        try:
+            out = plugin.create_volume(
+                header.get("name", ""), header.get("params") or {}
+            )
+            session.send({"ok": True, **out})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
+
+    def _csi_delete(self, session, header) -> None:
+        plugin = self._csi_plugin(session, header)
+        if plugin is None:
+            return
+        try:
+            plugin.delete_volume(header.get("external_id", ""))
+            session.send({"ok": True})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
 
     # -- alloc lifecycle (reference client/alloc_endpoint.go) -----------
 

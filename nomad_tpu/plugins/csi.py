@@ -95,6 +95,15 @@ class CSIPlugin:
         """Raise CSIError if the volume can't satisfy the requested modes
         (CSI ValidateVolumeCapabilities)."""
 
+    def create_volume(self, name: str, params: dict) -> dict:
+        """Provision storage; returns {"external_id": ..., "context":
+        {...}} (CSI CreateVolume)."""
+        raise CSIError("plugin does not support volume creation")
+
+    def delete_volume(self, external_id: str) -> None:
+        """CSI DeleteVolume."""
+        raise CSIError("plugin does not support volume deletion")
+
     # -- node service --------------------------------------------------
 
     def node_get_info(self) -> dict[str, str]:
@@ -171,6 +180,17 @@ class FakeCSIPlugin(CSIPlugin):
                 f"got {attachment_mode!r}"
             )
 
+    def create_volume(self, name: str, params: dict) -> dict:
+        external_id = f"vol-{name}"
+        os.makedirs(os.path.join(self.backing_dir, external_id),
+                    exist_ok=True)
+        return {"external_id": external_id, "context": dict(params or {})}
+
+    def delete_volume(self, external_id: str) -> None:
+        path = os.path.join(self.backing_dir, external_id)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+
     def node_get_info(self):
         return {"node_id": f"fake-{os.uname().nodename}"}
 
@@ -246,6 +266,12 @@ class _CSIEndpoint:
 
     def node_get_info(self, args):
         return self.plugin.node_get_info()
+
+    def create_volume(self, args):
+        return self.plugin.create_volume(args["name"], args.get("params") or {})
+
+    def delete_volume(self, args):
+        self.plugin.delete_volume(args["external_id"])
 
     def _ctx(self, args) -> StageContext:
         return StageContext(**args["ctx"])
@@ -364,6 +390,14 @@ class ExternalCSIPlugin(CSIPlugin):
 
     def node_get_info(self):
         return self._call("CSI.node_get_info")
+
+    def create_volume(self, name, params):
+        return self._call(
+            "CSI.create_volume", {"name": name, "params": params}
+        )
+
+    def delete_volume(self, external_id):
+        self._call("CSI.delete_volume", {"external_id": external_id})
 
     def _wire_ctx(self, ctx: StageContext) -> dict:
         return {"ctx": {

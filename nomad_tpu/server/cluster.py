@@ -362,6 +362,60 @@ class VolumeEndpoint(_Forwarder):
     def for_alloc(self, args):
         return self.cs.server.state.volumes_for_alloc(args["alloc_id"])
 
+    def create(self, args):
+        """Provision through a controller plugin then register
+        (reference csi_endpoint.go Create → ClientCSI controller RPC on
+        a plugin-bearing node)."""
+
+        def local(a):
+            vol = a["volume"]
+            out = self.cs.csi_controller_roundtrip(
+                vol.plugin_id,
+                "CSI.create",
+                {"name": vol.name or vol.id,
+                 "params": dict(vol.context or {})},
+            )
+            vol = vol.copy()
+            vol.type = "csi"
+            vol.external_id = out.get("external_id", "")
+            ctx = out.get("context") or {}
+            vol.context = {**(vol.context or {}), **ctx}
+            self.cs.server.volume_register(vol)
+            return self.cs.server.state.volume_by_id(
+                vol.namespace, vol.id
+            )
+
+        return self._forward("Volume.create", args, local)
+
+    def delete(self, args):
+        """Deregister then deprovision via the controller plugin
+        (reference csi_endpoint.go Delete)."""
+
+        def local(a):
+            ns, vol_id = a["namespace"], a["volume_id"]
+            vol = self.cs.server.state.volume_by_id(ns, vol_id)
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            if vol.claims:
+                raise ValueError(
+                    f"volume {vol_id} has {len(vol.claims)} active claims"
+                )
+            # Deprovision BEFORE dropping the record: a controller
+            # failure here leaves the record in place so the operator
+            # can retry — the reverse order would orphan the external
+            # storage forever (the record with its external_id is the
+            # only handle we have on it).
+            if vol.plugin_id and vol.external_id:
+                self.cs.csi_controller_roundtrip(
+                    vol.plugin_id,
+                    "CSI.delete",
+                    {"external_id": vol.external_id},
+                )
+            self.cs.server.volume_deregister(ns, vol_id)
+            return None
+
+        return self._forward("Volume.delete", args, local)
+
     def plugins(self, args):
         return self.cs.server.state.csi_plugins()
 
@@ -824,6 +878,48 @@ class ClusterServer:
         self._reconciler.start()
 
     # -- wiring --------------------------------------------------------
+
+    def csi_controller_roundtrip(
+        self, plugin_id: str, verb: str, header: dict
+    ) -> dict:
+        """Run one controller verb on SOME node carrying a healthy
+        controller-capable instance of the plugin (reference: the server
+        routes controller RPCs to a random plugin-bearing client)."""
+        candidates = []
+        for node in self.server.state.nodes():
+            info = node.csi_plugins.get(plugin_id)
+            addr_s = node.attributes.get("unique.client.rpc", "")
+            if (
+                info
+                and info.get("healthy")
+                and info.get("controller")
+                and addr_s
+            ):
+                host, _, port = addr_s.rpartition(":")
+                candidates.append((host, int(port)))
+        if not candidates:
+            raise RPCError(
+                f"no healthy controller for CSI plugin {plugin_id!r}"
+            )
+        import random
+
+        last: Exception = RPCError("unreachable")
+        for addr in random.sample(candidates, len(candidates)):
+            try:
+                session = self.pool.stream(
+                    addr, verb, {"plugin_id": plugin_id, **header}
+                )
+            except (ConnectionError, OSError) as e:
+                last = e
+                continue
+            try:
+                msg = session.recv(timeout_s=30)
+            finally:
+                session.close()
+            if msg.get("error"):
+                raise RPCError(msg["error"])
+            return msg
+        raise RPCError(f"controller unreachable: {last}")
 
     def find_alloc(self, alloc_id: str):
         """Resolve an alloc by exact id or unique prefix — the single

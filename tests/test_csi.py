@@ -315,3 +315,48 @@ def test_csi_job_does_not_place_without_plugin(tmp_path):
         assert live == [], "no plugin on any node: nothing may place"
     finally:
         server.shutdown()
+
+
+def test_volume_create_and_delete_via_controller(tmp_path):
+    """`volume create` provisions through a controller-bearing client
+    then registers; delete deprovisions after deregistration
+    (reference csi_endpoint.go Create/Delete → ClientCSI routing)."""
+    from nomad_tpu.server.cluster import ClusterServer
+    from nomad_tpu.server.cluster import ClusterRPC
+    from nomad_tpu.client import Client
+    from nomad_tpu.structs.node_class import compute_node_class
+
+    cs = ClusterServer("s1", port=0, num_workers=1, bootstrap_expect=1)
+    cs.start()
+    client = None
+    try:
+        assert wait_until(lambda: cs.is_leader(), 10)
+        client = Client(
+            ClusterRPC([cs.rpc.addr]),
+            data_dir=str(tmp_path / "c0"),
+        )
+        backing = tmp_path / "backing"
+        client.csi_manager.register(
+            "hostpath", FakeCSIPlugin(backing_dir=str(backing))
+        )
+        client._fingerprint_csi()
+        client.node.computed_class = compute_node_class(client.node)
+        client.start()
+        assert client.wait_registered(10)
+
+        vol = _csi_vol(vol_id="made", plugin="hostpath", name="made")
+        vol.external_id = ""  # the plugin assigns it
+        created = cs.rpc_self("Volume.create", {"volume": vol})
+        assert created.external_id == "vol-made"
+        assert (backing / "vol-made").is_dir(), "storage provisioned"
+        assert cs.server.state.volume_by_id("default", "made") is not None
+
+        cs.rpc_self(
+            "Volume.delete", {"namespace": "default", "volume_id": "made"}
+        )
+        assert cs.server.state.volume_by_id("default", "made") is None
+        assert not (backing / "vol-made").exists(), "storage deprovisioned"
+    finally:
+        if client is not None:
+            client.shutdown()
+        cs.shutdown()
