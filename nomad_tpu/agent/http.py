@@ -137,9 +137,6 @@ class HTTPAgentServer:
             if acl.allow_namespace_op(getattr(o, "namespace", "default"), cap)
         ]
 
-    def _job_scale_rpc(self, args):
-        return self.rpc_region("Job.scale", args)
-
     def rpc_region(self, method: str, args):
         """rpc_self with the request's ?region= attached, so any route
         can address a federated region (reference: Region rides every
@@ -268,7 +265,8 @@ class HTTPAgentServer:
             except (TypeError, ValueError):
                 raise HTTPError(400, f"Count must be an integer, got {count!r}")
             try:
-                eval_id = self._job_scale_rpc(
+                eval_id = self.rpc_region(
+                "Job.scale",
                 {
                     "namespace": ns,
                     "job_id": p["id"],
@@ -603,6 +601,8 @@ class HTTPAgentServer:
                 return self.rpc_region("Volume.create", {"volume": vol})
             except KeyError as e:
                 raise HTTPError(404, str(e))
+            except ValueError as e:
+                raise HTTPError(400, str(e))
 
         def volume_csi_delete(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
@@ -757,6 +757,18 @@ class HTTPAgentServer:
         route("GET", "/v1/allocations", allocs_list)
         route("GET", "/v1/allocation/(?P<id>[^/]+)", alloc_get)
         route("GET", "/v1/evaluations", evals_list)
+        def eval_delete(p, q, body, tok):
+            # the endpoint owns the terminal-only invariant (checked on
+            # the leader right before the apply) and ?region= forwards
+            try:
+                self.rpc_region("Eval.delete", {"eval_ids": [p["id"]]})
+            except KeyError as e:
+                raise HTTPError(404, str(e))
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+            return None
+
+        route("DELETE", "/v1/evaluation/(?P<id>[^/]+)", eval_delete)
         route("GET", "/v1/evaluation/(?P<id>[^/]+)", eval_get)
         route("GET", "/v1/evaluation/(?P<id>[^/]+)/allocations", eval_allocs)
 

@@ -402,6 +402,9 @@ class Evaluations(_Resource):
     def list(self):
         return self.c.get("/v1/evaluations")
 
+    def delete(self, eval_id: str):
+        return self.c.delete(f"/v1/evaluation/{eval_id}")
+
     def get(self, eval_id: str):
         return self.c.get(f"/v1/evaluation/{eval_id}")
 

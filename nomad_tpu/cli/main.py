@@ -1068,6 +1068,22 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_eval_delete(args) -> int:
+    """Reference: command/eval_delete.go."""
+    api = _client(args)
+    api.evaluations.delete(args.eval_id)
+    print(f"Deleted evaluation {args.eval_id[:8]}")
+    return 0
+
+
+def cmd_node_purge(args) -> int:
+    """Reference: command/node_status.go -purge path (Node.Purge)."""
+    api = _client(args)
+    api.put(f"/v1/node/{args.node_id}/purge")
+    print(f"Node {args.node_id[:8]} purged")
+    return 0
+
+
 def cmd_system_gc(args) -> int:
     """Reference: command/system_gc.go."""
     api = _client(args)
@@ -1551,6 +1567,9 @@ def build_parser() -> argparse.ArgumentParser:
     nm = nsub.add_parser("meta")
     nm.add_argument("node_id")
     nm.set_defaults(fn=cmd_node_meta)
+    np_ = nsub.add_parser("purge")
+    np_.add_argument("node_id")
+    np_.set_defaults(fn=cmd_node_purge)
 
     alloc = sub.add_parser("alloc", help="alloc commands")
     asub = alloc.add_subparsers(dest="subcmd")
@@ -1596,6 +1615,9 @@ def build_parser() -> argparse.ArgumentParser:
     est.set_defaults(fn=cmd_eval_status)
     el = esub.add_parser("list")
     el.set_defaults(fn=cmd_eval_list)
+    edel = esub.add_parser("delete")
+    edel.add_argument("eval_id")
+    edel.set_defaults(fn=cmd_eval_delete)
 
     dep = sub.add_parser("deployment", help="deployment commands")
     dsub = dep.add_subparsers(dest="subcmd")

@@ -369,6 +369,19 @@ class VolumeEndpoint(_Forwarder):
 
         def local(a):
             vol = a["volume"]
+            # validate BEFORE provisioning: a rejected register after
+            # the controller call would orphan the external storage
+            self.cs.server.validate_volume(vol)
+            if vol.plugin_id == "":
+                raise ValueError("csi volume requires plugin_id")
+            existing = self.cs.server.state.volume_by_id(
+                vol.namespace, vol.id
+            )
+            if existing is not None:
+                raise ValueError(
+                    f"volume {vol.id} already exists (external id "
+                    f"{existing.external_id!r}); delete it first"
+                )
             out = self.cs.csi_controller_roundtrip(
                 vol.plugin_id,
                 "CSI.create",
@@ -605,6 +618,29 @@ class NodeEndpoint(_Forwarder):
 class EvalEndpoint(_Forwarder):
     def get(self, args):
         return self.cs.server.state.eval_by_id(args["eval_id"])
+
+    def delete(self, args):
+        """Delete terminal evals (reference eval_endpoint.go Delete —
+        1.4's operator eval cleanup). The terminal check lives HERE, on
+        the leader, immediately before the apply — an HTTP-layer-only
+        check would let any fabric caller (or a check-then-apply race)
+        drop a pending eval from the broker."""
+
+        def local(a):
+            for eid in a["eval_ids"]:
+                ev = self.cs.server.state.eval_by_id(eid)
+                if ev is None:
+                    raise KeyError(f"eval {eid} not found")
+                if not ev.terminal_status():
+                    raise ValueError(
+                        f"eval {eid} is {ev.status}; only terminal "
+                        f"evaluations can be deleted"
+                    )
+            return self.cs.server.raft_apply(
+                "eval_delete", (a["eval_ids"], [])
+            )
+
+        return self._forward("Eval.delete", args, local)
 
     def allocs(self, args):
         return self.cs.server.state.allocs_by_eval(args["eval_id"])

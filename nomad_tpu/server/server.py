@@ -392,9 +392,10 @@ class Server:
 
     # -- volume endpoint -----------------------------------------------
 
-    def volume_register(self, vol) -> None:
-        """Register (or update) a volume; claims survive updates
-        (reference csi_endpoint.go Register, reshaped for host volumes)."""
+    def validate_volume(self, vol) -> None:
+        """Shared register/create validation — create must run this
+        BEFORE provisioning, or a rejected register would orphan the
+        freshly provisioned external storage."""
         if not vol.id or not vol.name:
             raise ValueError("volume requires id and name")
         from ..structs.structs import (
@@ -414,6 +415,11 @@ class Server:
                 f"invalid access_mode {vol.access_mode!r}; "
                 f"one of {', '.join(valid_modes)}"
             )
+
+    def volume_register(self, vol) -> None:
+        """Register (or update) a volume; claims survive updates
+        (reference csi_endpoint.go Register, reshaped for host volumes)."""
+        self.validate_volume(vol)
         self._ensure_namespace(vol.namespace)
         self.raft_apply("volume_register", vol)
 

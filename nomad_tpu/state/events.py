@@ -14,6 +14,8 @@ from ..stream.event_broker import (
     TOPIC_EVAL,
     TOPIC_JOB,
     TOPIC_NODE,
+    TOPIC_SERVICE,
+    TOPIC_VOLUME,
     Event,
     EventBroker,
 )
@@ -23,6 +25,8 @@ from .store import (
     TABLE_EVALS,
     TABLE_JOBS,
     TABLE_NODES,
+    TABLE_SERVICES,
+    TABLE_VOLUMES,
     StateStore,
 )
 
@@ -32,6 +36,8 @@ _TABLE_TOPICS = {
     TABLE_EVALS: TOPIC_EVAL,
     TABLE_ALLOCS: TOPIC_ALLOC,
     TABLE_DEPLOYMENTS: TOPIC_DEPLOYMENT,
+    TABLE_SERVICES: TOPIC_SERVICE,
+    TABLE_VOLUMES: TOPIC_VOLUME,
 }
 
 _DEFAULT_TYPES = {
@@ -40,6 +46,8 @@ _DEFAULT_TYPES = {
     TABLE_EVALS: "EvaluationUpdated",
     TABLE_ALLOCS: "AllocationUpdated",
     TABLE_DEPLOYMENTS: "DeploymentStatusUpdate",
+    TABLE_SERVICES: "ServiceRegistration",
+    TABLE_VOLUMES: "VolumeEvent",
 }
 
 
@@ -62,6 +70,14 @@ def _event_for(index: int, table: str, obj, etype: str) -> Event:
         filter_keys = tuple(
             k for k in (obj.job_id, obj.node_id, obj.deployment_id) if k
         )
+    elif table == TABLE_SERVICES:
+        key = obj.service_name
+        filter_keys = tuple(
+            k for k in (obj.job_id, obj.alloc_id, obj.node_id) if k
+        )
+    elif table == TABLE_VOLUMES:
+        key = obj.id
+        filter_keys = (obj.plugin_id,) if obj.plugin_id else ()
     else:
         key = obj.id
         filter_keys = (obj.job_id,)

@@ -30,6 +30,8 @@ TOPIC_JOB = "Job"
 TOPIC_EVAL = "Evaluation"
 TOPIC_ALLOC = "Allocation"
 TOPIC_DEPLOYMENT = "Deployment"
+TOPIC_SERVICE = "Service"
+TOPIC_VOLUME = "Volume"
 
 
 @dataclass(frozen=True)

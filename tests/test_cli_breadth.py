@@ -270,3 +270,28 @@ def test_agent_monitor_streams_logs(agent):
     assert got and any(
         "monitor-ping-123" in r["Message"] for r in got
     ), got
+
+
+def test_eval_delete_and_node_purge(agent):
+    api = _api(agent)
+    _run_job(agent, job_id="evjob")
+    srv = agent.server.server
+    # find a terminal eval
+    ev = next(
+        e for e in srv.state.evals() if e.status == "complete"
+    )
+    api.evaluations.delete(ev.id)
+    assert srv.state.eval_by_id(ev.id) is None
+    # a pending/blocked eval refuses deletion
+    from nomad_tpu.api.client import APIError
+    from nomad_tpu.structs.structs import Evaluation
+    from nomad_tpu.structs import generate_uuid, now_ns
+
+    pend = Evaluation(
+        id=generate_uuid(), namespace="default", priority=50,
+        type="service", job_id="evjob", status="pending",
+        create_time=now_ns(), modify_time=now_ns(),
+    )
+    srv.state.upsert_evals(srv.state.latest_index() + 1, [pend])
+    with pytest.raises(APIError):
+        api.evaluations.delete(pend.id)

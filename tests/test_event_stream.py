@@ -162,3 +162,37 @@ class TestStateEventCoverage:
         job = mock.job()  # default namespace
         store.upsert_job(1, job)
         assert sub.next(timeout_s=0.1) == []
+
+
+def test_service_and_volume_events_flow():
+    """Service registrations and volume writes reach subscribers on
+    their own topics (reference events.go Service/CSIVolume topics)."""
+    from nomad_tpu.server import Server
+    from nomad_tpu.structs.structs import ServiceRegistration, Volume
+
+    s = Server(num_workers=1)
+    s.establish_leadership()
+    try:
+        sub = s.event_broker.subscribe(topics={"Service": ["*"],
+                                               "Volume": ["*"]})
+        s.volume_register(Volume(id="ev-vol", name="ev-vol", type="host"))
+        s.state.upsert_service_registrations(
+            s.state.latest_index() + 1,
+            [ServiceRegistration(id="r1", service_name="web",
+                                 alloc_id="a1")],
+        )
+        import time as _t
+
+        got = []
+        deadline = _t.monotonic() + 5
+        while _t.monotonic() < deadline and len(
+            {e.topic for e in got}
+        ) < 2:
+            got.extend(sub.next(timeout_s=0.5) or [])
+        topics = {e.topic for e in got}
+        assert "Volume" in topics and "Service" in topics, topics
+        svc = next(e for e in got if e.topic == "Service")
+        assert svc.key == "web" and svc.type == "ServiceRegistration"
+        sub.close()
+    finally:
+        s.shutdown()
