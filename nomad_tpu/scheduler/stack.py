@@ -201,6 +201,7 @@ class SystemStack:
     def set_job(self, job: Job) -> None:
         self.job = job
         self.ctx.eligibility.set_job(job)
+        self._post_checkers: dict[str, list] = {}
 
     def select(
         self, tg: TaskGroup, node: Node, metrics=None, evict: bool = False
@@ -220,6 +221,30 @@ class SystemStack:
             NetworkChecker(self.ctx, tg),
             DeviceChecker(self.ctx, tg),
         ]
+        # distinct_property budgets are shared across the walk's own
+        # placements (reference SystemStack wires DistinctPropertyIterator
+        # too, stack.go:197-259); PropertySet reads the live plan so each
+        # placed node decrements the per-value budget for the next one.
+        post = getattr(self, "_post_checkers", {}).get(tg.name)
+        if post is None:
+            post = []
+            for c in _distinct_property_constraints(job.constraints):
+                pset = PropertySet(self.ctx, job)
+                pset.set_job_constraint(c)
+                post.append(_DistinctPropertyChecker(pset))
+            for c in _distinct_property_constraints(tg.constraints):
+                pset = PropertySet(self.ctx, job)
+                pset.set_tg_constraint(c, tg.name)
+                post.append(_DistinctPropertyChecker(pset))
+            if not hasattr(self, "_post_checkers"):
+                self._post_checkers = {}
+            self._post_checkers[tg.name] = post
+        for checker in post:
+            good, reason = checker.feasible(node)
+            if not good:
+                if metrics is not None:
+                    metrics.filter_node(node, reason)
+                return None
         feasible = feasibility_pipeline(
             self.ctx, [node], job_checkers, tg_checkers, tg.name, metrics
         )

@@ -624,3 +624,48 @@ def test_tpu_system_two_groups_share_capacity():
     # 12 nodes, the other places nowhere — and backends agree
     assert results["host"] == results["tpu"], results
     assert sorted(results["tpu"].values()) == [12], results
+
+
+def test_diff_system_distinct_property_matches_host():
+    """distinct_property budgets are a SHARED per-value cap the one-shot
+    vector mask can't express — the TPU system scheduler must route to
+    the host walk and land on the same per-rack counts."""
+    from nomad_tpu.structs import Constraint
+
+    def build(h):
+        for i in range(16):
+            n = mock.node()
+            n.meta["rack"] = f"r{i % 2}"  # 8 nodes per rack
+            n.computed_class = compute_node_class(n)
+            h.state.upsert_node(h.next_index(), n)
+        job = mock.system_job(id="sysprop")
+        job.constraints.append(
+            Constraint("${meta.rack}", "3", "distinct_property")
+        )
+        tg = job.task_groups[0]
+        tg.tasks[0].resources.cpu = 100
+        tg.tasks[0].resources.memory_mb = 32
+        tg.tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        return job
+
+    per_rack = {}
+    for backend in ("host", "tpu"):
+        h = Harness()
+        job = build(h)
+        h.process(
+            "system", mock.eval_for_job(job),
+            SchedulerConfig(backend=backend),
+        )
+        counts: dict = {}
+        for a in h.state.allocs_by_job(job.namespace, job.id):
+            if a.terminal_status():
+                continue
+            rack = h.state.node_by_id(a.node_id).meta["rack"]
+            counts[rack] = counts.get(rack, 0) + 1
+        per_rack[backend] = counts
+    assert per_rack["host"] == per_rack["tpu"], per_rack
+    assert all(v <= 3 for v in per_rack["tpu"].values()), (
+        "distinct_property budget must cap each rack"
+    )
+    assert sum(per_rack["tpu"].values()) == 6, per_rack
