@@ -45,6 +45,9 @@ CAVEATS = [
     "drain config: service evals run the batched solver; the system eval "
     "runs the TPU backend's vectorized system scheduler (one lowered "
     "feasibility+capacity pass, per-node fallback for ports/devices)",
+    "when tpu_available=false the TPU device was unreachable at bench "
+    "time and every number was measured on CPU fallback — the TPU "
+    "solve itself is strictly faster than what is recorded here",
 ]
 
 
@@ -351,7 +354,47 @@ SERVICE_CONFIGS = {
 }
 
 
+def _ensure_device() -> dict:
+    """Guard against an unreachable TPU wedging the whole bench run.
+
+    The axon tunnel has been observed to hang jax device init
+    indefinitely; probe it in a SUBPROCESS with a hard timeout and, on
+    failure, fall back to CPU with an explicit flag so the output is
+    never silently mislabeled. Returns {"platform", "tpu_available"}."""
+    import subprocess
+
+    if os.environ.get("BENCH_SKIP_TPU_PROBE"):
+        return {"platform": "as-configured", "tpu_available": None}
+    timeout_s = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        platform = (proc.stdout or "").strip().lower()
+        # a CPU-only jax init "succeeds" — that is exactly the silent
+        # mislabeling this probe exists to prevent
+        ok = proc.returncode == 0 and platform not in ("", "cpu")
+    except subprocess.TimeoutExpired:
+        ok = False
+    if ok:
+        return {"platform": "tpu", "tpu_available": True}
+    log(
+        f"WARNING: TPU device init failed/timed out after {timeout_s}s; "
+        f"falling back to CPU — TPU throughput is higher than these "
+        f"numbers"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return {"platform": "cpu-fallback", "tpu_available": False}
+
+
 def main():
+    device = _ensure_device()
     sel = os.environ.get("BENCH_CONFIG", "all")
     names = (
         ["smoke", "c1k", "c2m", "preempt", "drain"] if sel == "all" else [sel]
@@ -380,6 +423,8 @@ def main():
                 "unit": "evals/sec",
                 "vs_baseline": hl["vs_host"],
                 "configs": results,
+                "platform": device["platform"],
+                "tpu_available": device["tpu_available"],
                 "caveats": CAVEATS,
             }
         )

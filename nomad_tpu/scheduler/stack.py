@@ -55,6 +55,25 @@ def _has_distinct_hosts(constraints: list[Constraint]) -> bool:
     return any(c.operand == CONSTRAINT_DISTINCT_HOSTS for c in constraints)
 
 
+def _distinct_property_checkers(ctx, job, tg) -> list:
+    """Stateful distinct_property checkers for one task group — job,
+    group, AND task level (lower.py folds task constraints into
+    units_cap the same way, so both backends agree)."""
+    post = []
+    for c in _distinct_property_constraints(job.constraints):
+        pset = PropertySet(ctx, job)
+        pset.set_job_constraint(c)
+        post.append(_DistinctPropertyChecker(pset))
+    tg_level = list(tg.constraints)
+    for t in tg.tasks:
+        tg_level.extend(t.constraints)
+    for c in _distinct_property_constraints(tg_level):
+        pset = PropertySet(ctx, job)
+        pset.set_tg_constraint(c, tg.name)
+        post.append(_DistinctPropertyChecker(pset))
+    return post
+
+
 class _DistinctPropertyChecker(FeasibilityChecker):
     def __init__(self, pset: PropertySet) -> None:
         self.pset = pset
@@ -138,14 +157,7 @@ class GenericStack:
                 post.append(DistinctHostsChecker(self.ctx, job.id, tg.name, True))
             elif _has_distinct_hosts(tg.constraints):
                 post.append(DistinctHostsChecker(self.ctx, job.id, tg.name, False))
-            for c in _distinct_property_constraints(job.constraints):
-                pset = PropertySet(self.ctx, job)
-                pset.set_job_constraint(c)
-                post.append(_DistinctPropertyChecker(pset))
-            for c in _distinct_property_constraints(tg.constraints):
-                pset = PropertySet(self.ctx, job)
-                pset.set_tg_constraint(c, tg.name)
-                post.append(_DistinctPropertyChecker(pset))
+            post.extend(_distinct_property_checkers(self.ctx, job, tg))
             self._post_checkers[tg.name] = post
         if post:
             def _post_filter(nodes):
@@ -194,6 +206,7 @@ class SystemStack:
         self.ctx = ctx
         self.nodes: list[Node] = []
         self.job: Optional[Job] = None
+        self._post_checkers: dict[str, list] = {}
 
     def set_nodes(self, nodes: list[Node]) -> None:
         self.nodes = list(nodes)
@@ -201,7 +214,7 @@ class SystemStack:
     def set_job(self, job: Job) -> None:
         self.job = job
         self.ctx.eligibility.set_job(job)
-        self._post_checkers: dict[str, list] = {}
+        self._post_checkers = {}
 
     def select(
         self, tg: TaskGroup, node: Node, metrics=None, evict: bool = False
@@ -221,33 +234,33 @@ class SystemStack:
             NetworkChecker(self.ctx, tg),
             DeviceChecker(self.ctx, tg),
         ]
-        # distinct_property budgets are shared across the walk's own
-        # placements (reference SystemStack wires DistinctPropertyIterator
-        # too, stack.go:197-259); PropertySet reads the live plan so each
-        # placed node decrements the per-value budget for the next one.
-        post = getattr(self, "_post_checkers", {}).get(tg.name)
-        if post is None:
-            post = []
-            for c in _distinct_property_constraints(job.constraints):
-                pset = PropertySet(self.ctx, job)
-                pset.set_job_constraint(c)
-                post.append(_DistinctPropertyChecker(pset))
-            for c in _distinct_property_constraints(tg.constraints):
-                pset = PropertySet(self.ctx, job)
-                pset.set_tg_constraint(c, tg.name)
-                post.append(_DistinctPropertyChecker(pset))
-            if not hasattr(self, "_post_checkers"):
-                self._post_checkers = {}
-            self._post_checkers[tg.name] = post
-        for checker in post:
-            good, reason = checker.feasible(node)
-            if not good:
-                if metrics is not None:
-                    metrics.filter_node(node, reason)
-                return None
         feasible = feasibility_pipeline(
             self.ctx, [node], job_checkers, tg_checkers, tg.name, metrics
         )
+        # distinct_property budgets are shared across the walk's own
+        # placements (reference SystemStack wires DistinctPropertyIterator
+        # AFTER the feasibility chain, stack.go:197-259, so filter
+        # metrics match the generic stack); PropertySet reads the live
+        # plan so each placed node decrements the per-value budget.
+        post = self._post_checkers.get(tg.name)
+        if post is None:
+            post = _distinct_property_checkers(self.ctx, job, tg)
+            self._post_checkers[tg.name] = post
+        if post:
+            def _post_filter(nodes):
+                for n in nodes:
+                    ok = True
+                    for checker in post:
+                        good, reason = checker.feasible(n)
+                        if not good:
+                            if metrics is not None:
+                                metrics.filter_node(n, reason)
+                            ok = False
+                            break
+                    if ok:
+                        yield n
+
+            feasible = _post_filter(feasible)
         options = binpack_rank(
             self.ctx, feasible, tg, metrics, evict=evict, job=job
         )

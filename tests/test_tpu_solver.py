@@ -669,3 +669,40 @@ def test_diff_system_distinct_property_matches_host():
         "distinct_property budget must cap each rack"
     )
     assert sum(per_rack["tpu"].values()) == 6, per_rack
+
+
+def test_diff_system_task_level_distinct_property():
+    """Task-level distinct_property budgets are enforced too (lower.py
+    folds task constraints into units_cap; the walk must agree)."""
+    from nomad_tpu.structs import Constraint
+
+    def build(h):
+        for i in range(8):
+            n = mock.node()
+            n.meta["zone"] = "z0"  # one shared value: budget 2 total
+            n.computed_class = compute_node_class(n)
+            h.state.upsert_node(h.next_index(), n)
+        job = mock.system_job(id="syspropt")
+        tg = job.task_groups[0]
+        tg.tasks[0].constraints.append(
+            Constraint("${meta.zone}", "2", "distinct_property")
+        )
+        tg.tasks[0].resources.cpu = 100
+        tg.tasks[0].resources.memory_mb = 32
+        tg.tasks[0].resources.networks = []
+        h.state.upsert_job(h.next_index(), job)
+        return job
+
+    for backend in ("host", "tpu"):
+        h = Harness()
+        job = build(h)
+        h.process(
+            "system", mock.eval_for_job(job),
+            SchedulerConfig(backend=backend),
+        )
+        live = [
+            a
+            for a in h.state.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+        assert len(live) == 2, (backend, len(live))
