@@ -1,0 +1,16 @@
+"""Framework-version fingerprinter (reference
+client/fingerprint/nomad.go)."""
+
+from __future__ import annotations
+
+from .base import Fingerprinter, FingerprintResponse
+
+
+class NomadFingerprint(Fingerprinter):
+    name = "nomad"
+
+    def fingerprint(self, data_dir: str) -> FingerprintResponse:
+        resp = FingerprintResponse()
+        resp.attributes["nomad.version"] = "0.1.0"
+        resp.detected = True
+        return resp

@@ -1,0 +1,35 @@
+"""Storage fingerprinter — PERIODIC (reference
+client/fingerprint/storage.go re-samples free space)."""
+
+from __future__ import annotations
+
+import shutil
+
+from .base import Fingerprinter, FingerprintResponse
+
+# Granularity keeps jitter (a few MB of disk churn) from re-registering
+# the node every fingerprint period.
+STORAGE_GRANULARITY_MB = 1024
+
+
+class StorageFingerprint(Fingerprinter):
+    name = "storage"
+    periodic = True
+
+    def fingerprint(self, data_dir: str) -> FingerprintResponse:
+        resp = FingerprintResponse()
+        try:
+            disk = shutil.disk_usage(data_dir)
+        except OSError:
+            return resp
+        free_mb = (disk.free // (1024 * 1024)) // STORAGE_GRANULARITY_MB
+        free_mb *= STORAGE_GRANULARITY_MB
+        total_mb = disk.total // (1024 * 1024)
+        resp.attributes = {
+            "unique.storage.volume": data_dir,
+            "unique.storage.bytesfree": str(free_mb * 1024 * 1024),
+            "unique.storage.bytestotal": str(total_mb * 1024 * 1024),
+        }
+        resp.resources["disk_mb"] = disk.free // (1024 * 1024)
+        resp.detected = True
+        return resp
