@@ -323,6 +323,14 @@ class Client:
                 if self.node.attributes.get(k) != v:
                     self.node.attributes[k] = v
                     changed = True
+            # a periodic attribute that STOPPED being reported must be
+            # dropped (e.g. cgroups unmounted) — merge-only would leave
+            # the node advertising stale capabilities forever
+            gone = getattr(self, "_last_dynamic_keys", set()) - set(dyn)
+            for k in gone:
+                if self.node.attributes.pop(k, None) is not None:
+                    changed = True
+            self._last_dynamic_keys = set(dyn)
             if not changed or not self._registered.is_set():
                 continue
             from ..structs.node_class import compute_node_class

@@ -73,11 +73,9 @@ def fingerprint_node(
             resources.disk_mb = resp.resources["disk_mb"]
         if "networks" in resp.resources:
             resources.networks = resp.resources["networks"]
-    import socket as _socket
-
     node = Node(
         id=node_id or str(uuid.uuid4()),
-        name=_socket.gethostname(),
+        name=socket.gethostname(),
         datacenter=datacenter,
         node_class=node_class,
         attributes=attributes,
@@ -96,7 +94,10 @@ def dynamic_attributes(data_dir: str = "/tmp") -> dict[str, str]:
         if not fp.periodic:
             continue
         try:
-            out.update(fp.fingerprint(data_dir).attributes)
+            resp = fp.fingerprint(data_dir)
         except Exception:
+            logger.exception("periodic fingerprinter %s failed", fp.name)
             continue
+        if resp.detected:
+            out.update(resp.attributes)
     return out

@@ -3,6 +3,7 @@ client/fingerprint/nomad.go)."""
 
 from __future__ import annotations
 
+from ... import __version__
 from .base import Fingerprinter, FingerprintResponse
 
 
@@ -11,6 +12,6 @@ class NomadFingerprint(Fingerprinter):
 
     def fingerprint(self, data_dir: str) -> FingerprintResponse:
         resp = FingerprintResponse()
-        resp.attributes["nomad.version"] = "0.1.0"
+        resp.attributes["nomad.version"] = __version__
         resp.detected = True
         return resp

@@ -30,6 +30,9 @@ class StorageFingerprint(Fingerprinter):
             "unique.storage.bytesfree": str(free_mb * 1024 * 1024),
             "unique.storage.bytestotal": str(total_mb * 1024 * 1024),
         }
-        resp.resources["disk_mb"] = disk.free // (1024 * 1024)
+        # same granularity as the attribute: disk_mb is hashed into the
+        # computed node class, and raw free-byte jitter would fragment
+        # the per-class feasibility memoization
+        resp.resources["disk_mb"] = free_mb
         resp.detected = True
         return resp

@@ -1,0 +1,40 @@
+"""bench.py contract test: the driver runs it at round end, so a
+breakage found THERE costs the round's numbers. The smoke config runs
+here on CPU fallback (probe timeout forced tiny) and the output JSON
+must carry the full contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_contract():
+    env = dict(
+        os.environ,
+        BENCH_CONFIG="smoke",
+        BENCH_TPU_PROBE_TIMEOUT="1",  # force the CPU fallback path fast
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO_ROOT,
+        timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    # the one-line contract the driver records
+    assert out["metric"] == "smoke_scheduler_throughput"
+    assert out["unit"] == "evals/sec"
+    assert out["value"] > 0 and out["vs_baseline"] > 0
+    assert out["platform"] == "cpu-fallback"
+    assert out["tpu_available"] is False
+    assert any("tpu_available=false" in c for c in out["caveats"])
+    smoke = out["configs"]["smoke"]
+    assert smoke["tpu_placed"] == smoke["host_placed"] == 10
+    assert smoke["density_within_1pct"] in (True, False)
