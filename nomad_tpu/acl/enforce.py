@@ -101,7 +101,12 @@ _NODE_WRITE = [("PUT", re.compile(r"^/v1/node/.*$")), ("POST", re.compile(r"^/v1
 # pprof dumps internal state and can occupy handler threads for seconds:
 # agent:write, like the reference (command/agent/agent_endpoint.go
 # AgentPprofRequest). Checked BEFORE the broader agent-read rule.
-_AGENT_WRITE = [("GET", re.compile(r"^/v1/agent/pprof/.*$"))]
+_AGENT_WRITE = [
+    ("GET", re.compile(r"^/v1/agent/pprof/.*$")),
+    # force-leave ejects a member from gossip (reference agent:write)
+    ("PUT", re.compile(r"^/v1/agent/force-leave$")),
+    ("POST", re.compile(r"^/v1/agent/force-leave$")),
+]
 _AGENT_READ = [
     ("GET", re.compile(r"^/v1/agent/.*$")),
     ("GET", re.compile(r"^/v1/metrics$")),

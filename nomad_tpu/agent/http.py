@@ -137,6 +137,21 @@ class HTTPAgentServer:
             if acl.allow_namespace_op(getattr(o, "namespace", "default"), cap)
         ]
 
+    def _map_forward_error(self, e: Exception):
+        """KeyError/ValueError raised on THIS server map directly; the
+        same errors raised on the LEADER arrive as RPCError strings —
+        map both so followers return 404/400 instead of 500."""
+        if isinstance(e, KeyError):
+            return HTTPError(404, str(e))
+        if isinstance(e, ValueError):
+            return HTTPError(400, str(e))
+        msg = str(e)
+        if "KeyError" in msg or "not found" in msg:
+            return HTTPError(404, msg)
+        if "ValueError" in msg:
+            return HTTPError(400, msg)
+        return None
+
     def rpc_region(self, method: str, args):
         """rpc_self with the request's ?region= attached, so any route
         can address a federated region (reference: Region rides every
@@ -482,6 +497,28 @@ class HTTPAgentServer:
         route("GET", "/v1/job/(?P<id>[^/]+)/evaluations", job_evals)
         route("GET", "/v1/job/(?P<id>[^/]+)/summary", job_summary)
         route("GET", "/v1/job/(?P<id>[^/]+)/versions", job_versions)
+        def job_evaluate(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            try:
+                eval_id = self.rpc_region(
+                    "Job.evaluate", {"namespace": ns, "job_id": p["id"]}
+                )
+            except Exception as e:
+                mapped = self._map_forward_error(e)
+                if mapped is None:
+                    raise
+                raise mapped
+            return {"EvalID": eval_id}
+
+        def job_deployments(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return self.rpc_region(
+                "Job.deployments", {"namespace": ns, "job_id": p["id"]}
+            )
+
+        route("PUT", "/v1/job/(?P<id>[^/]+)/evaluate", job_evaluate)
+        route("POST", "/v1/job/(?P<id>[^/]+)/evaluate", job_evaluate)
+        route("GET", "/v1/job/(?P<id>[^/]+)/deployments", job_deployments)
         route("POST", "/v1/job/(?P<id>[^/]+)/scale", job_scale)
         route("PUT", "/v1/job/(?P<id>[^/]+)/scale", job_scale)
         route("GET", "/v1/job/(?P<id>[^/]+)/scale", job_scale_status)
@@ -1064,8 +1101,18 @@ class HTTPAgentServer:
             self.rpc_region("Operator.force_gc", {})
             return None
 
+        def system_reconcile(p, q, body, tok):
+            n = self.rpc_region("System.reconcile_summaries", {})
+            return {"Reconciled": n}
+
         route("PUT", "/v1/system/gc", system_gc)
         route("POST", "/v1/system/gc", system_gc)
+        route(
+            "PUT", "/v1/system/reconcile/summaries", system_reconcile
+        )
+        route(
+            "POST", "/v1/system/reconcile/summaries", system_reconcile
+        )
 
         # -- operator --------------------------------------------------
         def scheduler_config_get(p, q, body, tok):
@@ -1122,6 +1169,35 @@ class HTTPAgentServer:
         route(
             "DELETE", "/v1/operator/raft/peer", operator_raft_remove_peer
         )
+
+        def autopilot_get(p, q, body, tok):
+            return self.rpc_region("Operator.autopilot_get_config", {})
+
+        def autopilot_set(p, q, body, tok):
+            return self.rpc_region(
+                "Operator.autopilot_set_config", {"config": body or {}}
+            )
+
+        def agent_force_leave(p, q, body, tok):
+            member = q.get("node", [""])[0]
+            if not member:
+                raise HTTPError(400, "node query param required")
+            acked = self.rpc_region(
+                "Operator.force_leave", {"member_id": member}
+            )
+            return {"Acked": acked}
+
+        route(
+            "GET", "/v1/operator/autopilot/configuration", autopilot_get
+        )
+        route(
+            "PUT", "/v1/operator/autopilot/configuration", autopilot_set
+        )
+        route(
+            "POST", "/v1/operator/autopilot/configuration", autopilot_set
+        )
+        route("PUT", "/v1/agent/force-leave", agent_force_leave)
+        route("POST", "/v1/agent/force-leave", agent_force_leave)
 
         route("GET", "/v1/status/leader", status_leader)
         route("GET", "/v1/status/peers", status_peers)

@@ -189,6 +189,19 @@ class Jobs(_Resource):
             },
         )
 
+    def evaluate(self, job_id: str, namespace: Optional[str] = None):
+        """Force a new evaluation (reference api/jobs.go ForceEvaluate)."""
+        return self.c.put(
+            f"/v1/job/{job_id}/evaluate",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def deployments(self, job_id: str, namespace: Optional[str] = None):
+        return self.c.get(
+            f"/v1/job/{job_id}/deployments",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
     def scale_status(self, job_id: str, namespace: Optional[str] = None):
         return self.c.get(
             f"/v1/job/{job_id}/scale",
@@ -397,6 +410,9 @@ class SystemAPI(_Resource):
     def gc(self):
         return self.c.put("/v1/system/gc")
 
+    def reconcile_summaries(self):
+        return self.c.put("/v1/system/reconcile/summaries")
+
 
 class Evaluations(_Resource):
     def list(self):
@@ -573,6 +589,14 @@ class Plugins(_Resource):
 
 
 class Operator(_Resource):
+    def autopilot_configuration(self):
+        return self.c.get("/v1/operator/autopilot/configuration")
+
+    def autopilot_set_configuration(self, config: dict):
+        return self.c.put(
+            "/v1/operator/autopilot/configuration", body=config
+        )
+
     def raft_remove_peer(self, peer_id: str):
         return self.c.delete(
             "/v1/operator/raft/peer", params={"id": peer_id}
@@ -605,6 +629,9 @@ class Operator(_Resource):
 
 
 class AgentAPI(_Resource):
+    def force_leave(self, node: str):
+        return self.c.put("/v1/agent/force-leave", params={"node": node})
+
     def members(self):
         return self.c.get("/v1/agent/members")
 

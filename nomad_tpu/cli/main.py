@@ -1068,6 +1068,141 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_job_eval(args) -> int:
+    """Reference: command/job_eval.go — force a new evaluation."""
+    api = _client(args)
+    out = api.jobs.evaluate(args.job_id)
+    print(f"Created eval {out['EvalID'][:8]} for job \"{args.job_id}\"")
+    return 0
+
+
+def cmd_job_deployments(args) -> int:
+    """Reference: command/job_deployments.go."""
+    api = _client(args)
+    deps = api.jobs.deployments(args.job_id)
+    if not deps:
+        print("No deployments")
+        return 0
+    print(
+        _fmt_table(
+            [
+                [d.id[:8], str(d.job_version), d.status,
+                 d.status_description[:60]]
+                for d in sorted(
+                    deps, key=lambda d: d.job_version, reverse=True
+                )
+            ],
+            header=["ID", "Job Version", "Status", "Description"],
+        )
+    )
+    return 0
+
+
+def cmd_job_promote(args) -> int:
+    """Reference: command/job_promote.go — promote the job's latest
+    deployment's canaries."""
+    api = _client(args)
+    deps = api.jobs.deployments(args.job_id)
+    active = [d for d in deps if d.active()]
+    if not active:
+        print(f'No active deployment for job "{args.job_id}"',
+              file=sys.stderr)
+        return 1
+    d = max(active, key=lambda d: d.job_version)
+    api.deployments.promote(d.id)
+    print(f"Deployment {d.id[:8]} promoted")
+    return 0
+
+
+def cmd_namespace_status(args) -> int:
+    """Reference: command/namespace_status.go."""
+    api = _client(args)
+    ns = api.namespaces.get(args.name)
+    print(f"Name        = {ns.name}")
+    print(f"Description = {ns.description}")
+    jobs = api.jobs.list(namespace=args.name)
+    print(f"Jobs        = {len(jobs)}")
+    return 0
+
+
+def cmd_system_reconcile(args) -> int:
+    """Reference: command/system_reconcile_summaries.go."""
+    api = _client(args)
+    out = api.system.reconcile_summaries()
+    print(f"Reconciled {out['Reconciled']} job summaries")
+    return 0
+
+
+def cmd_server_force_leave(args) -> int:
+    """Reference: command/server_force_leave.go."""
+    api = _client(args)
+    out = api.agent.force_leave(args.node)
+    print(f'Member "{args.node}" force-left ({out["Acked"]} peers acked)')
+    return 0
+
+
+def cmd_operator_autopilot_get(args) -> int:
+    api = _client(args)
+    cfg = api.operator.autopilot_configuration()
+    print(f"CleanupDeadServers = {cfg['CleanupDeadServers']}")
+    return 0
+
+
+def cmd_operator_autopilot_set(args) -> int:
+    api = _client(args)
+    cfg = {}
+    if args.cleanup_dead_servers is not None:
+        cfg["CleanupDeadServers"] = args.cleanup_dead_servers == "true"
+    api.operator.autopilot_set_configuration(cfg)
+    print("Autopilot configuration updated!")
+    return 0
+
+
+def cmd_operator_keygen(args) -> int:
+    """Reference: command/operator_keygen.go — a random fabric secret
+    (rpc_secret in agent config)."""
+    import base64
+    import secrets as _secrets
+
+    print(base64.b64encode(_secrets.token_bytes(32)).decode())
+    return 0
+
+
+def cmd_operator_snapshot_inspect(args) -> int:
+    """Reference: command/operator_snapshot_inspect.go."""
+    from .. import codec
+
+    with open(args.file, "rb") as f:
+        raw = f.read()
+    data = codec.unpack(raw)
+    tables = data.get("tables", data) if isinstance(data, dict) else {}
+    print(f"File    = {args.file}")
+    print(f"Size    = {len(raw)} bytes")
+    rows = []
+    for name, t in sorted(tables.items()):
+        try:
+            rows.append([name, str(len(t))])
+        except TypeError:
+            rows.append([name, "?"])
+    if rows:
+        print(_fmt_table(rows, header=["Table", "Entries"]))
+    return 0
+
+
+def cmd_ui(args) -> int:
+    """Reference: command/ui.go — print (and try to open) the web UI."""
+    addr, _, _ = _conn_opts(args)
+    url = f"{addr}/ui/"
+    print(f"Opening URL {url}")
+    try:
+        import webbrowser
+
+        webbrowser.open(url)
+    except Exception:
+        pass
+    return 0
+
+
 def cmd_eval_delete(args) -> int:
     """Reference: command/eval_delete.go."""
     api = _client(args)
@@ -1514,6 +1649,15 @@ def build_parser() -> argparse.ArgumentParser:
     jst.add_argument("job_id")
     jst.add_argument("-purge", action="store_true")
     jst.set_defaults(fn=cmd_job_stop)
+    jev = jsub.add_parser("eval")
+    jev.add_argument("job_id")
+    jev.set_defaults(fn=cmd_job_eval)
+    jdp = jsub.add_parser("deployments")
+    jdp.add_argument("job_id")
+    jdp.set_defaults(fn=cmd_job_deployments)
+    jpr = jsub.add_parser("promote")
+    jpr.add_argument("job_id")
+    jpr.set_defaults(fn=cmd_job_promote)
     jsc = jsub.add_parser("scale")
     jsc.add_argument("job_id")
     jsc.add_argument("group")
@@ -1637,6 +1781,11 @@ def build_parser() -> argparse.ArgumentParser:
     dpa.add_argument("deployment_id")
     dpa.add_argument("-resume", action="store_true")
     dpa.set_defaults(fn=cmd_deployment_pause)
+    dre = dsub.add_parser("resume")
+    dre.add_argument("deployment_id")
+    dre.set_defaults(
+        fn=lambda a: cmd_deployment_pause(_set_resume(a))
+    )
 
     acl = sub.add_parser("acl", help="ACL commands")
     aclsub = acl.add_subparsers(dest="subcmd")
@@ -1671,9 +1820,15 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = srv.add_subparsers(dest="subcmd")
     sm = ssub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
+    sfl = ssub.add_parser("force-leave")
+    sfl.add_argument("node")
+    sfl.set_defaults(fn=cmd_server_force_leave)
 
     nsp = sub.add_parser("namespace", help="namespace commands")
     nssub = nsp.add_subparsers(dest="subcmd")
+    nst = nssub.add_parser("status")
+    nst.add_argument("name")
+    nst.set_defaults(fn=cmd_namespace_status)
     nsl = nssub.add_parser("list")
     nsl.set_defaults(fn=cmd_namespace_list)
     nsa = nssub.add_parser("apply")
@@ -1720,6 +1875,10 @@ def build_parser() -> argparse.ArgumentParser:
     syssub = system.add_subparsers(dest="subcmd")
     sgc = syssub.add_parser("gc")
     sgc.set_defaults(fn=cmd_system_gc)
+    srec = syssub.add_parser("reconcile")
+    srecsub = srec.add_subparsers(dest="subsubcmd")
+    srs = srecsub.add_parser("summaries")
+    srs.set_defaults(fn=cmd_system_reconcile)
 
     sec = sub.add_parser("secret", help="embedded secrets store commands")
     secsub = sec.add_subparsers(dest="subcmd")
@@ -1739,6 +1898,9 @@ def build_parser() -> argparse.ArgumentParser:
     sdel.add_argument("path")
     sdel.add_argument("-namespace", default="default")
     sdel.set_defaults(fn=cmd_secret_delete)
+
+    uic = sub.add_parser("ui", help="open the web UI")
+    uic.set_defaults(fn=cmd_ui)
 
     svc = sub.add_parser("service", help="service discovery commands")
     svcsub = svc.add_subparsers(dest="subcmd")
@@ -1763,6 +1925,9 @@ def build_parser() -> argparse.ArgumentParser:
     opss = opsnapsub.add_parser("save")
     opss.add_argument("file")
     opss.set_defaults(fn=cmd_operator_snapshot_save)
+    opsi = opsnapsub.add_parser("inspect")
+    opsi.add_argument("file")
+    opsi.set_defaults(fn=cmd_operator_snapshot_inspect)
     opsr = opsnapsub.add_parser("restore")
     opsr.add_argument("file")
     opsr.set_defaults(fn=cmd_operator_snapshot_restore)
@@ -1773,6 +1938,18 @@ def build_parser() -> argparse.ArgumentParser:
     oprm = opraftsub.add_parser("remove-peer")
     oprm.add_argument("peer_id")
     oprm.set_defaults(fn=cmd_operator_raft_remove_peer)
+    opap = opsub.add_parser("autopilot")
+    opapsub = opap.add_subparsers(dest="subsubcmd")
+    opag = opapsub.add_parser("get-config")
+    opag.set_defaults(fn=cmd_operator_autopilot_get)
+    opas = opapsub.add_parser("set-config")
+    opas.add_argument(
+        "-cleanup-dead-servers", dest="cleanup_dead_servers",
+        default=None, choices=["true", "false"],
+    )
+    opas.set_defaults(fn=cmd_operator_autopilot_set)
+    opkg = opsub.add_parser("keygen")
+    opkg.set_defaults(fn=cmd_operator_keygen)
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)
@@ -1815,6 +1992,11 @@ def build_parser() -> argparse.ArgumentParser:
     ver.set_defaults(fn=cmd_version)
 
     return p
+
+
+def _set_resume(a):
+    a.resume = True
+    return a
 
 
 def _elig_fix(a):

@@ -324,13 +324,23 @@ class Client:
                     self.node.attributes[k] = v
                     changed = True
             # a periodic attribute that STOPPED being reported must be
-            # dropped (e.g. cgroups unmounted) — merge-only would leave
-            # the node advertising stale capabilities forever
-            gone = getattr(self, "_last_dynamic_keys", set()) - set(dyn)
-            for k in gone:
-                if self.node.attributes.pop(k, None) is not None:
-                    changed = True
-            self._last_dynamic_keys = set(dyn)
+            # dropped (e.g. cgroups unmounted) — but only after TWO
+            # consecutive misses, so a transient sample failure doesn't
+            # strip attributes and churn re-registration cluster-wide
+            misses = getattr(self, "_dyn_miss_counts", {})
+            known = getattr(self, "_dyn_known_keys", set()) | set(dyn)
+            for k in list(known):
+                if k in dyn:
+                    misses.pop(k, None)
+                    continue
+                misses[k] = misses.get(k, 0) + 1
+                if misses[k] >= 2:
+                    known.discard(k)
+                    misses.pop(k, None)
+                    if self.node.attributes.pop(k, None) is not None:
+                        changed = True
+            self._dyn_miss_counts = misses
+            self._dyn_known_keys = known
             if not changed or not self._registered.is_set():
                 continue
             from ..structs.node_class import compute_node_class

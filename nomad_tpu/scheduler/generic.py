@@ -19,6 +19,7 @@ from ..structs import (
     now_ns,
 )
 from ..structs.structs import (
+    EVAL_TRIGGER_FORCE_EVAL,
     ALLOC_CLIENT_STATUS_FAILED,
     ALLOC_DESIRED_STATUS_STOP,
     EVAL_STATUS_COMPLETE,
@@ -98,6 +99,7 @@ class GenericScheduler:
             EVAL_TRIGGER_MAX_PLANS,
             EVAL_TRIGGER_DEPLOYMENT_WATCHER,
             EVAL_TRIGGER_RETRY_FAILED_ALLOC,
+            EVAL_TRIGGER_FORCE_EVAL,
             EVAL_TRIGGER_FAILED_FOLLOWUP,
             EVAL_TRIGGER_PREEMPTION,
             EVAL_TRIGGER_SCALING,

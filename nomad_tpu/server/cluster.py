@@ -82,6 +82,32 @@ class OperatorEndpoint(_Forwarder):
             lambda a: self.cs.server.force_gc(),
         )
 
+    def autopilot_get_config(self, args):
+        """Reference operator_endpoint.go AutopilotGetConfiguration
+        (the OSS-relevant knob: dead-server cleanup). Raft-replicated:
+        every replica reads its own consistent copy and the setting
+        survives failover."""
+        return self.cs.autopilot_config()
+
+    def autopilot_set_config(self, args):
+        def apply(a):
+            cfg = a.get("config") or {}
+            cur = self.cs.autopilot_config()
+            if "CleanupDeadServers" in cfg:
+                cur["CleanupDeadServers"] = bool(
+                    cfg["CleanupDeadServers"]
+                )
+            self.cs.server.raft_apply("operator_config_upsert",
+                                      ("autopilot", cur))
+            return {"Updated": True}
+
+        return self._forward(
+            "Operator.autopilot_set_config", args, apply
+        )
+
+    def force_leave(self, args):
+        return self.cs.force_leave(args["member_id"])
+
     def scheduler_get_config(self, args):
         def local(a):
             return self._scheduler_config_payload()
@@ -259,6 +285,20 @@ class JobEndpoint(_Forwarder):
             "JobStopped": job.stop,
             "TaskGroups": groups,
         }
+
+    def evaluate(self, args):
+        return self._forward(
+            "Job.evaluate",
+            args,
+            lambda a: self.cs.server.job_force_evaluate(
+                a["namespace"], a["job_id"]
+            ),
+        )
+
+    def deployments(self, args):
+        return self.cs.server.state.deployments_by_job(
+            args["namespace"], args["job_id"]
+        )
 
     def scale(self, args):
         return self._forward(
@@ -767,6 +807,17 @@ class ACLEndpoint(_Forwarder):
         return out
 
 
+class SystemEndpoint(_Forwarder):
+    """Reference: nomad/system_endpoint.go."""
+
+    def reconcile_summaries(self, args):
+        return self._forward(
+            "System.reconcile_summaries",
+            args,
+            lambda a: self.cs.server.reconcile_job_summaries(),
+        )
+
+
 class StatusEndpoint(_Forwarder):
     def leader(self, args):
         addr = self.cs.raft.leader_addr()
@@ -877,6 +928,7 @@ class ClusterServer:
             ("Deployment", DeploymentEndpoint(self)),
             ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),
+            ("System", SystemEndpoint(self)),
             ("Operator", OperatorEndpoint(self)),
         ):
             self.rpc.register(name, ep)
@@ -914,6 +966,40 @@ class ClusterServer:
         self._reconciler.start()
 
     # -- wiring --------------------------------------------------------
+
+    def autopilot_config(self) -> dict:
+        cfg = self.server.state.operator_config("autopilot")
+        return dict(cfg) if cfg else {"CleanupDeadServers": True}
+
+    def force_leave(self, member_id: str) -> int:
+        """Force a (presumed-dead) member out of gossip everywhere
+        (reference `server force-leave` / serf RemoveFailedNode).
+        Returns how many peers acknowledged."""
+        target = next(
+            (m for m in self.serf.members() if m.id == member_id), None
+        )
+        # Unknown locally ⇒ peers may hold it at any incarnation: use an
+        # operator-override incarnation that outranks organic ones (a
+        # force-left member is declared dead; it does not refute).
+        inc = (target.incarnation + 1) if target else (1 << 30)
+        self.serf.endpoint.leave(
+            {"id": member_id, "incarnation": inc}
+        )
+        acked = 0
+        for m in self.serf.members():
+            if m.id in (member_id, self.node_id):
+                continue
+            try:
+                accepted = self.pool.call(
+                    tuple(m.addr), "Serf.leave",
+                    {"id": member_id, "incarnation": inc},
+                    timeout_s=3.0,
+                )
+            except Exception:
+                continue
+            if accepted:
+                acked += 1
+        return acked
 
     def csi_controller_roundtrip(
         self, plugin_id: str, verb: str, header: dict
@@ -1379,6 +1465,10 @@ class ClusterServer:
                 if kind in ("member-join", "member-alive"):
                     self.raft.add_peer(member.id, tuple(member.addr))
                 elif kind in ("member-failed", "member-leave"):
+                    if kind == "member-failed" and not self.autopilot_config().get(
+                        "CleanupDeadServers", True
+                    ):
+                        continue  # operator opted out of auto-removal
                     self.raft.remove_peer(member.id)
             except (NotLeaderError, TimeoutError):
                 pass

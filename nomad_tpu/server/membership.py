@@ -98,8 +98,7 @@ class SerfEndpoint:
             return {"ok": False}
 
     def leave(self, args):
-        self._mgr._on_leave_rumor(args["id"], args["incarnation"])
-        return True
+        return self._mgr._on_leave_rumor(args["id"], args["incarnation"])
 
 
 class Membership:
@@ -289,15 +288,19 @@ class Membership:
             self._suspect_since[member_id] = time.monotonic()
         logger.debug("member %s suspected", member_id)
 
-    def _on_leave_rumor(self, member_id: str, incarnation: int) -> None:
+    def _on_leave_rumor(self, member_id: str, incarnation: int) -> bool:
+        """Returns whether the rumor was ACCEPTED — a caller counting
+        acknowledgements (force-leave) must not mistake a dropped
+        lower-incarnation rumor for one."""
         with self._lock:
             m = self._members.get(member_id)
             if m is None or incarnation < m.incarnation:
-                return
+                return False
             m.incarnation = incarnation
             m.status = LEFT
             self._suspect_since.pop(member_id, None)
         self._fire("member-leave", m)
+        return True
 
     def _merge(self, remote: list[Member]) -> None:
         # (kind, member) transitions to fire after releasing the lock —

@@ -91,6 +91,12 @@ class FSM:
                 self.state.delete_services_by_alloc(i, p)
             ),
             "secret_upsert": lambda i, p: self.state.upsert_secret(i, p),
+            "summaries_reconcile": lambda i, p: (
+                self.state.reconcile_job_summaries(i)
+            ),
+            "operator_config_upsert": lambda i, p: (
+                self.state.upsert_operator_config(i, p[0], p[1])
+            ),
             "secret_delete": lambda i, p: self.state.delete_secret(
                 i, p[0], p[1]
             ),

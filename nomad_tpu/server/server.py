@@ -584,6 +584,35 @@ class Server:
         tg.count = count
         return self.job_register(job)
 
+    def job_force_evaluate(self, namespace: str, job_id: str) -> str:
+        """Create a new eval for the job (reference job_endpoint.go
+        Evaluate / `nomad job eval`). Returns the eval id."""
+        job = self.state.job_by_id(namespace, job_id)
+        if job is None:
+            raise KeyError(f"job {job_id} not found")
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=namespace,
+            priority=job.priority,
+            type=job.type,
+            triggered_by="job-eval",
+            job_id=job_id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        self.raft_apply("eval_update", [ev])
+        return ev.id
+
+    def reconcile_job_summaries(self) -> int:
+        """Rebuild every job summary from the alloc table (reference
+        system_endpoint.go ReconcileJobSummaries / `system reconcile
+        summaries`). Returns how many jobs were recomputed (raft_apply
+        returns the LOG INDEX, not the FSM result — count from state)."""
+        n = len(self.state.jobs())
+        self.raft_apply("summaries_reconcile", None)
+        return n
+
     def job_plan(self, job: Job, diff: bool = True) -> dict:
         """Dry-run the candidate job: run the real scheduler against a
         snapshot without committing; return annotations + diff + failures

@@ -69,6 +69,7 @@ TABLE_VOLUMES = "volumes"
 TABLE_NAMESPACES = "namespaces"
 TABLE_SERVICES = "services"
 TABLE_SECRETS = "secrets"
+TABLE_OPERATOR = "operator_config"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -83,6 +84,7 @@ ALL_TABLES = (
     TABLE_NAMESPACES,
     TABLE_SERVICES,
     TABLE_SECRETS,
+    TABLE_OPERATOR,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -333,6 +335,10 @@ class _ReadMixin:
 
     def service_registration_by_id(self, reg_id: str):
         return self._tables[TABLE_SERVICES].get(reg_id)
+
+    # operator config --------------------------------------------------
+    def operator_config(self, key: str):
+        return self._tables[TABLE_OPERATOR].get(key)
 
     # secrets ----------------------------------------------------------
     def secret_by_path(self, namespace: str, path: str):
@@ -866,6 +872,73 @@ class StateStore(_ReadMixin):
         summary.modify_index = index
         st[job.ns_id()] = summary
 
+    def reconcile_job_summaries(self, index: int) -> int:
+        """Rebuild every job summary from the alloc table (reference
+        state_store.go ReconcileJobSummaries — `system reconcile
+        summaries` repairs drifted counters). Returns jobs recomputed."""
+        with self._lock:
+            st = self._wtable(TABLE_JOB_SUMMARIES)
+            jobs = dict(self._tables[TABLE_JOBS])
+            per_job: dict[tuple, dict[str, dict[str, int]]] = {}
+            for alloc in self._tables[TABLE_ALLOCS].values():
+                key = (alloc.namespace, alloc.job_id)
+                if key not in jobs:
+                    continue
+                groups = per_job.setdefault(key, {})
+                c = groups.setdefault(
+                    alloc.task_group,
+                    {
+                        "queued": 0,
+                        "complete": 0,
+                        "failed": 0,
+                        "running": 0,
+                        "starting": 0,
+                        "lost": 0,
+                    },
+                )
+                status = alloc.client_status
+                if alloc.server_terminal_status() and status not in (
+                    ALLOC_CLIENT_STATUS_COMPLETE,
+                    ALLOC_CLIENT_STATUS_FAILED,
+                    ALLOC_CLIENT_STATUS_LOST,
+                ):
+                    continue  # stopping: counted nowhere, like fresh GC
+                if status == ALLOC_CLIENT_STATUS_RUNNING:
+                    c["running"] += 1
+                elif status == ALLOC_CLIENT_STATUS_COMPLETE:
+                    c["complete"] += 1
+                elif status == ALLOC_CLIENT_STATUS_FAILED:
+                    c["failed"] += 1
+                elif status == ALLOC_CLIENT_STATUS_LOST:
+                    c["lost"] += 1
+                else:
+                    c["starting"] += 1
+            for key, job in jobs.items():
+                old = st.get(key)
+                summary = JobSummary(job.id, job.namespace)
+                summary.create_index = old.create_index if old else index
+                summary.modify_index = index
+                summary.summary = per_job.get(key, {})
+                for tg in job.task_groups:
+                    summary.summary.setdefault(
+                        tg.name,
+                        {
+                            "queued": 0,
+                            "complete": 0,
+                            "failed": 0,
+                            "running": 0,
+                            "starting": 0,
+                            "lost": 0,
+                        },
+                    )
+                if old is not None:
+                    summary.children_pending = old.children_pending
+                    summary.children_running = old.children_running
+                    summary.children_dead = old.children_dead
+                st[key] = summary
+            self._stamp(index, TABLE_JOB_SUMMARIES)
+            return len(jobs)
+
     def delete_job(self, index: int, namespace: str, job_id: str) -> None:
         with self._lock:
             t = self._wtable(TABLE_JOBS)
@@ -1316,6 +1389,16 @@ class StateStore(_ReadMixin):
                     log.warning(
                         "volume claim for alloc %s: %s", alloc.id, e
                     )
+
+    # -- operator config -----------------------------------------------
+
+    def upsert_operator_config(self, index: int, key: str, value: dict) -> None:
+        """Raft-replicated operator knobs (reference: autopilot config
+        lives in raft state, operator_endpoint.go)."""
+        with self._lock:
+            t = self._wtable(TABLE_OPERATOR)
+            t[key] = dict(value)
+            self._stamp(index, TABLE_OPERATOR)
 
     # -- secrets -------------------------------------------------------
 

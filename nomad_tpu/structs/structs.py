@@ -80,6 +80,7 @@ EVAL_TRIGGER_RETRY_FAILED_ALLOC = "alloc-failure"
 EVAL_TRIGGER_QUEUED_ALLOCS = "queued-allocs"
 EVAL_TRIGGER_PREEMPTION = "preemption"
 EVAL_TRIGGER_SCALING = "job-scaling"
+EVAL_TRIGGER_FORCE_EVAL = "job-eval"
 
 # Constraint operands (reference: nomad/structs/structs.go:8248-8258)
 CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"

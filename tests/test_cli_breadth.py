@@ -295,3 +295,45 @@ def test_eval_delete_and_node_purge(agent):
     srv.state.upsert_evals(srv.state.latest_index() + 1, [pend])
     with pytest.raises(APIError):
         api.evaluations.delete(pend.id)
+
+
+def test_job_eval_and_deployments_and_reconcile(agent):
+    api = _api(agent)
+    _run_job(agent, job_id="evaljob")
+    out = api.jobs.evaluate("evaljob")
+    assert out["EvalID"]
+    srv = agent.server.server
+    assert wait_until(
+        lambda: srv.state.eval_by_id(out["EvalID"]) is not None
+        and srv.state.eval_by_id(out["EvalID"]).status == "complete",
+        10,
+    )
+    # deployments listing (service job creates one when update strategy
+    # applies; empty list is fine too — the contract is the route)
+    deps = api.jobs.deployments("evaljob")
+    assert isinstance(deps, list)
+    # corrupt a summary, reconcile repairs it
+    summ = srv.state.job_summary_by_id("default", "evaljob")
+    bad = summ.copy()
+    bad.summary["web"]["running"] = 99
+    srv.state._wtable("job_summary")[("default", "evaljob")] = bad
+    out = api.system.reconcile_summaries()
+    assert out["Reconciled"] >= 1
+    fixed = srv.state.job_summary_by_id("default", "evaljob")
+    assert fixed.summary["web"]["running"] == 1, fixed.summary
+
+
+def test_autopilot_roundtrip(agent):
+    api = _api(agent)
+    cfg = api.operator.autopilot_configuration()
+    assert cfg["CleanupDeadServers"] is True
+    api.operator.autopilot_set_configuration(
+        {"CleanupDeadServers": False}
+    )
+    assert (
+        api.operator.autopilot_configuration()["CleanupDeadServers"]
+        is False
+    )
+    assert (
+        agent.server.autopilot_config()["CleanupDeadServers"] is False
+    )
