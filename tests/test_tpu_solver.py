@@ -706,3 +706,131 @@ def test_diff_system_task_level_distinct_property():
             if not a.terminal_status()
         ]
         assert len(live) == 2, (backend, len(live))
+
+
+def test_diff_randomized_clusters_match_host():
+    """Property-style check across seeded random clusters. Exact count
+    equality is NOT a sound invariant here: the host oracle samples
+    among top-scoring nodes (reference select), so two valid greedy
+    schedules fragment capacity differently. What MUST hold for both
+    backends, per seed:
+
+      1. capacity safety — no node overcommitted;
+      2. constraint satisfaction — every placed alloc's node matches
+         the job's constraints;
+      3. greedy completeness — when a job ends under its count, no
+         node has room+feasibility for one more instance (a backend
+         that strands placeable instances is broken, which is the bug
+         class this test exists to catch).
+
+    Preemption is disabled: an evicted alloc's follow-up reschedule
+    eval is processed by a real server's broker, not by this harness,
+    so a preempted-then-reschedulable job would look 'incomplete' here
+    (preemption parity has its own dedicated diff tests)."""
+    import random
+
+    from nomad_tpu.structs import Constraint, Spread
+
+    def build(seed):
+        rng = random.Random(seed)
+        h = Harness()
+        dcs = ["dc1", "dc2"]
+        nodes = []
+        for _ in range(rng.randint(12, 24)):
+            n = mock.node()
+            n.datacenter = rng.choice(dcs)
+            n.resources.cpu = rng.choice([2000, 4000])
+            n.resources.memory_mb = rng.choice([2048, 8192])
+            n.meta["tier"] = rng.choice(["a", "b"])
+            n.computed_class = compute_node_class(n)
+            h.state.upsert_node(h.next_index(), n)
+            nodes.append(n)
+            if rng.random() < 0.3:
+                filler = mock.alloc(node_=n)
+                filler.resources.tasks["web"].cpu = n.resources.cpu // 2
+                h.state.upsert_allocs(h.next_index(), [filler])
+        jobs = []
+        for j in range(rng.randint(3, 6)):
+            job = mock.job(id=f"rand-{seed}-{j}")
+            job.datacenters = dcs
+            job.priority = rng.choice([30, 50, 70])
+            tg = job.task_groups[0]
+            tg.count = rng.randint(2, 12)
+            tg.tasks[0].resources.cpu = rng.choice([200, 400, 900])
+            tg.tasks[0].resources.memory_mb = rng.choice([64, 256])
+            tg.tasks[0].resources.networks = []
+            if rng.random() < 0.5:
+                job.constraints.append(
+                    Constraint("${meta.tier}", "a", "=")
+                )
+            if rng.random() < 0.4:
+                job.spreads = [
+                    Spread(attribute="${node.datacenter}", weight=50)
+                ]
+            h.state.upsert_job(h.next_index(), job)
+            jobs.append(job)
+        return h, jobs, nodes
+
+    def free_cpu_mem(h, node):
+        used_cpu = used_mem = 0
+        for a in h.state.allocs_by_node(node.id):
+            if a.terminal_status():
+                continue
+            for tr in a.resources.tasks.values():
+                used_cpu += tr.cpu
+                used_mem += tr.memory_mb
+        return node.resources.cpu - used_cpu, (
+            node.resources.memory_mb - used_mem
+        )
+
+    def node_feasible(job, node):
+        for c in job.constraints:
+            if c.ltarget == "${meta.tier}" and c.operand == "=":
+                if node.meta.get("tier") != c.rtarget:
+                    return False
+        return node.datacenter in job.datacenters
+
+    for seed in (7, 23, 91, 108, 117, 119):
+        for backend in ("host", "tpu"):
+            h, jobs, nodes = build(seed)
+            cfg = SchedulerConfig(
+                backend=backend, preemption_service=False
+            )
+            for job in jobs:
+                h.process("service", mock.eval_for_job(job), cfg)
+            # 1. capacity safety
+            for n in nodes:
+                free_cpu, free_mem = free_cpu_mem(h, n)
+                assert free_cpu >= 0 and free_mem >= 0, (
+                    seed, backend, n.id[:8], free_cpu, free_mem,
+                )
+            for job in jobs:
+                tg = job.task_groups[0]
+                ask = tg.tasks[0].resources
+                live = [
+                    a
+                    for a in h.state.allocs_by_job("default", job.id)
+                    if not a.terminal_status()
+                ]
+                # 2. constraint satisfaction
+                for a in live:
+                    node = h.state.node_by_id(a.node_id)
+                    assert node_feasible(job, node), (
+                        seed, backend, job.id, node.meta,
+                    )
+                # 3. greedy completeness
+                if len(live) < tg.count:
+                    for n in nodes:
+                        if not node_feasible(job, n):
+                            continue
+                        free_cpu, free_mem = free_cpu_mem(h, n)
+                        assert not (
+                            free_cpu >= ask.cpu
+                            and free_mem >= ask.memory_mb
+                        ), (
+                            f"seed {seed} {backend}: job {job.id} placed "
+                            f"{len(live)}/{tg.count} but node {n.id[:8]} "
+                            f"still fits one (free {free_cpu}cpu/"
+                            f"{free_mem}mb vs ask {ask.cpu}/"
+                            f"{ask.memory_mb})"
+                        )
