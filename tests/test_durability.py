@@ -263,3 +263,74 @@ class TestRestoreIndexRebase:
             assert srv.state.latest_index() >= idx > latest - 1
         finally:
             srv.shutdown()
+
+
+def test_restart_preserves_round3_tables(tmp_path):
+    """Services, secrets, CSI volumes, and operator config all ride
+    raft — a full single-server kill/restart must bring every one of
+    them back (snapshot + log replay)."""
+    import socket as _socket
+
+    from nomad_tpu.structs.structs import (
+        SecretEntry,
+        ServiceRegistration,
+        Volume,
+    )
+
+    s = _socket.create_server(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    def boot():
+        cs = ClusterServer(
+            "solo", port=port, num_workers=1,
+            data_dir=str(tmp_path / "solo"), bootstrap_expect=1,
+        )
+        cs.start()
+        assert wait_until(lambda: cs.is_leader(), 10)
+        return cs
+
+    cs = boot()
+    try:
+        srv = cs.server
+        n = mock.node()
+        srv.node_register(n)
+        job = mock.job(id="dur3")
+        srv.job_register(job)
+        assert wait_until(
+            lambda: srv.state.allocs_by_job("default", "dur3"), 10
+        )
+        alloc = srv.state.allocs_by_job("default", "dur3")[0]
+        srv.secret_upsert(SecretEntry(path="d/s", items={"k": "v"}))
+        srv.services_register([
+            ServiceRegistration(
+                id="reg1", service_name="web", alloc_id=alloc.id
+            )
+        ])
+        srv.volume_register(Volume(
+            id="cv", name="cv", type="csi", plugin_id="hp",
+            external_id="ext-cv",
+        ))
+        srv.raft_apply(
+            "operator_config_upsert",
+            ("autopilot", {"CleanupDeadServers": False}),
+        )
+    finally:
+        cs.shutdown()
+
+    cs2 = boot()
+    try:
+        st = cs2.server.state
+        assert wait_until(
+            lambda: st.secret_by_path("default", "d/s") is not None, 10
+        )
+        assert st.secret_by_path("default", "d/s").items == {"k": "v"}
+        regs = st.service_registrations("default", "web")
+        assert [r.id for r in regs] == ["reg1"]
+        vol = st.volume_by_id("default", "cv")
+        assert vol is not None and vol.external_id == "ext-cv"
+        assert st.operator_config("autopilot") == {
+            "CleanupDeadServers": False
+        }
+    finally:
+        cs2.shutdown()
