@@ -114,6 +114,10 @@ class AgentConfig:
     # exec driver chroot map {host_src: dst_in_chroot} (reference:
     # client config chroot_env — operator-owned, never jobspec)
     chroot_env: dict = field(default_factory=dict)
+    # operator-registered host volumes: name -> {path, read_only}
+    # (reference: client config host_volume stanzas feed
+    # Node.HostVolumes for the scheduler's HostVolumeChecker)
+    host_volumes: dict = field(default_factory=dict)
     # external task-driver plugins: driver name -> "module:Class" factory
     # ref, launched out-of-process over the plugin fabric (reference:
     # the go-plugin catalog, plugins/serve.go + helper/pluginutils)
@@ -214,6 +218,7 @@ class Agent:
                 rpc,
                 driver_plugins=config.driver_plugins,
                 chroot_env=config.chroot_env,
+                host_volumes=config.host_volumes,
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,

@@ -189,6 +189,14 @@ def _load_agent_config(path: str):
             cfg.chroot_env = {
                 str(k): str(v) for k, v in ce.body.attrs().items()
             }
+        for hv in cb.body.blocks("host_volume"):
+            name = hv.labels[0] if hv.labels else ""
+            a2 = hv.body.attrs()
+            if name and a2.get("path"):
+                cfg.host_volumes[name] = {
+                    "path": str(a2["path"]),
+                    "read_only": bool(a2.get("read_only", False)),
+                }
     pb = body.block("ports")
     if pb is not None:
         pa = pb.body.attrs()
@@ -222,6 +230,15 @@ def _apply_config_dict(cfg, data: dict) -> None:
             cfg.client_enabled = v.get("enabled", True)
             cfg.client_servers = [_addr(s) for s in v.get("servers", [])]
             cfg.csi_plugins = dict(v.get("csi_plugins", {}))
+            cfg.chroot_env = dict(v.get("chroot_env", {}))
+            cfg.host_volumes = {
+                str(name): {
+                    "path": str(hv.get("path", "")),
+                    "read_only": bool(hv.get("read_only", False)),
+                }
+                for name, hv in (v.get("host_volumes") or {}).items()
+                if hv.get("path")
+            }
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)

@@ -99,6 +99,7 @@ class Client:
         csi_plugins: Optional[dict] = None,
         driver_plugins: Optional[dict] = None,  # name -> "module:Class"
         chroot_env: Optional[dict] = None,  # exec driver's chroot map
+        host_volumes: Optional[dict] = None,  # name -> {path, read_only}
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
@@ -147,6 +148,17 @@ class Client:
         # maps plugin_id -> builtin catalog name | "module:Class" ref.
         from .csimanager import CSIManager
 
+        # operator host volumes land on the node BEFORE the class hash
+        # (reference: client config host_volume → Node.HostVolumes)
+        if host_volumes:
+            from ..structs.structs import HostVolumeConfig
+
+            for name, hv in host_volumes.items():
+                self.node.host_volumes[name] = HostVolumeConfig(
+                    name=name,
+                    path=str(hv.get("path", "")),
+                    read_only=bool(hv.get("read_only", False)),
+                )
         self.csi_manager = CSIManager(data_dir, node_id=self.node.id)
         self.csi_manager.register_from_config(csi_plugins or {})
         # Task secrets-token derivation + renewal (reference
@@ -227,8 +239,17 @@ class Client:
             self._reverse.stop()
         self.endpoints.stop()
         if kill_allocs:
-            for ar in list(self.alloc_runners.values()):
+            runners = list(self.alloc_runners.values())
+            for ar in runners:
                 ar.destroy()
+            # destroy() only SIGNALS the task threads; wait for the
+            # kill→destroy path to actually run or the process exits
+            # with supervisors still alive (daemonized executors would
+            # linger forever after their tasks die). ONE shared deadline
+            # — a per-runner bound would multiply by the task count.
+            deadline = time.monotonic() + 10.0
+            for ar in runners:
+                ar.wait(timeout_s=max(0.0, deadline - time.monotonic()))
         self.vault_client.stop()
         self.csi_manager.shutdown()
         # out-of-process driver plugins die with us, not as orphans

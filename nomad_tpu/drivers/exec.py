@@ -166,6 +166,11 @@ class ExecDriver(Driver):
                 if not force:
                     raise DriverError("task still running")
                 self.stop_task(task_id, timeout_s=2)
+        except (ExecutorError, OSError):
+            pass
+        # ALWAYS attempt the supervisor shutdown — a failed status probe
+        # must not leave the daemonized supervisor listening forever
+        try:
             task.handle.shutdown()
         except (ExecutorError, OSError):
             pass

@@ -337,3 +337,63 @@ def test_autopilot_roundtrip(agent):
     assert (
         agent.server.autopilot_config()["CleanupDeadServers"] is False
     )
+
+
+def test_host_volume_client_config(tmp_path):
+    """client { host_volume "data" { path } } fingerprints onto the
+    node and a volume-mounting job schedules + links it (reference:
+    client config host_volume → HostVolumeChecker)."""
+    from nomad_tpu.cli.main import _load_agent_config
+    from nomad_tpu.structs.structs import VolumeMount, VolumeRequest
+
+    data = tmp_path / "shared"
+    data.mkdir()
+    cfgfile = tmp_path / "agent.hcl"
+    cfgfile.write_text(
+        'client {\n  enabled = true\n'
+        f'  host_volume "shared" {{ path = "{data}" }}\n}}\n'
+    )
+    cfg = _load_agent_config(str(cfgfile))
+    assert cfg.host_volumes == {
+        "shared": {"path": str(data), "read_only": False}
+    }
+    cfg.server_enabled = True
+    cfg.dev_mode = True
+    cfg.data_dir = str(tmp_path / "agentdata")
+    a = Agent(cfg)
+    a.start()
+    try:
+        assert a.client.wait_registered(10)
+        srv = a.server.server
+        node = srv.state.node_by_id(a.client.node.id)
+        assert "shared" in node.host_volumes
+        job = mock.job(id="hv-job")
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.volumes = {
+            "v": VolumeRequest(name="v", type="host", source="shared")
+        }
+        t = tg.tasks[0]
+        t.driver = "mock"
+        t.config = {}
+        t.volume_mounts = [VolumeMount(volume="v", destination="data")]
+        srv.job_register(job)
+        assert wait_until(
+            lambda: [
+                x
+                for x in srv.state.allocs_by_job("default", "hv-job")
+                if x.client_status == "running"
+            ],
+            15,
+        )
+        alloc = [
+            x
+            for x in srv.state.allocs_by_job("default", "hv-job")
+            if x.client_status == "running"
+        ][0]
+        runner = a.client.alloc_runners[alloc.id]
+        link = os.path.join(runner.alloc_dir, t.name, "data")
+        assert wait_until(lambda: os.path.islink(link), 5)
+        assert os.path.realpath(link) == os.path.realpath(str(data))
+    finally:
+        a.shutdown()
