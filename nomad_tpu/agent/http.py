@@ -409,7 +409,10 @@ class HTTPAgentServer:
 
         def volumes_list(p, q, body, tok):
             ns = q.get("namespace", ["default"])[0]
-            return self.rpc_region("Volume.list", {"namespace": ns})
+            return self.rpc_region(
+                "Volume.list",
+                {"namespace": None if ns == "*" else ns},
+            )
 
         def volume_register(p, q, body, tok):
             vol = codec.from_wire(body["Volume"])

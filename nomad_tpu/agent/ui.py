@@ -81,7 +81,8 @@ const $ = (s) => document.querySelector(s);
 const NAV = [
   ["jobs", "Jobs"], ["nodes", "Clients"], ["allocs", "Allocations"],
   ["evals", "Evaluations"], ["services", "Services"],
-  ["topology", "Topology"], ["servers", "Servers"],
+  ["storage", "Storage"], ["topology", "Topology"],
+  ["servers", "Servers"],
 ];
 $("#nav").innerHTML = NAV.map(([r, t]) =>
   `<a href="#/${r}" data-route="${r}">${t}</a>`).join("");
@@ -275,6 +276,32 @@ const views = {
         `${p.controllers_healthy}/${p.controllers_expected}`,
         `${p.nodes_healthy}/${p.nodes_expected}`,
       ]));
+    return html;
+  },
+
+  async storage() {
+    const [vols, plugins, namespaces] = await Promise.all([
+      api("/v1/volumes?namespace=*").catch(() => []),
+      api("/v1/plugins"),
+      api("/v1/namespaces"),
+    ]);
+    let html = `<h1>Storage</h1><h2>Volumes</h2>` + table(
+      ["ID", "Namespace", "Type", "Plugin", "Access Mode", "Claims"],
+      (vols || []).map((v) => [
+        esc(v.id), esc(v.namespace), esc(v.type),
+        esc(v.plugin_id || "-"), esc(v.access_mode),
+        Object.keys(v.claims || {}).length,
+      ]));
+    html += `<h2>CSI Plugins</h2>` + table(
+      ["ID", "Controllers Healthy", "Nodes Healthy"],
+      plugins.map((p) => [
+        esc(p.id),
+        `${p.controllers_healthy}/${p.controllers_expected}`,
+        `${p.nodes_healthy}/${p.nodes_expected}`,
+      ]));
+    html += `<h2>Namespaces</h2>` + table(
+      ["Name", "Description"],
+      namespaces.map((n) => [esc(n.name), esc(n.description || "-")]));
     return html;
   },
 
