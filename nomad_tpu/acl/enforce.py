@@ -84,6 +84,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("PUT", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
     ("POST", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
     ("DELETE", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
+    # scaling policies read with namespace read (reference
+    # scaling_endpoint.go ListPolicies: read-job or list-scaling-policies)
+    ("GET", re.compile(r"^/v1/scaling/policies$"), CAP_READ_JOB),
+    ("GET", re.compile(r"^/v1/scaling/policy/.*$"), CAP_READ_JOB),
     # native service discovery (reference
     # service_registration_endpoint.go: read-job to list, submit-job to
     # delete a registration)

@@ -620,6 +620,25 @@ class HTTPAgentServer:
             service_delete,
         )
 
+        def scaling_policies(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            return self.rpc_region(
+                "Scaling.list_policies",
+                {"namespace": None if ns == "*" else ns},
+            )
+
+        def scaling_policy_get(p, q, body, tok):
+            pol = self.rpc_region(
+                "Scaling.get_policy", {"policy_id": p["id"]}
+            )
+            if pol is None:
+                raise HTTPError(404, f"scaling policy {p['id']} not found")
+            self._ns_guard(tok, pol.namespace, "read-job")
+            return pol
+
+        route("GET", "/v1/scaling/policies", scaling_policies)
+        route("GET", "/v1/scaling/policy/(?P<id>.+)", scaling_policy_get)
+
         def plugins_list(p, q, body, tok):
             plugins = self.rpc_region("Volume.plugins", {})
             return sorted(plugins.values(), key=lambda x: x["id"])

@@ -123,6 +123,15 @@ function table(headers, rows) {
     `<tr>${r.map((c) => `<td>${c}</td>`).join("")}</tr>`).join("")
   }</tbody></table>`;
 }
+function pluginsTable(plugins) {
+  return table(
+    ["ID", "Controllers Healthy", "Nodes Healthy"],
+    plugins.map((p) => [
+      esc(p.id),
+      `${p.controllers_healthy}/${p.controllers_expected}`,
+      `${p.nodes_healthy}/${p.nodes_expected}`,
+    ]));
+}
 function kv(pairs) {
   return `<dl class="kv">${pairs.map(([k, v]) =>
     `<dt>${esc(k)}</dt><dd>${v}</dd>`).join("")}</dl>`;
@@ -260,23 +269,13 @@ const views = {
   },
 
   async services() {
-    const [svcs, plugins] = await Promise.all([
-      api("/v1/services?namespace=*"), api("/v1/plugins"),
-    ]);
-    let html = `<h1>Services</h1>` + table(
+    const svcs = await api("/v1/services?namespace=*");
+    return `<h1>Services</h1>` + table(
       ["Name", "Namespace", "Tags", "Instances"],
       svcs.map((s) => [
         esc(s.service_name), esc(s.namespace),
         esc((s.tags || []).join(", ")), s.instances,
       ]));
-    html += `<h2>CSI Plugins</h2>` + table(
-      ["ID", "Controllers Healthy", "Nodes Healthy"],
-      plugins.map((p) => [
-        esc(p.id),
-        `${p.controllers_healthy}/${p.controllers_expected}`,
-        `${p.nodes_healthy}/${p.nodes_expected}`,
-      ]));
-    return html;
   },
 
   async storage() {
@@ -292,13 +291,7 @@ const views = {
         esc(v.plugin_id || "-"), esc(v.access_mode),
         Object.keys(v.claims || {}).length,
       ]));
-    html += `<h2>CSI Plugins</h2>` + table(
-      ["ID", "Controllers Healthy", "Nodes Healthy"],
-      plugins.map((p) => [
-        esc(p.id),
-        `${p.controllers_healthy}/${p.controllers_expected}`,
-        `${p.nodes_healthy}/${p.nodes_expected}`,
-      ]));
+    html += `<h2>CSI Plugins</h2>` + pluginsTable(plugins);
     html += `<h2>Namespaces</h2>` + table(
       ["Name", "Description"],
       namespaces.map((n) => [esc(n.name), esc(n.description || "-")]));

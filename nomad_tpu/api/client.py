@@ -56,6 +56,7 @@ class NomadClient:
         self.namespaces = Namespaces(self)
         self.search = Search(self)
         self.system = SystemAPI(self)
+        self.scaling = Scaling(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -404,6 +405,19 @@ class ExecSession:
             pass
         self._session.close()
         self._pool.shutdown()
+
+
+class Scaling(_Resource):
+    """Reference: api/scaling.go."""
+
+    def list_policies(self, namespace: Optional[str] = None):
+        return self.c.get(
+            "/v1/scaling/policies",
+            params={"namespace": namespace or self.c.namespace},
+        )
+
+    def get_policy(self, policy_id: str):
+        return self.c.get(f"/v1/scaling/policy/{policy_id}")
 
 
 class SystemAPI(_Resource):

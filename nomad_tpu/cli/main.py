@@ -1085,6 +1085,44 @@ def cmd_alloc_stop(args) -> int:
     return 0
 
 
+def cmd_scaling_policy_list(args) -> int:
+    """Reference: command/scaling_policy_list.go."""
+    api = _client(args)
+    pols = api.scaling.list_policies(namespace=args.namespace)
+    if not pols:
+        print("No scaling policies")
+        return 0
+    print(
+        _fmt_table(
+            [
+                [p.id, p.job_id, p.group, str(p.min), str(p.max),
+                 str(p.enabled)]
+                for p in pols
+            ],
+            header=["ID", "Job", "Group", "Min", "Max", "Enabled"],
+        )
+    )
+    return 0
+
+
+def cmd_scaling_policy_info(args) -> int:
+    """Reference: command/scaling_policy_info.go."""
+    api = _client(args)
+    p = api.scaling.get_policy(args.policy_id)
+    print(f"ID      = {p.id}")
+    print(f"Job     = {p.job_id}")
+    print(f"Group   = {p.group}")
+    print(f"Type    = {p.type}")
+    print(f"Min     = {p.min}")
+    print(f"Max     = {p.max}")
+    print(f"Enabled = {p.enabled}")
+    if p.policy:
+        print("Policy:")
+        for k in sorted(p.policy):
+            print(f"  {k} = {p.policy[k]}")
+    return 0
+
+
 def cmd_job_eval(args) -> int:
     """Reference: command/job_eval.go — force a new evaluation."""
     api = _client(args)
@@ -1918,6 +1956,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     uic = sub.add_parser("ui", help="open the web UI")
     uic.set_defaults(fn=cmd_ui)
+
+    scal = sub.add_parser("scaling", help="scaling policy commands")
+    scalsub = scal.add_subparsers(dest="subcmd")
+    scp = scalsub.add_parser("policy")
+    scpsub = scp.add_subparsers(dest="subsubcmd")
+    scpl = scpsub.add_parser("list")
+    scpl.add_argument("-namespace", default="default")
+    scpl.set_defaults(fn=cmd_scaling_policy_list)
+    scpi = scpsub.add_parser("info")
+    scpi.add_argument("policy_id")
+    scpi.set_defaults(fn=cmd_scaling_policy_info)
 
     svc = sub.add_parser("service", help="service discovery commands")
     svcsub = svc.add_subparsers(dest="subcmd")

@@ -23,6 +23,7 @@ from ..structs.structs import (
     ReschedulePolicy,
     Resources,
     RestartPolicy,
+    ScalingPolicy,
     Service,
     Spread,
     SpreadTarget,
@@ -141,6 +142,23 @@ def _group(b: Block, job: Job) -> TaskGroup:
             access_mode=va.get("access_mode", ""),
             attachment_mode=va.get("attachment_mode", ""),
             per_alloc=bool(va.get("per_alloc", False)),
+        )
+    scb = b.body.block("scaling")
+    if scb is not None:
+        sca = scb.body.attrs()
+        pol = {}
+        pb2 = scb.body.block("policy")
+        if pb2 is not None:
+            # the policy is OPAQUE autoscaler config: round-trip nested
+            # blocks (check/strategy stanzas) as nested dicts, not just
+            # top-level attrs
+            pol = _config_dict(pb2.body)
+        tg.scaling = ScalingPolicy(
+            type=sca.get("type", "horizontal"),
+            min=int(sca.get("min", 0)),
+            max=int(sca.get("max", 0)),
+            enabled=bool(sca.get("enabled", True)),
+            policy=pol,
         )
     for sb2 in b.body.blocks("service"):
         tg.services.append(_service(sb2))

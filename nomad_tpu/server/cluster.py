@@ -266,6 +266,12 @@ class JobEndpoint(_Forwarder):
         if job is None:
             return None
         allocs = st.allocs_by_job(args["namespace"], args["job_id"])
+        policies = {
+            p.group: p
+            for p in st.scaling_policies_by_job(
+                args["namespace"], args["job_id"]
+            )
+        }
         groups = {}
         for tg in job.task_groups:
             live = [
@@ -273,13 +279,20 @@ class JobEndpoint(_Forwarder):
                 for a in allocs
                 if a.task_group == tg.name and not a.terminal_status()
             ]
-            groups[tg.name] = {
+            entry = {
                 "Desired": tg.count,
                 "Running": sum(
                     1 for a in live if a.client_status == "running"
                 ),
                 "Placed": len(live),
             }
+            pol = policies.get(tg.name)
+            if pol is not None:
+                entry["ScalingPolicy"] = {
+                    "ID": pol.id, "Min": pol.min, "Max": pol.max,
+                    "Enabled": pol.enabled,
+                }
+            groups[tg.name] = entry
         return {
             "JobID": job.id,
             "JobStopped": job.stop,
@@ -807,6 +820,20 @@ class ACLEndpoint(_Forwarder):
         return out
 
 
+class ScalingEndpoint(_Forwarder):
+    """Reference: nomad/scaling_endpoint.go."""
+
+    def list_policies(self, args):
+        return self.cs.server.state.scaling_policies(
+            args.get("namespace")
+        )
+
+    def get_policy(self, args):
+        return self.cs.server.state.scaling_policy_by_id(
+            args["policy_id"]
+        )
+
+
 class SystemEndpoint(_Forwarder):
     """Reference: nomad/system_endpoint.go."""
 
@@ -929,6 +956,7 @@ class ClusterServer:
             ("ACL", ACLEndpoint(self)),
             ("Status", StatusEndpoint(self)),
             ("System", SystemEndpoint(self)),
+            ("Scaling", ScalingEndpoint(self)),
             ("Operator", OperatorEndpoint(self)),
         ):
             self.rpc.register(name, ep)

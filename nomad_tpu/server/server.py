@@ -319,6 +319,21 @@ class Server:
                     self._check_vault_policies(
                         list(task.vault.get("policies", []))
                     )
+            # scaling stanza sanity at SUBMIT time (reference
+            # ScalingPolicy.Validate): a min>max or out-of-bounds count
+            # would make the group permanently unscalable
+            sc = tg.scaling
+            if sc is not None and sc.enabled:
+                if sc.min < 0 or (sc.max and sc.max < sc.min):
+                    raise ValueError(
+                        f"group {tg.name!r}: scaling bounds invalid "
+                        f"(min {sc.min}, max {sc.max})"
+                    )
+                if tg.count < sc.min or (sc.max and tg.count > sc.max):
+                    raise ValueError(
+                        f"group {tg.name!r}: count {tg.count} outside "
+                        f"scaling bounds [{sc.min}, {sc.max}]"
+                    )
         self._ensure_namespace(job.namespace)
         if job.is_periodic():
             # A malformed cron spec must be rejected at the API, not fire
@@ -581,6 +596,13 @@ class Server:
             raise ValueError(
                 f"task group {group!r} does not exist in job {job_id}"
             )
+        if tg.scaling is not None and tg.scaling.enabled:
+            lo, hi = tg.scaling.min, tg.scaling.max
+            if count < lo or (hi and count > hi):
+                raise ValueError(
+                    f"count {count} outside scaling bounds [{lo}, {hi}] "
+                    f"for group {group!r}"
+                )
         tg.count = count
         return self.job_register(job)
 

@@ -70,6 +70,7 @@ TABLE_NAMESPACES = "namespaces"
 TABLE_SERVICES = "services"
 TABLE_SECRETS = "secrets"
 TABLE_OPERATOR = "operator_config"
+TABLE_SCALING_POLICIES = "scaling_policy"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -85,6 +86,7 @@ ALL_TABLES = (
     TABLE_SERVICES,
     TABLE_SECRETS,
     TABLE_OPERATOR,
+    TABLE_SCALING_POLICIES,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -335,6 +337,26 @@ class _ReadMixin:
 
     def service_registration_by_id(self, reg_id: str):
         return self._tables[TABLE_SERVICES].get(reg_id)
+
+    # scaling policies -------------------------------------------------
+    def scaling_policies(self, namespace: Optional[str] = None) -> list:
+        out = [
+            p
+            for p in self._tables[TABLE_SCALING_POLICIES].values()
+            if namespace is None or p.namespace == namespace
+        ]
+        out.sort(key=lambda p: (p.namespace, p.job_id, p.group))
+        return out
+
+    def scaling_policy_by_id(self, policy_id: str):
+        return self._tables[TABLE_SCALING_POLICIES].get(policy_id)
+
+    def scaling_policies_by_job(self, namespace: str, job_id: str) -> list:
+        return [
+            p
+            for p in self._tables[TABLE_SCALING_POLICIES].values()
+            if p.namespace == namespace and p.job_id == job_id
+        ]
 
     # operator config --------------------------------------------------
     def operator_config(self, key: str):
@@ -810,6 +832,7 @@ class StateStore(_ReadMixin):
     def upsert_job(self, index: int, job: Job, keep_version: bool = False) -> None:
         with self._lock:
             self._upsert_job_txn(index, job, keep_version)
+            self._sync_scaling_policies_txn(index, job)
             self._stamp(index, TABLE_JOBS, TABLE_JOB_VERSIONS, TABLE_JOB_SUMMARIES)
             self._publish(
                 index,
@@ -871,6 +894,40 @@ class StateStore(_ReadMixin):
             )
         summary.modify_index = index
         st[job.ns_id()] = summary
+
+    def _sync_scaling_policies_txn(self, index: int, job) -> None:
+        """Keep the scaling-policy table in lockstep with the job's
+        scaling stanzas (reference: UpsertJob upserts/deletes policies
+        for the job's groups, state_store.go updateJobScalingPolicies).
+        Deterministic ids (ns/job/group) so re-registration updates in
+        place."""
+        t = self._wtable(TABLE_SCALING_POLICIES)
+        wanted: dict[str, object] = {}
+        for tg in job.task_groups:
+            if tg.scaling is None:
+                continue
+            pol = tg.scaling.copy()
+            pol.id = f"{job.namespace}/{job.id}/{tg.name}"
+            pol.namespace = job.namespace
+            pol.job_id = job.id
+            pol.group = tg.name
+            existing = t.get(pol.id)
+            pol.create_index = existing.create_index if existing else index
+            pol.modify_index = index
+            wanted[pol.id] = pol
+        stale = [
+            pid
+            for pid, p in t.items()
+            if p.namespace == job.namespace
+            and p.job_id == job.id
+            and pid not in wanted
+        ]
+        changed = bool(wanted) or bool(stale)
+        for pid in stale:
+            del t[pid]
+        t.update(wanted)
+        if changed:
+            self._stamp(index, TABLE_SCALING_POLICIES)
 
     def reconcile_job_summaries(self, index: int) -> int:
         """Rebuild every job summary from the alloc table (reference
@@ -950,7 +1007,17 @@ class StateStore(_ReadMixin):
                 del vt[k]
             st = self._wtable(TABLE_JOB_SUMMARIES)
             st.pop((namespace, job_id), None)
-            self._stamp(index, TABLE_JOBS, TABLE_JOB_VERSIONS, TABLE_JOB_SUMMARIES)
+            sp = self._wtable(TABLE_SCALING_POLICIES)
+            for pid in [
+                pid
+                for pid, p in sp.items()
+                if p.namespace == namespace and p.job_id == job_id
+            ]:
+                del sp[pid]
+            self._stamp(
+                index, TABLE_JOBS, TABLE_JOB_VERSIONS,
+                TABLE_JOB_SUMMARIES, TABLE_SCALING_POLICIES,
+            )
             if job is not None:
                 self._publish(index, TABLE_JOBS, [job], "JobDeregistered")
 

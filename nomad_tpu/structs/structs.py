@@ -595,6 +595,30 @@ class Service:
 
 
 @dataclass(slots=True)
+class ScalingPolicy:
+    """A group's scaling bounds + opaque autoscaler policy (reference:
+    structs.go ScalingPolicy :5397 — stored and served by the cluster;
+    the autoscaler itself is an external consumer)."""
+
+    id: str = ""
+    type: str = "horizontal"
+    namespace: str = DEFAULT_NAMESPACE
+    job_id: str = ""
+    group: str = ""
+    min: int = 0
+    max: int = 0
+    enabled: bool = True
+    policy: dict[str, Any] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "ScalingPolicy":
+        c = dataclasses.replace(self)
+        c.policy = dict(self.policy)
+        return c
+
+
+@dataclass(slots=True)
 class SecretEntry:
     """A namespaced secret document in the cluster's embedded secrets
     store (the tpu-native stand-in for the reference's external Vault:
@@ -793,6 +817,9 @@ class TaskGroup:
     volumes: dict[str, VolumeRequest] = field(default_factory=dict)
     ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
     meta: dict[str, str] = field(default_factory=dict)
+    # scaling stanza (reference TaskGroup.Scaling): bounds + opaque
+    # autoscaler policy; None = group not scalable
+    scaling: Optional[ScalingPolicy] = None
     stop_after_client_disconnect_s: float = 0.0
     shutdown_delay_s: float = 0.0
 
@@ -815,6 +842,7 @@ class TaskGroup:
             volumes={k: v.copy() for k, v in self.volumes.items()},
             ephemeral_disk=self.ephemeral_disk.copy(),
             meta=dict(self.meta),
+            scaling=self.scaling.copy() if self.scaling else None,
             stop_after_client_disconnect_s=self.stop_after_client_disconnect_s,
             shutdown_delay_s=self.shutdown_delay_s,
         )

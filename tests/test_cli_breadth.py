@@ -397,3 +397,45 @@ def test_host_volume_client_config(tmp_path):
         assert os.path.realpath(link) == os.path.realpath(str(data))
     finally:
         a.shutdown()
+
+
+def test_scaling_policies(agent, tmp_path):
+    """Group scaling stanzas store policies; job scale enforces the
+    bounds; /v1/scaling surfaces them (reference scaling_endpoint.go)."""
+    from nomad_tpu.jobspec import parse_job
+
+    src = """
+job "scaly" {
+  group "web" {
+    count = 2
+    scaling {
+      min     = 1
+      max     = 4
+      policy { cooldown = "1m" }
+    }
+    task "t" { driver = "mock"
+      config {} }
+  }
+}
+"""
+    job = parse_job(src)
+    srv = agent.server.server
+    srv.job_register(job)
+    api = _api(agent)
+    pols = api.scaling.list_policies()
+    assert len(pols) == 1
+    pol = pols[0]
+    assert (pol.min, pol.max, pol.group) == (1, 4, "web")
+    got = api.scaling.get_policy(pol.id)
+    assert got.policy.get("cooldown") == "1m"
+    # bounds enforced on scale
+    api.jobs.scale("scaly", "web", 3)  # in range
+    from nomad_tpu.api.client import APIError
+
+    with pytest.raises(APIError):
+        api.jobs.scale("scaly", "web", 9)
+    with pytest.raises(APIError):
+        api.jobs.scale("scaly", "web", 0)
+    # job purge drops the policy
+    srv.job_deregister("default", "scaly", purge=True)
+    assert api.scaling.list_policies() == []
