@@ -73,6 +73,7 @@ def test_full_stack_is_inversion_free(tmp_path):
 
         from nomad_tpu.client import Client, ServerRPC
         from nomad_tpu.server import Server
+        from nomad_tpu.structs.structs import SecretEntry, Service, Volume
         from nomad_tpu import mock
 
         server = Server(num_workers=2)
@@ -80,10 +81,16 @@ def test_full_stack_is_inversion_free(tmp_path):
         client = Client(ServerRPC(server), data_dir=%r)
         client.start()
         assert client.wait_registered(15)
+        # exercise the round-3 subsystems' locks too: secrets store,
+        # service registration + check watcher, volume claims
+        server.secret_upsert(SecretEntry(path="race/s", items={"k": "v"}))
+        server.volume_register(Volume(id="race-vol", name="race-vol",
+                                      type="host"))
         job = mock.job(id="race-e2e")
         job.task_groups[0].count = 2
         t = job.task_groups[0].tasks[0]
         t.driver = "mock"; t.config = {}
+        t.services = [Service(name="race-svc", port_label="9999")]
         server.job_register(job)
         deadline = time.time() + 20
         while time.time() < deadline:
