@@ -498,6 +498,7 @@ class TaskRunner:
             config=interpolate(dict(self.task.config), env),
             resources_cpu=self.task.resources.cpu,
             resources_memory_mb=self.task.resources.memory_mb,
+            resources_memory_max_mb=self.task.resources.memory_max_mb,
             task_dir=task_dir.dir,
             stdout_path=self.alloc_dir.stdout_path(self.task.name),
             stderr_path=self.alloc_dir.stderr_path(self.task.name),

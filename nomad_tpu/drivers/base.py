@@ -41,6 +41,8 @@ class TaskConfig:
     config: dict[str, Any] = field(default_factory=dict)  # driver-specific
     resources_cpu: int = 0
     resources_memory_mb: int = 0
+    # oversubscription hard cap (0 = cap at the reserve)
+    resources_memory_max_mb: int = 0
     task_dir: str = ""
     stdout_path: str = ""
     stderr_path: str = ""

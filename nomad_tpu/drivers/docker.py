@@ -434,7 +434,9 @@ class DockerDriver(Driver):
             binds.append(f"{m['host_path']}:{dest}{mode}")
         host_config: dict[str, Any] = {
             "Binds": binds,
-            "Memory": int(cfg.resources_memory_mb) * 1024 * 1024,
+            "Memory": int(
+                cfg.resources_memory_max_mb or cfg.resources_memory_mb
+            ) * 1024 * 1024,
             "CpuShares": int(cfg.resources_cpu),
         }
         if conf.get("network_mode"):

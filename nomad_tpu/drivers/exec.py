@@ -113,7 +113,9 @@ class ExecDriver(Driver):
                 chroot=chroot,
                 user=cfg.user,
                 cgroup=cgroup,
-                memory_max_bytes=cfg.resources_memory_mb * 1024 * 1024,
+                memory_max_bytes=(
+                    cfg.resources_memory_max_mb or cfg.resources_memory_mb
+                ) * 1024 * 1024,
                 # cgroup v2 cpu.weight range 1..10000; map MHz shares
                 cpu_weight=min(10000, max(1, cfg.resources_cpu // 10)) if cfg.resources_cpu else 0,
             )

@@ -80,6 +80,7 @@ class JavaDriver(RawExecDriver):
             config={"command": argv[0], "args": argv[1:]},
             resources_cpu=cfg.resources_cpu,
             resources_memory_mb=cfg.resources_memory_mb,
+            resources_memory_max_mb=cfg.resources_memory_max_mb,
             task_dir=cfg.task_dir,
             stdout_path=cfg.stdout_path,
             stderr_path=cfg.stderr_path,

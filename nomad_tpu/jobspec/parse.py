@@ -273,6 +273,7 @@ def _resources(b: Block) -> Resources:
     res = Resources(
         cpu=int(a.get("cpu", 100)),
         memory_mb=int(a.get("memory", 300)),
+        memory_max_mb=int(a.get("memory_max", 0)),
         disk_mb=int(a.get("disk", 0)),
         cores=int(a.get("cores", 0)),
     )

@@ -310,6 +310,13 @@ class Server:
         job = job.copy()
         job.canonicalize()
         job.validate()
+        # memory oversubscription gate (reference: Register strips
+        # MemoryMaxMB unless the scheduler config enables it, so a
+        # disabled cluster never hands excess caps to clients)
+        if not self.scheduler_config.memory_oversubscription:
+            for tg in job.task_groups:
+                for task in tg.tasks:
+                    task.resources.memory_max_mb = 0
         # Fail fast on vault policies outside the operator allowlist
         # (reference job_endpoint.go Register → validateJob vault check);
         # derive_task_token re-checks at mint time.

@@ -221,6 +221,10 @@ class Resources:
 
     cpu: int = 100
     memory_mb: int = 300
+    # memory oversubscription (reference MemoryMaxMB, 1.1+): the cgroup
+    # hard cap when the operator enables oversubscription; scheduling
+    # still packs on memory_mb (the reserve). 0 = no excess.
+    memory_max_mb: int = 0
     disk_mb: int = 0
     networks: list[NetworkResource] = field(default_factory=list)
     devices: list[RequestedDevice] = field(default_factory=list)
@@ -230,6 +234,7 @@ class Resources:
         return Resources(
             cpu=self.cpu,
             memory_mb=self.memory_mb,
+            memory_max_mb=self.memory_max_mb,
             disk_mb=self.disk_mb,
             networks=[n.copy() for n in self.networks],
             devices=[d.copy() for d in self.devices],
@@ -259,6 +264,10 @@ class Resources:
             raise ValueError("resources: cpu must be >= 0")
         if self.memory_mb < 0:
             raise ValueError("resources: memory must be >= 0")
+        if self.memory_max_mb and self.memory_max_mb < self.memory_mb:
+            raise ValueError(
+                "resources: memory_max must be >= memory (the reserve)"
+            )
 
 
 @dataclass(slots=True)

@@ -439,3 +439,45 @@ job "scaly" {
     # job purge drops the policy
     srv.job_deregister("default", "scaly", purge=True)
     assert api.scaling.list_policies() == []
+
+
+def test_memory_oversubscription_gate(agent, tmp_path):
+    """memory_max is honored only when the operator enables
+    oversubscription; otherwise it is stripped at registration
+    (reference: Register gates MemoryMaxMB on SchedulerConfiguration)."""
+    from nomad_tpu.jobspec import parse_job
+
+    src = """
+job "oversub" {
+  group "g" {
+    task "t" {
+      driver = "mock"
+      config {}
+      resources { cpu = 100  memory = 128  memory_max = 512 }
+    }
+  }
+}
+"""
+    srv = agent.server.server
+    job = parse_job(src)
+    assert job.task_groups[0].tasks[0].resources.memory_max_mb == 512
+    # disabled (default): stripped
+    srv.job_register(job)
+    stored = srv.state.job_by_id("default", "oversub")
+    assert stored.task_groups[0].tasks[0].resources.memory_max_mb == 0
+    # enabled: preserved
+    api = _api(agent)
+    api.operator.scheduler_set_configuration(
+        {"MemoryOversubscriptionEnabled": True}
+    )
+    job2 = parse_job(src)
+    job2.id = job2.name = "oversub2"
+    srv.job_register(job2)
+    stored = srv.state.job_by_id("default", "oversub2")
+    assert stored.task_groups[0].tasks[0].resources.memory_max_mb == 512
+    # invalid: max below reserve rejected
+    bad = parse_job(src)
+    bad.id = bad.name = "oversub3"
+    bad.task_groups[0].tasks[0].resources.memory_max_mb = 64
+    with pytest.raises(ValueError, match="memory_max"):
+        srv.job_register(bad)
