@@ -118,6 +118,12 @@ class AgentConfig:
     # (reference: client config host_volume stanzas feed
     # Node.HostVolumes for the scheduler's HostVolumeChecker)
     host_volumes: dict = field(default_factory=dict)
+    # static node metadata (reference: client config meta — constraint
+    # and spread targets)
+    node_meta: dict = field(default_factory=dict)
+    # capacity carved out for the OS/agent (reference: client config
+    # reserved stanza — subtracted from what the scheduler may pack)
+    reserved: dict = field(default_factory=dict)
     # external task-driver plugins: driver name -> "module:Class" factory
     # ref, launched out-of-process over the plugin fabric (reference:
     # the go-plugin catalog, plugins/serve.go + helper/pluginutils)
@@ -219,6 +225,8 @@ class Agent:
                 driver_plugins=config.driver_plugins,
                 chroot_env=config.chroot_env,
                 host_volumes=config.host_volumes,
+                node_meta=config.node_meta,
+                reserved=config.reserved,
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,

@@ -189,6 +189,19 @@ def _load_agent_config(path: str):
             cfg.chroot_env = {
                 str(k): str(v) for k, v in ce.body.attrs().items()
             }
+        mb2 = cb.body.block("meta")
+        if mb2 is not None:
+            cfg.node_meta = {
+                str(k): str(v) for k, v in mb2.body.attrs().items()
+            }
+        rb2 = cb.body.block("reserved")
+        if rb2 is not None:
+            ra = rb2.body.attrs()
+            cfg.reserved = {
+                "cpu": int(ra.get("cpu", 0)),
+                "memory": int(ra.get("memory", 0)),
+                "disk": int(ra.get("disk", 0)),
+            }
         for hv in cb.body.blocks("host_volume"):
             name = hv.labels[0] if hv.labels else ""
             a2 = hv.body.attrs()
@@ -239,6 +252,15 @@ def _apply_config_dict(cfg, data: dict) -> None:
                 for name, hv in (v.get("host_volumes") or {}).items()
                 if hv.get("path")
             }
+            cfg.node_meta = {
+                str(k): str(vv) for k, vv in (v.get("meta") or {}).items()
+            }
+            if v.get("reserved"):
+                cfg.reserved = {
+                    "cpu": int(v["reserved"].get("cpu", 0)),
+                    "memory": int(v["reserved"].get("memory", 0)),
+                    "disk": int(v["reserved"].get("disk", 0)),
+                }
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)

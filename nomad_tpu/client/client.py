@@ -100,6 +100,8 @@ class Client:
         driver_plugins: Optional[dict] = None,  # name -> "module:Class"
         chroot_env: Optional[dict] = None,  # exec driver's chroot map
         host_volumes: Optional[dict] = None,  # name -> {path, read_only}
+        node_meta: Optional[dict] = None,  # static node metadata
+        reserved: Optional[dict] = None,  # {cpu, memory, disk} carve-out
     ) -> None:
         self.rpc = rpc
         self.data_dir = data_dir
@@ -148,6 +150,16 @@ class Client:
         # maps plugin_id -> builtin catalog name | "module:Class" ref.
         from .csimanager import CSIManager
 
+        # operator meta + reserved capacity land on the node BEFORE the
+        # class hash (reference: client config meta/reserved stanzas)
+        if node_meta:
+            self.node.meta.update(
+                {str(k): str(v) for k, v in node_meta.items()}
+            )
+        if reserved:
+            self.node.reserved.cpu = int(reserved.get("cpu", 0))
+            self.node.reserved.memory_mb = int(reserved.get("memory", 0))
+            self.node.reserved.disk_mb = int(reserved.get("disk", 0))
         # operator host volumes land on the node BEFORE the class hash
         # (reference: client config host_volume → Node.HostVolumes)
         if host_volumes:

@@ -39,6 +39,10 @@ def build_env(
         "NOMAD_CPU_LIMIT": str(task.resources.cpu),
         "NOMAD_MEMORY_LIMIT": str(task.resources.memory_mb),
     }
+    # oversubscription cap: set only when memory_max survived the
+    # scheduler-config gate (reference NOMAD_MEMORY_MAX_LIMIT)
+    if task.resources.memory_max_mb:
+        env["NOMAD_MEMORY_MAX_LIMIT"] = str(task.resources.memory_max_mb)
     if alloc_dir:
         env["NOMAD_ALLOC_DIR"] = alloc_dir
     if task_dir:

@@ -305,18 +305,22 @@ class Server:
 
     # -- job endpoint --------------------------------------------------
 
+    def apply_memory_oversubscription_gate(self, job: Job) -> None:
+        """Strip memory_max unless the scheduler config enables it
+        (reference: Register gates MemoryMaxMB) — register AND plan
+        must apply the same gate or plan diffs lie about destructive
+        updates."""
+        if not self.scheduler_config.memory_oversubscription:
+            for tg in job.task_groups:
+                for task in tg.tasks:
+                    task.resources.memory_max_mb = 0
+
     def job_register(self, job: Job) -> str:
         """Returns the created eval id (reference job_endpoint.go:80)."""
         job = job.copy()
         job.canonicalize()
         job.validate()
-        # memory oversubscription gate (reference: Register strips
-        # MemoryMaxMB unless the scheduler config enables it, so a
-        # disabled cluster never hands excess caps to clients)
-        if not self.scheduler_config.memory_oversubscription:
-            for tg in job.task_groups:
-                for task in tg.tasks:
-                    task.resources.memory_max_mb = 0
+        self.apply_memory_oversubscription_gate(job)
         # Fail fast on vault policies outside the operator allowlist
         # (reference job_endpoint.go Register → validateJob vault check);
         # derive_task_token re-checks at mint time.
@@ -648,6 +652,10 @@ class Server:
         (reference job_endpoint.go:521 + scheduler/annotate.go)."""
         from .job_plan import plan_job
 
+        job = job.copy()
+        # same gate register applies — or the plan would diff a
+        # memory_max the register is about to strip
+        self.apply_memory_oversubscription_gate(job)
         return plan_job(self.state, job, diff, self.scheduler_config)
 
     def job_deregister(self, namespace: str, job_id: str, purge: bool = False) -> str:

@@ -481,3 +481,64 @@ job "oversub" {
     bad.task_groups[0].tasks[0].resources.memory_max_mb = 64
     with pytest.raises(ValueError, match="memory_max"):
         srv.job_register(bad)
+
+
+def test_client_meta_and_reserved_config(tmp_path):
+    """client { meta {} reserved {} } land on the node: meta is a
+    constraint target, reserved capacity is withheld from packing."""
+    from nomad_tpu.cli.main import _load_agent_config
+    from nomad_tpu.structs import Constraint
+
+    cfgfile = tmp_path / "agent.hcl"
+    cfgfile.write_text(
+        'client {\n  enabled = true\n'
+        '  meta { rack = "r9" }\n'
+        '  reserved { cpu = 500  memory = 256 }\n}\n'
+    )
+    cfg = _load_agent_config(str(cfgfile))
+    assert cfg.node_meta == {"rack": "r9"}
+    assert cfg.reserved["cpu"] == 500
+    cfg.server_enabled = True
+    cfg.dev_mode = True
+    cfg.data_dir = str(tmp_path / "data")
+    a = Agent(cfg)
+    a.start()
+    try:
+        assert a.client.wait_registered(10)
+        srv = a.server.server
+        node = srv.state.node_by_id(a.client.node.id)
+        assert node.meta["rack"] == "r9"
+        assert node.reserved.cpu == 500
+        # a job constrained to the configured meta places
+        job = mock.job(id="meta-match")
+        job.constraints.append(Constraint("${meta.rack}", "r9", "="))
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "mock"
+        tg.tasks[0].config = {}
+        srv.job_register(job)
+        assert wait_until(
+            lambda: [
+                x
+                for x in srv.state.allocs_by_job("default", "meta-match")
+                if x.client_status == "running"
+            ],
+            15,
+        )
+        # a job asking for MORE than capacity-minus-reserved blocks
+        big = mock.job(id="too-big")
+        big.task_groups[0].count = 1
+        t = big.task_groups[0].tasks[0]
+        t.driver = "mock"
+        t.config = {}
+        t.resources.cpu = node.resources.cpu - 200  # > cap - reserved
+        srv.job_register(big)
+        time.sleep(1.5)
+        live = [
+            x
+            for x in srv.state.allocs_by_job("default", "too-big")
+            if not x.terminal_status()
+        ]
+        assert live == [], "reserved capacity must not be packable"
+    finally:
+        a.shutdown()
