@@ -414,6 +414,19 @@ def cmd_job_status(args) -> int:
         )
     except APIError:
         pass
+    try:
+        deps = api.jobs.deployments(args.job_id)
+        active = [d for d in deps if d.active()]
+        latest = max(
+            active or deps, key=lambda d: d.job_version, default=None
+        )
+        if latest is not None:
+            print("\nLatest Deployment")
+            print(f"ID          = {latest.id[:8]}")
+            print(f"Status      = {latest.status}")
+            print(f"Description = {latest.status_description}")
+    except APIError:
+        pass
     allocs = api.jobs.allocations(args.job_id)
     if allocs:
         print("\nAllocations")
