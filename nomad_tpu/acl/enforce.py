@@ -84,6 +84,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("PUT", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
     ("POST", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
     ("DELETE", re.compile(r"^/v1/secret/.*$"), CAP_WRITE_SECRET),
+    # server-side job validation: read-level (nothing is committed;
+    # reference agent ValidateJobRequest allows any submitter)
+    ("PUT", re.compile(r"^/v1/validate/job$"), CAP_READ_JOB),
+    ("POST", re.compile(r"^/v1/validate/job$"), CAP_READ_JOB),
     # scaling policies read with namespace read (reference
     # scaling_endpoint.go ListPolicies: read-job or list-scaling-policies)
     ("GET", re.compile(r"^/v1/scaling/policies$"), CAP_READ_JOB),

@@ -519,6 +519,34 @@ class HTTPAgentServer:
                 "Job.deployments", {"namespace": ns, "job_id": p["id"]}
             )
 
+        def validate_job(p, q, body, tok):
+            # reference command/agent/job_endpoint.go ValidateJobRequest:
+            # canonicalize+validate server-side, report errors as data
+            # (not an HTTP failure)
+            if not (body or {}).get("Job"):
+                raise HTTPError(400, "Job is required")
+            try:
+                job = codec.from_wire(body["Job"])
+                job = job.copy()
+                job.canonicalize()
+                job.validate()
+                srv.apply_memory_oversubscription_gate(job)
+                for tg in job.task_groups:
+                    for task in tg.tasks:
+                        if task.vault:
+                            srv._check_vault_policies(
+                                list(task.vault.get("policies", []))
+                            )
+            except (ValueError, PermissionError) as e:
+                return {
+                    "Error": str(e),
+                    "ValidationErrors": [str(e)],
+                    "Warnings": "",
+                }
+            return {"Error": "", "ValidationErrors": [], "Warnings": ""}
+
+        route("PUT", "/v1/validate/job", validate_job)
+        route("POST", "/v1/validate/job", validate_job)
         route("PUT", "/v1/job/(?P<id>[^/]+)/evaluate", job_evaluate)
         route("POST", "/v1/job/(?P<id>[^/]+)/evaluate", job_evaluate)
         route("GET", "/v1/job/(?P<id>[^/]+)/deployments", job_deployments)

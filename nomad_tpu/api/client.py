@@ -190,6 +190,13 @@ class Jobs(_Resource):
             },
         )
 
+    def validate(self, job):
+        """Server-side validation; returns {Error, ValidationErrors,
+        Warnings} (reference api/jobs.go Validate)."""
+        return self.c.put(
+            "/v1/validate/job", body={"Job": codec.to_wire(job)}
+        )
+
     def evaluate(self, job_id: str, namespace: Optional[str] = None):
         """Force a new evaluation (reference api/jobs.go ForceEvaluate)."""
         return self.c.put(

@@ -1368,13 +1368,21 @@ def cmd_agent_info(args) -> int:
 
 
 def cmd_job_validate(args) -> int:
-    """Reference: command/job_validate.go — parse + validate, no submit."""
+    """Reference: command/job_validate.go — parse + validate locally,
+    then server-side (/v1/validate/job) when a server is reachable."""
     try:
         job = _load_jobfile(args.jobfile, _parse_vars(args.var))
         job.canonicalize()
         job.validate()
     except Exception as e:
         print(f"Job validation errors:\n  {e}", file=sys.stderr)
+        return 1
+    try:
+        out = _client(args).jobs.validate(job)
+    except Exception:
+        out = None  # no server: local validation stands alone
+    if out and out.get("Error"):
+        print(f"Job validation errors:\n  {out['Error']}", file=sys.stderr)
         return 1
     print("Job validation successful")
     return 0

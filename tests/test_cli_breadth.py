@@ -542,3 +542,20 @@ def test_client_meta_and_reserved_config(tmp_path):
         assert live == [], "reserved capacity must not be packable"
     finally:
         a.shutdown()
+
+
+def test_validate_job_endpoint(agent):
+    """POST /v1/validate/job validates server-side without committing
+    (reference agent ValidateJobRequest)."""
+    api = _api(agent)
+    good = mock.job(id="valid-me")
+    out = api.jobs.validate(good)
+    assert out["Error"] == "" and out["ValidationErrors"] == []
+    bad = mock.job(id="invalid-me")
+    bad.task_groups[0].count = -3
+    out = api.jobs.validate(bad)
+    assert out["Error"] and out["ValidationErrors"]
+    # nothing was committed either way
+    srv = agent.server.server
+    assert srv.state.job_by_id("default", "valid-me") is None
+    assert srv.state.job_by_id("default", "invalid-me") is None
