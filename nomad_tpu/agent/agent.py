@@ -147,6 +147,12 @@ class AgentConfig:
     # vault stanza: operator allowlist for task-derivable secret-token
     # policies (None = unrestricted, the reference default)
     vault_allowed_policies: Optional[list] = None
+    # tls stanza (reference config tls { http cert_file key_file }):
+    # serves the HTTP API over HTTPS; the RPC fabric stays on the
+    # shared-secret transport
+    tls_http: bool = False
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -157,6 +163,14 @@ class AgentConfig:
 
 class Agent:
     def __init__(self, config: AgentConfig) -> None:
+        if config.tls_http and not (
+            config.tls_cert_file and config.tls_key_file
+        ):
+            # silently serving plaintext when the operator asked for
+            # TLS would put tokens on the wire in the clear
+            raise ValueError(
+                "tls { http = true } requires cert_file and key_file"
+            )
         self.config = config
         self.server: Optional[ClusterServer] = None
         self.client: Optional[Client] = None
@@ -249,6 +263,12 @@ class Agent:
                 port=config.http_port,
                 acl_resolver=resolver,
                 enable_debug=config.enable_debug or config.dev_mode,
+                tls_cert=(
+                    config.tls_cert_file if config.tls_http else ""
+                ),
+                tls_key=(
+                    config.tls_key_file if config.tls_http else ""
+                ),
             )
 
     def start(self) -> None:

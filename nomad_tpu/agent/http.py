@@ -67,6 +67,8 @@ class HTTPAgentServer:
         port: int = 0,
         acl_resolver=None,  # installed by the ACL layer (nomad_tpu/acl)
         enable_debug: bool = False,  # pprof off unless opted in (reference)
+        tls_cert: str = "",  # PEM cert+key enable HTTPS (reference:
+        tls_key: str = "",   # tls { http = true } agent stanza)
     ) -> None:
         self.cluster = cluster
         self.client = client
@@ -87,6 +89,22 @@ class HTTPAgentServer:
         handler = self._make_handler()
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
+        self.tls = bool(tls_cert and tls_key)
+        if self.tls:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            # handshake must NOT run in the accept loop: a client that
+            # connects and sends nothing would block serve_forever and
+            # freeze the whole API. Deferred, the handshake happens on
+            # first read in the per-connection worker thread, bounded
+            # by the handler's socket timeout.
+            self._httpd.socket = ctx.wrap_socket(
+                self._httpd.socket,
+                server_side=True,
+                do_handshake_on_connect=False,
+            )
         self.addr = self._httpd.server_address
         self._thread: Optional[threading.Thread] = None
 
@@ -526,17 +544,7 @@ class HTTPAgentServer:
             if not (body or {}).get("Job"):
                 raise HTTPError(400, "Job is required")
             try:
-                job = codec.from_wire(body["Job"])
-                job = job.copy()
-                job.canonicalize()
-                job.validate()
-                srv.apply_memory_oversubscription_gate(job)
-                for tg in job.task_groups:
-                    for task in tg.tasks:
-                        if task.vault:
-                            srv._check_vault_policies(
-                                list(task.vault.get("policies", []))
-                            )
+                srv.validate_job_submission(codec.from_wire(body["Job"]))
             except (ValueError, PermissionError) as e:
                 return {
                     "Error": str(e),
@@ -1531,6 +1539,10 @@ class HTTPAgentServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # bounds half-open connections AND the deferred TLS
+            # handshake; long-lived streams (event stream, monitor,
+            # logs -f) manage their own cadence under this
+            timeout = 120
 
             def log_message(self, fmt, *args):  # quiet
                 logger.debug("http: " + fmt, *args)

@@ -34,12 +34,24 @@ class NomadClient:
         namespace: str = "default",
         region: str = "",
         timeout_s: float = 35.0,
+        ca_cert: str = "",  # PEM bundle verifying an https:// server
+        tls_skip_verify: bool = False,
     ) -> None:
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
         self.region = region  # "" = the contacted server's own region
         self.timeout_s = timeout_s
+        self._ssl_ctx = None
+        if address.startswith("https://"):
+            import ssl
+
+            if tls_skip_verify:
+                self._ssl_ctx = ssl._create_unverified_context()
+            elif ca_cert:
+                self._ssl_ctx = ssl.create_default_context(cafile=ca_cert)
+            else:
+                self._ssl_ctx = ssl.create_default_context()
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.allocations = Allocations(self)
@@ -85,7 +97,9 @@ class NomadClient:
             req.add_header("X-Nomad-Token", self.token)
         try:
             resp = urllib.request.urlopen(
-                req, timeout=timeout_s or self.timeout_s
+                req,
+                timeout=timeout_s or self.timeout_s,
+                context=self._ssl_ctx,
             )
         except urllib.error.HTTPError as e:
             try:

@@ -57,7 +57,16 @@ def _conn_opts(args) -> tuple[str, str, str]:
 
 def _client(args) -> NomadClient:
     addr, token, region = _conn_opts(args)
-    return NomadClient(addr, token=token, region=region)
+    return NomadClient(
+        addr,
+        token=token,
+        region=region,
+        # TLS against an internal CA (reference NOMAD_CACERT /
+        # -tls-skip-verify)
+        ca_cert=os.environ.get("NOMAD_CACERT", ""),
+        tls_skip_verify=os.environ.get("NOMAD_SKIP_VERIFY", "") in
+        ("1", "true"),
+    )
 
 
 def _parse_vars(pairs: list[str]) -> dict:
@@ -225,6 +234,12 @@ def _load_agent_config(path: str):
             cfg.vault_allowed_policies = [
                 str(x) for x in va["allowed_policies"]
             ]
+    tb = body.block("tls")
+    if tb is not None:
+        ta = tb.body.attrs()
+        cfg.tls_http = bool(ta.get("http", False))
+        cfg.tls_cert_file = str(ta.get("cert_file", ""))
+        cfg.tls_key_file = str(ta.get("key_file", ""))
     for plug in body.blocks("plugin"):
         name = plug.labels[0] if plug.labels else ""
         ref = plug.body.attrs().get("factory", "")
@@ -266,6 +281,10 @@ def _apply_config_dict(cfg, data: dict) -> None:
             cfg.rpc_port = v.get("rpc", 0)
         elif k == "acl" and isinstance(v, dict):
             cfg.acl_enabled = v.get("enabled", False)
+        elif k == "tls" and isinstance(v, dict):
+            cfg.tls_http = bool(v.get("http", False))
+            cfg.tls_cert_file = str(v.get("cert_file", ""))
+            cfg.tls_key_file = str(v.get("key_file", ""))
         elif hasattr(cfg, k):
             setattr(cfg, k, v)
 
@@ -1379,6 +1398,11 @@ def cmd_job_validate(args) -> int:
         return 1
     try:
         out = _client(args).jobs.validate(job)
+    except APIError as e:
+        # a REACHABLE server's error (ACL denial, 500) must surface —
+        # only an unreachable server downgrades to local-only checks
+        print(f"Server-side validation failed: {e}", file=sys.stderr)
+        return 1
     except Exception:
         out = None  # no server: local validation stands alone
     if out and out.get("Error"):

@@ -315,8 +315,11 @@ class Server:
                 for task in tg.tasks:
                     task.resources.memory_max_mb = 0
 
-    def job_register(self, job: Job) -> str:
-        """Returns the created eval id (reference job_endpoint.go:80)."""
+    def validate_job_submission(self, job: Job) -> Job:
+        """The full register-time validation front-half on a COPY:
+        canonicalize, struct validation, oversubscription gate, vault
+        allowlist, scaling bounds. One implementation serves register
+        AND /v1/validate/job, so the two can never drift."""
         job = job.copy()
         job.canonicalize()
         job.validate()
@@ -345,6 +348,11 @@ class Server:
                         f"group {tg.name!r}: count {tg.count} outside "
                         f"scaling bounds [{sc.min}, {sc.max}]"
                     )
+        return job
+
+    def job_register(self, job: Job) -> str:
+        """Returns the created eval id (reference job_endpoint.go:80)."""
+        job = self.validate_job_submission(job)
         self._ensure_namespace(job.namespace)
         if job.is_periodic():
             # A malformed cron spec must be rejected at the API, not fire

@@ -559,3 +559,43 @@ def test_validate_job_endpoint(agent):
     srv = agent.server.server
     assert srv.state.job_by_id("default", "valid-me") is None
     assert srv.state.job_by_id("default", "invalid-me") is None
+
+
+def test_tls_http_api(tmp_path):
+    """tls { http = true } serves the API over HTTPS; the SDK verifies
+    against the operator CA (reference config tls stanza)."""
+    import subprocess
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-nodes", "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path / "agent")
+    cfg.tls_http = True
+    cfg.tls_cert_file = str(cert)
+    cfg.tls_key_file = str(key)
+    a = Agent(cfg)
+    a.start()
+    try:
+        assert a.http.tls
+        api = NomadClient(
+            f"https://127.0.0.1:{a.http_addr[1]}", ca_cert=str(cert)
+        )
+        assert api.status.regions() == ["global"]
+        # plain http against the TLS port fails
+        import urllib.error
+
+        plain = NomadClient(f"http://127.0.0.1:{a.http_addr[1]}")
+        with pytest.raises(Exception):
+            plain.status.regions()
+    finally:
+        a.shutdown()
