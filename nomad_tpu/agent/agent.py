@@ -147,12 +147,17 @@ class AgentConfig:
     # vault stanza: operator allowlist for task-derivable secret-token
     # policies (None = unrestricted, the reference default)
     vault_allowed_policies: Optional[list] = None
-    # tls stanza (reference config tls { http cert_file key_file }):
-    # serves the HTTP API over HTTPS; the RPC fabric stays on the
-    # shared-secret transport
+    # tls stanza (reference config tls { http rpc cert_file key_file
+    # ca_file }): http serves the API over HTTPS; rpc wraps the fabric
+    # (below) — the shared secret still authenticates when set
     tls_http: bool = False
     tls_cert_file: str = ""
     tls_key_file: str = ""
+    # tls { rpc = true }: wrap the whole RPC fabric (server<->server,
+    # server<->client, reverse-dial) in TLS; ca_file enables mTLS peer
+    # verification (reference verify_incoming/verify_outgoing)
+    tls_rpc: bool = False
+    tls_ca_file: str = ""
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -163,15 +168,24 @@ class AgentConfig:
 
 class Agent:
     def __init__(self, config: AgentConfig) -> None:
-        if config.tls_http and not (
+        if (config.tls_http or config.tls_rpc) and not (
             config.tls_cert_file and config.tls_key_file
         ):
             # silently serving plaintext when the operator asked for
             # TLS would put tokens on the wire in the clear
             raise ValueError(
-                "tls { http = true } requires cert_file and key_file"
+                "tls { http/rpc = true } requires cert_file and key_file"
             )
         self.config = config
+        self.fabric_tls = None
+        if config.tls_rpc:
+            from ..rpc.tls import fabric_contexts
+
+            self.fabric_tls = fabric_contexts(
+                config.tls_cert_file,
+                config.tls_key_file,
+                config.tls_ca_file,
+            )
         self.server: Optional[ClusterServer] = None
         self.client: Optional[Client] = None
         self.http = None
@@ -213,6 +227,7 @@ class Agent:
                 rpc_secret=config.rpc_secret,
                 data_dir=None if config.dev_mode else config.data_dir,
                 acl_enforce=config.acl_enabled,
+                tls=self.fabric_tls,
             )
             self.server.server.vault_allowed_policies = (
                 list(config.vault_allowed_policies)
@@ -233,6 +248,9 @@ class Agent:
                 rpc = ClusterRPC(
                     [tuple(a) for a in config.client_servers],
                     rpc_secret=config.rpc_secret,
+                    tls_context=(
+                        self.fabric_tls[1] if self.fabric_tls else None
+                    ),
                 )
             self.client = Client(
                 rpc,
@@ -247,6 +265,7 @@ class Agent:
                 rpc_secret=config.rpc_secret,
                 advertise_host=config.bind_addr,
                 csi_plugins=config.csi_plugins,
+                tls=self.fabric_tls,
             )
         if self.server is not None:
             from .http import HTTPAgentServer

@@ -105,6 +105,23 @@ class HTTPAgentServer:
                 server_side=True,
                 do_handshake_on_connect=False,
             )
+            # plaintext probes (health checkers, LBs) fail the deferred
+            # handshake inside the handler thread; socketserver would
+            # print a full traceback per connection — log one line
+            base_handle_error = self._httpd.handle_error
+
+            def handle_error(request, client_address, _base=base_handle_error):
+                import sys as _sys
+
+                exc = _sys.exc_info()[1]
+                if isinstance(exc, (ssl.SSLError, ConnectionError)):
+                    logger.debug(
+                        "https %s: %s", client_address, exc
+                    )
+                    return
+                _base(request, client_address)
+
+            self._httpd.handle_error = handle_error
         self.addr = self._httpd.server_address
         self._thread: Optional[threading.Thread] = None
 

@@ -369,19 +369,27 @@ class Allocations(_Resource):
         task: str = "",
         tty: bool = False,
         rpc_secret: str = "",
+        tls=None,  # (cert_file, key_file, ca_file) when tls { rpc }
     ):
         """Open an interactive exec session over the RPC fabric.
 
         Returns an ExecSession: .recv() yields output frames, .send_stdin()
         writes input, .close() ends it. The fabric address comes from
         /v1/agent/self; a cluster rpc_secret must be supplied when the
-        fabric requires one.
+        fabric requires one, and `tls` (cert/key/ca paths) when the
+        fabric runs TLS (rpc/tls.py).
         """
         from ..rpc import ConnPool
 
+        tls_ctx = None
+        if tls:
+            from ..rpc.tls import client_context
+
+            cert, key, ca = tls
+            tls_ctx = client_context(ca, cert, key)
         info = self.c.get("/v1/agent/self")
         host, port = info["rpc_addr"]
-        pool = ConnPool(secret=rpc_secret)
+        pool = ConnPool(secret=rpc_secret, tls_context=tls_ctx)
         session = pool.stream(
             (host, int(port)),
             "ClientExec.exec",
@@ -755,7 +763,7 @@ def event_stream(
     req = urllib.request.Request(url)
     if client.token:
         req.add_header("X-Nomad-Token", client.token)
-    resp = urllib.request.urlopen(req)
+    resp = urllib.request.urlopen(req, context=client._ssl_ctx)
     for line in resp:
         line = line.strip()
         if not line or line == b"{}":

@@ -64,8 +64,8 @@ def _client(args) -> NomadClient:
         # TLS against an internal CA (reference NOMAD_CACERT /
         # -tls-skip-verify)
         ca_cert=os.environ.get("NOMAD_CACERT", ""),
-        tls_skip_verify=os.environ.get("NOMAD_SKIP_VERIFY", "") in
-        ("1", "true"),
+        tls_skip_verify=os.environ.get("NOMAD_SKIP_VERIFY", "").lower()
+        in ("1", "true", "t", "yes"),
     )
 
 
@@ -238,8 +238,10 @@ def _load_agent_config(path: str):
     if tb is not None:
         ta = tb.body.attrs()
         cfg.tls_http = bool(ta.get("http", False))
+        cfg.tls_rpc = bool(ta.get("rpc", False))
         cfg.tls_cert_file = str(ta.get("cert_file", ""))
         cfg.tls_key_file = str(ta.get("key_file", ""))
+        cfg.tls_ca_file = str(ta.get("ca_file", ""))
     for plug in body.blocks("plugin"):
         name = plug.labels[0] if plug.labels else ""
         ref = plug.body.attrs().get("factory", "")
@@ -283,8 +285,10 @@ def _apply_config_dict(cfg, data: dict) -> None:
             cfg.acl_enabled = v.get("enabled", False)
         elif k == "tls" and isinstance(v, dict):
             cfg.tls_http = bool(v.get("http", False))
+            cfg.tls_rpc = bool(v.get("rpc", False))
             cfg.tls_cert_file = str(v.get("cert_file", ""))
             cfg.tls_key_file = str(v.get("key_file", ""))
+            cfg.tls_ca_file = str(v.get("ca_file", ""))
         elif hasattr(cfg, k):
             setattr(cfg, k, v)
 
@@ -668,8 +672,35 @@ def cmd_alloc_exec(args) -> int:
     api = _client(args)
     alloc = _find_by_prefix(api.allocations.list(), args.alloc_id)
     secret = args.rpc_secret or _os.environ.get("NOMAD_TPU_RPC_SECRET", "")
+    # fabric TLS (tls { rpc = true }) is EXPLICIT opt-in (-fabric-tls):
+    # inferring it from stray NOMAD_CLIENT_CERT would TLS-dial plaintext
+    # fabrics. Creds come from the standard env vars; cert/key optional
+    # against an encryption-only fabric, required together for mTLS.
+    tls = None
+    if args.fabric_tls:
+        cert = _os.environ.get("NOMAD_CLIENT_CERT", "")
+        key = _os.environ.get("NOMAD_CLIENT_KEY", "")
+        if bool(cert) != bool(key):
+            print(
+                "alloc exec: NOMAD_CLIENT_CERT and NOMAD_CLIENT_KEY "
+                "must both be set for fabric mTLS",
+                file=_sys.stderr,
+            )
+            return 1
+        ca = _os.environ.get("NOMAD_CACERT", "")
+        if not ca:
+            # no CA means verify_mode=CERT_NONE: the handshake succeeds
+            # against ANY endpoint, and the rpc_secret preamble would go
+            # to an unverified peer — loudly flag the downgrade
+            print(
+                "alloc exec: -fabric-tls without NOMAD_CACERT — server "
+                "certificate will NOT be verified",
+                file=_sys.stderr,
+            )
+        tls = (cert, key, ca)
     session = api.allocations.exec_session(
-        alloc.id, args.cmd, task=args.task, tty=args.tty, rpc_secret=secret
+        alloc.id, args.cmd, task=args.task, tty=args.tty, rpc_secret=secret,
+        tls=tls,
     )
     stop = _threading.Event()
 
@@ -1868,6 +1899,11 @@ def build_parser() -> argparse.ArgumentParser:
     aex.add_argument("-t", "-tty", dest="tty", action="store_true")
     aex.add_argument("-task", default="")
     aex.add_argument("-rpc-secret", dest="rpc_secret", default="")
+    aex.add_argument(
+        "-fabric-tls", dest="fabric_tls", action="store_true",
+        help="dial the RPC fabric over TLS (tls { rpc = true }); "
+        "creds from NOMAD_CLIENT_CERT/KEY + NOMAD_CACERT",
+    )
     aex.add_argument("alloc_id")
     # REMAINDER: everything after the alloc id belongs to the command,
     # including its own dashed flags (nomad alloc exec <id> sh -c ...)

@@ -105,6 +105,9 @@ class AllocRunner:
                 lambda aid: self._client.alloc_runners.get(aid),
                 rpc=self._client.rpc,
                 secret=self._client.endpoints.rpc.secret,
+                tls_context=(
+                    self._client.tls[1] if self._client.tls else None
+                ),
             ).run()
         batch = job.type in (JOB_TYPE_BATCH, JOB_TYPE_SYSBATCH)
         restored_states = (

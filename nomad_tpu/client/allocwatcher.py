@@ -28,7 +28,9 @@ class PrevAllocMigrator:
         rpc=None,
         secret: str = "",
         wait_timeout_s: float = 30.0,
+        tls_context=None,
     ) -> None:
+        self.tls_context = tls_context
         self.alloc = alloc
         self.tg = tg
         self.allocdir = allocdir
@@ -121,7 +123,7 @@ class PrevAllocMigrator:
             return
         host, _, port = str(addr_s).rpartition(":")
         addr = (host, int(port))
-        pool = ConnPool(secret=self.secret)
+        pool = ConnPool(secret=self.secret, tls_context=self.tls_context)
         try:
             copied = self._fetch_tree(pool, addr, prev_id, "alloc/data", "")
             logger.info(

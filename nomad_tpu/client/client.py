@@ -102,8 +102,10 @@ class Client:
         host_volumes: Optional[dict] = None,  # name -> {path, read_only}
         node_meta: Optional[dict] = None,  # static node metadata
         reserved: Optional[dict] = None,  # {cpu, memory, disk} carve-out
+        tls=None,  # (server_ctx, client_ctx) — fabric TLS, rpc/tls.py
     ) -> None:
         self.rpc = rpc
+        self.tls = tls
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         # Fingerprint against the REAL data dir: the periodic loop
@@ -119,7 +121,8 @@ class Client:
         from .endpoints import ClientEndpoints
 
         self.endpoints = ClientEndpoints(
-            self, host=advertise_host, secret=rpc_secret
+            self, host=advertise_host, secret=rpc_secret,
+            tls_context=tls[0] if tls else None,
         )
         host, port = self.endpoints.addr
         self.node.attributes["unique.client.rpc"] = f"{host}:{port}"
@@ -225,6 +228,7 @@ class Client:
             self._reverse = ReverseDialer(
                 self, self.endpoints, addrs_fn,
                 secret=self.endpoints.rpc.secret,
+                tls_context=self.tls[1] if self.tls else None,
             )
             self._reverse.start()
         self._restore()

@@ -34,9 +34,12 @@ CHUNK = 64 * 1024
 class ClientEndpoints:
     """Owns the client agent's listener and its stream handlers."""
 
-    def __init__(self, client, host: str = "127.0.0.1", secret: str = "") -> None:
+    def __init__(self, client, host: str = "127.0.0.1", secret: str = "",
+                 tls_context=None) -> None:
         self.client = client
-        self.rpc = RPCServer(host=host, port=0, secret=secret)
+        self.rpc = RPCServer(
+            host=host, port=0, secret=secret, tls_context=tls_context
+        )
         self.rpc.register_stream("FS.logs", self._fs_logs)
         self.rpc.register_stream("FS.ls", self._fs_ls)
         self.rpc.register_stream("FS.cat", self._fs_cat)
@@ -398,6 +401,7 @@ class ReverseDialer:
         idle_target: int = 2,
         secret: str = "",
         retry_s: float = 2.0,
+        tls_context=None,
     ) -> None:
         from ..rpc import ConnPool
 
@@ -406,7 +410,7 @@ class ReverseDialer:
         self.addrs_fn = addrs_fn
         self.idle_target = idle_target
         self.retry_s = retry_s
-        self.pool = ConnPool(secret=secret)
+        self.pool = ConnPool(secret=secret, tls_context=tls_context)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._lock = threading.Lock()

@@ -30,9 +30,14 @@ class _Conn:
     reader = dedicated thread demuxing responses by seq."""
 
     def __init__(
-        self, addr: tuple[str, int], connect_timeout_s: float, secret: str = ""
+        self, addr: tuple[str, int], connect_timeout_s: float,
+        secret: str = "", tls_context=None,
     ) -> None:
         self.sock = socket.create_connection(addr, timeout=connect_timeout_s)
+        if tls_context is not None:
+            self.sock = tls_context.wrap_socket(
+                self.sock, server_hostname=addr[0]
+            )
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
         self.sock.sendall(bytes([BYTE_RPC]))
@@ -114,11 +119,13 @@ class _Conn:
 class ConnPool:
     """Pooled RPC connections keyed by address (reference helper/pool)."""
 
-    def __init__(self, connect_timeout_s: float = 5.0, secret: str = "") -> None:
+    def __init__(self, connect_timeout_s: float = 5.0, secret: str = "",
+                 tls_context=None) -> None:
         self._conns: dict[tuple[str, int], _Conn] = {}
         self._lock = threading.Lock()
         self._connect_timeout_s = connect_timeout_s
         self.secret = secret
+        self.tls_context = tls_context  # ssl client ctx — fabric TLS
 
     def call(
         self,
@@ -147,6 +154,10 @@ class ConnPool:
     ) -> StreamSession:
         """Open a dedicated streaming session (reference RpcStreaming)."""
         sock = socket.create_connection(addr, timeout=self._connect_timeout_s)
+        if self.tls_context is not None:
+            sock = self.tls_context.wrap_socket(
+                sock, server_hostname=addr[0]
+            )
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
         sock.sendall(bytes([BYTE_STREAMING]))
@@ -167,7 +178,8 @@ class ConnPool:
             conn = self._conns.get(addr)
             if conn is not None and not conn.dead:
                 return conn
-            conn = _Conn(addr, self._connect_timeout_s, self.secret)
+            conn = _Conn(addr, self._connect_timeout_s, self.secret,
+                         tls_context=self.tls_context)
             self._conns[addr] = conn
             return conn
 

@@ -68,8 +68,10 @@ class RPCServer:
         port: int = 0,
         num_workers: int = 8,
         secret: str = "",
+        tls_context=None,  # ssl.SSLContext (server side) — fabric TLS
     ) -> None:
         self.secret = secret
+        self.tls_context = tls_context
         self._endpoints: dict[str, object] = {}
         self._stream_handlers: dict[str, Callable[[StreamSession, dict], None]] = {}
         self.raft_handler: Optional[Callable[[StreamSession], None]] = None
@@ -193,6 +195,28 @@ class RPCServer:
 
     def _handle_conn(self, conn: socket.socket) -> None:
         try:
+            if self.tls_context is not None:
+                # per-connection handshake in THIS worker thread — the
+                # accept loop must never block on a silent client
+                conn.settimeout(30.0)
+                plain = conn
+                try:
+                    conn = self.tls_context.wrap_socket(
+                        conn, server_side=True
+                    )
+                except (OSError, ValueError) as e:
+                    logger.debug("fabric TLS handshake failed: %s", e)
+                    return
+                # wrap_socket DETACHES the plain socket: re-track the
+                # SSLSocket or shutdown() force-closes a dead husk while
+                # the live connection's reader blocks forever
+                with self._conns_lock:
+                    self._conns.discard(plain)
+                    if self._shutdown.is_set():
+                        conn.close()
+                        return
+                    self._conns.add(conn)
+                conn.settimeout(None)
             first = conn.recv(1)
             if not first:
                 return

@@ -891,13 +891,20 @@ class ClusterServer:
         rpc_secret: str = "",
         data_dir: Optional[str] = None,
         acl_enforce: bool = False,
+        tls=None,  # (server_ctx, client_ctx) from rpc.tls.fabric_contexts
         **raft_kw,
     ) -> None:
         self.node_id = node_id
         self.region = region
         self.acl_enforce = acl_enforce
-        self.rpc = RPCServer(host=host, port=port, secret=rpc_secret)
-        self.pool = ConnPool(secret=rpc_secret)
+        self.tls = tls
+        self.rpc = RPCServer(
+            host=host, port=port, secret=rpc_secret,
+            tls_context=tls[0] if tls else None,
+        )
+        self.pool = ConnPool(
+            secret=rpc_secret, tls_context=tls[1] if tls else None
+        )
         self.server = Server(
             num_workers=num_workers, use_tpu_batch_worker=use_tpu_batch_worker
         )
@@ -1532,9 +1539,16 @@ class ClusterRPC:
         addrs: list[tuple[str, int]],
         pool: Optional[ConnPool] = None,
         rpc_secret: str = "",
+        tls_context=None,  # client-side ssl ctx (rpc.tls.fabric_contexts)
     ):
         self.addrs = [tuple(a) for a in addrs]
-        self.pool = pool or ConnPool(secret=rpc_secret)
+        if pool is not None and tls_context is not None:
+            # silently dropping the context would dial a TLS fabric in
+            # plaintext with no hint why registration fails
+            raise ValueError("pass tls_context on the pool, not both")
+        self.pool = pool or ConnPool(
+            secret=rpc_secret, tls_context=tls_context
+        )
         # The client's heartbeat and watch threads share this object;
         # rotation must be atomic or concurrent failures double-rotate
         # past live servers.

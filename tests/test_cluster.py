@@ -156,3 +156,87 @@ def test_leader_failover_reschedules(cluster3):
         ), "job registered after failover should run"
     finally:
         pool.shutdown()
+
+
+def test_tls_rpc_fabric(tmp_path):
+    """tls { rpc = true }: the whole fabric — raft replication between
+    servers, client registration/heartbeats, and plan placement — runs
+    over mTLS, and a plaintext dialer is rejected at the handshake
+    (reference nomad/rpc.go rpcTLS + tlsutil verify_incoming)."""
+    import subprocess
+
+    from nomad_tpu.rpc.tls import fabric_contexts
+
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", str(key), "-out", str(cert), "-days", "1",
+            "-nodes", "-subj", "/CN=fabric",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    # self-signed cert doubles as the CA: full mTLS both directions
+    tls = fabric_contexts(str(cert), str(key), ca_file=str(cert))
+
+    import socket as _socket
+
+    ports = []
+    for _ in range(2):
+        s = _socket.create_server(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    addrs = {f"s{i}": ("127.0.0.1", p) for i, p in enumerate(ports)}
+    servers = {
+        nid: ClusterServer(
+            nid,
+            peers={p: a for p, a in addrs.items() if p != nid},
+            port=addrs[nid][1],
+            num_workers=1,
+            tls=tls,
+        )
+        for nid in addrs
+    }
+    for s in servers.values():
+        s.start()
+    client = None
+    try:
+        assert wait_until(
+            lambda: any(s.is_leader() for s in servers.values())
+        )
+        client = Client(
+            ClusterRPC(
+                [s.addr for s in servers.values()], tls_context=tls[1]
+            ),
+            data_dir=str(tmp_path / "c0"),
+            tls=tls,
+        )
+        client.start()
+        assert client.wait_registered(15)
+        leader = next(s for s in servers.values() if s.is_leader())
+        job = mock.job(id="tls-fabric")
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].driver = "mock"
+        job.task_groups[0].tasks[0].config = {}
+        leader.server.job_register(job)
+        assert wait_until(
+            lambda: any(
+                a.client_status == "running"
+                for a in leader.server.state.allocs_by_job(
+                    "default", "tls-fabric"
+                )
+            ),
+            timeout_s=15,
+        )
+        # a non-TLS dialer must not get through the fabric
+        with pytest.raises((ConnectionError, OSError, TimeoutError)):
+            ConnPool(connect_timeout_s=2.0).call(
+                servers["s0"].rpc.addr, "Status.ping", {}, timeout_s=3.0
+            )
+    finally:
+        if client is not None:
+            client.shutdown()
+        for s in servers.values():
+            s.shutdown()
