@@ -180,7 +180,10 @@ class AllocRunner:
             from .serviceregistration import ServiceWatcher
 
             self._services = ServiceWatcher(
-                self.alloc, self.node, self._client.rpc
+                self.alloc, self.node, self._client.rpc,
+                exec_fn=self._check_exec,
+                restart_fn=self._check_restart,
+                started_fn=self._task_started_stamp,
             )
             self._services.start()
         # Deployment allocs get a health watcher (reference
@@ -325,6 +328,58 @@ class AllocRunner:
         alloc_endpoint.go Restart → task runner restart without budget)."""
         for tr in self._lifecycle_targets(task_name):
             tr.trigger_restart()
+
+    # -- health-check hooks (serviceregistration.ServiceWatcher) -------
+
+    def _check_exec(self, task_name: str, cmd: list, timeout_s: float):
+        """Script checks run INSIDE the task's context via the driver
+        (reference command/agent/consul/check_watcher.go execs through
+        the driver's ExecTask). Non-zero on any failure to exec."""
+        from ..drivers.base import DriverError
+
+        tr = self.task_runners.get(task_name)
+        if tr is None or tr.state.state != "running":
+            return 1
+        try:
+            _out, code = tr.driver.exec_task(
+                tr.task_id, cmd, timeout_s=max(timeout_s, 0.1)
+            )
+            return code
+        except (DriverError, OSError):
+            return 1
+
+    def _task_started_stamp(self, task_name: str):
+        """Start stamp for check_restart grace re-arming: changes on
+        every (re)start of the task. Group services ("" task) re-arm on
+        ANY task's restart — a group trip bounces every task."""
+        if not task_name:
+            return max(
+                (tr.state.started_at_ns
+                 for tr in self.task_runners.values()),
+                default=0,
+            )
+        tr = self.task_runners.get(task_name)
+        return tr.state.started_at_ns if tr is not None else 0
+
+    def _check_restart(self, task_name: str, reason: str) -> None:
+        """check_restart tripped: bounce the owning task (group service
+        → every task, matching the reference's group-level semantics),
+        consuming restart-policy budget. A NAMED task that doesn't
+        exist is a config error — restarting the whole healthy group
+        for it would burn every task's budget."""
+        if task_name and task_name not in self.task_runners:
+            logger.error(
+                "alloc %s: check_restart names unknown task %r — ignoring",
+                self.alloc.id[:8], task_name,
+            )
+            return
+        targets = (
+            [self.task_runners[task_name]]
+            if task_name
+            else list(self.task_runners.values())
+        )
+        for tr in targets:
+            tr.trigger_failure_restart(reason)
 
     def signal(self, sig: str, task_name: str = "") -> None:
         for tr in self._lifecycle_targets(task_name):

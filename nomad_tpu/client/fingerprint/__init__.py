@@ -67,6 +67,8 @@ def fingerprint_node(
         attributes.update(resp.attributes)
         if "cpu" in resp.resources:
             resources.cpu = resp.resources["cpu"]
+        if "total_cores" in resp.resources:
+            resources.total_cores = resp.resources["total_cores"]
         if "memory_mb" in resp.resources:
             resources.memory_mb = resp.resources["memory_mb"]
         if "disk_mb" in resp.resources:

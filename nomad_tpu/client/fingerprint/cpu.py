@@ -48,5 +48,6 @@ class CPUFingerprint(Fingerprinter):
             "cpu.frequency": str(total // cores),
         }
         resp.resources["cpu"] = total
+        resp.resources["total_cores"] = cores
         resp.detected = True
         return resp

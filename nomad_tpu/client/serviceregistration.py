@@ -8,9 +8,15 @@ services table, and this watcher pushes aggregate check status updates
 the same way the consul agent would flip a check to critical.
 
 One ServiceWatcher per alloc covers group services and every task's
-services. Checks supported: ``http`` (2xx = passing) and ``tcp``
-(connect = passing); intervals honor the check's ``interval``/``timeout``
-(defaults 10s/2s, floors 1s/0.1s).
+services. Checks supported: ``http`` (2xx = passing), ``tcp``
+(connect = passing) and ``script`` (command exec'd INSIDE the task via
+the driver, exit 0 = passing — reference structs.go ServiceCheck
+Command); intervals honor the check's ``interval``/``timeout``
+(defaults 10s/2s, floors 1s/0.1s). A ``check_restart`` stanza
+(reference command/agent/consul/check_watcher.go) restarts the task
+after ``limit`` consecutive failures once ``grace`` has elapsed from
+watch start; the restart consumes the task's restart-policy budget, so
+a permanently sick task eventually fails instead of flapping forever.
 """
 
 from __future__ import annotations
@@ -18,6 +24,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 import urllib.request
 from typing import Optional
 
@@ -114,12 +121,25 @@ class ServiceWatcher:
     deregisters on stop."""
 
     def __init__(self, alloc, node, rpc,
-                 poll_interval_s: Optional[float] = None) -> None:
+                 poll_interval_s: Optional[float] = None,
+                 exec_fn=None, restart_fn=None, started_fn=None) -> None:
         import os
 
         self.alloc = alloc
         self.node = node
         self.rpc = rpc
+        # exec_fn(task_name, cmd: list, timeout_s) -> exit_code — script
+        # checks; restart_fn(task_name, reason) — check_restart trips;
+        # started_fn(task_name) -> start stamp (any monotone value that
+        # CHANGES on restart) so grace re-arms per instance
+        self.exec_fn = exec_fn
+        self.restart_fn = restart_fn
+        self.started_fn = started_fn
+        self._started_at = time.monotonic()
+        # (reg.id, check idx) -> consecutive failures since last pass
+        self._fail_counts: dict[tuple[str, int], int] = {}
+        # task -> last seen start stamp (re-arms counters on change)
+        self._grace_base: dict[str, int] = {}
         self.regs, sources = build_registrations(
             alloc, node, with_services=True
         )
@@ -177,7 +197,11 @@ class ServiceWatcher:
 
     def _run_check(self, reg: ServiceRegistration, check: dict) -> bool:
         ctype = check.get("type", "tcp")
-        timeout = _parse_secs(check.get("timeout"), 2.0)
+        # the parser stores seconds under timeout_s; accept the raw
+        # jobspec key too for hand-built check dicts
+        timeout = _parse_secs(
+            check.get("timeout_s", check.get("timeout")), 2.0
+        )
         timeout = max(timeout, 0.1)
         addr = check.get("address") or reg.address or "127.0.0.1"
         port = reg.port
@@ -187,6 +211,20 @@ class ServiceWatcher:
             except (TypeError, ValueError):
                 pass
         try:
+            if ctype == "script":
+                # group-service checks name their exec task via the
+                # check's `task` field (reference ServiceCheck.TaskName)
+                task = check.get("task") or reg.task_name
+                if self.exec_fn is None or not task:
+                    logger.warning(
+                        "script check on %s has no exec context: critical",
+                        reg.service_name,
+                    )
+                    return False
+                cmd = [check.get("command", "")] + list(
+                    check.get("args") or []
+                )
+                return self.exec_fn(task, cmd, timeout) == 0
             if ctype == "http":
                 path = check.get("path", "/")
                 proto = check.get("protocol", "http")
@@ -208,10 +246,73 @@ class ServiceWatcher:
                 checks = self._checks.get(reg.id) or []
                 if not checks:
                     continue
-                passing = all(self._run_check(reg, c) for c in checks)
+                passing = True
+                for i, c in enumerate(checks):
+                    ok = self._run_check(reg, c)
+                    passing = passing and ok
+                    self._track_restart(reg, i, c, ok)
                 status = "passing" if passing else "critical"
                 if reg.status != status:
                     reg.status = status
                     changed = True
             if changed and not self._stop.is_set():
                 self._register()
+
+    def _track_restart(self, reg, idx: int, check: dict, ok: bool) -> None:
+        """check_restart accounting: `limit` consecutive failures after
+        `grace` from watch start trip a task restart (reference
+        check_watcher.go checkRestart.apply)."""
+        cr = check.get("check_restart") or {}
+        limit = int(cr.get("limit", 0))
+        if limit <= 0 or self.restart_fn is None:
+            return
+        key = (reg.id, idx)
+        if ok:
+            self._fail_counts[key] = 0
+            return
+        # grace counts from the task's LAST start, not watcher birth:
+        # a restarted instance gets its full startup window again and
+        # the previous instance's failures don't carry over (reference
+        # check_watcher.go re-arms on task restart)
+        target = check.get("task") or reg.task_name
+        grace = float(cr.get("grace_s", 1.0))
+        stamp = (
+            self.started_fn(target)
+            if self.started_fn is not None
+            else 0
+        )
+        if stamp:
+            prev = self._grace_base.get(target)
+            if prev != stamp:
+                # new instance observed: EVERY check that RESOLVES to
+                # this task sheds the previous instance's failures —
+                # including group-service checks naming it via `task`
+                self._grace_base[target] = stamp
+                for r in self.regs:
+                    for i, c in enumerate(self._checks.get(r.id) or []):
+                        if (c.get("task") or r.task_name) == target:
+                            self._fail_counts[(r.id, i)] = 0
+            # grace runs from the task's REAL start, so a long-running
+            # instance's first failure counts immediately (reference
+            # check_watcher: grace shields startup, not steady state)
+            if (time.time_ns() - stamp) / 1e9 < grace:
+                return
+        elif time.monotonic() - self._started_at < grace:
+            return
+        n = self._fail_counts.get(key, 0) + 1
+        self._fail_counts[key] = n
+        if n < limit:
+            return
+        self._fail_counts[key] = 0
+        reason = (
+            f"check {check.get('name') or check.get('type')!r} "
+            f"unhealthy {n}x"
+        )
+        logger.warning(
+            "alloc %s task %s: %s — restarting",
+            self.alloc.id[:8], target or "(group)", reason,
+        )
+        try:
+            self.restart_fn(target, reason)
+        except Exception:
+            logger.exception("check_restart restart failed")

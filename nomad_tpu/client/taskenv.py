@@ -43,6 +43,14 @@ def build_env(
     # scheduler-config gate (reference NOMAD_MEMORY_MAX_LIMIT)
     if task.resources.memory_max_mb:
         env["NOMAD_MEMORY_MAX_LIMIT"] = str(task.resources.memory_max_mb)
+    # dedicated cores the scheduler granted (reference NOMAD_CPU_CORES,
+    # taskenv/env.go — comma list of core ids; drivers pin to them)
+    if alloc.resources is not None:
+        granted = alloc.resources.tasks.get(task.name)
+        if granted is not None and granted.reserved_cores:
+            env["NOMAD_CPU_CORES"] = ",".join(
+                str(c) for c in granted.reserved_cores
+            )
     if alloc_dir:
         env["NOMAD_ALLOC_DIR"] = alloc_dir
     if task_dir:

@@ -87,6 +87,17 @@ class TaskRunner:
         self._thread: Optional[threading.Thread] = None
         self._rotators: list[LogRotator] = []
         self._template_restart = threading.Event()
+        # check_restart trips: like a template restart but CONSUMES the
+        # restart-policy budget (reference check_watcher → restartTracker
+        # SetRestartTriggered(failure=true)) so flapping converges to
+        # failed instead of bouncing forever
+        self._failure_restart = threading.Event()
+        self._failure_restart_reason = ""
+        # instance token: the started_at_ns the trip was aimed at — a
+        # trip raised against a PREVIOUS instance (set during the
+        # stop/backoff window while state still reads "running") must
+        # not kill the fresh one
+        self._failure_restart_token = 0
         self._tmpl_watcher = None
         # template re-render poll cadence (env knob so tests can shrink it
         # through the full client stack)
@@ -107,6 +118,15 @@ class TaskRunner:
                 f"({self.state.state})"
             )
         self._template_restart.set()
+
+    def trigger_failure_restart(self, reason: str) -> None:
+        """Health-check-initiated restart (reference check_watcher.go):
+        counts against the restart policy. No-op unless running."""
+        if self.state.state != "running":
+            return
+        self._failure_restart_token = self.state.started_at_ns
+        self._failure_restart_reason = reason
+        self._failure_restart.set()
 
     def signal(self, sig: str) -> None:
         """Operator-initiated signal (reference alloc signal)."""
@@ -214,11 +234,56 @@ class TaskRunner:
             while result is None and not self._kill.is_set():
                 if self._template_restart.is_set():
                     break
+                if self._failure_restart.is_set():
+                    if (
+                        self._failure_restart_token
+                        == self.state.started_at_ns
+                    ):
+                        break
+                    # stale: aimed at a previous instance
+                    self._failure_restart.clear()
                 try:
                     result = self.driver.wait_task(self.task_id, timeout_s=0.2)
                 except DriverError:
                     break
-            if self._template_restart.is_set() and result is None:
+            # a trip aimed at a PREVIOUS instance is stale however the
+            # wait loop exited (it may have broken on template/kill
+            # before the in-loop staleness check ran)
+            if (
+                self._failure_restart.is_set()
+                and self._failure_restart_token
+                != self.state.started_at_ns
+            ):
+                self._failure_restart.clear()
+            # a kill always wins over pending restarts: acting on a
+            # restart first would spawn a throwaway instance
+            if (
+                self._failure_restart.is_set()
+                and result is None
+                and not self._kill.is_set()
+            ):
+                self._failure_restart.clear()
+                # a concurrently pending template restart is satisfied
+                # by this bounce too — the new instance starts from the
+                # latest rendered templates
+                self._template_restart.clear()
+                self._event(
+                    EVENT_RESTARTING,
+                    self._failure_restart_reason or "unhealthy check",
+                )
+                try:
+                    self.driver.stop_task(self.task_id, self.task.kill_timeout_s)
+                    self.driver.destroy_task(self.task_id, force=True)
+                except DriverError:
+                    pass
+                if not self._maybe_restart(success=False):
+                    return
+                continue
+            if (
+                self._template_restart.is_set()
+                and result is None
+                and not self._kill.is_set()
+            ):
                 # change_mode=restart fired: bounce the task WITHOUT
                 # consuming the restart policy's budget (reference
                 # restarts.go SetRestartTriggered).
@@ -253,6 +318,11 @@ class TaskRunner:
                     return
                 continue
 
+            # the task exited on its own: a restart request that raced
+            # the exit is stale — acting on it would kill the NEXT
+            # instance within a beat (and charge the budget)
+            self._failure_restart.clear()
+            self._template_restart.clear()
             success = result.successful()
             self._event(
                 EVENT_TERMINATED,
@@ -490,15 +560,31 @@ class TaskRunner:
         return mounts
 
     def _task_config(self, task_dir, env: dict[str, str]) -> TaskConfig:
+        granted_res = (
+            self.alloc.resources.tasks.get(self.task.name)
+            if self.alloc.resources is not None
+            else None
+        )
         return TaskConfig(
             id=self.task_id,
             name=self.task.name,
             alloc_id=self.alloc.id,
             env=env,
             config=interpolate(dict(self.task.config), env),
-            resources_cpu=self.task.resources.cpu,
+            # the GRANT, not the ask: a cores task's cpu share is
+            # derived (cores x MHz/core) and drives cgroup weight
+            resources_cpu=(
+                granted_res.cpu
+                if granted_res is not None and granted_res.cpu
+                else self.task.resources.cpu
+            ),
             resources_memory_mb=self.task.resources.memory_mb,
             resources_memory_max_mb=self.task.resources.memory_max_mb,
+            reserved_cores=(
+                list(granted_res.reserved_cores)
+                if granted_res is not None
+                else []
+            ),
             task_dir=task_dir.dir,
             stdout_path=self.alloc_dir.stdout_path(self.task.name),
             stderr_path=self.alloc_dir.stderr_path(self.task.name),

@@ -43,6 +43,9 @@ class TaskConfig:
     resources_memory_mb: int = 0
     # oversubscription hard cap (0 = cap at the reserve)
     resources_memory_max_mb: int = 0
+    # dedicated core ids (reference LinuxResources.CpusetCpus): pinning
+    # drivers restrict the task's cpu affinity to exactly these
+    reserved_cores: list = field(default_factory=list)
     task_dir: str = ""
     stdout_path: str = ""
     stderr_path: str = ""

@@ -118,6 +118,7 @@ class ExecDriver(Driver):
                 ) * 1024 * 1024,
                 # cgroup v2 cpu.weight range 1..10000; map MHz shares
                 cpu_weight=min(10000, max(1, cfg.resources_cpu // 10)) if cfg.resources_cpu else 0,
+                cores=cfg.reserved_cores,
             )
         except ExecutorError as e:
             raise DriverError(f"exec: {e}") from e

@@ -232,6 +232,7 @@ def launch_executor(
     cgroup: str = "",
     memory_max_bytes: int = 0,
     cpu_weight: int = 0,
+    cores: Optional[list] = None,
     cache_dir: Optional[str] = None,
 ) -> ExecutorHandle:
     """Write the spec, launch the daemonized supervisor, return a handle."""
@@ -245,6 +246,7 @@ def launch_executor(
             raise ExecutorError(f"invalid env key {k!r}")
     lines = [f"command\t{_esc(command)}"]
     lines += [f"arg\t{_esc(a)}" for a in args]
+    lines += [f"core\t{int(c)}" for c in (cores or [])]
     lines += [f"env\t{_esc(f'{k}={v}')}" for k, v in env.items()]
     if cwd:
         lines.append(f"cwd\t{_esc(cwd)}")

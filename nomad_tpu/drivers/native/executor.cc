@@ -35,6 +35,7 @@
 #include <poll.h>
 #include <pty.h>
 #include <pwd.h>
+#include <sched.h>
 #include <signal.h>
 #include <string>
 #include <sys/resource.h>
@@ -60,6 +61,8 @@ struct Spec {
   std::string cgroup;             // cgroup v2 dir to create/join
   long long memory_max = 0;       // bytes, 0 = unset
   int cpu_weight = 0;             // cgroup v2 cpu.weight, 0 = unset
+  std::vector<int> cores;         // dedicated core ids: pin via affinity
+                                  // (reference LinuxResources.CpusetCpus)
   std::string user;
 };
 
@@ -109,6 +112,7 @@ static bool read_spec(const char *path, Spec &s) {
     else if (key == "cgroup") s.cgroup = val;
     else if (key == "memory_max") s.memory_max = atoll(val.c_str());
     else if (key == "cpu_weight") s.cpu_weight = atoi(val.c_str());
+    else if (key == "core") s.cores.push_back(atoi(val.c_str()));
     else if (key == "user") s.user = val;
   }
   free(line);
@@ -157,6 +161,16 @@ static pid_t spawn_task(const Spec &s, bool join_cgroup) {
   if (pid != 0) return pid;
   // task child
   setsid();
+  if (!s.cores.empty()) {
+    // pin to the scheduler-granted dedicated cores; best-effort (an
+    // offline core must not fail the start — the grant is advisory
+    // on hosts that shrank since fingerprinting)
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    for (int c : s.cores)
+      if (c >= 0 && c < CPU_SETSIZE) CPU_SET(c, &set);
+    sched_setaffinity(0, sizeof(set), &set);
+  }
   if (join_cgroup) {
     // v2: write 0 (self) into cgroup.procs before exec
     std::string procs = s.cgroup + "/cgroup.procs";

@@ -31,6 +31,27 @@ from .base import (
 )
 
 
+def _pin_cores(pid: int, cores: list) -> None:
+    """Parent-side affinity pin for scheduler-granted dedicated cores
+    (reference: cpuset cgroup via LinuxResources.CpusetCpus). Runs
+    immediately after spawn — NOT via preexec_fn, which executes Python
+    between fork and exec in this heavily threaded process (documented
+    deadlock hazard). The window before the pin is microseconds; tasks
+    needing fork-safe pinning from the first instruction use the exec
+    driver, whose C++ supervisor pins in the child natively.
+    Best-effort: an out-of-range id (host shrank) must not fail the
+    start."""
+    try:
+        os.sched_setaffinity(pid, {int(c) for c in cores})
+    except (OSError, AttributeError, ValueError):
+        import logging
+
+        logging.getLogger("nomad_tpu.drivers").warning(
+            "could not pin pid %d to cores %s", pid, cores
+        )
+
+
+
 class _RawTask:
     def __init__(self, cfg: TaskConfig, proc: subprocess.Popen):
         self.cfg = cfg
@@ -162,6 +183,8 @@ class RawExecDriver(Driver):
             for f in (stdout, stderr):
                 if hasattr(f, "close"):
                     f.close()
+        if cfg.reserved_cores:
+            _pin_cores(proc.pid, cfg.reserved_cores)
         task = _RawTask(cfg, proc)
         with self._lock:
             self.tasks[cfg.id] = task

@@ -326,15 +326,29 @@ def _service(b: Block) -> Service:
     )
     for cb in b.body.blocks("check"):
         ca = cb.body.attrs()
-        svc.checks.append(
-            {
-                "name": ca.get("name", ""),
-                "type": ca.get("type", "tcp"),
-                "path": ca.get("path", ""),
-                "interval_s": parse_duration(ca.get("interval", "10s")),
-                "timeout_s": parse_duration(ca.get("timeout", "2s")),
+        check = {
+            "name": ca.get("name", ""),
+            "type": ca.get("type", "tcp"),
+            "path": ca.get("path", ""),
+            "interval_s": parse_duration(ca.get("interval", "10s")),
+            "timeout_s": parse_duration(ca.get("timeout", "2s")),
+        }
+        if ca.get("task"):
+            # group-service checks name the task that hosts script
+            # execs / owns the restart (reference ServiceCheck.TaskName)
+            check["task"] = str(ca["task"])
+        if ca.get("command"):
+            # script checks exec inside the task (reference
+            # structs.go ServiceCheck Command/Args)
+            check["command"] = str(ca["command"])
+            check["args"] = [str(x) for x in ca.get("args", [])]
+        for rb in cb.body.blocks("check_restart"):
+            ra = rb.body.attrs()
+            check["check_restart"] = {
+                "limit": int(ra.get("limit", 0)),
+                "grace_s": parse_duration(ra.get("grace", "1s")),
             }
-        )
+        svc.checks.append(check)
     return svc
 
 

@@ -66,6 +66,7 @@ def node(**overrides) -> Node:
             cpu=4000,
             memory_mb=8192,
             disk_mb=100 * 1024,
+            total_cores=4,
             networks=[
                 NetworkResource(
                     device="eth0", cidr="192.168.0.100/32", ip="192.168.0.100", mbits=1000

@@ -123,6 +123,18 @@ def binpack_rank(
         dev_alloc = DeviceAllocator(ctx, node)
         dev_alloc.add_allocs(proposed)
 
+        # Dedicated cores (reference rank.go: AllocatedCpuResources via
+        # idset): free ids = node's cores minus every proposed alloc's
+        # reservations; a `cores` task gets the lowest free ids and a
+        # DERIVED cpu share (cores x node MHz/core) so MHz accounting
+        # stays consistent with share-based tasks.
+        free_cores: list = []
+        mhz_per_core = 0
+        if any(t.resources.cores > 0 for t in tg.tasks):
+            from ..structs.funcs import node_core_pool
+
+            free_cores, mhz_per_core = node_core_pool(node, proposed)
+
         # Per-task port/bandwidth + device assignment.
         task_resources: dict[str, AllocatedTaskResources] = {}
         feasible = True
@@ -130,6 +142,22 @@ def binpack_rank(
             tr = AllocatedTaskResources(
                 cpu=task.resources.cpu, memory_mb=task.resources.memory_mb
             )
+            if task.resources.cores > 0:
+                if len(free_cores) < task.resources.cores:
+                    if metrics is not None:
+                        metrics.exhausted_node(node, "cores")
+                    feasible = False
+                    break
+                tr.reserved_cores = free_cores[: task.resources.cores]
+                free_cores = free_cores[task.resources.cores :]
+                tr.cpu = task.resources.cores * mhz_per_core
+                util.cpu += tr.cpu - task.resources.cpu
+                ok, dim = available.superset(util)
+                if not ok:
+                    if metrics is not None:
+                        metrics.exhausted_node(node, dim)
+                    feasible = False
+                    break
             for ask in task.resources.networks:
                 offer = net_idx.assign_network(ask)
                 if offer is None:

@@ -49,6 +49,10 @@ class NodeTable:
     # and each tier's usage — feeds the preemption kernel's prefix sums
     tier_prios: list[int] = field(default_factory=list)
     tier_used: Optional[np.ndarray] = None  # [T, N, NUM_RES] int64
+    # dedicated-core availability: total ids and ids held by live allocs
+    # (cores ride OUTSIDE the dense NUM_RES columns — a static screen
+    # here, exact id assignment at materialization, allocs_fit backstop)
+    cores_free: Optional[np.ndarray] = None  # [N] int64
     # lazily built per-attribute interning: ltarget -> (codes [N] int32, values)
     _attr_cache: dict[str, tuple[np.ndarray, list[str], np.ndarray]] = field(
         default_factory=dict
@@ -146,10 +150,12 @@ def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
     index_of: dict[str, int] = {}
     # usage bucketed by the owning job's priority → preemption tiers
     by_prio: dict[int, np.ndarray] = {}
+    cores_free = np.zeros(n, dtype=np.int64)
     for i, node in enumerate(nodes):
         index_of[node.id] = i
         avail = node.available_resources()
         cap[i] = (avail.cpu, avail.memory_mb, avail.disk_mb)
+        cores_free[i] = node.resources.total_cores or 0
         code = dc_code.get(node.datacenter)
         if code is None:
             code = len(dc_values)
@@ -160,6 +166,11 @@ def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
             r = alloc.comparable_resources()
             vec = (r.cpu, r.memory_mb, r.disk_mb)
             used[i] += vec
+            if alloc.resources is not None:
+                cores_free[i] -= sum(
+                    len(tr.reserved_cores)
+                    for tr in alloc.resources.tasks.values()
+                )
             prio = alloc.job.priority if alloc.job is not None else 50
             tier = by_prio.get(prio)
             if tier is None:
@@ -180,6 +191,7 @@ def build_node_table(nodes: list[Node], allocs_by_node) -> NodeTable:
         dc_values=dc_values,
         tier_prios=tier_prios,
         tier_used=tier_used,
+        cores_free=cores_free,
     )
     table._allocs_by_node = allocs_by_node
     return table
@@ -396,6 +408,17 @@ def lower_group(
                     desired > 0, (desired - counts) / np.maximum(desired, 1), -1.0
                 )
             bias += (boost[codes] * (s.weight / sum_w)).astype(np.float32)
+
+    cores_ask = sum(t.resources.cores for t in tg.tasks)
+    if cores_ask > 0 and table.cores_free is not None:
+        feas = feas & (table.cores_free >= cores_ask)
+        # dedicated ids are NOT in the dense resource columns, so cap
+        # the per-node unit count here or the solver would stack more
+        # instances than a node has cores and the materializer would
+        # drop the overflow
+        units_cap = np.minimum(
+            units_cap, np.maximum(table.cores_free, 0) // cores_ask
+        )
 
     ask = np.array(tg.combined_resources().vector(), dtype=np.int64)
     return LoweredGroup(

@@ -105,9 +105,34 @@ class BatchSolver:
         # Per-node device allocator, shared across the batch (like the
         # port index above) so placements see each other's reservations.
         self._dev_cache: dict[str, object] = {}
+        # Per-node (free dedicated-core ids, MHz/core), shared across
+        # the batch; the list is mutated in place as grants are cut.
+        self._core_cache: dict[str, tuple] = {}
+        # set by solve(): with no cores ask anywhere in the batch the
+        # dense solve's declared-MHz accounting is exact and the ledger
+        # (an O(allocs-per-node) state scan per node) is skipped
+        self._batch_has_cores = False
+        # allocs stopped by this batch's plans: vacated for seeding
+        self._stopped_ids: set = set()
+        # Per-node cpu MHz ledger. The dense solve packs the DECLARED
+        # cpu ask; a `cores` task's granted cpu is DERIVED (cores x
+        # MHz/core) and can exceed it, so cores placements re-screen
+        # against real remaining MHz (rank.py does the same superset
+        # re-check on the host path). _state_cpu is the committed-state
+        # baseline; _batch_cpu tracks EVERY placement this solve makes
+        # (fast path included) so the screen sees same-batch neighbors.
+        self._state_cpu: dict[str, int] = {}
+        self._batch_cpu: dict[str, int] = {}
 
     def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
         out = SolveOutcome()
+        self._batch_has_cores = any(
+            t.resources.cores > 0
+            for ask in asks
+            for tg in [ask.job.lookup_task_group(ask.tg_name)]
+            if tg is not None
+            for t in tg.tasks
+        )
         self._outcome = out
         if not asks:
             return out
@@ -142,6 +167,10 @@ class BatchSolver:
             if ask.plan is not None:
                 for allocs_ in ask.plan.node_update.values():
                     stopped_ids.update(a.id for a in allocs_)
+        # the materializer's per-node seeds (ports/devices/cores/cpu)
+        # must see the SAME vacated capacity as the dense table, or an
+        # in-place replacement of a full node can never materialize
+        self._stopped_ids = stopped_ids
 
         def live_allocs(nid: str):
             return [
@@ -579,6 +608,8 @@ class BatchSolver:
                 bool(tg.networks)
                 or any(t.resources.networks for t in tg.tasks)
                 or any(t.resources.devices for t in tg.tasks)
+                # dedicated cores need per-placement id assignment
+                or any(t.resources.cores > 0 for t in tg.tasks)
                 or any(r.previous_alloc is not None for r in reqs)
             )
             if slow:
@@ -610,12 +641,23 @@ class BatchSolver:
                 jid = grp.job.id
                 tg_name = tg.name
                 job = grp.job
+                group_cpu = sum(t.resources.cpu for t in tg.tasks)
                 for i, ni in enumerate(node_idx):
                     if over_set is not None and ni in over_set:
                         if not _check_over(ni):
                             unplaced.append(reqs[i])
                             continue
                     node = nodes[ni]
+                    if self._batch_has_cores:
+                        # the dense solve can't see the derived-MHz
+                        # excess of cores groups materialized earlier
+                        # in this batch — the shared ledger can
+                        if group_cpu > self._remaining_cpu(node):
+                            unplaced.append(reqs[i])
+                            continue
+                        self._batch_cpu[node.id] = (
+                            self._batch_cpu.get(node.id, 0) + group_cpu
+                        )
                     placements.append(
                         Allocation(
                             id=uuids[i],
@@ -759,6 +801,27 @@ class BatchSolver:
                 return picks
         return None
 
+    def _live_allocs(self, node_id: str):
+        """Non-terminal allocs minus this batch's plan-stops — the same
+        vacated view the dense table packs against."""
+        return [
+            a
+            for a in self.state.allocs_by_node_terminal(node_id, False)
+            if a.id not in self._stopped_ids
+        ]
+
+    def _remaining_cpu(self, node) -> int:
+        """Node MHz still grantable: committed-state baseline minus
+        every placement this batch already made (either path)."""
+        base = self._state_cpu.get(node.id)
+        if base is None:
+            base = node.available_resources().cpu - sum(
+                a.comparable_resources().cpu
+                for a in self._live_allocs(node.id)
+            )
+            self._state_cpu[node.id] = base
+        return base - self._batch_cpu.get(node.id, 0)
+
     def _build_alloc(
         self, table, grp: LoweredGroup, node, req: PlacementRequest
     ) -> Optional[Allocation]:
@@ -767,7 +830,7 @@ class BatchSolver:
         if net_idx is None:
             net_idx = NetworkIndex()
             net_idx.set_node(node)
-            net_idx.add_allocs(self.state.allocs_by_node_terminal(node.id, False))
+            net_idx.add_allocs(self._live_allocs(node.id))
             self._net_cache[node.id] = net_idx
 
         # Device instance assignment (mirrors rank.py's DeviceAllocator
@@ -780,10 +843,43 @@ class BatchSolver:
             dev_alloc = self._dev_cache.get(node.id)
             if dev_alloc is None:
                 dev_alloc = DeviceAllocator(self.ctx, node)
-                dev_alloc.add_allocs(
-                    self.state.allocs_by_node_terminal(node.id, False)
-                )
+                dev_alloc.add_allocs(self._live_allocs(node.id))
                 self._dev_cache[node.id] = dev_alloc
+
+        remaining_cpu = (
+            self._remaining_cpu(node) if self._batch_has_cores else 0
+        )
+
+        # Dedicated-core id pool per node (mirrors rank.py): the dense
+        # solve reserved core COUNTS (the 4th resource column); ids are
+        # assigned here on materialization, shared across the batch via
+        # the cache so two placements never collide.
+        free_cores = None
+        mhz_per_core = 0
+        if any(t.resources.cores > 0 for t in tg.tasks):
+            from ...structs.funcs import node_core_pool
+
+            cached = self._core_cache.get(node.id)
+            if cached is None:
+                cached = node_core_pool(node, self._live_allocs(node.id))
+                self._core_cache[node.id] = cached
+            free_cores, mhz_per_core = cached
+
+        if self._batch_has_cores:
+            # the dense solve screened DECLARED MHz asks; cores grants
+            # are DERIVED (cores x MHz/core) and may exceed them, so in
+            # a cores-bearing batch EVERY slow-path group re-screens
+            # against the shared ledger (rank.py does the same superset
+            # re-check on the host path). Before any reservation, so no
+            # rollback needed.
+            group_cpu = sum(
+                t.resources.cores * mhz_per_core
+                if t.resources.cores > 0
+                else t.resources.cpu
+                for t in tg.tasks
+            )
+            if group_cpu > remaining_cpu:
+                return None
 
         # Track reservations for rollback: the shared per-node caches
         # outlive this call, so a half-built placement that fails a later
@@ -792,18 +888,31 @@ class BatchSolver:
         granted_offers: list = []
         granted_devs: list = []
 
+        granted_cores: list = []
+        granted_cpu = 0
+
         def _rollback():
             for offer in granted_offers:
                 net_idx.remove_reserved(offer)
             if dev_alloc is not None:
                 for got in granted_devs:
                     dev_alloc.free[got["id"]].update(got["device_ids"])
+            if free_cores is not None:
+                free_cores.extend(granted_cores)
 
         task_resources: dict[str, AllocatedTaskResources] = {}
         for task in tg.tasks:
             tr = AllocatedTaskResources(
                 cpu=task.resources.cpu, memory_mb=task.resources.memory_mb
             )
+            if task.resources.cores > 0:
+                if free_cores is None or len(free_cores) < task.resources.cores:
+                    _rollback()
+                    return None
+                tr.reserved_cores = free_cores[: task.resources.cores]
+                del free_cores[: task.resources.cores]
+                granted_cores.extend(tr.reserved_cores)
+                tr.cpu = task.resources.cores * mhz_per_core
             for ask in task.resources.networks:
                 offer = net_idx.assign_network(ask)
                 if offer is None:
@@ -822,6 +931,7 @@ class BatchSolver:
                     return None  # instances exhausted on this node
                 granted_devs.append(got)
                 tr.devices.append(got)
+            granted_cpu += tr.cpu
             task_resources[task.name] = tr
         shared_networks = []
         for ask in tg.networks:
@@ -833,6 +943,10 @@ class BatchSolver:
             granted_offers.append(offer)
             shared_networks.append(offer)
 
+        if self._batch_has_cores:
+            self._batch_cpu[node.id] = (
+                self._batch_cpu.get(node.id, 0) + granted_cpu
+            )
         alloc = Allocation(
             id=generate_uuid(),
             namespace=grp.job.namespace,

@@ -53,6 +53,8 @@ class TPUSystemScheduler(SystemScheduler):
             bool(tg.networks)
             or any(t.resources.networks for t in tg.tasks)
             or any(t.resources.devices for t in tg.tasks)
+            # dedicated cores need per-node id grants (disjointness)
+            or any(t.resources.cores > 0 for t in tg.tasks)
             or any(
                 c.operand == CONSTRAINT_DISTINCT_PROPERTY
                 for c in all_constraints

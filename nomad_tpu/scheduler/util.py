@@ -139,6 +139,7 @@ def tasks_updated(job_a: Job, job_b: Job, tg_name: str) -> bool:
             or [a_.copy() for a_ in ta.artifacts] != [b_.copy() for b_ in tb.artifacts]
             or [t_.copy() for t_ in ta.templates] != [t_.copy() for t_ in tb.templates]
             or ta.resources.cpu != tb.resources.cpu
+            or ta.resources.cores != tb.resources.cores
             or ta.resources.memory_max_mb != tb.resources.memory_max_mb
             or ta.resources.memory_mb != tb.resources.memory_mb
             or [n.copy() for n in ta.resources.networks]

@@ -41,6 +41,28 @@ def score_fit_spread(node: Node, util: Resources) -> float:
     return max(0.0, min(MAX_FIT_SCORE, score))
 
 
+def node_core_pool(node, allocs):
+    """Free dedicated-core ids on a node given live allocs, plus the
+    node's MHz per core (the derived cpu share a `cores` grant carries).
+    The single source of truth both scheduler backends use, keeping
+    grant ordering and derivation in lockstep (reference: the cpuset
+    idset in structs/numalib)."""
+    total = node.resources.total_cores or 0
+    used: set[int] = set()
+    for a in allocs:
+        if not a.terminal_status() and a.resources is not None:
+            for tr in a.resources.tasks.values():
+                used.update(tr.reserved_cores)
+    free = [c for c in range(total) if c not in used]
+    # derive from AVAILABLE MHz (minus the client reserved carve-out):
+    # otherwise a node with any reservation could never grant all of
+    # its cores — the derived total would exceed what is grantable
+    mhz_per_core = (
+        node.available_resources().cpu // total if total else 0
+    )
+    return free, mhz_per_core
+
+
 def allocs_fit(
     node: Node,
     allocs: list[Allocation],
@@ -54,6 +76,7 @@ def allocs_fit(
     collisions; otherwise one is built here.
     """
     used = Resources(cpu=0, memory_mb=0, disk_mb=0)
+    seen_cores: set[int] = set()
     for alloc in allocs:
         if alloc.terminal_status():
             continue
@@ -61,6 +84,18 @@ def allocs_fit(
         used.cpu += r.cpu
         used.memory_mb += r.memory_mb
         used.disk_mb += r.disk_mb
+        # dedicated cores must be disjoint (reference funcs.go AllocsFit
+        # cpuset overlap check)
+        if alloc.resources is not None:
+            total = node.resources.total_cores or 0
+            for tr in alloc.resources.tasks.values():
+                for c in tr.reserved_cores:
+                    if c in seen_cores:
+                        return False, "cores (id collision)", used
+                    if c < 0 or c >= total:
+                        # node shrank since scheduling, or a corrupt grant
+                        return False, "cores (stale id)", used
+                    seen_cores.add(c)
 
     available = node.available_resources()
     ok, dim = available.superset(used)

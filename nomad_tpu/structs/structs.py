@@ -793,6 +793,15 @@ class Task:
             c.validate()
         for a in self.affinities:
             a.validate()
+        for svc in self.services:
+            for check in svc.checks:
+                if check.get("type") == "script" and not check.get(
+                    "command"
+                ):
+                    raise ValueError(
+                        f"task {self.name}: script check on service "
+                        f"{svc.name!r} requires a command"
+                    )
 
     def is_prestart(self) -> bool:
         return self.lifecycle is not None and self.lifecycle.hook == "prestart"
@@ -891,6 +900,26 @@ class TaskGroup:
         leaders = sum(1 for t in self.tasks if t.leader)
         if leaders > 1:
             raise ValueError(f"group {self.name}: only one task may be leader")
+        for svc in self.services:
+            for check in svc.checks:
+                if check.get("type") == "script":
+                    if not check.get("command"):
+                        raise ValueError(
+                            f"group {self.name}: script check on "
+                            f"service {svc.name!r} requires a command"
+                        )
+                    target = check.get("task", "")
+                    if not target:
+                        raise ValueError(
+                            f"group {self.name}: script check on group "
+                            f"service {svc.name!r} requires a task field"
+                        )
+                    if target not in names:
+                        raise ValueError(
+                            f"group {self.name}: script check on "
+                            f"service {svc.name!r} names unknown task "
+                            f"{target!r}"
+                        )
 
 
 @dataclass(slots=True)
@@ -1447,6 +1476,10 @@ class AllocatedTaskResources:
     memory_mb: int = 0
     networks: list[NetworkResource] = field(default_factory=list)
     devices: list[dict[str, Any]] = field(default_factory=list)
+    # dedicated core ids granted for a `cores` ask (reference
+    # structs.go AllocatedCpuResources.ReservedCores): disjoint across
+    # every alloc on the node; cpu above holds the DERIVED MHz
+    reserved_cores: list[int] = field(default_factory=list)
 
     def copy(self) -> "AllocatedTaskResources":
         return AllocatedTaskResources(
@@ -1454,6 +1487,7 @@ class AllocatedTaskResources:
             memory_mb=self.memory_mb,
             networks=[n.copy() for n in self.networks],
             devices=[dict(d) for d in self.devices],
+            reserved_cores=list(self.reserved_cores),
         )
 
 

@@ -196,3 +196,45 @@ def test_e2e_two_clients_node_down(cluster):
         == 4,
         timeout_s=15,
     ), "all 4 allocs should come back on the surviving node"
+
+
+def test_e2e_dedicated_cores_pin_and_env(cluster, tmp_path):
+    """A `cores` task sees NOMAD_CPU_CORES and actually runs pinned to
+    exactly those cores (reference: cpuset via LinuxResources; here
+    sched_setaffinity)."""
+    import sys as _sys
+
+    server, add_client = cluster
+    client = add_client()
+    # the client fingerprints the REAL host core count; ask for 1 core
+    marker = tmp_path / "cores.txt"
+    job = mock.batch_job()
+    t = job.task_groups[0].tasks[0]
+    t.driver = "rawexec"
+    t.resources.cores = 1
+    t.config = {
+        "command": _sys.executable,
+        "args": [
+            "-c",
+            "import os; print(os.environ['NOMAD_CPU_CORES']);"
+            "print(sorted(os.sched_getaffinity(0)))",
+        ],
+    }
+    job.datacenters = [client.node.datacenter]
+    server.job_register(job)
+    assert wait_until(
+        lambda: all(
+            a.client_status == "complete"
+            for a in server.state.allocs_by_job(job.namespace, job.id)
+        )
+        and len(server.state.allocs_by_job(job.namespace, job.id)) == 1,
+        20,
+    )
+    alloc = server.state.allocs_by_job(job.namespace, job.id)[0]
+    granted = list(alloc.resources.tasks.values())[0].reserved_cores
+    assert len(granted) == 1
+    out = client.alloc_runners[alloc.id].allocdir.stdout_path(t.name)
+    with open(out) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    assert lines[0] == ",".join(str(c) for c in granted)
+    assert lines[1] == str(sorted(int(c) for c in granted))

@@ -317,3 +317,40 @@ class TestDuration:
         assert parse_duration(45) == 45.0
         with pytest.raises(ValueError):
             parse_duration("nope")
+
+
+def test_parse_script_check_and_check_restart():
+    """Script checks carry command/args; check_restart nests limit and
+    grace (reference jobspec/parse_service.go)."""
+    from nomad_tpu.jobspec import parse_job
+
+    hcl = """
+    job "checked" {
+      group "g" {
+        task "t" {
+          driver = "mock"
+          service {
+            name = "svc"
+            port = "8080"
+            check {
+              type    = "script"
+              command = "/bin/check-health"
+              args    = ["--fast"]
+              interval = "5s"
+              check_restart {
+                limit = 3
+                grace = "10s"
+              }
+            }
+          }
+        }
+      }
+    }
+    """
+    job = parse_job(hcl)
+    check = job.task_groups[0].tasks[0].services[0].checks[0]
+    assert check["type"] == "script"
+    assert check["command"] == "/bin/check-health"
+    assert check["args"] == ["--fast"]
+    assert check["check_restart"] == {"limit": 3, "grace_s": 10.0}
+    assert "task" not in check  # only set when given

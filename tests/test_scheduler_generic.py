@@ -403,3 +403,108 @@ def test_reject_plan_forces_retry_then_fail():
     # scheduler retried up to the max, then failed the eval
     assert len(h.plans) == 5
     assert h.updates[-1].status == "failed"
+
+
+def test_dedicated_cores_disjoint_and_exhausting():
+    """`resources { cores }` grants DISJOINT core ids per node, derives
+    the cpu share from the node's MHz/core, and exhausts once a node's
+    cores are spoken for (reference rank.go AllocatedCpuResources)."""
+    h = Harness()
+    node = mock.node()  # 4 cores, 4000 MHz
+    h.state.upsert_node(h.next_index(), node)
+    job = mock.job(id="pinned")
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.cores = 2
+    tg.tasks[0].resources.cpu = 100
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", mock.eval_for_job(job))
+    placed = [
+        a
+        for plan in h.plans
+        for allocs in plan.node_allocation.values()
+        for a in allocs
+    ]
+    assert len(placed) == 2
+    grants = [
+        list(a.resources.tasks.values())[0].reserved_cores for a in placed
+    ]
+    assert all(len(g) == 2 for g in grants)
+    assert len(set(grants[0]) | set(grants[1])) == 4, (
+        f"ids must be disjoint: {grants}"
+    )
+    # derived cpu: 2 cores x (4000/4) MHz
+    assert all(
+        list(a.resources.tasks.values())[0].cpu == 2000 for a in placed
+    )
+    # a third 2-core alloc has nowhere to go: blocked
+    job2 = mock.job(id="pinned-2")
+    job2.task_groups[0].count = 1
+    job2.task_groups[0].tasks[0].resources.cores = 2
+    h.state.upsert_job(h.next_index(), job2)
+    h.process("service", mock.eval_for_job(job2))
+    assert not h.state.allocs_by_job("default", "pinned-2")
+
+
+def test_dedicated_cores_tpu_backend_parity():
+    """The TPU backend's materializer assigns the same disjoint-id
+    invariant (counts screened in the dense solve, ids at materialize)."""
+    from nomad_tpu.scheduler.context import SchedulerConfig
+
+    h = Harness()
+    for _ in range(2):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.job(id="pinned-tpu")
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.tasks[0].resources.cores = 2
+    h.state.upsert_job(h.next_index(), job)
+    h.process(
+        "service", mock.eval_for_job(job),
+        config=SchedulerConfig(backend="tpu"),
+    )
+    placed = [
+        a
+        for plan in h.plans
+        for allocs in plan.node_allocation.values()
+        for a in allocs
+    ]
+    assert len(placed) == 4  # 2 nodes x 4 cores / 2 per alloc
+    by_node = {}
+    for a in placed:
+        ids = list(a.resources.tasks.values())[0].reserved_cores
+        assert len(ids) == 2
+        by_node.setdefault(a.node_id, []).extend(ids)
+    for node_id, ids in by_node.items():
+        assert len(ids) == len(set(ids)) == 4, (
+            f"core collision on {node_id}: {ids}"
+        )
+
+
+def test_allocs_fit_rejects_core_collision():
+    """The plan applier's backstop: duplicate core ids on one node fail
+    verification (reference funcs.go AllocsFit)."""
+    from nomad_tpu.structs.funcs import allocs_fit
+    from nomad_tpu.structs.structs import (
+        AllocatedResources,
+        AllocatedTaskResources,
+    )
+
+    node = mock.node()
+    a1 = mock.alloc()
+    a1.resources = AllocatedResources(
+        tasks={"t": AllocatedTaskResources(
+            cpu=1000, memory_mb=64, reserved_cores=[0, 1]
+        )}
+    )
+    a2 = mock.alloc()
+    a2.resources = AllocatedResources(
+        tasks={"t": AllocatedTaskResources(
+            cpu=1000, memory_mb=64, reserved_cores=[1, 2]
+        )}
+    )
+    ok, dim, _ = allocs_fit(node, [a1, a2])
+    assert not ok and "cores" in dim
+    a2.resources.tasks["t"].reserved_cores = [2, 3]
+    ok, _, _ = allocs_fit(node, [a1, a2])
+    assert ok

@@ -128,3 +128,29 @@ def test_system_infeasible_node_skipped():
     assert allocs[0].node_id == good.id
     # failed placement recorded for the bad node
     assert h.updates[-1].queued_allocations.get("web") == 1
+
+
+def test_system_job_cores_assigned_on_tpu_backend():
+    """System jobs asking dedicated cores route through the per-node
+    walk on the TPU backend so every alloc carries real core ids."""
+    from nomad_tpu.scheduler.context import SchedulerConfig
+
+    h = Harness()
+    for _ in range(3):
+        h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.system_job(id="sys-pinned")
+    job.task_groups[0].tasks[0].resources.cores = 1
+    h.state.upsert_job(h.next_index(), job)
+    h.process(
+        "system", mock.eval_for_job(job),
+        config=SchedulerConfig(backend="tpu"),
+    )
+    allocs = [
+        a for a in h.state.allocs_by_job("default", "sys-pinned")
+        if a.desired_status == "run"
+    ]
+    assert len(allocs) == 3
+    for a in allocs:
+        tr = list(a.resources.tasks.values())[0]
+        assert len(tr.reserved_cores) == 1, a.node_id
+        assert tr.cpu == 1000  # 4000 MHz / 4 cores

@@ -834,3 +834,154 @@ def test_diff_randomized_clusters_match_host():
                             f"{free_mem}mb vs ask {ask.cpu}/"
                             f"{ask.memory_mb})"
                         )
+
+
+def test_tpu_cores_derived_cpu_screened_at_materialize():
+    """A cores ask whose DERIVED MHz exceeds what's left on a node must
+    not place there even though the declared cpu ask fits the dense
+    solve (the materializer's cpu ledger re-screens like rank.py)."""
+    h = Harness()
+    node = mock.node()  # 4000 MHz, 4 cores
+    h.state.upsert_node(h.next_index(), node)
+    # occupy 3000 MHz with a share-based job
+    fat = mock.job(id="fat")
+    fat.task_groups[0].count = 1
+    fat.task_groups[0].tasks[0].resources.cpu = 3000
+    h.state.upsert_job(h.next_index(), fat)
+    h.process("service", mock.eval_for_job(fat), config=tpu_config)
+    assert len(live(h, fat)) == 1
+    # cores=2 derives 2000 MHz > the 1000 remaining; declared cpu is 0
+    pin = mock.job(id="pin")
+    pin.task_groups[0].count = 1
+    pin.task_groups[0].tasks[0].resources.cores = 2
+    pin.task_groups[0].tasks[0].resources.cpu = 0
+    h.state.upsert_job(h.next_index(), pin)
+    h.process("service", mock.eval_for_job(pin), config=tpu_config)
+    assert not live(h, pin), "derived-cpu overcommit must not place"
+
+
+def test_tpu_cores_mixed_group_cpu_screen():
+    """A group mixing a cores task with a fat share task must screen the
+    WHOLE group's derived grant, not just the cores task's."""
+    h = Harness()
+    h.state.upsert_node(h.next_index(), mock.node())  # 4000 MHz / 4 cores
+    job = mock.job(id="mixed")
+    tg = job.task_groups[0]
+    tg.count = 1
+    from nomad_tpu.structs.structs import Resources, Task
+
+    tg.tasks[0].resources = Resources(cores=2, cpu=100, memory_mb=64)
+    tg.tasks.append(Task(
+        name="fat", driver="mock", config={},
+        resources=Resources(cpu=3000, memory_mb=64),
+    ))
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", mock.eval_for_job(job), config=tpu_config)
+    # derived 2000 + 3000 = 5000 > 4000: must not place
+    assert not live(h, job)
+
+
+def test_tpu_cores_sees_same_batch_fast_path_usage():
+    """The derived-cpu screen must count fast-path placements from the
+    SAME batch solve: a plain 3500 MHz group and a cores=1 (derived
+    1000 MHz) group can't both land on one 4000 MHz node."""
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.structs.structs import Resources
+
+    h = Harness()
+    h.state.upsert_node(h.next_index(), mock.node())
+    fat = mock.job(id="fat-batch")
+    fat.task_groups[0].count = 1
+    fat.task_groups[0].tasks[0].resources = Resources(
+        cpu=3500, memory_mb=64
+    )
+    pin = mock.job(id="pin-batch")
+    pin.task_groups[0].count = 1
+    pin.task_groups[0].tasks[0].resources = Resources(
+        cores=1, cpu=100, memory_mb=64
+    )
+    h.state.upsert_job(h.next_index(), fat)
+    h.state.upsert_job(h.next_index(), pin)
+    plans = solve_eval_batch(
+        h.snapshot(), h,
+        [mock.eval_for_job(fat), mock.eval_for_job(pin)],
+    )
+    placed = [
+        a
+        for plan in plans.values()
+        for allocs in plan.node_allocation.values()
+        for a in allocs
+    ]
+    granted = sum(
+        tr.cpu for a in placed for tr in a.resources.tasks.values()
+    )
+    # whichever wins, the combined grant must fit the node
+    assert granted <= 4000, f"overcommitted: {granted} MHz"
+    assert len(placed) == 1
+
+
+def test_tpu_cores_derived_excess_blocks_fast_path_neighbor():
+    """Reverse order of the previous test: the cores group materializes
+    FIRST (derived 1000 MHz vs declared 100), and the plain fast-path
+    group must see the derived excess through the shared ledger."""
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.structs.structs import Resources
+
+    h = Harness()
+    h.state.upsert_node(h.next_index(), mock.node())
+    pin = mock.job(id="pin-first")
+    pin.priority = 80  # solved before the lower-priority fat group
+    pin.task_groups[0].count = 1
+    pin.task_groups[0].tasks[0].resources = Resources(
+        cores=1, cpu=100, memory_mb=64
+    )
+    fat = mock.job(id="fat-second")
+    fat.priority = 20
+    fat.task_groups[0].count = 1
+    fat.task_groups[0].tasks[0].resources = Resources(
+        cpu=3500, memory_mb=64
+    )
+    h.state.upsert_job(h.next_index(), pin)
+    h.state.upsert_job(h.next_index(), fat)
+    plans = solve_eval_batch(
+        h.snapshot(), h,
+        [mock.eval_for_job(pin), mock.eval_for_job(fat)],
+    )
+    placed = [
+        a
+        for plan in plans.values()
+        for allocs in plan.node_allocation.values()
+        for a in allocs
+    ]
+    granted = sum(
+        tr.cpu for a in placed for tr in a.resources.tasks.values()
+    )
+    assert granted <= 4000, f"overcommitted: {granted} MHz"
+
+
+def test_tpu_cores_destructive_update_reuses_vacated_ids():
+    """A destructive update of a job holding ALL of a node's cores must
+    place its replacement in the same plan: the materializer's core
+    pool sees the in-plan stop as vacated (like the dense table)."""
+    from nomad_tpu.structs.structs import Resources
+
+    h = Harness()
+    h.state.upsert_node(h.next_index(), mock.node())  # 4 cores
+    job = mock.job(id="full-pin")
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources = Resources(
+        cores=4, cpu=100, memory_mb=64
+    )
+    h.state.upsert_job(h.next_index(), job)
+    h.process("service", mock.eval_for_job(job), config=tpu_config)
+    assert len(live(h, job)) == 1
+    # destructive update: change the task env → new version
+    updated = job.copy()
+    updated.task_groups[0].tasks[0].env = {"V": "2"}
+    updated.version = job.version + 1
+    h.state.upsert_job(h.next_index(), updated)
+    h.process("service", mock.eval_for_job(updated), config=tpu_config)
+    allocs = live(h, updated)
+    assert len(allocs) == 1, "replacement must place in the same pass"
+    tr = list(allocs[0].resources.tasks.values())[0]
+    assert sorted(tr.reserved_cores) == [0, 1, 2, 3]
