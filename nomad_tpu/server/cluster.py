@@ -946,7 +946,7 @@ class ClusterServer:
             store=self.raft_store,
             **raft_kw,
         )
-        self.server.set_raft_applier(self._raft_apply)
+        self.server.set_raft_applier(self._raft_apply, self._raft_apply_async)
         self.rpc.precheck = self._rpc_precheck
         self.rpc.register("Raft", self.raft.endpoint)
         for name, ep in (
@@ -1287,6 +1287,10 @@ class ClusterServer:
 
     def _raft_apply(self, msg_type: str, payload) -> int:
         return self.raft.apply(msg_type, payload)
+
+    def _raft_apply_async(self, msg_type: str, payload):
+        index, term = self.raft.apply_submit(msg_type, payload)
+        return index, (lambda: self.raft.apply_wait(index, term))
 
     def _on_leader_change(self, is_leader: bool) -> None:
         if is_leader:

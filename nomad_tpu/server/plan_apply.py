@@ -6,11 +6,22 @@ applier re-verifies every touched node against the LATEST state and commits
 only the subset that still fits. A partial commit sets refresh_index, which
 forces the worker to refresh its snapshot and retry the remainder.
 
-Reference parallelizes per-node verification over a pool
-(plan_apply_pool.go) and pipelines verification of plan N+1 with the Raft
-apply of plan N; under the GIL a thread pool buys nothing, so verification
-here is a straight loop over touched nodes — the batched TPU path already
-amortizes this by submitting fewer, larger plans.
+The reference parallelizes per-node verification over a worker pool
+(plan_apply_pool.go:18) and pipelines verification of plan N+1 with the
+Raft apply of plan N (plan_apply.go:54-63). Threads buy nothing under the
+GIL, so the same two overlaps are won differently here:
+
+- per-node verification is VECTORIZED: the state store maintains an
+  incremental per-node usage aggregate (state/store.py IDX_NODE_USED), so
+  each touched node's re-verification is an O(1) aggregate read plus one
+  numpy compare over the whole plan's node set, instead of re-summing
+  every node's allocs in interpreted loops. Nodes whose fit depends on
+  ports/cores/volumes take the exact per-node path (evaluate_node_plan).
+- the applier PIPELINES: verification of plan N+1 runs while the raft
+  commit of plan N is still in flight, against the latest snapshot with
+  plan N's result overlaid (OverlaySnapshot). Before responding to N's
+  worker the applier hands the commit-wait to a side thread, so the
+  verify loop never blocks on replication round-trips.
 """
 
 from __future__ import annotations
@@ -19,6 +30,9 @@ import logging
 import threading
 from typing import Callable, Optional
 
+import numpy as np
+
+from ..state.store import usage_contribution
 from ..structs import Plan, PlanResult, allocs_fit
 from ..structs.structs import NODE_STATUS_READY
 from .plan_queue import PlanQueue
@@ -106,9 +120,53 @@ def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
     return bad
 
 
+def _fast_path_usage(snapshot, plan: Plan, node_id: str, node):
+    """Try to express one node's re-verification as a 3-vector compare.
+
+    Returns (cpu, mem, disk) the node would hold after the plan, or None
+    when the node needs the exact path: some involved alloc carries cores
+    or port asks, or the node's own reserved ports could self-collide."""
+    used = snapshot.node_usage(node_id)
+    if used[3] > 0:
+        return None  # a committed alloc on this node has cores/ports
+    rp = node.reserved.reserved_ports
+    if rp and len(rp) != len(set(rp)) and node.resources.networks:
+        return None  # reserved-port self-collision is ip-dependent
+    cpu, mem, disk = used[0], used[1], used[2]
+    proposed = plan.node_allocation.get(node_id, [])
+    remove_ids = {a.id for a in plan.node_update.get(node_id, [])}
+    remove_ids |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+    remove_ids |= {a.id for a in proposed}
+    for aid in remove_ids:
+        stored = snapshot.alloc_by_id(aid)
+        if stored is not None and stored.node_id == node_id:
+            c = usage_contribution(stored)
+            if c is not None:
+                cpu -= c[0]
+                mem -= c[1]
+                disk -= c[2]
+    for alloc in proposed:
+        c = usage_contribution(alloc)
+        if c is None:
+            continue
+        if c[3]:
+            return None  # proposed alloc asks for cores/ports
+        cpu += c[0]
+        mem += c[1]
+        disk += c[2]
+    return (cpu, mem, disk)
+
+
 def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     """Re-verify the whole plan; return the committable subset
-    (reference :400)."""
+    (reference :400).
+
+    Vectorized: nodes whose fit is a pure cpu/mem/disk question — the
+    overwhelming majority — are verified with ONE numpy compare over the
+    plan's node set, reading the store's incremental per-node usage
+    aggregate. Only nodes involving ports, dedicated cores, or volume
+    claims re-walk their allocs (evaluate_node_plan, the exact oracle
+    this fast path is differential-tested against)."""
     result = PlanResult(
         node_update=dict(plan.node_update),
         node_allocation={},
@@ -123,21 +181,57 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     # serializes this per volume; our claim point is plan apply).
     vol_rejected = _volume_overcommitted_nodes(snapshot, plan)
     rejected = False
-    for node_id in plan.node_allocation:
-        ok, reason = (
-            (False, "volume write-claim conflict")
-            if node_id in vol_rejected
-            else evaluate_node_plan(snapshot, plan, node_id)
+
+    def reject(node_id: str, reason: str) -> None:
+        nonlocal rejected
+        rejected = True
+        # A rejected placement must not still evict its victims:
+        # preemptions free capacity FOR that node's placements and
+        # are meaningless without them.
+        result.node_preemptions.pop(node_id, None)
+        logger.debug("plan for node %s rejected: %s", node_id, reason)
+
+    fast_ids: list[str] = []
+    fast_rows: list[tuple[int, int, int, int, int, int]] = []
+    slow_ids: list[str] = []
+    for node_id, proposed in plan.node_allocation.items():
+        if node_id in vol_rejected:
+            reject(node_id, "volume write-claim conflict")
+            continue
+        if not proposed:
+            result.node_allocation[node_id] = proposed
+            continue
+        node = snapshot.node_by_id(node_id)
+        if node is None:
+            reject(node_id, "node does not exist")
+            continue
+        if node.status != NODE_STATUS_READY:
+            reject(node_id, f"node is {node.status}")
+            continue
+        usage = _fast_path_usage(snapshot, plan, node_id, node)
+        if usage is None:
+            slow_ids.append(node_id)
+            continue
+        avail = node.available_resources()
+        fast_ids.append(node_id)
+        fast_rows.append(
+            (usage[0], usage[1], usage[2], avail.cpu, avail.memory_mb, avail.disk_mb)
         )
+    if fast_rows:
+        rows = np.asarray(fast_rows, dtype=np.int64)
+        fits = (rows[:, :3] <= rows[:, 3:]).all(axis=1)
+        for node_id, ok in zip(fast_ids, fits):
+            if ok:
+                result.node_allocation[node_id] = plan.node_allocation[node_id]
+            else:
+                reject(node_id, "resources exhausted")
+    for node_id in slow_ids:
+        ok, reason = evaluate_node_plan(snapshot, plan, node_id)
         if ok:
             result.node_allocation[node_id] = plan.node_allocation[node_id]
         else:
-            rejected = True
-            # A rejected placement must not still evict its victims:
-            # preemptions free capacity FOR that node's placements and
-            # are meaningless without them.
-            result.node_preemptions.pop(node_id, None)
-            logger.debug("plan for node %s rejected: %s", node_id, reason)
+            reject(node_id, reason)
+
     if rejected:
         if plan.all_at_once:
             # all-or-nothing jobs: reject the ENTIRE plan — stops,
@@ -152,27 +246,202 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     return result
 
 
-class PlanApplier:
-    """Dequeues plans, verifies, applies through the raft layer."""
+def _contribution_with_job(alloc, default_job):
+    """usage_contribution for a plan alloc that may have been normalized
+    (job detached onto the PlanResult): compute with the result's job
+    temporarily re-attached, exactly as the FSM will see it at apply."""
+    if alloc.job is None and default_job is not None and alloc.job_id == default_job.id:
+        alloc.job = default_job
+        try:
+            return usage_contribution(alloc)
+        finally:
+            alloc.job = None
+    return usage_contribution(alloc)
 
-    def __init__(self, queue: PlanQueue, state, raft_apply: Callable) -> None:
+
+class OverlaySnapshot:
+    """The latest committed snapshot with one in-flight PlanResult
+    optimistically applied: what state WILL look like once the pending
+    plan's raft commit lands. Plan N+1 verifies against this while plan
+    N replicates — the pipelining of reference plan_apply.go:54-63,
+    without blocking on snapshotMinIndex.
+
+    Only the surface evaluate_plan reads is overlaid (allocs by id/node,
+    per-node usage); everything else delegates to the base snapshot.
+    Volume-touching plans never verify on an overlay (the applier drains
+    the pipeline first), so volume claims always read committed state."""
+
+    def __init__(self, base, result: PlanResult, job) -> None:
+        self.base = base
+        self.index = base.index
+        self._placed: dict[str, object] = {}
+        self._placed_by_node: dict[str, list] = {}
+        self._stopped: set[str] = set()
+        # node_id -> [cpu, mem, disk, complex] delta vs the base aggregate,
+        # mirroring exactly what the FSM's alloc writes will do to it.
+        delta: dict[str, list] = {}
+
+        def _sub_stored(alloc_id: str, node_id: str) -> None:
+            stored = base.alloc_by_id(alloc_id)
+            if stored is None or stored.node_id != node_id:
+                return
+            c = usage_contribution(stored)
+            if c is not None:
+                d = delta.setdefault(node_id, [0, 0, 0, 0])
+                for i in range(4):
+                    d[i] -= c[i]
+
+        for node_id, allocs in result.node_update.items():
+            for a in allocs:
+                self._stopped.add(a.id)
+                _sub_stored(a.id, node_id)
+        for node_id, allocs in result.node_preemptions.items():
+            for a in allocs:
+                self._stopped.add(a.id)
+                _sub_stored(a.id, node_id)
+        for node_id, allocs in result.node_allocation.items():
+            bucket = self._placed_by_node.setdefault(node_id, [])
+            for a in allocs:
+                self._placed[a.id] = a
+                bucket.append(a)
+                _sub_stored(a.id, node_id)
+                c = _contribution_with_job(a, job)
+                if c is not None:
+                    d = delta.setdefault(node_id, [0, 0, 0, 0])
+                    for i in range(4):
+                        d[i] += c[i]
+        self._usage_delta = delta
+
+    def __getattr__(self, name):
+        return getattr(self.base, name)
+
+    def node_usage(self, node_id: str):
+        base = self.base.node_usage(node_id)
+        d = self._usage_delta.get(node_id)
+        if d is None:
+            return base
+        return (base[0] + d[0], base[1] + d[1], base[2] + d[2], base[3] + d[3])
+
+    def alloc_by_id(self, alloc_id: str):
+        a = self._placed.get(alloc_id)
+        if a is not None:
+            return a
+        a = self.base.alloc_by_id(alloc_id)
+        if a is not None and alloc_id in self._stopped:
+            from ..structs.structs import ALLOC_DESIRED_STATUS_STOP
+
+            a = a.copy()
+            a.desired_status = ALLOC_DESIRED_STATUS_STOP
+        return a
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool = False):
+        out = []
+        for a in self.base.allocs_by_node_terminal(node_id, terminal):
+            if a.id in self._placed:
+                continue
+            if not terminal and a.id in self._stopped:
+                continue
+            out.append(a)
+        for a in self._placed_by_node.get(node_id, []):
+            if a.terminal_status() == terminal:
+                out.append(a)
+        return out
+
+
+def _plan_touches_volumes(plan: Plan) -> bool:
+    """Does any placement in this plan use task-group volumes? Such plans
+    must verify against committed state (volume claims commit atomically
+    with the plan that placed them, so an overlay could miss a pending
+    single-writer claim)."""
+    seen: set[tuple[int, str]] = set()
+    for allocs in plan.node_allocation.values():
+        for a in allocs:
+            job = a.job or plan.job
+            if job is None:
+                continue
+            key = (id(job), a.task_group)
+            if key in seen:
+                continue
+            seen.add(key)
+            tg = job.lookup_task_group(a.task_group)
+            if tg is not None and tg.volumes:
+                return True
+    return False
+
+
+class PlanApplier:
+    """Dequeues plans, verifies, applies through the raft layer.
+
+    Pipelined (reference plan_apply.go:54-63): after submitting plan N's
+    result to raft, the applier immediately verifies plan N+1 against the
+    latest snapshot with N's result overlaid; a completion thread waits
+    out N's commit and responds to its worker. At most one plan result is
+    in flight — the depth the reference runs at."""
+
+    def __init__(
+        self,
+        queue: PlanQueue,
+        state,
+        raft_apply: Callable,
+        raft_apply_async: Optional[Callable] = None,
+    ) -> None:
         self.queue = queue
         self.state = state  # live StateStore
         self.raft_apply = raft_apply  # (msg_type, payload) -> index
+        # (msg_type, payload) -> (index, wait_fn) — wait_fn blocks until
+        # committed+applied. None disables pipelining (serial fallback).
+        self.raft_apply_async = raft_apply_async
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._cthread: Optional[threading.Thread] = None
+        self._cq: list = []
+        self._cq_cv = threading.Condition()
+        self._outstanding = 0
+        # Bumped on every start(): a completion thread from a previous
+        # start/stop cycle that was stuck inside wait_fn past the join
+        # timeout must not touch the restarted applier's queue/counter.
+        self._gen = 0
+        # Set by the completion thread when a commit fails (leadership
+        # loss or timeout): the raft index whose fate is unknown. The
+        # overlay built from it must be discarded, and the next
+        # verification first gives the state store a short grace window
+        # to catch up — a TIMED-OUT commit can still land, and verifying
+        # without it would double-commit its capacity.
+        self._commit_failed_index = 0
+        # (raft index, PlanResult, job) of the not-yet-committed plan
+        self._inflight: Optional[tuple[int, PlanResult, object]] = None
 
     def start(self) -> None:
         self._stop.clear()
+        self._inflight = None
+        with self._cq_cv:
+            self._gen += 1
+            gen = self._gen
+            self._cq = []
+            self._outstanding = 0
+            self._commit_failed_index = 0
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="plan-applier"
         )
         self._thread.start()
+        if self.raft_apply_async is not None:
+            self._cthread = threading.Thread(
+                target=self._completion_loop,
+                args=(gen,),
+                daemon=True,
+                name="plan-applier-wait",
+            )
+            self._cthread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cq_cv:
+            self._cq_cv.notify_all()
         if self._thread:
             self._thread.join(timeout=2)
+        if self._cthread:
+            self._cthread.join(timeout=2)
+            self._cthread = None
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -181,19 +450,107 @@ class PlanApplier:
                 continue
             plan, fut = item
             try:
-                result = self.apply_one(plan)
-                fut.set_result(result)
+                self._apply_pipelined(plan, fut)
             except Exception as e:  # pragma: no cover - defensive
                 logger.exception("plan apply failed")
                 if not fut.done():
                     fut.set_exception(e)
 
-    def apply_one(self, plan: Plan) -> PlanResult:
+    # -- pipelined path -------------------------------------------------
+
+    def _apply_pipelined(self, plan: Plan, fut) -> None:
+        pipelining = self.raft_apply_async is not None
+        self._absorb_commit_failure()
+        if pipelining and self._inflight is not None and _plan_touches_volumes(plan):
+            self._drain()
+            self._absorb_commit_failure()
         snapshot = self.state.snapshot()
+        if self._inflight is not None:
+            idx, res, job = self._inflight
+            if snapshot.index >= idx:
+                self._inflight = None  # committed and applied; base is current
+            else:
+                snapshot = OverlaySnapshot(snapshot, res, job)
         result = evaluate_plan(snapshot, plan)
         if result.is_no_op():
-            return result
+            fut.set_result(result)
+            return
         result.preemption_evals = self._preemption_evals(result)
+        self._normalize(plan, result)
+        if not pipelining:
+            index = self.raft_apply("apply_plan_results", result)
+            result.alloc_index = index
+            fut.set_result(result)
+            return
+        index, wait_fn = self.raft_apply_async("apply_plan_results", result)
+        # Depth-1 pipeline: wait out the PREVIOUS commit (its replication
+        # overlapped with the verification we just finished) before
+        # recording this one as in flight.
+        self._drain()
+        self._inflight = (index, result, plan.job)
+        with self._cq_cv:
+            self._cq.append((index, wait_fn, result, fut))
+            self._outstanding += 1
+            self._cq_cv.notify_all()
+
+    def _absorb_commit_failure(self) -> None:
+        """If an in-flight commit failed, discard its overlay — after
+        giving the state store a short window to catch up, since a commit
+        that failed by TIMEOUT may still land and verifying without its
+        effects would double-commit capacity. If the index never arrives
+        the entry is presumed truncated (leadership moved): subsequent
+        submits fail leader checks, so nothing stale can commit."""
+        with self._cq_cv:
+            failed_idx = self._commit_failed_index
+            self._commit_failed_index = 0
+        if not failed_idx:
+            return
+        try:
+            self.state.snapshot_min_index(failed_idx, timeout_s=1.0)
+        except TimeoutError:
+            pass
+        self._inflight = None
+
+    def _drain(self) -> None:
+        """Block until every submitted result has committed (or failed)
+        and its worker has been answered."""
+        with self._cq_cv:
+            while self._outstanding > 0:
+                self._cq_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+
+    def _completion_loop(self, gen: int) -> None:
+        while True:
+            with self._cq_cv:
+                while (
+                    not self._cq
+                    and not self._stop.is_set()
+                    and gen == self._gen
+                ):
+                    self._cq_cv.wait(0.5)
+                if gen != self._gen:
+                    return  # superseded by a restart; a new thread owns _cq
+                if self._stop.is_set() and not self._cq:
+                    return
+                index, wait_fn, result, fut = self._cq.pop(0)
+            try:
+                result.alloc_index = wait_fn()
+                fut.set_result(result)
+            except Exception as e:
+                with self._cq_cv:
+                    if gen == self._gen:
+                        self._commit_failed_index = index
+                if not fut.done():
+                    fut.set_exception(e)
+            finally:
+                with self._cq_cv:
+                    if gen == self._gen:
+                        self._outstanding -= 1
+                        self._cq_cv.notify_all()
+
+    @staticmethod
+    def _normalize(plan: Plan, result: PlanResult) -> None:
         # Normalize before the log encodes the payload: embedded Job copies
         # would serialize once PER ALLOCATION (a c2m-scale plan would pack
         # ~100k Jobs). The scheduled job version rides ONCE on the result
@@ -209,6 +566,16 @@ class PlanApplier:
                 for a in allocs:
                     if a.job is result.job:
                         a.job = None
+
+    def apply_one(self, plan: Plan) -> PlanResult:
+        """Serial verify+commit of one plan (direct callers and tests;
+        the dequeue loop runs the pipelined path)."""
+        snapshot = self.state.snapshot()
+        result = evaluate_plan(snapshot, plan)
+        if result.is_no_op():
+            return result
+        result.preemption_evals = self._preemption_evals(result)
+        self._normalize(plan, result)
         index = self.raft_apply("apply_plan_results", result)
         result.alloc_index = index
         return result

@@ -279,6 +279,13 @@ class InmemLog:
         self.fsm.apply(index, msg_type, codec.unpack(raw))
         return index
 
+    def apply_async(self, msg_type: str, payload):
+        """Async-apply contract: (index, wait_fn). Single-node in-memory
+        apply is synchronous, so the waiter is already resolved — the plan
+        applier's pipeline degenerates to serial here, which is correct."""
+        index = self.apply(msg_type, payload)
+        return index, (lambda: index)
+
     def entries_since(self, index: int) -> list[tuple[int, str, object]]:
         with self._lock:
             return [e for e in self._entries if e[0] > index]

@@ -259,6 +259,13 @@ class RaftNode:
     def apply(self, msg_type: str, payload, timeout_s: float = 10.0):
         """Append on the leader, replicate, block until committed AND
         applied locally. Returns the entry index."""
+        index, term = self.apply_submit(msg_type, payload)
+        return self.apply_wait(index, term, timeout_s)
+
+    def apply_submit(self, msg_type: str, payload) -> tuple[int, int]:
+        """Append on the leader and kick replication WITHOUT waiting for
+        the commit. Returns (index, term) for apply_wait. This is what
+        lets the plan applier verify plan N+1 while plan N replicates."""
         # Encode OUTSIDE the lock: packing a large plan payload under
         # _lock would stall the replication loops' heartbeats and get the
         # leader deposed. The bytes depend only on the payload.
@@ -277,6 +284,10 @@ class RaftNode:
                 ev.set()
             if not self.peers:
                 self._advance_commit_locked()
+        return index, term
+
+    def apply_wait(self, index: int, term: int, timeout_s: float = 10.0) -> int:
+        """Block until a submitted entry is committed and applied locally."""
         deadline = time.monotonic() + timeout_s
         with self._commit_cv:
             while self.last_applied < index:

@@ -95,7 +95,9 @@ class Server:
                 "nomad.plan_queue", lambda: {"depth": self.plan_queue.depth()}
             )),
         ]
-        self.plan_applier = PlanApplier(self.plan_queue, self.state, self.raft_apply)
+        self.plan_applier = PlanApplier(
+            self.plan_queue, self.state, self.raft_apply, self.raft_apply_async
+        )
         self.blocked_evals = BlockedEvals(self._requeue_unblocked)
         self.heartbeaters = HeartbeatTimers(self._invalidate_heartbeat)
         self.heartbeaters.node_count_fn = lambda: len(self.state.nodes())
@@ -225,17 +227,27 @@ class Server:
 
     # -- raft ----------------------------------------------------------
 
-    def set_raft_applier(self, applier) -> None:
+    def set_raft_applier(self, applier, applier_async=None) -> None:
         """Swap the single-node InmemLog for a replicated log (the cluster
         layer installs RaftNode.apply). Every subsystem routes through
-        raft_apply, so nothing else changes."""
+        raft_apply, so nothing else changes. applier_async is the
+        submit-without-waiting variant the plan applier pipelines on."""
         self._raft_applier = applier
+        self._raft_applier_async = applier_async
 
     def raft_apply(self, msg_type: str, payload) -> int:
         applier = getattr(self, "_raft_applier", None)
         if applier is not None:
             return applier(msg_type, payload)
         return self.log.apply(msg_type, payload)
+
+    def raft_apply_async(self, msg_type: str, payload):
+        """Submit a raft entry and return (index, wait_fn) without
+        blocking on the commit."""
+        applier = getattr(self, "_raft_applier_async", None)
+        if applier is not None:
+            return applier(msg_type, payload)
+        return self.log.apply_async(msg_type, payload)
 
     # -- FSM side channels --------------------------------------------
 
