@@ -65,80 +65,174 @@ def _register_builtin_structs() -> None:
         register_type(getattr(driver_base, name))
 
 
-def to_wire(obj: Any) -> Any:
+# Per-class encode/decode plans. A raft apply of a c2m-scale plan packs
+# and unpacks ~10⁵ Allocations; per-object dataclasses.fields() reflection
+# was the single largest cost of applying a plan. Each entry:
+#   cls -> list[(name, compare_default, factory_or_None, has_default)]
+# compare_default is what an encoder elides against; factory (when set)
+# is what a decoder calls to mint a FRESH default for a missing field —
+# mutable defaults must never be shared across decoded objects.
+_FIELD_PLANS: dict[type, list] = {}
+# cls -> frozenset(field names) for dataclasses, None for other
+# registered types (JobSummary et al round-trip via __dict__).
+_DATACLASSES: dict[type, Optional[frozenset]] = {}
+
+_SCALARS = frozenset((bool, int, float, str, bytes, type(None)))
+_MISSING = object()
+
+
+def _field_plan(cls: type) -> list:
+    plan = _FIELD_PLANS.get(cls)
+    if plan is None:
+        plan = []
+        for f in dataclasses.fields(cls):
+            if f.default is not dataclasses.MISSING:
+                plan.append((f.name, f.default, None, True))
+            elif f.default_factory is not dataclasses.MISSING:
+                plan.append((f.name, f.default_factory(), f.default_factory, True))
+            else:
+                plan.append((f.name, None, None, False))
+        _FIELD_PLANS[cls] = plan
+    return plan
+
+
+def to_wire(obj: Any, _elide: bool = False) -> Any:
     """Lower to JSON/msgpack-able data. Unknown object types are an error —
-    payloads must be built from registered structs and primitives."""
-    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+    payloads must be built from registered structs and primitives.
+
+    With _elide (the pack()/RPC path), dataclass fields still equal to
+    their declared default are OMITTED: decoders restore defaults for
+    missing fields (the version-skew path), so elision is lossless for
+    struct consumers — and most fields of bulk payloads (plan allocs) are
+    defaults, which is the difference between encoding ~40 and ~8 fields
+    per Allocation. The HTTP/JSON path keeps full field sets: the UI and
+    third-party API clients read raw JSON, not rehydrated structs."""
+    cls = obj.__class__
+    if obj is None or cls in _SCALARS:
         return obj
-    if isinstance(obj, tuple):
-        return {_TUPLE_KEY: [to_wire(v) for v in obj]}
-    if isinstance(obj, (list, set, frozenset)):
-        return [to_wire(v) for v in obj]
-    if isinstance(obj, dict):
+    if _elide:
+        enc = _ENCODERS.get(cls)
+        if enc is not None:
+            return enc(obj)
+    if cls is list:
+        return [to_wire(v, _elide) for v in obj]
+    if cls is dict:
         # A "$"-prefixed key in user data could collide with our tags
         # ($t/$tuple/$map/$b64) — escape such dicts into the pair-list
         # form, which decodes any keys verbatim.
-        if all(isinstance(k, str) for k in obj) and not any(
-            k.startswith("$") for k in obj
-        ):
-            return {k: to_wire(v) for k, v in obj.items()}
-        return {_MAP_KEY: [[to_wire(k), to_wire(v)] for k, v in obj.items()]}
-    cls = type(obj)
+        if all(type(k) is str and not k.startswith("$") for k in obj):
+            return {k: to_wire(v, _elide) for k, v in obj.items()}
+        return {
+            _MAP_KEY: [
+                [to_wire(k, _elide), to_wire(v, _elide)] for k, v in obj.items()
+            ]
+        }
+    if isinstance(obj, tuple):
+        return {_TUPLE_KEY: [to_wire(v, _elide) for v in obj]}
+    if isinstance(obj, (bool, int, float, str, bytes)):
+        return obj
+    if isinstance(obj, (list, set, frozenset)):
+        return [to_wire(v, _elide) for v in obj]
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) and not k.startswith("$") for k in obj):
+            return {k: to_wire(v, _elide) for k, v in obj.items()}
+        return {
+            _MAP_KEY: [
+                [to_wire(k, _elide), to_wire(v, _elide)] for k, v in obj.items()
+            ]
+        }
     if dataclasses.is_dataclass(obj):
         if cls.__name__ not in _REGISTRY:
             register_type(cls)
+        if _elide:
+            enc = _ENCODERS.get(cls)
+            if enc is None:
+                enc = _gen_encoder(cls)
+            return enc(obj)
         out: dict[str, Any] = {_TYPE_KEY: cls.__name__}
-        for f in dataclasses.fields(obj):
-            out[f.name] = to_wire(getattr(obj, f.name))
+        for name, _default, _factory, _has_default in _field_plan(cls):
+            out[name] = to_wire(getattr(obj, name))
         return out
     # Non-dataclass registered types (e.g. JobSummary) round-trip via
     # __dict__.
     if cls.__name__ in _REGISTRY:
         out = {_TYPE_KEY: cls.__name__}
         for k, v in vars(obj).items():
-            out[k] = to_wire(v)
+            out[k] = to_wire(v, _elide)
         return out
     raise TypeError(f"cannot encode {cls.__name__!r} for the wire")
 
 
-def from_wire(data: Any) -> Any:
-    if data is None or isinstance(data, (bool, int, float, str, bytes)):
-        return data
-    if isinstance(data, list):
-        return [from_wire(v) for v in data]
-    if isinstance(data, dict):
-        if _TUPLE_KEY in data and len(data) == 1:
-            return tuple(from_wire(v) for v in data[_TUPLE_KEY])
-        if _MAP_KEY in data and len(data) == 1:
-            return {from_wire(k): from_wire(v) for k, v in data[_MAP_KEY]}
-        if _BYTES_KEY in data and len(data) == 1:
-            import base64
+def _dataclass_fields(cls: type) -> Optional[frozenset]:
+    """frozenset of field names for dataclasses (cached), None for other
+    registered types (JobSummary et al round-trip via __dict__)."""
+    names = _DATACLASSES.get(cls, _MISSING)
+    if names is _MISSING:
+        names = (
+            frozenset(f.name for f in dataclasses.fields(cls))
+            if dataclasses.is_dataclass(cls)
+            else None
+        )
+        _DATACLASSES[cls] = names
+    return names
 
-            return base64.b64decode(data[_BYTES_KEY])
+
+def _restore_defaults(obj, data: dict, cls: type) -> None:
+    """Fields the sender elided or didn't know about (defaults / version
+    skew) get their declared defaults so the struct is always fully
+    formed — mutable ones freshly minted, never shared across objects."""
+    for name, default, factory, has_default in _field_plan(cls):
+        if name in data:
+            continue
+        if factory is not None:
+            setattr(obj, name, factory())
+        elif has_default:
+            setattr(obj, name, default)
+
+
+def from_wire(data: Any) -> Any:
+    cls = data.__class__
+    if data is None or cls in _SCALARS:
+        return data
+    if cls is list:
+        return [from_wire(v) for v in data]
+    if cls is dict:
         tname = data.get(_TYPE_KEY)
-        if tname is None:
-            return {k: from_wire(v) for k, v in data.items()}
-        cls = _REGISTRY.get(tname)
-        if cls is None:
-            raise TypeError(f"unknown wire type {tname!r}")
-        obj = cls.__new__(cls)
-        seen = set()
-        for k, v in data.items():
-            if k == _TYPE_KEY:
-                continue
-            setattr(obj, k, from_wire(v))
-            seen.add(k)
-        # Fields the sender didn't know about (version skew) get their
-        # declared defaults so the struct is always fully formed.
-        if dataclasses.is_dataclass(cls):
-            for f in dataclasses.fields(cls):
-                if f.name in seen:
-                    continue
-                if f.default is not dataclasses.MISSING:
-                    setattr(obj, f.name, f.default)
-                elif f.default_factory is not dataclasses.MISSING:
-                    setattr(obj, f.name, f.default_factory())
-        return obj
+        if tname is not None:
+            tcls = _REGISTRY.get(tname)
+            if tcls is None:
+                raise TypeError(f"unknown wire type {tname!r}")
+            obj = tcls.__new__(tcls)
+            field_names = _dataclass_fields(tcls)
+            for k, v in data.items():
+                # Unknown sender fields (version skew) are dropped — the
+                # same rule the msgpack hook applies, and slots classes
+                # could not hold them anyway.
+                if k != _TYPE_KEY and (field_names is None or k in field_names):
+                    setattr(obj, k, from_wire(v))
+            if field_names is not None:
+                _restore_defaults(obj, data, tcls)
+            return obj
+        if len(data) == 1:
+            if _TUPLE_KEY in data:
+                return tuple(from_wire(v) for v in data[_TUPLE_KEY])
+            if _MAP_KEY in data:
+                return {
+                    from_wire(k): from_wire(v) for k, v in data[_MAP_KEY]
+                }
+            if _BYTES_KEY in data:
+                import base64
+
+                return base64.b64decode(data[_BYTES_KEY])
+        return {k: from_wire(v) for k, v in data.items()}
+    if isinstance(data, (bool, int, float, str, bytes)):
+        return data
+    if isinstance(data, (list, dict)):  # subclasses
+        return (
+            [from_wire(v) for v in data]
+            if isinstance(data, list)
+            else {k: from_wire(v) for k, v in data.items()}
+        )
     raise TypeError(f"cannot decode wire value of type {type(data).__name__}")
 
 
@@ -156,12 +250,101 @@ def json_default(o):
     raise TypeError(f"not JSON serializable: {type(o).__name__}")
 
 
+# cls -> generated elide-encoder. Like the dataclasses module itself,
+# the codec compiles a specialized function per class: scalar fields are
+# compared and emitted inline (no recursive to_wire frame per int/str),
+# which matters when a raft apply packs 10⁵ allocs.
+_ENCODERS: dict[type, Any] = {}
+
+
+def _gen_encoder(cls: type):
+    lines = [
+        "def _enc(obj):",
+        f"    out = {{{_TYPE_KEY!r}: {cls.__name__!r}}}",
+    ]
+    ns: dict[str, Any] = {"_w": to_wire}
+    for i, (name, default, _factory, has_default) in enumerate(
+        _field_plan(cls)
+    ):
+        v, d, t = f"v{i}", f"d{i}", f"t{i}"
+        lines.append(f"    {v} = obj.{name}")
+        if not has_default:
+            lines.append(f"    out[{name!r}] = _w({v}, True)")
+        elif default is None:
+            lines.append(f"    if {v} is not None:")
+            lines.append(f"        out[{name!r}] = _w({v}, True)")
+        elif default.__class__ in (bool, int, float, str, bytes):
+            ns[d] = default
+            ns[t] = default.__class__
+            lines.append(f"    if {v}.__class__ is {t}:")
+            lines.append(f"        if {v} != {d}:")
+            lines.append(f"            out[{name!r}] = {v}")
+            lines.append(f"    else:")
+            lines.append(f"        out[{name!r}] = _w({v}, True)")
+        else:
+            ns[d] = default
+            ns[t] = default.__class__
+            lines.append(
+                f"    if not ({v}.__class__ is {t} and {v} == {d}):"
+            )
+            lines.append(f"        out[{name!r}] = _w({v}, True)")
+    lines.append("    return out")
+    exec("\n".join(lines), ns)
+    enc = ns["_enc"]
+    _ENCODERS[cls] = enc
+    return enc
+
+
+def _object_hook(data: dict) -> Any:
+    """Per-map decode hook for msgpack: children are already decoded by
+    the C unpacker (scalars/lists never surface to Python), so this runs
+    once per MAP — the struct-count, not the value-count, bounds the
+    Python work of unpacking a bulk payload."""
+    tname = data.pop(_TYPE_KEY, None)
+    if tname is not None:
+        cls = _REGISTRY.get(tname)
+        if cls is None:
+            raise TypeError(f"unknown wire type {tname!r}")
+        field_names = _dataclass_fields(cls)
+        if field_names is not None:
+            try:
+                # The generated __init__ fills every elided/missing field
+                # with its declared default (fresh factory instances) in
+                # one call — the decode hot path.
+                return cls(**data)
+            except TypeError:
+                # sender knows fields we don't (version skew): keep the
+                # intersection and default the rest
+                obj = cls.__new__(cls)
+                for k, v in data.items():
+                    if k in field_names:
+                        setattr(obj, k, v)
+                _restore_defaults(obj, data, cls)
+                return obj
+        obj = cls.__new__(cls)
+        for k, v in data.items():
+            setattr(obj, k, v)
+        return obj
+    if len(data) == 1:
+        if _TUPLE_KEY in data:
+            return tuple(data[_TUPLE_KEY])
+        if _MAP_KEY in data:
+            return {k: v for k, v in data[_MAP_KEY]}
+        if _BYTES_KEY in data:
+            import base64
+
+            return base64.b64decode(data[_BYTES_KEY])
+    return data
+
+
 def pack(obj: Any) -> bytes:
-    return msgpack.packb(to_wire(obj), use_bin_type=True)
+    return msgpack.packb(to_wire(obj, _elide=True), use_bin_type=True)
 
 
 def unpack(raw: bytes) -> Any:
-    return from_wire(msgpack.unpackb(raw, raw=False, strict_map_key=False))
+    return msgpack.unpackb(
+        raw, raw=False, strict_map_key=False, object_hook=_object_hook
+    )
 
 
 _register_builtin_structs()

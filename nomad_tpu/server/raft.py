@@ -251,10 +251,12 @@ class InmemLog:
     the entries for tests and for the Phase-2 replication layer to seed
     followers."""
 
-    def __init__(self, fsm: FSM) -> None:
+    def __init__(self, fsm: FSM, start_index: int = 0) -> None:
         self.fsm = fsm
         self._lock = threading.Lock()
-        self._index = 0
+        # start_index: first entry gets start_index+1 — lets a log wrap a
+        # state store that already holds indexed writes (bench harnesses).
+        self._index = start_index
         self._entries: list[tuple[int, str, object]] = []
 
     @property
