@@ -280,12 +280,25 @@ def run_drain_config():
         return h, svcs, sysjob
 
     def drain_nodes(h):
+        from nomad_tpu.structs.structs import DesiredTransition
+
         nodes = h.state.nodes()[:drain_n]
         for n in nodes:
             h.state.update_node_drain(
                 h.next_index(), n.id, DrainStrategy(deadline_s=300)
             )
-        return {n.id for n in nodes}
+        # The node drainer marks each draining node's allocs for
+        # migration (drainer.py / reference drainer/watch_nodes.go);
+        # without the marks a drain eval is a no-op and the config
+        # measures nothing but reconcile overhead.
+        drained = {n.id for n in nodes}
+        marks = {
+            a.id: DesiredTransition(migrate=True)
+            for nid in drained
+            for a in h.state.allocs_by_node_terminal(nid, False)
+        }
+        h.state.update_alloc_desired_transition(h.next_index(), marks, [])
+        return drained
 
     def drain_evals(h, svcs, sysjob, drained):
         from nomad_tpu import mock as m

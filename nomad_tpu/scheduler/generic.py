@@ -48,6 +48,7 @@ from .reconcile import AllocReconciler, PlacementRequest
 from .stack import GenericStack
 from .util import (
     SchedulerRetryError,
+    annotate_previous_alloc,
     ready_nodes_in_dcs,
     retry_max,
     tainted_nodes,
@@ -330,23 +331,7 @@ class GenericScheduler:
                 for p in option.preempted_allocs:
                     self.plan.append_preempted_alloc(p, alloc.id)
 
-            prev = req.previous_alloc
-            if prev is not None:
-                alloc.previous_allocation = prev.id
-                if req.reschedule:
-                    tracker = (
-                        prev.reschedule_tracker.copy()
-                        if prev.reschedule_tracker
-                        else RescheduleTracker()
-                    )
-                    tracker.events.append(
-                        RescheduleEvent(
-                            reschedule_time_ns=now_ns(),
-                            prev_alloc_id=prev.id,
-                            prev_node_id=prev.node_id,
-                        )
-                    )
-                    alloc.reschedule_tracker = tracker
+            annotate_previous_alloc(alloc, req)
             self.plan.append_alloc(alloc, job)
             queued[tg.name] = max(0, queued.get(tg.name, 0) - 1)
 

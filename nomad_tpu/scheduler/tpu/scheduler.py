@@ -136,10 +136,12 @@ class TPUGenericScheduler(GenericScheduler):
                         dstate.placed_allocs += 1
             elif job.type == "service" and active_deployment is not None:
                 alloc.deployment_id = active_deployment.id
-            self.plan.append_fresh_alloc(alloc, job)
+            if not outcome.pre_appended:
+                self.plan.append_fresh_alloc(alloc, job)
             queued[alloc.task_group] = max(0, queued.get(alloc.task_group, 0) - 1)
-        for victim, by_id in outcome.preemptions.get(eval_obj.id, []):
-            self.plan.append_preempted_alloc(victim, by_id)
+        if not outcome.pre_appended:
+            for victim, by_id in outcome.preemptions.get(eval_obj.id, []):
+                self.plan.append_preempted_alloc(victim, by_id)
 
         self.failed_tg_allocs = outcome.failures.get(eval_obj.id, {})
         self.queued_allocs = queued
@@ -253,8 +255,10 @@ def solve_eval_batch(
                     dstate = deployment.task_groups.get(alloc.task_group)
                     if dstate is not None and deployment is plan.deployment:
                         dstate.placed_allocs += 1
-            plan.append_fresh_alloc(alloc, job)
-        for victim, by_id in outcome.preemptions.get(ev.id, []):
-            plan.append_preempted_alloc(victim, by_id)
+            if not outcome.pre_appended:
+                plan.append_fresh_alloc(alloc, job)
+        if not outcome.pre_appended:
+            for victim, by_id in outcome.preemptions.get(ev.id, []):
+                plan.append_preempted_alloc(victim, by_id)
         ev.failed_tg_allocs = outcome.failures.get(ev.id, {})
     return plans

@@ -106,6 +106,33 @@ def update_non_terminal_allocs_to_lost(
                                       ALLOC_CLIENT_STATUS_LOST)
 
 
+def annotate_previous_alloc(alloc, req) -> None:
+    """previous_allocation + reschedule-tracker wiring, shared by the host
+    placement loop (generic.py computePlacements), the dense materializer
+    (tpu/solver.py _build_alloc), and the small-batch host path — one
+    copy so reschedule-event semantics cannot drift between backends."""
+    from ..structs.structs import RescheduleEvent, RescheduleTracker, now_ns
+
+    prev = req.previous_alloc
+    if prev is None:
+        return
+    alloc.previous_allocation = prev.id
+    if req.reschedule:
+        tracker = (
+            prev.reschedule_tracker.copy()
+            if prev.reschedule_tracker
+            else RescheduleTracker()
+        )
+        tracker.events.append(
+            RescheduleEvent(
+                reschedule_time_ns=now_ns(),
+                prev_alloc_id=prev.id,
+                prev_node_id=prev.node_id,
+            )
+        )
+        alloc.reschedule_tracker = tracker
+
+
 def tasks_updated(job_a: Job, job_b: Job, tg_name: str) -> bool:
     """Do two job versions differ such that the group's allocs must be
     destructively replaced? (reference: util.go tasksUpdated :993).

@@ -461,7 +461,7 @@ def test_dedicated_cores_tpu_backend_parity():
     h.state.upsert_job(h.next_index(), job)
     h.process(
         "service", mock.eval_for_job(job),
-        config=SchedulerConfig(backend="tpu"),
+        config=SchedulerConfig(backend="tpu", small_batch_threshold=0),
     )
     placed = [
         a

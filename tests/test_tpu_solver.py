@@ -14,7 +14,7 @@ from nomad_tpu.structs import Constraint, Spread
 from nomad_tpu.structs.node_class import compute_node_class
 from nomad_tpu.testing import Harness
 
-tpu_config = SchedulerConfig(backend="tpu")
+tpu_config = SchedulerConfig(backend="tpu", small_batch_threshold=0)
 
 
 def fill_nodes(h, count, **overrides):
@@ -118,7 +118,7 @@ def _run_both(setup_fn, count=10, n_nodes=10):
     for backend in ("host", "tpu"):
         h = Harness()
         job = setup_fn(h)
-        cfg = SchedulerConfig(backend=backend)
+        cfg = SchedulerConfig(backend=backend, small_batch_threshold=0)
         h.process(job.type, mock.eval_for_job(job), config=cfg)
         results[backend] = (h, job)
     return results
@@ -277,7 +277,7 @@ def test_batch_solve_many_evals_one_kernel():
         h.state.upsert_job(h.next_index(), job)
         jobs.append(job)
         evals.append(mock.eval_for_job(job))
-    plans = solve_eval_batch(h.snapshot(), h, evals)
+    plans = solve_eval_batch(h.snapshot(), h, evals, SchedulerConfig(small_batch_threshold=0))
     assert len(plans) == 5
     total = 0
     for ev in evals:
@@ -397,7 +397,7 @@ def test_tpu_batch_preemption_many_nodes():
 
     ev = mock.eval_for_job(job)
     plans = solve_eval_batch(
-        h.state.snapshot(), h, [ev], SchedulerConfig(backend="tpu")
+        h.state.snapshot(), h, [ev], SchedulerConfig(backend="tpu", small_batch_threshold=0)
     )
     plan = plans[ev.id]
     placed = [a for allocs in plan.node_allocation.values() for a in allocs]
@@ -520,6 +520,7 @@ def test_sharded_preempt_end_to_end_solver():
     h.state.upsert_job(h.next_index(), lo)
     plans = solve_eval_batch(
         h.snapshot(), h, [mock.eval_for_job(lo)],
+        SchedulerConfig(small_batch_threshold=0),
         solve_fn=make_sharded_solver(mesh),
         solve_preempt_fn=make_sharded_solver_preempt(mesh),
     )
@@ -534,6 +535,7 @@ def test_sharded_preempt_end_to_end_solver():
     h.state.upsert_job(h.next_index(), hi)
     plans = solve_eval_batch(
         h.snapshot(), h, [mock.eval_for_job(hi)],
+        SchedulerConfig(small_batch_threshold=0),
         solve_fn=make_sharded_solver(mesh),
         solve_preempt_fn=make_sharded_solver_preempt(mesh),
     )
@@ -574,7 +576,7 @@ def test_diff_system_scheduler_matches_host():
     for backend in ("host", "tpu"):
         h = Harness()
         job = build(h)
-        h.process("system", mock.eval_for_job(job), SchedulerConfig(backend=backend))
+        h.process("system", mock.eval_for_job(job), SchedulerConfig(backend=backend, small_batch_threshold=0))
         placed[backend] = {
             h.state.node_by_id(a.node_id).attributes.get("role", "")
             for a in h.state.allocs_by_job(job.namespace, job.id)
@@ -610,7 +612,7 @@ def test_tpu_system_two_groups_share_capacity():
         job.task_groups.append(tg2)
         h.state.upsert_job(h.next_index(), job)
         h.process("system", mock.eval_for_job(job),
-                  SchedulerConfig(backend=backend))
+                  SchedulerConfig(backend=backend, small_batch_threshold=0))
         live_allocs = [
             a
             for a in h.state.allocs_by_job(job.namespace, job.id)
@@ -655,7 +657,7 @@ def test_diff_system_distinct_property_matches_host():
         job = build(h)
         h.process(
             "system", mock.eval_for_job(job),
-            SchedulerConfig(backend=backend),
+            SchedulerConfig(backend=backend, small_batch_threshold=0),
         )
         counts: dict = {}
         for a in h.state.allocs_by_job(job.namespace, job.id):
@@ -698,7 +700,7 @@ def test_diff_system_task_level_distinct_property():
         job = build(h)
         h.process(
             "system", mock.eval_for_job(job),
-            SchedulerConfig(backend=backend),
+            SchedulerConfig(backend=backend, small_batch_threshold=0),
         )
         live = [
             a
@@ -794,7 +796,8 @@ def test_diff_randomized_clusters_match_host():
         for backend in ("host", "tpu"):
             h, jobs, nodes = build(seed)
             cfg = SchedulerConfig(
-                backend=backend, preemption_service=False
+                backend=backend, preemption_service=False,
+                small_batch_threshold=0,
             )
             for job in jobs:
                 h.process("service", mock.eval_for_job(job), cfg)
@@ -905,6 +908,7 @@ def test_tpu_cores_sees_same_batch_fast_path_usage():
     plans = solve_eval_batch(
         h.snapshot(), h,
         [mock.eval_for_job(fat), mock.eval_for_job(pin)],
+        SchedulerConfig(small_batch_threshold=0),
     )
     placed = [
         a
@@ -946,6 +950,7 @@ def test_tpu_cores_derived_excess_blocks_fast_path_neighbor():
     plans = solve_eval_batch(
         h.snapshot(), h,
         [mock.eval_for_job(pin), mock.eval_for_job(fat)],
+        SchedulerConfig(small_batch_threshold=0),
     )
     placed = [
         a
@@ -985,3 +990,147 @@ def test_tpu_cores_destructive_update_reuses_vacated_ids():
     assert len(allocs) == 1, "replacement must place in the same pass"
     tr = list(allocs[0].resources.tasks.values())[0]
     assert sorted(tr.reserved_cores) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Small-batch fast path (VERDICT r3 #3): host-stack routing under the
+# threshold must behave like the dense kernel — differential.
+# ---------------------------------------------------------------------------
+
+
+def test_small_batch_routes_to_host_and_matches_dense():
+    """The same small batch solved below and above the routing threshold
+    places the same load with the same capacity safety."""
+    import random as _random
+
+    for seed in (3, 17, 42):
+        outcomes = {}
+        for threshold in (0, 10_000):  # dense vs host fast path
+            _random.seed(seed)
+            h = Harness()
+            fill_nodes(h, 8)
+            jobs = []
+            for j in range(3):
+                job = mock.job(id=f"sb-{j}")
+                job.task_groups[0].count = 4
+                job.task_groups[0].tasks[0].resources.networks = []
+                h.state.upsert_job(h.next_index(), job)
+                jobs.append(job)
+            from nomad_tpu.scheduler.tpu import solve_eval_batch
+
+            evals = [mock.eval_for_job(j) for j in jobs]
+            plans = solve_eval_batch(
+                h.snapshot(), h, evals,
+                SchedulerConfig(small_batch_threshold=threshold),
+            )
+            for ev in evals:
+                h.submit_plan(plans[ev.id])
+            placed = {j.id: len(live(h, j)) for j in jobs}
+            outcomes[threshold] = placed
+            # capacity safety on every node
+            for n in h.state.nodes():
+                used = sum(
+                    a.comparable_resources().cpu
+                    for a in h.state.allocs_by_node_terminal(n.id, False)
+                )
+                assert used <= n.resources.cpu, (seed, threshold, n.id)
+        assert outcomes[0] == outcomes[10_000], seed
+
+
+def test_small_batch_fast_path_ports_and_failures():
+    """Port asks and unsatisfiable groups behave identically on the fast
+    path: static port conflicts fail the overflow, failures surface in
+    eval.failed_tg_allocs."""
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.structs.structs import NetworkResource, Port
+
+    h = Harness()
+    fill_nodes(h, 2)
+    job = mock.job(id="static-port")
+    tg = job.task_groups[0]
+    tg.count = 3  # 3 static-port asks on 2 nodes: one must fail
+    tg.tasks[0].resources.networks = [
+        NetworkResource(reserved_ports=[Port("http", 8080)])
+    ]
+    h.state.upsert_job(h.next_index(), job)
+    ev = mock.eval_for_job(job)
+    plans = solve_eval_batch(
+        h.snapshot(), h, [ev], SchedulerConfig()  # default threshold: host path
+    )
+    h.submit_plan(plans[ev.id])
+    allocs = live(h, job)
+    assert len(allocs) == 2
+    assert {a.node_id for a in allocs} == {n.id for n in h.state.nodes()}
+    assert "web" in ev.failed_tg_allocs
+    for a in allocs:
+        ports = [
+            p.value
+            for tr in a.resources.tasks.values()
+            for net in tr.networks
+            for p in net.reserved_ports
+        ]
+        assert ports == [8080]
+
+
+def test_small_batch_fast_path_sees_plan_stops():
+    """A destructive update on a full node must reuse the vacated slot —
+    the fast path's stack reads the plan's stops (ProposedAllocs)."""
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.structs.structs import Resources
+
+    h = Harness()
+    h.state.upsert_node(h.next_index(), mock.node())
+    job = mock.job(id="full-node")
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources = Resources(cpu=3800, memory_mb=256)
+    h.state.upsert_job(h.next_index(), job)
+    ev = mock.eval_for_job(job)
+    plans = solve_eval_batch(h.snapshot(), h, [ev], SchedulerConfig())
+    h.submit_plan(plans[ev.id])
+    assert len(live(h, job)) == 1
+
+    updated = job.copy()
+    updated.task_groups[0].tasks[0].env = {"V": "2"}
+    updated.version = job.version + 1
+    h.state.upsert_job(h.next_index(), updated)
+    ev2 = mock.eval_for_job(updated)
+    plans = solve_eval_batch(h.snapshot(), h, [ev2], SchedulerConfig())
+    h.submit_plan(plans[ev2.id])
+    allocs = live(h, updated)
+    assert len(allocs) == 1, "replacement must land in the vacated slot"
+    assert allocs[0].job.version == updated.version
+
+
+def test_small_batch_cross_eval_no_double_booking():
+    """Two evals in one small batch must see each other's placements:
+    3 single-alloc evals of 3000 MHz on 2x4000 MHz nodes place exactly 2
+    (the dense path's answer), not 3 piled on one node."""
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.structs.structs import Resources
+
+    h = Harness()
+    fill_nodes(h, 2)
+    jobs = []
+    for j in range(3):
+        job = mock.job(id=f"fat-{j}")
+        job.task_groups[0].count = 1
+        job.task_groups[0].tasks[0].resources = Resources(
+            cpu=3000, memory_mb=64
+        )
+        h.state.upsert_job(h.next_index(), job)
+        jobs.append(job)
+    evals = [mock.eval_for_job(j) for j in jobs]
+    plans = solve_eval_batch(
+        h.snapshot(), h, evals,
+        SchedulerConfig(preemption_service=False),  # default threshold: host path
+    )
+    placed_nodes = [
+        node_id
+        for p in plans.values()
+        for node_id, allocs in p.node_allocation.items()
+        for _ in allocs
+    ]
+    assert len(placed_nodes) == 2, f"placed {len(placed_nodes)}, want 2"
+    assert len(set(placed_nodes)) == 2, "two placements double-booked a node"
+    failed = [ev for ev in evals if ev.failed_tg_allocs]
+    assert len(failed) == 1
