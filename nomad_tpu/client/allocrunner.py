@@ -187,8 +187,12 @@ class AllocRunner:
             )
             self._services.start()
         # Deployment allocs get a health watcher (reference
-        # alloc_runner_hooks.go: allocHealthWatcherHook → client/allochealth)
-        if self.alloc.deployment_id and self.alloc.deployment_status is None:
+        # alloc_runner_hooks.go: allocHealthWatcherHook → client/allochealth).
+        # Canaries arrive with a deployment_status already attached
+        # (canary=True, healthy=None) — "not yet judged" is healthy=None,
+        # not status=None.
+        ds = self.alloc.deployment_status
+        if self.alloc.deployment_id and (ds is None or ds.healthy is None):
             self._health = HealthTracker(
                 self.alloc, self._task_states, self._set_health
             )
@@ -251,7 +255,12 @@ class AllocRunner:
 
     def _set_health(self, healthy: bool) -> None:
         with self._lock:
-            self.alloc.deployment_status = new_deployment_status(healthy)
+            status = new_deployment_status(healthy)
+            # the canary marker rides the same struct — never clobber it
+            prev = self.alloc.deployment_status
+            if prev is not None:
+                status.canary = prev.canary
+            self.alloc.deployment_status = status
         self.on_update(self.alloc)
 
     def _task_state_updated(self) -> None:

@@ -19,6 +19,8 @@ from ..structs import (
     now_ns,
 )
 from ..structs.structs import (
+    AllocDeploymentStatus,
+    DEPLOYMENT_STATUS_FAILED,
     EVAL_TRIGGER_FORCE_EVAL,
     ALLOC_CLIENT_STATUS_FAILED,
     ALLOC_DESIRED_STATUS_STOP,
@@ -212,7 +214,11 @@ class GenericScheduler:
             deployment = self.state.latest_deployment_by_job(
                 eval_obj.namespace, eval_obj.job_id
             )
-            if deployment is not None and not deployment.active():
+            if deployment is not None and not deployment.active() and (
+                deployment.status != DEPLOYMENT_STATUS_FAILED
+            ):
+                # failed deployments stay attached: they gate placements
+                # and their canaries need cleanup (reconcile.py)
                 deployment = None
 
         reconciler = AllocReconciler(
@@ -298,6 +304,7 @@ class GenericScheduler:
                     self.failed_tg_allocs[tg.name] = metric
                 continue
 
+            pjob = req.job_override if req.job_override is not None else job
             alloc = Allocation(
                 id=generate_uuid(),
                 namespace=self.eval.namespace,
@@ -305,14 +312,16 @@ class GenericScheduler:
                 name=req.name,
                 node_id=option.node.id,
                 node_name=option.node.name,
-                job_id=job.id,
-                job=job,
+                job_id=pjob.id,
+                job=pjob,
                 task_group=tg.name,
                 resources=option.alloc_resources,
                 metrics=metric,
                 desired_status="run",
                 client_status="pending",
             )
+            if req.canary:
+                alloc.deployment_status = AllocDeploymentStatus(canary=True)
             if self.plan.deployment is not None and tg.update is not None:
                 alloc.deployment_id = self.plan.deployment.id
                 dstate = self.plan.deployment.task_groups.get(tg.name)
@@ -332,7 +341,7 @@ class GenericScheduler:
                     self.plan.append_preempted_alloc(p, alloc.id)
 
             annotate_previous_alloc(alloc, req)
-            self.plan.append_alloc(alloc, job)
+            self.plan.append_alloc(alloc, pjob)
             queued[tg.name] = max(0, queued.get(tg.name, 0) - 1)
 
         self.queued_allocs = queued

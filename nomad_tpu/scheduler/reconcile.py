@@ -5,9 +5,21 @@ allocSet/allocNameIndex). Computes, per task group: placements, stops,
 in-place updates, destructive updates, migrations, delayed reschedules
 (follow-up evals), and deployment bookkeeping.
 
-Round-1 scope note: rolling deployments (max_parallel batching, auto-revert
-bookkeeping, progress deadlines) are implemented; canary placement is tracked
-through DeploymentState but canary-specific placement naming is simplified.
+Canary semantics follow the reference in full (reconcile.go:341
+computeGroup): handleGroupCanaries stops stale canaries, canary state
+gates destructive updates and placements (computeLimit :666), canaries
+take names from NextCanaries (reconcile_util.go:519 — destructive
+indexes first, then free, then overflow past count), computeStop prefers
+stopping non-canary duplicates after promotion (:772), and non-canary
+replacements placed during a canary deployment are downgraded to the old
+job version (allocPlaceResult.downgradeNonCanary).
+
+One deliberate departure: the reference identifies an OLDER deployment's
+non-promoted canaries via its oldDeployment handle; here they are
+recognized by the alloc canary flag plus a foreign deployment_id — our
+store clears the flag on promotion (store.promote_deployment), so a
+still-flagged canary of another deployment is exactly a non-promoted
+stale canary.
 """
 
 from __future__ import annotations
@@ -32,6 +44,8 @@ from ..structs.structs import (
     ALLOC_DESIRED_STATUS_RUN,
     ALLOC_DESIRED_STATUS_STOP,
     DEPLOYMENT_STATUS_CANCELLED,
+    DEPLOYMENT_STATUS_FAILED,
+    DEPLOYMENT_STATUS_PAUSED,
     DEPLOYMENT_STATUS_RUNNING,
     DEPLOYMENT_STATUS_SUCCESSFUL,
     JOB_TYPE_BATCH,
@@ -64,6 +78,10 @@ class PlacementRequest:
     penalty_node: str = ""
     min_job_version: int = 0
     lost: bool = False
+    # Replacements made while a canary deployment is unpromoted must run
+    # the OLD job version (reference allocPlaceResult.downgradeNonCanary):
+    # the schedulers place with this job instead of the eval's current one.
+    job_override: Optional[Job] = None
 
 
 @dataclass
@@ -122,6 +140,8 @@ class AllocReconciler:
         self.batch = batch
         self.now_ns = now_fn()
         self.results = ReconcileResults()
+        self.deployment_paused = False
+        self.deployment_failed = False
 
     # ------------------------------------------------------------------
 
@@ -130,6 +150,14 @@ class AllocReconciler:
 
         # Cancel deployments for stopped jobs or version mismatch.
         self._cancel_stale_deployments(stopped)
+
+        if self.deployment is not None:
+            self.deployment_paused = (
+                self.deployment.status == DEPLOYMENT_STATUS_PAUSED
+            )
+            self.deployment_failed = (
+                self.deployment.status == DEPLOYMENT_STATUS_FAILED
+            )
 
         groups = {tg.name: tg for tg in self.job.task_groups} if not stopped else {}
         by_group: dict[str, list[Allocation]] = {}
@@ -156,33 +184,50 @@ class AllocReconciler:
                     status_description="Deployment completed successfully",
                 )
             )
+        # A created deployment that needs promotion says so (reference
+        # Compute :243 sets the running-needs-promotion description).
+        d = self.results.deployment
+        if d is not None and d.requires_promotion():
+            if any(s.auto_promote for s in d.task_groups.values()):
+                d.status_description = "Deployment is running pending automatic promotion"
+            else:
+                d.status_description = "Deployment is running but requires manual promotion"
         return self.results
 
     def _cancel_stale_deployments(self, stopped: bool) -> None:
+        """reference cancelDeployments :258: stopped jobs and version
+        mismatches cancel; successful clears; FAILED deployments remain
+        attached (they gate placements via deployment_failed)."""
         d = self.deployment
         if d is None:
             return
         if stopped:
-            self.results.deployment_updates.append(
-                DeploymentStatusUpdate(
-                    deployment_id=d.id,
-                    status=DEPLOYMENT_STATUS_CANCELLED,
-                    status_description="Cancelled because job is stopped",
+            if d.active():
+                self.results.deployment_updates.append(
+                    DeploymentStatusUpdate(
+                        deployment_id=d.id,
+                        status=DEPLOYMENT_STATUS_CANCELLED,
+                        status_description="Cancelled because job is stopped",
+                    )
                 )
-            )
             self.deployment = None
             return
         if d.job_version != self.job.version:
-            self.results.deployment_updates.append(
-                DeploymentStatusUpdate(
-                    deployment_id=d.id,
-                    status=DEPLOYMENT_STATUS_CANCELLED,
-                    status_description="Cancelled due to newer version of job",
+            if d.active():
+                self.results.deployment_updates.append(
+                    DeploymentStatusUpdate(
+                        deployment_id=d.id,
+                        status=DEPLOYMENT_STATUS_CANCELLED,
+                        status_description="Cancelled due to newer version of job",
+                    )
                 )
-            )
             self.deployment = None
             return
-        if not d.active():
+        if d.status not in (
+            DEPLOYMENT_STATUS_RUNNING,
+            DEPLOYMENT_STATUS_PAUSED,
+            DEPLOYMENT_STATUS_FAILED,
+        ):
             self.deployment = None
 
     # ------------------------------------------------------------------
@@ -200,39 +245,63 @@ class AllocReconciler:
                     summary.stop += 1
             return True
 
-        # Partition by node taint and client status (reference:
-        # reconcile_util.go filterByTainted + filterByRescheduleable).
+        desired = tg.count
+        strategy = tg.update
+
+        # Deployment state for the group (reference computeGroup :362):
+        # a fresh dstate is prepared even before deciding to create the
+        # deployment; it attaches only if needed.
+        dstate: Optional[DeploymentState] = None
+        existing_deployment = False
+        if self.deployment is not None:
+            dstate = self.deployment.task_groups.get(name)
+            existing_deployment = dstate is not None
+        if not existing_deployment and strategy is not None:
+            dstate = DeploymentState(
+                auto_revert=strategy.auto_revert,
+                auto_promote=strategy.auto_promote,
+                progress_deadline_s=strategy.progress_deadline_s,
+            )
+
+        all_ = [a for a in allocs if not a.server_terminal_status()]
+
+        # Canaries: stop stale ones, collect the current deployment's
+        # (reference handleGroupCanaries :614).
+        canaries, all_ = self._handle_group_canaries(name, all_, summary)
+        canary_ids = {a.id for a in canaries}
+
+        # --- partition by node taint (reference filterByTainted) ---
+        untainted: list[Allocation] = []
         migrate: list[Allocation] = []
         lost: list[Allocation] = []
-        resched_now: list[Allocation] = []
-        resched_later: list[tuple[Allocation, int]] = []
-        stable: list[Allocation] = []
-        completed: list[Allocation] = []  # batch-only: ran to completion
-        for a in allocs:
-            if a.server_terminal_status():
-                continue  # already stopping
+        for a in all_:
             node = self.tainted.get(a.node_id, "ok")
             if node != "ok" and not a.client_terminal_status():
                 if node is None or node.status == NODE_STATUS_DOWN:
                     lost.append(a)
                 elif a.desired_transition.should_migrate():
-                    # The drainer subsystem marks allocs for migration with
-                    # rate limiting (reference reconcile_util.go
-                    # filterByTainted: drain-node allocs migrate only once
-                    # DesiredTransition.ShouldMigrate is set).
+                    # The drainer marks allocs for migration with rate
+                    # limiting; unmarked drain-node allocs wait their turn.
                     migrate.append(a)
                 else:
-                    stable.append(a)  # awaiting its drainer slot
+                    untainted.append(a)
                 continue
             if (
                 a.desired_transition.should_migrate()
                 and not a.client_terminal_status()
             ):
-                # `alloc stop` on a healthy node (reference
-                # reconcile_util.go filterByTainted: an untainted alloc
-                # with ShouldMigrate still migrates)
+                # `alloc stop` / migrate on a healthy node
                 migrate.append(a)
                 continue
+            untainted.append(a)
+
+        # --- rescheduleability (reference filterByRescheduleable) ---
+        kept: list[Allocation] = []
+        resched_now: list[Allocation] = []
+        resched_later: list[tuple[Allocation, int]] = []
+        for a in untainted:
+            if a.next_allocation and a.terminal_status():
+                continue  # already replaced
             if a.client_status == ALLOC_CLIENT_STATUS_FAILED:
                 if a.desired_transition.should_force_reschedule():
                     resched_now.append(a)
@@ -243,147 +312,233 @@ class AllocReconciler:
                         resched_now.append(a)
                     else:
                         resched_later.append((a, when))
-                        stable.append(a)  # keeps its name until replaced
+                        kept.append(a)  # keeps its name until replaced
                 else:
-                    stable.append(a)  # attempts exhausted: leave it failed
+                    kept.append(a)  # attempts exhausted: stays failed
             elif a.client_status == ALLOC_CLIENT_STATUS_COMPLETE:
                 if self.batch:
-                    completed.append(a)  # done; keeps name, never replaced
-                # service: name is released and the count refilled below
+                    kept.append(a)  # ran successfully: holds its name
+                # service: name released, count refilled below
             elif a.client_status == ALLOC_CLIENT_STATUS_LOST:
                 pass  # replaced via missing-count placement
             else:
-                stable.append(a)
+                kept.append(a)
+        untainted = kept
 
-        desired = tg.count
-
-        # Name index over allocs that keep their names.
+        # Name index over allocs that keep names (reference :403).
         used_names = (
-            {a.name for a in stable}
+            {a.name for a in untainted}
             | {a.name for a in migrate}
-            | {a.name for a in completed}
+            | {a.name for a in resched_now}
+            | {a.name for a in lost}
         )
         name_index = _NameIndex(self.job_id, name, desired, used_names)
 
-        # --- stops: scale down ---
-        keep = [a for a in stable]
-        n_live = len(keep) + len(migrate)
-        if n_live > desired:
-            excess = n_live - desired
-            # prefer stopping migrating allocs? reference stops highest indexes
-            removable = sorted(
-                keep, key=lambda a: (a.index() < desired, -a.index())
-            )
-            for a in removable[:excess]:
-                self.results.stop.append((a, ALLOC_NOT_NEEDED, ""))
-                summary.stop += 1
-                keep.remove(a)
-                name_index.release(a.name)
-            n_live = len(keep) + len(migrate)
+        canary_state = (
+            dstate is not None
+            and dstate.desired_canaries != 0
+            and not dstate.promoted
+        )
 
-        # --- deployment handling ---
-        dstate: Optional[DeploymentState] = None
-        if self.deployment is not None:
-            dstate = self.deployment.task_groups.get(name)
+        # --- stops (reference computeStop :772) ---
+        stop_ids = self._compute_stop(
+            tg, name_index, untainted, migrate, lost, canaries, canary_state,
+            summary,
+        )
+        untainted = [a for a in untainted if a.id not in stop_ids]
+        migrate = [a for a in migrate if a.id not in stop_ids]
 
-        # Updates among the kept allocs (job version drift).
+        # --- updates (reference computeUpdates :879) ---
         inplace: list[Allocation] = []
         destructive: list[Allocation] = []
-        for a in keep:
+        for a in untainted:
             if a.job is None or a.job.version == self.job.version:
                 summary.ignore += 1
-                continue
-            if tasks_updated(self.job, a.job, name):
+            elif tasks_updated(self.job, a.job, name):
                 destructive.append(a)
             else:
                 inplace.append(a)
-
-        # Should we create a deployment? Service jobs with an update strategy
-        # and pending destructive/new placements get one.
-        requires_deploy = (
-            tg.update is not None
-            and not self.batch
-            and self.job.type == "service"
-            and not self.job.stopped()
-            and (destructive or len(keep) + len(migrate) < desired or inplace)
-        )
-        if requires_deploy and self.deployment is None:
-            self.deployment = new_deployment(self.job)
-            self.results.deployment = self.deployment
-        if self.deployment is not None and tg.update is not None:
-            if name not in self.deployment.task_groups:
-                dstate = DeploymentState(
-                    auto_revert=tg.update.auto_revert,
-                    auto_promote=tg.update.auto_promote,
-                    desired_total=desired,
-                    desired_canaries=tg.update.canary,
-                    progress_deadline_s=tg.update.progress_deadline_s,
-                )
-                self.deployment.task_groups[name] = dstate
-            else:
-                dstate = self.deployment.task_groups[name]
-
-        # In-place updates pass straight through.
         for a in inplace:
             updated = a.copy()
             updated.job = self.job
             self.results.inplace_update.append(updated)
             summary.in_place += 1
+        if not existing_deployment and dstate is not None:
+            dstate.desired_total += len(destructive) + len(inplace)
 
-        # Destructive updates are limited by max_parallel of healthy slack.
-        limit = self._update_limit(tg, dstate, len(destructive))
-        for a in destructive[:limit]:
-            req = PlacementRequest(
-                name=a.name,
-                task_group=tg,
-                previous_alloc=a,
-                min_job_version=self.job.version,
-            )
-            self.results.destructive_update.append((a, req))
-            summary.destructive += 1
-        for a in destructive[limit:]:
-            summary.ignore += 1
+        # Remove canaries from placement decisions (reference :422).
+        if canary_state:
+            untainted = [a for a in untainted if a.id not in canary_ids]
 
-        # Migrations: stop + replacement carrying the same name.
-        for a in migrate:
-            self.results.stop.append((a, ALLOC_MIGRATING, ""))
-            summary.migrate += 1
-            summary.place += 1  # queued accounting counts every placement
-            self.results.place.append(
-                PlacementRequest(
-                    name=a.name,
-                    task_group=tg,
-                    previous_alloc=a,
-                )
-            )
-
-        # Lost: mark lost (client status) + replacement.
-        for a in lost:
-            self.results.stop.append((a, ALLOC_LOST, ALLOC_CLIENT_STATUS_LOST))
-            summary.stop += 1
-            if not self.batch or a.client_status != ALLOC_CLIENT_STATUS_COMPLETE:
+        # Destructive updates pending and fewer canaries than asked:
+        # create canaries (reference :426-446).
+        canaries_promoted = dstate is not None and dstate.promoted
+        require_canary = (
+            len(destructive) != 0
+            and strategy is not None
+            and strategy.canary > 0
+            and len(canaries) < strategy.canary
+            and not canaries_promoted
+            # canaries ride deployments, which only service jobs get —
+            # a batch job with a stray update stanza must not churn
+            and not self.batch
+            and self.job.type == "service"
+        )
+        if require_canary:
+            dstate.desired_canaries = strategy.canary
+        if require_canary and not self.deployment_paused and not self.deployment_failed:
+            n = strategy.canary - len(canaries)
+            summary.canary += n
+            for cname in name_index.next_canaries(n, canaries, destructive):
                 self.results.place.append(
-                    PlacementRequest(
-                        name=a.name,
-                        task_group=tg,
-                        previous_alloc=a,
-                        lost=True,
-                    )
+                    PlacementRequest(name=cname, task_group=tg, canary=True)
                 )
                 summary.place += 1
+        canary_state = (
+            dstate is not None
+            and dstate.desired_canaries != 0
+            and not dstate.promoted
+        )
 
-        # Reschedule now: replacement with penalty on previous node.
+        limit = self._compute_limit(tg, untainted, destructive, migrate, canary_state)
+
+        # --- placements (reference computePlacements :712) ---
+        downgrade = self._downgrade_job(untainted) if canary_state else None
+
+        def _downgrade_for(a: Optional[Allocation]) -> Optional[Job]:
+            if not canary_state:
+                return None
+            if a is not None:
+                if a.deployment_status is not None and a.deployment_status.canary:
+                    return None  # canaries replace at the new version
+                return a.job if a.job is not None and a.job.version != self.job.version else None
+            return downgrade
+
+        def _tg_for(job_override: Optional[Job]) -> TaskGroup:
+            if job_override is not None:
+                old_tg = job_override.lookup_task_group(name)
+                if old_tg is not None:
+                    return old_tg
+            return tg
+
+        place: list[PlacementRequest] = []
         for a in resched_now:
-            self.results.place.append(
+            ov = _downgrade_for(a)
+            place.append(
                 PlacementRequest(
                     name=a.name,
-                    task_group=tg,
+                    task_group=_tg_for(ov),
                     previous_alloc=a,
                     reschedule=True,
                     penalty_node=a.node_id,
+                    canary=(
+                        a.deployment_status is not None
+                        and a.deployment_status.canary
+                    ),
+                    job_override=ov,
+                    min_job_version=a.job.version if a.job else 0,
                 )
             )
-            summary.place += 1
+        existing = len(untainted) + len(migrate) + len(resched_now)
+        for a in lost:
+            if existing >= desired:
+                break  # at count: do not replace remaining lost
+            existing += 1
+            ov = _downgrade_for(a)
+            place.append(
+                PlacementRequest(
+                    name=a.name,
+                    task_group=_tg_for(ov),
+                    previous_alloc=a,
+                    lost=True,
+                    canary=(
+                        a.deployment_status is not None
+                        and a.deployment_status.canary
+                    ),
+                    job_override=ov,
+                )
+            )
+        if existing < desired:
+            ov = _downgrade_for(None)
+            for _ in range(desired - existing):
+                idx = name_index.next()
+                place.append(
+                    PlacementRequest(
+                        name=alloc_name(self.job_id, name, idx),
+                        task_group=_tg_for(ov),
+                        job_override=ov,
+                    )
+                )
+        if not existing_deployment and dstate is not None:
+            dstate.desired_total += len(place)
+
+        deployment_place_ready = (
+            not self.deployment_paused
+            and not self.deployment_failed
+            and not canary_state
+        )
+        if deployment_place_ready:
+            self.results.place.extend(place)
+            summary.place += len(place)
+            for a in resched_now:
+                self.results.stop.append((a, ALLOC_RESCHEDULED, ""))
+                summary.stop += 1
+            limit -= min(len(place), limit)
+        else:
+            # Paused/failed/canarying deployments still replace lost
+            # allocs and reschedule failures (reference :477-505), except
+            # failures belonging to the failed deployment itself.
+            for req in place:
+                if req.lost:
+                    self.results.place.append(req)
+                    summary.place += 1
+                elif req.reschedule:
+                    prev = req.previous_alloc
+                    if self.deployment_failed and prev is not None and (
+                        self.deployment is not None
+                        and prev.deployment_id == self.deployment.id
+                    ):
+                        continue
+                    self.results.place.append(req)
+                    summary.place += 1
+                    self.results.stop.append((prev, ALLOC_RESCHEDULED, ""))
+                    summary.stop += 1
+
+        # --- destructive updates (reference :507-522) ---
+        if deployment_place_ready:
+            n = min(len(destructive), limit)
+            for a in sorted(destructive, key=lambda x: x.index())[:n]:
+                req = PlacementRequest(
+                    name=a.name,
+                    task_group=tg,
+                    previous_alloc=a,
+                    min_job_version=self.job.version,
+                )
+                self.results.destructive_update.append((a, req))
+                summary.destructive += 1
+            summary.ignore += len(destructive) - n
+        else:
+            summary.ignore += len(destructive)
+
+        # --- migrations (reference :524-541) ---
+        for a in sorted(migrate, key=lambda x: x.index()):
+            self.results.stop.append((a, ALLOC_MIGRATING, ""))
+            summary.migrate += 1
+            summary.place += 1  # queued accounting counts every placement
+            ov = _downgrade_for(a)
+            self.results.place.append(
+                PlacementRequest(
+                    name=a.name,
+                    task_group=_tg_for(ov),
+                    previous_alloc=a,
+                    canary=(
+                        a.deployment_status is not None
+                        and a.deployment_status.canary
+                    ),
+                    job_override=ov,
+                    min_job_version=a.job.version if a.job else 0,
+                )
+            )
 
         # Reschedule later: follow-up eval at the earliest eligible time.
         if resched_later:
@@ -395,49 +550,219 @@ class AllocReconciler:
             for a, _ in resched_later:
                 self.results.attr_updates[a.id] = followup.id
 
-        # New placements to reach the desired count.
-        have = len(keep) + len(migrate) + len(resched_now) + len(completed)
-        have += sum(1 for _ in lost)  # lost replacements already queued
-        missing = max(0, desired - have)
-        for _ in range(missing):
-            idx = name_index.next()
-            self.results.place.append(
-                PlacementRequest(name=alloc_name(self.job_id, name, idx), task_group=tg)
-            )
-            summary.place += 1
-
-        if dstate is not None:
-            dstate.desired_total = desired
-
-        # Group is deployment-complete if no pending work remains.
-        complete = not (
-            destructive
-            or missing
-            or migrate
-            or lost
-            or resched_now
-            or resched_later
+        # --- create the deployment if warranted (reference :543-570) ---
+        updating_spec = bool(destructive) or bool(inplace)
+        had_running = any(
+            a.job is not None and a.job.version == self.job.version
+            for a in all_
         )
-        if dstate is not None and complete:
-            complete = (
-                dstate.desired_total <= dstate.healthy_allocs
-            )
+        if (
+            not existing_deployment
+            and strategy is not None
+            and not self.batch
+            and self.job.type == "service"
+            and dstate is not None
+            and dstate.desired_total != 0
+            and (not had_running or updating_spec)
+        ):
+            if self.deployment is None:
+                self.deployment = new_deployment(self.job)
+                self.results.deployment = self.deployment
+            self.deployment.task_groups[name] = dstate
+
+        # --- deployment completeness (reference :571-585) ---
+        complete = (
+            not destructive
+            and not inplace
+            and not place
+            and not migrate
+            and not resched_now
+            and not resched_later
+            and not require_canary
+        )
+        if complete and self.deployment is not None and dstate is not None:
+            if dstate.healthy_allocs < max(
+                dstate.desired_total, dstate.desired_canaries
+            ) or (dstate.desired_canaries > 0 and not dstate.promoted):
+                complete = False
         return complete
 
-    def _update_limit(
-        self, tg: TaskGroup, dstate: Optional[DeploymentState], want: int
+    def _handle_group_canaries(
+        self, group: str, all_: list[Allocation], summary: GroupSummary
+    ) -> tuple[list[Allocation], list[Allocation]]:
+        """Stop unneeded canaries, return (current canaries, remaining
+        allocs) — reference handleGroupCanaries :614."""
+        stop_ids: set[str] = set()
+        cur = self.deployment
+        cur_id = cur.id if cur is not None else ""
+        # Non-promoted canaries from an OLDER deployment: the canary flag
+        # survives only while unpromoted (store.promote_deployment clears
+        # it), so flagged canaries of a foreign deployment are stale.
+        for a in all_:
+            if (
+                a.deployment_status is not None
+                and a.deployment_status.canary
+                and a.deployment_id != cur_id
+                and not a.terminal_status()
+            ):
+                stop_ids.add(a.id)
+        # Non-promoted canaries of a FAILED current deployment.
+        if cur is not None and cur.status == DEPLOYMENT_STATUS_FAILED:
+            for ds in cur.task_groups.values():
+                if not ds.promoted:
+                    stop_ids.update(ds.placed_canaries)
+        for a in all_:
+            if a.id in stop_ids and not a.terminal_status():
+                self.results.stop.append((a, ALLOC_NOT_NEEDED, ""))
+                summary.stop += 1
+        all_ = [a for a in all_ if a.id not in stop_ids]
+
+        canaries: list[Allocation] = []
+        if cur is not None and cur.status != DEPLOYMENT_STATUS_FAILED:
+            ds = cur.task_groups.get(group)
+            ids = set(ds.placed_canaries) if ds is not None else set()
+            gone: set[str] = set()
+            for a in all_:
+                if a.id not in ids:
+                    continue
+                node = self.tainted.get(a.node_id, "ok")
+                if node != "ok" and not a.client_terminal_status():
+                    # Tainted canaries just stop; replacements come from
+                    # the canary count, not migration.
+                    if node is None or node.status == NODE_STATUS_DOWN:
+                        self.results.stop.append(
+                            (a, ALLOC_LOST, ALLOC_CLIENT_STATUS_LOST)
+                        )
+                    else:
+                        self.results.stop.append((a, ALLOC_MIGRATING, ""))
+                    summary.stop += 1
+                    gone.add(a.id)
+                    continue
+                canaries.append(a)
+            all_ = [a for a in all_ if a.id not in gone]
+        return canaries, all_
+
+    def _compute_stop(
+        self,
+        tg: TaskGroup,
+        name_index: "_NameIndex",
+        untainted: list[Allocation],
+        migrate: list[Allocation],
+        lost: list[Allocation],
+        canaries: list[Allocation],
+        canary_state: bool,
+        summary: GroupSummary,
+    ) -> set[str]:
+        """reference computeStop :772. Returns ids marked for stopping."""
+        stop_ids: set[str] = set()
+        for a in lost:
+            stop_ids.add(a.id)
+            self.results.stop.append((a, ALLOC_LOST, ALLOC_CLIENT_STATUS_LOST))
+            summary.stop += 1
+
+        canary_ids = {a.id for a in canaries}
+        pool = (
+            [a for a in untainted if a.id not in canary_ids]
+            if canary_state
+            else list(untainted)
+        )
+        remove = len(pool) + len(migrate) - tg.count
+        if remove <= 0:
+            return stop_ids
+
+        pool = [a for a in pool if not a.terminal_status()]
+
+        def _stop(a: Allocation, desc: str = ALLOC_NOT_NEEDED) -> None:
+            stop_ids.add(a.id)
+            self.results.stop.append((a, desc, ""))
+            summary.stop += 1
+
+        # After promotion, prefer stopping old allocs that share a
+        # canary's name (the duplicates the canaries were named after).
+        if not canary_state and canaries:
+            canary_names = {a.name for a in canaries}
+            for a in list(pool):
+                if a.id in canary_ids or a.name not in canary_names:
+                    continue
+                _stop(a)
+                pool.remove(a)
+                remove -= 1
+                if remove == 0:
+                    return stop_ids
+
+        # Prefer stopping migrating allocs (highest names first).
+        if migrate and remove > 0:
+            by_idx = sorted(migrate, key=lambda x: -x.index())
+            for a in by_idx:
+                _stop(a)
+                name_index.release(a.name)
+                remove -= 1
+                if remove == 0:
+                    return stop_ids
+
+        # Highest-index names among the rest.
+        if remove > 0:
+            highest = {
+                a.name
+                for a in sorted(pool, key=lambda x: -x.index())[:remove]
+            }
+            for a in list(pool):
+                if a.name in highest:
+                    _stop(a)
+                    pool.remove(a)
+                    name_index.release(a.name)
+                    remove -= 1
+                    if remove == 0:
+                        return stop_ids
+            # Duplicate names can leave stragglers; stop anything left.
+            for a in list(pool):
+                _stop(a)
+                pool.remove(a)
+                remove -= 1
+                if remove == 0:
+                    return stop_ids
+        return stop_ids
+
+    def _compute_limit(
+        self,
+        tg: TaskGroup,
+        untainted: list[Allocation],
+        destructive: list[Allocation],
+        migrate: list[Allocation],
+        canary_state: bool,
     ) -> int:
-        """How many destructive updates may proceed this pass
-        (reference: reconcile.go computeLimit :666)."""
-        if tg.update is None or tg.update.max_parallel <= 0:
-            return want
+        """reference computeLimit :666."""
+        if (
+            tg.update is None
+            or tg.update.max_parallel <= 0
+            or len(destructive) + len(migrate) == 0
+        ):
+            return tg.count
+        if self.deployment_paused or self.deployment_failed:
+            return 0
+        if canary_state:
+            return 0
         limit = tg.update.max_parallel
-        if dstate is not None:
-            # Only as many as have proven healthy so far plus max_parallel,
-            # minus those already placed and unhealthy.
-            pending = dstate.placed_allocs - dstate.healthy_allocs
-            limit = max(0, tg.update.max_parallel - pending)
-        return min(want, limit)
+        if self.deployment is not None:
+            for a in untainted:
+                if a.deployment_id != self.deployment.id:
+                    continue
+                ds = a.deployment_status
+                if ds is not None and ds.healthy is False:
+                    return 0  # an unhealthy alloc halts the rollout
+                if ds is None or ds.healthy is not True:
+                    limit -= 1
+        return max(0, limit)
+
+    def _downgrade_job(self, untainted: list[Allocation]) -> Optional[Job]:
+        """The old job version non-canary replacements should run while
+        canaries are unpromoted (reference downgradedJobForPlacement)."""
+        for a in untainted:
+            if a.deployment_status is not None and a.deployment_status.canary:
+                continue
+            if a.job is not None and a.job.version != self.job.version:
+                return a.job
+        return None
 
 
 class _NameIndex:
@@ -469,6 +794,47 @@ class _NameIndex:
         self.used_idx.add(i)
         self._cursor = i + 1
         return i
+
+    def next_canaries(
+        self, n: int, existing: list, destructive: list
+    ) -> list[str]:
+        """Names for n new canaries (reference reconcile_util.go:519
+        NextCanaries): prefer the indexes of destructive allocs (their
+        names free up on promotion), then unused indexes, then overflow
+        past count so promotion shuts the overflow down."""
+        out: list[str] = []
+        existing_names = {a.name for a in existing}
+
+        def _try(idx: int) -> bool:
+            cname = alloc_name(self.job_id, self.group, idx)
+            if cname in existing_names:
+                return False
+            out.append(cname)
+            self.used_idx.add(idx)
+            return len(out) == n
+
+        didx = sorted(
+            {
+                i
+                for a in destructive
+                if 0 <= (i := _index_of(a.name)) < self.count
+            }
+        )
+        for i in didx:
+            if _try(i):
+                return out
+        for i in range(self.count):
+            if i in self.used_idx and i not in didx:
+                continue
+            if i in didx:
+                continue  # already tried above
+            if _try(i):
+                return out
+        i = self.count
+        while len(out) < n:
+            out.append(alloc_name(self.job_id, self.group, i))
+            i += 1
+        return out
 
 
 def _index_of(name: str) -> int:

@@ -21,6 +21,7 @@ from typing import Optional
 
 from ...structs import Evaluation, Plan
 from ...structs.structs import (
+    DEPLOYMENT_STATUS_FAILED,
     EVAL_STATUS_COMPLETE,
     EVAL_STATUS_FAILED,
 )
@@ -36,6 +37,22 @@ from ..util import (
 from .solver import BatchSolver, GroupAsk
 
 logger = logging.getLogger("nomad_tpu.scheduler.tpu")
+
+
+def _bucket_requests(job, place_requests):
+    """Group placement requests into solver asks by (group, job version):
+    requests carrying a job_override (canary-state downgrades) lower with
+    THAT job's task group so old-version resources/constraints hold."""
+    by_group: dict[tuple, list] = {}
+    jobs: dict[tuple, object] = {}
+    for req in place_requests:
+        pjob = req.job_override if req.job_override is not None else job
+        key = (req.task_group.name, pjob.version)
+        by_group.setdefault(key, []).append(req)
+        jobs[key] = pjob
+    return [
+        (jobs[key], key[0], reqs) for key, reqs in by_group.items()
+    ]
 
 
 class TPUGenericScheduler(GenericScheduler):
@@ -58,7 +75,11 @@ class TPUGenericScheduler(GenericScheduler):
             deployment = self.state.latest_deployment_by_job(
                 eval_obj.namespace, eval_obj.job_id
             )
-            if deployment is not None and not deployment.active():
+            if deployment is not None and not deployment.active() and (
+                deployment.status != DEPLOYMENT_STATUS_FAILED
+            ):
+                # failed deployments stay attached: they gate placements
+                # and their canaries need cleanup (reconcile.py)
                 deployment = None
 
         reconciler = AllocReconciler(
@@ -113,16 +134,13 @@ class TPUGenericScheduler(GenericScheduler):
             active_deployment = None
 
         # --- the TPU departure: one batched solve instead of the loop ---
-        by_group: dict[str, list] = {}
-        for req in place_requests:
-            by_group.setdefault(req.task_group.name, []).append(req)
         solver = BatchSolver(
             self.state, self.config, solve_fn=self.solve_fn,
             solve_preempt_fn=self.solve_preempt_fn,
         )
         asks = [
-            GroupAsk(eval_obj, job, tg_name, reqs, plan=self.plan)
-            for tg_name, reqs in by_group.items()
+            GroupAsk(eval_obj, pjob, tg_name, reqs, plan=self.plan)
+            for pjob, tg_name, reqs in _bucket_requests(job, place_requests)
         ]
         outcome = solver.solve(asks)
 
@@ -137,7 +155,8 @@ class TPUGenericScheduler(GenericScheduler):
             elif job.type == "service" and active_deployment is not None:
                 alloc.deployment_id = active_deployment.id
             if not outcome.pre_appended:
-                self.plan.append_fresh_alloc(alloc, job)
+                # downgraded placements already carry their (old) job
+                self.plan.append_fresh_alloc(alloc, alloc.job or job)
             queued[alloc.task_group] = max(0, queued.get(alloc.task_group, 0) - 1)
         if not outcome.pre_appended:
             for victim, by_id in outcome.preemptions.get(eval_obj.id, []):
@@ -191,7 +210,9 @@ def solve_eval_batch(
                     plan.append_stopped_alloc(a, "alloc not needed", "")
             continue
         deployment = state.latest_deployment_by_job(ev.namespace, ev.job_id)
-        if deployment is not None and not deployment.active():
+        if deployment is not None and not deployment.active() and (
+            deployment.status != DEPLOYMENT_STATUS_FAILED
+        ):
             deployment = None
         reconciler = AllocReconciler(
             job,
@@ -224,11 +245,8 @@ def solve_eval_batch(
             plan.append_stopped_alloc(old, "alloc not needed due to job update", "")
             place_requests.append(req)
         place_requests.extend(results.place)
-        by_group: dict[str, list] = {}
-        for req in place_requests:
-            by_group.setdefault(req.task_group.name, []).append(req)
-        for tg_name, reqs in by_group.items():
-            asks.append(GroupAsk(ev, job, tg_name, reqs, plan=plan))
+        for pjob, tg_name, reqs in _bucket_requests(job, place_requests):
+            asks.append(GroupAsk(ev, pjob, tg_name, reqs, plan=plan))
 
     solver = BatchSolver(
         state, config, solve_fn=solve_fn, solve_preempt_fn=solve_preempt_fn
@@ -256,7 +274,8 @@ def solve_eval_batch(
                     if dstate is not None and deployment is plan.deployment:
                         dstate.placed_allocs += 1
             if not outcome.pre_appended:
-                plan.append_fresh_alloc(alloc, job)
+                # downgraded placements already carry their (old) job
+                plan.append_fresh_alloc(alloc, alloc.job or job)
         if not outcome.pre_appended:
             for victim, by_id in outcome.preemptions.get(ev.id, []):
                 plan.append_preempted_alloc(victim, by_id)

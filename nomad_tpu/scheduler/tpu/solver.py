@@ -40,6 +40,7 @@ from ...structs import (
 from ..context import EvalContext, SchedulerConfig
 from ..reconcile import PlacementRequest
 from ..util import ready_nodes_in_dcs
+from ...structs.structs import AllocDeploymentStatus
 from ..preemption import PRIORITY_DELTA
 from .lower import LoweredGroup, build_node_table, lower_group
 from .kernels import (
@@ -350,7 +351,10 @@ class BatchSolver:
             if not nodes:
                 self._fail_all(out, ask, dc_counts)
                 continue
-            skey = (ask.eval_obj.id, ask.job.id)
+            # keyed by version too: one eval can carry asks for two job
+            # versions (canary-state downgrades), each needing its own
+            # job-level constraint set
+            skey = (ask.eval_obj.id, ask.job.id, ask.job.version)
             stack = stacks.get(skey)
             if stack is None:
                 ctx = EvalContext(
@@ -405,6 +409,8 @@ class BatchSolver:
                     desired_status="run",
                     client_status="pending",
                 )
+                if req.canary:
+                    alloc.deployment_status = AllocDeploymentStatus(canary=True)
                 if option.preempted_allocs:
                     alloc.preempted_allocations = [
                         p.id for p in option.preempted_allocs
@@ -736,7 +742,8 @@ class BatchSolver:
                 or any(t.resources.devices for t in tg.tasks)
                 # dedicated cores need per-placement id assignment
                 or any(t.resources.cores > 0 for t in tg.tasks)
-                or any(r.previous_alloc is not None for r in reqs)
+                # canaries carry a per-alloc deployment status
+                or any(r.previous_alloc is not None or r.canary for r in reqs)
             )
             if slow:
                 for i, ni in enumerate(node_idx):
@@ -1090,6 +1097,8 @@ class BatchSolver:
             ),
             metrics=AllocMetric(nodes_evaluated=table.n),
         )
+        if req.canary:
+            alloc.deployment_status = AllocDeploymentStatus(canary=True)
         from ..util import annotate_previous_alloc
 
         annotate_previous_alloc(alloc, req)

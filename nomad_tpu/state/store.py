@@ -1736,11 +1736,41 @@ class StateStore(_ReadMixin):
             # need them (reference: the CSI claim RPC; here the plan
             # apply IS the claim point for registered volumes).
             self._claim_volumes_txn(index, fresh_allocs)
+            # Record placed canaries on their deployment's group state
+            # (reference state_store.go:4888 "Ensure PlacedCanaries
+            # accurately reflects the alloc canary status"): the
+            # reconciler and promotion read dstate.placed_canaries.
+            canary_by_deploy: dict[str, list[Allocation]] = {}
+            for a in allocs_to_upsert:
+                if (
+                    a.deployment_id
+                    and a.deployment_status is not None
+                    and a.deployment_status.canary
+                ):
+                    canary_by_deploy.setdefault(a.deployment_id, []).append(a)
+            if canary_by_deploy:
+                dt = self._wtable(TABLE_DEPLOYMENTS)
+                for dep_id, callocs in canary_by_deploy.items():
+                    existing_d = dt.get(dep_id)
+                    if existing_d is None:
+                        continue
+                    d = existing_d.copy()
+                    for a in callocs:
+                        ds = d.task_groups.get(a.task_group)
+                        if ds is not None and a.id not in ds.placed_canaries:
+                            ds.placed_canaries.append(a.id)
+                    d.modify_index = index
+                    dt[dep_id] = d
+                    deployment_events.append(d)
             if result.preemption_evals:
                 self._upsert_evals_txn(index, result.preemption_evals)
                 self._stamp(index, TABLE_EVALS)
             tables = [TABLE_ALLOCS, TABLE_JOB_SUMMARIES]
-            if result.deployment is not None or result.deployment_updates:
+            if (
+                result.deployment is not None
+                or result.deployment_updates
+                or canary_by_deploy
+            ):
                 tables.append(TABLE_DEPLOYMENTS)
             self._stamp(index, *tables)
             jobs_touched = {

@@ -124,3 +124,72 @@ def test_e2e_periodic_force_launch_and_gc(cluster):
         return server.state.job_by_id(pj.namespace, child_id) is None
 
     assert wait_until(purged), "force GC should purge the dead child"
+
+
+def test_e2e_canary_auto_promote_rollout(cluster):
+    """v0 deploy -> update with canary + auto_promote -> canary reports
+    healthy via the client health watcher -> deployment watcher promotes
+    -> rollout completes at the new version (reference: the full
+    canary lifecycle across scheduler, client allochealth, and
+    deployment watcher)."""
+    from nomad_tpu.structs.structs import UpdateStrategy
+
+    server, add_client = cluster
+    add_client()
+    add_client()
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 3
+    tg.tasks[0].resources.networks = []
+    tg.update = UpdateStrategy(
+        max_parallel=2, canary=1, auto_promote=True, min_healthy_time_s=0.01
+    )
+    server.job_register(job)
+
+    def live():
+        return [
+            a
+            for a in server.state.allocs_by_job(job.namespace, job.id)
+            if not a.terminal_status()
+        ]
+
+    wait_until(
+        lambda: len(live()) == 3
+        and all(a.client_status == "running" for a in live())
+    )
+    d0 = server.state.latest_deployment_by_job(job.namespace, job.id)
+    wait_until(
+        lambda: server.state.deployment_by_id(d0.id).status == "successful"
+    )
+
+    v1 = job.copy()
+    v1.task_groups[0].tasks[0].env = {"V": "2"}
+    server.job_register(v1)
+    stored = server.state.job_by_id(job.namespace, job.id)
+
+    wait_until(
+        lambda: any(
+            a.deployment_status is not None and a.deployment_status.canary
+            for a in live()
+        )
+    )
+    d1 = server.state.latest_deployment_by_job(job.namespace, job.id)
+    assert d1.id != d0.id
+    wait_until(
+        lambda: server.state.deployment_by_id(d1.id)
+        .task_groups["web"]
+        .promoted,
+        timeout_s=20,
+    )
+    wait_until(
+        lambda: len(live()) == 3
+        and all(
+            a.job.version == stored.version and a.client_status == "running"
+            for a in live()
+        ),
+        timeout_s=20,
+    )
+    wait_until(
+        lambda: server.state.deployment_by_id(d1.id).status == "successful",
+        timeout_s=20,
+    )
