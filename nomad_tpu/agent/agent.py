@@ -158,6 +158,11 @@ class AgentConfig:
     # verification (reference verify_incoming/verify_outgoing)
     tls_rpc: bool = False
     tls_ca_file: str = ""
+    # telemetry stanza (reference: telemetry { statsd_address
+    # collection_interval prometheus_metrics }): prometheus is pull-mode
+    # via /v1/metrics?format=prometheus (always on); statsd pushes.
+    telemetry_statsd_address: str = ""
+    telemetry_interval_s: float = 10.0
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -301,8 +306,19 @@ class Agent:
             self.http.start()
         if self.client is not None:
             self.client.start()
+        if self.config.telemetry_statsd_address:
+            from ..metrics import StatsdSink
+
+            self.statsd = StatsdSink(
+                self.config.telemetry_statsd_address,
+                self.config.telemetry_interval_s,
+            )
+            self.statsd.start()
 
     def shutdown(self) -> None:
+        if getattr(self, "statsd", None) is not None:
+            self.statsd.stop()
+            self.statsd = None
         if self.http is not None:
             self.http.shutdown()
         if self.client is not None:

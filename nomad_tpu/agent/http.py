@@ -42,6 +42,15 @@ _REQ_REGION = contextvars.ContextVar("nomad_http_region", default="")
 _REQ_TOKEN = contextvars.ContextVar("nomad_http_token", default="")
 
 
+class RawResponse:
+    """A handler return that bypasses the JSON encode — raw bytes with an
+    explicit content type (the Prometheus exposition endpoint)."""
+
+    def __init__(self, data: bytes, content_type: str) -> None:
+        self.data = data
+        self.content_type = content_type
+
+
 class HTTPError(Exception):
     def __init__(self, status: int, message: str) -> None:
         self.status = status
@@ -995,7 +1004,13 @@ class HTTPAgentServer:
 
         def agent_metrics(p, q, body, tok):
             # reference: /v1/metrics (command/agent/http.go MetricsRequest,
-            # behind agent:read / AgentReadACL)
+            # behind agent:read / AgentReadACL); ?format=prometheus serves
+            # the text exposition format a stock Prometheus scrapes
+            if q.get("format", [""])[0] == "prometheus":
+                return RawResponse(
+                    metrics.prometheus_text().encode(),
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
             return metrics.snapshot()
 
         def agent_members(p, q, body, tok):
@@ -1652,6 +1667,17 @@ class HTTPAgentServer:
                         index = None
                         if isinstance(result, tuple):
                             result, index = result
+                        if isinstance(result, RawResponse):
+                            self.send_response(200)
+                            self.send_header(
+                                "Content-Type", result.content_type
+                            )
+                            self.send_header(
+                                "Content-Length", str(len(result.data))
+                            )
+                            self.end_headers()
+                            self.wfile.write(result.data)
+                            return
                         self._reply(200, codec.to_wire(result), index)
                         return
                     self._reply(404, {"error": f"no route {method} {parsed.path}"})

@@ -242,6 +242,16 @@ def _load_agent_config(path: str):
         cfg.tls_cert_file = str(ta.get("cert_file", ""))
         cfg.tls_key_file = str(ta.get("key_file", ""))
         cfg.tls_ca_file = str(ta.get("ca_file", ""))
+    teb = body.block("telemetry")
+    if teb is not None:
+        from ..jobspec.hcl import parse_duration
+
+        tea = teb.body.attrs()
+        cfg.telemetry_statsd_address = str(tea.get("statsd_address", ""))
+        if "collection_interval" in tea:
+            cfg.telemetry_interval_s = parse_duration(
+                tea["collection_interval"]
+            )
     for plug in body.blocks("plugin"):
         name = plug.labels[0] if plug.labels else ""
         ref = plug.body.attrs().get("factory", "")
@@ -278,6 +288,14 @@ def _apply_config_dict(cfg, data: dict) -> None:
                     "memory": int(v["reserved"].get("memory", 0)),
                     "disk": int(v["reserved"].get("disk", 0)),
                 }
+        elif k == "telemetry" and isinstance(v, dict):
+            from ..jobspec.hcl import parse_duration
+
+            cfg.telemetry_statsd_address = str(v.get("statsd_address", ""))
+            if "collection_interval" in v:
+                cfg.telemetry_interval_s = parse_duration(
+                    v["collection_interval"]
+                )
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)

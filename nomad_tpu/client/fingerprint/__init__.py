@@ -22,6 +22,11 @@ from ...structs.node_class import compute_node_class
 from .base import Fingerprinter, FingerprintResponse
 from .cgroup import CgroupFingerprint
 from .cpu import CPUFingerprint
+from .env_cloud import (
+    EnvAWSFingerprint,
+    EnvAzureFingerprint,
+    EnvGCEFingerprint,
+)
 from .host import HostFingerprint
 from .memory import MemoryFingerprint
 from .network import NetworkFingerprint
@@ -38,6 +43,9 @@ BUILTIN_FINGERPRINTERS: list[Fingerprinter] = [
     NetworkFingerprint(),
     CgroupFingerprint(),
     NomadFingerprint(),
+    EnvAWSFingerprint(),
+    EnvGCEFingerprint(),
+    EnvAzureFingerprint(),
 ]
 
 

@@ -124,6 +124,35 @@ class Registry:
             "samples": samples,
         }
 
+    def prometheus_text(self) -> str:
+        """The registry in Prometheus text exposition format (0.0.4) —
+        what a stock Prometheus scrapes from /v1/metrics?format=prometheus
+        (reference: command/agent/command.go:979-1036 wires a prometheus
+        sink beside the inmem one).
+
+        counters → <name>_total counter; gauges → gauge; samples →
+        summary (_count/_sum) with min/max/last as companion gauges."""
+        snap = self.snapshot()
+        lines: list[str] = []
+
+        def emit(name: str, kind: str, value: float) -> None:
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {_prom_value(value)}")
+
+        emit("nomad_uptime_seconds", "gauge", snap["uptime_seconds"])
+        for name, v in sorted(snap["counters"].items()):
+            emit(_prom_name(name) + "_total", "counter", v)
+        for name, v in sorted(snap["gauges"].items()):
+            emit(_prom_name(name), "gauge", v)
+        for name, s in sorted(snap["samples"].items()):
+            n = _prom_name(name)
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_sum {_prom_value(s['sum'])}")
+            lines.append(f"{n}_count {_prom_value(s['count'])}")
+            for stat in ("min", "max", "last"):
+                emit(f"{n}_{stat}", "gauge", s[stat])
+        return "\n".join(lines) + "\n"
+
     def reset(self) -> None:
         """Test helper: forget everything (providers included)."""
         with self._lock:
@@ -148,3 +177,98 @@ time_ns = _global.time_ns
 register_provider = _global.register_provider
 unregister_provider = _global.unregister_provider
 snapshot = _global.snapshot
+prometheus_text = _global.prometheus_text
+
+
+import re as _re
+
+
+def _prom_name(name: str) -> str:
+    out = _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class StatsdSink:
+    """Push-mode telemetry: periodically emits the registry to a statsd
+    daemon over UDP (reference: command/agent/command.go:1002 wires
+    statsd_address into a go-metrics fanout sink).
+
+    gauges ride as |g; counters as |c DELTAS since the last push (statsd
+    counters are rate-counters, so a monotonic total must be
+    differenced); sample counts/sums as |g so dashboards can rate() them.
+    """
+
+    def __init__(self, address: str, interval_s: float = 10.0,
+                 reg: Optional[Registry] = None) -> None:
+        import socket
+
+        host, sep, port = address.rpartition(":")
+        if not sep or not port.isdigit():
+            raise ValueError(
+                f"statsd_address must be host:port, got {address!r}"
+            )
+        self.addr = (host.strip("[]") or "127.0.0.1", int(port))
+        # a zero/negative interval would busy-loop the sink thread
+        self.interval_s = max(1.0, float(interval_s))
+        self.reg = reg or _global
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._last_counters: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="statsd-sink"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self._sock.close()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.push_once()
+            except OSError:
+                pass  # daemon away; keep trying
+
+    def push_once(self) -> int:
+        snap = self.reg.snapshot()
+        lines: list[str] = []
+        for name, v in snap["counters"].items():
+            delta = v - self._last_counters.get(name, 0)
+            self._last_counters[name] = v
+            if delta:
+                lines.append(f"{_prom_name(name)}:{_prom_value(delta)}|c")
+        for name, v in snap["gauges"].items():
+            lines.append(f"{_prom_name(name)}:{_prom_value(v)}|g")
+        for name, s in snap["samples"].items():
+            n = _prom_name(name)
+            lines.append(f"{n}.count:{_prom_value(s['count'])}|g")
+            lines.append(f"{n}.sum:{_prom_value(s['sum'])}|g")
+        sent = 0
+        buf: list[str] = []
+        size = 0
+        for line in lines:
+            if size + len(line) > 1400 and buf:  # stay under typical MTU
+                self._sock.sendto("\n".join(buf).encode(), self.addr)
+                sent += len(buf)
+                buf, size = [], 0
+            buf.append(line)
+            size += len(line) + 1
+        if buf:
+            self._sock.sendto("\n".join(buf).encode(), self.addr)
+            sent += len(buf)
+        return sent

@@ -238,3 +238,80 @@ def test_e2e_dedicated_cores_pin_and_env(cluster, tmp_path):
         lines = [ln.strip() for ln in f if ln.strip()]
     assert lines[0] == ",".join(str(c) for c in granted)
     assert lines[1] == str(sorted(int(c) for c in granted))
+
+
+def test_e2e_artifacts_git_and_archive(cluster, tmp_path):
+    """Artifact stanza end-to-end: a git ref clone AND an auto-unpacked
+    tarball land in the task dir before the task starts (reference:
+    go-getter through the taskrunner's artifact hook)."""
+    import hashlib
+    import subprocess
+    import tarfile
+
+    from nomad_tpu.structs.structs import TaskArtifact
+
+    import os as _os
+
+    server, add_client = cluster
+    client = add_client()
+
+    # a git repo with a tagged version
+    repo = tmp_path / "src"
+    repo.mkdir()
+    env = dict(_os.environ)
+    env.update({
+        "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+        "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+    })
+    subprocess.run(["git", "init", "-q", "-b", "main", str(repo)],
+                   check=True, env=env)
+    (repo / "app.conf").write_text("version=1\n")
+    subprocess.run(["git", "-C", str(repo), "add", "."], check=True, env=env)
+    subprocess.run(["git", "-C", str(repo), "commit", "-qm", "v1"],
+                   check=True, env=env)
+    subprocess.run(["git", "-C", str(repo), "tag", "v1.0"], check=True, env=env)
+    (repo / "app.conf").write_text("version=2\n")
+    subprocess.run(["git", "-C", str(repo), "commit", "-qam", "v2"],
+                   check=True, env=env)
+
+    # a tarball with a checksum
+    (tmp_path / "data.txt").write_text("payload\n")
+    tarball = tmp_path / "bundle.tar.gz"
+    with tarfile.open(tarball, "w:gz") as tf:
+        tf.add(tmp_path / "data.txt", arcname="data.txt")
+    digest = hashlib.sha256(tarball.read_bytes()).hexdigest()
+
+    out = tmp_path / "out.txt"
+    job = mock.batch_job()
+    task = job.task_groups[0].tasks[0]
+    task.driver = "rawexec"
+    task.artifacts = [
+        TaskArtifact(
+            getter_source=f"git::file://{repo}?ref=v1.0",
+            relative_dest="local/repo",
+        ),
+        TaskArtifact(
+            getter_source=str(tarball),
+            getter_options={"checksum": f"sha256:{digest}"},
+            relative_dest="local/bundle",
+        ),
+    ]
+    task.config = {
+        "command": "/bin/sh",
+        "args": [
+            "-c",
+            "cat ${NOMAD_TASK_DIR}/repo/app.conf "
+            f"${{NOMAD_TASK_DIR}}/bundle/data.txt > {out}",
+        ],
+    }
+    job.datacenters = [client.node.datacenter]
+    server.job_register(job)
+
+    assert wait_until(
+        lambda: server.state.allocs_by_job(job.namespace, job.id)
+        and all(
+            a.client_status == "complete"
+            for a in server.state.allocs_by_job(job.namespace, job.id)
+        )
+    )
+    assert out.read_text() == "version=1\npayload\n"

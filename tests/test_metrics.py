@@ -111,3 +111,76 @@ def test_tpu_solver_records_timings():
     h.submit_plan(plans[next(iter(plans))])
     after = metrics.snapshot()["samples"]["nomad.tpu.solve_seconds"]["count"]
     assert after == before + 1
+
+
+def test_prometheus_exposition_format():
+    """/v1/metrics?format=prometheus emits the text exposition format a
+    stock Prometheus scrapes (reference command/agent/command.go:979)."""
+    import re
+    import urllib.request
+
+    from nomad_tpu.agent.agent import Agent, AgentConfig
+
+    metrics.incr("nomad.rpc.request", 3)
+    metrics.set_gauge("nomad.broker.total_ready", 7)
+    metrics.observe("nomad.worker.invoke", 0.25)
+    agent = Agent(AgentConfig.dev())
+    agent.start()
+    try:
+        host, port = agent.http_addr
+        raw = urllib.request.urlopen(
+            f"http://{host}:{port}/v1/metrics?format=prometheus", timeout=5
+        )
+        assert raw.headers["Content-Type"].startswith("text/plain")
+        text = raw.read().decode()
+    finally:
+        agent.shutdown()
+
+    assert "# TYPE nomad_rpc_request_total counter" in text
+    assert re.search(r"^nomad_rpc_request_total \d+$", text, re.M)
+    assert "# TYPE nomad_broker_total_ready gauge" in text
+    assert "# TYPE nomad_worker_invoke summary" in text
+    assert re.search(r"^nomad_worker_invoke_count \d+$", text, re.M)
+    assert re.search(r"^nomad_worker_invoke_sum [\d.]+$", text, re.M)
+    # every metric line is name<space>value with a legal metric name, and
+    # every name is preceded by a TYPE declaration (scrapeability)
+    typed = set()
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*) (-?[\d.e+-]+)$", line)
+        assert m, f"unscrapeable line: {line!r}"
+        name = m.group(1)
+        assert any(
+            name == t or name.startswith(t + "_") or name.rstrip("_sum").rstrip("_count") == t
+            for t in typed
+        ) or name in typed, f"no TYPE for {name}"
+
+
+def test_statsd_sink_pushes_deltas():
+    import socket
+
+    from nomad_tpu.metrics import Registry, StatsdSink
+
+    reg = Registry()
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.settimeout(5)
+    port = srv.getsockname()[1]
+    sink = StatsdSink(f"127.0.0.1:{port}", interval_s=999, reg=reg)
+    try:
+        reg.incr("a.count", 5)
+        reg.set_gauge("b.depth", 2)
+        sink.push_once()
+        data = srv.recv(65535).decode()
+        assert "a_count:5|c" in data
+        assert "b_depth:2|g" in data
+        # counters push DELTAS: unchanged counter is omitted next push
+        reg.incr("a.count", 1)
+        sink.push_once()
+        data = srv.recv(65535).decode()
+        assert "a_count:1|c" in data
+    finally:
+        sink.stop()
+        srv.close()
