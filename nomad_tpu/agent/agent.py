@@ -128,6 +128,10 @@ class AgentConfig:
     # ref, launched out-of-process over the plugin fabric (reference:
     # the go-plugin catalog, plugins/serve.go + helper/pluginutils)
     driver_plugins: dict = field(default_factory=dict)
+    # external device plugins: name -> "module:Class" or
+    # {"factory": ref, "config": {...}} (reference: plugins/device; the
+    # builtin flagship is nomad_tpu.devices.tpu:TPUDevice)
+    device_plugins: dict = field(default_factory=dict)
     # http
     http_port: int = 0  # reference default 4646
     # scheduler
@@ -260,6 +264,7 @@ class Agent:
             self.client = Client(
                 rpc,
                 driver_plugins=config.driver_plugins,
+                device_plugins=config.device_plugins,
                 chroot_env=config.chroot_env,
                 host_volumes=config.host_volumes,
                 node_meta=config.node_meta,

@@ -1167,6 +1167,16 @@ class HTTPAgentServer:
             eval_id = self.rpc_region("Alloc.stop", {"alloc_id": alloc.id})
             return {"EvalID": eval_id}
 
+        def alloc_stats(p, q, body, tok):
+            # reference: GET /v1/client/allocation/:id/stats
+            # (client/alloc_endpoint.go Stats → AllocResourceUsage)
+            alloc = self._resolve_alloc(p["id"])
+            self._ns_guard(tok, alloc.namespace, "read-job")
+            return self._client_roundtrip(alloc, "Alloc.stats", {})
+
+        route(
+            "GET", "/v1/client/allocation/(?P<id>[^/]+)/stats", alloc_stats
+        )
         route(
             "PUT", "/v1/client/allocation/(?P<id>[^/]+)/restart",
             alloc_restart,

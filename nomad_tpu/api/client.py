@@ -310,6 +310,11 @@ class Allocations(_Resource):
     def stop(self, alloc_id: str):
         return self.c.put(f"/v1/allocation/{alloc_id}/stop")
 
+    def stats(self, alloc_id: str):
+        """Live resource usage incl. device stats (reference:
+        GET /v1/client/allocation/:id/stats)."""
+        return self.c.get(f"/v1/client/allocation/{alloc_id}/stats")
+
     def list(self):
         return self.c.get("/v1/allocations")
 

@@ -257,6 +257,15 @@ def _load_agent_config(path: str):
         ref = plug.body.attrs().get("factory", "")
         if name and ref:
             cfg.driver_plugins[name] = str(ref)
+    for plug in body.blocks("device_plugin"):
+        name = plug.labels[0] if plug.labels else ""
+        pa = plug.body.attrs()
+        ref = pa.get("factory", "")
+        if name and ref:
+            spec = {"factory": str(ref)}
+            if pa.get("config"):
+                spec["config"] = dict(pa["config"])
+            cfg.device_plugins[name] = spec
     return cfg
 
 
@@ -288,6 +297,8 @@ def _apply_config_dict(cfg, data: dict) -> None:
                     "memory": int(v["reserved"].get("memory", 0)),
                     "disk": int(v["reserved"].get("disk", 0)),
                 }
+        elif k == "device_plugins" and isinstance(v, dict):
+            cfg.device_plugins = dict(v)
         elif k == "telemetry" and isinstance(v, dict):
             from ..jobspec.hcl import parse_duration
 
@@ -765,6 +776,28 @@ def cmd_alloc_status(args) -> int:
     print(f"Task Group    = {alloc.task_group}")
     print(f"Desired       = {alloc.desired_status}")
     print(f"Client Status = {alloc.client_status}")
+    # assigned device instances + live stats (reference: alloc status
+    # shows Device Stats fed by the device plugin's Stats stream)
+    if alloc.resources is not None:
+        devlines = []
+        for tname, tr in sorted(alloc.resources.tasks.items()):
+            for dev in tr.devices or []:
+                devlines.append(
+                    f"  {tname}: {dev.get('id', '')} -> "
+                    + ",".join(dev.get("device_ids", []))
+                )
+        if devlines:
+            print("\nDevices")
+            print("\n".join(devlines))
+            try:
+                stats = api.allocations.stats(alloc.id)
+            except Exception:
+                stats = {}
+            for plugin, insts in sorted((stats.get("devices") or {}).items()):
+                print(f"\nDevice Stats ({plugin})")
+                for iid, s in sorted(insts.items()):
+                    kv = ", ".join(f"{k}={v}" for k, v in sorted(s.items()))
+                    print(f"  {iid}: {kv}")
     for task, state in sorted(alloc.task_states.items()):
         print(f"\nTask \"{task}\" is \"{state.state}\"")
         for ev in state.events[-5:]:

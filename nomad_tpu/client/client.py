@@ -98,6 +98,7 @@ class Client:
         advertise_host: str = "127.0.0.1",
         csi_plugins: Optional[dict] = None,
         driver_plugins: Optional[dict] = None,  # name -> "module:Class"
+        device_plugins: Optional[dict] = None,  # name -> "module:Class"
         chroot_env: Optional[dict] = None,  # exec driver's chroot map
         host_volumes: Optional[dict] = None,  # name -> {path, read_only}
         node_meta: Optional[dict] = None,  # static node metadata
@@ -148,7 +149,7 @@ class Client:
         # scheduler's DeviceAllocator has real instances to assign.
         from .devicemanager import DeviceManager
 
-        self.device_manager = DeviceManager()
+        self.device_manager = DeviceManager(external=device_plugins)
         # CSI plugins (reference: client/pluginmanager/csimanager) — config
         # maps plugin_id -> builtin catalog name | "module:Class" ref.
         from .csimanager import CSIManager
@@ -268,6 +269,7 @@ class Client:
                 ar.wait(timeout_s=max(0.0, deadline - time.monotonic()))
         self.vault_client.stop()
         self.csi_manager.shutdown()
+        self.device_manager.shutdown()
         # out-of-process driver plugins die with us, not as orphans
         for driver in self.drivers.values():
             stop = getattr(driver, "shutdown_plugin", None)

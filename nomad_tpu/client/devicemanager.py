@@ -40,6 +40,15 @@ class DevicePlugin:
         """The visibility variable workloads read for this device type."""
         return f"NOMAD_DEVICE_{self.name.upper()}"
 
+    def reserve(self, instance_ids: list[str]) -> dict:
+        """Reservation response for granted instances (reference
+        plugins/device Reserve → ContainerReservation): {"env": {...}}."""
+        return {"env": {self.env_var(): ",".join(instance_ids)}}
+
+    def stats(self) -> dict:
+        """instance id -> {stat: value} (reference Stats stream)."""
+        return {}
+
 
 class TPUDevicePlugin(DevicePlugin):
     """TPU chips appear as /dev/accel<N> (PCI) or /dev/vfio devices."""
@@ -109,15 +118,46 @@ class NvidiaDevicePlugin(DevicePlugin):
 
 
 class DeviceManager:
-    """Aggregates plugins for node fingerprinting and task env wiring
-    (reference client/devicemanager/manager.go)."""
+    """Aggregates plugins for node fingerprinting, task env wiring, and
+    stats collection (reference client/devicemanager/manager.go).
 
-    def __init__(self, plugins: Optional[list[DevicePlugin]] = None) -> None:
+    external: name -> "module:Class" factory refs (or
+    {"factory": ref, "config": {...}} dicts) launched out-of-process
+    over the device-plugin fabric (nomad_tpu/devices/plugin.py) — the
+    reference's go-plugin device catalog."""
+
+    def __init__(
+        self,
+        plugins: Optional[list[DevicePlugin]] = None,
+        external: Optional[dict] = None,
+    ) -> None:
         self.plugins = (
             plugins
             if plugins is not None
             else [TPUDevicePlugin(), NvidiaDevicePlugin()]
         )
+        self._external = []
+        for name, spec in (external or {}).items():
+            from ..devices.plugin import ExternalDevicePlugin
+
+            if isinstance(spec, dict):
+                ref, config = spec.get("factory", ""), spec.get("config")
+            else:
+                ref, config = str(spec), None
+            if ref:
+                ext = ExternalDevicePlugin(name, ref, config)
+                # an external plugin REPLACES a same-named builtin (the
+                # driver-plugin catalog overlays builtins the same way)
+                self.plugins = [p for p in self.plugins if p.name != name]
+                self.plugins.append(ext)
+                self._external.append(ext)
+
+    def shutdown(self) -> None:
+        for ext in self._external:
+            try:
+                ext.shutdown_plugin()
+            except Exception:
+                logger.exception("device plugin %s shutdown failed", ext.name)
 
     def fingerprint(self) -> list[NodeDeviceResource]:
         out: list[NodeDeviceResource] = []
@@ -126,6 +166,19 @@ class DeviceManager:
                 out.extend(plugin.fingerprint())
             except Exception:
                 logger.exception("device plugin %s failed", plugin.name)
+        return out
+
+    def stats(self) -> dict[str, dict]:
+        """plugin name -> {instance id -> {stat: value}}."""
+        out: dict[str, dict] = {}
+        for plugin in self.plugins:
+            try:
+                s = plugin.stats()
+            except Exception:
+                logger.exception("device plugin %s stats failed", plugin.name)
+                continue
+            if s:
+                out[plugin.name] = s
         return out
 
     def task_env(self, task_resources) -> dict[str, str]:
@@ -151,6 +204,13 @@ class DeviceManager:
                 ),
                 None,
             )
-            var = plugin.env_var() if plugin else f"NOMAD_DEVICE_{dtype.upper()}"
-            env[var] = ",".join(ids)
+            if plugin is not None:
+                try:
+                    env.update(plugin.reserve(ids).get("env", {}))
+                    continue
+                except Exception:
+                    logger.exception(
+                        "device plugin %s reserve failed", plugin.name
+                    )
+            env[f"NOMAD_DEVICE_{dtype.upper()}"] = ",".join(ids)
         return env

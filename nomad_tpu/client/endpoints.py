@@ -47,6 +47,7 @@ class ClientEndpoints:
         self.rpc.register_stream("Exec.exec", self._exec)
         self.rpc.register_stream("Alloc.restart", self._alloc_restart)
         self.rpc.register_stream("Alloc.signal", self._alloc_signal)
+        self.rpc.register_stream("Alloc.stats", self._alloc_stats)
         self.rpc.register_stream("CSI.create", self._csi_create)
         self.rpc.register_stream("CSI.delete", self._csi_delete)
 
@@ -125,6 +126,38 @@ class ClientEndpoints:
                 header.get("signal", "SIGTERM"), header.get("task", "")
             ),
         )
+
+    def _alloc_stats(self, session, header) -> None:
+        """Resource usage for one alloc: per-task driver stats plus the
+        alloc's reserved device instances' stats (reference:
+        GET /v1/client/allocation/:id/stats → AllocResourceUsage; the
+        nvidia plugin's Stats stream feeds the DeviceStats section)."""
+        runner = self.client.alloc_runners.get(header.get("alloc_id", ""))
+        if runner is None:
+            session.send({"error": "alloc not running on this client"})
+            return
+        tasks: dict = {}
+        for name, tr in runner.task_runners.items():
+            try:
+                tasks[name] = tr.driver.task_stats(tr.task_id) or {}
+            except Exception:
+                tasks[name] = {}
+        # device stats, filtered to the instances this alloc holds
+        assigned: set[str] = set()
+        res = runner.alloc.resources
+        if res is not None:
+            for tr_res in res.tasks.values():
+                for dev in tr_res.devices or []:
+                    assigned.update(dev.get("device_ids", []))
+        devices: dict = {}
+        if assigned:
+            for plugin, insts in self.client.device_manager.stats().items():
+                mine = {
+                    iid: s for iid, s in insts.items() if iid in assigned
+                }
+                if mine:
+                    devices[plugin] = mine
+        session.send({"tasks": tasks, "devices": devices})
 
     # -- helpers --------------------------------------------------------
 

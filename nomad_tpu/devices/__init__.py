@@ -1,0 +1,13 @@
+"""Device plugins.
+
+Reference: plugins/device/ (the DevicePlugin gRPC API) and
+devices/gpu/nvidia/ (the canonical out-of-process device plugin,
+device.go:1). The flagship here is the TPU device plugin (tpu.py),
+served out-of-process over the same plugin fabric the task drivers use
+(plugin.py); the client's DeviceManager proxies it transparently.
+"""
+
+from .plugin import ExternalDevicePlugin, serve_device_plugin
+from .tpu import TPUDevice
+
+__all__ = ["ExternalDevicePlugin", "TPUDevice", "serve_device_plugin"]

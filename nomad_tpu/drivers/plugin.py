@@ -18,12 +18,10 @@ Run a plugin process with:
 
 from __future__ import annotations
 
-import subprocess
 import sys
-import threading
 from typing import Any, Optional
 
-from ..rpc import ConnPool, RPCError, RPCServer
+from ..rpc import RPCServer
 from .base import (
     Driver,
     DriverError,
@@ -105,48 +103,23 @@ class ExternalDriver(Driver):
     """
 
     def __init__(self, name: str, factory_ref: str) -> None:
+        from ..plugins.launcher import PluginProcess
+
         self.name = name
         self.factory_ref = factory_ref
-        self._proc: Optional[subprocess.Popen] = None
-        self._addr: Optional[tuple[str, int]] = None
-        self._pool = ConnPool()
-        self._lock = threading.Lock()
+        self._proc = PluginProcess(
+            [sys.executable, "-m", "nomad_tpu.drivers.plugin", factory_ref],
+            HANDSHAKE_PREFIX,
+            DriverError,
+        )
 
     # -- process lifecycle ---------------------------------------------
 
-    def _ensure_running(self) -> tuple[str, int]:
-        with self._lock:
-            if self._proc is not None and self._proc.poll() is None:
-                return self._addr  # type: ignore[return-value]
-            self._proc = subprocess.Popen(
-                [sys.executable, "-m", "nomad_tpu.drivers.plugin", self.factory_ref],
-                stdin=subprocess.PIPE,
-                stdout=subprocess.PIPE,
-                text=True,
-            )
-            line = self._proc.stdout.readline().strip()  # type: ignore[union-attr]
-            if not line.startswith(HANDSHAKE_PREFIX):
-                raise DriverError(f"bad plugin handshake: {line!r}")
-            host, _, port = line[len(HANDSHAKE_PREFIX):].partition(":")
-            self._addr = (host, int(port))
-            return self._addr
-
     def shutdown_plugin(self) -> None:
-        with self._lock:
-            if self._proc is not None:
-                try:
-                    self._proc.stdin.close()  # type: ignore[union-attr]
-                    self._proc.wait(timeout=5)
-                except Exception:
-                    self._proc.kill()
-                self._proc = None
+        self._proc.shutdown()
 
     def _call(self, method: str, args=None, timeout_s: float = 30.0):
-        addr = self._ensure_running()
-        try:
-            return self._pool.call(addr, method, args, timeout_s=timeout_s)
-        except RPCError as e:
-            raise DriverError(str(e)) from None
+        return self._proc.call(method, args, timeout_s=timeout_s)
 
     # -- Driver verbs --------------------------------------------------
 

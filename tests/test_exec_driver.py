@@ -186,7 +186,7 @@ class TestPluginBoundary:
         ext = ExternalDriver("mock", "nomad_tpu.drivers.mock:MockDriver")
         try:
             ext.fingerprint()
-            proc = ext._proc
+            proc = ext._proc._proc  # the launcher's subprocess handle
             assert proc.poll() is None
         finally:
             ext.shutdown_plugin()
