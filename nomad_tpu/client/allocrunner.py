@@ -56,12 +56,39 @@ class AllocRunner:
         self._destroyed = False
         self._health: Optional[HealthTracker] = None
         self._services = None
+        self._network = None  # AllocNetwork when bridge mode
 
     # ------------------------------------------------------------------
 
     def _on_handle(self, task_name: str, handle: dict) -> None:
         if self.state_db is not None:
             self.state_db.put_task_handle(self.alloc.id, task_name, handle)
+
+    def _fail_all(self, tg, reason: str) -> None:
+        logger.error("alloc %s: %s", self.alloc.id, reason)
+        self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
+        for task in tg.tasks:
+            self.alloc.task_states[task.name] = TaskState(
+                state="dead", failed=True
+            )
+        self.on_update(self.alloc)
+
+    def _port_mappings(self) -> list[tuple[int, int]]:
+        """(host port, container port) pairs this alloc was granted —
+        ports with a `to` mapping forward; unmapped ports relay to the
+        same number inside the namespace."""
+        out: list[tuple[int, int]] = []
+        res = self.alloc.resources
+        if res is None:
+            return out
+        nets = list(res.shared_networks)
+        for tr in res.tasks.values():
+            nets.extend(tr.networks)
+        for net in nets:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                if p.value:
+                    out.append((p.value, p.to or p.value))
+        return out
 
     def run(self) -> None:
         self.allocdir.build()
@@ -79,14 +106,33 @@ class AllocRunner:
         try:
             volume_paths = self._resolve_volumes(tg)
         except Exception as e:
-            logger.error("alloc %s: volume setup failed: %s", self.alloc.id, e)
-            self.alloc.client_status = ALLOC_CLIENT_STATUS_FAILED
-            for task in tg.tasks:
-                self.alloc.task_states[task.name] = TaskState(
-                    state="dead", failed=True
-                )
-            self.on_update(self.alloc)
+            self._fail_all(tg, f"volume setup failed: {e}")
             return
+        # Bridge networking (reference alloc_runner_hooks.go
+        # network_hook → networking_bridge_linux.go): a netns per alloc,
+        # veth onto the shared bridge, and host→container port relays
+        # for every granted port with a `to` mapping.
+        if (
+            tg.networks
+            and tg.networks[0].mode == "bridge"
+            and self._client is not None
+        ):
+            from .network import BridgeNetwork, NetworkError, PortProxy
+
+            if not BridgeNetwork.available():
+                self._fail_all(tg, "bridge networking unavailable on host")
+                return
+            try:
+                net = self._client.bridge_network.create(self.alloc.id)
+                for host_port, to_port in self._port_mappings():
+                    net.proxies.append(
+                        PortProxy(host_port, net.ip, to_port)
+                    )
+                self._network = net
+            except (NetworkError, OSError) as e:
+                self._client.bridge_network.destroy(self.alloc.id)
+                self._fail_all(tg, f"network setup failed: {e}")
+                return
         # Sticky/migrate ephemeral disk: inherit the previous alloc's
         # shared data before any task starts (reference allocwatcher;
         # restored allocs already own their dir).
@@ -168,6 +214,9 @@ class AllocRunner:
                     self._client.vault_client
                     if self._client is not None
                     else None
+                ),
+                network_ns=(
+                    self._network.ns_path if self._network is not None else ""
                 ),
             )
             self.task_runners[task.name] = tr
@@ -301,12 +350,16 @@ class AllocRunner:
             # deregister services and stop the check loop — the catalog
             # must not advertise a dead instance
             services = None
+            teardown_net = False
             if status in (
                 ALLOC_CLIENT_STATUS_COMPLETE, ALLOC_CLIENT_STATUS_FAILED
             ):
                 services, self._services = self._services, None
+                teardown_net = self._network is not None
         if services is not None:
             services.stop()
+        if teardown_net:
+            self._teardown_network()
         # Always sync: task_states changed even when status didn't, and the
         # client's alloc-sync loop batches/dedups by alloc id anyway.
         self.on_update(self.alloc)
@@ -414,9 +467,22 @@ class AllocRunner:
         for tr in self.task_runners.values():
             tr.kill()
 
+    def _teardown_network(self) -> None:
+        """Release the netns and its host-port relays (reference:
+        network_hook Postrun)."""
+        net, self._network = self._network, None
+        if net is not None and self._client is not None:
+            try:
+                self._client.bridge_network.destroy(self.alloc.id)
+            except Exception:
+                logger.exception(
+                    "alloc %s: network teardown failed", self.alloc.id
+                )
+
     def destroy(self) -> None:
         self._destroyed = True
         self.stop()
+        self._teardown_network()
         if self._client is not None:
             # unwind CSI publishes (reference: csi_hook Postrun)
             try:

@@ -150,6 +150,11 @@ class Client:
         from .devicemanager import DeviceManager
 
         self.device_manager = DeviceManager(external=device_plugins)
+        # Bridge networking state (lazy: nothing touches the host until
+        # the first bridge-mode alloc lands)
+        from .network import BridgeNetwork
+
+        self.bridge_network = BridgeNetwork()
         # CSI plugins (reference: client/pluginmanager/csimanager) — config
         # maps plugin_id -> builtin catalog name | "module:Class" ref.
         from .csimanager import CSIManager
@@ -270,6 +275,10 @@ class Client:
         self.vault_client.stop()
         self.csi_manager.shutdown()
         self.device_manager.shutdown()
+        # kill_allocs=False leaves tasks running in their namespaces;
+        # only the in-process port relays stop (the next incarnation
+        # adopts the netns and restarts them)
+        self.bridge_network.shutdown(keep_namespaces=not kill_allocs)
         # out-of-process driver plugins die with us, not as orphans
         for driver in self.drivers.values():
             stop = getattr(driver, "shutdown_plugin", None)

@@ -78,17 +78,23 @@ def build_env(
     for k, v in meta.items():
         env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = v
         env[f"NOMAD_META_{k}"] = v
-    # network ports (reference: NOMAD_PORT_<label> / NOMAD_ADDR_<label>)
+    # network ports (reference: NOMAD_PORT_<label> / NOMAD_ADDR_<label>);
+    # group-level (shared) networks are visible to every task
     if alloc.resources is not None:
         tr = alloc.resources.tasks.get(task.name)
+        nets = list(alloc.resources.shared_networks)
         if tr is not None:
-            for net in tr.networks:
+            nets.extend(tr.networks)
+        if nets:
+            for net in nets:
                 for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                    env[f"NOMAD_PORT_{p.label}"] = str(p.value)
+                    # with a `to` mapping (bridge mode) the task binds the
+                    # container-side port; NOMAD_HOST_PORT carries the
+                    # host side (reference taskenv: AddrPrefix/HostPort)
+                    env[f"NOMAD_PORT_{p.label}"] = str(p.to or p.value)
                     env[f"NOMAD_IP_{p.label}"] = net.ip
                     env[f"NOMAD_ADDR_{p.label}"] = f"{net.ip}:{p.value}"
-                    if p.to:
-                        env[f"NOMAD_HOST_PORT_{p.label}"] = str(p.value)
+                    env[f"NOMAD_HOST_PORT_{p.label}"] = str(p.value)
     for k, v in task.env.items():
         env[k] = interpolate(v, env)
     return env

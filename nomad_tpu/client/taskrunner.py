@@ -62,7 +62,9 @@ class TaskRunner:
         service_fn=None,  # (name) -> [ServiceRegistration] (native SD)
         secret_fn=None,  # (path) -> SecretEntry | None (embedded Vault)
         vault_client=None,  # the client's VaultClient (token lifecycle)
+        network_ns: str = "",  # bridge mode: the alloc's netns path
     ) -> None:
+        self.network_ns = network_ns
         self.device_manager = device_manager
         self.volume_paths = volume_paths or {}
         self.service_fn = service_fn
@@ -590,6 +592,7 @@ class TaskRunner:
             stderr_path=self.alloc_dir.stderr_path(self.task.name),
             user=self.task.user,
             mounts=self._setup_volume_mounts(task_dir),
+            network_ns=self.network_ns,
         )
 
     def _event(self, etype: str, details: str = "") -> None:

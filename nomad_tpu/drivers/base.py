@@ -50,6 +50,9 @@ class TaskConfig:
     stdout_path: str = ""
     stderr_path: str = ""
     user: str = ""
+    # bridge mode: the alloc's network namespace path — drivers run the
+    # task inside it (reference drivers' NetworkIsolationSpec)
+    network_ns: str = ""
     # volume mounts: [{"host_path", "task_path", "read_only"}] —
     # bind-mounting drivers (docker) consume these; filesystem drivers
     # get a symlink placed by the task runner (reference: TaskConfig.Mounts)

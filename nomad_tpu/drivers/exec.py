@@ -119,6 +119,8 @@ class ExecDriver(Driver):
                 # cgroup v2 cpu.weight range 1..10000; map MHz shares
                 cpu_weight=min(10000, max(1, cfg.resources_cpu // 10)) if cfg.resources_cpu else 0,
                 cores=cfg.reserved_cores,
+                # the executor enters the netns before chroot/privdrop
+                netns=cfg.network_ns,
             )
         except ExecutorError as e:
             raise DriverError(f"exec: {e}") from e

@@ -230,6 +230,7 @@ def launch_executor(
     chroot: str = "",
     user: str = "",
     cgroup: str = "",
+    netns: str = "",
     memory_max_bytes: int = 0,
     cpu_weight: int = 0,
     cores: Optional[list] = None,
@@ -250,6 +251,8 @@ def launch_executor(
     lines += [f"env\t{_esc(f'{k}={v}')}" for k, v in env.items()]
     if cwd:
         lines.append(f"cwd\t{_esc(cwd)}")
+    if netns:
+        lines.append(f"netns\t{_esc(netns)}")
     if chroot:
         lines.append(f"chroot\t{_esc(chroot)}")
     if stdout_path:

@@ -64,6 +64,7 @@ struct Spec {
   std::vector<int> cores;         // dedicated core ids: pin via affinity
                                   // (reference LinuxResources.CpusetCpus)
   std::string user;
+  std::string netns;              // network namespace path (bridge mode)
 };
 
 // Values are backslash-escaped by the launcher (\\ \n \r \t) so that
@@ -114,6 +115,7 @@ static bool read_spec(const char *path, Spec &s) {
     else if (key == "cpu_weight") s.cpu_weight = atoi(val.c_str());
     else if (key == "core") s.cores.push_back(atoi(val.c_str()));
     else if (key == "user") s.user = val;
+    else if (key == "netns") s.netns = val;
   }
   free(line);
   fclose(f);
@@ -196,6 +198,15 @@ static pid_t spawn_task(const Spec &s, bool join_cgroup) {
     run_gid = pw->pw_gid;
     if (initgroups(pw->pw_name, pw->pw_gid) != 0) _exit(126);
     drop_user = true;
+  }
+  // Enter the alloc's network namespace BEFORE the chroot (the nsfs
+  // path lives on the host filesystem) and before the privilege drop
+  // (setns(CLONE_NEWNET) needs CAP_SYS_ADMIN). Bridge-mode isolation
+  // must never silently degrade to the host network: failure is fatal.
+  if (!s.netns.empty()) {
+    int nsfd = open(s.netns.c_str(), O_RDONLY | O_CLOEXEC);
+    if (nsfd < 0 || setns(nsfd, CLONE_NEWNET) != 0) _exit(126);
+    close(nsfd);
   }
   bool logs_opened = false;
   if (!s.chroot_dir.empty()) {

@@ -168,9 +168,13 @@ class RawExecDriver(Driver):
         stderr = open(cfg.stderr_path, "ab") if cfg.stderr_path else subprocess.DEVNULL
         env = dict(os.environ)
         env.update(cfg.env)
+        argv = [command] + args
+        if cfg.network_ns:
+            # bridge mode: run inside the alloc's network namespace
+            argv = ["nsenter", f"--net={cfg.network_ns}", "--"] + argv
         try:
             proc = subprocess.Popen(
-                [command] + args,
+                argv,
                 stdout=stdout,
                 stderr=stderr,
                 env=env,
