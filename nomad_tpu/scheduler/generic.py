@@ -282,7 +282,25 @@ class GenericScheduler:
             metric = AllocMetric(nodes_available=dict(self._dc_counts))
             start = now_ns()
             penalty = {req.penalty_node} if req.penalty_node else None
-            option = self.stack.select(tg, penalty_nodes=penalty, metrics=metric)
+            option = None
+            prev = req.previous_alloc
+            if (
+                tg.ephemeral_disk.sticky
+                and prev is not None
+                and prev.node_id
+            ):
+                # sticky disk: prefer the previous node (reference
+                # computePlacements -> SelectOptions.PreferredNodes)
+                # a tainted/drained previous node is never preferred
+                # (reference selectNextOption's preferred-node filter)
+                prev_node = self.state.node_by_id(prev.node_id)
+                if prev_node is not None and prev_node.ready():
+                    option = self.stack.select(
+                        tg, penalty_nodes=penalty, metrics=metric,
+                        selected_nodes=[prev_node],
+                    )
+            if option is None:
+                option = self.stack.select(tg, penalty_nodes=penalty, metrics=metric)
             if option is None and self.ctx.scheduler_config.preemption_enabled(
                 job.type
             ):

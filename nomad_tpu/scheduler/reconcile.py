@@ -265,6 +265,23 @@ class AllocReconciler:
 
         all_ = [a for a in allocs if not a.server_terminal_status()]
 
+        # Batch jobs ignore terminal allocs from OLDER job versions
+        # entirely (reference filterOldTerminalAllocs :589): a completed
+        # run of v(N-1) must be neither counted nor churned when vN
+        # arrives — its name frees up for the new version's instances.
+        if self.batch:
+            old_terminal = [
+                a
+                for a in all_
+                if a.job is not None
+                and a.job.version < self.job.version
+                and a.terminal_status()
+            ]
+            if old_terminal:
+                summary.ignore += len(old_terminal)
+                dropped = {a.id for a in old_terminal}
+                all_ = [a for a in all_ if a.id not in dropped]
+
         # Canaries: stop stale ones, collect the current deployment's
         # (reference handleGroupCanaries :614).
         canaries, all_ = self._handle_group_canaries(name, all_, summary)

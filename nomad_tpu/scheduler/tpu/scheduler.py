@@ -154,12 +154,13 @@ class TPUGenericScheduler(GenericScheduler):
                         dstate.placed_allocs += 1
             elif job.type == "service" and active_deployment is not None:
                 alloc.deployment_id = active_deployment.id
-            if not outcome.pre_appended:
+            if alloc.id not in outcome.pre_appended:
                 # downgraded placements already carry their (old) job
                 self.plan.append_fresh_alloc(alloc, alloc.job or job)
             queued[alloc.task_group] = max(0, queued.get(alloc.task_group, 0) - 1)
-        if not outcome.pre_appended:
-            for victim, by_id in outcome.preemptions.get(eval_obj.id, []):
+        for victim, by_id in outcome.preemptions.get(eval_obj.id, []):
+            # a pre-appended preemptOR already carried its victims in
+            if by_id not in outcome.pre_appended:
                 self.plan.append_preempted_alloc(victim, by_id)
 
         self.failed_tg_allocs = outcome.failures.get(eval_obj.id, {})
@@ -273,11 +274,12 @@ def solve_eval_batch(
                     dstate = deployment.task_groups.get(alloc.task_group)
                     if dstate is not None and deployment is plan.deployment:
                         dstate.placed_allocs += 1
-            if not outcome.pre_appended:
+            if alloc.id not in outcome.pre_appended:
                 # downgraded placements already carry their (old) job
                 plan.append_fresh_alloc(alloc, alloc.job or job)
-        if not outcome.pre_appended:
-            for victim, by_id in outcome.preemptions.get(ev.id, []):
+        for victim, by_id in outcome.preemptions.get(ev.id, []):
+            # a pre-appended preemptOR already carried its victims in
+            if by_id not in outcome.pre_appended:
                 plan.append_preempted_alloc(victim, by_id)
         ev.failed_tg_allocs = outcome.failures.get(ev.id, {})
     return plans

@@ -79,10 +79,28 @@ class SolveOutcome:
     )
     groups: int = 0
     solve_ns: int = 0
-    # True when the solver already appended placements/preemptions to each
-    # ask's plan (the host fast path accumulates into the plan so later
-    # selects see earlier placements); the caller must not append again.
-    pre_appended: bool = False
+    # ids of allocs the solver already appended to their ask's plan
+    # (the host fast path accumulates into the plan so later selects see
+    # earlier placements); the caller must not append those again.
+    # Per-ALLOC because one eval can mix host-path asks (sticky groups)
+    # with dense-kernel asks in the same batch.
+    pre_appended: set = field(default_factory=set)
+
+
+def _merge_outcomes(a: SolveOutcome, b: SolveOutcome) -> SolveOutcome:
+    """Union of two partial solves (host-path sticky asks + dense rest)."""
+    out = SolveOutcome()
+    for src in (a, b):
+        for ev, allocs in src.placements.items():
+            out.placements.setdefault(ev, []).extend(allocs)
+        for ev, fails in src.failures.items():
+            out.failures.setdefault(ev, {}).update(fails)
+        for ev, pre in src.preemptions.items():
+            out.preemptions.setdefault(ev, []).extend(pre)
+        out.pre_appended |= src.pre_appended
+    out.groups = a.groups + b.groups
+    out.solve_ns = a.solve_ns + b.solve_ns
+    return out
 
 
 class BatchSolver:
@@ -128,6 +146,11 @@ class BatchSolver:
         # (fast path included) so the screen sees same-batch neighbors.
         self._state_cpu: dict[str, int] = {}
         self._batch_cpu: dict[str, int] = {}
+        # Set while solving the dense remainder of a mixed batch: the
+        # host partition's placements (capacity) and plans (cross-eval
+        # accounting) that this solve must observe.
+        self._partition_placed: list = []
+        self._partition_plans: list = []
 
     def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
         out = SolveOutcome()
@@ -141,6 +164,48 @@ class BatchSolver:
         self._outcome = out
         if not asks:
             return out
+        # Asks needing per-request node preference — sticky-disk
+        # replacements (prefer the previous node) and reschedules with a
+        # node penalty (avoid it) — take the host path; the dense kernel
+        # only expresses per-GROUP bias. The rest of the batch solves
+        # dense, with the host partition's placements counted against
+        # node capacity. A custom solve_fn keeps the whole batch (its
+        # topology logic must not be bypassed; preference degrades to
+        # none there).
+        if self.solve_fn is solve_placement:
+            sticky_idx = set()
+            for i, ask in enumerate(asks):
+                tg = ask.job.lookup_task_group(ask.tg_name)
+                sticky = (
+                    tg is not None
+                    and tg.ephemeral_disk.sticky
+                    and any(r.previous_alloc is not None for r in ask.requests)
+                )
+                if sticky or any(r.penalty_node for r in ask.requests):
+                    sticky_idx.add(i)
+            if sticky_idx:
+                sticky_asks = [a for i, a in enumerate(asks) if i in sticky_idx]
+                host_out = self._solve_host(sticky_asks)
+                rest = [a for i, a in enumerate(asks) if i not in sticky_idx]
+                if not rest:
+                    return host_out
+                # the rest-solve must see the host partition's results:
+                # its placements consume capacity; its plans feed the
+                # host fast path's cross-eval accounting
+                self._partition_placed = [
+                    a
+                    for allocs_ in host_out.placements.values()
+                    for a in allocs_
+                ]
+                self._partition_plans = [
+                    a.plan for a in sticky_asks if a.plan is not None
+                ]
+                try:
+                    dense_out = self.solve(rest)
+                finally:
+                    self._partition_placed = []
+                    self._partition_plans = []
+                return _merge_outcomes(host_out, dense_out)
         total_requests = sum(len(a.requests) for a in asks)
         # A custom solve_fn (e.g. the mesh-sharded solver) must never be
         # silently bypassed — the fast path exists for the default kernel's
@@ -193,12 +258,16 @@ class BatchSolver:
         # in-place replacement of a full node can never materialize
         self._stopped_ids = stopped_ids
 
+        placed_by_node: dict[str, list] = {}
+        for a in self._partition_placed:
+            placed_by_node.setdefault(a.node_id, []).append(a)
+
         def live_allocs(nid: str):
             return [
                 a
                 for a in self.state.allocs_by_node_terminal(nid, False)
                 if a.id not in stopped_ids
-            ]
+            ] + placed_by_node.get(nid, [])
 
         table = build_node_table(nodes, live_allocs)
 
@@ -324,14 +393,13 @@ class BatchSolver:
         from ..util import annotate_previous_alloc
 
         out = SolveOutcome()
-        out.pre_appended = True
         asks = sorted(asks, key=lambda a: -a.job.priority)
         # Cross-eval accounting: every eval's stack must see every OTHER
         # plan in this batch (via ctx.extra_plans) or two evals would
         # double-book one node's capacity/ports — the dense path
         # coordinates through its shared lowered table instead.
-        batch_plans: list = []
-        seen_plans: set[int] = set()
+        batch_plans: list = list(self._partition_plans)
+        seen_plans: set[int] = {id(p) for p in batch_plans}
         for ask in asks:
             if ask.plan is not None and id(ask.plan) not in seen_plans:
                 seen_plans.add(id(ask.plan))
@@ -372,11 +440,27 @@ class BatchSolver:
             placements = out.placements.setdefault(ask.eval_obj.id, [])
             preemptions = out.preemptions.setdefault(ask.eval_obj.id, [])
             preempt_ok = self.config.preemption_enabled(ask.job.type)
+            sticky = tg.ephemeral_disk.sticky
             for req in ask.requests:
                 penalty = {req.penalty_node} if req.penalty_node else None
                 metric = AllocMetric(nodes_available=dict(dc_counts))
                 start = now_ns()
-                option = stack.select(tg, penalty_nodes=penalty, metrics=metric)
+                option = None
+                prev = req.previous_alloc
+                if sticky and prev is not None and prev.node_id:
+                    # sticky disk: try the previous node first (reference
+                    # computePlacements -> SelectOptions.PreferredNodes);
+                    # a tainted/drained previous node is never preferred
+                    prev_node = self.state.node_by_id(prev.node_id)
+                    if prev_node is not None and prev_node.ready():
+                        option = stack.select(
+                            tg, penalty_nodes=penalty, metrics=metric,
+                            selected_nodes=[prev_node],
+                        )
+                if option is None:
+                    option = stack.select(
+                        tg, penalty_nodes=penalty, metrics=metric
+                    )
                 if option is None and preempt_ok:
                     option = stack.select(
                         tg, penalty_nodes=penalty, metrics=metric, evict=True
@@ -420,6 +504,7 @@ class BatchSolver:
                         preemptions.append((p, alloc.id))
                 annotate_previous_alloc(alloc, req)
                 ask.plan.append_fresh_alloc(alloc, ask.job)
+                out.pre_appended.add(alloc.id)
                 placements.append(alloc)
         return out
 

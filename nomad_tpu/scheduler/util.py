@@ -130,6 +130,10 @@ def annotate_previous_alloc(alloc, req) -> None:
                 prev_node_id=prev.node_id,
             )
         )
+        # bounded history (reference updateRescheduleTracker:
+        # maxPastRescheduleEvents = 5)
+        if len(tracker.events) > 5:
+            tracker.events = tracker.events[-5:]
         alloc.reschedule_tracker = tracker
 
 

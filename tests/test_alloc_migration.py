@@ -14,7 +14,7 @@ from nomad_tpu.structs import DrainStrategy
 from nomad_tpu.structs.structs import Resources, Task
 
 
-def wait_until(fn, timeout_s=15.0, interval=0.05):
+def wait_until(fn, timeout_s=40.0, interval=0.05):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if fn():
@@ -67,7 +67,7 @@ def test_sticky_disk_survives_destructive_update(tmp_path):
         job = _disk_job("sticky-job", "generation-one")
         job.datacenters = [client.node.datacenter]
         server.job_register(job)
-        assert wait_until(lambda: _running(server, job), 20)
+        assert wait_until(lambda: _running(server, job), 40)
         first = _running(server, job)[0]
         first_dir = client.alloc_runners[first.id].allocdir.data_dir
         assert wait_until(
@@ -110,7 +110,7 @@ def test_migrate_streams_data_across_nodes(tmp_path):
         job = _disk_job("migrate-job", "cross-node-data")
         job.datacenters = [c1.node.datacenter]
         server.job_register(job)
-        assert wait_until(lambda: _running(server, job), 20)
+        assert wait_until(lambda: _running(server, job), 40)
         first = _running(server, job)[0]
         assert first.node_id == c1.node.id
         first_dir = c1.alloc_runners[first.id].allocdir.data_dir
@@ -136,7 +136,7 @@ def test_migrate_streams_data_across_nodes(tmp_path):
         inherited = os.path.join(
             c2.alloc_runners[repl.id].allocdir.data_dir, "state.txt"
         )
-        assert wait_until(lambda: os.path.exists(inherited), 10), (
+        assert wait_until(lambda: os.path.exists(inherited), 30), (
             "migrated data not streamed across nodes"
         )
         assert "cross-node-data" in open(inherited).read()

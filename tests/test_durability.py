@@ -18,7 +18,7 @@ from nomad_tpu.server.raft_replication import LogEntry
 from nomad_tpu.server.raft_store import RaftLogStore
 
 
-def wait_until(fn, timeout_s=20.0, interval=0.05):
+def wait_until(fn, timeout_s=45.0, interval=0.05):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         if fn():
