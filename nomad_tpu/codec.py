@@ -337,11 +337,48 @@ def _object_hook(data: dict) -> Any:
     return data
 
 
+_fastpack = None
+_fastpack_synced = 0
+
+
+def _fastpack_module():
+    """The native encoder/decoder, with the class registry synced
+    lazily — register_type after a sync triggers a re-sync on the next
+    miss."""
+    global _fastpack, _fastpack_synced
+    if _fastpack is None:
+        from .native import load_fastpack
+
+        _fastpack = load_fastpack() or False
+    if _fastpack and _fastpack_synced != len(_REGISTRY):
+        for cls in _REGISTRY.values():
+            if dataclasses.is_dataclass(cls):
+                enc_plan = tuple(
+                    (fname, default, has)
+                    for fname, default, _factory, has in _field_plan(cls)
+                )
+                _fastpack.register_class(cls, enc_plan)
+            else:
+                _fastpack.register_class(cls, None)
+        _fastpack_synced = len(_REGISTRY)
+    return _fastpack or None
+
+
 def pack(obj: Any) -> bytes:
+    fp = _fastpack_module()
+    if fp is not None:
+        try:
+            return fp.pack(obj)
+        except fp.Fallback:
+            pass  # unregistered/unusual object: the Python path handles it
     return msgpack.packb(to_wire(obj, _elide=True), use_bin_type=True)
 
 
 def unpack(raw: bytes) -> Any:
+    # decode stays in Python: measured head-to-head, the generated
+    # dataclass __init__ through _object_hook beats a C-side
+    # __new__+setattr loop on CPython 3.12 (the specializing
+    # interpreter makes the 40-field init cheaper than 40 C SetAttrs).
     return msgpack.unpackb(
         raw, raw=False, strict_map_key=False, object_hook=_object_hook
     )

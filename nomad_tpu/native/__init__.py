@@ -1,0 +1,77 @@
+"""Native runtime components, compiled on demand and cached by source
+hash (the same discipline as drivers/executor.py):
+
+  * executor.cc — the daemonized task supervisor (drivers/native/)
+  * fastpack.c  — the wire codec's msgpack encoder (this package)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.machinery
+import importlib.util
+import os
+import shutil
+import subprocess
+import sysconfig
+import threading
+from pathlib import Path
+from typing import Optional
+
+_SRC = Path(__file__).parent / "fastpack.c"
+_LOCK = threading.Lock()
+_module = None
+_load_failed = False
+
+
+def load_fastpack():
+    """Compile (once) and import the fastpack extension; None when the
+    toolchain is unavailable — callers fall back to pure Python."""
+    global _module, _load_failed
+    if _module is not None or _load_failed:
+        return _module
+    with _LOCK:
+        if _module is not None or _load_failed:
+            return _module
+        try:
+            _module = _build_and_load()
+        except Exception:
+            import logging
+
+            logging.getLogger("nomad_tpu.native").exception(
+                "fastpack build failed; using the pure-Python encoder"
+            )
+            _load_failed = True
+    return _module
+
+
+def _build_and_load():
+    if os.environ.get("NOMAD_TPU_NO_FASTPACK"):
+        raise RuntimeError("fastpack disabled by env")
+    cache = Path(
+        os.environ.get("NOMAD_TPU_BIN_DIR")
+        or Path.home() / ".cache" / "nomad_tpu" / "bin"
+    )
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    so = cache / f"fastpack-{tag}.so"
+    if not so.exists():
+        cache.mkdir(parents=True, exist_ok=True)
+        cc = shutil.which("gcc") or shutil.which("cc") or shutil.which("g++")
+        if cc is None:
+            raise RuntimeError("no C compiler")
+        include = sysconfig.get_paths()["include"]
+        tmp = str(so) + ".tmp"
+        proc = subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", f"-I{include}",
+             "-o", tmp, str(_SRC)],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(f"fastpack compile failed: {proc.stderr[:400]}")
+        os.replace(tmp, so)
+    loader = importlib.machinery.ExtensionFileLoader("fastpack", str(so))
+    spec = importlib.util.spec_from_loader("fastpack", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
