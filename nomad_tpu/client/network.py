@@ -252,77 +252,16 @@ class BridgeNetwork:
 
 
 class PortProxy:
-    """Userspace TCP relay: host port → (alloc ip, container port)."""
+    """Host-port forward into an alloc namespace: a TcpRelay with a
+    fixed target (reference: the CNI portmap; approach: Docker's
+    userland-proxy)."""
 
     def __init__(self, host_port: int, target_ip: str, target_port: int) -> None:
+        from ..tcprelay import TcpRelay
+
         self.host_port = host_port
         self.target = (target_ip, target_port)
-        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._srv.bind(("0.0.0.0", host_port))
-        self._srv.listen(64)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._accept_loop, daemon=True,
-            name=f"portproxy-{host_port}",
-        )
-        self._thread.start()
+        self._relay = TcpRelay(host_port, lambda: self.target)
 
     def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._srv.close()
-        except OSError:
-            pass
-
-    def _accept_loop(self) -> None:
-        import time as _time
-
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._srv.accept()
-            except OSError:
-                if self._stop.is_set():
-                    return
-                # transient (EMFILE, ECONNABORTED): the relay must not
-                # die while its alloc lives — back off and keep serving
-                _time.sleep(0.05)
-                continue
-            threading.Thread(
-                target=self._relay, args=(conn,), daemon=True
-            ).start()
-
-    def _relay(self, conn: socket.socket) -> None:
-        try:
-            upstream = socket.create_connection(self.target, timeout=10)
-        except OSError:
-            conn.close()
-            return
-
-        def pump(src: socket.socket, dst: socket.socket) -> None:
-            try:
-                while True:
-                    data = src.recv(1 << 16)
-                    if not data:
-                        break
-                    dst.sendall(data)
-            except OSError:
-                pass
-            finally:
-                for s in (src, dst):
-                    try:
-                        s.shutdown(socket.SHUT_RDWR)
-                    except OSError:
-                        pass
-
-        t = threading.Thread(
-            target=pump, args=(conn, upstream), daemon=True
-        )
-        t.start()
-        pump(upstream, conn)
-        t.join(timeout=5)
-        for s in (conn, upstream):
-            try:
-                s.close()
-            except OSError:
-                pass
+        self._relay.stop()

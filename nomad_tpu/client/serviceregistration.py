@@ -66,13 +66,16 @@ def build_registrations(alloc, node, with_services: bool = False):
         if not address and node.http_addr:
             address = node.http_addr.rsplit(":", 1)[0]
 
-    # label -> allocated port value across all task network asks
+    # label -> allocated HOST port value across task network asks AND
+    # the group's shared networks (bridge-mode ports live there)
     ports: dict[str, int] = {}
     if alloc.resources is not None:
+        nets = list(alloc.resources.shared_networks)
         for tr in alloc.resources.tasks.values():
-            for net in tr.networks:
-                for p in list(net.reserved_ports) + list(net.dynamic_ports):
-                    ports[p.label] = p.value
+            nets.extend(tr.networks)
+        for net in nets:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                ports[p.label] = p.value
 
     def port_for(label: str) -> int:
         if label in ports:

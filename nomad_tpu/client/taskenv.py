@@ -95,6 +95,19 @@ def build_env(
                     env[f"NOMAD_IP_{p.label}"] = net.ip
                     env[f"NOMAD_ADDR_{p.label}"] = f"{net.ip}:{p.value}"
                     env[f"NOMAD_HOST_PORT_{p.label}"] = str(p.value)
+    # connect upstreams: tasks reach the mesh through the sidecar's
+    # local listener (reference taskenv: NOMAD_UPSTREAM_ADDR_<dest>)
+    tg = job.lookup_task_group(alloc.task_group) if job is not None else None
+    if tg is not None:
+        for svc in tg.services:
+            if svc.connect is None or svc.connect.sidecar_service is None:
+                continue
+            for up in svc.connect.sidecar_service.upstreams:
+                key = up.destination_name.upper().replace("-", "_")
+                env[f"NOMAD_UPSTREAM_ADDR_{key}"] = (
+                    f"127.0.0.1:{up.local_bind_port}"
+                )
+                env[f"NOMAD_UPSTREAM_PORT_{key}"] = str(up.local_bind_port)
     for k, v in task.env.items():
         env[k] = interpolate(v, env)
     return env

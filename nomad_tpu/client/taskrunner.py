@@ -435,12 +435,10 @@ class TaskRunner:
         self._template_restart.clear()
         if not self.task.templates:
             return
-        dynamic = [
-            t for t in self.task.templates
-            if (t.change_mode or "restart") != "noop"
-        ]
-        if not dynamic:
-            return
+        # noop templates are WATCHED too (consul-template semantics:
+        # re-render on change, take no action) — the connect sidecar's
+        # upstream address files depend on exactly that.
+        dynamic = list(self.task.templates)
 
         def signal_fn(sig: str) -> None:
             try:

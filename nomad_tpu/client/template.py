@@ -224,7 +224,8 @@ class TemplateWatcher:
                     continue
                 if content == self._last.get(i):
                     continue
-                if tmpl.splay_s > 0:
+                mode_ = tmpl.change_mode or "restart"
+                if mode_ != "noop" and tmpl.splay_s > 0:
                     # randomized, NOT capped: splay exists to stagger a
                     # fleet's restarts when a shared input changes
                     import random

@@ -1,0 +1,110 @@
+"""The sidecar proxy process (the envoy-analog data plane).
+
+Run as: python -m nomad_tpu.connect.sidecar <config.json>
+
+Config (rendered by the client's template engine, re-rendered when the
+service catalog changes; this process re-reads it on mtime change):
+
+    {
+      "inbound": {"listen_port": 20000, "local_port": 8080},
+      "upstreams": [
+        {"name": "api", "listen_port": 5000,
+         "addresses_file": "local/upstream-api.addrs"}
+      ]
+    }
+
+Each addresses_file holds "host:port" lines — the destination's
+advertised sidecars, rendered from the service catalog by the client's
+template engine and re-rendered when the catalog changes; this process
+re-reads on mtime change. Inbound mesh traffic arriving on listen_port
+relays to the co-located service at 127.0.0.1:local_port; each upstream
+gets a local listener relaying to one of the destination's sidecars
+(round-robin)."""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import time
+
+
+class _Relay:
+    """One listener relaying to a dynamic target list (round-robin),
+    built on the shared TcpRelay data plane."""
+
+    def __init__(self, listen_port: int, targets: list[str]) -> None:
+        from nomad_tpu.tcprelay import TcpRelay
+
+        self._targets = targets
+        self._rr = itertools.count()
+        self._relay = TcpRelay(listen_port, self._pick)
+
+    def set_targets(self, targets: list[str]) -> None:
+        self._targets = targets
+
+    def _pick(self) -> tuple[str, int] | None:
+        targets = self._targets
+        if not targets:
+            return None
+        raw = targets[next(self._rr) % len(targets)]
+        host, _, port = raw.rpartition(":")
+        try:
+            return (host, int(port))
+        except ValueError:
+            return None
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _read_addresses(path: str) -> list[str]:
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        sys.stderr.write("usage: sidecar <config.json>\n")
+        return 2
+    cfg = _load(sys.argv[1])
+    relays: dict[str, _Relay] = {}
+    inbound = cfg.get("inbound")
+    if inbound:
+        relays["__inbound__"] = _Relay(
+            int(inbound["listen_port"]),
+            [f"127.0.0.1:{inbound['local_port']}"],
+        )
+    watched: list[tuple[str, str, float]] = []  # (name, path, mtime)
+    for up in cfg.get("upstreams", []):
+        addr_path = up.get("addresses_file", "")
+        relays[up["name"]] = _Relay(
+            int(up["listen_port"]), _read_addresses(addr_path)
+        )
+        try:
+            mtime = os.path.getmtime(addr_path)
+        except OSError:
+            mtime = 0.0
+        watched.append((up["name"], addr_path, mtime))
+    sys.stderr.write("sidecar up\n")
+    sys.stderr.flush()
+    while True:
+        time.sleep(1.0)
+        for i, (name, addr_path, last) in enumerate(watched):
+            try:
+                mtime = os.path.getmtime(addr_path)
+            except OSError:
+                continue
+            if mtime != last:
+                watched[i] = (name, addr_path, mtime)
+                relays[name].set_targets(_read_addresses(addr_path))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -324,6 +324,30 @@ def _service(b: Block) -> Service:
         tags=[str(t) for t in a.get("tags", [])],
         provider=a.get("provider", "builtin"),
     )
+    conn = b.body.block("connect")
+    if conn is not None:
+        from ..structs.structs import Connect, ConnectUpstream, SidecarService
+
+        c = Connect(native=bool(conn.body.attrs().get("native", False)))
+        sb = conn.body.block("sidecar_service")
+        if sb is not None:
+            sc = SidecarService(port=str(sb.body.attrs().get("port", "")))
+            pb = sb.body.block("proxy")
+            if pb is not None:
+                for ub in pb.body.blocks("upstreams"):
+                    ua = ub.body.attrs()
+                    sc.upstreams.append(
+                        ConnectUpstream(
+                            destination_name=str(
+                                ua.get("destination_name", "")
+                            ),
+                            local_bind_port=int(
+                                ua.get("local_bind_port", 0)
+                            ),
+                        )
+                    )
+            c.sidecar_service = sc
+        svc.connect = c
     for cb in b.body.blocks("check"):
         ca = cb.body.attrs()
         check = {

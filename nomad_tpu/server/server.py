@@ -334,6 +334,12 @@ class Server:
         AND /v1/validate/job, so the two can never drift."""
         job = job.copy()
         job.canonicalize()
+        # Connect admission: inject sidecar tasks/ports/mesh services
+        # BEFORE validation so the injected pieces are validated too
+        # (reference job_endpoint_hooks.go:60 jobConnectHook).
+        from ..connect import inject_connect_sidecars
+
+        inject_connect_sidecars(job)
         job.validate()
         self.apply_memory_oversubscription_gate(job)
         # Fail fast on vault policies outside the operator allowlist
@@ -673,8 +679,13 @@ class Server:
         from .job_plan import plan_job
 
         job = job.copy()
-        # same gate register applies — or the plan would diff a
-        # memory_max the register is about to strip
+        # same admission mutations register applies — or the plan would
+        # diff a memory_max the register is about to strip and show the
+        # injected connect sidecars as deletions
+        job.canonicalize()
+        from ..connect import inject_connect_sidecars
+
+        inject_connect_sidecars(job)
         self.apply_memory_oversubscription_gate(job)
         return plan_job(self.state, job, diff, self.scheduler_config)
 

@@ -582,6 +582,53 @@ class VolumeMount:
 
 
 @dataclass(slots=True)
+class ConnectUpstream:
+    """One mesh upstream a sidecar exposes locally (reference:
+    structs.go ConsulUpstream :8210)."""
+
+    destination_name: str = ""
+    local_bind_port: int = 0
+
+    def copy(self) -> "ConnectUpstream":
+        return dataclasses.replace(self)
+
+
+@dataclass(slots=True)
+class SidecarService:
+    """connect { sidecar_service { ... } } (reference: structs.go
+    ConsulSidecarService :8080)."""
+
+    port: str = ""  # explicit sidecar port label; default injected
+    upstreams: list[ConnectUpstream] = field(default_factory=list)
+
+    def copy(self) -> "SidecarService":
+        return SidecarService(
+            port=self.port,
+            upstreams=[u.copy() for u in self.upstreams],
+        )
+
+
+@dataclass(slots=True)
+class Connect:
+    """The service-mesh stanza (reference: structs.go ConsulConnect
+    :8016). `native=True` means the workload speaks mesh natively and
+    only wants the catalog registration, no sidecar."""
+
+    sidecar_service: Optional[SidecarService] = None
+    native: bool = False
+
+    def copy(self) -> "Connect":
+        return Connect(
+            sidecar_service=(
+                self.sidecar_service.copy()
+                if self.sidecar_service is not None
+                else None
+            ),
+            native=self.native,
+        )
+
+
+@dataclass(slots=True)
 class Service:
     """Service registration (reference: structs.go Service :7582)."""
 
@@ -591,6 +638,7 @@ class Service:
     tags: list[str] = field(default_factory=list)
     checks: list[dict[str, Any]] = field(default_factory=list)
     provider: str = "builtin"
+    connect: Optional[Connect] = None
 
     def copy(self) -> "Service":
         return Service(
@@ -600,6 +648,7 @@ class Service:
             tags=list(self.tags),
             checks=[dict(c) for c in self.checks],
             provider=self.provider,
+            connect=self.connect.copy() if self.connect is not None else None,
         )
 
 

@@ -1,0 +1,250 @@
+"""Service mesh (Connect analog) tests.
+
+Reference shapes: nomad/job_endpoint_hooks.go:60 (sidecar injection),
+command/agent/consul/connect.go (mesh registration), envoy's data path
+(here: the nomad_tpu.connect.sidecar relay). The e2e drives two
+bridge-mode jobs whose tasks talk ONLY through the mesh:
+B's task -> B's sidecar (upstream listener) -> A's advertised sidecar
+(host port) -> A's inbound relay -> A's service, across namespaces.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.network import BridgeNetwork
+from nomad_tpu.connect import inject_connect_sidecars
+from nomad_tpu.connect.hook import ConnectValidationError
+from nomad_tpu.structs.structs import (
+    Connect,
+    ConnectUpstream,
+    NetworkResource,
+    Port,
+    Service,
+    SidecarService,
+)
+
+needs_netns = pytest.mark.skipif(
+    not BridgeNetwork.available(), reason="needs root + netns capability"
+)
+
+
+def connect_job(job_id, upstreams=(), port_to=8080):
+    job = mock.job(id=job_id)
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.networks = [
+        NetworkResource(
+            mode="bridge",
+            dynamic_ports=[Port(label="http", to=port_to)],
+        )
+    ]
+    tg.tasks[0].resources.networks = []
+    tg.services = [
+        Service(
+            name=job_id,
+            port_label="http",
+            connect=Connect(
+                sidecar_service=SidecarService(
+                    upstreams=[
+                        ConnectUpstream(
+                            destination_name=d, local_bind_port=p
+                        )
+                        for d, p in upstreams
+                    ]
+                )
+            ),
+        )
+    ]
+    return job
+
+
+# ---------------------------------------------------------------------------
+# admission hook
+# ---------------------------------------------------------------------------
+
+
+def test_injection_adds_sidecar_task_port_and_mesh_service():
+    job = connect_job("api")
+    inject_connect_sidecars(job)
+    tg = job.task_groups[0]
+    names = [t.name for t in tg.tasks]
+    assert "connect-proxy-api" in names
+    labels = [p.label for p in tg.networks[0].dynamic_ports]
+    assert "connect-proxy-api" in labels
+    svc_names = [s.name for s in tg.services]
+    assert "api-sidecar-proxy" in svc_names
+    sidecar = next(t for t in tg.tasks if t.name == "connect-proxy-api")
+    cfg = json.loads(sidecar.templates[0].embedded_tmpl)
+    assert cfg["inbound"]["local_port"] == 8080
+
+
+def test_injection_is_idempotent():
+    job = connect_job("api")
+    inject_connect_sidecars(job)
+    snapshot = (
+        len(job.task_groups[0].tasks),
+        len(job.task_groups[0].services),
+        len(job.task_groups[0].networks[0].dynamic_ports),
+    )
+    inject_connect_sidecars(job)
+    assert snapshot == (
+        len(job.task_groups[0].tasks),
+        len(job.task_groups[0].services),
+        len(job.task_groups[0].networks[0].dynamic_ports),
+    )
+
+
+def test_injection_requires_bridge_mode():
+    job = connect_job("api")
+    job.task_groups[0].networks[0].mode = "host"
+    with pytest.raises(ConnectValidationError, match="bridge"):
+        inject_connect_sidecars(job)
+
+
+def test_injection_requires_known_port():
+    job = connect_job("api")
+    job.task_groups[0].services[0].port_label = "nope"
+    with pytest.raises(ConnectValidationError, match="not defined"):
+        inject_connect_sidecars(job)
+
+
+def test_upstreams_render_templates_and_env():
+    job = connect_job("web", upstreams=[("api", 5000)])
+    inject_connect_sidecars(job)
+    tg = job.task_groups[0]
+    sidecar = next(t for t in tg.tasks if t.name == "connect-proxy-web")
+    dests = [t.dest_path for t in sidecar.templates]
+    assert "local/upstream-api.addrs" in dests
+    addr_tmpl = next(
+        t for t in sidecar.templates
+        if t.dest_path == "local/upstream-api.addrs"
+    )
+    assert 'service "api-sidecar-proxy"' in addr_tmpl.embedded_tmpl
+
+    # main tasks see the upstream locals in env
+    from nomad_tpu.client.taskenv import build_env
+
+    alloc = mock.alloc(job, mock.node())
+    env = build_env(alloc, tg.tasks[0], None, "/tmp")
+    assert env["NOMAD_UPSTREAM_ADDR_API"] == "127.0.0.1:5000"
+
+
+def test_jobspec_parses_connect_stanza():
+    from nomad_tpu.jobspec.parse import parse_job as parse_job_hcl
+
+    hcl = """
+job "web" {
+  group "g" {
+    network {
+      mode = "bridge"
+      port "http" { to = 8080 }
+    }
+    service {
+      name = "web"
+      port = "http"
+      connect {
+        sidecar_service {
+          proxy {
+            upstreams {
+              destination_name = "api"
+              local_bind_port  = 5000
+            }
+          }
+        }
+      }
+    }
+    task "t" {
+      driver = "mock"
+    }
+  }
+}
+"""
+    job = parse_job_hcl(hcl)
+    svc = job.task_groups[0].services[0]
+    assert svc.connect is not None
+    ups = svc.connect.sidecar_service.upstreams
+    assert len(ups) == 1
+    assert ups[0].destination_name == "api"
+    assert ups[0].local_bind_port == 5000
+
+
+# ---------------------------------------------------------------------------
+# e2e: two services talking only through the mesh
+# ---------------------------------------------------------------------------
+
+
+@needs_netns
+def test_e2e_mesh_roundtrip(tmp_path):
+    from nomad_tpu.client import Client, ServerRPC
+    from nomad_tpu.server import Server
+
+    server = Server(num_workers=2)
+    server.establish_leadership()
+    client = Client(ServerRPC(server), data_dir=str(tmp_path / "c0"))
+    client.start()
+    out = tmp_path / "fetched.txt"
+    try:
+        # service A: an http server answering "hello-from-api"
+        api = connect_job("api")
+        api.task_groups[0].tasks[0].driver = "rawexec"
+        api.task_groups[0].tasks[0].config = {
+            "command": "python3",
+            "args": [
+                "-c",
+                (
+                    "import http.server\n"
+                    "class H(http.server.BaseHTTPRequestHandler):\n"
+                    "  def do_GET(self):\n"
+                    "    b=b'hello-from-api'\n"
+                    "    self.send_response(200)\n"
+                    "    self.send_header('Content-Length',len(b))\n"
+                    "    self.end_headers();self.wfile.write(b)\n"
+                    "  def log_message(self,*a): pass\n"
+                    "http.server.HTTPServer(('0.0.0.0',8080),H)"
+                    ".serve_forever()"
+                ),
+            ],
+        }
+        api.datacenters = ["dc1"]
+        server.job_register(api)
+
+        # service B: fetches A through ITS OWN sidecar's upstream local
+        web = connect_job("web", upstreams=[("api", 5000)], port_to=8081)
+        web.task_groups[0].tasks[0].driver = "rawexec"
+        web.task_groups[0].tasks[0].config = {
+            "command": "/bin/sh",
+            "args": [
+                "-c",
+                "for i in $(seq 1 120); do "
+                "  if wget -q -O - http://$NOMAD_UPSTREAM_ADDR_API/ "
+                f"   > {out} 2>/dev/null; then break; fi; sleep 0.5; "
+                "done; sleep 300",
+            ],
+        }
+        web.datacenters = ["dc1"]
+        server.job_register(web)
+
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            if out.exists() and out.read_text().strip():
+                break
+            time.sleep(0.2)
+        assert out.exists() and out.read_text().strip() == "hello-from-api", (
+            "mesh roundtrip failed: "
+            + (out.read_text() if out.exists() else "<no file>")
+        )
+        # the catalog advertises both mesh services
+        regs = server.state.service_registrations("default", "api-sidecar-proxy")
+        assert regs and regs[0].port > 0
+    finally:
+        for j in ("api", "web"):
+            try:
+                server.job_deregister("default", j)
+            except Exception:
+                pass
+        client.shutdown()
+        server.shutdown()
