@@ -173,6 +173,11 @@ class SystemScheduler:
             option = stack.select(tg, node, metrics=metric, evict=True)
         metric.allocation_time_ns = now_ns() - start
         if option is None:
+            if metric.nodes_filtered > 0:
+                # the node was constraint-filtered: the system alloc was
+                # never meant to run here — neither queued nor reported
+                # as a failure (reference scheduler_system.go:308-322)
+                return
             self._record_failure(tg, metric, queued)
             return
         alloc = Allocation(

@@ -46,6 +46,18 @@ def harness(n_nodes=10, **node_kw):
     return h
 
 
+def add_node(h, **meta):
+    """Node with meta set BEFORE the class hash — feasibility is
+    memoized per computed class, so post-hoc meta edits are a bug."""
+    from nomad_tpu.structs.node_class import compute_node_class
+
+    n = mock.node()
+    n.meta.update(meta)
+    n.computed_class = compute_node_class(n)
+    h.state.upsert_node(h.next_index(), n)
+    return n
+
+
 def run(h, job, backend, **ev_kw):
     ev = mock.eval_for_job(job, **ev_kw)
     h.process(job.type, ev, cfg(backend))
@@ -162,9 +174,7 @@ def test_register_distinct_property_with_limit(backend):
     N instances per property value."""
     h = Harness()
     for i in range(3):
-        n = mock.node()
-        n.meta["rack"] = f"r{i}"
-        h.state.upsert_node(h.next_index(), n)
+        add_node(h, rack=f"r{i}")
     job = mock.job()
     job.task_groups[0].count = 6
     job.constraints.append(
@@ -187,9 +197,7 @@ def test_register_distinct_property_overflow_fails(backend):
     failed placements, never a violation."""
     h = Harness()
     for i in range(2):
-        n = mock.node()
-        n.meta["rack"] = f"r{i}"
-        h.state.upsert_node(h.next_index(), n)
+        add_node(h, rack=f"r{i}")
     job = mock.job()
     job.task_groups[0].count = 4
     job.constraints.append(
@@ -211,12 +219,7 @@ def test_register_task_group_distinct_property_incremental(backend):
     """TestServiceSched_JobRegister_DistinctProperty_TaskGroup_Incr:
     scaling up respects the distinctness of EXISTING allocs."""
     h = Harness()
-    nodes = []
-    for i in range(4):
-        n = mock.node()
-        n.meta["zone"] = f"z{i}"
-        h.state.upsert_node(h.next_index(), n)
-        nodes.append(n)
+    nodes = [add_node(h, zone=f"z{i}") for i in range(4)]
     job = mock.job()
     tg = job.task_groups[0]
     tg.count = 2
@@ -809,3 +812,153 @@ def test_disk_constraint_blocks_placement(backend):
     run(h, job, backend)
     assert not live(h, job)
     assert h.updates[-1].failed_tg_allocs
+
+
+# ---------------------------------------------------------------------------
+# system scheduler scenarios (reference scheduler_system_test.go)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_exhaust_resources(backend):
+    """TestSystemSched_ExhaustResources: a full node fails the system
+    placement instead of overcommitting."""
+    h = Harness()
+    n = mock.node()
+    h.state.upsert_node(h.next_index(), n)
+    fat = mock.job(id="fat")
+    fat.task_groups[0].count = 1
+    fat.task_groups[0].tasks[0].resources = Resources(cpu=3800, memory_mb=256)
+    fat.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), fat)
+    run(h, fat, backend)
+    assert len(live(h, fat)) == 1
+
+    sysjob = mock.system_job(id="sys")
+    sysjob.task_groups[0].tasks[0].resources = Resources(
+        cpu=500, memory_mb=64
+    )
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), sysjob)
+    ev = mock.eval_for_job(sysjob)
+    h.process("system", ev, cfg(backend))
+    assert not live(h, sysjob), "system job must not overcommit the node"
+    # capacity safety held
+    used = sum(
+        a.comparable_resources().cpu
+        for a in h.state.allocs_by_node_terminal(n.id, False)
+    )
+    assert used <= n.resources.cpu
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_add_node_gets_constrainted_alloc_only_when_feasible(backend):
+    """TestSystemSched_JobConstraint_AddNode: a new node only receives
+    the system alloc when it satisfies the job's constraints."""
+    h = Harness()
+    good = add_node(h, role="edge")
+    sysjob = mock.system_job(id="edge-agent")
+    sysjob.constraints.append(Constraint("${meta.role}", "edge", "="))
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), sysjob)
+    h.process("system", mock.eval_for_job(sysjob), cfg(backend))
+    assert len(live(h, sysjob)) == 1
+
+    # an ineligible node joins: no new alloc
+    plain = mock.node()
+    h.state.upsert_node(h.next_index(), plain)
+    h.process(
+        "system",
+        mock.eval_for_job(sysjob, triggered_by="node-update"),
+        cfg(backend),
+    )
+    assert len(live(h, sysjob)) == 1
+    # an eligible node joins: one more
+    edge2 = add_node(h, role="edge")
+    h.process(
+        "system",
+        mock.eval_for_job(sysjob, triggered_by="node-update"),
+        cfg(backend),
+    )
+    allocs = live(h, sysjob)
+    assert len(allocs) == 2
+    assert {a.node_id for a in allocs} == {good.id, edge2.id}
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_job_modify_destructive(backend):
+    """TestSystemSched_JobModify: a spec change replaces every system
+    alloc with the new version."""
+    h = harness(4)
+    sysjob = mock.system_job(id="sysmod")
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), sysjob)
+    h.process("system", mock.eval_for_job(sysjob), cfg(backend))
+    assert len(live(h, sysjob)) == 4
+    sj = update_spec(h, sysjob)
+    h.process("system", mock.eval_for_job(sj), cfg(backend))
+    allocs = live(h, sysjob)
+    assert len(allocs) == 4
+    assert all(a.job.version == sj.version for a in allocs)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_node_down_marks_lost_no_replacement_elsewhere(backend):
+    """TestSystemSched_NodeDown: a system alloc on a dead node is lost;
+    system jobs never 'move' it to another node (every live node
+    already has its own)."""
+    h = harness(3)
+    sysjob = mock.system_job(id="sysdown")
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), sysjob)
+    h.process("system", mock.eval_for_job(sysjob), cfg(backend))
+    assert len(live(h, sysjob)) == 3
+    victim_node = h.state.nodes()[0]
+    h.state.update_node_status(h.next_index(), victim_node.id, "down")
+    h.process(
+        "system",
+        mock.eval_for_job(sysjob, triggered_by="node-update"),
+        cfg(backend),
+    )
+    allocs = live(h, sysjob)
+    assert len(allocs) == 2
+    assert victim_node.id not in {a.node_id for a in allocs}
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_deregister_stops_all(backend):
+    """TestSystemSched_JobDeregister_Stopped."""
+    h = harness(3)
+    sysjob = mock.system_job(id="sysstop")
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), sysjob)
+    h.process("system", mock.eval_for_job(sysjob), cfg(backend))
+    assert len(live(h, sysjob)) == 3
+    stopped = stored_job(h, sysjob).copy()
+    stopped.stop = True
+    h.state.upsert_job(h.next_index(), stopped)
+    h.process(
+        "system",
+        mock.eval_for_job(stopped, triggered_by="job-deregister"),
+        cfg(backend),
+    )
+    assert not live(h, sysjob)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_system_queued_with_constraints(backend):
+    """TestSystemSched_Queued_With_Constraints: nodes filtered by
+    constraints count as neither queued nor failed placements
+    (reference scheduler_system.go:308-322)."""
+    h = Harness()
+    for i in range(4):
+        add_node(h, role="edge" if i == 0 else "core")
+    sysjob = mock.system_job(id="sysq")
+    sysjob.constraints.append(Constraint("${meta.role}", "edge", "="))
+    sysjob.task_groups[0].tasks[0].resources.networks = []
+    h.state.upsert_job(h.next_index(), sysjob)
+    ev = mock.eval_for_job(sysjob)
+    h.process("system", ev, cfg(backend))
+    assert len(live(h, sysjob)) == 1
+    assert ev.queued_allocations.get("web", 0) == 0, ev.queued_allocations
+    assert not h.updates[-1].failed_tg_allocs

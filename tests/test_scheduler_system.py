@@ -126,8 +126,10 @@ def test_system_infeasible_node_skipped():
     allocs = h.state.allocs_by_job(job.namespace, job.id)
     assert len(allocs) == 1
     assert allocs[0].node_id == good.id
-    # failed placement recorded for the bad node
-    assert h.updates[-1].queued_allocations.get("web") == 1
+    # a feasibility-filtered node (no driver) is neither queued nor a
+    # failure — the alloc was never meant to run there (reference
+    # scheduler_system.go:308-322 + TestSystemSched_Queued_With_Constraints)
+    assert h.updates[-1].queued_allocations.get("web", 0) == 0
 
 
 def test_system_job_cores_assigned_on_tpu_backend():
