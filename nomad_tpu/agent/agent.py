@@ -319,6 +319,13 @@ class Agent:
                 self.config.telemetry_interval_s,
             )
             self.statsd.start()
+        # Everything built so far (modules, config, stores, subsystems)
+        # is process-lifetime state: freeze it out of the cyclic
+        # collector so steady-state GC passes only ever walk young
+        # objects (gctune.py — the complement of the hot paths' pauses).
+        from ..gctune import freeze_startup_heap
+
+        freeze_startup_heap()
 
     def shutdown(self) -> None:
         if getattr(self, "statsd", None) is not None:

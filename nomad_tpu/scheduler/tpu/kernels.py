@@ -133,15 +133,17 @@ def _waterfill_topk(score, units, count, k: int):
     return jnp.zeros_like(units).at[order].set(take_sorted)
 
 
-def _place_group(cap, carry, xs):
-    """One lax.scan step: place count_g instances of one group."""
+def _place_group(cap, carry, xs, fill=_waterfill):
+    """One lax.scan step: place count_g instances of one group. `fill`
+    picks the waterfill variant (full sort, or top-k where the caller can
+    bound the receiving node set)."""
     used = carry
     ask, count, feas_g, bias_g, ucap = xs
     units = _units_for(cap - used, ask, ucap, feas_g, count)
     score = _score_nodes(cap.astype(jnp.float32), used.astype(jnp.float32),
                          ask.astype(jnp.float32), bias_g)
     score = jnp.where(units > 0, score, NEG_INF)
-    take = _waterfill(score, units, count)
+    take = fill(score, units, count)
     used = used + take[:, None] * ask[None, :]
     return used, take
 
@@ -212,23 +214,21 @@ def solve_placement_compact(
     n = cap.shape[0]
     feas_rows = jnp.unpackbits(feas_packed, axis=1, count=n).astype(bool)
 
+    # top-k waterfill: max_count bounds every group's receiving node set
+    # (see _waterfill_topk), so the partial fill is exact; k > N
+    # degenerates to the full sort (top-N = every node)
+    k = min(max_count, n)
+
     def step(used_c, xs):
         ask, count, fi, bi, ui = xs
-        # gather the group's deduped rows, then place with the top-k
-        # waterfill — max_count bounds every group's receiving node set
-        # (see _waterfill_topk), so the partial fill is exact
-        units = _units_for(
-            cap - used_c, ask, ucap_rows[ui].astype(jnp.int32),
-            feas_rows[fi], count,
+        # gather the group's deduped rows, then the shared scan step
+        return _place_group(
+            cap,
+            used_c,
+            (ask, count, feas_rows[fi], bias_rows[bi],
+             ucap_rows[ui].astype(jnp.int32)),
+            fill=lambda s, u, c: _waterfill_topk(s, u, c, k),
         )
-        score = _score_nodes(
-            cap.astype(jnp.float32), used_c.astype(jnp.float32),
-            ask.astype(jnp.float32), bias_rows[bi],
-        )
-        score = jnp.where(units > 0, score, NEG_INF)
-        # k > N degenerates to the full sort (top-N = every node)
-        take = _waterfill_topk(score, units, count, min(max_count, n))
-        return used_c + take[:, None] * ask[None, :], take
 
     used_out, takes = lax.scan(
         step, used, (asks, counts, feas_idx, bias_idx, ucap_idx)

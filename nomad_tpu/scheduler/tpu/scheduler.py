@@ -25,6 +25,7 @@ from ...structs.structs import (
     EVAL_STATUS_COMPLETE,
     EVAL_STATUS_FAILED,
 )
+from ...gctune import paused_gc
 from ..context import SchedulerConfig
 from ..generic import BLOCKED_EVAL_FAILED_PLACEMENTS, GenericScheduler
 from ..reconcile import AllocReconciler
@@ -194,8 +195,6 @@ def solve_eval_batch(
 
     Per-job serialization is the caller's duty (the eval broker already
     guarantees one in-flight eval per job)."""
-    from ...gctune import paused_gc
-
     with paused_gc():
         return _solve_eval_batch(
             state, planner, evals, config, solve_fn, solve_preempt_fn

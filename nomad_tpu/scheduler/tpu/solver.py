@@ -37,6 +37,7 @@ from ...structs import (
     generate_uuids,
     now_ns,
 )
+from ...gctune import paused_gc
 from ..context import EvalContext, SchedulerConfig
 from ..reconcile import PlacementRequest
 from ..util import ready_nodes_in_dcs
@@ -156,8 +157,6 @@ class BatchSolver:
         # One batch is a bounded allocation burst (up to ~100k minted
         # allocs at c2m scale); young-gen GC passes during it cost more
         # than everything they could ever reclaim (gctune.py).
-        from ...gctune import paused_gc
-
         with paused_gc():
             return self._solve(asks)
 

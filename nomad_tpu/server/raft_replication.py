@@ -31,6 +31,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from .. import codec
+from ..gctune import paused_gc
 from ..rpc import ConnPool
 from .raft import FSM
 
@@ -160,6 +161,16 @@ class RaftNode:
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._repl_wake: dict[str, threading.Event] = {}
+        # Leader-direct apply stash: index -> (term, original payload).
+        # The local FSM applies the submitted object instead of decoding
+        # its own encoded entry (decode of a 10^5-alloc plan dwarfed the
+        # whole apply); the encoded log remains the replication source of
+        # truth and followers still decode, which converges because
+        # decode(pack(x)) == x is differentially tested. Entries are
+        # keyed by (index, term) so a deposed leader's truncated indexes
+        # can never resolve to a stale payload; the stash clears on
+        # step-down.
+        self._direct_payloads: dict[int, tuple[int, object]] = {}
 
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -269,7 +280,8 @@ class RaftNode:
         # Encode OUTSIDE the lock: packing a large plan payload under
         # _lock would stall the replication loops' heartbeats and get the
         # leader deposed. The bytes depend only on the payload.
-        raw = codec.pack(payload)
+        with paused_gc():
+            raw = codec.pack(payload)
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_addr())
@@ -279,6 +291,7 @@ class RaftNode:
             self._log.append(entry)
             if self.store is not None:
                 self.store.append([entry])
+            self._direct_payloads[index] = (term, payload)
             self._match_index[self.node_id] = index
             for ev in self._repl_wake.values():
                 ev.set()
@@ -500,6 +513,10 @@ class RaftNode:
             self.voted_for = None
             self._persist_state_locked()
         self.state = FOLLOWER
+        # A deposed leader's uncommitted tail may be truncated and its
+        # indexes rewritten by the new leader — drop the direct-apply
+        # stash (the term check would reject them anyway).
+        self._direct_payloads.clear()
         # Forget the old leader until an AppendEntries names the new one —
         # a deposed leader keeping itself as the hint would make forwards
         # loop back to itself.
@@ -648,31 +665,39 @@ class RaftNode:
                 epoch = self._restore_epoch
                 off = start - self._snap_last_index - 1
                 entries = self._log[off : off + (end - start + 1)] if off >= 0 else []
-            for e in entries:
-                # A snapshot restore while we were applying makes the rest
-                # of this batch stale — re-applying old entries on top of
-                # newer restored state would corrupt it.
-                if e.msg_type in ("raft_add_peer", "raft_remove_peer"):
-                    # Raft-level config change: needs _lock, not the FSM
-                    # mutex (taking _lock under _fsm_mutex would deadlock
-                    # against InstallSnapshot's _lock → _fsm_mutex order).
-                    self._apply_peer_change(
-                        e.msg_type, codec.unpack(e.payload), epoch
-                    )
-                    continue
-                with self._fsm_mutex:
-                    if self._restore_epoch != epoch:
-                        break
-                    try:
-                        # Decode fresh per apply: the FSM (and through it the
-                        # state store) owns the decoded structs outright.
-                        self.fsm.apply(
-                            e.index, e.msg_type, codec.unpack(e.payload)
+            with paused_gc():
+                for e in entries:
+                    # A snapshot restore while we were applying makes the
+                    # rest of this batch stale — re-applying old entries on
+                    # top of newer restored state would corrupt it.
+                    direct = self._direct_payloads.pop(e.index, None)
+                    if e.msg_type in ("raft_add_peer", "raft_remove_peer"):
+                        # Raft-level config change: needs _lock, not the FSM
+                        # mutex (taking _lock under _fsm_mutex would deadlock
+                        # against InstallSnapshot's _lock → _fsm_mutex order).
+                        self._apply_peer_change(
+                            e.msg_type, codec.unpack(e.payload), epoch
                         )
-                    except Exception:
-                        logger.exception(
-                            "%s: FSM apply failed at %d", self.node_id, e.index
-                        )
+                        continue
+                    with self._fsm_mutex:
+                        if self._restore_epoch != epoch:
+                            break
+                        try:
+                            # Leader-direct: the submitted payload applies
+                            # as-is when this entry is provably ours (term
+                            # match); anything else decodes fresh — the FSM
+                            # (and through it the state store) owns applied
+                            # structs outright either way.
+                            if direct is not None and direct[0] == e.term:
+                                payload = direct[1]
+                            else:
+                                payload = codec.unpack(e.payload)
+                            self.fsm.apply(e.index, e.msg_type, payload)
+                        except Exception:
+                            logger.exception(
+                                "%s: FSM apply failed at %d",
+                                self.node_id, e.index,
+                            )
             with self._commit_cv:
                 if self._restore_epoch == epoch and end > self.last_applied:
                     self.last_applied = end

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from ..gctune import paused_gc
 from typing import Callable, Iterable, Optional
 
 from ..structs import (
@@ -1187,7 +1189,17 @@ class StateStore(_ReadMixin):
         will not mutate afterwards (the plan-apply path: every alloc in a
         submitted Plan is a plan-owned copy or freshly minted — see
         Plan.append_fresh_alloc). At c2m scale the per-alloc copy is the
-        single largest cost of applying a plan (VERDICT r2 weak #2)."""
+        single largest cost of applying a plan (VERDICT r2 weak #2).
+
+        Even when owned, allocs matching an EXISTING row are copied before
+        the client-state merge below: with leader-direct raft apply the
+        submitted objects are concurrently visible to the plan applier's
+        OverlaySnapshot, and while index stamps and job re-attachment are
+        invisible to its verification math (it reads statuses and
+        resources only), the existing-row merge rewrites client_status /
+        task_states — those must never mutate under a concurrent reader.
+        Fresh inserts (the ~10^5-alloc bulk of a c2m plan) stay
+        zero-copy."""
         t = self._wtable(TABLE_ALLOCS)
         jobs_touched: set[tuple[str, str]] = set()
         # (ns, job) -> {task_group: fresh insert count}: jobs whose touched
@@ -1227,7 +1239,7 @@ class StateStore(_ReadMixin):
         contrib_cache: dict[tuple, Optional[tuple]] = {}
         for alloc in allocs:
             existing = t.get(alloc.id)
-            if not owned:
+            if not owned or existing is not None:
                 alloc = alloc.copy()
             # Plan payloads are denormalized: allocs scheduled against the
             # plan's job version carry job=None and re-attach to it here —
@@ -1685,8 +1697,6 @@ class StateStore(_ReadMixin):
 
     def upsert_plan_results(self, index: int, result: PlanResult) -> None:
         """Apply a committed plan atomically (reference state_store.go:318)."""
-        from ..gctune import paused_gc
-
         with self._lock, paused_gc():
             allocs_to_upsert: list[Allocation] = []
             for allocs in result.node_allocation.values():

@@ -192,3 +192,72 @@ def test_snapshot_compaction_and_catch_up():
         ), "lagging follower should catch up via snapshot"
     finally:
         c.shutdown()
+
+
+def test_leader_direct_apply_converges_with_decoded_followers(cluster3):
+    """The leader's FSM applies the submitted payload object while
+    followers decode the encoded log entry (raft_replication.py
+    leader-direct apply); both must land identical state — the codec's
+    round-trip invariant made observable end to end."""
+    from nomad_tpu.structs import PlanResult
+
+    leader = cluster3.wait_leader()
+    node = mock.node()
+    leader.apply("node_register", node)
+    job = mock.job()
+    leader.apply("job_register", (job, None))
+    allocs = [mock.alloc(job=job, node_id=node.id) for _ in range(5)]
+    # denormalized payload, as the plan applier ships it
+    for a in allocs:
+        a.job = None
+    result = PlanResult(node_allocation={node.id: allocs}, job=job)
+    leader.apply("apply_plan_results", result)
+
+    def synced():
+        return all(
+            len(s.allocs_by_node(node.id)) == 5
+            for s in cluster3.stores.values()
+        )
+
+    assert wait_until(synced), "plan should apply on every store"
+    lead_store = cluster3.stores[leader.node_id]
+    want = {
+        a.id: (
+            a.job_id,
+            a.node_id,
+            a.task_group,
+            a.client_status,
+            a.desired_status,
+            a.create_index,
+            a.modify_index,
+            tuple(
+                (r.cpu, r.memory_mb, r.disk_mb)
+                for r in [a.comparable_resources()]
+            ),
+            a.job is not None and a.job.version,
+        )
+        for a in lead_store.allocs_by_node(node.id)
+    }
+    for nid, store in cluster3.stores.items():
+        got = {
+            a.id: (
+                a.job_id,
+                a.node_id,
+                a.task_group,
+                a.client_status,
+                a.desired_status,
+                a.create_index,
+                a.modify_index,
+                tuple(
+                    (r.cpu, r.memory_mb, r.disk_mb)
+                    for r in [a.comparable_resources()]
+                ),
+                a.job is not None and a.job.version,
+            )
+            for a in store.allocs_by_node(node.id)
+        }
+        assert got == want, f"store {nid} diverged from leader"
+    # the leader-direct path stamped the caller's objects in place
+    # (ownership transfer): the submitted allocs ARE the stored rows
+    assert allocs[0].create_index > 0
+    assert lead_store.alloc_by_id(allocs[0].id) is allocs[0]
