@@ -92,6 +92,22 @@ class InProcessClusterRPC:
         )
 
 
+def _tls_fingerprint(cert_file: str, key_file: str, ca_file: str = "") -> str:
+    """Content hash of the TLS material triple — reload() compares it to
+    detect in-place cert rotation (same paths, new bytes)."""
+    import hashlib
+
+    hsh = hashlib.sha256()
+    for path in (cert_file, key_file, ca_file):
+        if path:
+            try:
+                with open(path, "rb") as f:
+                    hsh.update(f.read())
+            except OSError:
+                pass
+    return hsh.hexdigest()
+
+
 @dataclass
 class AgentConfig:
     """Reference: command/agent/config.go (subset; grows with features)."""
@@ -137,6 +153,9 @@ class AgentConfig:
     # scheduler
     num_schedulers: int = 2
     use_tpu_batch_worker: bool = False
+    # which eval types this server's workers serve (reference
+    # EnabledSchedulers, config.go:159); None = all
+    enabled_schedulers: Optional[list] = None
     # retry_join seeds (serf)
     server_join: list = field(default_factory=list)
     # acl stanza
@@ -195,6 +214,12 @@ class Agent:
                 config.tls_key_file,
                 config.tls_ca_file,
             )
+        # baseline TLS-material fingerprint so reload() can detect
+        # in-place cert rotation (same paths, new bytes)
+        if config.tls_cert_file and config.tls_key_file:
+            self._tls_fp = _tls_fingerprint(
+                config.tls_cert_file, config.tls_key_file, config.tls_ca_file
+            )
         self.server: Optional[ClusterServer] = None
         self.client: Optional[Client] = None
         self.http = None
@@ -231,6 +256,7 @@ class Agent:
                 port=config.rpc_port,
                 num_workers=config.num_schedulers,
                 use_tpu_batch_worker=config.use_tpu_batch_worker,
+                enabled_schedulers=config.enabled_schedulers,
                 region=config.region,
                 bootstrap_expect=expect,
                 rpc_secret=config.rpc_secret,
@@ -326,6 +352,88 @@ class Agent:
         from ..gctune import freeze_startup_heap
 
         freeze_startup_heap()
+
+    def reload(self, new_config: AgentConfig) -> list[str]:
+        """Apply the RELOADABLE subset of a re-read config to the live
+        agent (reference command/agent/agent.go Agent.Reload, driven by
+        SIGHUP in command.go handleSignals). Hot paths:
+
+        - TLS material rotation: new certs/keys/CA load into the LIVE
+          ssl contexts shared by every fabric socket and the HTTPS
+          listener — subsequent handshakes present the new chain while
+          established connections keep flowing (nothing is dropped).
+        - client node_meta: replaced and re-registered so schedulers see
+          new constraint/spread targets.
+        - vault_allowed_policies: derivation allowlist swap.
+
+        Everything else (ports, server/client enablement, data_dir,
+        enabling TLS where it was off) still needs a restart — the same
+        boundary the reference draws. Returns the list of applied
+        changes for operator logs."""
+        changed: list[str] = []
+        old = self.config
+        # Always re-read the material when TLS is on: operators rotate
+        # certs IN PLACE (same path, new content) at least as often as
+        # they change paths, and a path compare would silently skip
+        # those. Re-loading unchanged files is harmless. A fingerprint
+        # of the file contents decides whether to REPORT a change.
+        if new_config.tls_cert_file and new_config.tls_key_file and (
+            self.fabric_tls is not None or (self.http and self.http.tls)
+        ):
+            new_fp = _tls_fingerprint(
+                new_config.tls_cert_file,
+                new_config.tls_key_file,
+                new_config.tls_ca_file,
+            )
+            rotated = new_fp != getattr(self, "_tls_fp", None) or (
+                new_config.tls_cert_file,
+                new_config.tls_key_file,
+                new_config.tls_ca_file,
+            ) != (old.tls_cert_file, old.tls_key_file, old.tls_ca_file)
+            self._tls_fp = new_fp
+            if rotated and self.fabric_tls is not None:
+                server_ctx, client_ctx = self.fabric_tls
+                server_ctx.load_cert_chain(
+                    new_config.tls_cert_file, new_config.tls_key_file
+                )
+                client_ctx.load_cert_chain(
+                    new_config.tls_cert_file, new_config.tls_key_file
+                )
+                if new_config.tls_ca_file:
+                    server_ctx.load_verify_locations(new_config.tls_ca_file)
+                    client_ctx.load_verify_locations(new_config.tls_ca_file)
+                changed.append("tls_rpc_material")
+            if (
+                rotated
+                and self.http is not None
+                and old.tls_http
+                and self.http.reload_tls(
+                    new_config.tls_cert_file, new_config.tls_key_file
+                )
+            ):
+                changed.append("tls_http_material")
+            old.tls_cert_file = new_config.tls_cert_file
+            old.tls_key_file = new_config.tls_key_file
+            old.tls_ca_file = new_config.tls_ca_file
+        if (
+            self.client is not None
+            and new_config.node_meta != old.node_meta
+        ):
+            self.client.update_node_meta(new_config.node_meta)
+            old.node_meta = dict(new_config.node_meta)
+            changed.append("client_node_meta")
+        if (
+            self.server is not None
+            and new_config.vault_allowed_policies != old.vault_allowed_policies
+        ):
+            self.server.server.vault_allowed_policies = (
+                list(new_config.vault_allowed_policies)
+                if new_config.vault_allowed_policies is not None
+                else None
+            )
+            old.vault_allowed_policies = new_config.vault_allowed_policies
+            changed.append("vault_allowed_policies")
+        return changed
 
     def shutdown(self) -> None:
         if getattr(self, "statsd", None) is not None:

@@ -99,11 +99,15 @@ class HTTPAgentServer:
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self.tls = bool(tls_cert and tls_key)
+        self._tls_ctx = None
         if self.tls:
             import ssl
 
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(tls_cert, tls_key)
+            # kept for SIGHUP cert rotation: new handshakes pick up
+            # material re-loaded into the live context (Agent.reload)
+            self._tls_ctx = ctx
             # handshake must NOT run in the accept loop: a client that
             # connects and sends nothing would block serve_forever and
             # freeze the whole API. Deferred, the handshake happens on
@@ -147,6 +151,19 @@ class HTTPAgentServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+    def reload_tls(self, cert_file: str, key_file: str) -> bool:
+        """Rotate the HTTPS certificate without dropping the listener:
+        loading new material into the live SSLContext makes every
+        SUBSEQUENT handshake present it while established connections
+        finish on the old session (reference Agent.Reload →
+        http.Server TLS config swap). No-op (False) when HTTPS is off —
+        enabling TLS on a plaintext listener needs a restart, as in the
+        reference."""
+        if self._tls_ctx is None:
+            return False
+        self._tls_ctx.load_cert_chain(cert_file, key_file)
+        return True
 
     # -- ACL helpers (second-stage, object-namespace-aware) ------------
 

@@ -134,14 +134,33 @@ def cmd_agent(args) -> int:
         print(f"==> RPC: {agent.server.addr[0]}:{agent.server.addr[1]}")
     print("==> Agent started! Ctrl-C to stop.")
     stop = [False]
+    hup = [False]
 
     def on_sig(sig, frame):
         stop[0] = True
 
+    def on_hup(sig, frame):
+        hup[0] = True  # handled on the main loop, not in the handler
+
     signal.signal(signal.SIGINT, on_sig)
     signal.signal(signal.SIGTERM, on_sig)
+    # SIGHUP re-reads the config file and applies the reloadable subset
+    # (TLS material, client meta, vault allowlist — Agent.reload);
+    # reference command/agent/command.go handleSignals → handleReload.
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, on_hup)
     try:
         while not stop[0]:
+            if hup[0]:
+                hup[0] = False
+                if args.config:
+                    try:
+                        changed = agent.reload(_load_agent_config(args.config))
+                        print(f"==> Config reloaded: {changed or 'no changes'}")
+                    except Exception as e:
+                        print(f"==> Config reload FAILED: {e}")
+                else:
+                    print("==> SIGHUP ignored: agent started without -config")
             time.sleep(0.2)
     finally:
         print("==> Shutting down")
@@ -2254,3 +2273,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             print(f"Error: {e.code}", file=sys.stderr)
             return 1
         raise
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -294,6 +294,20 @@ class Client:
     def wait_registered(self, timeout_s: float = 15.0) -> bool:
         return self._registered.wait(timeout_s)
 
+    def update_node_meta(self, meta: dict) -> None:
+        """Agent-reload path (reference client.Reload → UpdateConfig):
+        replace the operator-set static metadata and push the node so
+        schedulers see the new constraint/spread targets immediately."""
+        from ..structs.node_class import compute_node_class
+
+        self.node.meta = {str(k): str(v) for k, v in meta.items()}
+        self.node.computed_class = compute_node_class(self.node)
+        if self._registered.is_set():
+            try:
+                self.rpc.register(self.node)
+            except Exception:
+                logger.exception("node update after meta reload failed")
+
     def _fingerprint_drivers(self) -> bool:
         """Run every driver's fingerprint and fold the results into the
         node. Honors each driver's verdict — an undetected driver (e.g.

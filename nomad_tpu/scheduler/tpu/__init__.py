@@ -7,4 +7,4 @@ from .kernels import (
 )
 from .lower import build_node_table, lower_group
 from .scheduler import TPUBatchScheduler, TPUGenericScheduler, solve_eval_batch
-from .solver import BatchSolver, GroupAsk
+from .solver import BatchSolver, GroupAsk, ResidentClusterState

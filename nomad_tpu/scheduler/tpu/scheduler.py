@@ -189,15 +189,19 @@ def solve_eval_batch(
     config: Optional[SchedulerConfig] = None,
     solve_fn=None,
     solve_preempt_fn=None,
+    resident=None,
 ) -> dict[str, Plan]:
     """High-throughput path: reconcile every pending eval, solve ALL their
     placements in one kernel invocation, and emit one plan per eval.
 
     Per-job serialization is the caller's duty (the eval broker already
-    guarantees one in-flight eval per job)."""
+    guarantees one in-flight eval per job). `resident` — an optional
+    ResidentClusterState reused across calls so steady-state solves skip
+    the cap/used upload (solver.py)."""
     with paused_gc():
         return _solve_eval_batch(
-            state, planner, evals, config, solve_fn, solve_preempt_fn
+            state, planner, evals, config, solve_fn, solve_preempt_fn,
+            resident,
         )
 
 
@@ -208,6 +212,7 @@ def _solve_eval_batch(
     config: Optional[SchedulerConfig] = None,
     solve_fn=None,
     solve_preempt_fn=None,
+    resident=None,
 ) -> dict[str, Plan]:
     config = config or SchedulerConfig()
     plans: dict[str, Plan] = {}
@@ -265,7 +270,8 @@ def _solve_eval_batch(
             asks.append(GroupAsk(ev, pjob, tg_name, reqs, plan=plan))
 
     solver = BatchSolver(
-        state, config, solve_fn=solve_fn, solve_preempt_fn=solve_preempt_fn
+        state, config, solve_fn=solve_fn, solve_preempt_fn=solve_preempt_fn,
+        resident=resident,
     )
     outcome = solver.solve(asks)
     for ev in evals:

@@ -104,13 +104,134 @@ def _merge_outcomes(a: SolveOutcome, b: SolveOutcome) -> SolveOutcome:
     return out
 
 
+class ResidentClusterState:
+    """Device-resident capacity/usage tensors reused across solves.
+
+    Re-uploading the full [N, 3] cap/used tensors every solve is
+    redundant when the node universe is stable between batches: cap
+    changes only on node register/update and usage changes only by the
+    deltas of applied plans. This keeps both as DEVICE arrays at the
+    padded bucket shape and, per solve, ships only the rows that changed
+    since the last sync (diffed against the store's incremental per-node
+    usage aggregate, state/store.py IDX_NODE_USED). Through a
+    high-latency link (the axon tunnel here; PCIe/DCN generally) that
+    turns the steady-state upload into the per-batch group tensors plus
+    a usually-empty delta — the round-trip amortization VERDICT r4
+    item 2 asked for. Single-writer by design: the server's TPU worker
+    owns one instance (the eval broker already serializes solves).
+    """
+
+    def __init__(self) -> None:
+        self._node_vers: Optional[tuple] = None
+        self._usage: dict[str, tuple] = {}
+        self._cap_dev = None
+        self._used_dev = None
+        self._np = 0
+        # telemetry: how the last sync was satisfied
+        self.last_sync = "cold"
+
+    def sync(self, snapshot, nodes: list) -> tuple:
+        """Return (cap_dev, used_dev) current for `nodes` (table order).
+
+        Full re-upload when the node universe/capacity changed
+        (fingerprint: per-node (id, modify_index)); otherwise a
+        scatter-update of just the usage rows whose committed aggregate
+        moved since the last solve.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        n = len(nodes)
+        np_ = pad_n(n)
+        vers = tuple((node.id, node.modify_index) for node in nodes)
+        usage = {
+            node.id: snapshot.node_usage(node.id) for node in nodes
+        }
+        if (
+            self._node_vers != vers
+            or self._np != np_
+            or self._cap_dev is None
+        ):
+            # identical clipping to _lower_small so the resident tensors
+            # are bit-equal to what a fresh upload would carry
+            cap = np.zeros((np_, 3), dtype=np.int32)
+            used = np.zeros((np_, 3), dtype=np.int32)
+            cap_rows = np.array(
+                [
+                    (a.cpu, a.memory_mb, a.disk_mb)
+                    for a in (node.available_resources() for node in nodes)
+                ],
+                dtype=np.int64,
+            ).reshape(n, 3)
+            used_rows = np.array(
+                [usage[node.id][:3] for node in nodes], dtype=np.int64
+            ).reshape(n, 3)
+            cap[:n] = np.clip(cap_rows, 0, 2**31 - 1)
+            used[:n] = np.clip(used_rows, 0, 2**31 - 1)
+            self._cap_dev = jax.device_put(cap)
+            self._used_dev = jax.device_put(used)
+            self._node_vers = vers
+            self._np = np_
+            self._usage = usage
+            self.last_sync = "full"
+            return self._cap_dev, self._used_dev
+        prev = self._usage
+        changed_idx = [
+            i for i, node in enumerate(nodes)
+            if usage[node.id] != prev.get(node.id, (0, 0, 0, 0))
+        ]
+        if changed_idx:
+            rows = np.clip(
+                np.array(
+                    [usage[nodes[i].id][:3] for i in changed_idx],
+                    dtype=np.int64,
+                ),
+                0,
+                2**31 - 1,
+            ).astype(np.int32)
+            idx = np.asarray(changed_idx, dtype=np.int32)
+            self._used_dev = _scatter_rows(self._used_dev, idx, rows)
+            self._usage = usage
+            self.last_sync = f"delta:{len(changed_idx)}"
+        else:
+            self.last_sync = "clean"
+        return self._cap_dev, self._used_dev
+
+
+def _scatter_rows(used_dev, idx, rows, donate: bool = True):
+    """Row-scatter onto a resident device array. donate=True consumes
+    the old buffer in place (sync updates — the resident array is
+    replaced by its successor); donate=False leaves it intact (a
+    per-batch adjusted view for vacated stops / partition placements).
+    One jit per flavor, cached."""
+    import jax
+
+    fn = _SCATTER_JITS.get(donate)
+    if fn is None:
+
+        def _scatter(used, idx, rows):
+            return used.at[idx].set(rows)
+
+        fn = _SCATTER_JITS[donate] = jax.jit(
+            _scatter, donate_argnums=(0,) if donate else ()
+        )
+    return fn(used_dev, idx, rows)
+
+
+_SCATTER_JITS: dict = {}
+
+
 class BatchSolver:
     """Solves placement for a batch of evaluations against one snapshot."""
 
     def __init__(self, state, config: Optional[SchedulerConfig] = None,
-                 solve_fn=None, solve_preempt_fn=None) -> None:
+                 solve_fn=None, solve_preempt_fn=None,
+                 resident: Optional[ResidentClusterState] = None) -> None:
         self.state = state
         self.config = config or SchedulerConfig()
+        # Device-resident cap/used tensors shared across solves (the
+        # server's TPU worker owns one instance); None = upload per solve.
+        self.resident = resident
         self.ctx = EvalContext(state, None, logger, self.config)
         self.solve_fn = solve_fn or solve_placement
         # Preemption kernel seam: defaults to the single-chip tier kernel
@@ -283,7 +404,73 @@ class BatchSolver:
                 if a.id not in stopped_ids
             ] + placed_by_node.get(nid, [])
 
-        table = build_node_table(nodes, live_allocs)
+        # Aggregate fast path: when the batch can neither preempt (no
+        # tier tensors needed) nor ask for dedicated cores (no core
+        # pools), per-node utilization comes straight from the store's
+        # incremental aggregate — O(nodes), not O(allocs) — with this
+        # batch's vacated stops and the host partition's placements
+        # applied as per-node adjustments.
+        preempt_possible = self.solve_preempt_fn is not None and any(
+            self.config.preemption_enabled(a.job.type) for a in asks
+        )
+        if preempt_possible and hasattr(self.state, "alloc_priority_tiers"):
+            # Exact O(1) refinement: preemption can only trigger when some
+            # committed alloc's priority sits PRIORITY_DELTA below a batch
+            # job's — the store's priority-count aggregate proves absence
+            # without walking allocs (the common all-priority-50 cluster).
+            maxprio = max(
+                a.job.priority
+                for a in asks
+                if self.config.preemption_enabled(a.job.type)
+            )
+            tiers = list(self.state.alloc_priority_tiers())
+            # same-batch host-partition placements are preemptible too
+            # (they're in the dense table's live view)
+            tiers.extend(
+                a.job.priority if a.job is not None else 50
+                for a in self._partition_placed
+            )
+            preempt_possible = any(
+                maxprio - p >= PRIORITY_DELTA for p in tiers
+            )
+        usage_of = None
+        if (
+            not self._batch_has_cores
+            and not preempt_possible
+            and hasattr(self.state, "node_usage")
+        ):
+            adj: dict[str, list[int]] = {}
+
+            def _adjust(nid: str, r, sign: int) -> None:
+                d = adj.get(nid)
+                if d is None:
+                    d = adj[nid] = [0, 0, 0]
+                d[0] += sign * r.cpu
+                d[1] += sign * r.memory_mb
+                d[2] += sign * r.disk_mb
+
+            for sid in stopped_ids:
+                stored = self.state.alloc_by_id(sid)
+                if stored is not None and not stored.terminal_status():
+                    _adjust(
+                        stored.node_id, stored.comparable_resources(), -1
+                    )
+            for a in self._partition_placed:
+                _adjust(a.node_id, a.comparable_resources(), +1)
+            state_usage = self.state.node_usage
+            if adj:
+
+                def usage_of(nid: str):
+                    u = state_usage(nid)
+                    d = adj.get(nid)
+                    if d is None:
+                        return u
+                    return (u[0] + d[0], u[1] + d[1], u[2] + d[2])
+
+            else:
+                usage_of = state_usage
+
+        table = build_node_table(nodes, live_allocs, usage_of=usage_of)
 
         groups: list[LoweredGroup] = []
         base_of: dict[int, LoweredGroup] = {}  # group idx -> unrestricted base
@@ -320,7 +507,34 @@ class BatchSolver:
 
         t0 = now_ns()
         if compact:
-            inst, over, used_out = self._run_compact(table, groups, used)
+            # Resident device tensors: valid only when the usage-aggregate
+            # path produced the table (the sync diffs against the same
+            # aggregate) — the batch adjustments are scattered onto a
+            # non-donated copy so the resident buffer stays committed-state.
+            dev_state = None
+            if self.resident is not None and usage_of is not None:
+                cap_dev, used_dev = self.resident.sync(self.state, nodes)
+                # stops can reference nodes outside this batch's dc
+                # universe — those rows aren't in the table (or tensors)
+                adj_in = [nid for nid in adj if nid in table.index_of]
+                if adj_in:
+                    idx = np.array(
+                        [table.index_of[nid] for nid in adj_in],
+                        dtype=np.int32,
+                    )
+                    rows = np.clip(
+                        np.array(
+                            [usage_of(nid)[:3] for nid in adj_in],
+                            dtype=np.int64,
+                        ),
+                        0,
+                        2**31 - 1,
+                    ).astype(np.int32)
+                    used_dev = _scatter_rows(used_dev, idx, rows, donate=False)
+                dev_state = (cap_dev, used_dev)
+            inst, over, used_out = self._run_compact(
+                table, groups, used, dev_state=dev_state
+            )
             free_base = table.cap - table.used
             leftovers = self._materialize_compact(
                 table, groups, inst, over, free_base
@@ -600,10 +814,25 @@ class BatchSolver:
             out[j, : a.shape[0]] = a
         return out, idx
 
-    def _run_compact(self, table, groups: list[LoweredGroup], used_n):
+    def _run_compact(
+        self, table, groups: list[LoweredGroup], used_n, dev_state=None
+    ):
         """Default kernel with deduped/bit-packed uploads and device-side
         compaction: returns (inst_node [G, maxC], over [N] bool,
-        used' device array)."""
+        used' device array).
+
+        dev_state — optional (cap_dev, used_dev) resident device tensors
+        at this table's padded shape; when given, the [N, 3] host arrays
+        are used only for the readback-width bound and the upload ships
+        just the per-batch group tensors. Phase timings land in the
+        telemetry registry (nomad.tpu.{host_prep,device,readback}_seconds)
+        so the bench can publish the device/transfer/host split.
+        """
+        import jax
+
+        from ... import metrics
+
+        t_prep0 = now_ns()
         n, g = table.n, len(groups)
         np_, gp, cap, used, asks_arr, counts = self._lower_small(table, groups)
         used[:n] = used_n[:n]
@@ -650,9 +879,14 @@ class BatchSolver:
             if placeable > placeable_cap:
                 placeable_cap = placeable
         maxc = pad_c(max(1, placeable_cap))
+        # the resident device tensors replace the cap/used upload when
+        # their padded shape matches this table's bucket
+        cap_in, used_in = cap, used
+        if dev_state is not None and dev_state[0].shape == (np_, 3):
+            cap_in, used_in = dev_state
         inst, over, used_out = solve_placement_compact(
-            cap,
-            used,
+            cap_in,
+            used_in,
             asks_arr,
             counts,
             feas_packed,
@@ -663,9 +897,19 @@ class BatchSolver:
             ucap_idx,
             max_count=maxc,
         )
+        # device compute vs readback split: block on the async dispatch
+        # first, then transfer — so the bench's breakdown distinguishes
+        # chip time from the (tunnel) link time
+        metrics.time_ns("nomad.tpu.host_prep_seconds", now_ns() - t_prep0)
+        t_dev0 = now_ns()
+        jax.block_until_ready(used_out)
+        metrics.time_ns("nomad.tpu.device_seconds", now_ns() - t_dev0)
+        t_rb0 = now_ns()
         # slice on-device before the host transfer: the pad region is
         # noise and the tunnel to the chip is the slow link
-        return np.asarray(inst[:g]), np.asarray(over[:n]), used_out
+        result = np.asarray(inst[:g]), np.asarray(over[:n]), used_out
+        metrics.time_ns("nomad.tpu.readback_seconds", now_ns() - t_rb0)
+        return result
 
     def _run_kernel(
         self,

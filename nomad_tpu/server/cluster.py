@@ -768,12 +768,33 @@ class DeploymentEndpoint(_Forwarder):
 
 
 class ACLEndpoint(_Forwarder):
+    def _forward_authoritative(self, method: str, args):
+        """Replicated ACL state (policies, global tokens) is writable
+        ONLY in the authoritative region — a write landed here would be
+        reverted by the next replication poll. Forward it (reference
+        acl_endpoint.go rewrites args.Region to AuthoritativeRegion).
+        Returns None when THIS region is authoritative (or federation
+        is unconfigured) and the caller should apply locally."""
+        cs = self.cs
+        if not cs.authoritative_region or cs.region == cs.authoritative_region:
+            return None
+        addr = cs.region_server(cs.authoritative_region)
+        if addr is None:
+            raise RPCError(
+                f"authoritative region {cs.authoritative_region!r} "
+                f"unreachable for replicated ACL write"
+            )
+        return lambda: cs.pool.call(addr, method, args, timeout_s=10.0)
+
     def bootstrap(self, args):
         return self._forward(
             "ACL.bootstrap", args, lambda a: self.cs.server.acl_bootstrap()
         )
 
     def policy_upsert(self, args):
+        fwd = self._forward_authoritative("ACL.policy_upsert", args)
+        if fwd is not None:
+            return fwd()
         return self._forward(
             "ACL.policy_upsert",
             args,
@@ -781,6 +802,9 @@ class ACLEndpoint(_Forwarder):
         )
 
     def policy_delete(self, args):
+        fwd = self._forward_authoritative("ACL.policy_delete", args)
+        if fwd is not None:
+            return fwd()
         return self._forward(
             "ACL.policy_delete",
             args,
@@ -794,6 +818,15 @@ class ACLEndpoint(_Forwarder):
         return self.cs.server.state.acl_policies()
 
     def token_create(self, args):
+        # Global tokens are minted in the authoritative region and
+        # replicate outward (reference acl_endpoint.go UpsertTokens
+        # forwards globals to AuthoritativeRegion; leader.go:1423 pulls
+        # them back). Local tokens stay region-local.
+        token = args.get("token")
+        if token is not None and getattr(token, "global_", False):
+            fwd = self._forward_authoritative("ACL.token_create", args)
+            if fwd is not None:
+                return fwd()
         return self._forward(
             "ACL.token_create",
             args,
@@ -801,6 +834,27 @@ class ACLEndpoint(_Forwarder):
         )
 
     def token_delete(self, args):
+        # Global-token deletes must land in the authoritative region or
+        # the replication poll resurrects the revoked secret here within
+        # one interval. Split the batch: globals forward, locals apply.
+        state = self.cs.server.state
+        accessors = list(args.get("accessor_ids", []))
+        global_ids = [
+            aid
+            for aid in accessors
+            if (t := state.acl_token_by_accessor(aid)) is not None
+            and t.global_
+        ]
+        if global_ids:
+            fwd = self._forward_authoritative(
+                "ACL.token_delete", {**args, "accessor_ids": global_ids}
+            )
+            if fwd is not None:
+                fwd()
+                accessors = [a for a in accessors if a not in global_ids]
+                if not accessors:
+                    return None
+                args = {**args, "accessor_ids": accessors}
         return self._forward(
             "ACL.token_delete",
             args,
@@ -809,6 +863,26 @@ class ACLEndpoint(_Forwarder):
 
     def token_get(self, args):
         return self.cs.server.state.acl_token_by_accessor(args["accessor_id"])
+
+    def replicate(self, args):
+        """Server-to-server replication feed (reference ACL.ListPolicies /
+        ACL.ListTokens driven by leader.go:1282,1423): full policy set +
+        GLOBAL tokens WITH secrets, plus the acl table index so pollers
+        no-op cheaply. Rides the server fabric only — the fabric's shared
+        rpc secret/mTLS is the authorization boundary (the reference uses
+        a replication token; external clients never see this surface
+        because token_list redacts secrets)."""
+        from ..state.store import TABLE_ACL_POLICIES, TABLE_ACL_TOKENS
+
+        state = self.cs.server.state
+        idx = state.table_index(TABLE_ACL_POLICIES, TABLE_ACL_TOKENS)
+        if args.get("min_index") and idx <= args["min_index"]:
+            return {"index": idx, "unchanged": True}
+        return {
+            "index": idx,
+            "policies": state.acl_policies(),
+            "tokens": [t for t in state.acl_tokens() if t.global_],
+        }
 
     def token_list(self, args):
         # Secrets are never listed (reference redacts SecretID on list).
@@ -886,17 +960,26 @@ class ClusterServer:
         port: int = 0,
         num_workers: int = 2,
         use_tpu_batch_worker: bool = False,
+        enabled_schedulers=None,
         region: str = "global",
         bootstrap_expect: Optional[int] = None,
         rpc_secret: str = "",
         data_dir: Optional[str] = None,
         acl_enforce: bool = False,
+        authoritative_region: Optional[str] = None,
+        acl_replication_interval_s: float = 0.5,
         tls=None,  # (server_ctx, client_ctx) from rpc.tls.fabric_contexts
         **raft_kw,
     ) -> None:
         self.node_id = node_id
         self.region = region
         self.acl_enforce = acl_enforce
+        # Federated ACL replication (reference leader.go:1282,1423): a
+        # region naming an authoritative region other than itself pulls
+        # that region's policies + global tokens on its leader.
+        self.authoritative_region = authoritative_region
+        self.acl_replication_interval_s = acl_replication_interval_s
+        self._acl_repl_stop: Optional[threading.Event] = None
         self.tls = tls
         self.rpc = RPCServer(
             host=host, port=port, secret=rpc_secret,
@@ -906,7 +989,9 @@ class ClusterServer:
             secret=rpc_secret, tls_context=tls[1] if tls else None
         )
         self.server = Server(
-            num_workers=num_workers, use_tpu_batch_worker=use_tpu_batch_worker
+            num_workers=num_workers,
+            use_tpu_batch_worker=use_tpu_batch_worker,
+            enabled_schedulers=enabled_schedulers,
         )
         # Wider timers than the raw RaftNode defaults: a full server stacks
         # scheduler workers, watchers, and client traffic onto the same
@@ -1296,9 +1381,99 @@ class ClusterServer:
         if is_leader:
             logger.info("%s: establishing leadership", self.node_id)
             self.server.establish_leadership()
+            if (
+                self.authoritative_region
+                and self.authoritative_region != self.region
+                and self._acl_repl_stop is None
+            ):
+                self._acl_repl_stop = threading.Event()
+                t = threading.Thread(
+                    target=self._acl_replication_loop,
+                    args=(self._acl_repl_stop,),
+                    name=f"acl-repl-{self.node_id}",
+                    daemon=True,
+                )
+                t.start()
         else:
             logger.info("%s: revoking leadership", self.node_id)
+            if self._acl_repl_stop is not None:
+                self._acl_repl_stop.set()
+                self._acl_repl_stop = None
             self.server.revoke_leadership()
+
+    def _acl_replication_loop(self, stop: threading.Event) -> None:
+        """Leader-only puller in a NON-authoritative region: mirror the
+        authoritative region's policies and global tokens into this
+        region's raft (reference replicateACLPolicies leader.go:1282 +
+        replicateACLTokens leader.go:1423). Local (non-global) tokens in
+        this region are never touched; policies converge to the
+        authoritative set exactly."""
+        last_index = 0
+        while not stop.wait(self.acl_replication_interval_s):
+            addr = self.region_server(self.authoritative_region)
+            if addr is None:
+                continue  # authoritative region not gossip-visible yet
+            try:
+                feed = self.pool.call(
+                    addr, "ACL.replicate", {"min_index": last_index},
+                    timeout_s=10.0,
+                )
+            except Exception:
+                continue  # transient fabric failure: retry next tick
+            if feed.get("unchanged"):
+                continue
+            try:
+                self._acl_apply_feed(feed)
+                last_index = feed["index"]
+            except NotLeaderError:
+                return  # deposed mid-apply; the new leader re-pulls
+            except Exception:
+                # a transient apply failure (raft commit timeout under
+                # load) must not kill the daemon — replication would
+                # silently stop until the next leadership change
+                logger.exception(
+                    "%s: acl replication apply failed; retrying",
+                    self.node_id,
+                )
+
+    def _acl_apply_feed(self, feed: dict) -> None:
+        state = self.server.state
+        want_pols = {p.name: p for p in feed.get("policies", [])}
+        have_pols = {p.name: p for p in state.acl_policies()}
+        upserts = [
+            p
+            for name, p in want_pols.items()
+            if name not in have_pols
+            or have_pols[name].rules != p.rules
+            or have_pols[name].description != p.description
+        ]
+        deletes = [n for n in have_pols if n not in want_pols]
+        if upserts:
+            self.server.raft_apply(
+                "acl_policy_upsert", [p.copy() for p in upserts]
+            )
+        if deletes:
+            self.server.raft_apply("acl_policy_delete", deletes)
+        want_toks = {t.accessor_id: t for t in feed.get("tokens", [])}
+        have_toks = {
+            t.accessor_id: t for t in state.acl_tokens() if t.global_
+        }
+        tok_up = [
+            t
+            for aid, t in want_toks.items()
+            if aid not in have_toks
+            or have_toks[aid].secret_id != t.secret_id
+            or have_toks[aid].policies != t.policies
+            or have_toks[aid].type != t.type
+            or have_toks[aid].expiration_time_ns != t.expiration_time_ns
+        ]
+        tok_del = [aid for aid in have_toks if aid not in want_toks]
+        if tok_up:
+            self.server.raft_apply(
+                "acl_token_upsert", [t.copy() for t in tok_up]
+            )
+        if tok_del:
+            self.server.raft_apply("acl_token_delete", tok_del)
 
     @property
     def addr(self) -> tuple[str, int]:

@@ -72,7 +72,15 @@ class Server:
         num_workers: int = 2,
         scheduler_config: Optional[SchedulerConfig] = None,
         use_tpu_batch_worker: bool = False,
+        enabled_schedulers: Optional[list[str]] = None,
     ) -> None:
+        """enabled_schedulers — which eval types this server's workers
+        serve (reference EnabledSchedulers, nomad/config.go:159 consumed
+        by worker.go:146; num_workers is NumSchedulers). None = all
+        types. An operator shards scheduler load by giving servers
+        disjoint type lists — e.g. a server with ["sysbatch"] dedicates
+        its whole pool to sysbatch evals. The _core GC type is always
+        served (the reference appends it implicitly)."""
         self.state = StateStore()
         self.fsm = FSM(self.state)
         self.log = InmemLog(self.fsm)
@@ -111,12 +119,29 @@ class Server:
         self._gc_stop = threading.Event()
         self._gc_thread: Optional[threading.Thread] = None
 
+        all_types = ["service", "batch", "system", "sysbatch"]
+        if enabled_schedulers is None:
+            enabled = list(all_types)
+        else:
+            unknown = set(enabled_schedulers) - set(all_types)
+            if unknown:
+                raise ValueError(
+                    f"enabled_schedulers: unknown types {sorted(unknown)}"
+                )
+            enabled = [t for t in all_types if t in enabled_schedulers]
+        self.enabled_schedulers = enabled
+        serve = enabled + [JOB_TYPE_CORE]
         self.workers: list[Worker] = []
         self.tpu_worker: Optional[TPUBatchWorker] = None
-        if use_tpu_batch_worker:
-            self.tpu_worker = TPUBatchWorker(self, config=self.scheduler_config)
+        batchable = [t for t in ("service", "batch") if t in enabled]
+        if use_tpu_batch_worker and batchable:
+            self.tpu_worker = TPUBatchWorker(
+                self, schedulers=batchable, config=self.scheduler_config
+            )
             system_worker = Worker(
-                self, ["system", "sysbatch", JOB_TYPE_CORE],
+                self,
+                [t for t in ("system", "sysbatch") if t in enabled]
+                + [JOB_TYPE_CORE],
                 self.scheduler_config, name="worker-system",
             )
             self.workers.append(system_worker)
@@ -125,7 +150,7 @@ class Server:
                 self.workers.append(
                     Worker(
                         self,
-                        ["service", "batch", "system", "sysbatch", JOB_TYPE_CORE],
+                        list(serve),
                         self.scheduler_config,
                         name=f"worker-{i}",
                     )

@@ -39,6 +39,7 @@ from ..structs.structs import (
     ALLOC_CLIENT_STATUS_FAILED,
     ALLOC_CLIENT_STATUS_LOST,
     ALLOC_CLIENT_STATUS_RUNNING,
+    ALLOC_DESIRED_STATUS_EVICT,
     ALLOC_DESIRED_STATUS_STOP,
     DEPLOYMENT_STATUS_CANCELLED,
     DEPLOYMENT_STATUS_SUCCESSFUL,
@@ -107,7 +108,16 @@ IDX_ALLOCS_EVAL = "_idx_allocs_eval"
 # per-node path. Values are immutable tuples, replaced wholesale, so the
 # table obeys the same COW discipline as every other table.
 IDX_NODE_USED = "_idx_node_used"
-INDEX_TABLES = (IDX_ALLOCS_NODE, IDX_ALLOCS_JOB, IDX_ALLOCS_EVAL, IDX_NODE_USED)
+# priority -> count of non-terminal allocs at that job priority. A few
+# integers that let the batch solver prove "no preemptible tier exists
+# below this batch's priorities" in O(1) and take the aggregate-usage
+# lowering path (O(nodes)) instead of walking every live alloc to build
+# tier tensors it would never use.
+IDX_PRIO_COUNT = "_idx_prio_count"
+INDEX_TABLES = (
+    IDX_ALLOCS_NODE, IDX_ALLOCS_JOB, IDX_ALLOCS_EVAL, IDX_NODE_USED,
+    IDX_PRIO_COUNT,
+)
 
 
 def usage_contribution(alloc) -> "Optional[tuple[int, int, int, int]]":
@@ -161,6 +171,37 @@ def rebuild_node_usage(allocs: dict) -> dict:
     for alloc in allocs.values():
         _usage_add(ut, alloc.node_id, usage_contribution(alloc))
     return ut
+
+
+def _alloc_priority(alloc) -> int:
+    return alloc.job.priority if alloc.job is not None else 50
+
+
+def _prio_add(pt: dict, alloc, c) -> None:
+    """Count a non-terminal alloc (c = its usage contribution; None
+    means terminal and uncounted — the same rule the usage table uses)."""
+    if c is None:
+        return
+    p = _alloc_priority(alloc)
+    pt[p] = pt.get(p, 0) + 1
+
+
+def _prio_sub(pt: dict, alloc, c) -> None:
+    if c is None:
+        return
+    p = _alloc_priority(alloc)
+    cur = pt.get(p, 0) - 1
+    if cur <= 0:
+        pt.pop(p, None)
+    else:
+        pt[p] = cur
+
+
+def rebuild_prio_counts(allocs: dict) -> dict:
+    pt: dict[int, int] = {}
+    for alloc in allocs.values():
+        _prio_add(pt, alloc, usage_contribution(alloc))
+    return pt
 
 JOB_TRACKED_VERSIONS = 6
 
@@ -315,6 +356,12 @@ class _ReadMixin:
         re-summing the node's allocs. (No lock needed: a single dict.get
         of an immutable tuple.)"""
         return self._tables[IDX_NODE_USED].get(node_id, (0, 0, 0, 0))
+
+    def alloc_priority_tiers(self) -> list[int]:
+        """Ascending job priorities that have at least one committed
+        non-terminal alloc — the O(1) preemption-possibility signal the
+        batch solver gates its aggregate lowering path on."""
+        return sorted(self._tables[IDX_PRIO_COUNT])
 
     @_locked_on_live
     def allocs_by_node_terminal(
@@ -712,6 +759,9 @@ class StateStore(_ReadMixin):
         data["tables"][IDX_NODE_USED] = rebuild_node_usage(
             data["tables"][TABLE_ALLOCS]
         )
+        data["tables"][IDX_PRIO_COUNT] = rebuild_prio_counts(
+            data["tables"][TABLE_ALLOCS]
+        )
         with self._cv:
             self._tables = data["tables"]
             self._indexes = data["indexes"]
@@ -788,9 +838,14 @@ class StateStore(_ReadMixin):
         """Insert an alloc into the main table and every secondary index."""
         self._wtable(TABLE_ALLOCS)[alloc.id] = alloc
         ut = self._wtable(IDX_NODE_USED)
+        pt = self._wtable(IDX_PRIO_COUNT)
         if existing is not None:
-            _usage_sub(ut, existing.node_id, usage_contribution(existing))
-        _usage_add(ut, alloc.node_id, usage_contribution(alloc))
+            ce = usage_contribution(existing)
+            _usage_sub(ut, existing.node_id, ce)
+            _prio_sub(pt, existing, ce)
+        ca = usage_contribution(alloc)
+        _usage_add(ut, alloc.node_id, ca)
+        _prio_add(pt, alloc, ca)
         if existing is not None:
             if existing.node_id != alloc.node_id:
                 self._idx_del(IDX_ALLOCS_NODE, existing.node_id, alloc.id)
@@ -808,11 +863,9 @@ class StateStore(_ReadMixin):
         t = self._wtable(TABLE_ALLOCS)
         alloc = t.pop(alloc_id, None)
         if alloc is not None:
-            _usage_sub(
-                self._wtable(IDX_NODE_USED),
-                alloc.node_id,
-                usage_contribution(alloc),
-            )
+            c = usage_contribution(alloc)
+            _usage_sub(self._wtable(IDX_NODE_USED), alloc.node_id, c)
+            _prio_sub(self._wtable(IDX_PRIO_COUNT), alloc, c)
             self._idx_del(IDX_ALLOCS_NODE, alloc.node_id, alloc_id)
             self._idx_del(IDX_ALLOCS_JOB, (alloc.namespace, alloc.job_id), alloc_id)
             self._idx_del(IDX_ALLOCS_EVAL, alloc.eval_id, alloc_id)
@@ -1232,6 +1285,7 @@ class StateStore(_ReadMixin):
             return inner
 
         ut = self._wtable(IDX_NODE_USED)
+        pt = self._wtable(IDX_PRIO_COUNT)
         # Usage-contribution memo: the batch solver's fast-mint path shares
         # ONE AllocatedResources object across a whole group's fresh allocs
         # (solver._materialize_compact), so the contribution walk runs once
@@ -1290,7 +1344,9 @@ class StateStore(_ReadMixin):
                     self._idx_del(IDX_ALLOCS_EVAL, existing.eval_id, alloc.id)
                     inner_cache.pop((IDX_ALLOCS_EVAL, existing.eval_id), None)
             if existing is not None:
-                _usage_sub(ut, existing.node_id, usage_contribution(existing))
+                ce = usage_contribution(existing)
+                _usage_sub(ut, existing.node_id, ce)
+                _prio_sub(pt, existing, ce)
             ar = alloc.resources
             if ar is not None:
                 ck2 = (id(ar), alloc.desired_status, alloc.client_status)
@@ -1300,6 +1356,7 @@ class StateStore(_ReadMixin):
             else:
                 c = usage_contribution(alloc)
             _usage_add(ut, alloc.node_id, c)
+            _prio_add(pt, alloc, c)
             t[alloc.id] = alloc
             _inner(IDX_ALLOCS_NODE, alloc.node_id)[alloc.id] = alloc
             key = (alloc.namespace, alloc.job_id)
@@ -1307,10 +1364,13 @@ class StateStore(_ReadMixin):
             _inner(IDX_ALLOCS_EVAL, alloc.eval_id)[alloc.id] = alloc
             stored.append(alloc)
             jobs_touched.add(key)
+            # inlined: with client_status "pending" (non-terminal),
+            # terminal_status() reduces to the desired-status check
             if (
                 existing is None
                 and alloc.client_status == "pending"
-                and not alloc.terminal_status()
+                and alloc.desired_status != ALLOC_DESIRED_STATUS_STOP
+                and alloc.desired_status != ALLOC_DESIRED_STATUS_EVICT
             ):
                 groups = fresh_counts.setdefault(key, {})
                 groups[alloc.task_group] = groups.get(alloc.task_group, 0) + 1
@@ -1747,10 +1807,14 @@ class StateStore(_ReadMixin):
             # Ownership transfer: every alloc in a committed plan is either
             # freshly minted by the scheduler or a plan-owned copy (Plan's
             # append_* methods copy), so the store takes them without the
-            # per-alloc defensive copy.
-            fresh_allocs = [
-                a for a in allocs_to_upsert if a.id not in t
-            ]
+            # per-alloc defensive copy. The fresh-alloc scan only exists
+            # for volume claims — skip it (and its 10^5 membership probes)
+            # when no volumes are registered at all.
+            fresh_allocs = (
+                [a for a in allocs_to_upsert if a.id not in t]
+                if self._tables[TABLE_VOLUMES]
+                else []
+            )
             committed.extend(
                 self._upsert_allocs_txn(
                     index, allocs_to_upsert, owned=True,
@@ -1760,19 +1824,23 @@ class StateStore(_ReadMixin):
             # Volume claims attach atomically with the placements that
             # need them (reference: the CSI claim RPC; here the plan
             # apply IS the claim point for registered volumes).
-            self._claim_volumes_txn(index, fresh_allocs)
+            if fresh_allocs:
+                self._claim_volumes_txn(index, fresh_allocs)
             # Record placed canaries on their deployment's group state
             # (reference state_store.go:4888 "Ensure PlacedCanaries
             # accurately reflects the alloc canary status"): the
             # reconciler and promotion read dstate.placed_canaries.
+            # Canary markers only exist on deployment-bearing plans, so
+            # the per-alloc scan is gated on that.
             canary_by_deploy: dict[str, list[Allocation]] = {}
-            for a in allocs_to_upsert:
-                if (
-                    a.deployment_id
-                    and a.deployment_status is not None
-                    and a.deployment_status.canary
-                ):
-                    canary_by_deploy.setdefault(a.deployment_id, []).append(a)
+            if result.deployment is not None or self._tables[TABLE_DEPLOYMENTS]:
+                for a in allocs_to_upsert:
+                    if (
+                        a.deployment_id
+                        and a.deployment_status is not None
+                        and a.deployment_status.canary
+                    ):
+                        canary_by_deploy.setdefault(a.deployment_id, []).append(a)
             if canary_by_deploy:
                 dt = self._wtable(TABLE_DEPLOYMENTS)
                 for dep_id, callocs in canary_by_deploy.items():

@@ -149,3 +149,110 @@ def test_cross_region_requires_target_region_token(two_regions):
         },
     )
     assert eu.server.state.job_by_id("default", "legit") is not None
+
+
+@pytest.fixture
+def replicated_regions():
+    """us = authoritative; eu replicates ACL state from it
+    (reference leader.go:1282,1423)."""
+    us = ClusterServer(
+        "us-1", port=0, num_workers=1, region="us", bootstrap_expect=1,
+        authoritative_region="us",
+    )
+    eu = ClusterServer(
+        "eu-1", port=0, num_workers=1, region="eu", bootstrap_expect=1,
+        authoritative_region="us", acl_replication_interval_s=0.1,
+    )
+    us.start()
+    eu.start()
+    assert wait_until(lambda: us.is_leader(), 10)
+    assert wait_until(lambda: eu.is_leader(), 10)
+    eu.join([us.rpc.addr])
+    assert wait_until(
+        lambda: any(m.id == "us-1" for m in eu.serf.members())
+        and any(m.id == "eu-1" for m in us.serf.members()),
+        10,
+    )
+    yield us, eu
+    eu.shutdown()
+    us.shutdown()
+
+
+def test_acl_replication_us_token_authorizes_in_eu(replicated_regions):
+    """The VERDICT r4 item-4 done-criterion: an eu-submitted job
+    authorizes via a us-minted, replicated GLOBAL token."""
+    from nomad_tpu.acl.structs import ACLPolicy, ACLToken
+
+    us, eu = replicated_regions
+    us.acl_enforce = True
+    eu.acl_enforce = True
+    # mint policy + global client token in the AUTHORITATIVE region
+    us.server.acl_policy_upsert([
+        ACLPolicy(
+            name="submitter",
+            rules='namespace "default" { policy = "write" }',
+        )
+    ])
+    tok = ACLToken.new(name="ci", type="client", policies=["submitter"])
+    tok.global_ = True
+    us.server.raft_apply("acl_token_upsert", [tok])
+    # a local (non-global) us token must NOT replicate
+    local_tok = ACLToken.new(name="us-only", type="client",
+                             policies=["submitter"])
+    us.server.raft_apply("acl_token_upsert", [local_tok])
+
+    assert wait_until(
+        lambda: eu.server.state.acl_token_by_accessor(tok.accessor_id)
+        is not None,
+        10,
+    ), "global token should replicate to eu"
+    assert eu.server.state.acl_policy_by_name("submitter") is not None
+    assert (
+        eu.server.state.acl_token_by_accessor(local_tok.accessor_id) is None
+    ), "non-global tokens are region-local"
+
+    # an eu-submitted job (forwarded from us) authorizes via the
+    # replicated token against EU's OWN acl state
+    us.rpc_self(
+        "Job.register",
+        {
+            "job": mock.job(id="replicated-auth"),
+            "region": "eu",
+            "__cross_region_token__": tok.secret_id,
+        },
+    )
+    assert eu.server.state.job_by_id("default", "replicated-auth") is not None
+
+    # revocation replicates too: delete in us, eu converges to deny
+    us.server.acl_token_delete([tok.accessor_id])
+    assert wait_until(
+        lambda: eu.server.state.acl_token_by_accessor(tok.accessor_id)
+        is None,
+        10,
+    ), "token deletion should replicate"
+
+
+def test_global_token_create_routes_to_authoritative(replicated_regions):
+    """A global token minted via the NON-authoritative region lands in
+    the authoritative region's raft and replicates back (reference
+    acl_endpoint.go global-token forwarding)."""
+    from nomad_tpu.acl.structs import ACLPolicy, ACLToken
+
+    us, eu = replicated_regions
+    us.server.acl_policy_upsert([
+        ACLPolicy(name="p", rules='namespace "default" { policy = "read" }')
+    ])
+    req = ACLToken(name="made-in-eu", type="client", policies=["p"])
+    req.global_ = True
+    created = eu.rpc_self("ACL.token_create", {"token": req})
+    assert created is not None
+    assert wait_until(
+        lambda: us.server.state.acl_token_by_accessor(created.accessor_id)
+        is not None,
+        5,
+    ), "global token must live in the authoritative region"
+    assert wait_until(
+        lambda: eu.server.state.acl_token_by_accessor(created.accessor_id)
+        is not None,
+        10,
+    ), "and replicate back to eu"

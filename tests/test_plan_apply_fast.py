@@ -81,11 +81,18 @@ def exact_evaluate_plan(snapshot, plan: Plan) -> PlanResult:
 
 
 def _check_aggregate(store: StateStore) -> None:
-    from nomad_tpu.state.store import TABLE_ALLOCS
+    from nomad_tpu.state.store import (
+        IDX_PRIO_COUNT,
+        TABLE_ALLOCS,
+        rebuild_prio_counts,
+    )
 
     got = store._tables[IDX_NODE_USED]
     want = rebuild_node_usage(store._tables[TABLE_ALLOCS])
     assert got == want, f"usage aggregate drifted: {got} != {want}"
+    gotp = store._tables[IDX_PRIO_COUNT]
+    wantp = rebuild_prio_counts(store._tables[TABLE_ALLOCS])
+    assert gotp == wantp, f"priority counts drifted: {gotp} != {wantp}"
 
 
 def test_usage_aggregate_tracks_alloc_churn():
