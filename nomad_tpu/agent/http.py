@@ -1051,6 +1051,19 @@ class HTTPAgentServer:
         def agent_health(p, q, body, tok):
             return {"server": {"ok": True}, "client": {"ok": self.client is not None}}
 
+        def agent_join(p, q, body, tok):
+            # reference agent_endpoint.go AgentJoin: gossip-join the
+            # given servers (CLI `server join`)
+            addrs = []
+            for a in q.get("address", []):
+                host, _, port = a.partition(":")
+                addrs.append((host, int(port or 4647)))
+            if not addrs:
+                raise HTTPError(400, "address required")
+            joined = self.cluster.join(addrs)
+            err = "" if joined else "no servers could be contacted"
+            return {"num_joined": joined, "error": err}
+
         # -- acl -------------------------------------------------------
         def acl_bootstrap(p, q, body, tok):
             return self.rpc_region("ACL.bootstrap", {})
@@ -1085,11 +1098,30 @@ class HTTPAgentServer:
         def acl_token_put(p, q, body, tok):
             from ..acl import ACLToken
 
-            t = ACLToken(
-                name=body.get("Name", ""),
-                type=body.get("Type", "client"),
-                policies=body.get("Policies") or [],
-            )
+            accessor = body.get("AccessorID", "")
+            if accessor:
+                # update: keep identity+secret, swap the mutable fields
+                # (reference acl token update)
+                existing = self.rpc_region(
+                    "ACL.token_get", {"accessor_id": accessor}
+                )
+                if existing is None:
+                    raise HTTPError(404, f"token {accessor} not found")
+                t = existing.copy()
+                if "Name" in body:
+                    t.name = body["Name"]
+                if "Policies" in body:
+                    t.policies = list(body["Policies"] or [])
+                if "Type" in body:
+                    t.type = body["Type"]
+            else:
+                t = ACLToken(
+                    name=body.get("Name", ""),
+                    type=body.get("Type", "client"),
+                    policies=body.get("Policies") or [],
+                )
+            if "Global" in body:
+                t.global_ = bool(body["Global"])
             return self.rpc_region("ACL.token_create", {"token": t})
 
         def acl_token_get(p, q, body, tok):
@@ -1329,6 +1361,8 @@ class HTTPAgentServer:
         route("GET", "/v1/agent/self", agent_self)
         route("GET", "/v1/agent/monitor", agent_monitor)
         route("GET", "/v1/agent/health", agent_health)
+        route("PUT", "/v1/agent/join", agent_join)
+        route("POST", "/v1/agent/join", agent_join)
 
     # -- event stream (long-lived NDJSON response) ---------------------
 

@@ -693,6 +693,11 @@ class AgentAPI(_Resource):
     def health(self):
         return self.c.get("/v1/agent/health")
 
+    def join(self, *addresses: str):
+        return self.c.put(
+            "/v1/agent/join", params={"address": list(addresses)}
+        )
+
 
 class Status(_Resource):
     def leader(self):
@@ -734,12 +739,28 @@ class ACLAPI(_Resource):
         return self.c.get("/v1/acl/token/self")
 
     def token_create(
-        self, name: str = "", type: str = "client", policies=None
+        self, name: str = "", type: str = "client", policies=None,
+        global_: bool = False,
     ):
         return self.c.put(
             "/v1/acl/token",
-            body={"Name": name, "Type": type, "Policies": policies or []},
+            body={
+                "Name": name, "Type": type, "Policies": policies or [],
+                "Global": global_,
+            },
         )
+
+    def token_update(self, accessor_id: str, **fields):
+        """Update mutable fields of an existing token (reference
+        acl token update): Name, Policies, Type, Global."""
+        body = {"AccessorID": accessor_id}
+        for k_api, k_py in (
+            ("Name", "name"), ("Policies", "policies"),
+            ("Type", "type"), ("Global", "global_"),
+        ):
+            if k_py in fields:
+                body[k_api] = fields[k_py]
+        return self.c.put("/v1/acl/token", body=body)
 
     def token_delete(self, accessor_id: str):
         return self.c.delete(f"/v1/acl/token/{accessor_id}")

@@ -976,7 +976,8 @@ def cmd_acl_policy_delete(args) -> int:
 def cmd_acl_token_create(args) -> int:
     api = _client(args)
     token = api.acl.token_create(
-        name=args.name or "", type=args.type, policies=args.policy or []
+        name=args.name or "", type=args.type, policies=args.policy or [],
+        global_=getattr(args, "set_global", False),
     )
     print(f"Accessor ID = {token.accessor_id}")
     print(f"Secret ID   = {token.secret_id}")
@@ -1006,6 +1007,117 @@ def cmd_acl_token_delete(args) -> int:
     match = _find_by_prefix_attr(tokens, "accessor_id", args.accessor_id)
     api.acl.token_delete(match.accessor_id)
     print(f"Token {match.accessor_id[:8]} deleted")
+    return 0
+
+
+def _print_token(t) -> None:
+    print(f"Accessor ID = {t.accessor_id}")
+    print(f"Secret ID   = {t.secret_id}")
+    print(f"Name        = {t.name}")
+    print(f"Type        = {t.type}")
+    print(f"Global      = {t.global_}")
+    print(f"Policies    = {','.join(t.policies)}")
+
+
+def cmd_acl_policy_info(args) -> int:
+    api = _client(args)
+    p = api.acl.policy(args.name)
+    print(f"Name        = {p.name}")
+    print(f"Description = {p.description}")
+    print("Rules:")
+    print(p.rules)
+    return 0
+
+
+def cmd_acl_token_info(args) -> int:
+    api = _client(args)
+    tokens = api.acl.tokens()
+    match = _find_by_prefix_attr(tokens, "accessor_id", args.accessor_id)
+    _print_token(api.acl.token(match.accessor_id))
+    return 0
+
+
+def cmd_acl_token_self(args) -> int:
+    api = _client(args)
+    _print_token(api.acl.token_self())
+    return 0
+
+
+def cmd_acl_token_update(args) -> int:
+    api = _client(args)
+    fields = {}
+    if args.name is not None:
+        fields["name"] = args.name
+    if args.policy:
+        fields["policies"] = args.policy
+    if args.type is not None:
+        fields["type"] = args.type
+    if args.set_global is not None:
+        fields["global_"] = args.set_global == "true"
+    t = api.acl.token_update(args.accessor_id, **fields)
+    _print_token(t)
+    return 0
+
+
+def cmd_namespace_inspect(args) -> int:
+    api = _client(args)
+    ns = next(
+        (n for n in api.namespaces.list() if n.name == args.name), None
+    )
+    if ns is None:
+        print(f"Namespace {args.name!r} not found", file=sys.stderr)
+        return 1
+    print(json.dumps(
+        {"Name": ns.name, "Description": ns.description}, indent=2
+    ))
+    return 0
+
+
+def cmd_server_join(args) -> int:
+    api = _client(args)
+    out = api.agent.join(*args.address)
+    if out.get("error"):
+        print(f"Join failed: {out['error']}", file=sys.stderr)
+        return 1
+    print(f"Joined {out['num_joined']} servers successfully")
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Agent health probe for external monitors (reference
+    command/check.go): exit 0 healthy, 1 unhealthy/unreachable."""
+    try:
+        h = _client(args).agent.health()
+    except Exception as e:
+        print(f"unhealthy: {e}", file=sys.stderr)
+        return 1
+    ok = all(part.get("ok") for part in h.values())
+    print("healthy" if ok else f"unhealthy: {h}")
+    return 0 if ok else 1
+
+
+VOLUME_INIT_TEMPLATE = """\
+id        = "example-volume"
+name      = "example-volume"
+type      = "host"
+node_id   = "<node-id>"
+path      = "/srv/volumes/example"
+
+capability {
+  access_mode     = "single-node-writer"
+  attachment_mode = "file-system"
+}
+"""
+
+
+def cmd_volume_init(args) -> int:
+    filename = args.filename or "volume.hcl"
+    if os.path.exists(filename):
+        print(f"File {filename} already exists", file=sys.stderr)
+        return 1
+    with open(filename, "w") as f:
+        f.write(VOLUME_INIT_TEMPLATE)
+    print(f"Example volume specification written to {filename}")
     return 0
 
 
@@ -2031,18 +2143,35 @@ def build_parser() -> argparse.ArgumentParser:
     apd = apsub.add_parser("delete")
     apd.add_argument("name")
     apd.set_defaults(fn=cmd_acl_policy_delete)
+    api_ = apsub.add_parser("info")
+    api_.add_argument("name")
+    api_.set_defaults(fn=cmd_acl_policy_info)
     at = aclsub.add_parser("token")
     atsub = at.add_subparsers(dest="subsubcmd")
     atc = atsub.add_parser("create")
     atc.add_argument("-name", default=None)
     atc.add_argument("-type", default="client")
     atc.add_argument("-policy", action="append", default=[])
+    atc.add_argument("-global", dest="set_global", action="store_true")
     atc.set_defaults(fn=cmd_acl_token_create)
     atl = atsub.add_parser("list")
     atl.set_defaults(fn=cmd_acl_token_list)
     atd = atsub.add_parser("delete")
     atd.add_argument("accessor_id")
     atd.set_defaults(fn=cmd_acl_token_delete)
+    ati = atsub.add_parser("info")
+    ati.add_argument("accessor_id")
+    ati.set_defaults(fn=cmd_acl_token_info)
+    ats = atsub.add_parser("self")
+    ats.set_defaults(fn=cmd_acl_token_self)
+    atu = atsub.add_parser("update")
+    atu.add_argument("accessor_id")
+    atu.add_argument("-name", default=None)
+    atu.add_argument("-type", default=None)
+    atu.add_argument("-policy", action="append", default=[])
+    atu.add_argument("-global", dest="set_global", choices=["true", "false"],
+                     default=None)
+    atu.set_defaults(fn=cmd_acl_token_update)
 
     srv = sub.add_parser("server", help="server commands")
     ssub = srv.add_subparsers(dest="subcmd")
@@ -2051,6 +2180,9 @@ def build_parser() -> argparse.ArgumentParser:
     sfl = ssub.add_parser("force-leave")
     sfl.add_argument("node")
     sfl.set_defaults(fn=cmd_server_force_leave)
+    sj = ssub.add_parser("join")
+    sj.add_argument("address", nargs="+")
+    sj.set_defaults(fn=cmd_server_join)
 
     nsp = sub.add_parser("namespace", help="namespace commands")
     nssub = nsp.add_subparsers(dest="subcmd")
@@ -2066,6 +2198,9 @@ def build_parser() -> argparse.ArgumentParser:
     nsd = nssub.add_parser("delete")
     nsd.add_argument("name")
     nsd.set_defaults(fn=cmd_namespace_delete)
+    nsi = nssub.add_parser("inspect")
+    nsi.add_argument("name")
+    nsi.set_defaults(fn=cmd_namespace_inspect)
 
     vol = sub.add_parser("volume", help="volume commands")
     volsub = vol.add_subparsers(dest="subcmd")
@@ -2082,6 +2217,9 @@ def build_parser() -> argparse.ArgumentParser:
     vreg.add_argument("-plugin", default="")
     vreg.add_argument("-external-id", dest="external_id", default="")
     vreg.set_defaults(fn=cmd_volume_register)
+    vinit = volsub.add_parser("init")
+    vinit.add_argument("filename", nargs="?")
+    vinit.set_defaults(fn=cmd_volume_init)
     vstat = volsub.add_parser("status")
     vstat.add_argument("id", nargs="?")
     vstat.add_argument("-namespace", default="default")
@@ -2226,6 +2364,86 @@ def build_parser() -> argparse.ArgumentParser:
     st = sub.add_parser("status", help="list jobs")
     st.add_argument("job_id", nargs="?")
     st.set_defaults(fn=cmd_status)
+
+    # -- top-level aliases (reference commands.go registers these
+    # shortcuts alongside the namespaced forms: run == job run, etc.) --
+    al_run = sub.add_parser("run", help="alias of `job run`")
+    al_run.add_argument("jobfile")
+    al_run.add_argument("-var", action="append", default=[])
+    al_run.add_argument("-detach", action="store_true")
+    al_run.set_defaults(fn=cmd_job_run)
+    al_stop = sub.add_parser("stop", help="alias of `job stop`")
+    al_stop.add_argument("job_id")
+    al_stop.add_argument("-purge", action="store_true")
+    al_stop.set_defaults(fn=cmd_job_stop)
+    al_plan = sub.add_parser("plan", help="alias of `job plan`")
+    al_plan.add_argument("jobfile")
+    al_plan.add_argument("-var", action="append", default=[])
+    al_plan.set_defaults(fn=cmd_job_plan)
+    al_val = sub.add_parser("validate", help="alias of `job validate`")
+    al_val.add_argument("jobfile")
+    al_val.add_argument("-var", action="append", default=[])
+    al_val.set_defaults(fn=cmd_job_validate)
+    al_init = sub.add_parser("init", help="alias of `job init`")
+    al_init.add_argument("filename", nargs="?")
+    al_init.set_defaults(fn=cmd_job_init)
+    al_insp = sub.add_parser("inspect", help="alias of `job inspect`")
+    al_insp.add_argument("job_id")
+    al_insp.set_defaults(fn=cmd_job_inspect)
+    al_exec = sub.add_parser("exec", help="alias of `alloc exec`")
+    al_exec.add_argument("-t", "-tty", dest="tty", action="store_true")
+    al_exec.add_argument("-task", default="")
+    al_exec.add_argument("-rpc-secret", dest="rpc_secret", default="")
+    al_exec.add_argument(
+        "-fabric-tls", dest="fabric_tls", action="store_true"
+    )
+    al_exec.add_argument("alloc_id")
+    al_exec.add_argument("cmd", nargs=argparse.REMAINDER)
+    al_exec.set_defaults(fn=cmd_alloc_exec)
+    al_logs = sub.add_parser("logs", help="alias of `alloc logs`")
+    al_logs.add_argument("-f", "-follow", dest="follow", action="store_true")
+    al_logs.add_argument("-stderr", action="store_true")
+    al_logs.add_argument("-task", default="")
+    al_logs.add_argument("alloc_id")
+    al_logs.set_defaults(fn=cmd_alloc_logs)
+    al_fs = sub.add_parser("fs", help="alias of `alloc fs`")
+    al_fs.add_argument("alloc_id")
+    al_fs.add_argument("path", nargs="?", default="")
+    al_fs.set_defaults(fn=cmd_alloc_fs)
+    al_ast = sub.add_parser("alloc-status", help="alias of `alloc status`")
+    al_ast.add_argument("alloc_id")
+    al_ast.set_defaults(fn=cmd_alloc_status)
+    al_est = sub.add_parser("eval-status", help="alias of `eval status`")
+    al_est.add_argument("eval_id")
+    al_est.set_defaults(fn=cmd_eval_status)
+    al_nst = sub.add_parser("node-status", help="alias of `node status`")
+    al_nst.add_argument("node_id", nargs="?")
+    al_nst.set_defaults(fn=cmd_node_status)
+    al_ndr = sub.add_parser("node-drain", help="alias of `node drain`")
+    al_ndr.add_argument("node_id")
+    al_ndr.add_argument("-enable", action="store_true")
+    al_ndr.add_argument("-disable", action="store_true")
+    al_ndr.add_argument("-deadline", default="1h")
+    al_ndr.add_argument("-ignore-system", dest="ignore_system",
+                        action="store_true")
+    al_ndr.set_defaults(fn=cmd_node_drain)
+    al_sm = sub.add_parser("server-members", help="alias of `server members`")
+    al_sm.set_defaults(fn=cmd_server_members)
+    al_sj = sub.add_parser("server-join", help="alias of `server join`")
+    al_sj.add_argument("address", nargs="+")
+    al_sj.set_defaults(fn=cmd_server_join)
+    al_sfl = sub.add_parser(
+        "server-force-leave", help="alias of `server force-leave`"
+    )
+    al_sfl.add_argument("node")
+    al_sfl.set_defaults(fn=cmd_server_force_leave)
+    al_kg = sub.add_parser("keygen", help="alias of `operator keygen`")
+    al_kg.set_defaults(fn=cmd_operator_keygen)
+    al_dbg = sub.add_parser("debug", help="alias of `operator debug`")
+    al_dbg.add_argument("-output", default="")
+    al_dbg.set_defaults(fn=cmd_operator_debug)
+    chk = sub.add_parser("check", help="agent health probe")
+    chk.set_defaults(fn=cmd_check)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=cmd_version)

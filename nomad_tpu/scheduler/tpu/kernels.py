@@ -373,7 +373,7 @@ def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
     solves are equivalence-tested against each other
     (tests/test_tpu_solver.py).
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     def sharded_solve(
         cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap,
@@ -463,7 +463,7 @@ def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
                 P(),                  # tier_limit
             ),
             out_specs=(P(None, axis), P(None, axis), P(axis, None)),
-            check_rep=False,
+            check_vma=False,
         )(cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap,
           tier_limit)
 
@@ -478,7 +478,7 @@ def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
     (deterministic, replicated) waterfill decision, then each device applies
     its slice. Communication: O(G * N * 8 bytes) over ICI.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n_dev = mesh.shape[axis]
 
@@ -525,7 +525,7 @@ def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
                 P(None, axis),  # units_cap
             ),
             out_specs=(P(None, axis), P(axis, None)),
-            check_rep=False,
+            check_vma=False,
         )(cap, used, asks, counts, feas, bias, units_cap)
 
     return jax.jit(sharded_solve)

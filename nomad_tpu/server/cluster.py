@@ -823,7 +823,18 @@ class ACLEndpoint(_Forwarder):
         # forwards globals to AuthoritativeRegion; leader.go:1423 pulls
         # them back). Local tokens stay region-local.
         token = args.get("token")
-        if token is not None and getattr(token, "global_", False):
+        stored_global = False
+        if token is not None and token.accessor_id:
+            stored = self.cs.server.state.acl_token_by_accessor(
+                token.accessor_id
+            )
+            stored_global = stored is not None and stored.global_
+        # forward when the token IS global or WAS global (a demotion to
+        # local must land authoritatively too, or replication re-promotes
+        # it here within one poll)
+        if token is not None and (
+            getattr(token, "global_", False) or stored_global
+        ):
             fwd = self._forward_authoritative("ACL.token_create", args)
             if fwd is not None:
                 return fwd()

@@ -599,3 +599,156 @@ def test_tls_http_api(tmp_path):
             plain.status.regions()
     finally:
         a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Reference command-surface enumeration (VERDICT r4 item 6)
+# ---------------------------------------------------------------------------
+
+# Every command name registered in the reference's command factory
+# (command/commands.go:57 Commands map), normalized: deprecated duplicate
+# spellings the reference itself hides from help (e.g. "server-members"
+# AND "server members") both appear because both must keep working.
+REFERENCE_COMMANDS = [
+    "acl", "acl bootstrap", "acl policy", "acl policy apply",
+    "acl policy delete", "acl policy info", "acl policy list",
+    "acl token", "acl token create", "acl token delete", "acl token info",
+    "acl token list", "acl token self", "acl token update",
+    "agent", "agent-info",
+    "alloc", "alloc exec", "alloc fs", "alloc logs", "alloc restart",
+    "alloc signal", "alloc status", "alloc stop", "alloc-status",
+    "check", "client-config", "debug",
+    "deployment", "deployment fail", "deployment list",
+    "deployment pause", "deployment promote", "deployment resume",
+    "deployment status", "deployment unblock",
+    "eval", "eval status", "eval-status", "exec", "fs", "init", "inspect",
+    "job", "job deployments", "job dispatch", "job eval", "job history",
+    "job init", "job inspect", "job periodic", "job periodic force",
+    "job plan", "job promote", "job revert", "job run", "job scale",
+    "job scaling-events", "job status", "job stop", "job validate",
+    "keygen", "keyring", "license", "license get", "logs", "monitor",
+    "namespace", "namespace apply", "namespace delete",
+    "namespace inspect", "namespace list", "namespace status",
+    "node", "node config", "node drain", "node eligibility",
+    "node status", "node-drain", "node-status",
+    "operator", "operator autopilot", "operator autopilot get-config",
+    "operator autopilot set-config", "operator debug", "operator keygen",
+    "operator keyring", "operator metrics", "operator raft",
+    "operator raft list-peers", "operator raft remove-peer",
+    "operator snapshot", "operator snapshot inspect",
+    "operator snapshot restore", "operator snapshot save",
+    "plugin", "plugin status",
+    "quota", "quota apply", "quota delete", "quota init", "quota inspect",
+    "quota list", "quota status",
+    "recommendation", "recommendation apply", "recommendation dismiss",
+    "recommendation info", "recommendation list",
+    "run", "scaling", "scaling policy", "scaling policy info",
+    "scaling policy list",
+    "sentinel", "sentinel apply", "sentinel delete", "sentinel list",
+    "sentinel read",
+    "server", "server force-leave", "server join", "server members",
+    "server-force-leave", "server-join", "server-members",
+    "status", "stop",
+    "system", "system gc", "system reconcile",
+    "system reconcile summaries",
+    "ui", "validate", "version",
+    "volume", "volume create", "volume delete", "volume deregister",
+    "volume detach", "volume init", "volume register",
+    "volume snapshot create", "volume snapshot delete",
+    "volume snapshot list", "volume status",
+]
+
+# The explicit, justified not-ported list — every entry must carry a
+# reason; the test fails if it grows past 20 or if anything NOT listed
+# here is missing. Shrink by porting, never by deleting justifications.
+JUSTIFIED_UNPORTED = {
+    "client-config": "deprecated alias the reference hides from help "
+    "(command/commands.go marks it hidden); `node config` covers it",
+    "node config": "mutates the client's server list at runtime; this "
+    "client auto-discovers servers through the cluster fabric and "
+    "fails over internally (client/client.py ClusterRPC), so the knob "
+    "has no meaning here",
+    "deployment unblock": "multiregion deployment gate — enterprise-"
+    "only in the reference (OSS build returns an error)",
+    "job scaling-events": "scale-event history log; scaling policies + "
+    "scale status are implemented, the event journal is not yet",
+    "keyring": "serf gossip symmetric-key rotation; this fabric "
+    "authenticates with the rpc_secret + mTLS instead of serf "
+    "encryption keys (rpc/tls.py), so there is no keyring to rotate",
+    "operator keyring": "same as keyring",
+    "license": "enterprise licensing surface",
+    "license get": "enterprise licensing surface",
+    "quota": "resource quotas are enterprise-only in the reference",
+    "quota apply": "enterprise", "quota delete": "enterprise",
+    "quota init": "enterprise", "quota inspect": "enterprise",
+    "quota list": "enterprise", "quota status": "enterprise",
+    "recommendation": "dynamic application sizing — enterprise-only",
+    "recommendation apply": "enterprise",
+    "recommendation dismiss": "enterprise",
+    "recommendation info": "enterprise",
+    "recommendation list": "enterprise",
+    "sentinel apply": "sentinel policies are enterprise-only",
+}
+# group containers whose subcommands are all enterprise are implied:
+JUSTIFIED_PREFIXES = ("quota", "recommendation", "sentinel", "license")
+
+# volume snapshots: external CSI snapshot RPCs; the native CSI manager
+# implements attach/claim lifecycles, snapshots are listed unported
+for _cmd in ("volume detach", "volume snapshot create",
+             "volume snapshot delete", "volume snapshot list"):
+    JUSTIFIED_UNPORTED[_cmd] = (
+        "CSI external snapshot/detach RPCs; the native volume manager "
+        "covers claim/attach lifecycles, snapshot RPCs not yet"
+    )
+
+
+def _our_commands() -> set:
+    import argparse as _ap
+
+    from nomad_tpu.cli.main import build_parser
+
+    def walk(parser, prefix=""):
+        cmds = set()
+        for action in parser._actions:
+            if isinstance(action, _ap._SubParsersAction):
+                for name, subp in action.choices.items():
+                    full = f"{prefix}{name}".strip()
+                    cmds.add(full)
+                    cmds |= walk(subp, prefix=f"{full} ")
+        return cmds
+
+    return walk(build_parser())
+
+
+def test_cli_breadth_vs_reference_command_list():
+    ours = _our_commands()
+    missing = []
+    for cmd in REFERENCE_COMMANDS:
+        if cmd in ours:
+            continue
+        if cmd in JUSTIFIED_UNPORTED:
+            continue
+        if any(
+            cmd == p or cmd.startswith(p + " ") for p in JUSTIFIED_PREFIXES
+        ):
+            continue
+        missing.append(cmd)
+    assert missing == [], (
+        f"reference commands neither ported nor justified: {missing}"
+    )
+    # the justified list must stay small and honest
+    flat_unported = set(JUSTIFIED_UNPORTED) | {
+        c
+        for c in REFERENCE_COMMANDS
+        if any(
+            c == p or c.startswith(p + " ") for p in JUSTIFIED_PREFIXES
+        )
+    }
+    real_unported = [c for c in flat_unported if c not in ours]
+    assert len([c for c in real_unported
+                if not any(c == p or c.startswith(p + " ")
+                           for p in JUSTIFIED_PREFIXES)]) < 20, (
+        "non-enterprise unported list must stay under 20"
+    )
+    for cmd, why in JUSTIFIED_UNPORTED.items():
+        assert why.strip(), f"{cmd}: justification required"
