@@ -88,6 +88,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     # reference agent ValidateJobRequest allows any submitter)
     ("PUT", re.compile(r"^/v1/validate/job$"), CAP_READ_JOB),
     ("POST", re.compile(r"^/v1/validate/job$"), CAP_READ_JOB),
+    # HCL parse is pure computation (nothing committed) — read-level,
+    # so the UI Run view works with a submit-job token
+    ("PUT", re.compile(r"^/v1/jobs/parse$"), CAP_READ_JOB),
+    ("POST", re.compile(r"^/v1/jobs/parse$"), CAP_READ_JOB),
     # scaling policies read with namespace read (reference
     # scaling_endpoint.go ListPolicies: read-job or list-scaling-policies)
     ("GET", re.compile(r"^/v1/scaling/policies$"), CAP_READ_JOB),
@@ -114,6 +118,9 @@ _AGENT_WRITE = [
     # force-leave ejects a member from gossip (reference agent:write)
     ("PUT", re.compile(r"^/v1/agent/force-leave$")),
     ("POST", re.compile(r"^/v1/agent/force-leave$")),
+    # gossip-join mutates membership (reference agent:write)
+    ("PUT", re.compile(r"^/v1/agent/join$")),
+    ("POST", re.compile(r"^/v1/agent/join$")),
 ]
 _AGENT_READ = [
     ("GET", re.compile(r"^/v1/agent/.*$")),

@@ -552,9 +552,29 @@ class HTTPAgentServer:
                 "Job.periodic_force", {"namespace": ns, "job_id": p["id"]}
             )
 
+        def jobs_parse(p, q, body, tok):
+            # Server-side HCL parse (reference /v1/jobs/parse,
+            # jobs_endpoint.go): the browser UI submits raw jobspec text
+            # and gets the canonical job back for plan/register.
+            from ..jobspec import parse_job
+
+            src = body.get("JobHCL", "")
+            if not src.strip():
+                raise HTTPError(400, "JobHCL required")
+            variables = body.get("Variables") or {}
+            try:
+                job = parse_job(src, variables=variables)
+            except Exception as e:
+                raise HTTPError(400, f"parse failed: {e}")
+            # the Job dataclass rides the reply encoder once — returning
+            # a pre-encoded dict here would double-encode into $map form
+            return {"Job": job}
+
         route("GET", "/v1/jobs", jobs_list)
         route("PUT", "/v1/jobs", jobs_register)
         route("POST", "/v1/jobs", jobs_register)
+        route("POST", "/v1/jobs/parse", jobs_parse)
+        route("PUT", "/v1/jobs/parse", jobs_parse)
         route("GET", "/v1/job/(?P<id>[^/]+)", job_get)
         route("DELETE", "/v1/job/(?P<id>[^/]+)", job_delete)
         route("GET", "/v1/job/(?P<id>[^/]+)/allocations", job_allocs)
@@ -1056,8 +1076,14 @@ class HTTPAgentServer:
             # given servers (CLI `server join`)
             addrs = []
             for a in q.get("address", []):
-                host, _, port = a.partition(":")
-                addrs.append((host, int(port or 4647)))
+                host, _, port = a.rpartition(":")
+                if not host:  # bare host, default port
+                    host, port = a, ""
+                host = host.strip("[]")  # [::1]:4647 form
+                try:
+                    addrs.append((host, int(port or 4647)))
+                except ValueError:
+                    raise HTTPError(400, f"invalid address {a!r}")
             if not addrs:
                 raise HTTPError(400, "address required")
             joined = self.cluster.join(addrs)
@@ -1365,6 +1391,172 @@ class HTTPAgentServer:
         route("POST", "/v1/agent/join", agent_join)
 
     # -- event stream (long-lived NDJSON response) ---------------------
+
+    # -- browser exec (WebSocket bridge to the fabric exec stream) ------
+
+    def _serve_exec_ws(self, handler, alloc_id, query, token) -> None:
+        """RFC6455 WebSocket endpoint bridging a browser terminal to the
+        fabric's interactive exec stream (reference: the Ember UI's
+        /v1/client/allocation/:id/exec websocket, bridged to the same
+        streaming RPC the CLI uses). Message protocol, JSON text frames:
+        client -> {"stdin": <b64>}; server -> {"stdout": <b64>},
+        {"error": str}, {"exit": true}. The browser cannot set
+        X-Nomad-Token on a websocket, so ?token= is accepted here (as
+        the reference does for its ws_handshake)."""
+        import base64
+        import hashlib
+        import struct
+        import threading
+
+        alloc = self._resolve_alloc(alloc_id)
+        self._ns_guard(token, alloc.namespace, "alloc-exec")
+        key = handler.headers.get("Sec-WebSocket-Key", "")
+        if not key:
+            raise HTTPError(400, "missing Sec-WebSocket-Key")
+        accept = base64.b64encode(
+            hashlib.sha1(
+                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+            ).digest()
+        ).decode()
+        conn = handler.connection
+        # exec sessions are long-lived and a browser sends nothing while
+        # the user watches output — the handler's 120s read timeout must
+        # not tear the session down
+        conn.settimeout(None)
+        conn.sendall(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            b"Sec-WebSocket-Accept: " + accept.encode() + b"\r\n\r\n"
+        )
+        handler.close_connection = True
+        # one writer at a time: output frames (pump thread) and pong /
+        # close frames (reader thread) must never interleave mid-frame
+        wlock = threading.Lock()
+
+        def raw_send(data: bytes) -> None:
+            with wlock:
+                conn.sendall(data)
+
+        def ws_send(obj) -> None:
+            payload = json.dumps(obj).encode()
+            head = bytearray([0x81])  # FIN + text
+            n = len(payload)
+            if n < 126:
+                head.append(n)
+            elif n < 65536:
+                head.append(126)
+                head += struct.pack(">H", n)
+            else:
+                head.append(127)
+                head += struct.pack(">Q", n)
+            raw_send(bytes(head) + payload)
+
+        rfile = handler.rfile
+
+        def ws_recv():
+            """One frame -> (opcode, payload) or None on EOF."""
+            hdr = rfile.read(2)
+            if len(hdr) < 2:
+                return None
+            opcode = hdr[0] & 0x0F
+            masked = hdr[1] & 0x80
+            n = hdr[1] & 0x7F
+            if n == 126:
+                n = struct.unpack(">H", rfile.read(2))[0]
+            elif n == 127:
+                n = struct.unpack(">Q", rfile.read(8))[0]
+            mask = rfile.read(4) if masked else b""
+            data = rfile.read(n) if n else b""
+            if masked and data:
+                data = bytes(
+                    b ^ mask[i % 4] for i, b in enumerate(data)
+                )
+            return opcode, data
+
+        cmd = query.get("command", []) or ["/bin/sh"]
+        task = query.get("task", [""])[0]
+        tty = query.get("tty", ["false"])[0] == "true"
+        session = self.cluster.pool.stream(
+            self.cluster.rpc.addr,
+            "ClientExec.exec",
+            {
+                "alloc_id": alloc.id,
+                "task": task,
+                "cmd": list(cmd),
+                "tty": tty,
+                "token": token,
+            },
+        )
+        done = threading.Event()
+
+        def pump_output() -> None:
+            try:
+                while not done.is_set():
+                    try:
+                        msg = session.recv(timeout_s=0.5)
+                    except TimeoutError:
+                        continue
+                    except (ConnectionError, OSError):
+                        break
+                    if msg is None:
+                        continue
+                    if msg.get("error"):
+                        ws_send({"error": msg["error"]})
+                        break
+                    data = msg.get("data")
+                    if data:
+                        ws_send(
+                            {
+                                "stdout": base64.b64encode(data).decode()
+                            }
+                        )
+                    if msg.get("eof"):
+                        ws_send({"exit": True})
+                        break
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+            finally:
+                done.set()
+                try:
+                    raw_send(b"\x88\x00")  # close frame
+                except OSError:
+                    pass
+
+        t = threading.Thread(
+            target=pump_output, name="ws-exec-out", daemon=True
+        )
+        t.start()
+        try:
+            while not done.is_set():
+                frame = ws_recv()
+                if frame is None:
+                    break
+                opcode, data = frame
+                if opcode == 0x8:  # close
+                    break
+                if opcode == 0x9:  # ping -> pong
+                    raw_send(b"\x8a" + bytes([len(data)]) + data)
+                    continue
+                if opcode != 0x1 or not data:
+                    continue
+                try:
+                    msg = json.loads(data)
+                except ValueError:
+                    continue
+                if "stdin" in msg:
+                    session.send(
+                        {"stdin": base64.b64decode(msg["stdin"])}
+                    )
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            done.set()
+            try:
+                session.send({"eof": True})
+            except (ConnectionError, OSError):
+                pass
+            session.close()
+            t.join(timeout=2)
 
     def _serve_event_stream(self, handler, query) -> None:
         topics: dict[str, list[str]] = {}
@@ -1680,6 +1872,27 @@ class HTTPAgentServer:
                     self.wfile.write(data)
                     return
                 try:
+                    exec_m = re.match(
+                        r"^/v1/client/allocation/(?P<id>[^/]+)/exec$",
+                        parsed.path,
+                    )
+                    if (
+                        method == "GET"
+                        and exec_m
+                        and "websocket"
+                        in (self.headers.get("Upgrade") or "").lower()
+                    ):
+                        # BEFORE the generic resolver: browsers cannot
+                        # set X-Nomad-Token on a websocket, so the token
+                        # may ride ?token= — _serve_exec_ws enforces
+                        # alloc-exec on the alloc's own namespace itself
+                        outer._serve_exec_ws(
+                            self,
+                            exec_m.group("id"),
+                            query,
+                            token or query.get("token", [""])[0],
+                        )
+                        return
                     if outer.acl_resolver is not None:
                         from ..acl.enforce import AuthError
 

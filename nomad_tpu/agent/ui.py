@@ -63,6 +63,23 @@ a:hover { text-decoration: underline; }
 .barbg { display: inline-block; width: 120px; height: 10px;
   background: var(--line); border-radius: 2px; vertical-align: middle; }
 code { background: var(--bg); padding: 1px 5px; border-radius: 3px; }
+textarea, #xin { width: 100%; background: var(--bg); color: var(--fg);
+  border: 1px solid var(--line); border-radius: 6px; padding: 10px;
+  font: 13px/1.45 ui-monospace, "SF Mono", Menlo, monospace; }
+textarea { min-height: 320px; resize: vertical; }
+button { background: var(--accent); color: #08110d; border: none;
+  padding: 7px 16px; border-radius: 5px; font-weight: 600;
+  cursor: pointer; margin-right: 8px; }
+button.alt { background: var(--panel); color: var(--fg);
+  border: 1px solid var(--line); }
+button.danger { background: var(--bad); color: #140a0b; }
+#term { background: #06090d; border: 1px solid var(--line);
+  border-radius: 6px; padding: 12px; min-height: 260px; max-height: 420px;
+  overflow-y: auto; white-space: pre-wrap; word-break: break-all;
+  font: 13px/1.4 ui-monospace, "SF Mono", Menlo, monospace; }
+#runout { white-space: pre-wrap; background: var(--panel);
+  border: 1px solid var(--line); border-radius: 6px; padding: 12px;
+  font: 13px/1.45 ui-monospace, Menlo, monospace; }
 </style>
 </head>
 <body>
@@ -79,10 +96,10 @@ code { background: var(--bg); padding: 1px 5px; border-radius: 3px; }
 "use strict";
 const $ = (s) => document.querySelector(s);
 const NAV = [
-  ["jobs", "Jobs"], ["nodes", "Clients"], ["allocs", "Allocations"],
-  ["evals", "Evaluations"], ["services", "Services"],
-  ["storage", "Storage"], ["topology", "Topology"],
-  ["servers", "Servers"],
+  ["jobs", "Jobs"], ["run", "Run"], ["nodes", "Clients"],
+  ["allocs", "Allocations"], ["evals", "Evaluations"],
+  ["services", "Services"], ["storage", "Storage"],
+  ["topology", "Topology"], ["servers", "Servers"],
 ];
 $("#nav").innerHTML = NAV.map(([r, t]) =>
   `<a href="#/${r}" data-route="${r}">${t}</a>`).join("");
@@ -93,18 +110,27 @@ tokenInput.addEventListener("change", () => {
   render();
 });
 
-async function api(path) {
+async function api(path, opts) {
   const headers = {};
   const tok = localStorage.getItem("nomad_token") || "";
   if (tok) headers["X-Nomad-Token"] = tok;
-  const resp = await fetch(path, { headers });
+  let init = { headers };
+  if (opts && opts.body !== undefined) {
+    headers["Content-Type"] = "application/json";
+    init = { method: opts.method || "POST", headers,
+             body: JSON.stringify(opts.body) };
+  } else if (opts && opts.method) {
+    init = { method: opts.method, headers };
+  }
+  const resp = await fetch(path, init);
   const body = await resp.json().catch(() => ({}));
   if (!resp.ok) throw new Error(`${path}: ${body.error || resp.status}`);
   return body;
 }
 
-const esc = (s) => String(s ?? "").replace(/[&<>"]/g,
-  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
+    "'":"&#39;"}[c]));
 const short = (id) => esc(String(id || "").slice(0, 8));
 function pill(status) {
   const cls = {
@@ -154,7 +180,13 @@ const views = {
       api(`/v1/job/${id}/allocations?namespace=${ns}`),
       api(`/v1/job/${id}/evaluations?namespace=${ns}`),
     ]);
-    let html = `<h1>${esc(job.name || job.id)} ${pill(job.status)}</h1>`;
+    setTimeout(() => {
+      const b = $("#stopbtn");
+      if (b) b.onclick = () => stopJob(job.namespace, job.id);
+    }, 0);
+    let html = `<h1>${esc(job.name || job.id)} ${pill(job.status)}
+      <button class="danger" style="float:right" id="stopbtn">
+        Stop</button></h1>`;
     html += kv([
       ["ID", esc(job.id)], ["Namespace", esc(job.namespace)],
       ["Type", esc(job.type)], ["Priority", job.priority],
@@ -181,6 +213,29 @@ const views = {
       evals.map((e) => [short(e.id), esc(e.triggered_by),
         pill(e.status)]));
     return html;
+  },
+
+  async run() {
+    const saved = sessionStorage.getItem("nomad_jobspec") ||
+      `job "example" {\n  group "web" {\n    count = 1\n    task "app" {\n      driver = "mock"\n      config {}\n    }\n  }\n}\n`;
+    setTimeout(() => {
+      const ta = $("#jobsrc");
+      if (!ta) return;
+      ta.value = saved;
+      ta.addEventListener("input", () =>
+        sessionStorage.setItem("nomad_jobspec", ta.value));
+      $("#btnplan").addEventListener("click", () => planJob());
+      $("#btnrun").addEventListener("click", () => runJob());
+    }, 0);
+    return `<h1>Run Job</h1>
+      <p class="dim">Paste an HCL jobspec; Plan dry-runs the scheduler
+      against live state, Run submits it.</p>
+      <textarea id="jobsrc" spellcheck="false"></textarea>
+      <p style="margin:12px 0">
+        <button id="btnrun">Run</button>
+        <button id="btnplan" class="alt">Plan</button>
+      </p>
+      <div id="runout" class="dim">no output yet</div>`;
   },
 
   async nodes() {
@@ -255,6 +310,27 @@ const views = {
           st.restarts || 0,
           esc(ev.type ? `${ev.type} ${ev.details || ""}` : "-")];
       }));
+    if (a.client_status === "running") {
+      const tasks = Object.keys(states);
+      setTimeout(() => {
+        const b = $("#xconnect");
+        if (b) b.onclick = () => execConnect(a.id);
+      }, 0);
+      html += `<h2>Exec</h2>
+        <p>
+          <select id="xtask">${tasks.map((t) =>
+            `<option value="${esc(t)}">${esc(t)}</option>`).join("")}
+          </select>
+          <input id="xcmd" value="/bin/sh" style="width:220px;
+            background:var(--bg);color:var(--fg);
+            border:1px solid var(--line);border-radius:4px;
+            padding:5px 8px">
+          <button id="xconnect">Connect</button>
+        </p>
+        <div id="term" class="dim">not connected</div>
+        <input id="xin" placeholder="stdin — Enter sends a line"
+          style="margin-top:8px">`;
+    }
     return html;
   },
 
@@ -343,6 +419,101 @@ const views = {
   },
 };
 
+async function parseJob() {
+  const src = $("#jobsrc").value;
+  const out = await api("/v1/jobs/parse", { body: { JobHCL: src } });
+  return out.Job;
+}
+async function planJob() {
+  const el = $("#runout");
+  el.textContent = "planning…";
+  try {
+    const job = await parseJob();
+    const plan = await api(
+      `/v1/job/${encodeURIComponent(job.id)}/plan`,
+      { method: "PUT", body: { Job: job, Diff: true } });
+    const ann = plan.Annotations || plan.annotations || {};
+    const tg = ann.DesiredTGUpdates || ann.desired_tg_updates || {};
+    let lines = [`plan for ${job.id}:`];
+    for (const [g, u] of Object.entries(tg)) {
+      lines.push(
+        `  group ${g}: +${u.Place ?? u.place ?? 0} place, ` +
+        `${u.DestructiveUpdate ?? u.destructive ?? 0} destructive, ` +
+        `${u.InPlaceUpdate ?? u.in_place_update ?? 0} in-place, ` +
+        `${u.Stop ?? u.stop ?? 0} stop, ` +
+        `${u.Ignore ?? u.ignore ?? 0} ignore`);
+    }
+    if (plan.FailedTGAllocs && Object.keys(plan.FailedTGAllocs).length)
+      lines.push(`  FAILED groups: ` +
+        Object.keys(plan.FailedTGAllocs).join(", "));
+    el.textContent = lines.join("\n");
+  } catch (e) { el.textContent = String(e.message || e); }
+}
+async function runJob() {
+  const el = $("#runout");
+  el.textContent = "submitting…";
+  try {
+    const job = await parseJob();
+    const out = await api("/v1/jobs", { method: "PUT",
+      body: { Job: job } });
+    const evalId = typeof out === "string" ? out :
+      (out.EvalID || out.eval_id || "");
+    el.textContent = `submitted: eval ${evalId}`;
+    location.hash = `#/jobs/${job.namespace || "default"}/${job.id}`;
+  } catch (e) { el.textContent = String(e.message || e); }
+}
+async function stopJob(ns, id) {
+  if (!confirm(`Stop job ${id}?`)) return;
+  await api(`/v1/job/${encodeURIComponent(id)}?namespace=${ns}`,
+    { method: "DELETE" });
+  render();
+}
+
+// -- browser exec terminal (WebSocket to the agent's exec bridge) ------
+let execWs = null;
+function execConnect(allocId) {
+  const term = $("#term");
+  const task = $("#xtask").value;
+  const cmd = $("#xcmd").value || "/bin/sh";
+  term.textContent = "";
+  if (execWs) { try { execWs.close(); } catch (_) {} }
+  const tok = localStorage.getItem("nomad_token") || "";
+  const proto = location.protocol === "https:" ? "wss" : "ws";
+  const params = new URLSearchParams();
+  for (const part of cmd.split(" ").filter(Boolean))
+    params.append("command", part);
+  if (task) params.set("task", task);
+  if (tok) params.set("token", tok);
+  const ws = new WebSocket(
+    `${proto}://${location.host}/v1/client/allocation/${allocId}` +
+    `/exec?${params}`);
+  execWs = ws;
+  const append = (txt) => {
+    term.textContent += txt;
+    term.scrollTop = term.scrollHeight;
+  };
+  ws.onopen = () => append(`connected: ${cmd}\n`);
+  ws.onmessage = (ev) => {
+    try {
+      const m = JSON.parse(ev.data);
+      if (m.stdout) append(atob(m.stdout));
+      if (m.error) append(`\n[error] ${m.error}\n`);
+      if (m.exit) append("\n[session ended]\n");
+    } catch (_) {}
+  };
+  ws.onclose = () => append("\n[disconnected]\n");
+  const input = $("#xin");
+  input.onkeydown = (ev) => {
+    if (ev.key !== "Enter") return;
+    const line = input.value + "\n";
+    input.value = "";
+    append(line);
+    if (ws.readyState === 1)
+      ws.send(JSON.stringify({ stdin: btoa(line) }));
+  };
+  input.focus();
+}
+
 let refreshTimer = null;
 let renderGen = 0;
 async function render() {
@@ -371,7 +542,10 @@ async function render() {
     $("#err").textContent = String(e.message || e);
   }
   clearTimeout(refreshTimer);
-  refreshTimer = setTimeout(render, 5000);
+  // the editor and the exec terminal must not be wiped by auto-refresh
+  const live = parts[0] === "run" ||
+    (parts[0] === "allocs" && parts.length === 2);
+  if (!live) refreshTimer = setTimeout(render, 5000);
 }
 window.addEventListener("hashchange", render);
 render();

@@ -1941,6 +1941,109 @@ def cmd_version(args) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _args_job_run(p):
+    p.add_argument("jobfile")
+    p.add_argument("-var", action="append", default=[])
+    p.add_argument("-detach", action="store_true")
+    p.set_defaults(fn=cmd_job_run)
+
+
+def _args_job_stop(p):
+    p.add_argument("job_id")
+    p.add_argument("-purge", action="store_true")
+    p.set_defaults(fn=cmd_job_stop)
+
+
+def _args_job_plan(p):
+    p.add_argument("jobfile")
+    p.add_argument("-var", action="append", default=[])
+    p.set_defaults(fn=cmd_job_plan)
+
+
+def _args_job_validate(p):
+    p.add_argument("jobfile")
+    p.add_argument("-var", action="append", default=[])
+    p.set_defaults(fn=cmd_job_validate)
+
+
+def _args_job_init(p):
+    p.add_argument("filename", nargs="?")
+    p.set_defaults(fn=cmd_job_init)
+
+
+def _args_job_inspect(p):
+    p.add_argument("job_id")
+    p.set_defaults(fn=cmd_job_inspect)
+
+
+def _args_alloc_exec(p):
+    p.add_argument("-t", "-tty", dest="tty", action="store_true")
+    p.add_argument("-task", default="")
+    p.add_argument("-rpc-secret", dest="rpc_secret", default="")
+    p.add_argument(
+        "-fabric-tls", dest="fabric_tls", action="store_true",
+        help="dial the RPC fabric over TLS (tls { rpc = true }); "
+        "creds from NOMAD_CLIENT_CERT/KEY + NOMAD_CACERT",
+    )
+    p.add_argument("alloc_id")
+    p.add_argument("cmd", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_alloc_exec)
+
+
+def _args_alloc_logs(p):
+    p.add_argument("-f", "-follow", dest="follow", action="store_true")
+    p.add_argument("-stderr", action="store_true")
+    p.add_argument("-task", default="")
+    p.add_argument("alloc_id")
+    p.set_defaults(fn=cmd_alloc_logs)
+
+
+def _args_alloc_fs(p):
+    p.add_argument("alloc_id")
+    p.add_argument("path", nargs="?", default="")
+    p.set_defaults(fn=cmd_alloc_fs)
+
+
+def _args_alloc_status(p):
+    p.add_argument("alloc_id")
+    p.set_defaults(fn=cmd_alloc_status)
+
+
+def _args_eval_status(p):
+    p.add_argument("eval_id")
+    p.set_defaults(fn=cmd_eval_status)
+
+
+def _args_node_status(p):
+    p.add_argument("node_id", nargs="?")
+    p.set_defaults(fn=cmd_node_status)
+
+
+def _args_node_drain(p):
+    p.add_argument("node_id")
+    p.add_argument("-enable", action="store_true")
+    p.add_argument("-disable", action="store_true")
+    p.add_argument("-deadline", default="1h")
+    p.add_argument("-ignore-system", dest="ignore_system",
+                   action="store_true")
+    p.set_defaults(fn=cmd_node_drain)
+
+
+def _args_server_join(p):
+    p.add_argument("address", nargs="+")
+    p.set_defaults(fn=cmd_server_join)
+
+
+def _args_server_force_leave(p):
+    p.add_argument("node")
+    p.set_defaults(fn=cmd_server_force_leave)
+
+
+def _args_operator_debug(p):
+    p.add_argument("-output", default="")
+    p.set_defaults(fn=cmd_operator_debug)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
     p.add_argument("-address", default=None, help="HTTP API address")
@@ -1968,22 +2071,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     job = sub.add_parser("job", help="job commands")
     jsub = job.add_subparsers(dest="subcmd")
-    jr = jsub.add_parser("run")
-    jr.add_argument("jobfile")
-    jr.add_argument("-var", action="append", default=[])
-    jr.add_argument("-detach", action="store_true")
-    jr.set_defaults(fn=cmd_job_run)
-    jp = jsub.add_parser("plan")
-    jp.add_argument("jobfile")
-    jp.add_argument("-var", action="append", default=[])
-    jp.set_defaults(fn=cmd_job_plan)
+    _args_job_run(jsub.add_parser("run"))
+    _args_job_plan(jsub.add_parser("plan"))
     js = jsub.add_parser("status")
     js.add_argument("job_id", nargs="?")
     js.set_defaults(fn=cmd_job_status)
-    jst = jsub.add_parser("stop")
-    jst.add_argument("job_id")
-    jst.add_argument("-purge", action="store_true")
-    jst.set_defaults(fn=cmd_job_stop)
+    _args_job_stop(jsub.add_parser("stop"))
     jev = jsub.add_parser("eval")
     jev.add_argument("job_id")
     jev.set_defaults(fn=cmd_job_eval)
@@ -1998,16 +2091,9 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.add_argument("group")
     jsc.add_argument("count", type=int)
     jsc.set_defaults(fn=cmd_job_scale)
-    jva = jsub.add_parser("validate")
-    jva.add_argument("jobfile")
-    jva.add_argument("-var", action="append", default=[])
-    jva.set_defaults(fn=cmd_job_validate)
-    jin = jsub.add_parser("init")
-    jin.add_argument("filename", nargs="?")
-    jin.set_defaults(fn=cmd_job_init)
-    ji = jsub.add_parser("inspect")
-    ji.add_argument("job_id")
-    ji.set_defaults(fn=cmd_job_inspect)
+    _args_job_validate(jsub.add_parser("validate"))
+    _args_job_init(jsub.add_parser("init"))
+    _args_job_inspect(jsub.add_parser("inspect"))
     jh = jsub.add_parser("history")
     jh.add_argument("job_id")
     jh.set_defaults(fn=cmd_job_history)
@@ -2028,16 +2114,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     node = sub.add_parser("node", help="node commands")
     nsub = node.add_subparsers(dest="subcmd")
-    ns = nsub.add_parser("status")
-    ns.add_argument("node_id", nargs="?")
-    ns.set_defaults(fn=cmd_node_status)
-    nd = nsub.add_parser("drain")
-    nd.add_argument("node_id")
-    nd.add_argument("-enable", action="store_true")
-    nd.add_argument("-disable", action="store_true")
-    nd.add_argument("-deadline", default="1h")
-    nd.add_argument("-ignore-system", action="store_true", dest="ignore_system")
-    nd.set_defaults(fn=cmd_node_drain)
+    _args_node_status(nsub.add_parser("status"))
+    _args_node_drain(nsub.add_parser("drain"))
     ne = nsub.add_parser("eligibility")
     ne.add_argument("node_id")
     ne.add_argument("-enable", action="store_true")
@@ -2052,19 +2130,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     alloc = sub.add_parser("alloc", help="alloc commands")
     asub = alloc.add_subparsers(dest="subcmd")
-    ast = asub.add_parser("status")
-    ast.add_argument("alloc_id")
-    ast.set_defaults(fn=cmd_alloc_status)
-    alg = asub.add_parser("logs")
-    alg.add_argument("-f", "-follow", dest="follow", action="store_true")
-    alg.add_argument("-stderr", action="store_true")
-    alg.add_argument("-task", default="")
-    alg.add_argument("alloc_id")
-    alg.set_defaults(fn=cmd_alloc_logs)
-    afs = asub.add_parser("fs")
-    afs.add_argument("alloc_id")
-    afs.add_argument("path", nargs="?", default="")
-    afs.set_defaults(fn=cmd_alloc_fs)
+    _args_alloc_status(asub.add_parser("status"))
+    _args_alloc_logs(asub.add_parser("logs"))
+    _args_alloc_fs(asub.add_parser("fs"))
     arst = asub.add_parser("restart")
     arst.add_argument("alloc_id")
     arst.add_argument("-task", default="")
@@ -2077,26 +2145,13 @@ def build_parser() -> argparse.ArgumentParser:
     astp = asub.add_parser("stop")
     astp.add_argument("alloc_id")
     astp.set_defaults(fn=cmd_alloc_stop)
-    aex = asub.add_parser("exec")
-    aex.add_argument("-t", "-tty", dest="tty", action="store_true")
-    aex.add_argument("-task", default="")
-    aex.add_argument("-rpc-secret", dest="rpc_secret", default="")
-    aex.add_argument(
-        "-fabric-tls", dest="fabric_tls", action="store_true",
-        help="dial the RPC fabric over TLS (tls { rpc = true }); "
-        "creds from NOMAD_CLIENT_CERT/KEY + NOMAD_CACERT",
-    )
-    aex.add_argument("alloc_id")
-    # REMAINDER: everything after the alloc id belongs to the command,
-    # including its own dashed flags (nomad alloc exec <id> sh -c ...)
-    aex.add_argument("cmd", nargs=argparse.REMAINDER)
-    aex.set_defaults(fn=cmd_alloc_exec)
+    # REMAINDER semantics (everything after the alloc id belongs to the
+    # command, its own dashed flags included) live in _args_alloc_exec
+    _args_alloc_exec(asub.add_parser("exec"))
 
     ev = sub.add_parser("eval", help="eval commands")
     esub = ev.add_subparsers(dest="subcmd")
-    est = esub.add_parser("status")
-    est.add_argument("eval_id")
-    est.set_defaults(fn=cmd_eval_status)
+    _args_eval_status(esub.add_parser("status"))
     el = esub.add_parser("list")
     el.set_defaults(fn=cmd_eval_list)
     edel = esub.add_parser("delete")
@@ -2177,12 +2232,8 @@ def build_parser() -> argparse.ArgumentParser:
     ssub = srv.add_subparsers(dest="subcmd")
     sm = ssub.add_parser("members")
     sm.set_defaults(fn=cmd_server_members)
-    sfl = ssub.add_parser("force-leave")
-    sfl.add_argument("node")
-    sfl.set_defaults(fn=cmd_server_force_leave)
-    sj = ssub.add_parser("join")
-    sj.add_argument("address", nargs="+")
-    sj.set_defaults(fn=cmd_server_join)
+    _args_server_force_leave(ssub.add_parser("force-leave"))
+    _args_server_join(ssub.add_parser("join"))
 
     nsp = sub.add_parser("namespace", help="namespace commands")
     nssub = nsp.add_subparsers(dest="subcmd")
@@ -2330,9 +2381,7 @@ def build_parser() -> argparse.ArgumentParser:
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)
-    opdbg = opsub.add_parser("debug")
-    opdbg.add_argument("-output", default="")
-    opdbg.set_defaults(fn=cmd_operator_debug)
+    _args_operator_debug(opsub.add_parser("debug"))
     opsch = opsub.add_parser("scheduler")
     opschsub = opsch.add_subparsers(dest="subsubcmd")
     opsg = opschsub.add_parser("get-config")
@@ -2366,82 +2415,49 @@ def build_parser() -> argparse.ArgumentParser:
     st.set_defaults(fn=cmd_status)
 
     # -- top-level aliases (reference commands.go registers these
-    # shortcuts alongside the namespaced forms: run == job run, etc.) --
-    al_run = sub.add_parser("run", help="alias of `job run`")
-    al_run.add_argument("jobfile")
-    al_run.add_argument("-var", action="append", default=[])
-    al_run.add_argument("-detach", action="store_true")
-    al_run.set_defaults(fn=cmd_job_run)
-    al_stop = sub.add_parser("stop", help="alias of `job stop`")
-    al_stop.add_argument("job_id")
-    al_stop.add_argument("-purge", action="store_true")
-    al_stop.set_defaults(fn=cmd_job_stop)
-    al_plan = sub.add_parser("plan", help="alias of `job plan`")
-    al_plan.add_argument("jobfile")
-    al_plan.add_argument("-var", action="append", default=[])
-    al_plan.set_defaults(fn=cmd_job_plan)
-    al_val = sub.add_parser("validate", help="alias of `job validate`")
-    al_val.add_argument("jobfile")
-    al_val.add_argument("-var", action="append", default=[])
-    al_val.set_defaults(fn=cmd_job_validate)
-    al_init = sub.add_parser("init", help="alias of `job init`")
-    al_init.add_argument("filename", nargs="?")
-    al_init.set_defaults(fn=cmd_job_init)
-    al_insp = sub.add_parser("inspect", help="alias of `job inspect`")
-    al_insp.add_argument("job_id")
-    al_insp.set_defaults(fn=cmd_job_inspect)
-    al_exec = sub.add_parser("exec", help="alias of `alloc exec`")
-    al_exec.add_argument("-t", "-tty", dest="tty", action="store_true")
-    al_exec.add_argument("-task", default="")
-    al_exec.add_argument("-rpc-secret", dest="rpc_secret", default="")
-    al_exec.add_argument(
-        "-fabric-tls", dest="fabric_tls", action="store_true"
+    # shortcuts alongside the namespaced forms: run == job run, etc.) —
+    # each shares its canonical subcommand's argument-registration
+    # helper, so flags can never drift between the two spellings
+    _args_job_run(sub.add_parser("run", help="alias of `job run`"))
+    _args_job_stop(sub.add_parser("stop", help="alias of `job stop`"))
+    _args_job_plan(sub.add_parser("plan", help="alias of `job plan`"))
+    _args_job_validate(
+        sub.add_parser("validate", help="alias of `job validate`")
     )
-    al_exec.add_argument("alloc_id")
-    al_exec.add_argument("cmd", nargs=argparse.REMAINDER)
-    al_exec.set_defaults(fn=cmd_alloc_exec)
-    al_logs = sub.add_parser("logs", help="alias of `alloc logs`")
-    al_logs.add_argument("-f", "-follow", dest="follow", action="store_true")
-    al_logs.add_argument("-stderr", action="store_true")
-    al_logs.add_argument("-task", default="")
-    al_logs.add_argument("alloc_id")
-    al_logs.set_defaults(fn=cmd_alloc_logs)
-    al_fs = sub.add_parser("fs", help="alias of `alloc fs`")
-    al_fs.add_argument("alloc_id")
-    al_fs.add_argument("path", nargs="?", default="")
-    al_fs.set_defaults(fn=cmd_alloc_fs)
-    al_ast = sub.add_parser("alloc-status", help="alias of `alloc status`")
-    al_ast.add_argument("alloc_id")
-    al_ast.set_defaults(fn=cmd_alloc_status)
-    al_est = sub.add_parser("eval-status", help="alias of `eval status`")
-    al_est.add_argument("eval_id")
-    al_est.set_defaults(fn=cmd_eval_status)
-    al_nst = sub.add_parser("node-status", help="alias of `node status`")
-    al_nst.add_argument("node_id", nargs="?")
-    al_nst.set_defaults(fn=cmd_node_status)
-    al_ndr = sub.add_parser("node-drain", help="alias of `node drain`")
-    al_ndr.add_argument("node_id")
-    al_ndr.add_argument("-enable", action="store_true")
-    al_ndr.add_argument("-disable", action="store_true")
-    al_ndr.add_argument("-deadline", default="1h")
-    al_ndr.add_argument("-ignore-system", dest="ignore_system",
-                        action="store_true")
-    al_ndr.set_defaults(fn=cmd_node_drain)
+    _args_job_init(sub.add_parser("init", help="alias of `job init`"))
+    _args_job_inspect(
+        sub.add_parser("inspect", help="alias of `job inspect`")
+    )
+    _args_alloc_exec(sub.add_parser("exec", help="alias of `alloc exec`"))
+    _args_alloc_logs(sub.add_parser("logs", help="alias of `alloc logs`"))
+    _args_alloc_fs(sub.add_parser("fs", help="alias of `alloc fs`"))
+    _args_alloc_status(
+        sub.add_parser("alloc-status", help="alias of `alloc status`")
+    )
+    _args_eval_status(
+        sub.add_parser("eval-status", help="alias of `eval status`")
+    )
+    _args_node_status(
+        sub.add_parser("node-status", help="alias of `node status`")
+    )
+    _args_node_drain(
+        sub.add_parser("node-drain", help="alias of `node drain`")
+    )
     al_sm = sub.add_parser("server-members", help="alias of `server members`")
     al_sm.set_defaults(fn=cmd_server_members)
-    al_sj = sub.add_parser("server-join", help="alias of `server join`")
-    al_sj.add_argument("address", nargs="+")
-    al_sj.set_defaults(fn=cmd_server_join)
-    al_sfl = sub.add_parser(
-        "server-force-leave", help="alias of `server force-leave`"
+    _args_server_join(
+        sub.add_parser("server-join", help="alias of `server join`")
     )
-    al_sfl.add_argument("node")
-    al_sfl.set_defaults(fn=cmd_server_force_leave)
+    _args_server_force_leave(
+        sub.add_parser(
+            "server-force-leave", help="alias of `server force-leave`"
+        )
+    )
     al_kg = sub.add_parser("keygen", help="alias of `operator keygen`")
     al_kg.set_defaults(fn=cmd_operator_keygen)
-    al_dbg = sub.add_parser("debug", help="alias of `operator debug`")
-    al_dbg.add_argument("-output", default="")
-    al_dbg.set_defaults(fn=cmd_operator_debug)
+    _args_operator_debug(
+        sub.add_parser("debug", help="alias of `operator debug`")
+    )
     chk = sub.add_parser("check", help="agent health probe")
     chk.set_defaults(fn=cmd_check)
 
