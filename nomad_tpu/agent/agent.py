@@ -185,6 +185,9 @@ class AgentConfig:
     # collection_interval prometheus_metrics }): prometheus is pull-mode
     # via /v1/metrics?format=prometheus (always on); statsd pushes.
     telemetry_statsd_address: str = ""
+    # DogStatsD push (reference telemetry { datadog_address }): statsd
+    # wire format + constant |#tags (node/region/dc)
+    telemetry_datadog_address: str = ""
     telemetry_interval_s: float = 10.0
 
     @staticmethod
@@ -345,6 +348,19 @@ class Agent:
                 self.config.telemetry_interval_s,
             )
             self.statsd.start()
+        if self.config.telemetry_datadog_address:
+            from ..metrics import DatadogSink
+
+            self.datadog = DatadogSink(
+                self.config.telemetry_datadog_address,
+                self.config.telemetry_interval_s,
+                tags={
+                    "node": self.config.node_name or "agent",
+                    "region": self.config.region,
+                    "datacenter": self.config.datacenter,
+                },
+            )
+            self.datadog.start()
         # Everything built so far (modules, config, stores, subsystems)
         # is process-lifetime state: freeze it out of the cyclic
         # collector so steady-state GC passes only ever walk young
@@ -439,6 +455,9 @@ class Agent:
         if getattr(self, "statsd", None) is not None:
             self.statsd.stop()
             self.statsd = None
+        if getattr(self, "datadog", None) is not None:
+            self.datadog.stop()
+            self.datadog = None
         if self.http is not None:
             self.http.shutdown()
         if self.client is not None:

@@ -1076,10 +1076,15 @@ class HTTPAgentServer:
             # given servers (CLI `server join`)
             addrs = []
             for a in q.get("address", []):
-                host, _, port = a.rpartition(":")
-                if not host:  # bare host, default port
+                if a.startswith("["):  # [::1]:4647 form
+                    host, _, port = a.rpartition(":")
+                    host = host.strip("[]")
+                elif a.count(":") > 1:  # bare IPv6: no port to split off
                     host, port = a, ""
-                host = host.strip("[]")  # [::1]:4647 form
+                else:
+                    host, _, port = a.rpartition(":")
+                    if not host:  # bare hostname/IPv4, default port
+                        host, port = a, ""
                 try:
                     addrs.append((host, int(port or 4647)))
                 except ValueError:
@@ -1476,17 +1481,27 @@ class HTTPAgentServer:
         cmd = query.get("command", []) or ["/bin/sh"]
         task = query.get("task", [""])[0]
         tty = query.get("tty", ["false"])[0] == "true"
-        session = self.cluster.pool.stream(
-            self.cluster.rpc.addr,
-            "ClientExec.exec",
-            {
-                "alloc_id": alloc.id,
-                "task": task,
-                "cmd": list(cmd),
-                "tty": tty,
-                "token": token,
-            },
-        )
+        try:
+            session = self.cluster.pool.stream(
+                self.cluster.rpc.addr,
+                "ClientExec.exec",
+                {
+                    "alloc_id": alloc.id,
+                    "task": task,
+                    "cmd": list(cmd),
+                    "tty": tty,
+                    "token": token,
+                },
+            )
+        except Exception as e:
+            # the 101 already went out: any failure from here on must be
+            # a websocket frame, never HTTP bytes into the upgraded stream
+            try:
+                ws_send({"error": f"exec stream failed: {e}"})
+                raw_send(b"\x88\x00")
+            except OSError:
+                pass
+            return
         done = threading.Event()
 
         def pump_output() -> None:
@@ -1975,6 +1990,19 @@ class HTTPAgentServer:
                 data = json.dumps(payload, default=_json_default).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                # gzip negotiation (reference command/agent/http.go:248
+                # wraps every handler in gziphandler): list payloads at
+                # cluster scale compress ~10x; tiny replies skip the
+                # header+CPU cost. Vary tells caches the body depends on
+                # the request encoding; q=0 is an explicit refusal.
+                self.send_header("Vary", "Accept-Encoding")
+                if len(data) > 1024 and _accepts_gzip(
+                    self.headers.get("Accept-Encoding")
+                ):
+                    import gzip as _gzip
+
+                    data = _gzip.compress(data, compresslevel=1)
+                    self.send_header("Content-Encoding", "gzip")
                 self.send_header("Content-Length", str(len(data)))
                 if index is not None:
                     self.send_header("X-Nomad-Index", str(index))
@@ -1994,6 +2022,22 @@ class HTTPAgentServer:
                 self._dispatch("DELETE")
 
         return Handler
+
+
+def _accepts_gzip(header: Optional[str]) -> bool:
+    """Accept-Encoding negotiation for gzip: present and not q=0."""
+    for part in (header or "").split(","):
+        toks = [t.strip() for t in part.split(";")]
+        if not toks or toks[0] != "gzip":
+            continue
+        for t in toks[1:]:
+            if t.startswith("q="):
+                try:
+                    return float(t[2:]) > 0
+                except ValueError:
+                    return True
+        return True
+    return False
 
 
 def _parse_wait(raw: str) -> float:

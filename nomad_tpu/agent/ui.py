@@ -464,8 +464,14 @@ async function runJob() {
 }
 async function stopJob(ns, id) {
   if (!confirm(`Stop job ${id}?`)) return;
-  await api(`/v1/job/${encodeURIComponent(id)}?namespace=${ns}`,
-    { method: "DELETE" });
+  try {
+    await api(
+      `/v1/job/${encodeURIComponent(id)}?namespace=` +
+      encodeURIComponent(ns), { method: "DELETE" });
+  } catch (e) {
+    $("#err").textContent = `stop failed: ${e.message || e}`;
+    return;
+  }
   render();
 }
 
@@ -542,10 +548,12 @@ async function render() {
     $("#err").textContent = String(e.message || e);
   }
   clearTimeout(refreshTimer);
-  // the editor and the exec terminal must not be wiped by auto-refresh
-  const live = parts[0] === "run" ||
-    (parts[0] === "allocs" && parts.length === 2);
-  if (!live) refreshTimer = setTimeout(render, 5000);
+  // the editor and a CONNECTED exec terminal must not be wiped by
+  // auto-refresh; an alloc page without a live session still refreshes
+  const termLive = execWs && execWs.readyState <= 1 &&
+    parts[0] === "allocs" && parts.length === 2;
+  if (parts[0] !== "run" && !termLive)
+    refreshTimer = setTimeout(render, 5000);
 }
 window.addEventListener("hashchange", render);
 render();

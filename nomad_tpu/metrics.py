@@ -244,6 +244,10 @@ class StatsdSink:
             except OSError:
                 pass  # daemon away; keep trying
 
+    def _decorate(self, line: str) -> str:
+        """Per-line hook for dialect extensions (DogStatsD tags)."""
+        return line
+
     def push_once(self) -> int:
         snap = self.reg.snapshot()
         lines: list[str] = []
@@ -251,13 +255,17 @@ class StatsdSink:
             delta = v - self._last_counters.get(name, 0)
             self._last_counters[name] = v
             if delta:
-                lines.append(f"{_prom_name(name)}:{_prom_value(delta)}|c")
+                lines.append(self._decorate(
+                    f"{_prom_name(name)}:{_prom_value(delta)}|c"))
         for name, v in snap["gauges"].items():
-            lines.append(f"{_prom_name(name)}:{_prom_value(v)}|g")
+            lines.append(self._decorate(
+                f"{_prom_name(name)}:{_prom_value(v)}|g"))
         for name, s in snap["samples"].items():
             n = _prom_name(name)
-            lines.append(f"{n}.count:{_prom_value(s['count'])}|g")
-            lines.append(f"{n}.sum:{_prom_value(s['sum'])}|g")
+            lines.append(self._decorate(
+                f"{n}.count:{_prom_value(s['count'])}|g"))
+            lines.append(self._decorate(
+                f"{n}.sum:{_prom_value(s['sum'])}|g"))
         sent = 0
         buf: list[str] = []
         size = 0
@@ -272,3 +280,24 @@ class StatsdSink:
             self._sock.sendto("\n".join(buf).encode(), self.addr)
             sent += len(buf)
         return sent
+
+
+class DatadogSink(StatsdSink):
+    """DogStatsD flavor of the statsd push (reference:
+    command/agent/command.go:1010 wires datadog_address into a
+    datadog.NewDogStatsdSink): same wire protocol plus |#tag:value
+    annotations. Constant tags (node name, region, datacenter) ride on
+    every metric, which is how the reference's DogStatsd sink attaches
+    its host tags."""
+
+    def __init__(self, address: str, interval_s: float = 10.0,
+                 reg: Optional[Registry] = None,
+                 tags: Optional[dict] = None) -> None:
+        super().__init__(address, interval_s, reg)
+        self._suffix = ""
+        if tags:
+            joined = ",".join(f"{k}:{v}" for k, v in sorted(tags.items()))
+            self._suffix = f"|#{joined}"
+
+    def _decorate(self, line: str) -> str:
+        return line + self._suffix

@@ -182,3 +182,28 @@ def _get_raw_jobs(self, index=None, wait=None):
 
 
 NomadClient.get_raw_jobs = _get_raw_jobs
+
+
+def test_gzip_response_negotiation(agent):
+    """Accept-Encoding: gzip compresses large list payloads
+    (reference command/agent/http.go:248 gzip wrap); absent the header,
+    plain JSON."""
+    import gzip
+    import json as _json
+    import urllib.request
+
+    base = f"http://127.0.0.1:{agent.http_addr[1]}"
+    srv = agent.server.server
+    # enough nodes that the /v1/nodes payload crosses the 1KiB threshold
+    for _ in range(8):
+        srv.node_register(mock.node())
+    req = urllib.request.Request(
+        f"{base}/v1/nodes", headers={"Accept-Encoding": "gzip"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.headers.get("Content-Encoding") == "gzip"
+        nodes = _json.loads(gzip.decompress(resp.read()))
+    assert len(nodes) >= 8
+    with urllib.request.urlopen(f"{base}/v1/nodes", timeout=10) as resp:
+        assert resp.headers.get("Content-Encoding") is None
+        assert len(_json.loads(resp.read())) >= 8

@@ -184,3 +184,26 @@ def test_statsd_sink_pushes_deltas():
     finally:
         sink.stop()
         srv.close()
+
+
+def test_datadog_sink_tags():
+    """DogStatsD sink decorates every line with constant tags
+    (reference command/agent/command.go:1010)."""
+    import socket
+
+    from nomad_tpu import metrics as m
+    from nomad_tpu.metrics import DatadogSink
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.settimeout(5)
+    m.incr("nomad.dd.test", 2)
+    sink = DatadogSink(
+        f"127.0.0.1:{srv.getsockname()[1]}", tags={"dc": "dc1"}
+    )
+    sink.push_once()
+    data = srv.recv(65535).decode()
+    srv.close()
+    assert any(
+        line.endswith("|#dc:dc1") for line in data.splitlines()
+    ), data
