@@ -1076,8 +1076,11 @@ class HTTPAgentServer:
             # given servers (CLI `server join`)
             addrs = []
             for a in q.get("address", []):
-                if a.startswith("["):  # [::1]:4647 form
-                    host, _, port = a.rpartition(":")
+                if a.startswith("["):  # [::1]:4647 or bare [::1]
+                    if "]:" in a:
+                        host, _, port = a.rpartition(":")
+                    else:
+                        host, port = a, ""
                     host = host.strip("[]")
                 elif a.count(":") > 1:  # bare IPv6: no port to split off
                     host, port = a, ""

@@ -482,6 +482,8 @@ function execConnect(allocId) {
   const task = $("#xtask").value;
   const cmd = $("#xcmd").value || "/bin/sh";
   term.textContent = "";
+  // a pending auto-refresh would re-render and detach this terminal
+  clearTimeout(refreshTimer);
   if (execWs) { try { execWs.close(); } catch (_) {} }
   const tok = localStorage.getItem("nomad_token") || "";
   const proto = location.protocol === "https:" ? "wss" : "ws";
@@ -507,7 +509,12 @@ function execConnect(allocId) {
       if (m.exit) append("\n[session ended]\n");
     } catch (_) {}
   };
-  ws.onclose = () => append("\n[disconnected]\n");
+  ws.onclose = () => {
+    append("\n[disconnected]\n");
+    // session over: let the alloc page resume its auto-refresh cycle
+    clearTimeout(refreshTimer);
+    refreshTimer = setTimeout(render, 5000);
+  };
   const input = $("#xin");
   input.onkeydown = (ev) => {
     if (ev.key !== "Enter") return;

@@ -267,6 +267,7 @@ def _load_agent_config(path: str):
 
         tea = teb.body.attrs()
         cfg.telemetry_statsd_address = str(tea.get("statsd_address", ""))
+        cfg.telemetry_datadog_address = str(tea.get("datadog_address", ""))
         if "collection_interval" in tea:
             cfg.telemetry_interval_s = parse_duration(
                 tea["collection_interval"]
@@ -322,6 +323,9 @@ def _apply_config_dict(cfg, data: dict) -> None:
             from ..jobspec.hcl import parse_duration
 
             cfg.telemetry_statsd_address = str(v.get("statsd_address", ""))
+            cfg.telemetry_datadog_address = str(
+                v.get("datadog_address", "")
+            )
             if "collection_interval" in v:
                 cfg.telemetry_interval_s = parse_duration(
                     v["collection_interval"]
