@@ -1063,6 +1063,31 @@ def cmd_acl_token_update(args) -> int:
     return 0
 
 
+def cmd_job_scaling_events(args) -> int:
+    api = _client(args)
+    st = api.jobs.scale_status(args.job_id)
+    rows = []
+    for group, events in sorted((st.get("ScalingEvents") or {}).items()):
+        for e in events:
+            when = time.strftime(
+                "%Y-%m-%dT%H:%M:%S",
+                time.localtime(e.get("Time", 0) / 1e9),
+            )
+            rows.append([
+                when, group, e.get("PreviousCount", ""),
+                e.get("Count", ""), str(e.get("EvalID", ""))[:8],
+                e.get("Message", ""),
+            ])
+    if not rows:
+        print("No scaling events")
+        return 0
+    print(_fmt_table(
+        rows,
+        header=["Time", "Group", "Previous", "Count", "Eval", "Message"],
+    ))
+    return 0
+
+
 def cmd_namespace_inspect(args) -> int:
     api = _client(args)
     ns = next(
@@ -2095,6 +2120,9 @@ def build_parser() -> argparse.ArgumentParser:
     jsc.add_argument("group")
     jsc.add_argument("count", type=int)
     jsc.set_defaults(fn=cmd_job_scale)
+    jse = jsub.add_parser("scaling-events")
+    jse.add_argument("job_id")
+    jse.set_defaults(fn=cmd_job_scaling_events)
     _args_job_validate(jsub.add_parser("validate"))
     _args_job_init(jsub.add_parser("init"))
     _args_job_inspect(jsub.add_parser("inspect"))

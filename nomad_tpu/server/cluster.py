@@ -297,6 +297,11 @@ class JobEndpoint(_Forwarder):
             "JobID": job.id,
             "JobStopped": job.stop,
             "TaskGroups": groups,
+            # newest-first scale-event journal per group (reference
+            # JobScaleStatus — `nomad job scaling-events` reads this)
+            "ScalingEvents": st.scaling_events(
+                args["namespace"], args["job_id"]
+            ),
         }
 
     def evaluate(self, args):

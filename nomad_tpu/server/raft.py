@@ -95,6 +95,11 @@ class FSM:
             "summaries_reconcile": lambda i, p: (
                 self.state.reconcile_job_summaries(i)
             ),
+            "job_scaling_event": lambda i, p: (
+                self.state.upsert_scaling_event(
+                    i, p["namespace"], p["job_id"], p["group"], p["event"]
+                )
+            ),
             "operator_config_upsert": lambda i, p: (
                 self.state.upsert_operator_config(i, p[0], p[1])
             ),

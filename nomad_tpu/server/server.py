@@ -665,8 +665,25 @@ class Server:
                     f"count {count} outside scaling bounds [{lo}, {hi}] "
                     f"for group {group!r}"
                 )
+        prev = tg.count
         tg.count = count
-        return self.job_register(job)
+        eval_id = self.job_register(job)
+        self.raft_apply(
+            "job_scaling_event",
+            {
+                "namespace": namespace,
+                "job_id": job_id,
+                "group": group,
+                "event": {
+                    "Time": now_ns(),
+                    "Count": count,
+                    "PreviousCount": prev,
+                    "Message": message or "submitted via scale API",
+                    "EvalID": eval_id,
+                },
+            },
+        )
+        return eval_id
 
     def job_force_evaluate(self, namespace: str, job_id: str) -> str:
         """Create a new eval for the job (reference job_endpoint.go

@@ -74,6 +74,9 @@ TABLE_SERVICES = "services"
 TABLE_SECRETS = "secrets"
 TABLE_OPERATOR = "operator_config"
 TABLE_SCALING_POLICIES = "scaling_policy"
+# (ns, job_id) -> {group: [event dicts]} — bounded scale-event journal
+# (reference state_store.go UpsertScalingEvent, JOB_TRACKED_SCALING_EVENTS)
+TABLE_SCALING_EVENTS = "scaling_event"
 ALL_TABLES = (
     TABLE_NODES,
     TABLE_JOBS,
@@ -90,6 +93,7 @@ ALL_TABLES = (
     TABLE_SECRETS,
     TABLE_OPERATOR,
     TABLE_SCALING_POLICIES,
+    TABLE_SCALING_EVENTS,
 )
 
 # Secondary indexes: key -> {alloc_id: Allocation}. Kept under the same
@@ -471,6 +475,12 @@ class _ReadMixin:
 
     def scaling_policy_by_id(self, policy_id: str):
         return self._tables[TABLE_SCALING_POLICIES].get(policy_id)
+
+    def scaling_events(self, namespace: str, job_id: str) -> dict:
+        """group -> [events], newest first (reference JobScalingEvents)."""
+        return self._tables[TABLE_SCALING_EVENTS].get(
+            (namespace, job_id), {}
+        )
 
     def scaling_policies_by_job(self, namespace: str, job_id: str) -> list:
         return [
@@ -1155,9 +1165,13 @@ class StateStore(_ReadMixin):
                 if p.namespace == namespace and p.job_id == job_id
             ]:
                 del sp[pid]
+            self._wtable(TABLE_SCALING_EVENTS).pop(
+                (namespace, job_id), None
+            )
             self._stamp(
                 index, TABLE_JOBS, TABLE_JOB_VERSIONS,
                 TABLE_JOB_SUMMARIES, TABLE_SCALING_POLICIES,
+                TABLE_SCALING_EVENTS,
             )
             if job is not None:
                 self._publish(index, TABLE_JOBS, [job], "JobDeregistered")
@@ -1203,6 +1217,27 @@ class StateStore(_ReadMixin):
         for ns, job_id in jobs_touched:
             self._update_job_status_txn(index, ns, job_id)
         return stored
+
+    # reference structs.go JobTrackedScalingEvents = 20
+    SCALING_EVENTS_TRACKED = 20
+
+    def upsert_scaling_event(
+        self, index: int, namespace: str, job_id: str, group: str,
+        event: dict,
+    ) -> None:
+        """Append one scale event, bounded per group (reference
+        state_store.go UpsertScalingEvent keeps the newest
+        JobTrackedScalingEvents = 20)."""
+        with self._lock:
+            t = self._wtable(TABLE_SCALING_EVENTS)
+            key = (namespace, job_id)
+            cur = t.get(key) or {}
+            fresh = {g: list(evs) for g, evs in cur.items()}
+            evs = fresh.setdefault(group, [])
+            evs.insert(0, dict(event))
+            del evs[self.SCALING_EVENTS_TRACKED:]
+            t[key] = fresh
+            self._stamp(index, TABLE_SCALING_EVENTS)
 
     def delete_evals(self, index: int, eval_ids: list[str], alloc_ids: list[str]) -> None:
         with self._lock:

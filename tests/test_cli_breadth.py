@@ -670,8 +670,6 @@ JUSTIFIED_UNPORTED = {
     "has no meaning here",
     "deployment unblock": "multiregion deployment gate — enterprise-"
     "only in the reference (OSS build returns an error)",
-    "job scaling-events": "scale-event history log; scaling policies + "
-    "scale status are implemented, the event journal is not yet",
     "keyring": "serf gossip symmetric-key rotation; this fabric "
     "authenticates with the rpc_secret + mTLS instead of serf "
     "encryption keys (rpc/tls.py), so there is no keyring to rotate",
@@ -752,3 +750,28 @@ def test_cli_breadth_vs_reference_command_list():
     )
     for cmd, why in JUSTIFIED_UNPORTED.items():
         assert why.strip(), f"{cmd}: justification required"
+
+
+def test_job_scaling_events_journal(agent):
+    """Scale events are journaled per group, bounded, newest first, and
+    purge with the job (reference state_store.go UpsertScalingEvent +
+    `nomad job scaling-events`)."""
+    _run_job(agent, job_id="eventful")
+    api = _api(agent)
+    api.jobs.scale("eventful", "web", 3)
+    api.jobs.scale("eventful", "web", 2)
+    st = api.jobs.scale_status("eventful")
+    events = st["ScalingEvents"]["web"]
+    assert len(events) == 2
+    assert events[0]["Count"] == 2 and events[0]["PreviousCount"] == 3
+    assert events[1]["Count"] == 3 and events[1]["PreviousCount"] == 1
+    assert events[0]["EvalID"]
+    # bounded journal
+    srv = agent.server.server
+    for i in range(25):
+        api.jobs.scale("eventful", "web", 2 + (i % 2))
+    st = api.jobs.scale_status("eventful")
+    assert len(st["ScalingEvents"]["web"]) == srv.state.SCALING_EVENTS_TRACKED
+    # purge drops the journal
+    srv.job_deregister("default", "eventful", purge=True)
+    assert srv.state.scaling_events("default", "eventful") == {}
