@@ -32,6 +32,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..gctune import paused_gc
 from ..state.store import usage_contribution
 from ..structs import Plan, PlanResult, allocs_fit
 from ..structs.structs import NODE_STATUS_READY
@@ -120,7 +121,8 @@ def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
     return bad
 
 
-def _fast_path_usage(snapshot, plan: Plan, node_id: str, node):
+def _fast_path_usage(snapshot, plan: Plan, node_id: str, node,
+                     contrib: Optional[dict] = None):
     """Try to express one node's re-verification as a 3-vector compare.
 
     Returns (cpu, mem, disk) the node would hold after the plan, or None
@@ -146,7 +148,17 @@ def _fast_path_usage(snapshot, plan: Plan, node_id: str, node):
                 mem -= c[1]
                 disk -= c[2]
     for alloc in proposed:
-        c = usage_contribution(alloc)
+        # fresh solver placements share one AllocatedResources per group
+        # (solver fast-mint): memoize the contribution walk per distinct
+        # (resources, status) across the whole plan
+        ar = alloc.resources
+        if contrib is not None and ar is not None:
+            key = (id(ar), alloc.desired_status, alloc.client_status)
+            c = contrib.get(key)
+            if c is None and key not in contrib:
+                c = contrib[key] = usage_contribution(alloc)
+        else:
+            c = usage_contribution(alloc)
         if c is None:
             continue
         if c[3]:
@@ -194,6 +206,7 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     fast_ids: list[str] = []
     fast_rows: list[tuple[int, int, int, int, int, int]] = []
     slow_ids: list[str] = []
+    contrib: dict = {}  # per-plan shared-resources contribution memo
     for node_id, proposed in plan.node_allocation.items():
         if node_id in vol_rejected:
             reject(node_id, "volume write-claim conflict")
@@ -208,7 +221,7 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
         if node.status != NODE_STATUS_READY:
             reject(node_id, f"node is {node.status}")
             continue
-        usage = _fast_path_usage(snapshot, plan, node_id, node)
+        usage = _fast_path_usage(snapshot, plan, node_id, node, contrib)
         if usage is None:
             slow_ids.append(node_id)
             continue
@@ -471,12 +484,18 @@ class PlanApplier:
                 self._inflight = None  # committed and applied; base is current
             else:
                 snapshot = OverlaySnapshot(snapshot, res, job)
-        result = evaluate_plan(snapshot, plan)
-        if result.is_no_op():
-            fut.set_result(result)
-            return
-        result.preemption_evals = self._preemption_evals(result)
-        self._normalize(plan, result)
+        # verification + normalization allocate in bulk at c2m scale —
+        # same GC-pause rationale as the solver (gctune.py). ONLY the
+        # allocation burst: the blocking raft waits below must not hold
+        # the process-wide collector off (the raft/store paths pause
+        # around their own bursts).
+        with paused_gc():
+            result = evaluate_plan(snapshot, plan)
+            if result.is_no_op():
+                fut.set_result(result)
+                return
+            result.preemption_evals = self._preemption_evals(result)
+            self._normalize(plan, result)
         if not pipelining:
             index = self.raft_apply("apply_plan_results", result)
             result.alloc_index = index
