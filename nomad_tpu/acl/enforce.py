@@ -71,6 +71,10 @@ _NS_ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/v1/volumes$"), CAP_SUBMIT_JOB),
     ("PUT", re.compile(r"^/v1/volumes/create$"), CAP_SUBMIT_JOB),
     ("POST", re.compile(r"^/v1/volumes/create$"), CAP_SUBMIT_JOB),
+    ("PUT", re.compile(r"^/v1/volumes/snapshot$"), CAP_SUBMIT_JOB),
+    ("POST", re.compile(r"^/v1/volumes/snapshot$"), CAP_SUBMIT_JOB),
+    ("DELETE", re.compile(r"^/v1/volumes/snapshot$"), CAP_SUBMIT_JOB),
+    ("GET", re.compile(r"^/v1/volumes/snapshot$"), CAP_READ_JOB),
     ("GET", re.compile(r"^/v1/volume/.*$"), CAP_READ_JOB),
     ("DELETE", re.compile(r"^/v1/volume/.*$"), CAP_SUBMIT_JOB),
     # CSI plugin health rides the volume read gate (reference

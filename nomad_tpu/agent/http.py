@@ -209,7 +209,7 @@ class HTTPAgentServer:
         msg = str(e)
         if "KeyError" in msg or "not found" in msg:
             return HTTPError(404, msg)
-        if "ValueError" in msg:
+        if "ValueError" in msg or "invalid" in msg:
             return HTTPError(400, msg)
         return None
 
@@ -776,8 +776,68 @@ class HTTPAgentServer:
                 raise HTTPError(409, str(e))
             return None
 
+        def volume_snapshot_create(p, q, body, tok):
+            ns = (body or {}).get("Namespace") or q.get(
+                "namespace", ["default"]
+            )[0]
+            self._ns_guard(tok, ns, "submit-job")
+            vol_id = (body or {}).get("VolumeID", "")
+            if not vol_id:
+                raise HTTPError(400, "VolumeID is required")
+            try:
+                return self.rpc_region(
+                    "Volume.snapshot_create",
+                    {
+                        "namespace": ns,
+                        "volume_id": vol_id,
+                        "name": (body or {}).get("Name", ""),
+                    },
+                )
+            except Exception as e:
+                mapped = self._map_forward_error(e)
+                if mapped is None:
+                    raise
+                raise mapped
+
+        def volume_snapshot_delete(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            self._ns_guard(tok, ns, "submit-job")
+            plugin_id = q.get("plugin_id", [""])[0]
+            snap_id = q.get("snapshot_id", [""])[0]
+            if not plugin_id or not snap_id:
+                raise HTTPError(400, "plugin_id and snapshot_id required")
+            try:
+                self.rpc_region(
+                    "Volume.snapshot_delete",
+                    {"plugin_id": plugin_id, "snapshot_id": snap_id},
+                )
+            except Exception as e:
+                mapped = self._map_forward_error(e)
+                if mapped is None:
+                    raise
+                raise mapped
+            return None
+
+        def volume_snapshot_list(p, q, body, tok):
+            plugin_id = q.get("plugin_id", [""])[0]
+            if not plugin_id:
+                raise HTTPError(400, "plugin_id required")
+            try:
+                return self.rpc_region(
+                    "Volume.snapshot_list", {"plugin_id": plugin_id}
+                )
+            except Exception as e:
+                mapped = self._map_forward_error(e)
+                if mapped is None:
+                    raise
+                raise mapped
+
         route("PUT", "/v1/volumes/create", volume_create)
         route("POST", "/v1/volumes/create", volume_create)
+        route("PUT", "/v1/volumes/snapshot", volume_snapshot_create)
+        route("POST", "/v1/volumes/snapshot", volume_snapshot_create)
+        route("DELETE", "/v1/volumes/snapshot", volume_snapshot_delete)
+        route("GET", "/v1/volumes/snapshot", volume_snapshot_list)
         route(
             "DELETE", "/v1/volume/(?P<id>[^/]+)/delete", volume_csi_delete
         )

@@ -563,6 +563,30 @@ class Volumes(_Resource):
             params={"namespace": namespace or self.c.namespace},
         )
 
+    def snapshot_create(self, volume_id: str, name: str = "",
+                        namespace: Optional[str] = None):
+        """Point-in-time snapshot via the CSI controller (reference
+        api/csi.go CreateSnapshot)."""
+        return self.c.put(
+            "/v1/volumes/snapshot",
+            body={
+                "VolumeID": volume_id,
+                "Name": name,
+                "Namespace": namespace or self.c.namespace,
+            },
+        )
+
+    def snapshot_delete(self, plugin_id: str, snapshot_id: str):
+        return self.c.delete(
+            "/v1/volumes/snapshot",
+            params={"plugin_id": plugin_id, "snapshot_id": snapshot_id},
+        )
+
+    def snapshot_list(self, plugin_id: str):
+        return self.c.get(
+            "/v1/volumes/snapshot", params={"plugin_id": plugin_id}
+        )
+
     def create(self, volume):
         """Provision through the CSI controller then register
         (reference api/csi.go Create)."""

@@ -1279,6 +1279,41 @@ def cmd_volume_delete(args) -> int:
     return 0
 
 
+def cmd_volume_snapshot_create(args) -> int:
+    api = _client(args)
+    out = api.volumes.snapshot_create(args.volume_id, name=args.name or "")
+    print(f"Snapshot ID  = {out.get('snapshot_id')}")
+    print(f"Volume ID    = {args.volume_id}")
+    print(f"Size (MB)    = {out.get('size_mb')}")
+    print(f"Ready        = {out.get('ready')}")
+    return 0
+
+
+def cmd_volume_snapshot_delete(args) -> int:
+    api = _client(args)
+    api.volumes.snapshot_delete(args.plugin_id, args.snapshot_id)
+    print(f"Snapshot {args.snapshot_id} deleted")
+    return 0
+
+
+def cmd_volume_snapshot_list(args) -> int:
+    api = _client(args)
+    snaps = api.volumes.snapshot_list(args.plugin_id)
+    print(_fmt_table(
+        [
+            [
+                s.get("snapshot_id", ""),
+                s.get("source_external_id", ""),
+                s.get("size_mb", ""),
+                "ready" if s.get("ready") else "pending",
+            ]
+            for s in snaps
+        ],
+        header=["Snapshot", "Volume", "Size MB", "Status"],
+    ))
+    return 0
+
+
 def cmd_volume_status(args) -> int:
     api = _client(args)
     if args.id:
@@ -2303,6 +2338,19 @@ def build_parser() -> argparse.ArgumentParser:
     vinit = volsub.add_parser("init")
     vinit.add_argument("filename", nargs="?")
     vinit.set_defaults(fn=cmd_volume_init)
+    vsnap = volsub.add_parser("snapshot")
+    vsnapsub = vsnap.add_subparsers(dest="subsubcmd")
+    vsc = vsnapsub.add_parser("create")
+    vsc.add_argument("volume_id")
+    vsc.add_argument("name", nargs="?")
+    vsc.set_defaults(fn=cmd_volume_snapshot_create)
+    vsd = vsnapsub.add_parser("delete")
+    vsd.add_argument("plugin_id")
+    vsd.add_argument("snapshot_id")
+    vsd.set_defaults(fn=cmd_volume_snapshot_delete)
+    vsl = vsnapsub.add_parser("list")
+    vsl.add_argument("-plugin", dest="plugin_id", required=True)
+    vsl.set_defaults(fn=cmd_volume_snapshot_list)
     vstat = volsub.add_parser("status")
     vstat.add_argument("id", nargs="?")
     vstat.add_argument("-namespace", default="default")

@@ -50,6 +50,15 @@ class ClientEndpoints:
         self.rpc.register_stream("Alloc.stats", self._alloc_stats)
         self.rpc.register_stream("CSI.create", self._csi_create)
         self.rpc.register_stream("CSI.delete", self._csi_delete)
+        self.rpc.register_stream(
+            "CSI.create_snapshot", self._csi_create_snapshot
+        )
+        self.rpc.register_stream(
+            "CSI.delete_snapshot", self._csi_delete_snapshot
+        )
+        self.rpc.register_stream(
+            "CSI.list_snapshots", self._csi_list_snapshots
+        )
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -95,6 +104,41 @@ class ClientEndpoints:
         try:
             plugin.delete_volume(header.get("external_id", ""))
             session.send({"ok": True})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
+
+    def _csi_create_snapshot(self, session, header) -> None:
+        plugin = self._csi_plugin(session, header)
+        if plugin is None:
+            return
+        try:
+            out = plugin.create_snapshot(
+                header.get("external_id", ""),
+                header.get("name", ""),
+                header.get("params") or {},
+            )
+            session.send({"ok": True, **out})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
+
+    def _csi_delete_snapshot(self, session, header) -> None:
+        plugin = self._csi_plugin(session, header)
+        if plugin is None:
+            return
+        try:
+            plugin.delete_snapshot(header.get("snapshot_id", ""))
+            session.send({"ok": True})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
+
+    def _csi_list_snapshots(self, session, header) -> None:
+        plugin = self._csi_plugin(session, header)
+        if plugin is None:
+            return
+        try:
+            session.send(
+                {"ok": True, "snapshots": plugin.list_snapshots()}
+            )
         except Exception as e:
             session.send({"error": f"{type(e).__name__}: {e}"})
 

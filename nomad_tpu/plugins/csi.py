@@ -25,11 +25,13 @@ interaction are what parity requires, not mount(2).
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import subprocess
 import sys
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -103,6 +105,22 @@ class CSIPlugin:
     def delete_volume(self, external_id: str) -> None:
         """CSI DeleteVolume."""
         raise CSIError("plugin does not support volume deletion")
+
+    def create_snapshot(
+        self, external_id: str, name: str, params: dict
+    ) -> dict:
+        """Point-in-time copy of a volume; returns {"snapshot_id",
+        "source_external_id", "size_mb", "create_time_ns", "ready"}
+        (CSI CreateSnapshot)."""
+        raise CSIError("plugin does not support snapshots")
+
+    def delete_snapshot(self, snapshot_id: str) -> None:
+        """CSI DeleteSnapshot."""
+        raise CSIError("plugin does not support snapshots")
+
+    def list_snapshots(self) -> list[dict]:
+        """CSI ListSnapshots — every snapshot this plugin holds."""
+        raise CSIError("plugin does not support snapshots")
 
     # -- node service --------------------------------------------------
 
@@ -191,6 +209,82 @@ class FakeCSIPlugin(CSIPlugin):
         if os.path.isdir(path):
             shutil.rmtree(path)
 
+    def _snap_dir(self) -> str:
+        path = os.path.join(self.backing_dir, "_snapshots")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    @staticmethod
+    def _safe_component(value: str, what: str) -> str:
+        """Snapshot ids/names become filesystem path components: reject
+        anything that could escape the snapshot directory (these arrive
+        straight off the HTTP query string)."""
+        if (
+            not value
+            or value != os.path.basename(value)
+            or value in (".", "..")
+            or "/" in value
+            or "\\" in value
+        ):
+            raise CSIError(f"invalid {what} {value!r}")
+        return value
+
+    def create_snapshot(self, external_id: str, name: str,
+                        params: dict) -> dict:
+        self._safe_component(external_id, "volume id")
+        if name:
+            self._safe_component(name, "snapshot name")
+        src = os.path.join(self.backing_dir, external_id)
+        if not os.path.isdir(src):
+            raise CSIError(f"volume {external_id!r} not found")
+        snap_id = f"snap-{name or external_id}-{int(time.time_ns())}"
+        dst = os.path.join(self._snap_dir(), snap_id)
+        shutil.copytree(src, dst)
+        meta = {
+            "snapshot_id": snap_id,
+            "source_external_id": external_id,
+            "size_mb": sum(
+                os.path.getsize(os.path.join(r, f))
+                for r, _, fs in os.walk(dst)
+                for f in fs
+            ) // (1024 * 1024),
+            "create_time_ns": time.time_ns(),
+            "ready": True,
+        }
+        # metadata rides BESIDE the copy, never inside it — the snapshot
+        # must stay a faithful point-in-time image of the volume
+        with open(os.path.join(self._snap_dir(),
+                               f"{snap_id}.meta.json"), "w") as f:
+            json.dump(meta, f)
+        return meta
+
+    def delete_snapshot(self, snapshot_id: str) -> None:
+        self._safe_component(snapshot_id, "snapshot id")
+        path = os.path.join(self._snap_dir(), snapshot_id)
+        if not os.path.isdir(path):
+            raise CSIError(f"snapshot {snapshot_id!r} not found")
+        shutil.rmtree(path)
+        meta = os.path.join(self._snap_dir(), f"{snapshot_id}.meta.json")
+        if os.path.exists(meta):
+            os.unlink(meta)
+
+    def list_snapshots(self) -> list[dict]:
+        out = []
+        snap_root = self._snap_dir()
+        for snap_id in sorted(os.listdir(snap_root)):
+            if not os.path.isdir(os.path.join(snap_root, snap_id)):
+                continue  # sibling .meta.json files
+            try:
+                with open(os.path.join(
+                    snap_root, f"{snap_id}.meta.json"
+                )) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                # missing/corrupt metadata must not break listing the
+                # rest; the snapshot itself is still intact
+                out.append({"snapshot_id": snap_id, "ready": True})
+        return out
+
     def node_get_info(self):
         return {"node_id": f"fake-{os.uname().nodename}"}
 
@@ -272,6 +366,18 @@ class _CSIEndpoint:
 
     def delete_volume(self, args):
         self.plugin.delete_volume(args["external_id"])
+
+    def create_snapshot(self, args):
+        return self.plugin.create_snapshot(
+            args["external_id"], args.get("name", ""),
+            args.get("params") or {},
+        )
+
+    def delete_snapshot(self, args):
+        self.plugin.delete_snapshot(args["snapshot_id"])
+
+    def list_snapshots(self, args):
+        return self.plugin.list_snapshots()
 
     def _ctx(self, args) -> StageContext:
         return StageContext(**args["ctx"])
@@ -398,6 +504,17 @@ class ExternalCSIPlugin(CSIPlugin):
 
     def delete_volume(self, external_id):
         self._call("CSI.delete_volume", {"external_id": external_id})
+
+    def create_snapshot(self, external_id, name, params):
+        return self._call("CSI.create_snapshot", {
+            "external_id": external_id, "name": name, "params": params,
+        })
+
+    def delete_snapshot(self, snapshot_id):
+        self._call("CSI.delete_snapshot", {"snapshot_id": snapshot_id})
+
+    def list_snapshots(self):
+        return self._call("CSI.list_snapshots")
 
     def _wire_ctx(self, ctx: StageContext) -> dict:
         return {"ctx": {

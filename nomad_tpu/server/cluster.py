@@ -490,6 +490,60 @@ class VolumeEndpoint(_Forwarder):
     def plugins(self, args):
         return self.cs.server.state.csi_plugins()
 
+    def snapshot_create(self, args):
+        """Point-in-time snapshot of a registered CSI volume (reference
+        csi_endpoint.go CreateSnapshot → controller RPC)."""
+
+        def local(a):
+            ns, vol_id = a["namespace"], a["volume_id"]
+            vol = self.cs.server.state.volume_by_id(ns, vol_id)
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            if not vol.plugin_id or not vol.external_id:
+                raise ValueError(
+                    f"volume {vol_id} is not a provisioned CSI volume"
+                )
+            out = self.cs.csi_controller_roundtrip(
+                vol.plugin_id,
+                "CSI.create_snapshot",
+                {
+                    "external_id": vol.external_id,
+                    "name": a.get("name") or vol_id,
+                    "params": dict(vol.context or {}),
+                },
+            )
+            # documented shape only — the roundtrip's transport "ok"
+            # key must not become accidental API contract
+            return {
+                k: out.get(k)
+                for k in (
+                    "snapshot_id", "source_external_id", "size_mb",
+                    "create_time_ns", "ready",
+                )
+            }
+
+        return self._forward("Volume.snapshot_create", args, local)
+
+    def snapshot_delete(self, args):
+        def local(a):
+            self.cs.csi_controller_roundtrip(
+                a["plugin_id"],
+                "CSI.delete_snapshot",
+                {"snapshot_id": a["snapshot_id"]},
+            )
+            return None
+
+        return self._forward("Volume.snapshot_delete", args, local)
+
+    def snapshot_list(self, args):
+        def local(a):
+            out = self.cs.csi_controller_roundtrip(
+                a["plugin_id"], "CSI.list_snapshots", {}
+            )
+            return out.get("snapshots", [])
+
+        return self._forward("Volume.snapshot_list", args, local)
+
 
 class SecretsEndpoint(_Forwarder):
     """Embedded secrets store + task-token derivation (the Vault-analog

@@ -690,14 +690,13 @@ JUSTIFIED_UNPORTED = {
 # group containers whose subcommands are all enterprise are implied:
 JUSTIFIED_PREFIXES = ("quota", "recommendation", "sentinel", "license")
 
-# volume snapshots: external CSI snapshot RPCs; the native CSI manager
-# implements attach/claim lifecycles, snapshots are listed unported
-for _cmd in ("volume detach", "volume snapshot create",
-             "volume snapshot delete", "volume snapshot list"):
-    JUSTIFIED_UNPORTED[_cmd] = (
-        "CSI external snapshot/detach RPCs; the native volume manager "
-        "covers claim/attach lifecycles, snapshot RPCs not yet"
-    )
+# volume detach: the one remaining CSI controller RPC — claims release
+# through plan apply / volume watcher here, so a manual detach verb has
+# no claim to operate on
+JUSTIFIED_UNPORTED["volume detach"] = (
+    "manual controller detach; claims attach/release through plan "
+    "apply and the volume watcher in this design, snapshots ARE ported"
+)
 
 
 def _our_commands() -> set:

@@ -360,3 +360,84 @@ def test_volume_create_and_delete_via_controller(tmp_path):
         if client is not None:
             client.shutdown()
         cs.shutdown()
+
+
+def test_volume_snapshot_lifecycle_via_controller(tmp_path):
+    """`volume snapshot create/list/delete` route to a controller-bearing
+    client's plugin (reference csi_endpoint.go CreateSnapshot/
+    ListSnapshots/DeleteSnapshot): the snapshot is a real point-in-time
+    copy of the volume's contents."""
+    from nomad_tpu.client import Client
+    from nomad_tpu.server.cluster import ClusterRPC, ClusterServer
+    from nomad_tpu.structs.node_class import compute_node_class
+
+    cs = ClusterServer("s1", port=0, num_workers=1, bootstrap_expect=1)
+    cs.start()
+    client = None
+    try:
+        assert wait_until(lambda: cs.is_leader(), 10)
+        client = Client(
+            ClusterRPC([cs.rpc.addr]), data_dir=str(tmp_path / "c0")
+        )
+        backing = tmp_path / "backing"
+        client.csi_manager.register(
+            "hostpath", FakeCSIPlugin(backing_dir=str(backing))
+        )
+        client._fingerprint_csi()
+        client.node.computed_class = compute_node_class(client.node)
+        client.start()
+        assert client.wait_registered(10)
+
+        vol = _csi_vol(vol_id="snappy", plugin="hostpath", name="snappy")
+        vol.external_id = ""
+        cs.rpc_self("Volume.create", {"volume": vol})
+        (backing / "vol-snappy" / "data.txt").write_text("precious")
+
+        snap = cs.rpc_self(
+            "Volume.snapshot_create",
+            {"namespace": "default", "volume_id": "snappy", "name": "s1"},
+        )
+        assert snap["snapshot_id"].startswith("snap-s1-")
+        assert snap["source_external_id"] == "vol-snappy"
+        assert snap["ready"] is True
+        copied = (
+            backing / "_snapshots" / snap["snapshot_id"] / "data.txt"
+        )
+        assert copied.read_text() == "precious", "point-in-time copy"
+
+        # the copy is independent of later volume writes
+        (backing / "vol-snappy" / "data.txt").write_text("mutated")
+        assert copied.read_text() == "precious"
+
+        listed = cs.rpc_self(
+            "Volume.snapshot_list", {"plugin_id": "hostpath"}
+        )
+        assert [s["snapshot_id"] for s in listed] == [snap["snapshot_id"]]
+
+        cs.rpc_self(
+            "Volume.snapshot_delete",
+            {
+                "plugin_id": "hostpath",
+                "snapshot_id": snap["snapshot_id"],
+            },
+        )
+        assert (
+            cs.rpc_self(
+                "Volume.snapshot_list", {"plugin_id": "hostpath"}
+            )
+            == []
+        )
+        # snapshotting an unprovisioned volume errors cleanly
+        import pytest as _pytest
+
+        from nomad_tpu.rpc import RPCError
+
+        with _pytest.raises((RPCError, ValueError, KeyError)):
+            cs.rpc_self(
+                "Volume.snapshot_create",
+                {"namespace": "default", "volume_id": "ghost"},
+            )
+    finally:
+        if client is not None:
+            client.shutdown()
+        cs.shutdown()
