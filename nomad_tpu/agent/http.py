@@ -209,7 +209,10 @@ class HTTPAgentServer:
         msg = str(e)
         if "KeyError" in msg or "not found" in msg:
             return HTTPError(404, msg)
-        if "ValueError" in msg or "invalid" in msg:
+        if "ValueError" in msg or "CSIError: invalid" in msg:
+            # CSIError's own "invalid <thing>" rejections are client
+            # errors; a bare "invalid" substring must NOT match (ids may
+            # contain the word while the fault is server-side)
             return HTTPError(400, msg)
         return None
 
@@ -834,6 +837,30 @@ class HTTPAgentServer:
 
         route("PUT", "/v1/volumes/create", volume_create)
         route("POST", "/v1/volumes/create", volume_create)
+        def volume_detach(p, q, body, tok):
+            ns = q.get("namespace", ["default"])[0]
+            self._ns_guard(tok, ns, "submit-job")
+            node_id = q.get("node", [""])[0]
+            if not node_id:
+                raise HTTPError(400, "node required")
+            try:
+                return self.rpc_region(
+                    "Volume.detach",
+                    {
+                        "namespace": ns,
+                        "volume_id": p["id"],
+                        "node_id": node_id,
+                    },
+                )
+            except Exception as e:
+                mapped = self._map_forward_error(e)
+                if mapped is None:
+                    raise
+                raise mapped
+
+        route(
+            "DELETE", "/v1/volume/(?P<id>[^/]+)/detach", volume_detach
+        )
         route("PUT", "/v1/volumes/snapshot", volume_snapshot_create)
         route("POST", "/v1/volumes/snapshot", volume_snapshot_create)
         route("DELETE", "/v1/volumes/snapshot", volume_snapshot_delete)

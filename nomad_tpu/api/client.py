@@ -563,6 +563,18 @@ class Volumes(_Resource):
             params={"namespace": namespace or self.c.namespace},
         )
 
+    def detach(self, volume_id: str, node_id: str,
+               namespace: Optional[str] = None):
+        """Release a node's claims + controller-unpublish (reference
+        api/csi.go Detach)."""
+        return self.c.delete(
+            f"/v1/volume/{volume_id}/detach",
+            params={
+                "node": node_id,
+                "namespace": namespace or self.c.namespace,
+            },
+        )
+
     def snapshot_create(self, volume_id: str, name: str = "",
                         namespace: Optional[str] = None):
         """Point-in-time snapshot via the CSI controller (reference

@@ -1279,9 +1279,23 @@ def cmd_volume_delete(args) -> int:
     return 0
 
 
+def cmd_volume_detach(args) -> int:
+    api = _client(args)
+    out = api.volumes.detach(
+        args.volume_id, args.node_id, namespace=args.namespace
+    )
+    print(
+        f"Volume {args.volume_id} detached from {args.node_id} "
+        f"({out['released_claims']} claims released)"
+    )
+    return 0
+
+
 def cmd_volume_snapshot_create(args) -> int:
     api = _client(args)
-    out = api.volumes.snapshot_create(args.volume_id, name=args.name or "")
+    out = api.volumes.snapshot_create(
+        args.volume_id, name=args.name or "", namespace=args.namespace
+    )
     print(f"Snapshot ID  = {out.get('snapshot_id')}")
     print(f"Volume ID    = {args.volume_id}")
     print(f"Size (MB)    = {out.get('size_mb')}")
@@ -2338,11 +2352,17 @@ def build_parser() -> argparse.ArgumentParser:
     vinit = volsub.add_parser("init")
     vinit.add_argument("filename", nargs="?")
     vinit.set_defaults(fn=cmd_volume_init)
+    vdet = volsub.add_parser("detach")
+    vdet.add_argument("volume_id")
+    vdet.add_argument("node_id")
+    vdet.add_argument("-namespace", default="default")
+    vdet.set_defaults(fn=cmd_volume_detach)
     vsnap = volsub.add_parser("snapshot")
     vsnapsub = vsnap.add_subparsers(dest="subsubcmd")
     vsc = vsnapsub.add_parser("create")
     vsc.add_argument("volume_id")
     vsc.add_argument("name", nargs="?")
+    vsc.add_argument("-namespace", default="default")
     vsc.set_defaults(fn=cmd_volume_snapshot_create)
     vsd = vsnapsub.add_parser("delete")
     vsd.add_argument("plugin_id")

@@ -59,6 +59,9 @@ class ClientEndpoints:
         self.rpc.register_stream(
             "CSI.list_snapshots", self._csi_list_snapshots
         )
+        self.rpc.register_stream(
+            "CSI.controller_unpublish", self._csi_controller_unpublish
+        )
 
     @property
     def addr(self) -> tuple[str, int]:
@@ -103,6 +106,20 @@ class ClientEndpoints:
             return
         try:
             plugin.delete_volume(header.get("external_id", ""))
+            session.send({"ok": True})
+        except Exception as e:
+            session.send({"error": f"{type(e).__name__}: {e}"})
+
+    def _csi_controller_unpublish(self, session, header) -> None:
+        plugin = self._csi_plugin(session, header)
+        if plugin is None:
+            return
+        try:
+            plugin.controller_unpublish(
+                header.get("volume_id", ""),
+                header.get("external_id", ""),
+                header.get("node_id", ""),
+            )
             session.send({"ok": True})
         except Exception as e:
             session.send({"error": f"{type(e).__name__}: {e}"})

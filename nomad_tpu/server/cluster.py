@@ -490,6 +490,49 @@ class VolumeEndpoint(_Forwarder):
     def plugins(self, args):
         return self.cs.server.state.csi_plugins()
 
+    def detach(self, args):
+        """Operator escape hatch for a wedged attachment (reference
+        csi_endpoint.go Unpublish / `nomad volume detach`): release the
+        volume's claims held by allocs on one node and tell the
+        controller plugin to unpublish it there."""
+
+        def local(a):
+            ns, vol_id, node_id = (
+                a["namespace"], a["volume_id"], a["node_id"]
+            )
+            vol = self.cs.server.state.volume_by_id(ns, vol_id)
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            alloc_ids = [
+                c.alloc_id
+                for c in vol.claims.values()
+                if c.node_id == node_id
+            ]
+            if alloc_ids:
+                # scoped: these allocs may hold legitimate claims on
+                # OTHER volumes — only this volume's claims release
+                self.cs.server.raft_apply(
+                    "volume_claim_release",
+                    {
+                        "namespace": ns,
+                        "volume_id": vol_id,
+                        "alloc_ids": alloc_ids,
+                    },
+                )
+            if vol.plugin_id and vol.external_id:
+                self.cs.csi_controller_roundtrip(
+                    vol.plugin_id,
+                    "CSI.controller_unpublish",
+                    {
+                        "volume_id": vol.id,
+                        "external_id": vol.external_id,
+                        "node_id": node_id,
+                    },
+                )
+            return {"released_claims": len(alloc_ids)}
+
+        return self._forward("Volume.detach", args, local)
+
     def snapshot_create(self, args):
         """Point-in-time snapshot of a registered CSI volume (reference
         csi_endpoint.go CreateSnapshot → controller RPC)."""

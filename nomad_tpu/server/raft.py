@@ -240,7 +240,18 @@ class FSM:
             self.on_eval_update([ev])
 
     def _apply_volume_release(self, index: int, payload) -> None:
-        released = self.state.release_volume_claims(index, list(payload))
+        if isinstance(payload, dict):
+            # scoped form (volume detach): one volume only
+            released = self.state.release_volume_claims_scoped(
+                index,
+                payload["namespace"],
+                payload["volume_id"],
+                list(payload["alloc_ids"]),
+            )
+        else:
+            released = self.state.release_volume_claims(
+                index, list(payload)
+            )
         if released and self.on_volume_release:
             # A freed claim can make a blocked single-writer job feasible
             # again; the leader re-runs blocked evals.

@@ -1762,6 +1762,35 @@ class StateStore(_ReadMixin):
                 )
             return len(gone)
 
+    def release_volume_claims_scoped(
+        self, index: int, namespace: str, vol_id: str,
+        alloc_ids: list[str],
+    ) -> int:
+        """Drop the given allocs' claims on ONE volume (the detach
+        escape hatch — releasing them everywhere would free claims the
+        same allocs legitimately hold on other volumes)."""
+        drop = set(alloc_ids)
+        released = 0
+        with self._lock:
+            t = self._wtable(TABLE_VOLUMES)
+            vol = t.get((namespace, vol_id))
+            if vol is None:
+                return 0
+            hits = drop & vol.claims.keys()
+            if not hits:
+                return 0
+            vol = vol.copy()
+            for aid in hits:
+                del vol.claims[aid]
+                released += 1
+            vol.modify_index = index
+            t[(namespace, vol_id)] = vol
+            self._stamp(index, TABLE_VOLUMES)
+            self._publish(
+                index, TABLE_VOLUMES, [vol], "VolumeClaimReleased"
+            )
+        return released
+
     def release_volume_claims(self, index: int, alloc_ids: list[str]) -> int:
         """Drop the given allocs' claims everywhere; returns how many
         claims were released (the volume watcher's write)."""

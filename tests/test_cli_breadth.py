@@ -690,13 +690,7 @@ JUSTIFIED_UNPORTED = {
 # group containers whose subcommands are all enterprise are implied:
 JUSTIFIED_PREFIXES = ("quota", "recommendation", "sentinel", "license")
 
-# volume detach: the one remaining CSI controller RPC — claims release
-# through plan apply / volume watcher here, so a manual detach verb has
-# no claim to operate on
-JUSTIFIED_UNPORTED["volume detach"] = (
-    "manual controller detach; claims attach/release through plan "
-    "apply and the volume watcher in this design, snapshots ARE ported"
-)
+
 
 
 def _our_commands() -> set:

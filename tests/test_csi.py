@@ -441,3 +441,82 @@ def test_volume_snapshot_lifecycle_via_controller(tmp_path):
         if client is not None:
             client.shutdown()
         cs.shutdown()
+
+
+def test_volume_detach_releases_claims_and_unpublishes(tmp_path):
+    """`volume detach <vol> <node>` drops the node's claims and runs
+    controller-unpublish (reference csi_endpoint.go Unpublish)."""
+    from nomad_tpu.client import Client
+    from nomad_tpu.server.cluster import ClusterRPC, ClusterServer
+    from nomad_tpu.structs.node_class import compute_node_class
+    from nomad_tpu.structs.structs import VolumeClaim
+
+    cs = ClusterServer("s1", port=0, num_workers=1, bootstrap_expect=1)
+    cs.start()
+    client = None
+    try:
+        assert wait_until(lambda: cs.is_leader(), 10)
+        client = Client(
+            ClusterRPC([cs.rpc.addr]), data_dir=str(tmp_path / "c0")
+        )
+        backing = tmp_path / "backing"
+        fake = FakeCSIPlugin(backing_dir=str(backing))
+        client.csi_manager.register("hostpath", fake)
+        client._fingerprint_csi()
+        client.node.computed_class = compute_node_class(client.node)
+        client.start()
+        assert client.wait_registered(10)
+
+        vol = _csi_vol(vol_id="stuck", plugin="hostpath", name="stuck")
+        vol.external_id = ""
+        cs.rpc_self("Volume.create", {"volume": vol})
+        # simulate a wedged attachment: claims + plugin-side attach
+        # state (upsert_volume deliberately preserves existing claims,
+        # so wedge the table directly like the claim txn would)
+        state = cs.server.state
+        stored = state.volume_by_id("default", "stuck")
+        wedged = stored.copy()
+        wedged.claims["alloc-1"] = VolumeClaim(
+            alloc_id="alloc-1", node_id="node-A"
+        )
+        wedged.claims["alloc-2"] = VolumeClaim(
+            alloc_id="alloc-2", node_id="node-B"
+        )
+        with state._lock:
+            state._wtable("volumes")[("default", "stuck")] = wedged
+        fake.attached["vol-stuck"] = {"node-A", "node-B"}
+
+        # the SAME alloc also holds a claim on another volume — detach
+        # must be scoped to the named volume, not sweep the alloc's
+        # claims everywhere
+        vol2 = _csi_vol(vol_id="other", plugin="hostpath", name="other")
+        vol2.external_id = ""
+        cs.rpc_self("Volume.create", {"volume": vol2})
+        o = state.volume_by_id("default", "other").copy()
+        o.claims["alloc-1"] = VolumeClaim(
+            alloc_id="alloc-1", node_id="node-A"
+        )
+        with state._lock:
+            state._wtable("volumes")[("default", "other")] = o
+
+        out = cs.rpc_self(
+            "Volume.detach",
+            {
+                "namespace": "default",
+                "volume_id": "stuck",
+                "node_id": "node-A",
+            },
+        )
+        assert out["released_claims"] == 1
+        after = cs.server.state.volume_by_id("default", "stuck")
+        assert set(after.claims) == {"alloc-2"}, "node-B claim survives"
+        assert fake.attached["vol-stuck"] == {"node-B"}, (
+            "controller unpublished node-A only"
+        )
+        assert set(
+            cs.server.state.volume_by_id("default", "other").claims
+        ) == {"alloc-1"}, "alloc-1's claim on the OTHER volume survives"
+    finally:
+        if client is not None:
+            client.shutdown()
+        cs.shutdown()
