@@ -919,6 +919,18 @@ class HTTPAgentServer:
                 if body.get("DrainSpec") is not None
                 else None
             )
+            if isinstance(drain, dict):
+                # raw-JSON clients (the browser UI, curl) send the
+                # reference's plain shape {"Deadline": ns, ...} rather
+                # than a codec-tagged struct — accept both
+                from ..structs import DrainStrategy
+
+                drain = DrainStrategy(
+                    deadline_s=float(drain.get("Deadline", 0)) / 1e9,
+                    ignore_system_jobs=bool(
+                        drain.get("IgnoreSystemJobs", False)
+                    ),
+                )
             self.rpc_region(
                 "Node.update_drain",
                 {

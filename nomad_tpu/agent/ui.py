@@ -96,10 +96,11 @@ button.danger { background: var(--bad); color: #140a0b; }
 "use strict";
 const $ = (s) => document.querySelector(s);
 const NAV = [
-  ["jobs", "Jobs"], ["run", "Run"], ["nodes", "Clients"],
-  ["allocs", "Allocations"], ["evals", "Evaluations"],
-  ["services", "Services"], ["storage", "Storage"],
-  ["topology", "Topology"], ["servers", "Servers"],
+  ["jobs", "Jobs"], ["run", "Run"], ["deployments", "Deployments"],
+  ["nodes", "Clients"], ["allocs", "Allocations"],
+  ["evals", "Evaluations"], ["services", "Services"],
+  ["storage", "Storage"], ["topology", "Topology"],
+  ["servers", "Servers"],
 ];
 $("#nav").innerHTML = NAV.map(([r, t]) =>
   `<a href="#/${r}" data-route="${r}">${t}</a>`).join("");
@@ -238,6 +239,58 @@ const views = {
       <div id="runout" class="dim">no output yet</div>`;
   },
 
+  async deployments() {
+    const deps = await api("/v1/deployments");
+    setTimeout(() => {
+      document.querySelectorAll("[data-dep-act]").forEach((b) => {
+        b.onclick = async () => {
+          const [act, id] = b.dataset.depAct.split("|");
+          try {
+            await api(`/v1/deployment/${act.replace("resume", "pause")
+              }/${id}`, { method: "PUT",
+              body: act === "pause" ? { Pause: true }
+                : act === "resume" ? { Pause: false } : {} });
+          } catch (e) {
+            $("#err").textContent = `${act} failed: ${e.message || e}`;
+            return;
+          }
+          render();
+        };
+      });
+    }, 0);
+    return `<h1>Deployments</h1>` + table(
+      ["ID", "Job", "Status", "Description", "Groups", "Actions"],
+      deps.map((d) => {
+        const groups = Object.entries(d.task_groups || {}).map(
+          ([g, st]) =>
+            `${esc(g)}: ${st.healthy_allocs ?? 0}/${st.desired_total
+            } healthy` + (st.desired_canaries
+              ? ` (${(st.placed_canaries || []).length}/${
+                st.desired_canaries} canaries${st.promoted
+                ? ", promoted" : ""})` : "")
+        ).join("<br>");
+        const active = ["running", "paused", "pending"].includes(
+          d.status);
+        const pauseAct = d.status === "paused"
+          ? `resume|${esc(d.id)}` : `pause|${esc(d.id)}`;
+        const pauseLabel = d.status === "paused" ? "Resume" : "Pause";
+        const acts = active
+          ? `<button data-dep-act="promote|${esc(d.id)}">Promote`
+            + `</button> <button class="alt" data-dep-act=`
+            + `"${pauseAct}">${pauseLabel}</button> <button `
+            + `class="danger" data-dep-act="fail|${esc(d.id)}">`
+            + `Fail</button>`
+          : `<span class="dim">—</span>`;
+        return [
+          short(d.id),
+          `<a href="#/jobs/${esc(d.namespace)}/${esc(d.job_id)}">`
+            + `${esc(d.job_id)}</a>`,
+          pill(d.status), esc(d.status_description || "-"),
+          groups, acts,
+        ];
+      }));
+  },
+
   async nodes() {
     const nodes = await api("/v1/nodes");
     return `<h1>Clients</h1>` + table(
@@ -255,7 +308,41 @@ const views = {
       api(`/v1/node/${id}`), api(`/v1/node/${id}/allocations`),
     ]);
     const res = node.resources || {};
-    let html = `<h1>${esc(node.name)} ${pill(node.status)}</h1>`;
+    setTimeout(() => {
+      const d = $("#ndrain"), e = $("#nelig");
+      if (d) d.onclick = async () => {
+        const enable = !node.drain_strategy;
+        try {
+          await api(`/v1/node/${node.id}/drain`, { method: "PUT",
+            body: { DrainSpec: enable ? { Deadline: 3600e9 } : null } });
+        } catch (err) {
+          $("#err").textContent = `drain failed: ${err.message || err}`;
+          return;
+        }
+        render();
+      };
+      if (e) e.onclick = async () => {
+        const elig = node.scheduling_eligibility === "eligible"
+          ? "ineligible" : "eligible";
+        try {
+          await api(`/v1/node/${node.id}/eligibility`, { method: "PUT",
+            body: { Eligibility: elig } });
+        } catch (err) {
+          $("#err").textContent =
+            `eligibility failed: ${err.message || err}`;
+          return;
+        }
+        render();
+      };
+    }, 0);
+    let html = `<h1>${esc(node.name)} ${pill(node.status)}
+      <span style="float:right">
+        <button class="alt" id="nelig">${
+          node.scheduling_eligibility === "eligible"
+            ? "Mark ineligible" : "Mark eligible"}</button>
+        <button class="danger" id="ndrain">${
+          node.drain_strategy ? "Stop drain" : "Drain"}</button>
+      </span></h1>`;
     html += kv([
       ["ID", esc(node.id)], ["Datacenter", esc(node.datacenter)],
       ["Class", esc(node.node_class || "-")],

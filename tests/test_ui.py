@@ -301,3 +301,54 @@ job "ui-submitted" {
     with pytest.raises(urllib.error.HTTPError) as e:
         post("/v1/jobs/parse", {"JobHCL": "job {{{{"})
     assert e.value.code == 400
+
+
+def test_ui_deployment_and_node_action_contracts(full_agent):
+    """The deployments view and node drain/eligibility buttons ride
+    these exact payload shapes — raw JSON, no codec tagging (the
+    browser can't build $t-tagged structs)."""
+    import urllib.request
+
+    a = full_agent
+    base = f"http://127.0.0.1:{a.http_addr[1]}"
+
+    def req(path, body=None, method="GET"):
+        data = json.dumps(body).encode() if body is not None else None
+        r = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            return json.loads(resp.read() or b"null")
+
+    deps = req("/v1/deployments")
+    assert isinstance(deps, list)
+    node_id = a.client.node.id
+    # drain on with the PLAIN reference shape, then off
+    req(f"/v1/node/{node_id}/drain", {"DrainSpec": {"Deadline": 3600e9}},
+        "PUT")
+    srv = a.server.server
+
+    def drained():
+        n = srv.state.node_by_id(node_id)
+        return n.drain and n.scheduling_eligibility == "ineligible"
+
+    assert wait_until(drained, 10)
+    req(f"/v1/node/{node_id}/drain",
+        {"DrainSpec": None, "MarkEligible": True}, "PUT")
+    assert wait_until(
+        lambda: not srv.state.node_by_id(node_id).drain, 10
+    )
+    # eligibility toggle
+    req(f"/v1/node/{node_id}/eligibility",
+        {"Eligibility": "ineligible"}, "PUT")
+    assert (
+        srv.state.node_by_id(node_id).scheduling_eligibility
+        == "ineligible"
+    )
+    req(f"/v1/node/{node_id}/eligibility",
+        {"Eligibility": "eligible"}, "PUT")
+    assert (
+        srv.state.node_by_id(node_id).scheduling_eligibility
+        == "eligible"
+    )
