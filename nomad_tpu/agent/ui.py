@@ -31,9 +31,19 @@ header .logo { font-weight: 700; color: var(--accent);
   letter-spacing: .5px; }
 nav a { color: var(--dim); text-decoration: none; margin-right: 14px; }
 nav a.active, nav a:hover { color: var(--fg); }
-#token { margin-left: auto; background: var(--bg);
+#token { background: var(--bg);
   border: 1px solid var(--line); color: var(--fg); padding: 4px 8px;
-  border-radius: 4px; width: 220px; }
+  border-radius: 4px; width: 180px; }
+#search { margin-left: auto; background: var(--bg);
+  border: 1px solid var(--line); color: var(--fg); padding: 4px 8px;
+  border-radius: 4px; width: 240px; }
+#searchresults { position: fixed; right: 210px; top: 44px; z-index: 10;
+  background: var(--panel); border: 1px solid var(--line);
+  border-radius: 0 0 6px 6px; max-width: 420px; max-height: 70vh;
+  overflow-y: auto; }
+#searchresults a { display: block; padding: 5px 12px; }
+#searchresults .ctx { color: var(--dim); font-size: 11px;
+  text-transform: uppercase; padding: 5px 12px 0; }
 main { padding: 20px; max-width: 1200px; margin: 0 auto; }
 h1 { font-size: 18px; margin: 0 0 14px; }
 h2 { font-size: 15px; margin: 22px 0 8px; color: var(--dim); }
@@ -86,8 +96,11 @@ button.danger { background: var(--bad); color: #140a0b; }
 <header>
   <span class="logo">nomad-tpu</span>
   <nav id="nav"></nav>
+  <input id="search" placeholder="search (jobs, nodes, allocs…)"
+    title="prefix search across the cluster">
   <input id="token" placeholder="ACL token" title="X-Nomad-Token">
 </header>
+<div id="searchresults"></div>
 <main>
   <div id="err"></div>
   <div id="view">loading…</div>
@@ -104,6 +117,66 @@ const NAV = [
 ];
 $("#nav").innerHTML = NAV.map(([r, t]) =>
   `<a href="#/${r}" data-route="${r}">${t}</a>`).join("");
+const searchInput = $("#search");
+const searchBox = $("#searchresults");
+let searchTimer = null;
+let searchGen = 0;
+searchInput.addEventListener("input", () => {
+  clearTimeout(searchTimer);
+  const prefix = searchInput.value.trim();
+  const gen = ++searchGen;  // invalidates any in-flight response
+  if (!prefix) { searchBox.innerHTML = ""; return; }
+  searchTimer = setTimeout(async () => {
+    let out;
+    try {
+      // namespace-scoped like the reference UI's search (matches carry
+      // no namespace, so cross-namespace hits couldn't be routed);
+      // list pages remain the cross-namespace view
+      out = await api("/v1/search", {
+        method: "POST", body: { Prefix: prefix, Context: "all" } });
+    } catch (_) { return; }
+    // a newer keystroke (or a clear) won while this was in flight
+    if (gen !== searchGen) return;
+    const routeOf = {
+      jobs: (id) => `#/jobs/default/${id}`,
+      nodes: (id) => `#/nodes/${id}`,
+      allocs: (id) => `#/allocs/${id}`,
+      deployments: () => `#/deployments`,
+      evals: () => `#/evals`,
+      volumes: () => `#/storage`,
+      namespaces: null,  // list-only context, no page to land on
+    };
+    let html = "";
+    for (const [ctx, ids] of Object.entries(out.Matches || {})) {
+      if (!ids || !ids.length) continue;
+      html += `<div class="ctx">${esc(ctx)}</div>`;
+      for (const id of ids.slice(0, 8)) {
+        const fn = routeOf[ctx];
+        html += fn
+          ? `<a href="${fn(encodeURIComponent(id))}">${esc(id)}</a>`
+          : `<span class="dim" style="display:block;padding:5px 12px">`
+            + `${esc(id)}</span>`;
+      }
+    }
+    searchBox.innerHTML = html;
+  }, 200);
+});
+function clearSearch() {
+  searchGen++;
+  searchBox.innerHTML = "";
+}
+searchBox.addEventListener("click", () => {
+  clearSearch(); searchInput.value = "";
+});
+// dismiss on navigation, Escape, or clicking anywhere else
+window.addEventListener("hashchange", clearSearch);
+searchInput.addEventListener("keydown", (ev) => {
+  if (ev.key === "Escape") { searchInput.value = ""; clearSearch(); }
+});
+document.addEventListener("click", (ev) => {
+  if (ev.target !== searchInput && !searchBox.contains(ev.target))
+    clearSearch();
+});
 const tokenInput = $("#token");
 tokenInput.value = localStorage.getItem("nomad_token") || "";
 tokenInput.addEventListener("change", () => {
