@@ -170,6 +170,8 @@ def solver_breakdown() -> dict:
         ("nomad.tpu.host_prep_seconds", "host_prep_s"),
         ("nomad.tpu.device_seconds", "device_s"),
         ("nomad.tpu.readback_seconds", "readback_s"),
+        ("nomad.tpu.materialize_seconds", "materialize_s"),
+        ("nomad.tpu.commit_seconds", "commit_s"),
     ):
         v = s.get(key)
         if v is not None:
@@ -515,13 +517,18 @@ def native_baseline(n_nodes, n_evals, count, constrained) -> dict | None:
 def run_plan_apply_config():
     """Applier-side throughput at c2m scale (VERDICT r3 next-round #2).
 
-    Solver-produced plans flow plan queue → pipelined applier
-    (vectorized verify → raft apply → FSM commit, including the codec
-    round-trip a replicated log pays). Reports queue→applied evals/s and
-    its ratio to the solver-internal rate; the done-criterion is the
-    applier keeping within 2x of the solver so verification is never the
+    Solver-produced plans flow plan queue → batched applier (one
+    enqueue_batch item: per-node conflict partition → merged verify →
+    ONE raft apply with a bulk store transaction; conflicting plans fall
+    back serial — plan_apply.py). Reports queue→applied evals/s and its
+    ratio to the solver-internal rate; the done-criterion is the applier
+    keeping within 2x of the solver so verification is never the
     pipeline's bottleneck (reference overlaps these the thread way,
-    plan_apply.go:54-63 + plan_apply_pool.go:18)."""
+    plan_apply.go:54-63 + plan_apply_pool.go:18).
+
+    Bench hygiene (r5 verdict: the gate margin sat inside load noise):
+    one un-measured warmup round, then median-of-5 with spread, gate
+    evaluated on the median."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.tpu import solve_eval_batch
     from nomad_tpu.server.plan_apply import PlanApplier
@@ -529,10 +536,15 @@ def run_plan_apply_config():
     from nomad_tpu.server.raft import FSM, InmemLog
 
     n_nodes, n_jobs, count = SERVICE_CONFIGS["c2m"][:3]
-    log(f"[plan_apply] {n_nodes} nodes, {n_jobs} plans x {count} allocs")
-    solve_rates, apply_rates = [], []
+    trials = max(1, int(os.environ.get("BENCH_PLAN_APPLY_TRIALS", "5")))
+    log(
+        f"[plan_apply] {n_nodes} nodes, {n_jobs} plans x {count} allocs, "
+        f"warmup + {trials} trials"
+    )
+    solve_rates, apply_rates, merged_counts = [], [], []
+    apply_dts = []
     h = jobs = plans = results = None
-    for _ in range(3):
+    for trial in range(trials + 1):  # trial 0 is the warmup round
         h = jobs = plans = results = None
         gc.collect()
         h, jobs = build_cluster(n_nodes, n_jobs, count, constrained=True)
@@ -552,33 +564,199 @@ def run_plan_apply_config():
         )
         applier.start()
         t0 = time.perf_counter()
-        futs = [queue.enqueue(plans[ev.id]) for ev in evals]
+        futs = queue.enqueue_batch([plans[ev.id] for ev in evals])
         results = [f.result(timeout=300) for f in futs]
         apply_dt = time.perf_counter() - t0
         applier.stop()
         queue.set_enabled(False)
+        if trial == 0:
+            continue  # warmup: jit, codec, allocator pools all hot now
         solve_rates.append(len(evals) / solve_dt)
         apply_rates.append(len(evals) / apply_dt)
+        apply_dts.append(apply_dt)
+        from nomad_tpu import metrics as _metrics
+
+        s = _metrics.snapshot()["samples"].get(
+            "nomad.plan_apply.batch_merged"
+        )
+        merged_counts.append(int(s["last"]) if s else 0)
     applied = sum(
         len(v) for r in results for v in r.node_allocation.values()
     )
     apply_rate = median(apply_rates)
     solve_rate = median(solve_rates)
     ratio = apply_rate / solve_rate
+    breakdown = solver_breakdown()
+    # the queue->applied wall time of one whole batch IS the commit
+    # stage here (the worker records nomad.tpu.commit_seconds live)
+    breakdown["commit_s"] = round(median(apply_dts), 4)
     log(
         f"[plan_apply] solve median {solve_rate:.2f} evals/s, apply "
-        f"median {apply_rate:.2f} evals/s over 3 runs (spread "
-        f"{spread_pct(apply_rates)}%, {applied} allocs committed/run), "
-        f"apply/solve {ratio:.2f} (pass={ratio >= 0.5})"
+        f"median {apply_rate:.2f} evals/s over {trials} runs (spread "
+        f"{spread_pct(apply_rates)}%, {applied} allocs committed/run, "
+        f"{merged_counts} plans merged/batch), apply/solve {ratio:.2f} "
+        f"on medians (pass={ratio >= 0.5}); breakdown {breakdown}"
     )
     return {
         "apply_evals_per_s": round(apply_rate, 2),
         "apply_evals_per_s_runs": [round(r, 2) for r in apply_rates],
         "apply_spread_pct": spread_pct(apply_rates),
         "solve_evals_per_s": round(solve_rate, 2),
+        "solve_evals_per_s_runs": [round(r, 2) for r in solve_rates],
         "apply_vs_solve": round(ratio, 3),
         "allocs_committed": applied,
+        "plans_merged_per_batch": merged_counts,
+        "stage_breakdown": breakdown,
         "within_2x_of_solver": ratio >= 0.5,
+    }
+
+
+def run_pipeline_config():
+    """Solve/commit overlap proof (round-6 tentpole acceptance): with a
+    simulated 0.15s device round-trip injected into every dense solve
+    (SchedulerConfig.inject_device_latency_s — the RTT measured through
+    the axon tunnel in r4/r5), the two-stage TPUBatchWorker must beat
+    the non-overlapped solve-then-commit loop on the same workload by
+    >= 1.5x. This is the evidence VERDICT r5 item #2 called testable
+    without the chip: batch N+1's dequeue/lower/device dispatch runs
+    while batch N's plans materialize and commit."""
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.server.eval_broker import EvalBroker
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+    from nomad_tpu.server.raft import FSM, InmemLog
+    from nomad_tpu.server.worker import TPUBatchWorker
+
+    n_nodes = int(os.environ.get("BENCH_PIPE_NODES", "2000"))
+    # 64 jobs x 300 allocs = 60% fill of the 2k-node cluster across 8
+    # batches — enough batches that pipeline fill/drain doesn't
+    # dominate, and per-batch host work comparable to the injected RTT
+    # so the overlap (not the GIL floor) is what's measured
+    n_jobs = int(os.environ.get("BENCH_PIPE_JOBS", "64"))
+    count = int(os.environ.get("BENCH_PIPE_COUNT", "300"))
+    batch_size = int(os.environ.get("BENCH_PIPE_BATCH", "8"))
+    latency = float(os.environ.get("BENCH_INJECT_LATENCY_S", "0.15"))
+    log(
+        f"[pipeline] {n_nodes} nodes, {n_jobs} jobs x {count} allocs, "
+        f"batches of {batch_size}, injected device RTT {latency}s"
+    )
+
+    class _MiniServer:
+        """Just enough server for the worker: broker + queue + applier +
+        raft-backed state (the real Server wires identically)."""
+
+        def __init__(self, state):
+            self.state = state
+            self.fsm = FSM(state)
+            self.log = InmemLog(self.fsm, start_index=state.latest_index())
+            self.eval_broker = EvalBroker()
+            self.eval_broker.set_enabled(True)
+            self.plan_queue = PlanQueue()
+            self.plan_queue.set_enabled(True)
+            self.plan_applier = PlanApplier(
+                self.plan_queue, state, self.raft_apply, self.raft_apply_async
+            )
+            self.plan_applier.start()
+            # partial-commit retry evals must re-enqueue (the real
+            # Server's FSM side channel) or the pipelined mode could
+            # silently drop conflicted work and look faster than it is
+            self.fsm.on_eval_update = self._on_eval_update
+
+        def _on_eval_update(self, evals):
+            for ev in evals:
+                if ev.should_enqueue():
+                    self.eval_broker.enqueue(ev)
+
+        def raft_apply(self, msg_type, payload):
+            return self.log.apply(msg_type, payload)
+
+        def raft_apply_async(self, msg_type, payload):
+            return self.log.apply_async(msg_type, payload)
+
+        def shutdown(self):
+            self.plan_applier.stop()
+            self.plan_queue.set_enabled(False)
+            self.eval_broker.set_enabled(False)
+
+    def run_once(pipeline: bool) -> float:
+        gc.collect()
+        h, jobs = build_cluster(n_nodes, n_jobs, count, False)
+        cfg = SchedulerConfig(
+            backend="tpu", inject_device_latency_s=latency
+        )
+        # warm the jit cache at the per-batch shapes, un-measured
+        warm_cfg = SchedulerConfig(backend="tpu")
+        solve_eval_batch(
+            h.snapshot(), h,
+            [mock.eval_for_job(j) for j in jobs[:batch_size]], warm_cfg,
+        )
+        srv = _MiniServer(h.state)
+        worker = TPUBatchWorker(
+            srv, batch_size=batch_size, config=cfg, pipeline=pipeline
+        )
+        for job in jobs:
+            srv.eval_broker.enqueue(mock.eval_for_job(job))
+
+        def all_placed():
+            # end-to-end completion: every job's allocs COMMITTED, not
+            # just evals acked — retries (if any) are paid, not dropped
+            for job in jobs:
+                live = sum(
+                    1
+                    for a in h.state.allocs_by_job(job.namespace, job.id)
+                    if not a.terminal_status()
+                )
+                if live < count:
+                    return False
+            return True
+
+        t0 = time.perf_counter()
+        worker.start()
+        deadline = t0 + 600
+        # coarse poll: all_placed() walks every job's allocs under the
+        # GIL, so a tight poll steals cycles from the very overlap being
+        # measured
+        while not all_placed() and time.perf_counter() < deadline:
+            time.sleep(0.05)
+        dt = time.perf_counter() - t0
+        done = all_placed()
+        worker.stop()
+        srv.shutdown()
+        if not done:
+            log(f"[pipeline] WARNING: workload incomplete after {dt:.0f}s")
+            incomplete[0] += 1
+        return n_jobs / dt
+
+    incomplete = [0]
+    piped, serial = [], []
+    for _ in range(3):
+        piped.append(run_once(pipeline=True))
+        serial.append(run_once(pipeline=False))
+    piped_rate, serial_rate = median(piped), median(serial)
+    ratio = piped_rate / max(serial_rate, 1e-9)
+    # an incomplete run means a deadline-floored rate somewhere in the
+    # ratio — the gate must not pass on a run where placement silently
+    # failed (in either mode: a hung comparator inflates the ratio)
+    ok = ratio >= 1.5 and incomplete[0] == 0
+    log(
+        f"[pipeline] pipelined {piped_rate:.2f} evals/s (spread "
+        f"{spread_pct(piped)}%) vs non-overlapped {serial_rate:.2f} "
+        f"(spread {spread_pct(serial)}%) -> overlap ratio {ratio:.2f} "
+        f"(pass={ok})"
+    )
+    return {
+        "pipelined_evals_per_s": round(piped_rate, 2),
+        "pipelined_runs": [round(r, 2) for r in piped],
+        "pipelined_spread_pct": spread_pct(piped),
+        "non_overlapped_evals_per_s": round(serial_rate, 2),
+        "non_overlapped_runs": [round(r, 2) for r in serial],
+        "non_overlapped_spread_pct": spread_pct(serial),
+        "injected_device_latency_s": latency,
+        "incomplete_runs": incomplete[0],
+        "overlap_ratio": round(ratio, 3),
+        "overlap_ge_1_5x": ok,
     }
 
 
@@ -634,7 +812,7 @@ def main():
     device = _ensure_device()
     sel = os.environ.get("BENCH_CONFIG", "all")
     names = (
-        ["smoke", "c1k", "c2m", "preempt", "drain", "plan_apply"]
+        ["smoke", "c1k", "c2m", "preempt", "drain", "plan_apply", "pipeline"]
         if sel == "all"
         else [sel]
     )
@@ -651,6 +829,8 @@ def main():
             results[name] = run_drain_config()
         elif name == "plan_apply":
             results[name] = run_plan_apply_config()
+        elif name == "pipeline":
+            results[name] = run_pipeline_config()
         else:
             raise SystemExit(f"unknown BENCH_CONFIG {name}")
 
@@ -665,6 +845,8 @@ def main():
             gates[f"{cname}_density"] = bool(r["density_within_1pct"])
         if "within_2x_of_solver" in r:
             gates[f"{cname}_apply_within_2x"] = bool(r["within_2x_of_solver"])
+        if "overlap_ge_1_5x" in r:
+            gates[f"{cname}_overlap_1_5x"] = bool(r["overlap_ge_1_5x"])
     gates_ok = all(gates.values())
     if not gates_ok:
         log(f"BENCH GATES FAILED: {gates}")

@@ -38,7 +38,10 @@ class SchedulerConfig:
         memory_oversubscription: bool = False,
         backend: str = "host",  # host | tpu — which placement backend to use
         small_batch_threshold: int = 48,
+        inject_device_latency_s: Optional[float] = None,
     ) -> None:
+        import os
+
         self.algorithm = algorithm
         self.preemption_service = preemption_service
         self.preemption_batch = preemption_batch
@@ -51,6 +54,16 @@ class SchedulerConfig:
         # they run the host iterator stack instead (VERDICT r3 #3 —
         # reference per-eval latency: scheduler/generic_sched.go:125).
         self.small_batch_threshold = small_batch_threshold
+        # Simulated device round-trip added to every dense kernel solve
+        # (docs/pipeline.md): on CPU fallback this reproduces the ~0.15s
+        # tunnel RTT the real chip pays, so the worker's solve/commit
+        # overlap is measurable without the hardware. Settable per-config
+        # or via NOMAD_TPU_INJECT_DEVICE_LATENCY_S.
+        if inject_device_latency_s is None:
+            inject_device_latency_s = float(
+                os.environ.get("NOMAD_TPU_INJECT_DEVICE_LATENCY_S", "0") or 0
+            )
+        self.inject_device_latency_s = inject_device_latency_s
 
     def preemption_enabled(self, scheduler_type: str) -> bool:
         return {

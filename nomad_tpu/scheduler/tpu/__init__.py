@@ -6,5 +6,11 @@ from .kernels import (
     solve_placement,
 )
 from .lower import build_node_table, lower_group
-from .scheduler import TPUBatchScheduler, TPUGenericScheduler, solve_eval_batch
-from .solver import BatchSolver, GroupAsk, ResidentClusterState
+from .scheduler import (
+    PendingEvalBatch,
+    TPUBatchScheduler,
+    TPUGenericScheduler,
+    solve_eval_batch,
+    solve_eval_batch_begin,
+)
+from .solver import BatchSolver, GroupAsk, PendingSolve, ResidentClusterState

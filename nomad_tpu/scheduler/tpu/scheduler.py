@@ -198,14 +198,61 @@ def solve_eval_batch(
     guarantees one in-flight eval per job). `resident` — an optional
     ResidentClusterState reused across calls so steady-state solves skip
     the cap/used upload (solver.py)."""
-    with paused_gc():
-        return _solve_eval_batch(
-            state, planner, evals, config, solve_fn, solve_preempt_fn,
-            resident,
-        )
+    return solve_eval_batch_begin(
+        state, planner, evals, config, solve_fn, solve_preempt_fn, resident
+    ).finish()
 
 
-def _solve_eval_batch(
+class PendingEvalBatch:
+    """Two-phase solve_eval_batch: begin() has reconciled every eval and
+    dispatched the device kernel; finish() blocks on the device,
+    materializes Allocations, and assembles the per-eval Plans. The
+    pipelined TPU worker hands this across its solve→commit stage
+    boundary so the device round-trip and plan materialization of batch
+    N overlap batch N+1's reconcile/lower/dispatch."""
+
+    def __init__(self, state, evals, plans, pending, config, solver) -> None:
+        self.state = state
+        self.evals = evals
+        self.plans = plans
+        self._pending = pending
+        self.config = config
+        self._solver = solver
+        self._finished = False
+
+    @property
+    def chain(self):
+        """(node_ids, used' device array) from this batch's solve: the
+        NEXT in-flight batch chains on it to stay conflict-free while
+        this one's commit is still pending (solver.py used_chain). Read
+        live from the solver, not snapshotted at begin(): the
+        spread-relaxation retry in finish() refreshes chain_out with its
+        own placements, and a reference swap is atomic so a concurrent
+        reader sees either consistent tuple."""
+        return self._solver.chain_out
+
+    @property
+    def chain_accepted(self) -> bool:
+        """Did this solve actually consume the used_chain it was given?
+        False when the host path ran, resident tensors won, or the chain
+        was rejected on a node-universe/shape mismatch — in those cases
+        the solve saw only committed state and a failed parent commit
+        does not invalidate it."""
+        return self._solver.chain_accepted
+
+    def finish(self) -> dict[str, Plan]:
+        # Idempotent at THIS layer too: PendingSolve caches its outcome,
+        # but re-running _attach_outcome would append every placement and
+        # preemption to the plans a second time.
+        if not self._finished:
+            outcome = self._pending.finish()
+            with paused_gc():
+                _attach_outcome(self.state, self.evals, self.plans, outcome)
+            self._finished = True
+        return self.plans
+
+
+def solve_eval_batch_begin(
     state,
     planner,
     evals: list[Evaluation],
@@ -213,8 +260,30 @@ def _solve_eval_batch(
     solve_fn=None,
     solve_preempt_fn=None,
     resident=None,
-) -> dict[str, Plan]:
+    used_chain=None,
+) -> PendingEvalBatch:
+    """Phase A of solve_eval_batch: reconcile + lower + async device
+    dispatch. Returns a PendingEvalBatch; call finish() for the plans.
+    used_chain — the previous (still-uncommitted) batch's
+    PendingEvalBatch.chain, so this solve sees its placements."""
     config = config or SchedulerConfig()
+    with paused_gc():
+        plans, asks = _reconcile_eval_batch(state, planner, evals, config)
+        solver = BatchSolver(
+            state, config, solve_fn=solve_fn,
+            solve_preempt_fn=solve_preempt_fn, resident=resident,
+            used_chain=used_chain,
+        )
+        pending = solver.solve_begin(asks)
+    return PendingEvalBatch(state, evals, plans, pending, config, solver)
+
+
+def _reconcile_eval_batch(
+    state,
+    planner,
+    evals: list[Evaluation],
+    config: SchedulerConfig,
+) -> tuple[dict[str, Plan], list[GroupAsk]]:
     plans: dict[str, Plan] = {}
     asks: list[GroupAsk] = []
     deployments: dict[str, object] = {}
@@ -268,12 +337,13 @@ def _solve_eval_batch(
         place_requests.extend(results.place)
         for pjob, tg_name, reqs in _bucket_requests(job, place_requests):
             asks.append(GroupAsk(ev, pjob, tg_name, reqs, plan=plan))
+    return plans, asks
 
-    solver = BatchSolver(
-        state, config, solve_fn=solve_fn, solve_preempt_fn=solve_preempt_fn,
-        resident=resident,
-    )
-    outcome = solver.solve(asks)
+
+def _attach_outcome(
+    state, evals: list[Evaluation], plans: dict[str, Plan], outcome
+) -> None:
+    """Fold a SolveOutcome back into the per-eval plans (phase B)."""
     for ev in evals:
         plan = plans[ev.id]
         job = state.job_by_id(ev.namespace, ev.job_id)
@@ -303,4 +373,3 @@ def _solve_eval_batch(
             if by_id not in outcome.pre_appended:
                 plan.append_preempted_alloc(victim, by_id)
         ev.failed_tg_allocs = outcome.failures.get(ev.id, {})
-    return plans

@@ -19,7 +19,8 @@ rejection and RefreshIndex semantics are untouched.
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
+import time
+from dataclasses import dataclass, field, fields as dataclass_fields
 from typing import Optional
 
 import numpy as np
@@ -221,17 +222,117 @@ def _scatter_rows(used_dev, idx, rows, donate: bool = True):
 _SCATTER_JITS: dict = {}
 
 
+def _scatter_add_rows(used_dev, idx, rows):
+    """Row-scatter-ADD (clamped at zero) onto a non-donated device usage
+    array: applies a batch's vacated-stop deltas on top of a CHAINED
+    used' tensor. A set-scatter of aggregate rows would clobber the
+    chain's in-flight placements; the delta add preserves them."""
+    import jax
+
+    fn = _SCATTER_ADD_JIT.get("fn")
+    if fn is None:
+        import jax.numpy as jnp
+
+        def _scatter_add(used, idx, rows):
+            return jnp.maximum(used.at[idx].add(rows), 0)
+
+        fn = _SCATTER_ADD_JIT["fn"] = jax.jit(_scatter_add)
+    return fn(used_dev, idx, rows)
+
+
+_SCATTER_ADD_JIT: dict = {}
+
+
+_ALLOC_FIELD_NAMES = tuple(f.name for f in dataclass_fields(Allocation))
+
+
+class _MintTemplate:
+    """Interned per-(job, taskgroup) Allocation prototype for the bulk
+    fast-mint path: fresh solver placements within one group differ only
+    in (id, name, node), so cloning the prototype via __new__ + slot
+    copy-and-patch skips the dataclass constructor and its per-alloc
+    default-factory constructions (~4 objects each across 10^5 mints at
+    c2m scale). Shared sub-objects — resources, metrics, the empty
+    containers — ride the state store's copy-on-write discipline: every
+    writer copies an alloc (Allocation.copy deep-copies the mutable
+    fields) before mutating, the same rule the shared AllocatedResources
+    fast-mint has always relied on."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, proto: Allocation) -> None:
+        self.items = [(n, getattr(proto, n)) for n in _ALLOC_FIELD_NAMES]
+
+    def mint(self, uid: str, name: str, node) -> Allocation:
+        a = Allocation.__new__(Allocation)
+        for n, v in self.items:
+            setattr(a, n, v)
+        a.id = uid
+        a.name = name
+        a.node_id = node.id
+        a.node_name = node.name
+        return a
+
+
+class PendingSolve:
+    """An in-flight batch solve between its two phases.
+
+    Phase A (already run): host prep + async device dispatch. finish()
+    runs phase B — block on the device, injected-RTT wait, readback,
+    materialization, spread-relaxation retry — and returns the
+    SolveOutcome. Single-shot; the generator is dropped after finish so
+    a double finish() returns the cached outcome."""
+
+    __slots__ = ("_gen", "_outcome")
+
+    def __init__(self, gen, outcome: Optional[SolveOutcome]) -> None:
+        self._gen = gen
+        self._outcome = outcome
+
+    def finish(self) -> SolveOutcome:
+        if self._gen is None:
+            return self._outcome
+        gen, self._gen = self._gen, None
+        with paused_gc():
+            try:
+                next(gen)
+            except StopIteration as s:
+                self._outcome = s.value
+                return self._outcome
+        raise AssertionError("solver generator yielded more than once")
+
+
 class BatchSolver:
     """Solves placement for a batch of evaluations against one snapshot."""
 
     def __init__(self, state, config: Optional[SchedulerConfig] = None,
                  solve_fn=None, solve_preempt_fn=None,
-                 resident: Optional[ResidentClusterState] = None) -> None:
+                 resident: Optional[ResidentClusterState] = None,
+                 used_chain: Optional[tuple] = None) -> None:
         self.state = state
         self.config = config or SchedulerConfig()
         # Device-resident cap/used tensors shared across solves (the
         # server's TPU worker owns one instance); None = upload per solve.
         self.resident = resident
+        # (node_ids tuple, used_dev) — the PREVIOUS batch's post-solve
+        # usage tensor, still on device. While that batch's commit is in
+        # flight, the committed aggregate hasn't caught up, so a
+        # deterministic binpack would re-place the next batch onto the
+        # same nodes and the applier would reject everything. Chaining
+        # the kernel's own used' output as the next solve's used input
+        # keeps consecutive in-flight batches conflict-free WITHOUT
+        # blocking on the device (a pure device-graph dependency) —
+        # this is what makes the worker's solve/commit overlap pay at
+        # high fill (docs/pipeline.md).
+        self.used_chain = used_chain
+        # set during phase A when the compact path dispatches: the
+        # (node_ids, used' device array) the NEXT batch may chain on
+        self.chain_out: Optional[tuple] = None
+        # did this solve actually CONSUME used_chain? False when the
+        # solve took the host/preempt path, the resident tensors won, or
+        # the chain was rejected on a node-universe/shape mismatch — the
+        # worker's chain-failure cascade only applies when this is True
+        self.chain_accepted = False
         self.ctx = EvalContext(state, None, logger, self.config)
         self.solve_fn = solve_fn or solve_placement
         # Preemption kernel seam: defaults to the single-chip tier kernel
@@ -273,15 +374,34 @@ class BatchSolver:
         # accounting) that this solve must observe.
         self._partition_placed: list = []
         self._partition_plans: list = []
+        # (id(job), tg_name) -> _MintTemplate, shared across a batch's
+        # groups (spread sub-groups and the relaxation retry re-hit it).
+        self._mint_cache: dict[tuple, _MintTemplate] = {}
 
     def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
+        return self.solve_begin(asks).finish()
+
+    def solve_begin(self, asks: list[GroupAsk]) -> "PendingSolve":
+        """Phase A of a two-phase solve: reconcile-independent host prep
+        (node table, lowering, ledgers) plus the ASYNC device dispatch.
+        Returns a PendingSolve whose finish() blocks on the device, reads
+        back, and materializes Allocations — the pipelined worker runs
+        finish() on its commit stage so batch N's readback/materialization
+        overlaps batch N+1's host prep and device round-trip."""
         # One batch is a bounded allocation burst (up to ~100k minted
         # allocs at c2m scale); young-gen GC passes during it cost more
         # than everything they could ever reclaim (gctune.py).
+        gen = self._solve_gen(asks)
         with paused_gc():
-            return self._solve(asks)
+            try:
+                next(gen)
+            except StopIteration as s:
+                # host-only solve (small batch / empty / host partition):
+                # finished without touching the device
+                return PendingSolve(None, s.value)
+        return PendingSolve(gen, None)
 
-    def _solve(self, asks: list[GroupAsk]) -> SolveOutcome:
+    def _solve_gen(self, asks: list[GroupAsk]):
         out = SolveOutcome()
         self._batch_has_cores = any(
             t.resources.cores > 0
@@ -532,13 +652,38 @@ class BatchSolver:
                     ).astype(np.int32)
                     used_dev = _scatter_rows(used_dev, idx, rows, donate=False)
                 dev_state = (cap_dev, used_dev)
-            inst, over, used_out = self._run_compact(
+            elif self.used_chain is not None and usage_of is not None:
+                # Chain the in-flight previous batch's post-solve usage
+                # (device array, never blocked on) so this batch's
+                # waterfill sees its placements and stays conflict-free.
+                chain_ids, chain_used = self.used_chain
+                if (
+                    chain_ids == tuple(node.id for node in nodes)
+                    and chain_used.shape == (pad_n(n), 3)
+                ):
+                    used_dev = chain_used
+                    adj_in = [nid for nid in adj if nid in table.index_of]
+                    if adj_in:
+                        idx = np.asarray(
+                            [table.index_of[nid] for nid in adj_in],
+                            dtype=np.int32,
+                        )
+                        rows = np.clip(
+                            np.asarray(
+                                [adj[nid] for nid in adj_in], dtype=np.int64
+                            ),
+                            -(2**31) + 1,
+                            2**31 - 1,
+                        ).astype(np.int32)
+                        used_dev = _scatter_add_rows(used_dev, idx, rows)
+                    dev_state = (None, used_dev)
+                    self.chain_accepted = True
+            pending = self._run_compact_async(
                 table, groups, used, dev_state=dev_state
             )
-            free_base = table.cap - table.used
-            leftovers = self._materialize_compact(
-                table, groups, inst, over, free_base
-            )
+            # expose this batch's post-solve usage for the NEXT batch's
+            # chain (pending[2] is the kernel's used' device output)
+            self.chain_out = (tuple(node.id for node in nodes), pending[2])
         else:
             # Exact-repair ledger as plain Python ints: it is touched once
             # per PLACED INSTANCE where small-array numpy ops cost ~10x an
@@ -546,11 +691,30 @@ class BatchSolver:
             self._free = [
                 [int(c) for c in row] for row in (table.cap - table.used)
             ]
-            assign, assign_evict, used_out = self._run_kernel(
+            pending = self._run_kernel_async(
                 table, groups, used, tier_limit=tier_limit,
                 use_preempt=use_preempt,
             )
+        # -- phase boundary: the kernel is dispatched, nothing has read
+        # it back. The pipelined worker parks here and resumes on its
+        # commit stage, so the device round-trip (and everything below)
+        # overlaps the NEXT batch's dequeue/reconcile/lower/dispatch.
+        phase_a_ns = now_ns() - t0
+        yield
+        t0 = now_ns()
+        if compact:
+            inst, over, used_out = self._run_compact_finish(pending)
+            free_base = table.cap - table.used
+            t_mat0 = now_ns()
+            leftovers = self._materialize_compact(
+                table, groups, inst, over, free_base
+            )
+            mat_ns = now_ns() - t_mat0
+        else:
+            assign, assign_evict, used_out = self._run_kernel_finish(pending)
+            t_mat0 = now_ns()
             leftovers = self._materialize(table, groups, assign, assign_evict)
+            mat_ns = now_ns() - t_mat0
 
         # Fallback pass: spread is a soft preference — requests a
         # value-restricted sub-group could not place retry against the
@@ -581,15 +745,30 @@ class BatchSolver:
             # preemption pass could double-claim the same victims.
             used2 = np.asarray(used_out)[:n]
             if compact:
-                inst2, over2, _ = self._run_compact(table, retry, used2)
+                inst2, over2, used_retry = self._run_compact(
+                    table, retry, used2
+                )
+                # Refresh the chain with the retry's used': the next
+                # chained batch must see BOTH passes' placements, not the
+                # pre-retry tensor. (Host-only overflow repair in
+                # _materialize_compact still isn't reflected — the
+                # applier's optimistic verification catches that residual
+                # over-placement direction.)
+                self.chain_out = (
+                    tuple(node.id for node in nodes), used_retry
+                )
+                t_mat0 = now_ns()
                 leftovers2 = self._materialize_compact(
                     table, retry, inst2, over2, table.cap - used2
                 )
+                mat_ns += now_ns() - t_mat0
             else:
                 assign2, _, _ = self._run_kernel(
                     table, retry, used2, use_preempt=False
                 )
+                t_mat0 = now_ns()
                 leftovers2 = self._materialize(table, retry, assign2, None)
+                mat_ns += now_ns() - t_mat0
             for gi, reqs in leftovers2.items():
                 grp = retry[gi]
                 key = (grp.key[0], grp.tg.name)
@@ -602,10 +781,14 @@ class BatchSolver:
             metric.nodes_filtered = n - int(np.sum(grp.feasible))
             metric.coalesced_failures = len(reqs) - 1
             out.failures.setdefault(eval_id, {})[tg_name] = metric
-        out.solve_ns = now_ns() - t0
+        # solve_ns excludes any pipeline gap between the two phases
+        out.solve_ns = phase_a_ns + (now_ns() - t0)
         from ... import metrics
 
         metrics.time_ns("nomad.tpu.solve_seconds", out.solve_ns)
+        # Alloc materialization joins the host_prep/device/readback stage
+        # registry so the bench's breakdown covers the full commit half.
+        metrics.time_ns("nomad.tpu.materialize_seconds", mat_ns)
         metrics.observe("nomad.tpu.solve_groups", out.groups)
         return out
 
@@ -817,9 +1000,20 @@ class BatchSolver:
     def _run_compact(
         self, table, groups: list[LoweredGroup], used_n, dev_state=None
     ):
+        """Synchronous form: async dispatch + finish in one call (the
+        spread-relaxation retry and direct callers use this)."""
+        return self._run_compact_finish(
+            self._run_compact_async(table, groups, used_n, dev_state)
+        )
+
+    def _run_compact_async(
+        self, table, groups: list[LoweredGroup], used_n, dev_state=None
+    ):
         """Default kernel with deduped/bit-packed uploads and device-side
-        compaction: returns (inst_node [G, maxC], over [N] bool,
-        used' device array).
+        compaction, DISPATCH HALF: lowers, uploads, and queues the kernel
+        without blocking. Returns a pending tuple for
+        _run_compact_finish, which blocks, reads back, and returns
+        (inst_node [G, maxC], over [N] bool, used' device array).
 
         dev_state — optional (cap_dev, used_dev) resident device tensors
         at this table's padded shape; when given, the [N, 3] host arrays
@@ -828,8 +1022,6 @@ class BatchSolver:
         telemetry registry (nomad.tpu.{host_prep,device,readback}_seconds)
         so the bench can publish the device/transfer/host split.
         """
-        import jax
-
         from ... import metrics
 
         t_prep0 = now_ns()
@@ -879,11 +1071,15 @@ class BatchSolver:
             if placeable > placeable_cap:
                 placeable_cap = placeable
         maxc = pad_c(max(1, placeable_cap))
-        # the resident device tensors replace the cap/used upload when
-        # their padded shape matches this table's bucket
+        # resident/chained device tensors replace the cap and/or used
+        # upload when their padded shape matches this table's bucket
         cap_in, used_in = cap, used
-        if dev_state is not None and dev_state[0].shape == (np_, 3):
-            cap_in, used_in = dev_state
+        if dev_state is not None:
+            dcap, dused = dev_state
+            if dcap is not None and dcap.shape == (np_, 3):
+                cap_in = dcap
+            if dused is not None and dused.shape == (np_, 3):
+                used_in = dused
         inst, over, used_out = solve_placement_compact(
             cap_in,
             used_in,
@@ -897,12 +1093,22 @@ class BatchSolver:
             ucap_idx,
             max_count=maxc,
         )
+        metrics.time_ns("nomad.tpu.host_prep_seconds", now_ns() - t_prep0)
+        return inst, over, used_out, g, n, time.perf_counter()
+
+    def _run_compact_finish(self, pending):
+        """Block on the dispatched compact kernel and read back."""
+        import jax
+
+        from ... import metrics
+
+        inst, over, used_out, g, n, t_disp = pending
         # device compute vs readback split: block on the async dispatch
         # first, then transfer — so the bench's breakdown distinguishes
         # chip time from the (tunnel) link time
-        metrics.time_ns("nomad.tpu.host_prep_seconds", now_ns() - t_prep0)
         t_dev0 = now_ns()
         jax.block_until_ready(used_out)
+        self._inject_rtt(t_disp)
         metrics.time_ns("nomad.tpu.device_seconds", now_ns() - t_dev0)
         t_rb0 = now_ns()
         # slice on-device before the host transfer: the pad region is
@@ -912,6 +1118,22 @@ class BatchSolver:
         return result
 
     def _run_kernel(
+        self,
+        table,
+        groups: list[LoweredGroup],
+        used_n: np.ndarray,
+        tier_limit: Optional[np.ndarray] = None,
+        use_preempt: bool = False,
+    ):
+        """Synchronous form: async dispatch + finish in one call."""
+        return self._run_kernel_finish(
+            self._run_kernel_async(
+                table, groups, used_n, tier_limit=tier_limit,
+                use_preempt=use_preempt,
+            )
+        )
+
+    def _run_kernel_async(
         self,
         table,
         groups: list[LoweredGroup],
@@ -947,17 +1169,38 @@ class BatchSolver:
                 cap, used, prefix, asks_arr, counts, feas, bias, ucap,
                 tier_limit,
             )
-            # slice on-device before the host transfer: the pad region
-            # is zeros and the tunnel to the chip is the slow link
-            return (
-                np.asarray(assign[:g, :n]),
-                np.asarray(assign_evict[:g, :n]),
-                used_out,
-            )
+            return assign, assign_evict, used_out, g, n, time.perf_counter()
         assign, used_out = self.solve_fn(
             cap, used, asks_arr, counts, feas, bias, ucap
         )
-        return np.asarray(assign[:g, :n]), None, used_out
+        return assign, None, used_out, g, n, time.perf_counter()
+
+    def _run_kernel_finish(self, pending):
+        """Block on the dispatched dense kernel and read back. The
+        on-device slice happens before the host transfer: the pad region
+        is zeros and the tunnel to the chip is the slow link."""
+        assign, assign_evict, used_out, g, n, t_disp = pending
+        self._inject_rtt(t_disp)
+        return (
+            np.asarray(assign[:g, :n]),
+            None if assign_evict is None else np.asarray(assign_evict[:g, :n]),
+            used_out,
+        )
+
+    def _inject_rtt(self, t_disp: float) -> None:
+        """Simulated chip round-trip (docs/pipeline.md): results become
+        available inject_device_latency_s AFTER DISPATCH, the way a real
+        async device computes while the host works — NOT a fixed sleep at
+        readback, which would model a device that only starts when asked
+        for results and would serialize the simulated RTT behind the
+        commit stage's own host work. Lets the worker's solve/commit
+        overlap be proven on CPU fallback."""
+        if self.config.inject_device_latency_s > 0:
+            remain = self.config.inject_device_latency_s - (
+                time.perf_counter() - t_disp
+            )
+            if remain > 0:
+                time.sleep(remain)
 
     # ------------------------------------------------------------------
 
@@ -1101,44 +1344,39 @@ class BatchSolver:
                         continue
                     placements.append(alloc)
             else:
-                shared_res = AllocatedResources(
-                    tasks={
-                        t.name: AllocatedTaskResources(
-                            cpu=t.resources.cpu,
-                            memory_mb=t.resources.memory_mb,
+                tmpl_key = (id(grp.job), tg.name)
+                tmpl = self._mint_cache.get(tmpl_key)
+                if tmpl is None:
+                    shared_res = AllocatedResources(
+                        tasks={
+                            t.name: AllocatedTaskResources(
+                                cpu=t.resources.cpu,
+                                memory_mb=t.resources.memory_mb,
+                            )
+                            for t in tg.tasks
+                        },
+                        shared_disk_mb=tg.ephemeral_disk.size_mb,
+                    )
+                    tmpl = self._mint_cache[tmpl_key] = _MintTemplate(
+                        Allocation(
+                            namespace=grp.job.namespace,
+                            eval_id=eval_id,
+                            job_id=grp.job.id,
+                            job=grp.job,
+                            task_group=tg.name,
+                            resources=shared_res,
+                            metrics=AllocMetric(nodes_evaluated=n),
                         )
-                        for t in tg.tasks
-                    },
-                    shared_disk_mb=tg.ephemeral_disk.size_mb,
-                )
-                shared_metric = AllocMetric(nodes_evaluated=n)
+                    )
                 uuids = generate_uuids(placed) if placed else []
-                ns_ = grp.job.namespace
-                jid = grp.job.id
-                tg_name = tg.name
-                job = grp.job
                 group_cpu = sum(t.resources.cpu for t in tg.tasks)
                 ap = placements.append
+                mint = tmpl.mint
                 if over_set is None and not self._batch_has_cores:
                     # the clean bulk case (no overflow repair, no cores
                     # ledger): one tight mint loop, ~100k iterations/solve
                     for uid, ni, req in zip(uuids, node_idx, reqs):
-                        node = nodes[ni]
-                        ap(
-                            Allocation(
-                                id=uid,
-                                namespace=ns_,
-                                eval_id=eval_id,
-                                name=req.name,
-                                node_id=node.id,
-                                node_name=node.name,
-                                job_id=jid,
-                                job=job,
-                                task_group=tg_name,
-                                resources=shared_res,
-                                metrics=shared_metric,
-                            )
-                        )
+                        ap(mint(uid, req.name, nodes[ni]))
                     node_idx = ()
                 for i, ni in enumerate(node_idx):
                     if over_set is not None and ni in over_set:
@@ -1156,21 +1394,7 @@ class BatchSolver:
                         self._batch_cpu[node.id] = (
                             self._batch_cpu.get(node.id, 0) + group_cpu
                         )
-                    ap(
-                        Allocation(
-                            id=uuids[i],
-                            namespace=ns_,
-                            eval_id=eval_id,
-                            name=reqs[i].name,
-                            node_id=node.id,
-                            node_name=node.name,
-                            job_id=jid,
-                            job=job,
-                            task_group=tg_name,
-                            resources=shared_res,
-                            metrics=shared_metric,
-                        )
-                    )
+                    ap(mint(uuids[i], reqs[i].name, node))
             unplaced.extend(reqs[placed:])
             if unplaced:
                 leftovers[gi] = unplaced

@@ -361,6 +361,69 @@ class OverlaySnapshot:
         return out
 
 
+def _plan_partition_key(plan: Plan) -> tuple[set[str], bool, Optional[tuple]]:
+    """(touched node set, touches_volumes, job key) — the plan facts the
+    conflict partition branches on. Derived once per plan; the round
+    loop in _commit_merged_rounds reuses them across rounds instead of
+    rebuilding the sets and re-walking volumes O(rounds x plans)."""
+    nodes = (
+        set(plan.node_allocation)
+        | set(plan.node_update)
+        | set(plan.node_preemptions)
+    )
+    job_key = (
+        (plan.job.namespace, plan.job.id) if plan.job is not None else None
+    )
+    return nodes, _plan_touches_volumes(plan), job_key
+
+
+def partition_plan_batch(
+    plans: list[Plan],
+    keys: Optional[list[tuple[set, bool, Optional[tuple]]]] = None,
+) -> tuple[list[int], list[int]]:
+    """Per-node conflict partition of a same-snapshot plan batch.
+
+    Returns (merged, serial) index lists. A plan joins the merged set
+    when its touched node set is disjoint from every earlier merged
+    plan's — disjoint node sets mean one plan's placements/stops cannot
+    change another's fit, so all of them verify correctly against ONE
+    snapshot and commit as one raft entry. Plans that conflict on a
+    node, or touch volumes (two node-disjoint plans can still race one
+    volume's write claim), fall back to the existing serial path, in
+    submission order, AFTER the merged commit — so their verification
+    sees the merged plans' effects and rejects/refreshes exactly as if
+    everything had been serial.
+
+    Two plans for the SAME job never merge either: the bulk commit
+    collapses each round's jobs by (namespace, id), so same-job plans at
+    different job versions would re-attach one plan's allocs to the
+    other's version. The eval broker's one-in-flight-eval-per-job lock
+    already makes this unreachable from the TPU worker, but enqueue_batch
+    is public API — enforce it here rather than rely on the convention.
+
+    keys — optional precomputed _plan_partition_key list parallel to
+    plans."""
+    if keys is None:
+        keys = [_plan_partition_key(p) for p in plans]
+    merged: list[int] = []
+    serial: list[int] = []
+    claimed: set[str] = set()
+    claimed_jobs: set[tuple] = set()
+    for i, (nodes, touches_volumes, job_key) in enumerate(keys):
+        if (
+            touches_volumes
+            or (nodes & claimed)
+            or (job_key is not None and job_key in claimed_jobs)
+        ):
+            serial.append(i)
+            continue
+        claimed |= nodes
+        if job_key is not None:
+            claimed_jobs.add(job_key)
+        merged.append(i)
+    return merged, serial
+
+
 def _plan_touches_volumes(plan: Plan) -> bool:
     """Does any placement in this plan use task-group volumes? Such plans
     must verify against committed state (volume claims commit atomically
@@ -462,6 +525,15 @@ class PlanApplier:
             if item is None:
                 continue
             plan, fut = item
+            if isinstance(plan, list):
+                try:
+                    self._apply_batch(plan, fut)
+                except Exception as e:  # pragma: no cover - defensive
+                    logger.exception("plan batch apply failed")
+                    for f in fut:
+                        if not f.done():
+                            f.set_exception(e)
+                continue
             try:
                 self._apply_pipelined(plan, fut)
             except Exception as e:  # pragma: no cover - defensive
@@ -511,6 +583,124 @@ class PlanApplier:
             self._cq.append((index, wait_fn, result, fut))
             self._outstanding += 1
             self._cq_cv.notify_all()
+
+    # -- merged batch path ----------------------------------------------
+
+    def _commit_merged(
+        self, plans: list[Plan], merged_idx: list[int], snapshot
+    ) -> dict[int, PlanResult]:
+        """Verify the merged (node-disjoint) subset against one snapshot
+        and commit every non-no-op result as ONE raft entry backed by one
+        bulk store transaction."""
+        results: dict[int, PlanResult] = {}
+        to_commit: list[tuple[int, PlanResult]] = []
+        with paused_gc():
+            for i in merged_idx:
+                result = evaluate_plan(snapshot, plans[i])
+                if result.is_no_op():
+                    results[i] = result
+                    continue
+                result.preemption_evals = self._preemption_evals(result)
+                self._normalize(plans[i], result)
+                to_commit.append((i, result))
+        if to_commit:
+            index = self.raft_apply(
+                "apply_plan_results_batch", [r for _, r in to_commit]
+            )
+            for i, r in to_commit:
+                r.alloc_index = index
+                results[i] = r
+        return results
+
+    def _commit_merged_rounds(
+        self, plans: list[Plan], snapshot
+    ) -> tuple[dict[int, PlanResult], list[int]]:
+        """Round-partitioned merged commit: each round commits the
+        mutually node-disjoint prefix of the REMAINING plans as one raft
+        entry, then re-snapshots so the next round's verification sees
+        it. A node-conflicting plan thus still rides a bulk commit one
+        round later (same optimistic-concurrency outcome as the serial
+        path: it verifies against committed state that includes the
+        plans that beat it, and rejects/refreshes if it lost the race)
+        instead of paying an individual raft apply + store transaction.
+        Volume-touching plans never merge; their indices are returned
+        for the caller's true serial path."""
+        from .. import metrics
+
+        results: dict[int, PlanResult] = {}
+        remaining = list(range(len(plans)))
+        keys = [_plan_partition_key(p) for p in plans]
+        merged_total = 0
+        rounds = 0
+        while remaining:
+            rel_merged, rel_rest = partition_plan_batch(
+                [plans[i] for i in remaining],
+                keys=[keys[i] for i in remaining],
+            )
+            if not rel_merged:
+                break  # only volume plans left — serial path
+            if rounds > 0:
+                snapshot = self.state.snapshot()
+            round_idx = [remaining[r] for r in rel_merged]
+            results.update(
+                self._commit_merged(plans, round_idx, snapshot)
+            )
+            merged_total += len(round_idx)
+            rounds += 1
+            remaining = [remaining[r] for r in rel_rest]
+        metrics.observe("nomad.plan_apply.batch_merged", merged_total)
+        metrics.observe("nomad.plan_apply.batch_rounds", rounds)
+        metrics.observe("nomad.plan_apply.batch_serial", len(remaining))
+        return results, remaining
+
+    def _apply_batch(self, plans: list[Plan], futs: list) -> None:
+        """Queue-dequeued batch: round-partitioned merged commits for
+        everything node-partitionable, serial fallback (in order) for
+        the volume-touching rest.
+
+        The batch verifies against COMMITTED state only, so any pipelined
+        single-plan commit still in flight is drained first — the merged
+        commit is itself one synchronous apply for N plans, which already
+        amortizes what the depth-1 pipeline would have hidden."""
+        self._drain()
+        self._absorb_commit_failure()
+        if self._stop.is_set():
+            err = RuntimeError("plan applier stopping")
+            for f in futs:
+                if not f.done():
+                    f.set_exception(err)
+            return
+        snapshot = self.state.snapshot()
+        if self._inflight is not None:
+            idx, res, job = self._inflight
+            if snapshot.index >= idx:
+                self._inflight = None
+            else:  # pragma: no cover - drain above makes this unreachable
+                snapshot = OverlaySnapshot(snapshot, res, job)
+        results, serial_idx = self._commit_merged_rounds(plans, snapshot)
+        for i, r in results.items():
+            futs[i].set_result(r)
+        # Volume-touching plans re-verify against post-merge state via
+        # the standard (pipelined) serial path and refresh/reject exactly
+        # as they always did.
+        for i in serial_idx:
+            try:
+                self._apply_pipelined(plans[i], futs[i])
+            except Exception as e:  # pragma: no cover - defensive
+                logger.exception("serial fallback apply failed")
+                if not futs[i].done():
+                    futs[i].set_exception(e)
+
+    def apply_batch(self, plans: list[Plan]) -> list[PlanResult]:
+        """Synchronous merged verify+commit of a plan batch (direct
+        callers and tests; the dequeue loop routes queue batches through
+        the same partition/merge core)."""
+        results, serial_idx = self._commit_merged_rounds(
+            plans, self.state.snapshot()
+        )
+        for i in serial_idx:
+            results[i] = self.apply_one(plans[i])
+        return [results[i] for i in range(len(plans))]
 
     def _absorb_commit_failure(self) -> None:
         """If an in-flight commit failed, discard its overlay — after

@@ -29,7 +29,11 @@ class PlanQueue:
             self._enabled = enabled
             if was and not enabled:
                 for _, _, _, fut in self._heap:
-                    fut.cancel()
+                    if isinstance(fut, list):
+                        for f in fut:
+                            f.cancel()
+                    else:
+                        fut.cancel()
                 self._heap.clear()
             self._cv.notify_all()
 
@@ -49,7 +53,34 @@ class PlanQueue:
             self._cv.notify_all()
         return fut
 
-    def dequeue(self, timeout_s: Optional[float] = None) -> Optional[tuple[Plan, Future]]:
+    def enqueue_batch(self, plans: list[Plan]) -> list[Future]:
+        """Enqueue N same-snapshot plans as ONE queue item so the applier
+        can verify/commit them together (merged plan apply). One future
+        per plan; the heap entry rides at the batch's max priority. The
+        applier's dequeue sees (list[Plan], list[Future]) and routes to
+        its batch path."""
+        futs: list[Future] = [Future() for _ in plans]
+        if not plans:
+            return futs
+        with self._lock:
+            if not self._enabled:
+                for fut in futs:
+                    fut.set_exception(RuntimeError("plan queue is disabled"))
+                return futs
+            prio = max(p.priority for p in plans)
+            heapq.heappush(
+                self._heap, (-prio, next(self._counter), list(plans), futs)
+            )
+            self._cv.notify_all()
+        return futs
+
+    def dequeue(
+        self, timeout_s: Optional[float] = None
+    ) -> Optional[tuple["Plan | list[Plan]", "Future | list[Future]"]]:
+        """Pop the highest-priority item. A single enqueue() yields
+        (Plan, Future); an enqueue_batch() item yields parallel
+        (list[Plan], list[Future]) — consumers must branch on
+        isinstance(plan, list) (the PlanApplier's run loop does)."""
         with self._cv:
             while True:
                 if self._heap:

@@ -65,6 +65,7 @@ class FSM:
             "alloc_client_update": self._apply_alloc_client_update,
             "alloc_update_desired_transition": self._apply_desired_transition,
             "apply_plan_results": self._apply_plan_results,
+            "apply_plan_results_batch": self._apply_plan_results_batch,
             "deployment_upsert": self._apply_deployment_upsert,
             "deployment_status_update": self._apply_deployment_status,
             "deployment_delete": self._apply_deployment_delete,
@@ -205,6 +206,16 @@ class FSM:
         # (reference fsm.go ApplyPlanResults → upsertEvals side channel).
         if result.preemption_evals and self.on_eval_update:
             self.on_eval_update(result.preemption_evals)
+
+    def _apply_plan_results_batch(
+        self, index: int, results: list[PlanResult]
+    ) -> None:
+        """N node-disjoint plan results committed as one log entry (the
+        batched plan applier's merged commit — one store transaction)."""
+        self.state.upsert_plan_results_batch(index, results)
+        evs = [e for r in results for e in r.preemption_evals]
+        if evs and self.on_eval_update:
+            self.on_eval_update(evs)
 
     def _apply_deployment_upsert(self, index: int, deployment: Deployment) -> None:
         self.state.upsert_deployment(index, deployment)

@@ -16,8 +16,10 @@ Two worker flavors:
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
 import threading
 import time
+from concurrent.futures import CancelledError
 from typing import Optional
 
 from .. import metrics
@@ -45,6 +47,19 @@ class WorkerPlanner:
                 result.refresh_index, timeout_s=5
             )
         return result, new_state
+
+    def submit_plan_batch(self, plans: list[Plan]) -> list[PlanResult]:
+        """Submit a whole batch of same-snapshot plans as one queue item;
+        the applier merges the node-disjoint subset into a single raft
+        apply (plan_apply.py). One snapshot wait covers every partial
+        commit in the batch, so retry evals never race their own
+        refresh index."""
+        futs = self.server.plan_queue.enqueue_batch(plans)
+        results: list[PlanResult] = [f.result(timeout=60) for f in futs]
+        max_refresh = max((r.refresh_index for r in results), default=0)
+        if max_refresh > 0:
+            self.server.state.snapshot_min_index(max_refresh, timeout_s=5)
+        return results
 
     def update_eval(self, eval_obj: Evaluation) -> None:
         self.server.raft_apply("eval_update", [eval_obj])
@@ -140,7 +155,18 @@ class Worker:
 
 class TPUBatchWorker:
     """Drains up to `batch_size` ready evals per cycle and solves them in
-    one batched tensor program."""
+    one batched tensor program.
+
+    Two-stage pipeline (docs/pipeline.md): the SOLVE stage (this worker's
+    main thread) dequeues a batch, snapshots, and runs the device solve;
+    the COMMIT stage (a dedicated thread) materializes plan submission,
+    eval updates, and ack/nack. A bounded handoff queue of depth 1 means
+    batch N+1's dequeue/lower/device dispatch overlaps batch N's plan
+    commit — the same depth-1 optimistic overlap the reference plan
+    applier runs (plan_apply.go:54-63), won here at the worker layer
+    where the GIL releases during the device round-trip. `pipeline=False`
+    degrades to the old solve-then-commit loop (the bench's
+    non-overlapped comparator)."""
 
     def __init__(
         self,
@@ -148,34 +174,94 @@ class TPUBatchWorker:
         schedulers: list[str] = ("service", "batch"),
         batch_size: int = 64,
         config: Optional[SchedulerConfig] = None,
+        pipeline: bool = True,
     ) -> None:
         self.server = server
         self.schedulers = list(schedulers)
         self.batch_size = batch_size
         self.config = config or SchedulerConfig(backend="tpu")
         self.planner = WorkerPlanner(server)
+        self.pipeline = pipeline
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._cthread: Optional[threading.Thread] = None
+        # depth-1 handoff: at most ONE solved batch awaits commit while
+        # the next batch solves
+        self._commit_q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1)
+        # (pending, committed_event, outcome, basis_index) of the batch
+        # handed to the commit stage: while its commit is in flight, the
+        # next solve chains on its device-resident used' tensor
+        # (solver.py used_chain) so the two batches place conflict-free.
+        # basis_index is the chain's transitive capacity basis (the
+        # oldest chained ancestor's snapshot index).
+        self._prev: Optional[tuple] = None
         self.processed = 0
 
     def start(self) -> None:
-        # Fresh Event per incarnation (see Worker.start).
+        # Fresh Event + queue per incarnation (see Worker.start).
         self._stop = threading.Event()
+        self._commit_q = queue_mod.Queue(maxsize=1)
+        self._prev = None
         self._thread = threading.Thread(
             target=self._run, args=(self._stop,), daemon=True,
-            name="tpu-batch-worker"
+            name="tpu-batch-solve"
         )
         self._thread.start()
+        if self.pipeline:
+            self._cthread = threading.Thread(
+                target=self._commit_loop,
+                args=(self._stop, self._commit_q),
+                daemon=True,
+                name="tpu-batch-commit",
+            )
+            self._cthread.start()
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._cthread:
+            # Sentinel AFTER the solve thread is down: the commit thread
+            # drains every batch handed off before it (FIFO) and exits on
+            # the sentinel itself — a stop racing the hand-off can never
+            # strand a solved batch between the two threads' stop checks
+            # (un-acked evals would hold the broker's per-job locks
+            # forever; only ack/nack release them).
+            try:
+                self._commit_q.put(None, timeout=15)
+            except queue_mod.Full:  # pragma: no cover - commit thread dead
+                pass
+            self._cthread.join(timeout=15)
+            self._cthread = None
+        # a zombie solve thread that outlived join(5) above could still
+        # have slipped one batch in after the sentinel: nack it so its
+        # evals redeliver instead of leaking their job locks
+        while True:
+            try:
+                item = self._commit_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if item is not None:
+                batch, _pending, _snapshot, committed, outcome, _chain = item
+                self._nack_batch(batch)
+                outcome["ok"] = False
+                committed.set()
+        # a stopped worker object stays referenced by the server; don't
+        # let it pin the last batch's device tensors and snapshot
+        self._prev = None
+
+    # -- solve stage ----------------------------------------------------
 
     def _run(self, stop: threading.Event) -> None:
         broker = self.server.eval_broker
         while not stop.is_set():
+            # Drop the previous batch's PendingEvalBatch once its commit
+            # lands: on an idle worker it would otherwise pin the solved
+            # batch's device tensors, node tables, and snapshot until the
+            # next eval arrives.
+            if self._prev is not None and self._prev[1].is_set():
+                self._prev = None
             batch: list[tuple[Evaluation, str]] = []
             ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
             if ev is None:
@@ -188,45 +274,230 @@ class TPUBatchWorker:
                     break
                 batch.append((ev2, token2))
             try:
-                self._process_batch([e for e, _ in batch])
+                pending, snapshot, chained_on = self._solve_batch(
+                    [e for e, _ in batch]
+                )
             except Exception:
-                logger.exception("tpu batch of %d failed", len(batch))
-                for ev_, tok in batch:
-                    try:
-                        broker.nack(ev_.id, tok)
-                    except ValueError:
-                        pass
+                logger.exception("tpu batch solve of %d failed", len(batch))
+                metrics.incr("nomad.worker.invoke.failed")
+                self._nack_batch(batch)
                 continue
-            for ev_, tok in batch:
+            # outcome["ok"] is the commit verdict the NEXT batch (which
+            # may have chained on this one's used' tensor) branches on:
+            # True/False once decided, None while in flight. FIFO commit
+            # order guarantees it is decided before the child commits.
+            outcome: dict = {"ok": None}
+            if not self.pipeline:
+                self._commit(
+                    batch, pending, snapshot, threading.Event(),
+                    outcome, chained_on,
+                )
+                continue
+            committed = threading.Event()
+            handed_off = False
+            while not stop.is_set():
                 try:
-                    broker.ack(ev_.id, tok)
-                except ValueError:
-                    pass
-            self.processed += len(batch)
+                    self._commit_q.put(
+                        (batch, pending, snapshot, committed,
+                         outcome, chained_on),
+                        timeout=0.2,
+                    )
+                    handed_off = True
+                    break
+                except queue_mod.Full:
+                    continue
+            if not handed_off:
+                # stopping with a solved batch that never reached the
+                # commit stage: nack so the evals redeliver cleanly
+                self._nack_batch(batch)
+                outcome["ok"] = False
+            else:
+                # this batch's effective capacity basis: its own snapshot
+                # unless it chained, in which case the chain's basis
+                # propagates TRANSITIVELY (a chain_out tensor built on a
+                # chained input is still based on the oldest ancestor's
+                # snapshot — external capacity events since then are
+                # masked for every descendant)
+                basis = chained_on[1] if chained_on else snapshot.index
+                self._prev = (pending, committed, outcome, basis)
 
-    def _process_batch(self, evals: list[Evaluation]) -> None:
-        from ..scheduler.tpu import solve_eval_batch
+    def _solve_batch(self, evals: list[Evaluation]):
+        """Phase A: snapshot + reconcile + lower + async device dispatch.
+        Returns the PendingEvalBatch whose finish() (run on the commit
+        stage) blocks on the device and materializes the plans."""
+        from ..scheduler.tpu import solve_eval_batch_begin
 
         wait_index = max(
             max(ev.modify_index for ev in evals),
             max(ev.snapshot_index for ev in evals),
         )
         snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        # Chain on the in-flight batch's post-solve usage tensor ONLY
+        # while its commit is pending: once committed, the snapshot's
+        # aggregate already carries those placements and the chain would
+        # just mask newer external writes.
+        chain = None
+        chained_on = None
+        if self._prev is not None:
+            prev_pending, committed, prev_outcome, prev_basis = self._prev
+            if not committed.is_set():
+                chain = prev_pending.chain
+                # (parent's commit-verdict holder, the chain's BASIS
+                # index). The basis is the parent's own basis — NOT its
+                # snapshot index — so it propagates transitively through
+                # multi-hop chains: capacity freed after the oldest
+                # ancestor's snapshot is masked by the chained used'
+                # tensor, so any blocked eval this solve mints must watch
+                # for unblocks from that index or a capacity event in the
+                # gap is treated as already seen and the eval strands.
+                chained_on = (prev_outcome, prev_basis)
+            else:
+                self._prev = None
         t0 = time.perf_counter()
-        plans = solve_eval_batch(snapshot, self.planner, evals, self.config)
+        pending = solve_eval_batch_begin(
+            snapshot, self.planner, evals, self.config, used_chain=chain
+        )
+        if chained_on is not None and not pending.chain_accepted:
+            # the solver took a path that never consumed the chain (host
+            # partition, resident tensors, node-universe mismatch): this
+            # solve saw only committed state, so the parent's commit
+            # verdict must not nack it and its blocked evals need no
+            # older basis index
+            chained_on = None
         metrics.observe("nomad.tpu.batch_evals", len(evals))
         metrics.observe(
-            "nomad.tpu.batch_solve_seconds", time.perf_counter() - t0
+            # renamed from batch_solve_seconds when the pipeline split
+            # landed: this now times ONLY phase A (reconcile + lower +
+            # async dispatch) — device wait and materialization moved to
+            # the commit stage's device/materialize/commit timers
+            "nomad.tpu.batch_dispatch_seconds", time.perf_counter() - t0
         )
+        return pending, snapshot, chained_on
+
+    # -- commit stage ---------------------------------------------------
+
+    def _commit_loop(
+        self, stop: threading.Event, cq: "queue_mod.Queue"
+    ) -> None:
+        # Exits ONLY on the stop() sentinel, never on a bare stop-flag
+        # check: the FIFO guarantees every batch handed off before the
+        # sentinel is committed (or nacked by _commit's failure path)
+        # first, so no solved batch is ever stranded with its evals
+        # un-acked.
+        while True:
+            item = cq.get()
+            if item is None:
+                return
+            batch, pending, snapshot, committed, outcome, chained_on = item
+            try:
+                self._commit(
+                    batch, pending, snapshot, committed, outcome, chained_on
+                )
+            except (Exception, CancelledError):
+                # _commit has its own guards; this is the backstop that
+                # keeps the commit thread alive no matter what — a dead
+                # commit thread strands every later batch with its evals
+                # un-acked (per-job broker locks leak forever)
+                logger.exception("tpu commit stage hard failure")
+                self._nack_batch(batch)
+                outcome["ok"] = False
+                committed.set()
+
+    def _nack_batch(self, batch: list[tuple[Evaluation, str]]) -> None:
+        broker = self.server.eval_broker
+        for ev_, tok in batch:
+            try:
+                broker.nack(ev_.id, tok)
+            except ValueError:
+                pass
+
+    def _commit(
+        self, batch, pending, snapshot, committed, outcome, chained_on
+    ) -> None:
+        broker = self.server.eval_broker
+        if chained_on is not None and chained_on[0].get("ok") is False:
+            # This batch solved against the used' tensor of a batch whose
+            # commit then FAILED: its view baked in placements that never
+            # landed, so near-full nodes look occupied that are free —
+            # committing would mint blocked evals waiting on a capacity
+            # event that never comes. Nack instead: the evals redeliver
+            # and re-solve against a clean snapshot. (FIFO commit order
+            # means the parent's verdict is always decided by now.)
+            metrics.incr("nomad.tpu.chain_parent_failed")
+            self._nack_batch(batch)
+            outcome["ok"] = False
+            committed.set()
+            return
+        try:
+            # phase B: block on the device, read back, materialize plans
+            # (device/readback/materialize stage timers land in the
+            # solver's registry); then the plan submit is timed as the
+            # commit stage proper
+            plans = pending.finish()
+            t0 = time.perf_counter()
+            all_full = self._commit_batch(
+                [e for e, _ in batch], plans, snapshot,
+                blocked_basis=chained_on[1] if chained_on else None,
+            )
+        except (Exception, CancelledError):
+            # CancelledError included: plan futures cancelled by a queue
+            # disable (leadership loss) are BaseException since py3.8 and
+            # must still nack, not kill the commit thread
+            logger.exception("tpu batch commit of %d failed", len(batch))
+            metrics.incr("nomad.worker.invoke.failed")
+            self._nack_batch(batch)
+            outcome["ok"] = False
+            return
+        finally:
+            # chain cutoff: the solve stage stops chaining on this batch
+            # the moment its effects are (or will never be) committed
+            committed.set()
+        # A partial commit is a failed verdict for chaining purposes: the
+        # trimmed placements are in the chained used' tensor but never
+        # landed, so a follower that baked them in must re-solve too.
+        outcome["ok"] = all_full
+        # commit_seconds joins the solver's host_prep/device/readback/
+        # materialize stage registry: the full commit half of the pipeline
+        metrics.observe(
+            "nomad.tpu.commit_seconds", time.perf_counter() - t0
+        )
+        for ev_, tok in batch:
+            try:
+                broker.ack(ev_.id, tok)
+            except ValueError:
+                pass
+        self.processed += len(batch)
+
+    def _commit_batch(
+        self, evals: list[Evaluation], plans, snapshot,
+        blocked_basis: Optional[int] = None,
+    ) -> bool:
+        # One merged submission for the whole batch (the applier commits
+        # the node-disjoint subset as a single raft apply + bulk store
+        # transaction, serial-fallback for conflicting plans). Returns
+        # whether EVERY plan committed in full — a trimmed plan means the
+        # chained used' tensor carries placements that never landed.
+        # blocked_basis — for a CHAINED solve, the parent's snapshot
+        # index: blocked evals must not mark capacity events between the
+        # chain basis and this snapshot as already seen.
+        submit = [
+            (ev, plans[ev.id]) for ev in evals if not plans[ev.id].is_no_op()
+        ]
+        results: dict[str, PlanResult] = {}
+        if submit:
+            got = self.planner.submit_plan_batch([p for _, p in submit])
+            results = {ev.id: r for (ev, _), r in zip(submit, got)}
+        all_full = True
         updates: list[Evaluation] = []
         for ev in evals:
             plan = plans[ev.id]
             failed = dict(ev.failed_tg_allocs)
             blocked: Optional[Evaluation] = None
-            if not plan.is_no_op():
-                result, new_state = self.planner.submit_plan(plan)
+            result = results.get(ev.id)
+            if result is not None:
                 full, _, _ = result.full_commit(plan)
                 if not full:
+                    all_full = False
                     # partial commit: requeue the eval for a fresh pass
                     retry = ev.copy()
                     retry.status = "pending"
@@ -235,7 +506,11 @@ class TPUBatchWorker:
                     continue
             if failed:
                 blocked = ev.create_blocked_eval({}, True, "", failed)
-                blocked.snapshot_index = snapshot.index
+                blocked.snapshot_index = (
+                    blocked_basis
+                    if blocked_basis is not None
+                    else snapshot.index
+                )
                 blocked.status_description = "created to place remaining allocations"
                 self.planner.create_eval(blocked)
             done = ev.copy()
@@ -246,3 +521,4 @@ class TPUBatchWorker:
             updates.append(done)
         if updates:
             self.server.raft_apply("eval_update", updates)
+        return all_full

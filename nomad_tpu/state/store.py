@@ -1270,6 +1270,7 @@ class StateStore(_ReadMixin):
         allocs: list[Allocation],
         owned: bool = False,
         default_job: Optional[Job] = None,
+        default_jobs: Optional[dict] = None,
     ) -> list[Allocation]:
         """owned=True transfers ownership of the alloc objects to the store:
         no defensive copy is made and index/time fields are stamped in
@@ -1287,7 +1288,13 @@ class StateStore(_ReadMixin):
         resources only), the existing-row merge rewrites client_status /
         task_states — those must never mutate under a concurrent reader.
         Fresh inserts (the ~10^5-alloc bulk of a c2m plan) stay
-        zero-copy."""
+        zero-copy.
+
+        default_jobs — the merged-plan form of default_job: a
+        {(namespace, job_id): Job} map when one bulk upsert carries
+        allocs scheduled against SEVERAL plans' job versions (the
+        batched plan apply commits N same-snapshot plans in one
+        transaction)."""
         t = self._wtable(TABLE_ALLOCS)
         jobs_touched: set[tuple[str, str]] = set()
         # (ns, job) -> {task_group: fresh insert count}: jobs whose touched
@@ -1321,6 +1328,12 @@ class StateStore(_ReadMixin):
 
         ut = self._wtable(IDX_NODE_USED)
         pt = self._wtable(IDX_PRIO_COUNT)
+        if default_jobs is None:
+            default_jobs = (
+                {(default_job.namespace, default_job.id): default_job}
+                if default_job is not None
+                else {}
+            )
         # Usage-contribution memo: the batch solver's fast-mint path shares
         # ONE AllocatedResources object across a whole group's fresh allocs
         # (solver._materialize_compact), so the contribution walk runs once
@@ -1334,13 +1347,10 @@ class StateStore(_ReadMixin):
             # plan's job version carry job=None and re-attach to it here —
             # BEFORE the existing-alloc fallback, which holds the OLD
             # version and would revert in-place updates.
-            if (
-                alloc.job is None
-                and default_job is not None
-                and alloc.job_id == default_job.id
-                and alloc.namespace == default_job.namespace
-            ):
-                alloc.job = default_job
+            if alloc.job is None and default_jobs:
+                alloc.job = default_jobs.get(
+                    (alloc.namespace, alloc.job_id)
+                )
             if existing is not None:
                 alloc.create_index = existing.create_index
                 alloc.create_time = existing.create_time
@@ -1821,28 +1831,54 @@ class StateStore(_ReadMixin):
 
     def upsert_plan_results(self, index: int, result: PlanResult) -> None:
         """Apply a committed plan atomically (reference state_store.go:318)."""
+        self.upsert_plan_results_batch(index, [result])
+
+    def upsert_plan_results_batch(
+        self, index: int, results: list[PlanResult]
+    ) -> None:
+        """Apply N verified plan results as ONE store transaction.
+
+        The batched plan applier commits a whole TPU batch's worth of
+        same-snapshot, node-disjoint plans in a single raft entry; here
+        they land under one lock acquisition with one bulk alloc upsert
+        (one COW table fork, one summaries/status pass, one publish)
+        instead of N serial upsert_plan_results calls. Semantics per
+        result are identical to the single-plan form — the differential
+        state-identity test (tests/test_plan_apply_batch.py) pins that.
+        """
         with self._lock, paused_gc():
             allocs_to_upsert: list[Allocation] = []
-            for allocs in result.node_allocation.values():
-                allocs_to_upsert.extend(allocs)
             stopped: list[Allocation] = []
-            for allocs in result.node_update.values():
-                stopped.extend(allocs)
             preempted: list[Allocation] = []
-            for allocs in result.node_preemptions.values():
-                preempted.extend(allocs)
-
             deployment_events: list = []
-            if result.deployment is not None:
-                self._upsert_deployment_txn(index, result.deployment)
-                deployment_events.append(
-                    self._tables[TABLE_DEPLOYMENTS][result.deployment.id]
-                )
-            for du in result.deployment_updates:
-                self._update_deployment_status_txn(index, du)
-                d = self._tables[TABLE_DEPLOYMENTS].get(du.deployment_id)
-                if d is not None:
-                    deployment_events.append(d)
+            default_jobs: dict[tuple[str, str], Job] = {}
+            preemption_evals: list[Evaluation] = []
+            for result in results:
+                for allocs in result.node_allocation.values():
+                    allocs_to_upsert.extend(allocs)
+                for allocs in result.node_update.values():
+                    stopped.extend(allocs)
+                for allocs in result.node_preemptions.values():
+                    preempted.extend(allocs)
+                if result.job is not None:
+                    default_jobs[
+                        (result.job.namespace, result.job.id)
+                    ] = result.job
+                if result.deployment is not None:
+                    self._upsert_deployment_txn(index, result.deployment)
+                    deployment_events.append(
+                        self._tables[TABLE_DEPLOYMENTS][result.deployment.id]
+                    )
+                for du in result.deployment_updates:
+                    self._update_deployment_status_txn(index, du)
+                    d = self._tables[TABLE_DEPLOYMENTS].get(du.deployment_id)
+                    if d is not None:
+                        deployment_events.append(d)
+                preemption_evals.extend(result.preemption_evals)
+            any_deployment = any(
+                r.deployment is not None or r.deployment_updates
+                for r in results
+            )
 
             t = self._wtable(TABLE_ALLOCS)
             # Stops and preemptions merge desired-status changes onto the
@@ -1882,7 +1918,7 @@ class StateStore(_ReadMixin):
             committed.extend(
                 self._upsert_allocs_txn(
                     index, allocs_to_upsert, owned=True,
-                    default_job=result.job,
+                    default_jobs=default_jobs,
                 )
             )
             # Volume claims attach atomically with the placements that
@@ -1897,7 +1933,7 @@ class StateStore(_ReadMixin):
             # Canary markers only exist on deployment-bearing plans, so
             # the per-alloc scan is gated on that.
             canary_by_deploy: dict[str, list[Allocation]] = {}
-            if result.deployment is not None or self._tables[TABLE_DEPLOYMENTS]:
+            if any_deployment or self._tables[TABLE_DEPLOYMENTS]:
                 for a in allocs_to_upsert:
                     if (
                         a.deployment_id
@@ -1919,15 +1955,11 @@ class StateStore(_ReadMixin):
                     d.modify_index = index
                     dt[dep_id] = d
                     deployment_events.append(d)
-            if result.preemption_evals:
-                self._upsert_evals_txn(index, result.preemption_evals)
+            if preemption_evals:
+                self._upsert_evals_txn(index, preemption_evals)
                 self._stamp(index, TABLE_EVALS)
             tables = [TABLE_ALLOCS, TABLE_JOB_SUMMARIES]
-            if (
-                result.deployment is not None
-                or result.deployment_updates
-                or canary_by_deploy
-            ):
+            if any_deployment or canary_by_deploy:
                 tables.append(TABLE_DEPLOYMENTS)
             self._stamp(index, *tables)
             jobs_touched = {

@@ -86,13 +86,23 @@ def test_alloc_signal_via_api(agent, tmp_path):
         config={"command": "/bin/sh", "args": ["-c", script]},
     )
     api = _api(agent)
-    # give the shell a beat to install the trap
-    time.sleep(0.5)
-    out = api.allocations.signal(alloc.id, "SIGHUP")
-    assert out["ok"] is True
-    assert wait_until(lambda: sig_file.exists(), 10), (
-        "SIGHUP must reach the task process"
-    )
+    # Deadline-based, not a fixed sleep: under load the shell may take
+    # seconds to install its trap, and a HUP delivered before that kills
+    # the process. Re-signal until the trap's side effect is observed —
+    # every delivery after the trap lands appends, so one success is
+    # enough and extra signals are harmless.
+    deadline = time.monotonic() + 20
+    delivered = False
+    signalled = False
+    while time.monotonic() < deadline and not delivered:
+        try:
+            out = api.allocations.signal(alloc.id, "SIGHUP")
+            signalled = signalled or bool(out.get("ok"))
+        except Exception:
+            pass  # task may be restarting after a pre-trap HUP
+        delivered = wait_until(lambda: sig_file.exists(), 1)
+    assert signalled, "signal endpoint never accepted the SIGHUP"
+    assert delivered, "SIGHUP must reach the task process"
     agent.server.server.job_deregister("default", "sig-job", purge=False)
 
 

@@ -124,3 +124,75 @@ def test_full_stack_is_inversion_free(tmp_path):
         f"stdout:\n{out.stdout[-4000:]}\nstderr:\n{out.stderr[-2000:]}"
     )
     assert "RACECHECK CLEAN" in out.stdout
+
+
+def test_tpu_pipeline_is_inversion_free():
+    """The two-stage TPU batch worker's new threads (tpu-batch-solve,
+    tpu-batch-commit) and the batched plan applier hold the repo's lock
+    discipline: a pipelined server places jobs through the dense kernel
+    path, is stopped mid-flight, restarted, and finishes — with every
+    Lock/RLock tracked and zero lock-order inversions."""
+    script = textwrap.dedent(
+        """
+        import os, sys, time
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.path.insert(0, %r)
+        from nomad_tpu.testing import racecheck
+        racecheck.install()  # BEFORE any nomad_tpu locks are created
+
+        from nomad_tpu.server import Server
+        from nomad_tpu.scheduler.context import SchedulerConfig
+        from nomad_tpu import mock
+
+        # small_batch_threshold=0 forces the dense-kernel two-phase
+        # path; the injected RTT widens the solve/commit overlap window
+        # so stop() lands with a batch genuinely in flight
+        cfg = SchedulerConfig(
+            backend="tpu", small_batch_threshold=0,
+            inject_device_latency_s=0.2,
+        )
+        server = Server(use_tpu_batch_worker=True, scheduler_config=cfg)
+        server.establish_leadership()
+        for _ in range(6):
+            server.node_register(mock.node())
+        for i in range(4):
+            job = mock.job(id=f"race-pipe-{i}")
+            job.task_groups[0].count = 2
+            server.job_register(job)
+        time.sleep(0.3)  # mid-batch
+        server.revoke_leadership()  # stop during an in-flight batch
+        server.establish_leadership()  # restart + drain the remainder
+        deadline = time.time() + 60
+        def placed():
+            return all(
+                len([
+                    a for a in server.state.allocs_by_job(
+                        "default", f"race-pipe-{i}"
+                    )
+                    if not a.terminal_status()
+                ]) == 2
+                for i in range(4)
+            )
+        while time.time() < deadline and not placed():
+            time.sleep(0.1)
+        ok = placed()
+        server.shutdown()
+        if not ok:
+            raise SystemExit("pipelined placement never completed")
+        vs = racecheck.violations()
+        if vs:
+            print(racecheck.report())
+            raise SystemExit(f"{len(vs)} lock-order inversions")
+        print("RACECHECK CLEAN")
+        """
+    ) % ("/root/repo",)
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert out.returncode == 0, (
+        f"stdout:\n{out.stdout[-4000:]}\nstderr:\n{out.stderr[-3000:]}"
+    )
+    assert "RACECHECK CLEAN" in out.stdout

@@ -309,6 +309,110 @@ def test_server_tpu_batch_worker():
         s.shutdown()
 
 
+def test_tpu_commit_chain_parent_failure_nacks_follower():
+    """A batch that solved against the chained used' tensor of a batch
+    whose commit FAILED baked phantom placements into its view —
+    committing it would mint blocked evals for capacity that is free.
+    The commit stage must nack it (evals redeliver, re-solve clean)
+    without ever touching the device results."""
+    import threading
+
+    s = Server(use_tpu_batch_worker=True)
+    w = s.tpu_worker
+    broker = s.eval_broker
+    broker.nack_delay_s = 0.01
+    broker.set_enabled(True)
+    ev = mock.evaluation()
+    broker.enqueue(ev)
+    got, tok = broker.dequeue(["service"], timeout_s=1)
+    assert got is not None
+
+    class MustNotFinish:
+        def finish(self):
+            raise AssertionError("finish() must not run when parent failed")
+
+    committed = threading.Event()
+    outcome = {"ok": None}
+    w._commit(
+        [(got, tok)], MustNotFinish(), None, committed, outcome,
+        chained_on=({"ok": False}, 7),
+    )
+    assert committed.is_set()
+    assert outcome["ok"] is False
+    again, _ = broker.dequeue(["service"], timeout_s=2)
+    assert again is not None and again.id == ev.id
+
+
+def test_tpu_commit_partial_commit_fails_chain_verdict():
+    """A partially-committed batch (applier trimmed/rejected some plans)
+    must record a FAILED chain verdict: the trimmed placements are baked
+    into the chained used' tensor but never landed, so a follower that
+    chained on it has to re-solve just as for a full commit failure."""
+    import threading
+
+    s = Server(use_tpu_batch_worker=True)
+    w = s.tpu_worker
+    broker = s.eval_broker
+    broker.nack_delay_s = 0.01
+    broker.set_enabled(True)
+    ev = mock.evaluation()
+    broker.enqueue(ev)
+    got, tok = broker.dequeue(["service"], timeout_s=1)
+    assert got is not None
+
+    class NoPlans:
+        def finish(self):
+            return {}
+
+    w._commit_batch = (
+        lambda evals, plans, snapshot, blocked_basis=None: False  # partial
+    )
+    committed = threading.Event()
+    outcome = {"ok": None}
+    w._commit([(got, tok)], NoPlans(), None, committed, outcome, None)
+    assert committed.is_set()
+    assert outcome["ok"] is False
+    # the batch itself is still acked: the committed subset landed and
+    # the partial-commit path requeues retry evals for the remainder —
+    # only the CHAIN verdict is a failure
+    with pytest.raises(ValueError):
+        broker.ack(got.id, tok)
+
+
+def test_tpu_commit_cancelled_future_nacks_batch():
+    """concurrent.futures.CancelledError is BaseException since py3.8:
+    plan futures cancelled by a queue disable (leadership loss) must
+    still nack the batch and record the failed outcome, not escape the
+    commit stage's guard and kill the tpu-batch-commit thread."""
+    import threading
+    from concurrent.futures import CancelledError
+
+    s = Server(use_tpu_batch_worker=True)
+    w = s.tpu_worker
+    broker = s.eval_broker
+    broker.nack_delay_s = 0.01
+    broker.set_enabled(True)
+    ev = mock.evaluation()
+    broker.enqueue(ev)
+    got, tok = broker.dequeue(["service"], timeout_s=1)
+    assert got is not None
+
+    class CancelledPending:
+        def finish(self):
+            raise CancelledError()
+
+    committed = threading.Event()
+    outcome = {"ok": None}
+    w._commit(
+        [(got, tok)], CancelledPending(), None, committed, outcome,
+        chained_on=None,
+    )
+    assert committed.is_set()
+    assert outcome["ok"] is False
+    again, _ = broker.dequeue(["service"], timeout_s=2)
+    assert again is not None and again.id == ev.id
+
+
 def test_blocked_evals_missed_unblock():
     """Capacity that appears BETWEEN the scheduler snapshot and the
     block() call must re-enqueue immediately (reference
