@@ -126,8 +126,14 @@ def density(h, jobs) -> tuple[int, int]:
 
 
 def tpu_place(h, jobs, config=None, warm=True, resident=None):
-    """Solve + submit all jobs' evals in one batch; returns (dt, plans)."""
-    from nomad_tpu import mock
+    """Solve + submit all jobs' evals in one batch; returns (dt, plans).
+
+    With BENCH_TRACE=1 every measured batch runs under a trace context
+    (nomad_tpu/trace.py), so the BENCH breakdown comes from the SAME
+    span machinery production serves at /v1/traces — not a parallel set
+    of hand-wired timers. The trace rides the global recorder; the
+    configs' summaries are published under each result's "trace" key."""
+    from nomad_tpu import mock, trace
     from nomad_tpu.scheduler.tpu import solve_eval_batch
 
     snap = h.snapshot()
@@ -140,12 +146,45 @@ def tpu_place(h, jobs, config=None, warm=True, resident=None):
             resident=resident,
         )
     evals = [mock.eval_for_job(job) for job in jobs]
+    ctx = trace.start_trace("bench.batch", evals=len(evals))
     t0 = time.perf_counter()
-    plans = solve_eval_batch(snap, h, evals, config, resident=resident)
-    for ev in evals:
-        h.submit_plan(plans[ev.id])
+    with trace.use(ctx):
+        plans = solve_eval_batch(snap, h, evals, config, resident=resident)
+        with trace.span(ctx, "plan.submit"):
+            for ev in evals:
+                h.submit_plan(plans[ev.id])
     dt = time.perf_counter() - t0
+    if ctx is not None:
+        ctx.finish()
     return dt, plans
+
+
+def trace_summary() -> dict | None:
+    """Critical-path summary of the bench.batch traces recorded so far
+    (BENCH_TRACE=1): top span names by total self-time, from the same
+    machinery /v1/traces and `operator trace -summary` read. Drains the
+    recorder so each config reports only its own batches."""
+    from nomad_tpu import trace
+
+    if not trace.enabled():
+        return None
+    rec = trace.recorder()
+    summaries = rec.list(name="bench.batch", limit=100)
+    traces = [rec.get(s["id"]) for s in summaries]
+    traces = [t for t in traces if t is not None]
+    if not traces:
+        return None
+    top = trace.critical_path(traces, top=8)
+    out = {
+        "batches": len(traces),
+        "top_self_time_ms": {
+            name: round(ns / 1e6, 3) for name, ns in top
+        },
+        "last_trace_id": summaries[0]["id"],
+        "last_coverage": round(trace.coverage(traces[0]), 4),
+    }
+    rec.clear()
+    return out
 
 
 def spread_pct(vals) -> float:
@@ -810,6 +849,13 @@ def _ensure_device() -> dict:
 
 def main():
     device = _ensure_device()
+    if os.environ.get("BENCH_TRACE"):
+        # per-batch span emission through the production tracing
+        # subsystem (trace.py); each config's critical-path summary
+        # lands under its result's "trace" key
+        from nomad_tpu import trace as _trace
+
+        _trace.configure(max_traces=256, enabled_=True)
     sel = os.environ.get("BENCH_CONFIG", "all")
     names = (
         ["smoke", "c1k", "c2m", "preempt", "drain", "plan_apply", "pipeline"]
@@ -833,6 +879,9 @@ def main():
             results[name] = run_pipeline_config()
         else:
             raise SystemExit(f"unknown BENCH_CONFIG {name}")
+        tsum = trace_summary()
+        if tsum is not None:
+            results[name]["trace"] = tsum
 
     headline = "c2m" if "c2m" in results else names[0]
     hl = results[headline]

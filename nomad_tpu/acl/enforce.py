@@ -129,6 +129,9 @@ _AGENT_WRITE = [
 _AGENT_READ = [
     ("GET", re.compile(r"^/v1/agent/.*$")),
     ("GET", re.compile(r"^/v1/metrics$")),
+    # traces expose request-level internals (job/eval ids, stage
+    # timings): same agent:read gate as /v1/metrics
+    ("GET", re.compile(r"^/v1/traces(/.*)?$")),
 ]
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)

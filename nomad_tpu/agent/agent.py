@@ -189,6 +189,12 @@ class AgentConfig:
     # wire format + constant |#tags (node/region/dc)
     telemetry_datadog_address: str = ""
     telemetry_interval_s: float = 10.0
+    # eval-lifecycle tracing (trace.py): OFF by default — the no-op path
+    # costs nothing on the hot paths. telemetry { trace_enabled = true
+    # trace_buffer = 256 } turns on span collection into a bounded ring
+    # served at /v1/traces; reloadable via SIGHUP (Agent.reload).
+    trace_enabled: bool = False
+    trace_buffer: int = 256
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -330,6 +336,13 @@ class Agent:
             )
 
     def start(self) -> None:
+        if self.config.trace_enabled:
+            from .. import trace
+
+            trace.configure(
+                max_traces=self.config.trace_buffer, enabled_=True
+            )
+            self._trace_owner = True
         if self.server is not None:
             self.server.start()
             if self.config.server_join:
@@ -439,6 +452,20 @@ class Agent:
             old.node_meta = dict(new_config.node_meta)
             changed.append("client_node_meta")
         if (
+            new_config.trace_enabled != old.trace_enabled
+            or new_config.trace_buffer != old.trace_buffer
+        ):
+            from .. import trace
+
+            trace.configure(
+                max_traces=new_config.trace_buffer,
+                enabled_=new_config.trace_enabled,
+            )
+            self._trace_owner = new_config.trace_enabled
+            old.trace_enabled = new_config.trace_enabled
+            old.trace_buffer = new_config.trace_buffer
+            changed.append("trace")
+        if (
             self.server is not None
             and new_config.vault_allowed_policies != old.vault_allowed_policies
         ):
@@ -452,6 +479,12 @@ class Agent:
         return changed
 
     def shutdown(self) -> None:
+        if getattr(self, "_trace_owner", False):
+            # tracing state is process-global (like the metrics registry):
+            # only the agent that enabled it turns it back off
+            from .. import trace
+
+            trace.set_enabled(False)
         if getattr(self, "statsd", None) is not None:
             self.statsd.stop()
             self.statsd = None

@@ -1149,6 +1149,34 @@ class HTTPAgentServer:
                 )
             return metrics.snapshot()
 
+        def traces_list(p, q, body, tok):
+            # /v1/traces: the tracing ring buffer (trace.py) — newest
+            # first, filterable by eval/job id and trace name. Follows
+            # the /v1/metrics pattern: agent-local observability surface.
+            from .. import trace as _trace
+
+            try:
+                limit = int(q.get("limit", ["50"])[0])
+            except ValueError:
+                raise HTTPError(400, "limit must be an integer")
+            return _trace.recorder().list(
+                name=q.get("name", [""])[0],
+                eval_id=q.get("eval_id", [""])[0],
+                job_id=q.get("job_id", [""])[0],
+                limit=max(1, min(limit, 1000)),
+            )
+
+        def trace_get(p, q, body, tok):
+            from .. import trace as _trace
+
+            t = _trace.recorder().get(p["id"])
+            if t is None:
+                raise HTTPError(404, f"trace {p['id']} not found")
+            return t
+
+        route("GET", "/v1/traces", traces_list)
+        route("GET", "/v1/traces/(?P<id>[^/]+)", trace_get)
+
         def agent_members(p, q, body, tok):
             return [m.to_wire() for m in self.cluster.serf.members()]
 
@@ -2054,7 +2082,33 @@ class HTTPAgentServer:
                         if match is None:
                             continue
                         body = json.loads(raw_body or b"{}")
-                        result = fn(match.groupdict(), query, body, token)
+                        # Write requests open a trace when tracing is on:
+                        # the RPC fabric forwards the context, so a
+                        # submit on a follower stitches through to the
+                        # leader's raft apply (trace.py).
+                        hctx = None
+                        if method != "GET":
+                            from .. import trace as _trace
+
+                            hctx = _trace.start_trace(
+                                "http", method=method, path=parsed.path
+                            )
+                        if hctx is not None:
+                            try:
+                                with _trace.use(hctx):
+                                    result = fn(
+                                        match.groupdict(), query, body, token
+                                    )
+                            except BaseException as e:
+                                # a failed write must not be recorded as
+                                # status=ok — the surface exists to debug
+                                # exactly these
+                                hctx.set_attr("error", type(e).__name__)
+                                hctx.finish("error")
+                                raise
+                            hctx.finish()
+                        else:
+                            result = fn(match.groupdict(), query, body, token)
                         index = None
                         if isinstance(result, tuple):
                             result, index = result

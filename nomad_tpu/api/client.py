@@ -69,6 +69,7 @@ class NomadClient:
         self.search = Search(self)
         self.system = SystemAPI(self)
         self.scaling = Scaling(self)
+        self.traces = Traces(self)
 
     # -- plumbing ------------------------------------------------------
 
@@ -452,6 +453,25 @@ class Scaling(_Resource):
 
     def get_policy(self, policy_id: str):
         return self.c.get(f"/v1/scaling/policy/{policy_id}")
+
+
+class Traces(_Resource):
+    """The agent's eval-lifecycle tracing ring (/v1/traces, trace.py)."""
+
+    def list(self, name: str = "", eval_id: str = "", job_id: str = "",
+             limit: int = 50):
+        return self.c.get(
+            "/v1/traces",
+            params={
+                "name": name,
+                "eval_id": eval_id,
+                "job_id": job_id,
+                "limit": limit,
+            },
+        )
+
+    def get(self, trace_id: str):
+        return self.c.get(f"/v1/traces/{trace_id}")
 
 
 class SystemAPI(_Resource):

@@ -272,6 +272,9 @@ def _load_agent_config(path: str):
             cfg.telemetry_interval_s = parse_duration(
                 tea["collection_interval"]
             )
+        cfg.trace_enabled = bool(tea.get("trace_enabled", False))
+        if "trace_buffer" in tea:
+            cfg.trace_buffer = int(tea["trace_buffer"])
     for plug in body.blocks("plugin"):
         name = plug.labels[0] if plug.labels else ""
         ref = plug.body.attrs().get("factory", "")
@@ -326,6 +329,9 @@ def _apply_config_dict(cfg, data: dict) -> None:
             cfg.telemetry_datadog_address = str(
                 v.get("datadog_address", "")
             )
+            cfg.trace_enabled = bool(v.get("trace_enabled", False))
+            if "trace_buffer" in v:
+                cfg.trace_buffer = int(v["trace_buffer"])
             if "collection_interval" in v:
                 cfg.telemetry_interval_s = parse_duration(
                     v["collection_interval"]
@@ -1934,6 +1940,67 @@ def cmd_operator_metrics(args) -> int:
     return 0
 
 
+def cmd_operator_trace(args) -> int:
+    """Render eval-lifecycle traces from the agent's /v1/traces ring
+    (trace.py): span tree with self-times for one trace, a listing when
+    no id is given, and -summary for the critical-path analyzer (top
+    span names by total self-time across the last N traces)."""
+    from ..trace import critical_path, render_tree
+
+    api = _client(args)
+    if args.summary:
+        summaries = api.traces.list(
+            name=args.name, eval_id=args.eval_id, job_id=args.job_id,
+            limit=args.n,
+        )
+        if not summaries:
+            print("No traces recorded (is trace_enabled on?)")
+            return 1
+        traces = [api.traces.get(s["id"]) for s in summaries]
+        total_ms = sum(t.get("duration_ms") or 0 for t in traces)
+        print(
+            f"Critical path over last {len(traces)} traces "
+            f"({total_ms:.1f}ms total): top spans by self-time"
+        )
+        rows = [
+            [name, f"{ns / 1e6:.3f}ms",
+             f"{ns / max(total_ms * 1e6, 1) * 100:.1f}%"]
+            for name, ns in critical_path(traces, top=args.top)
+        ]
+        print(_fmt_table(rows, ["Span", "Self Time", "Of Total"]))
+        return 0
+    if args.trace_id:
+        trace_doc = api.traces.get(args.trace_id)
+        print(render_tree(trace_doc))
+        return 0
+    summaries = api.traces.list(
+        name=args.name, eval_id=args.eval_id, job_id=args.job_id,
+        limit=args.n,
+    )
+    if not summaries:
+        print("No traces recorded (is trace_enabled on?)")
+        return 1
+    rows = []
+    for s in summaries:
+        a = s.get("attrs") or {}
+        rows.append(
+            [
+                s["id"],
+                s["name"],
+                f"{s.get('duration_ms', 0)}ms",
+                str(s.get("num_spans", 0)),
+                a.get("status", ""),
+                a.get("eval_id", "") or ",".join(
+                    (a.get("eval_ids") or [])[:2]
+                ),
+            ]
+        )
+    print(_fmt_table(
+        rows, ["ID", "Name", "Duration", "Spans", "Status", "Evals"]
+    ))
+    return 0
+
+
 def cmd_operator_raft_list_peers(args) -> int:
     """Reference: command/operator_raft_list.go."""
     api = _client(args)
@@ -2481,6 +2548,21 @@ def build_parser() -> argparse.ArgumentParser:
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)
+    optr = opsub.add_parser(
+        "trace", help="render eval-lifecycle traces (/v1/traces)"
+    )
+    optr.add_argument("trace_id", nargs="?", default="")
+    optr.add_argument("-summary", action="store_true",
+                      help="critical-path: top spans by total self-time")
+    optr.add_argument("-n", type=int, default=20,
+                      help="how many recent traces to list/summarize")
+    optr.add_argument("-top", type=int, default=5,
+                      help="how many span names in the summary")
+    optr.add_argument("-name", default="",
+                      help="filter by trace name (eval, tpu.batch, http)")
+    optr.add_argument("-eval-id", dest="eval_id", default="")
+    optr.add_argument("-job-id", dest="job_id", default="")
+    optr.set_defaults(fn=cmd_operator_trace)
     _args_operator_debug(opsub.add_parser("debug"))
     opsch = opsub.add_parser("scheduler")
     opschsub = opsch.add_subparsers(dest="subsubcmd")

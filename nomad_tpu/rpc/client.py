@@ -14,9 +14,16 @@ import socket
 import threading
 from typing import Optional
 
-from .. import codec
+from .. import codec, trace
 from .server import StreamSession
-from .wire import BYTE_RPC, BYTE_STREAMING, recv_frame, send_frame
+from .wire import (
+    BYTE_RPC,
+    BYTE_STREAMING,
+    TRACE_KEY,
+    TRACE_SPANS_KEY,
+    recv_frame,
+    send_frame,
+)
 
 logger = logging.getLogger("nomad_tpu.rpc")
 
@@ -87,20 +94,40 @@ class _Conn:
             if self.dead:
                 raise ConnectionError("connection closed")
             self._pending[seq] = waiter
+        # Trace propagation (wire.py TRACE_KEY): when the calling thread
+        # carries a trace, the request envelope forwards its context and
+        # the response brings the remote segment's spans home.
+        tctx = trace.current()
+        rpc_span = None
+        if tctx is not None:
+            rpc_span = tctx.start_span("rpc.call", method=method)
+        # the span must end on EVERY exit (a codec TypeError included) or
+        # it stays open on this thread's active-span stack and mis-parents
+        # everything the thread records afterwards
         try:
-            payload = codec.pack({"seq": seq, "method": method, "args": args})
-            with self._wlock:
-                send_frame(self.sock, payload)
-        except (ConnectionError, OSError):
-            with self._pending_lock:
-                self._pending.pop(seq, None)
-            self.dead = True
-            raise
-        if not waiter["event"].wait(timeout_s):
+            try:
+                req = {"seq": seq, "method": method, "args": args}
+                if tctx is not None:
+                    req[TRACE_KEY] = trace.wire_ref(tctx, rpc_span)
+                payload = codec.pack(req)
+                with self._wlock:
+                    send_frame(self.sock, payload)
+            except (ConnectionError, OSError):
+                with self._pending_lock:
+                    self._pending.pop(seq, None)
+                self.dead = True
+                raise
+            ok = waiter["event"].wait(timeout_s)
+        finally:
+            if rpc_span is not None:
+                tctx.end_span(rpc_span)
+        if not ok:
             with self._pending_lock:
                 self._pending.pop(seq, None)
             raise TimeoutError(f"rpc {method} timed out after {timeout_s}s")
         resp = waiter["resp"]
+        if tctx is not None and resp.get(TRACE_SPANS_KEY):
+            tctx.merge_remote(resp[TRACE_SPANS_KEY], rpc_span)
         if "error" in resp:
             if resp["error"] == "connection closed":
                 raise ConnectionError("connection closed")

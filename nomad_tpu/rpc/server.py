@@ -31,8 +31,16 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
-from .. import codec
-from .wire import BYTE_RAFT, BYTE_RPC, BYTE_STREAMING, recv_frame, send_frame
+from .. import codec, trace
+from .wire import (
+    BYTE_RAFT,
+    BYTE_RPC,
+    BYTE_STREAMING,
+    TRACE_KEY,
+    TRACE_SPANS_KEY,
+    recv_frame,
+    send_frame,
+)
 
 logger = logging.getLogger("nomad_tpu.rpc")
 
@@ -256,12 +264,25 @@ class RPCServer:
     def _dispatch(self, conn: socket.socket, wlock: threading.Lock, req) -> None:
         seq = req.get("seq")
         method = req.get("method", "")
+        # Remote trace segment (wire.py TRACE_KEY): the handler runs with
+        # the caller's trace installed as this thread's current context,
+        # so every span recorded below (raft applies included) stitches
+        # into the originator's trace; the spans ride back in the
+        # response rather than landing in this server's ring.
+        segment = None
+        ref = req.get(TRACE_KEY)
+        if isinstance(ref, dict) and ref.get("id"):
+            segment = trace.open_segment(f"rpc.{method}", ref)
         try:
-            result = self.dispatch_local(method, req.get("args"))
+            with trace.use(segment):
+                result = self.dispatch_local(method, req.get("args"))
             resp = {"seq": seq, "result": result}
         except Exception as e:  # handler errors travel as strings
             logger.debug("rpc %s failed: %s", method, e)
             resp = {"seq": seq, "error": f"{type(e).__name__}: {e}"}
+        if segment is not None:
+            segment.finish(record=False)
+            resp[TRACE_SPANS_KEY] = [s.to_wire() for s in segment.spans]
         try:
             with wlock:
                 send_frame(conn, codec.pack(resp))

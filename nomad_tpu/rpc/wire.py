@@ -18,6 +18,16 @@ BYTE_RPC = 0x01
 BYTE_RAFT = 0x02
 BYTE_STREAMING = 0x03
 
+# Trace-context propagation fields in the RPC envelope (trace.py): a
+# request may carry TRACE_KEY = {"id": trace_id, "parent": span_id}; the
+# handler side opens a remote segment of that trace and sends its spans
+# back under TRACE_SPANS_KEY in the response, so a trace stitches a
+# client submit on a follower to the raft apply on the leader. Absent
+# fields cost nothing — the envelope stays byte-identical when tracing
+# is off.
+TRACE_KEY = "trace"
+TRACE_SPANS_KEY = "trace_spans"
+
 MAX_FRAME = 256 * 1024 * 1024
 
 _LEN = struct.Struct("!I")

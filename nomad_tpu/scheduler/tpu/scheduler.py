@@ -17,8 +17,10 @@ Two operating modes:
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
+from ... import trace
 from ...structs import Evaluation, Plan
 from ...structs.structs import (
     DEPLOYMENT_STATUS_FAILED,
@@ -247,7 +249,9 @@ class PendingEvalBatch:
         if not self._finished:
             outcome = self._pending.finish()
             with paused_gc():
+                t0 = time.monotonic_ns()
                 _attach_outcome(self.state, self.evals, self.plans, outcome)
+                trace.stage("plan.assemble", time.monotonic_ns() - t0)
             self._finished = True
         return self.plans
 
@@ -268,7 +272,9 @@ def solve_eval_batch_begin(
     PendingEvalBatch.chain, so this solve sees its placements."""
     config = config or SchedulerConfig()
     with paused_gc():
+        t0 = time.monotonic_ns()
         plans, asks = _reconcile_eval_batch(state, planner, evals, config)
+        trace.stage("reconcile", time.monotonic_ns() - t0)
         solver = BatchSolver(
             state, config, solve_fn=solve_fn,
             solve_preempt_fn=solve_preempt_fn, resident=resident,

@@ -38,6 +38,7 @@ from ...structs import (
     generate_uuids,
     now_ns,
 )
+from ... import trace
 from ...gctune import paused_gc
 from ..context import EvalContext, SchedulerConfig
 from ..reconcile import PlacementRequest
@@ -374,8 +375,9 @@ class BatchSolver:
         # accounting) that this solve must observe.
         self._partition_placed: list = []
         self._partition_plans: list = []
-        # (id(job), tg_name) -> _MintTemplate, shared across a batch's
-        # groups (spread sub-groups and the relaxation retry re-hit it).
+        # (eval_id, id(job), tg_name) -> _MintTemplate, shared across a
+        # batch's groups (spread sub-groups and the relaxation retry
+        # re-hit it; keyed by eval so same-job evals never cross-stamp).
         self._mint_cache: dict[tuple, _MintTemplate] = {}
 
     def solve(self, asks: list[GroupAsk]) -> SolveOutcome:
@@ -470,6 +472,7 @@ class BatchSolver:
             out.solve_ns = now_ns() - t0
             metrics.time_ns("nomad.tpu.solve_seconds", out.solve_ns)
             metrics.observe("nomad.tpu.small_batch_requests", total_requests)
+            trace.stage("host_solve", out.solve_ns)
             return out
         # Priority order: higher-priority jobs consume capacity first
         # (mirrors the eval broker's priority dequeue).
@@ -789,6 +792,7 @@ class BatchSolver:
         # Alloc materialization joins the host_prep/device/readback stage
         # registry so the bench's breakdown covers the full commit half.
         metrics.time_ns("nomad.tpu.materialize_seconds", mat_ns)
+        trace.stage("materialize", mat_ns)
         metrics.observe("nomad.tpu.solve_groups", out.groups)
         return out
 
@@ -1093,7 +1097,9 @@ class BatchSolver:
             ucap_idx,
             max_count=maxc,
         )
-        metrics.time_ns("nomad.tpu.host_prep_seconds", now_ns() - t_prep0)
+        prep_ns = now_ns() - t_prep0
+        metrics.time_ns("nomad.tpu.host_prep_seconds", prep_ns)
+        trace.stage("host_prep", prep_ns)
         return inst, over, used_out, g, n, time.perf_counter()
 
     def _run_compact_finish(self, pending):
@@ -1109,12 +1115,16 @@ class BatchSolver:
         t_dev0 = now_ns()
         jax.block_until_ready(used_out)
         self._inject_rtt(t_disp)
-        metrics.time_ns("nomad.tpu.device_seconds", now_ns() - t_dev0)
+        dev_ns = now_ns() - t_dev0
+        metrics.time_ns("nomad.tpu.device_seconds", dev_ns)
+        trace.stage("device.wait", dev_ns)
         t_rb0 = now_ns()
         # slice on-device before the host transfer: the pad region is
         # noise and the tunnel to the chip is the slow link
         result = np.asarray(inst[:g]), np.asarray(over[:n]), used_out
-        metrics.time_ns("nomad.tpu.readback_seconds", now_ns() - t_rb0)
+        rb_ns = now_ns() - t_rb0
+        metrics.time_ns("nomad.tpu.readback_seconds", rb_ns)
+        trace.stage("readback", rb_ns)
         return result
 
     def _run_kernel(
@@ -1180,12 +1190,17 @@ class BatchSolver:
         on-device slice happens before the host transfer: the pad region
         is zeros and the tunnel to the chip is the slow link."""
         assign, assign_evict, used_out, g, n, t_disp = pending
+        t_dev0 = now_ns()
         self._inject_rtt(t_disp)
-        return (
+        result = (
             np.asarray(assign[:g, :n]),
             None if assign_evict is None else np.asarray(assign_evict[:g, :n]),
             used_out,
         )
+        # dense path: blocking transfer includes the device wait, so the
+        # two land as one combined stage span
+        trace.stage("device.readback", now_ns() - t_dev0)
+        return result
 
     def _inject_rtt(self, t_disp: float) -> None:
         """Simulated chip round-trip (docs/pipeline.md): results become
@@ -1344,7 +1359,12 @@ class BatchSolver:
                         continue
                     placements.append(alloc)
             else:
-                tmpl_key = (id(grp.job), tg.name)
+                # keyed by eval too: the broker serializes evals per job,
+                # but solve_eval_batch is public API — two evals of one
+                # job in a batch must not stamp each other's eval_id
+                # (the intended reuse — spread sub-groups, the
+                # relaxation retry — is all within one eval)
+                tmpl_key = (eval_id, id(grp.job), tg.name)
                 tmpl = self._mint_cache.get(tmpl_key)
                 if tmpl is None:
                     shared_res = AllocatedResources(

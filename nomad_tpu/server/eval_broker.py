@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Optional
 
+from .. import trace
 from ..structs import Evaluation, generate_uuid, now_ns
 
 DEFAULT_NACK_DELAY_S = 5.0
@@ -75,6 +76,10 @@ class EvalBroker:
         self._delayed: list = []
         self._delayed_counter = itertools.count()
         self._attempts: dict[str, int] = {}  # eval id -> deliveries
+        # eval id -> (TraceContext, open Span) — the per-eval lifecycle
+        # trace started at enqueue (trace.py). Bounded by queue depth:
+        # entries leave at ack / dead-letter / flush.
+        self._traces: dict[str, tuple] = {}
         self._timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.stats = {
@@ -114,6 +119,8 @@ class EvalBroker:
         self._blocked_jobs.clear()
         self._delayed.clear()
         self._attempts.clear()
+        # leadership loss: in-flight traces are abandoned, not recorded
+        self._traces.clear()
 
     # -- enqueue -------------------------------------------------------
 
@@ -129,6 +136,19 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation) -> None:
         if not self._enabled:
             return
+        if trace.enabled() and ev.id not in self._traces:
+            ctx = trace.start_trace(
+                "eval",
+                eval_id=ev.id,
+                job_id=ev.job_id,
+                type=ev.type,
+                triggered_by=ev.triggered_by,
+            )
+            if ctx is not None:
+                self._traces[ev.id] = (
+                    ctx,
+                    ctx.start_span("broker.wait", detached=True),
+                )
         if ev.wait_until_ns and ev.wait_until_ns > now_ns():
             heapq.heappush(
                 self._delayed, (ev.wait_until_ns, next(self._delayed_counter), ev)
@@ -164,6 +184,22 @@ class EvalBroker:
                         attempts = self._attempts.get(ev.id, 0) + 1
                         self._attempts[ev.id] = attempts
                         self._unacked[ev.id] = (ev, token, attempts)
+                        entry = self._traces.get(ev.id)
+                        if entry is not None:
+                            ctx, open_span = entry
+                            ctx.end_span(open_span)
+                            # NOT detached: dequeue runs on the worker's
+                            # own thread, so the processing span rides
+                            # that thread's stack and the worker's
+                            # snapshot/scheduler/plan spans nest under it
+                            self._traces[ev.id] = (
+                                ctx,
+                                ctx.start_span(
+                                    "processing",
+                                    parent=ctx.root,
+                                    attempt=attempts,
+                                ),
+                            )
                         return ev, token
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -198,6 +234,11 @@ class EvalBroker:
             ev = entry[0]
             self._attempts.pop(eval_id, None)
             self._release_job_locked(ev, eval_id)
+            tentry = self._traces.pop(eval_id, None)
+        if tentry is not None:
+            ctx, open_span = tentry
+            ctx.end_span(open_span)
+            ctx.finish("ok")
 
     def nack(self, eval_id: str, token: str) -> None:
         with self._lock:
@@ -215,9 +256,27 @@ class EvalBroker:
                 self._ready.setdefault(FAILED_QUEUE, _PendingHeap()).push(ev)
                 self.stats["failed"] += 1
                 self._cv.notify_all()
+                tentry = self._traces.pop(eval_id, None)
+                if tentry is not None:
+                    ctx, open_span = tentry
+                    open_span.attrs = dict(open_span.attrs or {},
+                                           outcome="nack")
+                    ctx.end_span(open_span)
+                    ctx.finish("failed")
                 return
             if self._in_flight.get(key) == eval_id:
                 del self._in_flight[key]
+            tentry = self._traces.get(eval_id)
+            if tentry is not None:
+                ctx, open_span = tentry
+                open_span.attrs = dict(open_span.attrs or {}, outcome="nack")
+                ctx.end_span(open_span)
+                self._traces[eval_id] = (
+                    ctx,
+                    ctx.start_span(
+                        "nack.wait", parent=ctx.root, detached=True
+                    ),
+                )
             # re-enqueue after the nack delay
             requeue_at = now_ns() + int(self.nack_delay_s * 1e9)
             heapq.heappush(
@@ -257,6 +316,23 @@ class EvalBroker:
             self._stop.wait(wait)
 
     # -- introspection -------------------------------------------------
+
+    def trace_context(self, eval_id: str):
+        """The in-flight eval's TraceContext (None when untracked): the
+        worker installs it as the thread's current context so scheduler
+        and plan spans land on the eval's own trace."""
+        with self._lock:
+            entry = self._traces.get(eval_id)
+        return entry[0] if entry is not None else None
+
+    def annotate_trace(self, eval_id: str, **attrs) -> None:
+        """Attach attrs to an in-flight eval's trace (the TPU batch
+        worker links each eval to its batch trace this way)."""
+        with self._lock:
+            entry = self._traces.get(eval_id)
+        if entry is not None:
+            for k, v in attrs.items():
+                entry[0].set_attr(k, v)
 
     def ready_count(self) -> int:
         with self._lock:

@@ -32,6 +32,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from .. import trace
 from ..gctune import paused_gc
 from ..state.store import usage_contribution
 from ..structs import Plan, PlanResult, allocs_fit
@@ -524,10 +525,15 @@ class PlanApplier:
             item = self.queue.dequeue(timeout_s=0.2)
             if item is None:
                 continue
-            plan, fut = item
+            plan, fut, tref = item
+            # tref: (TraceContext, parent Span) handed through the queue
+            # by the submitting worker — applier-side verify/apply spans
+            # land on the SAME trace, nested under its plan.submit span.
+            ctx = tref[0] if tref is not None else None
             if isinstance(plan, list):
                 try:
-                    self._apply_batch(plan, fut)
+                    with trace.use(ctx):
+                        self._apply_batch(plan, fut, tref)
                 except Exception as e:  # pragma: no cover - defensive
                     logger.exception("plan batch apply failed")
                     for f in fut:
@@ -535,7 +541,8 @@ class PlanApplier:
                             f.set_exception(e)
                 continue
             try:
-                self._apply_pipelined(plan, fut)
+                with trace.use(ctx):
+                    self._apply_pipelined(plan, fut, tref)
             except Exception as e:  # pragma: no cover - defensive
                 logger.exception("plan apply failed")
                 if not fut.done():
@@ -543,7 +550,8 @@ class PlanApplier:
 
     # -- pipelined path -------------------------------------------------
 
-    def _apply_pipelined(self, plan: Plan, fut) -> None:
+    def _apply_pipelined(self, plan: Plan, fut, tref=None) -> None:
+        tctx, tparent = tref if tref is not None else (None, None)
         pipelining = self.raft_apply_async is not None
         self._absorb_commit_failure()
         if pipelining and self._inflight is not None and _plan_touches_volumes(plan):
@@ -562,18 +570,23 @@ class PlanApplier:
         # the process-wide collector off (the raft/store paths pause
         # around their own bursts).
         with paused_gc():
-            result = evaluate_plan(snapshot, plan)
+            with trace.span(tctx, "plan.verify", parent=tparent):
+                result = evaluate_plan(snapshot, plan)
             if result.is_no_op():
                 fut.set_result(result)
                 return
             result.preemption_evals = self._preemption_evals(result)
             self._normalize(plan, result)
         if not pipelining:
-            index = self.raft_apply("apply_plan_results", result)
+            with trace.span(tctx, "plan.raft_apply", parent=tparent):
+                index = self.raft_apply("apply_plan_results", result)
             result.alloc_index = index
             fut.set_result(result)
             return
-        index, wait_fn = self.raft_apply_async("apply_plan_results", result)
+        with trace.span(tctx, "plan.raft_apply", parent=tparent):
+            index, wait_fn = self.raft_apply_async(
+                "apply_plan_results", result
+            )
         # Depth-1 pipeline: wait out the PREVIOUS commit (its replication
         # overlapped with the verification we just finished) before
         # recording this one as in flight.
@@ -587,33 +600,43 @@ class PlanApplier:
     # -- merged batch path ----------------------------------------------
 
     def _commit_merged(
-        self, plans: list[Plan], merged_idx: list[int], snapshot
+        self, plans: list[Plan], merged_idx: list[int], snapshot,
+        tref=None, round_no: int = 0,
     ) -> dict[int, PlanResult]:
         """Verify the merged (node-disjoint) subset against one snapshot
         and commit every non-no-op result as ONE raft entry backed by one
         bulk store transaction."""
+        tctx, tparent = tref if tref is not None else (None, None)
         results: dict[int, PlanResult] = {}
         to_commit: list[tuple[int, PlanResult]] = []
         with paused_gc():
-            for i in merged_idx:
-                result = evaluate_plan(snapshot, plans[i])
-                if result.is_no_op():
-                    results[i] = result
-                    continue
-                result.preemption_evals = self._preemption_evals(result)
-                self._normalize(plans[i], result)
-                to_commit.append((i, result))
+            with trace.span(
+                tctx, "plan.verify", parent=tparent,
+                round=round_no, plans=len(merged_idx),
+            ):
+                for i in merged_idx:
+                    result = evaluate_plan(snapshot, plans[i])
+                    if result.is_no_op():
+                        results[i] = result
+                        continue
+                    result.preemption_evals = self._preemption_evals(result)
+                    self._normalize(plans[i], result)
+                    to_commit.append((i, result))
         if to_commit:
-            index = self.raft_apply(
-                "apply_plan_results_batch", [r for _, r in to_commit]
-            )
+            with trace.span(
+                tctx, "plan.raft_apply", parent=tparent,
+                round=round_no, plans=len(to_commit),
+            ):
+                index = self.raft_apply(
+                    "apply_plan_results_batch", [r for _, r in to_commit]
+                )
             for i, r in to_commit:
                 r.alloc_index = index
                 results[i] = r
         return results
 
     def _commit_merged_rounds(
-        self, plans: list[Plan], snapshot
+        self, plans: list[Plan], snapshot, tref=None
     ) -> tuple[dict[int, PlanResult], list[int]]:
         """Round-partitioned merged commit: each round commits the
         mutually node-disjoint prefix of the REMAINING plans as one raft
@@ -643,7 +666,9 @@ class PlanApplier:
                 snapshot = self.state.snapshot()
             round_idx = [remaining[r] for r in rel_merged]
             results.update(
-                self._commit_merged(plans, round_idx, snapshot)
+                self._commit_merged(
+                    plans, round_idx, snapshot, tref=tref, round_no=rounds
+                )
             )
             merged_total += len(round_idx)
             rounds += 1
@@ -653,7 +678,7 @@ class PlanApplier:
         metrics.observe("nomad.plan_apply.batch_serial", len(remaining))
         return results, remaining
 
-    def _apply_batch(self, plans: list[Plan], futs: list) -> None:
+    def _apply_batch(self, plans: list[Plan], futs: list, tref=None) -> None:
         """Queue-dequeued batch: round-partitioned merged commits for
         everything node-partitionable, serial fallback (in order) for
         the volume-touching rest.
@@ -677,7 +702,9 @@ class PlanApplier:
                 self._inflight = None
             else:  # pragma: no cover - drain above makes this unreachable
                 snapshot = OverlaySnapshot(snapshot, res, job)
-        results, serial_idx = self._commit_merged_rounds(plans, snapshot)
+        results, serial_idx = self._commit_merged_rounds(
+            plans, snapshot, tref=tref
+        )
         for i, r in results.items():
             futs[i].set_result(r)
         # Volume-touching plans re-verify against post-merge state via
@@ -685,7 +712,7 @@ class PlanApplier:
         # as they always did.
         for i in serial_idx:
             try:
-                self._apply_pipelined(plans[i], futs[i])
+                self._apply_pipelined(plans[i], futs[i], tref)
             except Exception as e:  # pragma: no cover - defensive
                 logger.exception("serial fallback apply failed")
                 if not futs[i].done():
@@ -695,6 +722,12 @@ class PlanApplier:
         """Synchronous merged verify+commit of a plan batch (direct
         callers and tests; the dequeue loop routes queue batches through
         the same partition/merge core)."""
+        # Same preamble as the queue batch path: a pipelined single-plan
+        # commit still in flight is invisible to a fresh committed-state
+        # snapshot — verifying without draining it would double-book the
+        # node it landed on. No-ops when nothing is outstanding.
+        self._drain()
+        self._absorb_commit_failure()
         results, serial_idx = self._commit_merged_rounds(
             plans, self.state.snapshot()
         )

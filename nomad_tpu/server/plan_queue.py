@@ -28,7 +28,7 @@ class PlanQueue:
             was = self._enabled
             self._enabled = enabled
             if was and not enabled:
-                for _, _, _, fut in self._heap:
+                for _, _, _, fut, _tctx in self._heap:
                     if isinstance(fut, list):
                         for f in fut:
                             f.cancel()
@@ -41,19 +41,22 @@ class PlanQueue:
     def enabled(self) -> bool:
         return self._enabled
 
-    def enqueue(self, plan: Plan) -> Future:
+    def enqueue(self, plan: Plan, trace_ctx=None) -> Future:
+        """trace_ctx — optional (TraceContext, parent Span) the applier
+        records its verify/apply spans under (trace.py)."""
         fut: Future = Future()
         with self._lock:
             if not self._enabled:
                 fut.set_exception(RuntimeError("plan queue is disabled"))
                 return fut
             heapq.heappush(
-                self._heap, (-plan.priority, next(self._counter), plan, fut)
+                self._heap,
+                (-plan.priority, next(self._counter), plan, fut, trace_ctx),
             )
             self._cv.notify_all()
         return fut
 
-    def enqueue_batch(self, plans: list[Plan]) -> list[Future]:
+    def enqueue_batch(self, plans: list[Plan], trace_ctx=None) -> list[Future]:
         """Enqueue N same-snapshot plans as ONE queue item so the applier
         can verify/commit them together (merged plan apply). One future
         per plan; the heap entry rides at the batch's max priority. The
@@ -69,23 +72,25 @@ class PlanQueue:
                 return futs
             prio = max(p.priority for p in plans)
             heapq.heappush(
-                self._heap, (-prio, next(self._counter), list(plans), futs)
+                self._heap,
+                (-prio, next(self._counter), list(plans), futs, trace_ctx),
             )
             self._cv.notify_all()
         return futs
 
     def dequeue(
         self, timeout_s: Optional[float] = None
-    ) -> Optional[tuple["Plan | list[Plan]", "Future | list[Future]"]]:
-        """Pop the highest-priority item. A single enqueue() yields
-        (Plan, Future); an enqueue_batch() item yields parallel
-        (list[Plan], list[Future]) — consumers must branch on
-        isinstance(plan, list) (the PlanApplier's run loop does)."""
+    ) -> Optional[tuple]:
+        """Pop the highest-priority item as (plan, fut, trace_ctx). A
+        single enqueue() yields (Plan, Future, _); an enqueue_batch()
+        item yields parallel (list[Plan], list[Future], _) — consumers
+        must branch on isinstance(plan, list) (the PlanApplier's run
+        loop does)."""
         with self._cv:
             while True:
                 if self._heap:
-                    _, _, plan, fut = heapq.heappop(self._heap)
-                    return plan, fut
+                    _, _, plan, fut, tctx = heapq.heappop(self._heap)
+                    return plan, fut, tctx
                 if not self._cv.wait(timeout_s if timeout_s is not None else 1.0):
                     if timeout_s is not None:
                         return None

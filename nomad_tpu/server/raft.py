@@ -18,6 +18,7 @@ import pickle
 import threading
 from typing import Callable, Optional
 
+from .. import trace
 from ..gctune import paused_gc
 from ..state import StateStore
 from ..structs import (
@@ -307,14 +308,22 @@ class InmemLog:
         identical state (tests/test_raft.py leader-direct equivalence).
         """
         from .. import codec
+        import time as _time
 
+        tracing = trace.enabled() and trace.current() is not None
         with paused_gc():
+            t0 = _time.monotonic_ns() if tracing else 0
             raw = codec.pack(payload)
+            if tracing:
+                trace.stage("raft.encode", _time.monotonic_ns() - t0)
             with self._lock:
                 self._index += 1
                 index = self._index
                 self._entries.append((index, msg_type, raw))
+            t0 = _time.monotonic_ns() if tracing else 0
             self.fsm.apply(index, msg_type, payload)
+            if tracing:
+                trace.stage("fsm.apply", _time.monotonic_ns() - t0)
         return index
 
     def apply_async(self, msg_type: str, payload):

@@ -16,7 +16,7 @@ import logging
 import threading
 from typing import Optional
 
-from .. import metrics
+from .. import metrics, trace
 from ..scheduler.context import SchedulerConfig
 from ..state import StateStore
 from ..state.events import wire_events
@@ -262,9 +262,12 @@ class Server:
 
     def raft_apply(self, msg_type: str, payload) -> int:
         applier = getattr(self, "_raft_applier", None)
-        if applier is not None:
-            return applier(msg_type, payload)
-        return self.log.apply(msg_type, payload)
+        # the trace's terminal hop: broker dequeue → ... → raft apply
+        # (trace.span no-ops on an untraced thread)
+        with trace.span(trace.current(), "raft.apply", type=msg_type):
+            if applier is not None:
+                return applier(msg_type, payload)
+            return self.log.apply(msg_type, payload)
 
     def raft_apply_async(self, msg_type: str, payload):
         """Submit a raft entry and return (index, wait_fn) without

@@ -22,7 +22,7 @@ import time
 from concurrent.futures import CancelledError
 from typing import Optional
 
-from .. import metrics
+from .. import metrics, trace
 from ..scheduler import new_scheduler
 from ..scheduler.context import SchedulerConfig
 from ..structs import Evaluation, Plan, PlanResult
@@ -39,13 +39,17 @@ class WorkerPlanner:
         self.server = server
 
     def submit_plan(self, plan: Plan):
-        fut = self.server.plan_queue.enqueue(plan)
-        result: PlanResult = fut.result(timeout=30)
+        ctx = trace.current()
+        with trace.span(ctx, "plan.submit") as h:
+            tref = (ctx, h.span) if ctx is not None else None
+            fut = self.server.plan_queue.enqueue(plan, trace_ctx=tref)
+            result: PlanResult = fut.result(timeout=30)
         new_state = None
         if result.refresh_index > 0:
-            new_state = self.server.state.snapshot_min_index(
-                result.refresh_index, timeout_s=5
-            )
+            with trace.span(ctx, "snapshot.refresh"):
+                new_state = self.server.state.snapshot_min_index(
+                    result.refresh_index, timeout_s=5
+                )
         return result, new_state
 
     def submit_plan_batch(self, plans: list[Plan]) -> list[PlanResult]:
@@ -54,11 +58,19 @@ class WorkerPlanner:
         apply (plan_apply.py). One snapshot wait covers every partial
         commit in the batch, so retry evals never race their own
         refresh index."""
-        futs = self.server.plan_queue.enqueue_batch(plans)
-        results: list[PlanResult] = [f.result(timeout=60) for f in futs]
+        ctx = trace.current()
+        with trace.span(ctx, "plan.submit", plans=len(plans)) as h:
+            tref = (ctx, h.span) if ctx is not None else None
+            futs = self.server.plan_queue.enqueue_batch(
+                plans, trace_ctx=tref
+            )
+            results: list[PlanResult] = [f.result(timeout=60) for f in futs]
         max_refresh = max((r.refresh_index for r in results), default=0)
         if max_refresh > 0:
-            self.server.state.snapshot_min_index(max_refresh, timeout_s=5)
+            with trace.span(ctx, "snapshot.refresh"):
+                self.server.state.snapshot_min_index(
+                    max_refresh, timeout_s=5
+                )
         return results
 
     def update_eval(self, eval_obj: Evaluation) -> None:
@@ -113,7 +125,8 @@ class Worker:
                 continue
             t0 = time.perf_counter()
             try:
-                self._process(ev)
+                with trace.use(broker.trace_context(ev.id)):
+                    self._process(ev)
             except Exception:
                 logger.exception("%s: eval %s failed", self.name, ev.id)
                 metrics.incr("nomad.worker.invoke.failed")
@@ -134,10 +147,14 @@ class Worker:
             self.processed += 1
 
     def _process(self, ev: Evaluation) -> None:
+        ctx = trace.current()
         # Wait until our snapshot has caught up to the eval's creation
         # (reference: worker.go:121 snapshotMinIndex).
         wait_index = max(ev.modify_index, ev.snapshot_index)
-        snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        with trace.span(ctx, "snapshot.wait", index=wait_index):
+            snapshot = self.server.state.snapshot_min_index(
+                wait_index, timeout_s=5
+            )
         if ev.type == "_core":
             # GC evals dispatch to the CoreScheduler, which mutates state
             # through the server's raft rather than submitting plans
@@ -150,7 +167,8 @@ class Worker:
             # is all the cleanup they need.
             return
         sched = new_scheduler(ev.type, logger, snapshot, self.planner, self.config)
-        sched.process(ev)
+        with trace.span(ctx, "scheduler.invoke", type=ev.type):
+            sched.process(ev)
 
 
 class TPUBatchWorker:
@@ -243,10 +261,13 @@ class TPUBatchWorker:
             except queue_mod.Empty:
                 break
             if item is not None:
-                batch, _pending, _snapshot, committed, outcome, _chain = item
+                (batch, _pending, _snapshot, committed, outcome,
+                 _chain, bctx) = item
                 self._nack_batch(batch)
                 outcome["ok"] = False
                 committed.set()
+                if bctx is not None:
+                    bctx.finish("stopped")
         # a stopped worker object stays referenced by the server; don't
         # let it pin the last batch's device tensors and snapshot
         self._prev = None
@@ -267,20 +288,40 @@ class TPUBatchWorker:
             if ev is None:
                 continue
             batch.append((ev, token))
-            # opportunistically drain more ready evals without waiting
-            while len(batch) < self.batch_size:
-                ev2, token2 = broker.dequeue(self.schedulers, timeout_s=0.01)
-                if ev2 is None:
-                    break
-                batch.append((ev2, token2))
-            try:
-                pending, snapshot, chained_on = self._solve_batch(
-                    [e for e, _ in batch]
+            # One trace per BATCH (the per-eval broker traces link to it
+            # via the batch attr): solve/commit stage spans are shared
+            # across the whole batch, so duplicating them per eval would
+            # multiply span volume by batch_size for no information.
+            bctx = trace.start_trace("tpu.batch")
+            with trace.span(bctx, "broker.drain"):
+                # opportunistically drain more ready evals without waiting
+                while len(batch) < self.batch_size:
+                    ev2, token2 = broker.dequeue(
+                        self.schedulers, timeout_s=0.01
+                    )
+                    if ev2 is None:
+                        break
+                    batch.append((ev2, token2))
+            if bctx is not None:
+                bctx.set_attr("evals", len(batch))
+                bctx.set_attr("eval_ids", [e.id for e, _ in batch])
+                bctx.set_attr(
+                    "job_ids", sorted({e.job_id for e, _ in batch})
                 )
+                for e, _ in batch:
+                    broker.annotate_trace(e.id, batch=bctx.trace_id)
+            try:
+                with trace.use(bctx):
+                    with trace.span(bctx, "solve.dispatch"):
+                        pending, snapshot, chained_on = self._solve_batch(
+                            [e for e, _ in batch]
+                        )
             except Exception:
                 logger.exception("tpu batch solve of %d failed", len(batch))
                 metrics.incr("nomad.worker.invoke.failed")
                 self._nack_batch(batch)
+                if bctx is not None:
+                    bctx.finish("solve-failed")
                 continue
             # outcome["ok"] is the commit verdict the NEXT batch (which
             # may have chained on this one's used' tensor) branches on:
@@ -290,27 +331,32 @@ class TPUBatchWorker:
             if not self.pipeline:
                 self._commit(
                     batch, pending, snapshot, threading.Event(),
-                    outcome, chained_on,
+                    outcome, chained_on, bctx,
                 )
                 continue
             committed = threading.Event()
             handed_off = False
+            hspan = trace.span(bctx, "commit.handoff")
+            hspan.__enter__()
             while not stop.is_set():
                 try:
                     self._commit_q.put(
                         (batch, pending, snapshot, committed,
-                         outcome, chained_on),
+                         outcome, chained_on, bctx),
                         timeout=0.2,
                     )
                     handed_off = True
                     break
                 except queue_mod.Full:
                     continue
+            hspan.__exit__(None, None, None)
             if not handed_off:
                 # stopping with a solved batch that never reached the
                 # commit stage: nack so the evals redeliver cleanly
                 self._nack_batch(batch)
                 outcome["ok"] = False
+                if bctx is not None:
+                    bctx.finish("stopped")
             else:
                 # this batch's effective capacity basis: its own snapshot
                 # unless it chained, in which case the chain's basis
@@ -331,7 +377,10 @@ class TPUBatchWorker:
             max(ev.modify_index for ev in evals),
             max(ev.snapshot_index for ev in evals),
         )
-        snapshot = self.server.state.snapshot_min_index(wait_index, timeout_s=5)
+        with trace.span(trace.current(), "snapshot.wait", index=wait_index):
+            snapshot = self.server.state.snapshot_min_index(
+                wait_index, timeout_s=5
+            )
         # Chain on the in-flight batch's post-solve usage tensor ONLY
         # while its commit is pending: once committed, the snapshot's
         # aggregate already carries those placements and the chain would
@@ -388,10 +437,12 @@ class TPUBatchWorker:
             item = cq.get()
             if item is None:
                 return
-            batch, pending, snapshot, committed, outcome, chained_on = item
+            (batch, pending, snapshot, committed, outcome,
+             chained_on, bctx) = item
             try:
                 self._commit(
-                    batch, pending, snapshot, committed, outcome, chained_on
+                    batch, pending, snapshot, committed, outcome,
+                    chained_on, bctx,
                 )
             except (Exception, CancelledError):
                 # _commit has its own guards; this is the backstop that
@@ -402,6 +453,8 @@ class TPUBatchWorker:
                 self._nack_batch(batch)
                 outcome["ok"] = False
                 committed.set()
+                if bctx is not None:
+                    bctx.finish("commit-failed")
 
     def _nack_batch(self, batch: list[tuple[Evaluation, str]]) -> None:
         broker = self.server.eval_broker
@@ -412,7 +465,8 @@ class TPUBatchWorker:
                 pass
 
     def _commit(
-        self, batch, pending, snapshot, committed, outcome, chained_on
+        self, batch, pending, snapshot, committed, outcome, chained_on,
+        bctx=None,
     ) -> None:
         broker = self.server.eval_broker
         if chained_on is not None and chained_on[0].get("ok") is False:
@@ -427,18 +481,22 @@ class TPUBatchWorker:
             self._nack_batch(batch)
             outcome["ok"] = False
             committed.set()
+            if bctx is not None:
+                bctx.finish("chain-parent-failed")
             return
         try:
-            # phase B: block on the device, read back, materialize plans
-            # (device/readback/materialize stage timers land in the
-            # solver's registry); then the plan submit is timed as the
-            # commit stage proper
-            plans = pending.finish()
-            t0 = time.perf_counter()
-            all_full = self._commit_batch(
-                [e for e, _ in batch], plans, snapshot,
-                blocked_basis=chained_on[1] if chained_on else None,
-            )
+            with trace.use(bctx):
+                # phase B: block on the device, read back, materialize
+                # plans (device/readback/materialize stage timers become
+                # spans via the solver's trace.stage calls); then the
+                # plan submit is timed as the commit stage proper
+                with trace.span(bctx, "commit.finish"):
+                    plans = pending.finish()
+                t0 = time.perf_counter()
+                all_full = self._commit_batch(
+                    [e for e, _ in batch], plans, snapshot,
+                    blocked_basis=chained_on[1] if chained_on else None,
+                )
         except (Exception, CancelledError):
             # CancelledError included: plan futures cancelled by a queue
             # disable (leadership loss) are BaseException since py3.8 and
@@ -447,6 +505,8 @@ class TPUBatchWorker:
             metrics.incr("nomad.worker.invoke.failed")
             self._nack_batch(batch)
             outcome["ok"] = False
+            if bctx is not None:
+                bctx.finish("commit-failed")
             return
         finally:
             # chain cutoff: the solve stage stops chaining on this batch
@@ -461,11 +521,14 @@ class TPUBatchWorker:
         metrics.observe(
             "nomad.tpu.commit_seconds", time.perf_counter() - t0
         )
-        for ev_, tok in batch:
-            try:
-                broker.ack(ev_.id, tok)
-            except ValueError:
-                pass
+        with trace.span(bctx, "eval.ack"):
+            for ev_, tok in batch:
+                try:
+                    broker.ack(ev_.id, tok)
+                except ValueError:
+                    pass
+        if bctx is not None:
+            bctx.finish("ok" if all_full else "partial")
         self.processed += len(batch)
 
     def _commit_batch(

@@ -1,1 +1,2 @@
 from .harness import Harness, RejectPlanHarness
+from .waits import wait_for_state

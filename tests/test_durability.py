@@ -16,6 +16,7 @@ from nomad_tpu.rpc import ConnPool
 from nomad_tpu.server.cluster import ClusterServer
 from nomad_tpu.server.raft_replication import LogEntry
 from nomad_tpu.server.raft_store import RaftLogStore
+from nomad_tpu.testing import wait_for_state
 
 
 def wait_until(fn, timeout_s=45.0, interval=0.05):
@@ -119,16 +120,20 @@ class TestClusterRestart:
             job = mock.job()
             job.task_groups[0].count = 3
             pool.call(leader.addr, "Job.register", {"job": job})
-            assert wait_until(
-                lambda: len(
-                    _leader(servers).server.state.allocs_by_job(
-                        job.namespace, job.id
-                    )
-                )
-                == 3
-                if _leader(servers)
-                else False,
-                30,  # full-suite load can slow elections + placement
+
+            # event-driven (alloc upserts replicate to every server's
+            # store, each publishing to its event broker): re-check on
+            # each event instead of burning the box's one core on a
+            # 50ms sleep-poll — the known flake mode under full-suite
+            # load (VERDICT r6 item 7)
+            def placed():
+                lead = _leader(servers)
+                return bool(lead) and len(
+                    lead.server.state.allocs_by_job(job.namespace, job.id)
+                ) == 3
+
+            assert wait_for_state(
+                servers.values(), placed, timeout_s=45
             ), "allocs never placed"
         finally:
             pool.shutdown()
@@ -155,7 +160,12 @@ class TestClusterRestart:
                         return False
                 return True
 
-            assert wait_until(recovered, 30), "state not recovered from disk"
+            # log replay publishes store events as it applies; the
+            # helper's periodic fallback covers replays that finished
+            # before the subscription opened
+            assert wait_for_state(
+                servers2.values(), recovered, timeout_s=45
+            ), "state not recovered from disk"
         finally:
             for s in servers2.values():
                 s.shutdown()

@@ -74,7 +74,12 @@ def test_full_stack_is_inversion_free(tmp_path):
         from nomad_tpu.client import Client, ServerRPC
         from nomad_tpu.server import Server
         from nomad_tpu.structs.structs import SecretEntry, Service, Volume
-        from nomad_tpu import mock
+        from nomad_tpu import mock, trace
+
+        # tracing ON under the detector: the trace buffer/context locks
+        # are acquired from broker, worker, applier, and HTTP threads —
+        # exactly the cross-thread shape lock-order inversions hide in
+        trace.configure(max_traces=64, enabled_=True)
 
         server = Server(num_workers=2)
         server.establish_leadership()
@@ -107,6 +112,8 @@ def test_full_stack_is_inversion_free(tmp_path):
         time.sleep(1.0)
         client.shutdown()
         server.shutdown()
+        if not trace.recorder().list(name="eval"):
+            raise SystemExit("tracing produced no eval traces")
         vs = racecheck.violations()
         if vs:
             print(racecheck.report())
