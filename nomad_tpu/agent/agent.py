@@ -343,6 +343,18 @@ class Agent:
                 max_traces=self.config.trace_buffer, enabled_=True
             )
             self._trace_owner = True
+        # telemetry { collection_interval } is also the histogram window
+        # width (metrics.py windowed ring): "last window" in /v1/metrics
+        # and `operator top` means the last collection interval. Applied
+        # BEFORE the server starts — configure_windows only affects
+        # histograms created after it, and server bootstrap (raft
+        # applies at leadership) creates the first ones.
+        if self.config.telemetry_interval_s:
+            from .. import metrics as _metrics
+
+            _metrics.registry().configure_windows(
+                interval_s=self.config.telemetry_interval_s
+            )
         if self.server is not None:
             self.server.start()
             if self.config.server_join:

@@ -19,6 +19,7 @@ import json
 import logging
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
@@ -247,7 +248,17 @@ class HTTPAgentServer:
             return region if region and region != self.cluster.region else ""
 
         def route(method: str, pattern: str, fn: Callable) -> None:
-            self._routes.append((method, re.compile(f"^{pattern}$"), fn))
+            # per-route latency label precomputed at registration: the
+            # PATTERN (with named groups collapsed to :name), never the
+            # raw request path — ids in the path would make the metric
+            # name set unbounded
+            label = (
+                "nomad.http.request_seconds." + method + "."
+                + re.sub(r"\(\?P<(\w+)>[^)]*\)", r":\1", pattern)
+            )
+            self._routes.append(
+                (method, re.compile(f"^{pattern}$"), fn, label)
+            )
 
         def blocking(tables, query, reader):
             """Common blocking-query wrapper (reference http.go wrap +
@@ -2075,55 +2086,22 @@ class HTTPAgentServer:
                             hdr = {"path": query.get("path", [""])[0]}
                             outer._serve_fs_raw(self, alloc.id, "FS.cat", hdr)
                         return
-                    for m, pattern, fn in outer._routes:
+                    for m, pattern, fn, mlabel in outer._routes:
                         if m != method:
                             continue
                         match = pattern.match(parsed.path)
                         if match is None:
                             continue
-                        body = json.loads(raw_body or b"{}")
-                        # Write requests open a trace when tracing is on:
-                        # the RPC fabric forwards the context, so a
-                        # submit on a follower stitches through to the
-                        # leader's raft apply (trace.py).
-                        hctx = None
-                        if method != "GET":
-                            from .. import trace as _trace
-
-                            hctx = _trace.start_trace(
-                                "http", method=method, path=parsed.path
+                        t0 = time.perf_counter()
+                        try:
+                            self._run_route(
+                                fn, match, query, raw_body, token, method,
+                                parsed,
                             )
-                        if hctx is not None:
-                            try:
-                                with _trace.use(hctx):
-                                    result = fn(
-                                        match.groupdict(), query, body, token
-                                    )
-                            except BaseException as e:
-                                # a failed write must not be recorded as
-                                # status=ok — the surface exists to debug
-                                # exactly these
-                                hctx.set_attr("error", type(e).__name__)
-                                hctx.finish("error")
-                                raise
-                            hctx.finish()
-                        else:
-                            result = fn(match.groupdict(), query, body, token)
-                        index = None
-                        if isinstance(result, tuple):
-                            result, index = result
-                        if isinstance(result, RawResponse):
-                            self.send_response(200)
-                            self.send_header(
-                                "Content-Type", result.content_type
+                        finally:
+                            metrics.observe(
+                                mlabel, time.perf_counter() - t0
                             )
-                            self.send_header(
-                                "Content-Length", str(len(result.data))
-                            )
-                            self.end_headers()
-                            self.wfile.write(result.data)
-                            return
-                        self._reply(200, codec.to_wire(result), index)
                         return
                     self._reply(404, {"error": f"no route {method} {parsed.path}"})
                 except HTTPError as e:
@@ -2141,6 +2119,53 @@ class HTTPAgentServer:
                 except Exception as e:
                     logger.exception("http handler failed")
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _run_route(
+                self, fn, match, query, raw_body, token, method, parsed
+            ) -> None:
+                body = json.loads(raw_body or b"{}")
+                # Write requests open a trace when tracing is on:
+                # the RPC fabric forwards the context, so a
+                # submit on a follower stitches through to the
+                # leader's raft apply (trace.py).
+                hctx = None
+                if method != "GET":
+                    from .. import trace as _trace
+
+                    hctx = _trace.start_trace(
+                        "http", method=method, path=parsed.path
+                    )
+                if hctx is not None:
+                    try:
+                        with _trace.use(hctx):
+                            result = fn(
+                                match.groupdict(), query, body, token
+                            )
+                    except BaseException as e:
+                        # a failed write must not be recorded as
+                        # status=ok — the surface exists to debug
+                        # exactly these
+                        hctx.set_attr("error", type(e).__name__)
+                        hctx.finish("error")
+                        raise
+                    hctx.finish()
+                else:
+                    result = fn(match.groupdict(), query, body, token)
+                index = None
+                if isinstance(result, tuple):
+                    result, index = result
+                if isinstance(result, RawResponse):
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", result.content_type
+                    )
+                    self.send_header(
+                        "Content-Length", str(len(result.data))
+                    )
+                    self.end_headers()
+                    self.wfile.write(result.data)
+                    return
+                self._reply(200, codec.to_wire(result), index)
 
             def _reply(self, status: int, payload, index: Optional[int] = None):
                 data = json.dumps(payload, default=_json_default).encode()

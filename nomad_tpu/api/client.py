@@ -740,8 +740,18 @@ class AgentAPI(_Resource):
         return self.c.get("/v1/agent/members")
 
     def metrics(self):
-        """Telemetry snapshot (reference api/operator_metrics.go)."""
+        """Telemetry snapshot (reference api/operator_metrics.go):
+        counters, gauges, and histogram samples with cumulative and
+        last-window p50/p90/p95/p99 (metrics.py)."""
         return self.c.get("/v1/metrics")
+
+    def metrics_prometheus(self) -> str:
+        """The Prometheus text exposition (?format=prometheus) verbatim
+        — what a scraper sees, histogram buckets included."""
+        resp = self.c.get(
+            "/v1/metrics", params={"format": "prometheus"}, raw=True
+        )
+        return resp.read().decode()
 
     def self(self):
         return self.c.get("/v1/agent/self")

@@ -1940,6 +1940,146 @@ def cmd_operator_metrics(args) -> int:
     return 0
 
 
+def _fmt_dur(s: float) -> str:
+    """Compact duration: 840us / 12.5ms / 1.24s."""
+    if s < 0.001:
+        return f"{s * 1e6:.0f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+# `operator top` row order: the end-to-end pipeline first (enqueue →
+# dequeue → solve → queue → verify/apply), then whatever else is hot
+_TOP_STAGE_ORDER = [
+    "nomad.eval.e2e_seconds",
+    "nomad.broker.wait_seconds",
+    "nomad.worker.invoke_seconds.service",
+    "nomad.worker.invoke_seconds.batch",
+    "nomad.tpu.batch_dispatch_seconds",
+    "nomad.tpu.host_prep_seconds",
+    "nomad.tpu.device_seconds",
+    "nomad.tpu.readback_seconds",
+    "nomad.tpu.materialize_seconds",
+    "nomad.tpu.commit_seconds",
+    "nomad.plan.submit_seconds",
+    "nomad.plan_queue.wait_seconds",
+    "nomad.plan_apply.batch_seconds",
+    "nomad.raft.apply_seconds",
+]
+
+
+def _render_top(snap: dict, prev) -> str:
+    """One `operator top` frame from a /v1/metrics snapshot. prev is
+    (monotonic_time, snapshot) of the previous frame (None on the
+    first) — eval throughput is the e2e-count delta between frames,
+    falling back to the last window's rate."""
+    import time as _time
+
+    gauges = snap.get("gauges") or {}
+    samples = snap.get("samples") or {}
+    e2e = samples.get("nomad.eval.e2e_seconds") or {}
+    total_evals = int(e2e.get("count", 0))
+    rate = None
+    if prev is not None:
+        prev_t, prev_snap = prev
+        dt = _time.monotonic() - prev_t
+        prev_count = int(
+            (prev_snap.get("samples", {}).get("nomad.eval.e2e_seconds")
+             or {}).get("count", 0)
+        )
+        if dt > 0:
+            rate = (total_evals - prev_count) / dt
+    if rate is None:
+        win = e2e.get("window")
+        if win and win.get("interval_s"):
+            rate = win["count"] / max(win["interval_s"], 1e-9)
+    lines = [
+        f"nomad-tpu top — uptime {snap.get('uptime_seconds', 0):.0f}s",
+        "",
+        (
+            f"Throughput  {rate:.1f} evals/s" if rate is not None
+            else "Throughput  -"
+        )
+        + f"   total {total_evals} evals"
+        + f"   failed {int(gauges.get('nomad.broker.failed', 0))}",
+        (
+            "Queues      broker ready "
+            f"{int(gauges.get('nomad.broker.total_ready', 0))}"
+            f"  unacked {int(gauges.get('nomad.broker.total_unacked', 0))}"
+            f"  blocked {int(gauges.get('nomad.broker.total_blocked', 0))}"
+            f"  waiting {int(gauges.get('nomad.broker.total_waiting', 0))}"
+            f"   plan queue {int(gauges.get('nomad.plan_queue.depth', 0))}"
+        ),
+        (
+            f"Workers     {int(gauges.get('nomad.workers.count', 0))}"
+            " scheduler worker(s)"
+            f"   processed {int(gauges.get('nomad.workers.processed', 0))}"
+        ),
+        "",
+        "Stage latencies (cumulative | last window):",
+    ]
+    ordered = [n for n in _TOP_STAGE_ORDER if n in samples]
+    rest = sorted(
+        (
+            n for n in samples
+            if "_seconds" in n and n not in _TOP_STAGE_ORDER
+        ),
+        key=lambda n: -samples[n].get("count", 0),
+    )
+    rows = []
+    for name in ordered + rest:
+        s = samples[name]
+        if "p50" not in s:
+            continue  # legacy-mode sample: no distribution to show
+        win = s.get("window") or {}
+        rows.append([
+            name,
+            str(int(s["count"])),
+            _fmt_dur(s["p50"]), _fmt_dur(s["p95"]), _fmt_dur(s["p99"]),
+            "|",
+            str(int(win.get("count", 0))),
+            _fmt_dur(win["p50"]) if win else "-",
+            _fmt_dur(win["p95"]) if win else "-",
+            _fmt_dur(win["p99"]) if win else "-",
+        ])
+    lines.append(_fmt_table(
+        rows,
+        ["STAGE", "COUNT", "P50", "P95", "P99",
+         "|", "WCOUNT", "WP50", "WP95", "WP99"],
+    ))
+    return "\n".join(lines)
+
+
+def cmd_operator_top(args) -> int:
+    """Live telemetry dashboard: throughput, queue depths, worker
+    utilization, and per-stage p50/p95/p99 (cumulative + last window)
+    from /v1/metrics — the answer to "where is the batch spending its
+    second", refreshed in place."""
+    import time as _time
+
+    api = _client(args)
+    interval = max(0.2, float(args.interval))
+    frames = 0
+    prev = None
+    try:
+        while True:
+            snap = api.agent.metrics()
+            frame = _render_top(snap, prev)
+            prev = (_time.monotonic(), snap)
+            frames += 1
+            last = args.once or (args.n and frames >= args.n)
+            if not last and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(frame)
+            sys.stdout.flush()
+            if last:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_operator_trace(args) -> int:
     """Render eval-lifecycle traces from the agent's /v1/traces ring
     (trace.py): span tree with self-times for one trace, a listing when
@@ -2548,6 +2688,16 @@ def build_parser() -> argparse.ArgumentParser:
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)
+    optop = opsub.add_parser(
+        "top", help="live telemetry dashboard (/v1/metrics)"
+    )
+    optop.add_argument("-interval", type=float, default=2.0,
+                       help="seconds between refreshes")
+    optop.add_argument("-n", type=int, default=0,
+                       help="frames to render (0 = until interrupted)")
+    optop.add_argument("-once", action="store_true",
+                       help="render a single frame and exit")
+    optop.set_defaults(fn=cmd_operator_top)
     optr = opsub.add_parser(
         "trace", help="render eval-lifecycle traces (/v1/traces)"
     )

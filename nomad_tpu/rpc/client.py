@@ -12,9 +12,10 @@ import itertools
 import logging
 import socket
 import threading
+import time
 from typing import Optional
 
-from .. import codec, trace
+from .. import codec, metrics, trace
 from .server import StreamSession
 from .wire import (
     BYTE_RPC,
@@ -167,14 +168,25 @@ class ConnPool:
         redial)."""
         addr = (addr[0], addr[1])
         last_err: Optional[Exception] = None
-        for _ in range(retries + 1):
-            conn = self._get(addr)
-            try:
-                return conn.call(method, args, timeout_s)
-            except (ConnectionError, OSError) as e:
-                last_err = e
-                self._drop(addr, conn)
-        raise last_err  # type: ignore[misc]
+        # per-method latency as the CALLER saw it — redial retries
+        # included (that stall is real caller-visible latency). Method
+        # names are the closed Endpoint.method set, so cardinality is
+        # bounded.
+        t0 = time.perf_counter()
+        try:
+            for _ in range(retries + 1):
+                conn = self._get(addr)
+                try:
+                    return conn.call(method, args, timeout_s)
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    self._drop(addr, conn)
+            raise last_err  # type: ignore[misc]
+        finally:
+            metrics.observe(
+                f"nomad.rpc.call_seconds.{method}",
+                time.perf_counter() - t0,
+            )
 
     def stream(
         self, addr: tuple[str, int], method: str, header: Optional[dict] = None

@@ -31,7 +31,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
-from .. import codec, trace
+from .. import codec, metrics, trace
 from .wire import (
     BYTE_RAFT,
     BYTE_RPC,
@@ -273,6 +273,7 @@ class RPCServer:
         ref = req.get(TRACE_KEY)
         if isinstance(ref, dict) and ref.get("id"):
             segment = trace.open_segment(f"rpc.{method}", ref)
+        t0 = time.perf_counter()
         try:
             with trace.use(segment):
                 result = self.dispatch_local(method, req.get("args"))
@@ -280,6 +281,11 @@ class RPCServer:
         except Exception as e:  # handler errors travel as strings
             logger.debug("rpc %s failed: %s", method, e)
             resp = {"seq": seq, "error": f"{type(e).__name__}: {e}"}
+        # handler-side latency (the client-side nomad.rpc.call_seconds
+        # minus this is wire + queueing time)
+        metrics.observe(
+            f"nomad.rpc.served_seconds.{method}", time.perf_counter() - t0
+        )
         if segment is not None:
             segment.finish(record=False)
             resp[TRACE_SPANS_KEY] = [s.to_wire() for s in segment.spans]

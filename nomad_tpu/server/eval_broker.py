@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import trace
+from .. import metrics, trace
 from ..structs import Evaluation, generate_uuid, now_ns
 
 DEFAULT_NACK_DELAY_S = 5.0
@@ -80,6 +80,19 @@ class EvalBroker:
         # trace started at enqueue (trace.py). Bounded by queue depth:
         # entries leave at ack / dead-letter / flush.
         self._traces: dict[str, tuple] = {}
+        # eval id -> monotonic FIRST-enqueue time: the basis of
+        # nomad.eval.e2e_seconds, observed at ack (the worker acks only
+        # after the plan is applied). setdefault keeps the original
+        # enqueue across nack redeliveries so redelivered evals report
+        # their true end-to-end time. Bounded like _traces: entries
+        # leave at ack / dead-letter / flush.
+        self._enqueue_times: dict[str, float] = {}
+        # eval id -> monotonic time it last became READY (pushed onto a
+        # ready heap): the basis of nomad.broker.wait_seconds at
+        # dequeue. Distinct from _enqueue_times on purpose — a
+        # redelivered eval's queue wait must not include the prior
+        # attempt's processing time or the nack delay.
+        self._wait_starts: dict[str, float] = {}
         self._timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.stats = {
@@ -121,6 +134,8 @@ class EvalBroker:
         self._attempts.clear()
         # leadership loss: in-flight traces are abandoned, not recorded
         self._traces.clear()
+        self._enqueue_times.clear()
+        self._wait_starts.clear()
 
     # -- enqueue -------------------------------------------------------
 
@@ -136,6 +151,7 @@ class EvalBroker:
     def _enqueue_locked(self, ev: Evaluation) -> None:
         if not self._enabled:
             return
+        self._enqueue_times.setdefault(ev.id, time.monotonic())
         if trace.enabled() and ev.id not in self._traces:
             ctx = trace.start_trace(
                 "eval",
@@ -163,6 +179,7 @@ class EvalBroker:
 
     def _push_ready(self, ev: Evaluation) -> None:
         self._ready.setdefault(ev.type, _PendingHeap()).push(ev)
+        self._wait_starts[ev.id] = time.monotonic()
         if ev.job_id:
             self._in_flight[(ev.namespace, ev.job_id)] = ev.id
         self._cv.notify_all()
@@ -175,8 +192,9 @@ class EvalBroker:
         """Blocking dequeue of the highest-priority ready eval among the
         given scheduler types. Returns (eval, token) or (None, "")."""
         deadline = time.monotonic() + timeout_s if timeout_s is not None else None
-        with self._cv:
-            while True:
+        while True:
+            wait_s = None
+            with self._cv:
                 if self._enabled:
                     ev = self._pop_best_locked(schedulers)
                     if ev is not None:
@@ -184,6 +202,9 @@ class EvalBroker:
                         attempts = self._attempts.get(ev.id, 0) + 1
                         self._attempts[ev.id] = attempts
                         self._unacked[ev.id] = (ev, token, attempts)
+                        ready_at = self._wait_starts.pop(ev.id, None)
+                        if ready_at is not None:
+                            wait_s = time.monotonic() - ready_at
                         entry = self._traces.get(ev.id)
                         if entry is not None:
                             ctx, open_span = entry
@@ -200,7 +221,7 @@ class EvalBroker:
                                     attempt=attempts,
                                 ),
                             )
-                        return ev, token
+                        break
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -208,6 +229,12 @@ class EvalBroker:
                     self._cv.wait(remaining)
                 else:
                     self._cv.wait(1.0)
+        # histogram observe OUTSIDE the broker lock: the registry has
+        # its own lock and nesting it under _cv would add a lock-order
+        # edge the racecheck battery would have to carry forever
+        if wait_s is not None:
+            metrics.observe("nomad.broker.wait_seconds", wait_s)
+        return ev, token
 
     def _pop_best_locked(self, schedulers: list[str]) -> Optional[Evaluation]:
         best_type = None
@@ -235,6 +262,20 @@ class EvalBroker:
             self._attempts.pop(eval_id, None)
             self._release_job_locked(ev, eval_id)
             tentry = self._traces.pop(eval_id, None)
+            enq = self._enqueue_times.pop(eval_id, None)
+        if enq is not None:
+            # ack lands only after the eval's plan was applied (workers
+            # ack post-commit), so this IS the end-to-end eval latency:
+            # broker enqueue -> plan applied. One aggregate histogram
+            # plus a per-(scheduler type, triggered-by) labelled one —
+            # both label sets are small and closed.
+            e2e = time.monotonic() - enq
+            metrics.observe("nomad.eval.e2e_seconds", e2e)
+            metrics.observe(
+                f"nomad.eval.e2e_seconds.{ev.type}"
+                f".{ev.triggered_by or 'unknown'}",
+                e2e,
+            )
         if tentry is not None:
             ctx, open_span = tentry
             ctx.end_span(open_span)
@@ -256,6 +297,7 @@ class EvalBroker:
                 self._ready.setdefault(FAILED_QUEUE, _PendingHeap()).push(ev)
                 self.stats["failed"] += 1
                 self._cv.notify_all()
+                self._enqueue_times.pop(eval_id, None)
                 tentry = self._traces.pop(eval_id, None)
                 if tentry is not None:
                     ctx, open_span = tentry

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -650,6 +651,7 @@ class PlanApplier:
         for the caller's true serial path."""
         from .. import metrics
 
+        t0 = time.perf_counter()
         results: dict[int, PlanResult] = {}
         remaining = list(range(len(plans)))
         keys = [_plan_partition_key(p) for p in plans]
@@ -676,6 +678,9 @@ class PlanApplier:
         metrics.observe("nomad.plan_apply.batch_merged", merged_total)
         metrics.observe("nomad.plan_apply.batch_rounds", rounds)
         metrics.observe("nomad.plan_apply.batch_serial", len(remaining))
+        metrics.observe(
+            "nomad.plan_apply.batch_seconds", time.perf_counter() - t0
+        )
         return results, remaining
 
     def _apply_batch(self, plans: list[Plan], futs: list, tref=None) -> None:

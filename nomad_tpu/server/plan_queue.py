@@ -9,9 +9,11 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional
 
+from .. import metrics
 from ..structs import Plan
 
 
@@ -28,7 +30,7 @@ class PlanQueue:
             was = self._enabled
             self._enabled = enabled
             if was and not enabled:
-                for _, _, _, fut, _tctx in self._heap:
+                for _, _, _, fut, _tctx, _t_enq in self._heap:
                     if isinstance(fut, list):
                         for f in fut:
                             f.cancel()
@@ -51,7 +53,8 @@ class PlanQueue:
                 return fut
             heapq.heappush(
                 self._heap,
-                (-plan.priority, next(self._counter), plan, fut, trace_ctx),
+                (-plan.priority, next(self._counter), plan, fut, trace_ctx,
+                 time.monotonic()),
             )
             self._cv.notify_all()
         return fut
@@ -73,7 +76,8 @@ class PlanQueue:
             prio = max(p.priority for p in plans)
             heapq.heappush(
                 self._heap,
-                (-prio, next(self._counter), list(plans), futs, trace_ctx),
+                (-prio, next(self._counter), list(plans), futs, trace_ctx,
+                 time.monotonic()),
             )
             self._cv.notify_all()
         return futs
@@ -89,11 +93,18 @@ class PlanQueue:
         with self._cv:
             while True:
                 if self._heap:
-                    _, _, plan, fut, tctx = heapq.heappop(self._heap)
-                    return plan, fut, tctx
+                    _, _, plan, fut, tctx, t_enq = heapq.heappop(self._heap)
+                    break
                 if not self._cv.wait(timeout_s if timeout_s is not None else 1.0):
                     if timeout_s is not None:
                         return None
+        # observed OUTSIDE the queue lock (registry has its own lock):
+        # how long the plan (or batch) sat queued before the applier
+        # picked it up — the applier-backlog half of plan latency
+        metrics.observe(
+            "nomad.plan_queue.wait_seconds", time.monotonic() - t_enq
+        )
+        return plan, fut, tctx
 
     def depth(self) -> int:
         with self._lock:

@@ -307,10 +307,11 @@ class InmemLog:
         invariant, so followers replaying the encoded entry converge on
         identical state (tests/test_raft.py leader-direct equivalence).
         """
-        from .. import codec
+        from .. import codec, metrics
         import time as _time
 
         tracing = trace.enabled() and trace.current() is not None
+        apply_t0 = _time.monotonic_ns()
         with paused_gc():
             t0 = _time.monotonic_ns() if tracing else 0
             raw = codec.pack(payload)
@@ -324,6 +325,12 @@ class InmemLog:
             self.fsm.apply(index, msg_type, payload)
             if tracing:
                 trace.stage("fsm.apply", _time.monotonic_ns() - t0)
+        # one observation per raft entry (entries batch many payloads,
+        # so this is far off the per-alloc hot loop): encode + append +
+        # fsm apply — the commit half of every state mutation
+        metrics.time_ns(
+            "nomad.raft.apply_seconds", _time.monotonic_ns() - apply_t0
+        )
         return index
 
     def apply_async(self, msg_type: str, payload):

@@ -270,8 +270,17 @@ class RaftNode:
     def apply(self, msg_type: str, payload, timeout_s: float = 10.0):
         """Append on the leader, replicate, block until committed AND
         applied locally. Returns the entry index."""
+        from .. import metrics
+
+        t0 = time.perf_counter()
         index, term = self.apply_submit(msg_type, payload)
-        return self.apply_wait(index, term, timeout_s)
+        out = self.apply_wait(index, term, timeout_s)
+        # same name as InmemLog.apply (raft.py): encode + replicate +
+        # commit + local fsm apply, whichever log backs the server
+        metrics.observe(
+            "nomad.raft.apply_seconds", time.perf_counter() - t0
+        )
+        return out
 
     def apply_submit(self, msg_type: str, payload) -> tuple[int, int]:
         """Append on the leader and kick replication WITHOUT waiting for

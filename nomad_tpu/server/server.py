@@ -102,6 +102,11 @@ class Server:
             ("nomad.plan_queue", metrics.register_provider(
                 "nomad.plan_queue", lambda: {"depth": self.plan_queue.depth()}
             )),
+            # worker-pool utilization for `operator top`: pool size and
+            # total evals processed (throughput = its rate)
+            ("nomad.workers", metrics.register_provider(
+                "nomad.workers", self._worker_stats
+            )),
         ]
         self.plan_applier = PlanApplier(
             self.plan_queue, self.state, self.raft_apply, self.raft_apply_async
@@ -240,6 +245,15 @@ class Server:
             metrics.unregister_provider(name, handle)
         self.revoke_leadership()
         self._unblock_q.put(None)
+
+    def _worker_stats(self) -> dict[str, float]:
+        workers = list(self.workers)
+        processed = sum(w.processed for w in workers)
+        count = len(workers)
+        if self.tpu_worker is not None:
+            processed += self.tpu_worker.processed
+            count += 1
+        return {"count": float(count), "processed": float(processed)}
 
     def _restore_evals(self) -> None:
         """Broker state is not persisted; rebuild from the state store

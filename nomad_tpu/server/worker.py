@@ -40,10 +40,15 @@ class WorkerPlanner:
 
     def submit_plan(self, plan: Plan):
         ctx = trace.current()
+        t0 = time.perf_counter()
         with trace.span(ctx, "plan.submit") as h:
             tref = (ctx, h.span) if ctx is not None else None
             fut = self.server.plan_queue.enqueue(plan, trace_ctx=tref)
             result: PlanResult = fut.result(timeout=30)
+        # queue wait + verify + raft apply, as the worker saw it
+        metrics.observe(
+            "nomad.plan.submit_seconds", time.perf_counter() - t0
+        )
         new_state = None
         if result.refresh_index > 0:
             with trace.span(ctx, "snapshot.refresh"):
@@ -59,12 +64,16 @@ class WorkerPlanner:
         commit in the batch, so retry evals never race their own
         refresh index."""
         ctx = trace.current()
+        t0 = time.perf_counter()
         with trace.span(ctx, "plan.submit", plans=len(plans)) as h:
             tref = (ctx, h.span) if ctx is not None else None
             futs = self.server.plan_queue.enqueue_batch(
                 plans, trace_ctx=tref
             )
             results: list[PlanResult] = [f.result(timeout=60) for f in futs]
+        metrics.observe(
+            "nomad.plan.submit_seconds", time.perf_counter() - t0
+        )
         max_refresh = max((r.refresh_index for r in results), default=0)
         if max_refresh > 0:
             with trace.span(ctx, "snapshot.refresh"):
