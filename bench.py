@@ -918,6 +918,19 @@ def _ensure_device() -> dict:
 
 
 def main():
+    # Fault-injection tripwire: a capture taken while chaos knobs are
+    # live (NOMAD_TPU_INJECT_* env vars, or an installed FaultPlane)
+    # measures the injected faults, not the system — it must never be
+    # certifiable. The payload still prints (debugging under injection
+    # is legitimate) but every gate is forced to fail.
+    from nomad_tpu import faultplane as _chaos
+
+    chaos_knobs = _chaos.env_knobs_active()
+    if chaos_knobs:
+        log(
+            f"CHAOS INJECTION ACTIVE ({', '.join(chaos_knobs)}): "
+            f"this capture CANNOT gate — results are fault-distorted"
+        )
     device = _ensure_device()
     if os.environ.get("BENCH_TRACE"):
         # per-batch span emission through the production tracing
@@ -982,6 +995,9 @@ def main():
             )
         if "overlap_ge_1_5x" in r:
             gates[f"{cname}_overlap_1_5x"] = bool(r["overlap_ge_1_5x"])
+    if chaos_knobs:
+        # refuse to gate: an injected-fault run can never certify
+        gates["no_chaos_injection"] = False
     gates_ok = all(gates.values())
     if not gates_ok:
         log(f"BENCH GATES FAILED: {gates}")
@@ -997,6 +1013,7 @@ def main():
                 "configs": results,
                 "gates": gates,
                 "gates_pass": all(gates.values()),
+                "chaos_injection_active": chaos_knobs,
                 "loadavg": list(os.getloadavg()),
                 "platform": device["platform"],
                 "tpu_available": device["tpu_available"],

@@ -16,6 +16,7 @@ import time
 from typing import Optional
 
 from .. import codec, metrics, trace
+from .. import faultplane
 from .server import StreamSession
 from .wire import (
     BYTE_RPC,
@@ -89,11 +90,19 @@ class _Conn:
                 waiter["event"].set()
 
     def call(self, method: str, args, timeout_s: float):
+        """Errors carry `request_sent`: False means the request never
+        reached the peer (dead conn found up front, send failed — a
+        partial frame is never dispatched), so a caller may re-send
+        blindly; True means it WAS delivered and only the response is
+        unaccounted for (timeout, conn died while waiting) — re-sending
+        could double-apply a non-idempotent write."""
         seq = next(self._seq)
         waiter = {"event": threading.Event(), "resp": None}
         with self._pending_lock:
             if self.dead:
-                raise ConnectionError("connection closed")
+                err = ConnectionError("connection closed")
+                err.request_sent = False
+                raise err
             self._pending[seq] = waiter
         # Trace propagation (wire.py TRACE_KEY): when the calling thread
         # carries a trace, the request envelope forwards its context and
@@ -113,10 +122,11 @@ class _Conn:
                 payload = codec.pack(req)
                 with self._wlock:
                     send_frame(self.sock, payload)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
                 with self._pending_lock:
                     self._pending.pop(seq, None)
                 self.dead = True
+                e.request_sent = False
                 raise
             ok = waiter["event"].wait(timeout_s)
         finally:
@@ -125,13 +135,17 @@ class _Conn:
         if not ok:
             with self._pending_lock:
                 self._pending.pop(seq, None)
-            raise TimeoutError(f"rpc {method} timed out after {timeout_s}s")
+            err = TimeoutError(f"rpc {method} timed out after {timeout_s}s")
+            err.request_sent = True
+            raise err
         resp = waiter["resp"]
         if tctx is not None and resp.get(TRACE_SPANS_KEY):
             tctx.merge_remote(resp[TRACE_SPANS_KEY], rpc_span)
         if "error" in resp:
             if resp["error"] == "connection closed":
-                raise ConnectionError("connection closed")
+                err = ConnectionError("connection closed")
+                err.request_sent = True  # delivered; the reply was lost
+                raise err
             raise RPCError(resp["error"])
         return resp.get("result")
 
@@ -154,6 +168,10 @@ class ConnPool:
         self._connect_timeout_s = connect_timeout_s
         self.secret = secret
         self.tls_context = tls_context  # ssl client ctx — fabric TLS
+        # Fault-plane identity: the owning node's label (ClusterServer
+        # sets its node_id) so injected partitions can match this pool's
+        # outbound calls. Empty = an unlabeled client pool.
+        self.owner = ""
 
     def call(
         self,
@@ -165,7 +183,11 @@ class ConnPool:
     ):
         """Invoke `Endpoint.method` at addr. One automatic retry on a dead
         pooled connection (the reference's pool does the same rundown +
-        redial)."""
+        redial) — but ONLY when the request provably never reached the
+        peer (`request_sent` False): re-sending a delivered request
+        whose response was lost could double-apply a non-idempotent
+        write (at-most-once at this layer; idempotent or
+        leaderless-classified retries happen above, retry.py)."""
         addr = (addr[0], addr[1])
         last_err: Optional[Exception] = None
         # per-method latency as the CALLER saw it — redial retries
@@ -177,10 +199,21 @@ class ConnPool:
             for _ in range(retries + 1):
                 conn = self._get(addr)
                 try:
+                    # Fault plane (faultplane.py): injected drops/
+                    # delays/partitions act here, inside the attempt, so
+                    # they ride the SAME rundown + redial path a real
+                    # network failure does — a times=1 drop is absorbed
+                    # by the pool's retry exactly like a transient blip,
+                    # while a persistent partition fails every attempt.
+                    # No-op unless a plane is installed.
+                    if faultplane.plane is not None:
+                        faultplane.plane.on_rpc_call(self.owner, addr, method)
                     return conn.call(method, args, timeout_s)
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     self._drop(addr, conn)
+                    if getattr(e, "request_sent", False):
+                        raise
             raise last_err  # type: ignore[misc]
         finally:
             metrics.observe(

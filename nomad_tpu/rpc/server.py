@@ -32,6 +32,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 from .. import codec, metrics, trace
+from .. import faultplane
 from .wire import (
     BYTE_RAFT,
     BYTE_RPC,
@@ -112,6 +113,9 @@ class RPCServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set[socket.socket] = set()
         self._conns_lock = threading.Lock()
+        # Fault-plane identity (faultplane.py): the owning node's
+        # label, so injected response drops can target this server.
+        self.chaos_label = ""
 
     # -- registration --------------------------------------------------
 
@@ -264,6 +268,15 @@ class RPCServer:
     def _dispatch(self, conn: socket.socket, wlock: threading.Lock, req) -> None:
         seq = req.get("seq")
         method = req.get("method", "")
+        if faultplane.plane is not None:
+            # Injected response drop: the request was DELIVERED but the
+            # answer is lost — the caller sees a timeout, the nastier
+            # half of a partition (retries must tolerate a possibly
+            # already-applied write).
+            try:
+                faultplane.plane.on_rpc_serve(self.chaos_label, method)
+            except faultplane.DropResponse:
+                return
         # Remote trace segment (wire.py TRACE_KEY): the handler runs with
         # the caller's trace installed as this thread's current context,
         # so every span recorded below (raft applies included) stitches

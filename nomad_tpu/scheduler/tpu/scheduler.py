@@ -213,13 +213,18 @@ class PendingEvalBatch:
     boundary so the device round-trip and plan materialization of batch
     N overlap batch N+1's reconcile/lower/dispatch."""
 
-    def __init__(self, state, evals, plans, pending, config, solver) -> None:
+    def __init__(self, state, evals, plans, pending, config, solver,
+                 asks=None) -> None:
         self.state = state
         self.evals = evals
         self.plans = plans
         self._pending = pending
         self.config = config
         self._solver = solver
+        # the reconciled asks, kept for solve_host_fallback: a failed
+        # device stage re-solves THESE (reconcile is not re-run, so
+        # followup evals created during it are never duplicated)
+        self._asks = asks or []
         self._finished = False
 
     @property
@@ -255,6 +260,44 @@ class PendingEvalBatch:
             self._finished = True
         return self.plans
 
+    def solve_host_fallback(self) -> dict[str, Plan]:
+        """Re-solve this batch's asks entirely on the host oracle after
+        a retriable device-stage failure (worker.py device failover).
+
+        Reuses the reconcile output verbatim — the plans' stop/update
+        halves and any followup evals already created stay as they are;
+        only the placement solve re-runs, with small_batch_threshold
+        forced past the batch size so no device dispatch can recur. The
+        fresh solver exposes no chain (chain_out None, chain_accepted
+        False): the worker marks the batch's chain verdict failed so a
+        chained child re-solves against committed state.
+
+        Deliberately degraded semantics, both directions of the chain:
+        any used_chain THIS solve consumed is dropped too (the host
+        oracle has no device tensor to chain on), so the fallback sees
+        only committed state and may double-book nodes the still-
+        uncommitted parent batch filled — the plan applier's optimistic
+        verification trims those and the evals retry. A custom solve_fn
+        is likewise not reused: the fallback's whole point is to avoid
+        the failing device path, and the host oracle is the common-
+        denominator semantics every kernel is differentially tested
+        against."""
+        if self._finished:
+            return self.plans
+        import copy
+
+        cfg = copy.copy(self.config)
+        cfg.small_batch_threshold = 1 << 62
+        solver = BatchSolver(self.state, cfg)
+        with paused_gc():
+            outcome = solver.solve(self._asks)
+            t0 = time.monotonic_ns()
+            _attach_outcome(self.state, self.evals, self.plans, outcome)
+            trace.stage("plan.assemble", time.monotonic_ns() - t0)
+        self._solver = solver
+        self._finished = True
+        return self.plans
+
 
 def solve_eval_batch_begin(
     state,
@@ -281,7 +324,9 @@ def solve_eval_batch_begin(
             used_chain=used_chain,
         )
         pending = solver.solve_begin(asks)
-    return PendingEvalBatch(state, evals, plans, pending, config, solver)
+    return PendingEvalBatch(
+        state, evals, plans, pending, config, solver, asks=asks
+    )
 
 
 def _reconcile_eval_batch(

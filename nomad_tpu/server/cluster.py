@@ -33,31 +33,72 @@ import queue
 import threading
 from typing import Optional
 
+from ..retry import FORWARD_POLICY, call_with_retry
 from ..rpc import ConnPool, RPCError, RPCServer
+from .. import faultplane
 from ..structs import Allocation, Job, Node
 from .membership import Membership
-from .raft_replication import NotLeaderError, RaftNode
+from .raft_replication import LeadershipLostError, NotLeaderError, RaftNode
 from .server import Server
 
 logger = logging.getLogger("nomad_tpu.cluster")
 
 
+def _is_leaderless_error(e: BaseException) -> bool:
+    """Errors that mean 'the cluster is between leaders' — safe to retry
+    because they are raised BEFORE the write reaches the log (a local
+    NotLeaderError, or the remote's NotLeaderError/no-leader travelling
+    back as an RPCError string). A dial to a dead leader's address
+    (connection refused — the crash-failover case) and an injected
+    chaos drop are likewise pre-delivery. A generic ConnectionError
+    ('connection closed' mid-flight) is NOT retried: the request may
+    already have been applied and the response lost. LeadershipLostError
+    (deposed AFTER the entry was replicating — outcome unknown) is the
+    explicit do-not-retry variant, locally and as its RPC string."""
+    if isinstance(e, LeadershipLostError):
+        return False
+    if isinstance(e, RPCError) and "LeadershipLostError" in str(e):
+        return False
+    if isinstance(e, (NotLeaderError, ConnectionRefusedError)):
+        return True
+    if isinstance(e, faultplane.InjectedRPCError):
+        return True
+    if isinstance(e, RPCError):
+        msg = str(e)
+        return "NotLeaderError" in msg or "no cluster leader" in msg
+    return False
+
+
 class _Forwarder:
     """Endpoint helper: run locally on the leader, else forward the same
-    RPC to the leader (reference nomad/rpc.go forward)."""
+    RPC to the leader (reference nomad/rpc.go forward). Leaderless
+    windows (elections, leadership transfer) retry under the shared
+    RetryPolicy instead of failing the caller: each attempt re-resolves
+    the leader hint, so a request that lands mid-election sticks around
+    just long enough to follow the new leader."""
 
     def __init__(self, cs: "ClusterServer") -> None:
         self.cs = cs
 
     def _forward(self, method: str, args, local_fn, local_ok: bool = False):
-        if local_ok or self.cs.raft.is_leader():
-            return local_fn(args)
-        addr = self.cs.raft.leader_addr()
-        # A stale self-hint would loop the RPC back into our own worker
-        # pool until it deadlocks — treat it as leaderless instead.
-        if addr is None or addr == self.cs.rpc.addr:
-            raise RPCError("no cluster leader")
-        return self.cs.pool.call(addr, method, args, timeout_s=30.0)
+        cs = self.cs
+
+        def attempt():
+            if local_ok or cs.raft.is_leader():
+                return local_fn(args)
+            addr = cs.raft.leader_addr()
+            # A stale self-hint would loop the RPC back into our own
+            # worker pool until it deadlocks — treat it as leaderless.
+            if addr is None or addr == cs.rpc.addr:
+                raise RPCError("no cluster leader")
+            return cs.pool.call(addr, method, args, timeout_s=30.0)
+
+        return call_with_retry(
+            attempt,
+            policy=cs.forward_retry,
+            retry_if=_is_leaderless_error,
+            label=method,
+        )
 
 
 class OperatorEndpoint(_Forwarder):
@@ -1101,6 +1142,13 @@ class ClusterServer:
         self.pool = ConnPool(
             secret=rpc_secret, tls_context=tls[1] if tls else None
         )
+        # Fault-plane identity (faultplane.py): injected partitions
+        # and response drops match on these labels. No-ops in production.
+        self.pool.owner = node_id
+        self.rpc.chaos_label = node_id
+        # Leaderless-window retry budget for _Forwarder (retry.py) —
+        # overridable per deployment (tests shrink it).
+        self.forward_retry = FORWARD_POLICY
         self.server = Server(
             num_workers=num_workers,
             use_tpu_batch_worker=use_tpu_batch_worker,
@@ -1132,6 +1180,7 @@ class ClusterServer:
             self.raft_store = RaftLogStore(
                 os.path.join(data_dir, "server", "raft.db")
             )
+            self.raft_store.chaos_label = node_id
         self.raft = RaftNode(
             node_id,
             self.server.fsm,
@@ -1145,6 +1194,12 @@ class ClusterServer:
             **raft_kw,
         )
         self.server.set_raft_applier(self._raft_apply, self._raft_apply_async)
+        # Replay barrier for establish_leadership (server.py): broker
+        # state must be rebuilt only from a store that has applied this
+        # leader's own barrier entry — i.e. the full committed log, not
+        # a mid-replay prefix (the duplicate-alloc window after a
+        # full-cluster restart with leadership churn).
+        self.server.replay_barrier = self._replay_barrier
         self.rpc.precheck = self._rpc_precheck
         self.rpc.register("Raft", self.raft.endpoint)
         for name, ep in (
@@ -1482,6 +1537,15 @@ class ClusterServer:
             if down is not None:
                 down.close()
             session.close()
+
+    def _replay_barrier(self) -> bool:
+        """Wait for local replay of this leadership's barrier entry, as
+        long as we HOLD the leadership (a slow replay under load keeps
+        waiting; a depose aborts immediately so the queued revoke runs)."""
+        while not self.raft.wait_for_replay(timeout_s=5.0):
+            if not self.raft.is_leader() or self.raft._stop.is_set():
+                return False
+        return True
 
     def _raft_apply(self, msg_type: str, payload) -> int:
         return self.raft.apply(msg_type, payload)

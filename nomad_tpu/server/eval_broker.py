@@ -131,7 +131,15 @@ class EvalBroker:
         self._in_flight.clear()
         self._blocked_jobs.clear()
         self._delayed.clear()
-        self._attempts.clear()
+        # _attempts SURVIVES the flush on purpose: leadership often
+        # bounces straight back to this node (restart churn), and a
+        # redelivered eval must keep its delivery count or the
+        # delivery_limit resets on every churn — a poison eval could
+        # then loop forever instead of dead-lettering. Entries still
+        # clear at ack/dead-letter; the cap guards pathological churn
+        # where evals are acked on OTHER nodes and never clear here.
+        if len(self._attempts) > 8192:
+            self._attempts.clear()
         # leadership loss: in-flight traces are abandoned, not recorded
         self._traces.clear()
         self._enqueue_times.clear()
@@ -358,6 +366,16 @@ class EvalBroker:
             self._stop.wait(wait)
 
     # -- introspection -------------------------------------------------
+
+    def tracks(self, eval_id: str) -> bool:
+        """Is this eval currently anywhere in the broker (ready, unacked,
+        waiting behind its job, or nack-delayed)? _enqueue_times is
+        exactly that set: setdefault'ed on every enqueue, popped only at
+        ack / dead-letter / flush. Used by the leader's _restore_evals
+        so restoring state after churn is idempotent — an eval the FSM
+        side-channel already enqueued is not enqueued again."""
+        with self._lock:
+            return eval_id in self._enqueue_times
 
     def trace_context(self, eval_id: str):
         """The in-flight eval's TraceContext (None when untracked): the

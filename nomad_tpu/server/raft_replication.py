@@ -30,7 +30,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from .. import codec
+from .. import codec, metrics
 from ..gctune import paused_gc
 from ..rpc import ConnPool
 from .raft import FSM
@@ -43,9 +43,20 @@ LEADER = "leader"
 
 
 class NotLeaderError(Exception):
+    """Raised BEFORE a write reached the log (or after it provably did
+    not commit): safe for callers to retry against the new leader."""
+
     def __init__(self, leader_addr: Optional[tuple[str, int]]):
         self.leader_addr = leader_addr
         super().__init__(f"not the leader (leader hint: {leader_addr})")
+
+
+class LeadershipLostError(NotLeaderError):
+    """Deposed AFTER the entry was appended and replicating: the write's
+    outcome is UNKNOWN (the new leader may still commit it). Subclasses
+    NotLeaderError so churn backoff paths treat it the same, but the
+    RPC forwarder must NOT auto-retry it — a retry could double-apply a
+    write that did commit."""
 
 
 @dataclass
@@ -161,6 +172,10 @@ class RaftNode:
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
         self._repl_wake: dict[str, threading.Event] = {}
+        # peer id -> monotonic time of its last RPC response to us
+        # (leader-side CheckQuorum input, see _handle_request_vote's
+        # disruptive-server guard)
+        self._peer_contact: dict[str, float] = {}
         # Leader-direct apply stash: index -> (term, original payload).
         # The local FSM applies the submitted object instead of decoding
         # its own encoded entry (decode of a 10^5-alloc plan dwarfed the
@@ -184,6 +199,9 @@ class RaftNode:
         # itself (the epoch check alone can't cover an apply in progress).
         self._restore_epoch = 0
         self._fsm_mutex = threading.Lock()
+        # Index of the no-op barrier this node appended when it last
+        # became leader; wait_for_replay() blocks on it.
+        self._barrier_index = 0
         self.endpoint = RaftEndpoint(self)
 
     # ------------------------------------------------------------------
@@ -270,8 +288,6 @@ class RaftNode:
     def apply(self, msg_type: str, payload, timeout_s: float = 10.0):
         """Append on the leader, replicate, block until committed AND
         applied locally. Returns the entry index."""
-        from .. import metrics
-
         t0 = time.perf_counter()
         index, term = self.apply_submit(msg_type, payload)
         out = self.apply_wait(index, term, timeout_s)
@@ -299,7 +315,14 @@ class RaftNode:
             entry = LogEntry(index, term, msg_type, raw)
             self._log.append(entry)
             if self.store is not None:
-                self.store.append([entry])
+                try:
+                    self.store.append([entry])
+                except Exception:
+                    # A failed durable append must not leave the entry in
+                    # the in-memory log: it would replicate and commit an
+                    # entry this node forgets on restart.
+                    self._log.pop()
+                    raise
             self._direct_payloads[index] = (term, payload)
             self._match_index[self.node_id] = index
             for ev in self._repl_wake.values():
@@ -318,14 +341,36 @@ class RaftNode:
                 # Any truncation implies a follower interlude (term bump),
                 # which this check catches even if we re-won in between.
                 if self.state != LEADER or self.current_term != term:
-                    raise NotLeaderError(self.leader_addr())
+                    # Deposed mid-wait with the entry already appended
+                    # and replicating: the new leader may yet commit it,
+                    # so the outcome is UNKNOWN — callers must not
+                    # auto-retry (LeadershipLostError, not the
+                    # retry-safe NotLeaderError).
+                    raise LeadershipLostError(self.leader_addr())
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(f"raft apply timed out at index {index}")
                 self._commit_cv.wait(remaining)
-            if self.state != LEADER or self.current_term != term:
-                raise NotLeaderError(self.leader_addr())
-        return index
+            # Applied. Still leader at `term` ⇒ our own-term log is
+            # append-only ⇒ the applied entry at `index` is ours: done
+            # (this also covers entries already compacted into a
+            # snapshot, where the term can no longer be read).
+            if self.state == LEADER and self.current_term == term:
+                return index
+            # Deposed after the apply. The write still succeeded iff the
+            # entry at `index` carries our term — applied implies
+            # committed, and committed entries never truncate (erroring
+            # on a durable write would make retry-hardened callers
+            # re-submit it). A different term there means ours was
+            # truncated pre-commit: definitely not applied, retry-safe.
+            t_at = self._term_at(index)
+            if t_at == term:
+                return index
+            if t_at is None:
+                # compacted below the snapshot while deposed: ownership
+                # can no longer be verified — outcome unknown
+                raise LeadershipLostError(self.leader_addr())
+            raise NotLeaderError(self.leader_addr())
 
     # -- membership changes (single-server-at-a-time, via the log) ------
 
@@ -387,6 +432,31 @@ class RaftNode:
 
     def is_leader(self) -> bool:
         return self.state == LEADER
+
+    def wait_for_replay(self, timeout_s: float = 30.0) -> bool:
+        """Leader-only: block until the local FSM has applied this
+        leader's own no-op barrier — i.e. every entry committed before
+        (or at) this leadership is reflected in local state. This is the
+        reference's establish-leadership barrier (leader.go Barrier):
+        without it a fresh leader restores broker state from a
+        MID-REPLAY snapshot and can re-run evaluations whose effects are
+        still in the unapplied log tail (duplicate allocs). Returns
+        False when deposed or timed out — the caller must then skip
+        stale-state reads (a revoke is on its way, or state isn't
+        trustworthy yet)."""
+        deadline = time.monotonic() + timeout_s
+        with self._commit_cv:
+            while True:
+                if self._stop.is_set() or self.state != LEADER:
+                    return False
+                if self.last_applied >= self._barrier_index:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                # bounded slice: _commit_cv is notified on applies and
+                # step-downs, the slice only guards a missed stop()
+                self._commit_cv.wait(min(remaining, 0.25))
 
     @property
     def last_index(self) -> int:
@@ -487,6 +557,10 @@ class RaftNode:
         logger.info("%s: leader for term %d", self.node_id, self.current_term)
         self.state = LEADER
         self.leader_id = self.node_id
+        # Churn observability: every local leadership transition counts
+        # (step-downs increment in _become_follower_locked). A climbing
+        # rate on `operator top` is the signature of election storms.
+        metrics.incr("nomad.raft.leader_changes")
         # Barrier no-op in our own term: commit can only count current-term
         # entries (§5.4.2), so without this a fresh leader would sit on
         # fully-replicated prior-term entries until the next real write.
@@ -496,7 +570,28 @@ class RaftNode:
         )
         self._log.append(barrier)
         if self.store is not None:
-            self.store.append([barrier])
+            try:
+                self.store.append([barrier])
+            except Exception:
+                # Cannot lead without a durable barrier: keeping it only
+                # in memory while later appends persist would leave a
+                # HOLE in the stored log, and load_log's contiguity
+                # assumption (log[i] has index snap+i+1) would read
+                # shifted entries on restart. Abort this leadership —
+                # the cluster re-elects (possibly us, once the disk
+                # recovers).
+                logger.exception(
+                    "%s: barrier persist failed; abandoning leadership",
+                    self.node_id,
+                )
+                self._log.pop()
+                self.state = FOLLOWER
+                self.leader_id = None
+                return
+        # Everything at or below this index is this leader's replay
+        # debt: wait_for_replay() blocks until the local FSM has applied
+        # it, i.e. this replica's state reflects every prior commit.
+        self._barrier_index = barrier.index
         last = self._last_log_index()
         self._next_index = {p: last + 1 for p in self.peers}
         self._match_index = {p: 0 for p in self.peers}
@@ -517,6 +612,8 @@ class RaftNode:
 
     def _become_follower_locked(self, term: int) -> None:
         was_leader = self.state == LEADER
+        if was_leader:
+            metrics.incr("nomad.raft.leader_changes")
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
@@ -578,6 +675,9 @@ class RaftNode:
                 wake.wait(self.heartbeat_s)
                 continue
             with self._lock:
+                # any response (success or not) proves the peer is
+                # reachable — CheckQuorum input for the vote guard
+                self._peer_contact[peer_id] = time.monotonic()
                 if self.state != LEADER or self.current_term != term:
                     return
                 if resp["term"] > self.current_term:
@@ -740,12 +840,45 @@ class RaftNode:
     # ------------------------------------------------------------------
     # RPC handlers (follower side)
 
+    def _quorum_contact_fresh_locked(self) -> bool:
+        """Leader-side CheckQuorum: have we heard RPC responses from a
+        majority within the election timeout? (self counts)"""
+        if not self.peers:
+            return True
+        now = time.monotonic()
+        live = 1 + sum(
+            1
+            for p in self.peers
+            if now - self._peer_contact.get(p, 0.0) < self.election_s
+        )
+        return live * 2 > len(self.peers) + 1
+
     def _handle_request_vote(self, args):
         with self._lock:
             term = args["term"]
             if term < self.current_term:
                 return {"term": self.current_term, "granted": False}
             if term > self.current_term:
+                # Disruptive-server guard (Ongaro §4.2.3 / hashicorp
+                # CheckQuorum): a node that cannot HEAR the cluster (dead
+                # listener, healing partition) election-times-out on a
+                # loop and solicits votes at ever-climbing terms; without
+                # this guard each request deposes the healthy leader and
+                # the cluster churns for as long as the node stays deaf.
+                # A leader in contact with a quorum, and a follower that
+                # heard its leader within the minimum election timeout,
+                # both IGNORE the higher term (no step-down, no term
+                # bump, no vote). Real failovers are unaffected: once
+                # heartbeats actually stop, the guard lapses before any
+                # follower's own election timer fires.
+                if self.state == LEADER and self._quorum_contact_fresh_locked():
+                    return {"term": self.current_term, "granted": False}
+                if (
+                    self.state != LEADER
+                    and self.leader_id is not None
+                    and time.monotonic() - self._last_heartbeat < self.election_s
+                ):
+                    return {"term": self.current_term, "granted": False}
                 self._become_follower_locked(term)
             up_to_date = args["last_log_term"] > self._last_log_term() or (
                 args["last_log_term"] == self._last_log_term()
@@ -811,7 +944,17 @@ class RaftNode:
             if appended and self.store is not None:
                 # Persist before acking: success tells the leader these
                 # entries are stable on this follower.
-                self.store.append(appended)
+                try:
+                    self.store.append(appended)
+                except Exception:
+                    # Roll the in-memory suffix back too: otherwise the
+                    # leader's RETRY finds the entries already present,
+                    # skips the store write, and acks entries that never
+                    # hit disk — a full-cluster restart would then lose
+                    # an acked write (exposed by the chaos fsync fault).
+                    keep = appended[0].index - self._snap_last_index - 1
+                    self._log = self._log[:keep]
+                    raise
             if args["leader_commit"] > self.commit_index:
                 # §5.3: clamp to the index of the last entry COVERED BY
                 # THIS REQUEST, not our last log index — we may hold

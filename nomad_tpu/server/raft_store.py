@@ -29,6 +29,7 @@ import sqlite3
 import threading
 from typing import Optional
 
+from .. import faultplane
 from .raft_replication import LogEntry
 
 
@@ -36,6 +37,10 @@ class RaftLogStore:
     def __init__(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
+        # Fault-plane identity (faultplane.py): injected fsync
+        # failures / slow-disk rules match this label (the owning
+        # node's id when run under ChaosCluster).
+        self.chaos_label = ""
         self._lock = threading.Lock()
         # Exclusive advisory lock: two agents sharing a data_dir would
         # silently interleave terms/votes/logs (raft-boltdb fails fast on
@@ -82,6 +87,8 @@ class RaftLogStore:
     # -- stable store ---------------------------------------------------
 
     def set_state(self, term: int, voted_for: Optional[str]) -> None:
+        if faultplane.plane is not None:
+            faultplane.plane.on_disk(self.chaos_label, "state")
         with self._lock:
             self._db.execute(
                 "INSERT OR REPLACE INTO stable(key, value) VALUES ('term', ?)",
@@ -107,6 +114,12 @@ class RaftLogStore:
     def append(self, entries: list[LogEntry]) -> None:
         if not entries:
             return
+        # Injected fsync failure / slow disk (faultplane.py): raised
+        # BEFORE the write, so a "failed" append is never durable — the
+        # caller must treat it exactly like a torn write that rolled
+        # back, which is what the raft layer's error paths assume.
+        if faultplane.plane is not None:
+            faultplane.plane.on_disk(self.chaos_label, "append")
         with self._lock:
             self._db.executemany(
                 "INSERT OR REPLACE INTO log(idx, term, msg_type, payload) "
@@ -132,6 +145,8 @@ class RaftLogStore:
     # -- snapshot -------------------------------------------------------
 
     def store_snapshot(self, data: bytes, last_index: int, last_term: int) -> None:
+        if faultplane.plane is not None:
+            faultplane.plane.on_disk(self.chaos_label, "snapshot")
         with self._lock:
             self._db.execute(
                 "INSERT OR REPLACE INTO snapshot(id, last_index, last_term, data) "

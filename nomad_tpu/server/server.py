@@ -183,11 +183,47 @@ class Server:
         self.fsm.on_job_upsert = self._on_job_upsert
         self.fsm.on_volume_release = self.blocked_evals.unblock_all
         self._leader = False
+        # Replicated deployments install a replay barrier (cluster.py →
+        # RaftNode.wait_for_replay): establish_leadership must not
+        # rebuild broker state from a MID-REPLAY store or it re-enqueues
+        # evaluations whose plans are still in the unapplied log tail —
+        # the scheduler would then re-place them (duplicate allocs).
+        # None (single-node InmemLog) ⇒ state is applied synchronously,
+        # nothing to wait for.
+        self.replay_barrier: Optional[object] = None
 
     # -- lifecycle -----------------------------------------------------
 
     def establish_leadership(self) -> None:
-        """Enable leader-only subsystems (reference leader.go:224)."""
+        """Enable leader-only subsystems (reference leader.go:224).
+
+        The replay barrier runs FIRST: on a replicated server nothing
+        leader-only comes up until the local FSM has applied this
+        leadership's own barrier entry (reference leader.go Barrier).
+        Without it, subsystems start against a MID-REPLAY store — a
+        pending eval from the unapplied tail gets scheduled against
+        state that lacks its job's existing allocs and mints duplicates
+        (the load-flaky full-cluster-restart failure). Side channels are
+        also gated by _leader, so entries applied during the wait are
+        silently skipped and then swept up by the post-barrier
+        _restore_evals / subsystem starts, which all read the now-
+        caught-up store."""
+        caught_up = True
+        if self.replay_barrier is not None:
+            try:
+                caught_up = self.replay_barrier()
+            except Exception:
+                logger.exception("replay barrier failed")
+                caught_up = False
+        if not caught_up:
+            # Deposed during the wait (a revoke is queued right behind
+            # this event) — still enable everything so the transitions
+            # stay strictly alternating, but don't trust the state for
+            # eval restore; the next leader restores instead.
+            logger.warning(
+                "establishing leadership without a caught-up log "
+                "(leadership churn during recovery)"
+            )
         self.eval_broker.set_enabled(True)
         self.plan_queue.set_enabled(True)
         self.blocked_evals.set_enabled(True)
@@ -210,7 +246,8 @@ class Server:
         )
         self._gc_thread.start()
         self._leader = True
-        self._restore_evals()
+        if caught_up:
+            self._restore_evals()
         # Bootstrap the default namespace (reference leader.go
         # establishLeadership creates it so it always lists).
         try:
@@ -257,10 +294,15 @@ class Server:
 
     def _restore_evals(self) -> None:
         """Broker state is not persisted; rebuild from the state store
-        (reference leader.go:495 restoreEvals)."""
+        (reference leader.go:495 restoreEvals). Idempotent across
+        leadership churn: an eval the broker already tracks (enqueued by
+        an FSM side-channel while the replay barrier was waiting, or by
+        a previous establishment this incarnation) is skipped, so
+        restore can run any number of times without double-queueing."""
         for ev in self.state.evals():
             if ev.status == EVAL_STATUS_PENDING:
-                self.eval_broker.enqueue(ev)
+                if not self.eval_broker.tracks(ev.id):
+                    self.eval_broker.enqueue(ev)
             elif ev.status == EVAL_STATUS_BLOCKED:
                 self.blocked_evals.block(ev)
 
@@ -826,6 +868,12 @@ class Server:
             self.node_update_status(node_id, NODE_STATUS_DOWN)
         except KeyError:
             pass
+        except Exception:
+            # A deposed or quorumless leader cannot commit the down-mark
+            # (NotLeaderError / commit timeout during a partition); the
+            # next real leader's timers re-derive liveness — don't let
+            # the raft error escape into the Timer thread.
+            logger.exception("node %s down-mark failed", node_id)
 
     def _create_node_evals(
         self, node_id: str, trigger: str = EVAL_TRIGGER_NODE_UPDATE

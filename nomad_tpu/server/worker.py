@@ -23,13 +23,28 @@ from concurrent.futures import CancelledError
 from typing import Optional
 
 from .. import metrics, trace
+from ..retry import WORKER_POLICY
 from ..scheduler import new_scheduler
 from ..scheduler.context import SchedulerConfig
 from ..structs import Evaluation, Plan, PlanResult
+from .. import faultplane
+from .raft_replication import NotLeaderError
 
 logger = logging.getLogger("nomad_tpu.worker")
 
 DEQUEUE_TIMEOUT_S = 0.5
+
+
+def _retriable_device_error(e: BaseException) -> bool:
+    """Classify a device-stage failure: retriable ⇒ the batch falls back
+    to the host solve path (a sick device degrades throughput instead of
+    wedging the pipeline); terminal ⇒ the existing nack path. XLA
+    runtime errors (device OOM, halted chip, transfer failure) are
+    retriable — the host oracle needs no device. Injected chaos faults
+    carry their own classification."""
+    if isinstance(e, faultplane.DeviceFault):
+        return e.retriable
+    return type(e).__name__ == "XlaRuntimeError"
 
 
 class WorkerPlanner:
@@ -128,6 +143,12 @@ class Worker:
 
     def _run(self, stop: threading.Event) -> None:
         broker = self.server.eval_broker
+        # NotLeaderError backoff (retry.py): during a revoke window the
+        # broker still hands out evals for a beat, and every submit
+        # fails NotLeaderError — without backoff this loop nacks and
+        # redequeues at full speed (the hot loop the chaos harness
+        # reproduces with a leader kill). Resets on the next success.
+        backoff = WORKER_POLICY.backoff()
         while not stop.is_set():
             ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
             if ev is None:
@@ -136,13 +157,21 @@ class Worker:
             try:
                 with trace.use(broker.trace_context(ev.id)):
                     self._process(ev)
-            except Exception:
+                backoff.reset()
+            except (Exception, CancelledError) as e:
+                # CancelledError included: a leadership revoke disables
+                # the plan queue mid-submit and the cancelled future
+                # raises BaseException — it must nack and back off, not
+                # kill the worker thread with the eval un-nacked.
                 logger.exception("%s: eval %s failed", self.name, ev.id)
                 metrics.incr("nomad.worker.invoke.failed")
                 try:
                     broker.nack(ev.id, token)
                 except ValueError:
                     pass
+                if isinstance(e, (NotLeaderError, CancelledError)):
+                    metrics.incr("nomad.rpc.retry_count.worker.invoke")
+                    stop.wait(backoff.next())
                 continue
             # reference telemetry: nomad.worker.invoke_scheduler.<type>
             metrics.observe(
@@ -223,6 +252,9 @@ class TPUBatchWorker:
         # oldest chained ancestor's snapshot index).
         self._prev: Optional[tuple] = None
         self.processed = 0
+        # Shared NotLeaderError backoff across the commit stage (see
+        # Worker._run): a revoke window must throttle, not hot-loop.
+        self._nl_backoff = WORKER_POLICY.backoff()
 
     def start(self) -> None:
         # Fresh Event + queue per incarnation (see Worker.start).
@@ -412,6 +444,10 @@ class TPUBatchWorker:
             else:
                 self._prev = None
         t0 = time.perf_counter()
+        if faultplane.plane is not None:
+            # injected dispatch-stage fault: surfaces through the solve
+            # stage's existing failure path (nack + redeliver)
+            faultplane.plane.on_device("dispatch")
         pending = solve_eval_batch_begin(
             snapshot, self.planner, evals, self.config, used_chain=chain
         )
@@ -493,20 +529,42 @@ class TPUBatchWorker:
             if bctx is not None:
                 bctx.finish("chain-parent-failed")
             return
+        used_fallback = False
         try:
             with trace.use(bctx):
                 # phase B: block on the device, read back, materialize
                 # plans (device/readback/materialize stage timers become
                 # spans via the solver's trace.stage calls); then the
                 # plan submit is timed as the commit stage proper
-                with trace.span(bctx, "commit.finish"):
-                    plans = pending.finish()
+                try:
+                    with trace.span(bctx, "commit.finish"):
+                        if faultplane.plane is not None:
+                            faultplane.plane.on_device("finish")
+                        plans = pending.finish()
+                except (Exception, CancelledError) as de:
+                    if not _retriable_device_error(de):
+                        raise
+                    # Graceful degradation: the device stage died but the
+                    # batch's reconcile output is intact — re-solve the
+                    # same asks on the host oracle path. A sick device
+                    # costs throughput, not the pipeline.
+                    logger.warning(
+                        "device stage failed (%s: %s); falling back to "
+                        "host solve for %d evals",
+                        type(de).__name__, de, len(batch),
+                    )
+                    metrics.incr("nomad.worker.device_failover")
+                    with trace.span(
+                        bctx, "device.failover", error=type(de).__name__
+                    ):
+                        plans = pending.solve_host_fallback()
+                    used_fallback = True
                 t0 = time.perf_counter()
                 all_full = self._commit_batch(
                     [e for e, _ in batch], plans, snapshot,
                     blocked_basis=chained_on[1] if chained_on else None,
                 )
-        except (Exception, CancelledError):
+        except (Exception, CancelledError) as e:
             # CancelledError included: plan futures cancelled by a queue
             # disable (leadership loss) are BaseException since py3.8 and
             # must still nack, not kill the commit thread
@@ -516,15 +574,23 @@ class TPUBatchWorker:
             outcome["ok"] = False
             if bctx is not None:
                 bctx.finish("commit-failed")
+            if isinstance(e, (NotLeaderError, CancelledError)):
+                # leadership churn: throttle instead of hot-looping the
+                # solve→commit→nack cycle until the revoke lands
+                metrics.incr("nomad.rpc.retry_count.worker.submit")
+                self._stop.wait(self._nl_backoff.next())
             return
         finally:
             # chain cutoff: the solve stage stops chaining on this batch
             # the moment its effects are (or will never be) committed
             committed.set()
+        self._nl_backoff.reset()
         # A partial commit is a failed verdict for chaining purposes: the
         # trimmed placements are in the chained used' tensor but never
         # landed, so a follower that baked them in must re-solve too.
-        outcome["ok"] = all_full
+        # A host fallback is too: the committed placements came from the
+        # host oracle, not the device tensor a chained child consumed.
+        outcome["ok"] = all_full and not used_fallback
         # commit_seconds joins the solver's host_prep/device/readback/
         # materialize stage registry: the full commit half of the pipeline
         metrics.observe(

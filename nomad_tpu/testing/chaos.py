@@ -1,0 +1,251 @@
+"""Chaos scenario harness: scripted kill/partition/heal clusters.
+
+The FaultPlane core lives in the production-side leaf
+``nomad_tpu/faultplane.py`` (hook sites import only that); this module
+is the TEST surface — it re-exports the whole plane API so tests and
+docs say ``from nomad_tpu.testing import chaos`` — plus
+:class:`ChaosCluster`, the in-process multi-server cluster that
+scenarios kill, restart, partition, and heal, with the standard
+invariants every scenario asserts: no acked write lost, no duplicate
+alloc minted, convergence within a bound.
+
+See docs/fault-injection.md for the scenario cookbook.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Iterable, Optional
+
+from ..faultplane import (  # noqa: F401  (re-exported plane API)
+    DeviceFault,
+    DropResponse,
+    FaultPlane,
+    InjectedDiskError,
+    InjectedRPCError,
+    active,
+    env_knobs_active,
+    install,
+    uninstall,
+)
+
+
+def __getattr__(name):
+    # `chaos.plane` must always reflect the LIVE slot in faultplane
+    # (install/uninstall rebind it there); a by-value re-export would
+    # go stale after the first install.
+    if name == "plane":
+        from .. import faultplane
+
+        return faultplane.plane
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# Scenario harness: a live in-process cluster scripted kills/partitions run
+# against, with the standard invariants.
+# ---------------------------------------------------------------------------
+
+
+class ChaosCluster:
+    """An in-process raft cluster with durable per-node data dirs that
+    scenarios can kill, restart, partition, and heal.
+
+    Every server's ConnPool and raft store carry the node's label so
+    the installed FaultPlane can target them; ``install_plane=True``
+    (default) installs a fresh seeded plane for the cluster's lifetime
+    and uninstalls it on shutdown.
+    """
+
+    def __init__(self, n: int, data_root: str, seed: int = 0,
+                 install_plane: bool = True, **server_kw) -> None:
+        import socket
+
+        self.data_root = data_root
+        self.seed = seed
+        self.server_kw = dict(server_kw)
+        self.plane: Optional[FaultPlane] = None
+        self._installed = False
+        if install_plane:
+            self.plane = install(FaultPlane(seed=seed))
+            self._installed = True
+        socks = [socket.create_server(("127.0.0.1", 0)) for _ in range(n)]
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        self.ids = [f"s{i}" for i in range(n)]
+        self.addrs = {
+            nid: ("127.0.0.1", ports[i]) for i, nid in enumerate(self.ids)
+        }
+        self.servers: dict[str, object] = {}
+        # acked-write journal for the no-acked-write-lost invariant:
+        # scenarios record ids here only after the RPC returned success
+        self.acked_jobs: set[str] = set()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _boot_one(self, nid: str):
+        from ..server.cluster import ClusterServer
+
+        kw = dict(self.server_kw)
+        cs = ClusterServer(
+            nid,
+            peers={p: a for p, a in self.addrs.items() if p != nid},
+            port=self.addrs[nid][1],
+            num_workers=kw.pop("num_workers", 1),
+            data_dir=os.path.join(self.data_root, nid),
+            **kw,
+        )
+        # ClusterServer.__init__ already labels its pool/rpc/raft_store
+        # with the node id for the plane; the harness only needs to
+        # teach the plane which fabric addr belongs to which label.
+        if self.plane is not None:
+            self.plane.register_addr(nid, cs.rpc.addr)
+        cs.start()
+        self.servers[nid] = cs
+        return cs
+
+    def start(self) -> "ChaosCluster":
+        for nid in self.ids:
+            self._boot_one(nid)
+        return self
+
+    def shutdown(self) -> None:
+        for cs in list(self.servers.values()):
+            try:
+                cs.shutdown()
+            except Exception:
+                pass
+        self.servers.clear()
+        if self._installed:
+            uninstall()
+
+    # -- scripted faults -----------------------------------------------
+
+    def kill(self, nid: str) -> None:
+        """Hard-stop one server (threads die with the sockets; the data
+        dir survives for restart)."""
+        cs = self.servers.pop(nid, None)
+        if cs is not None:
+            cs.shutdown()
+
+    def restart(self, nid: str):
+        """Boot a fresh incarnation of a killed server from its disk."""
+        assert nid not in self.servers, f"{nid} still running"
+        return self._boot_one(nid)
+
+    def kill_when(self, nid: str, cond: Callable[[object], bool],
+                  timeout_s: float = 30.0) -> bool:
+        """Kill `nid` the moment cond(server) first holds — the scripted
+        way to land a crash inside a specific window (e.g. mid-replay:
+        ``cond=lambda cs: cs.raft.last_applied >= k``). Condition-
+        triggered, not timing-triggered, so it reproduces across boxes."""
+        cs = self.servers.get(nid)
+        if cs is None:
+            return False
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if cond(cs):
+                self.kill(nid)
+                return True
+            time.sleep(0.002)
+        return False
+
+    def partition(self, group_a: Iterable[str], group_b: Iterable[str]) -> None:
+        assert self.plane is not None, "cluster booted without a plane"
+        self.plane.partition(group_a, group_b)
+
+    def heal(self, kind: Optional[str] = None) -> None:
+        """Drop all fault rules, or only one kind (e.g. 'rpc.drop' to
+        end a partition while keeping disk/device faults live)."""
+        if self.plane is not None:
+            self.plane.heal(kind)
+
+    # -- observation ---------------------------------------------------
+
+    def leader(self):
+        for cs in self.servers.values():
+            if cs.is_leader():
+                return cs
+        return None
+
+    def wait_for_stable_leader(self, timeout_s: float = 45.0,
+                               stable_for_s: float = 0.0):
+        """Wait for exactly one live leader whose replay barrier has
+        applied (its FSM is caught up with its own log) and — when
+        stable_for_s > 0 — that keeps the lease that long. This is the
+        recovery-time 'wait for a stable leader' primitive: callers
+        retry through churn instead of failing on the first
+        NotLeaderError."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            leaders = [c for c in self.servers.values() if c.is_leader()]
+            if len(leaders) == 1:
+                lead = leaders[0]
+                if lead.raft.wait_for_replay(
+                    timeout_s=min(5.0, max(0.1, deadline - time.monotonic()))
+                ):
+                    if stable_for_s <= 0:
+                        return lead
+                    t0 = time.monotonic()
+                    while time.monotonic() - t0 < stable_for_s:
+                        if not lead.is_leader():
+                            break
+                        time.sleep(0.02)
+                    else:
+                        return lead
+            time.sleep(0.02)
+        return None
+
+    def converged(self, timeout_s: float = 45.0) -> bool:
+        """Every live server applied the same log prefix (last_applied
+        equal across the cluster and no committed entry unapplied)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            lead = self.leader()
+            if lead is not None:
+                applied = [
+                    cs.raft.last_applied for cs in self.servers.values()
+                ]
+                if (
+                    len(set(applied)) == 1
+                    and lead.raft.last_applied >= lead.raft.commit_index
+                    and lead.raft.commit_index > 0
+                ):
+                    return True
+            time.sleep(0.05)
+        return False
+
+    # -- invariants ----------------------------------------------------
+
+    def check_invariants(self) -> None:
+        """Assert the scenario-independent safety properties on every
+        live server: no acked write lost, no duplicate alloc minted."""
+        for nid, cs in self.servers.items():
+            st = cs.server.state
+            jobs = {
+                j.id for j in st.jobs() if not j.stop
+            }
+            missing = self.acked_jobs - jobs
+            assert not missing, (
+                f"acked writes lost on {nid}: jobs {sorted(missing)}"
+            )
+            assert_no_duplicate_allocs(st, label=nid)
+
+
+def assert_no_duplicate_allocs(state, label: str = "") -> None:
+    """No two live allocations may share (namespace, job, alloc name) —
+    a duplicate means one placement request was minted twice (e.g. an
+    eval restored from a stale mid-replay snapshot re-placed a job)."""
+    seen: dict[tuple, str] = {}
+    for a in state.allocs():
+        if a.terminal_status():
+            continue
+        key = (a.namespace, a.job_id, a.name)
+        if key in seen:
+            raise AssertionError(
+                f"duplicate alloc minted{' on ' + label if label else ''}: "
+                f"{key} -> {seen[key]} and {a.id}"
+            )
+        seen[key] = a.id

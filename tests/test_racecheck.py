@@ -1,7 +1,9 @@
 """Race-detector (lock-order inversion) tests — SURVEY §5.2's -race
 analog. The e2e case runs the full server+client stack under the
 detector in a SUBPROCESS so the monkeypatched primitives never leak
-into the rest of the suite."""
+into the rest of the suite. The partition/heal case additionally runs
+a chaos-plane scenario under the detector: fault-window code paths
+(election, step-down, forward retry) hold the lock discipline too."""
 
 import subprocess
 import sys
@@ -129,6 +131,77 @@ def test_full_stack_is_inversion_free(tmp_path):
     )
     assert out.returncode == 0, (
         f"stdout:\n{out.stdout[-4000:]}\nstderr:\n{out.stderr[-2000:]}"
+    )
+    assert "RACECHECK CLEAN" in out.stdout
+
+
+def test_partition_heal_is_inversion_free(tmp_path):
+    """One chaos partition/heal scenario under the lock-order detector:
+    a 3-server raft cluster loses its leader behind a partition while a
+    write lands on the majority side, heals, and converges — the
+    election/step-down/retry paths all hold the lock discipline, and
+    the scenario's own invariants (acked write present everywhere, no
+    duplicate allocs) pass."""
+    script = textwrap.dedent(
+        """
+        import sys, time
+        sys.path.insert(0, %r)
+        from nomad_tpu.testing import racecheck
+        racecheck.install()  # BEFORE any nomad_tpu locks are created
+
+        from nomad_tpu import mock
+        from nomad_tpu.rpc import ConnPool
+        from nomad_tpu.testing.chaos import ChaosCluster
+
+        cluster = ChaosCluster(3, %r, seed=17)
+        pool = ConnPool()
+        try:
+            cluster.start()
+            lead = cluster.wait_for_stable_leader(60)
+            assert lead is not None, "no leader"
+            job = mock.job(id="race-chaos-pre")
+            job.task_groups[0].count = 1
+            pool.call(lead.addr, "Job.register", {"job": job})
+            cluster.acked_jobs.add(job.id)
+
+            others = [n for n in cluster.ids if n != lead.node_id]
+            cluster.partition({lead.node_id}, set(others))
+            deadline = time.time() + 30
+            lead2 = None
+            while time.time() < deadline and lead2 is None:
+                for nid in others:
+                    cs = cluster.servers[nid]
+                    if cs.is_leader() and cs.raft.wait_for_replay(0.5):
+                        lead2 = cs
+                        break
+                time.sleep(0.05)
+            assert lead2 is not None, "majority never elected"
+            job2 = mock.job(id="race-chaos-mid")
+            job2.task_groups[0].count = 1
+            pool.call(lead2.addr, "Job.register", {"job": job2})
+            cluster.acked_jobs.add(job2.id)
+
+            cluster.heal()
+            assert cluster.converged(60), "no convergence after heal"
+            cluster.check_invariants()
+        finally:
+            pool.shutdown()
+            cluster.shutdown()
+        vs = racecheck.violations()
+        if vs:
+            print(racecheck.report())
+            raise SystemExit(f"{len(vs)} lock-order inversions")
+        print("RACECHECK CLEAN")
+        """
+    ) % ("/root/repo", str(tmp_path / "chaos"))
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0, (
+        f"stdout:\n{out.stdout[-4000:]}\nstderr:\n{out.stderr[-3000:]}"
     )
     assert "RACECHECK CLEAN" in out.stdout
 

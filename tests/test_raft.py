@@ -172,11 +172,26 @@ def test_snapshot_compaction_and_catch_up():
         )
         c.rpcs[lagging].shutdown()
         jobs = [mock.job() for _ in range(40)]
+        # Churn-tolerant apply loop: with 60/250ms timers a loaded box
+        # can depose the leader mid-loop — re-locate the current leader
+        # and retry under the shared policy (retry.py) instead of
+        # failing on the first NotLeaderError. job_register is an
+        # idempotent upsert, so an unknown-outcome retry is safe here.
+        from nomad_tpu.retry import RetryPolicy, call_with_retry
+
+        pol = RetryPolicy(base_s=0.05, max_s=0.5, deadline_s=30.0)
         for j in jobs:
-            leader.apply("job_register", (j, None))
+            call_with_retry(
+                lambda j=j: c.wait_leader(5).apply("job_register", (j, None)),
+                policy=pol,
+                retry_if=lambda e: isinstance(
+                    e, (NotLeaderError, TimeoutError)
+                ),
+                label="test.raft.apply",
+            )
         # force log compaction past the lagging follower's position
         assert wait_until(
-            lambda: leader._snap_last_index > 0, timeout_s=10
+            lambda: c.wait_leader()._snap_last_index > 0, timeout_s=10
         ), "leader should have compacted its log"
         # bring the follower back on the same port
         port = c.rpcs[lagging].addr[1]
