@@ -132,6 +132,11 @@ _AGENT_READ = [
     # traces expose request-level internals (job/eval ids, stage
     # timings): same agent:read gate as /v1/metrics
     ("GET", re.compile(r"^/v1/traces(/.*)?$")),
+    # solver observability snapshot (compile ledger / occupancy /
+    # transfers / device memory): agent-local read surface like
+    # /v1/metrics — read-only, so agent:read, not the pprof-style
+    # agent:write
+    ("GET", re.compile(r"^/v1/solver/status$")),
 ]
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)

@@ -137,4 +137,8 @@ def debug_bundle(api) -> dict:
     grab("namespaces", lambda: api.namespaces.list())
     grab("threads", lambda: api.get("/v1/agent/pprof/goroutine"))
     grab("heap", lambda: api.get("/v1/agent/pprof/heap"))
+    # solver observability: compile ledger / occupancy / transfers /
+    # device memory — one archive now diagnoses a slow solve offline
+    grab("solver", lambda: api.agent.solver_status())
+    grab("traces", lambda: api.traces.list(limit=50))
     return bundle

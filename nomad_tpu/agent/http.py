@@ -1188,6 +1188,39 @@ class HTTPAgentServer:
         route("GET", "/v1/traces", traces_list)
         route("GET", "/v1/traces/(?P<id>[^/]+)", trace_get)
 
+        def solver_status(p, q, body, tok):
+            # /v1/solver/status: the solver observatory's snapshot —
+            # compile ledger (bucket recompiles vs cache hits), batch
+            # occupancy/padding waste, host<->device transfer bytes,
+            # and device memory (solverobs.py). Same agent:read gate as
+            # /v1/metrics; always on (observability, not debug).
+            import sys as _sys
+
+            from .. import solverobs
+
+            out = solverobs.snapshot()
+            # jax's own jit-cache ground truth, cross-checking the
+            # ledger — only when the solver stack is already loaded in
+            # this process (never drag jax into a control plane)
+            kmod = _sys.modules.get("nomad_tpu.scheduler.tpu.kernels")
+            out["jit_cache_sizes"] = (
+                kmod.jit_cache_sizes() if kmod is not None else None
+            )
+            w = getattr(srv, "tpu_worker", None)
+            out["worker"] = (
+                {
+                    "pipeline": w.pipeline,
+                    "batch_size": w.batch_size,
+                    "processed": w.processed,
+                    "schedulers": list(w.schedulers),
+                }
+                if w is not None
+                else None
+            )
+            return out
+
+        route("GET", "/v1/solver/status", solver_status)
+
         def agent_members(p, q, body, tok):
             return [m.to_wire() for m in self.cluster.serf.members()]
 

@@ -753,6 +753,13 @@ class AgentAPI(_Resource):
         )
         return resp.read().decode()
 
+    def solver_status(self):
+        """Solver observability snapshot (/v1/solver/status): compile
+        ledger, batch occupancy/padding waste, host<->device transfer
+        bytes, device memory (nomad_tpu/solverobs.py); rendered by
+        `operator solver status|top`."""
+        return self.c.get("/v1/solver/status")
+
     def self(self):
         return self.c.get("/v1/agent/self")
 

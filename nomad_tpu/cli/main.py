@@ -1969,11 +1969,12 @@ _TOP_STAGE_ORDER = [
 ]
 
 
-def _render_top(snap: dict, prev) -> str:
+def _render_top(snap: dict, prev, solver=None) -> str:
     """One `operator top` frame from a /v1/metrics snapshot. prev is
     (monotonic_time, snapshot) of the previous frame (None on the
     first) — eval throughput is the e2e-count delta between frames,
-    falling back to the last window's rate."""
+    falling back to the last window's rate. solver is the optional
+    /v1/solver/status payload feeding the solver panel row."""
     import time as _time
 
     gauges = snap.get("gauges") or {}
@@ -2016,6 +2017,37 @@ def _render_top(snap: dict, prev) -> str:
             " scheduler worker(s)"
             f"   processed {int(gauges.get('nomad.workers.processed', 0))}"
         ),
+    ]
+    # solver panel: occupancy %, steady-state recompiles, device p95 —
+    # /v1/solver/status for the ledger, /v1/metrics for the occupancy
+    # histogram and the device-stage percentiles. Rendered only when a
+    # solver actually exists here: a TPU batch worker is wired, or
+    # batches have been solved (the snapshot itself is always truthy,
+    # control-plane-only agents included).
+    occ_s = samples.get("nomad.solver.occupancy")
+    has_solver = solver is not None and (
+        solver.get("worker") is not None
+        or (solver.get("occupancy") or {}).get("batches")
+    )
+    if has_solver or (occ_s and occ_s.get("count")):
+        ledger = (solver or {}).get("ledger") or {}
+        steady = ledger.get("steady_recompiles", "-")
+        dev = samples.get("nomad.tpu.device_seconds") or {}
+        occ_txt = (
+            f"{occ_s['last'] * 100:.1f}%"
+            if occ_s and occ_s.get("count")
+            else "-"
+        )
+        lines.append(
+            f"Solver      occupancy {occ_txt}"
+            f"   steady recompiles {steady}"
+            + (
+                f"   device p95 {_fmt_dur(dev['p95'])}"
+                if dev.get("count") and "p95" in dev
+                else "   device p95 -"
+            )
+        )
+    lines += [
         "",
         "Stage latencies (cumulative | last window):",
     ]
@@ -2065,7 +2097,11 @@ def cmd_operator_top(args) -> int:
     try:
         while True:
             snap = api.agent.metrics()
-            frame = _render_top(snap, prev)
+            try:
+                solver = api.agent.solver_status()
+            except Exception:
+                solver = None  # older agent / route unavailable
+            frame = _render_top(snap, prev, solver=solver)
             prev = (_time.monotonic(), snap)
             frames += 1
             last = args.once or (args.n and frames >= args.n)
@@ -2138,6 +2174,215 @@ def cmd_operator_trace(args) -> int:
     print(_fmt_table(
         rows, ["ID", "Name", "Duration", "Spans", "Status", "Evals"]
     ))
+    return 0
+
+
+def _fmt_bytes(n) -> str:
+    """Compact byte count: 512B / 3.2KB / 1.5MB / 2.1GB."""
+    n = float(n or 0)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
+def _render_solver_status(snap: dict) -> str:
+    """One `operator solver status` frame from /v1/solver/status."""
+    lines = ["nomad-tpu solver status", ""]
+    w = snap.get("worker")
+    if w:
+        lines.append(
+            f"Worker      batch_size {w['batch_size']}"
+            f"  pipeline {'on' if w.get('pipeline') else 'off'}"
+            f"  processed {w.get('processed', 0)} evals"
+        )
+    occ = snap.get("occupancy") or {}
+    last = occ.get("last_batch") or {}
+    asks = occ.get("last_asks") or {}
+    mean = occ.get("mean")
+    lines.append(
+        "Occupancy   "
+        + (
+            f"last {last['occupancy'] * 100:.1f}% "
+            f"({last['n']}x{last['g']} real in "
+            f"{last['pad_n']}x{last['pad_g']} padded, "
+            f"waste {last['pad_waste'] * 100:.1f}%)"
+            if last
+            else "no batches solved yet"
+        )
+        + (f"   mean {mean * 100:.1f}%" if mean is not None else "")
+        + (
+            f"   asks {asks['groups']} groups / "
+            f"{asks['requests']} requests"
+            if asks
+            else ""
+        )
+    )
+    tr = snap.get("transfers") or {}
+    lines.append(
+        f"Transfers   h2d {_fmt_bytes(tr.get('h2d_bytes'))}"
+        f"   d2h {_fmt_bytes(tr.get('d2h_bytes'))} (cumulative)"
+    )
+    mem = snap.get("device_memory")
+    lines.append(
+        "Device mem  "
+        + (
+            f"in use {_fmt_bytes(mem.get('bytes_in_use'))}"
+            + (
+                f" / limit {_fmt_bytes(mem['bytes_limit'])}"
+                if mem.get("bytes_limit")
+                else ""
+            )
+            if mem
+            else "unreported by backend (CPU fallback reports none)"
+        )
+        + f"   live arrays {_fmt_bytes(snap.get('live_array_bytes'))}"
+        + f" (highwater {_fmt_bytes(snap.get('live_array_highwater_bytes'))})"
+    )
+    ledger = snap.get("ledger") or {}
+    lines.append("")
+    lines.append(
+        f"Compile ledger: {ledger.get('compiles', 0)} compiles, "
+        f"{ledger.get('cache_hits', 0)} cache hits, "
+        f"{ledger.get('steady_recompiles', 0)} steady-state recompiles"
+    )
+    rows = []
+    for name, k in sorted((ledger.get("kernels") or {}).items()):
+        rows.append([
+            name,
+            str(k["compiles"]),
+            str(k["steady_recompiles"]),
+            str(k["cache_hits"]),
+            f"{k['first_compile_ms']:.1f}ms",
+            f"{k['steady_compile_ms']:.1f}ms",
+            str(k["signatures"]),
+        ])
+    if rows:
+        lines.append(_fmt_table(
+            rows,
+            ["KERNEL", "COMPILES", "RECOMPILES", "HITS",
+             "FIRST-COMPILE", "STEADY-COMPILE", "SHAPES"],
+        ))
+    jit = snap.get("jit_cache_sizes")
+    if jit:
+        lines.append(
+            "jit cache (jax ground truth): "
+            + "  ".join(f"{k}={v}" for k, v in sorted(jit.items()))
+        )
+    return "\n".join(lines)
+
+
+def cmd_operator_solver_status(args) -> int:
+    """Render /v1/solver/status: the compile ledger (bucket recompiles
+    vs cache hits), batch occupancy vs padding waste, host<->device
+    transfer bytes, and device memory — the triage surface for a slow
+    solve (operations.md § Diagnosing a slow solve)."""
+    import json as _json
+
+    api = _client(args)
+    snap = api.agent.solver_status()
+    if args.as_json:
+        print(_json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    print(_render_solver_status(snap))
+    return 0
+
+
+def cmd_operator_solver_top(args) -> int:
+    """Refresh-loop solver dashboard: occupancy, recompile rate, and
+    transfer rates from /v1/solver/status, beside the device-stage
+    percentiles from /v1/metrics."""
+    import time as _time
+
+    api = _client(args)
+    interval = max(0.2, float(args.interval))
+    frames = 0
+    prev = None
+    try:
+        while True:
+            snap = api.agent.solver_status()
+            msnap = api.agent.metrics()
+            lines = [_render_solver_status(snap)]
+            ledger = snap.get("ledger") or {}
+            tr = snap.get("transfers") or {}
+            if prev is not None:
+                prev_t, prev_ledger, prev_tr = prev
+                dt = max(_time.monotonic() - prev_t, 1e-9)
+                # clamp at 0: an agent restart between frames resets
+                # the cumulative counters and would render negatives
+                compiled = max(0, ledger.get("compiles", 0) - prev_ledger)
+                h2d_rate = max(0, tr.get("h2d_bytes", 0) - prev_tr[0]) / dt
+                d2h_rate = max(0, tr.get("d2h_bytes", 0) - prev_tr[1]) / dt
+                lines.append(
+                    f"\nRates       compiles {compiled} in {dt:.1f}s"
+                    f"   h2d {_fmt_bytes(h2d_rate)}/s"
+                    f"   d2h {_fmt_bytes(d2h_rate)}/s"
+                )
+            samples = msnap.get("samples") or {}
+            rows = []
+            for name in (
+                "nomad.tpu.host_prep_seconds",
+                "nomad.tpu.device_seconds",
+                "nomad.tpu.readback_seconds",
+                "nomad.tpu.materialize_seconds",
+                "nomad.solver.compile_seconds",
+            ):
+                s = samples.get(name)
+                if not s or "p50" not in s:
+                    continue
+                rows.append([
+                    name, str(int(s["count"])),
+                    _fmt_dur(s["p50"]), _fmt_dur(s["p95"]),
+                    _fmt_dur(s["p99"]),
+                ])
+            if rows:
+                lines.append("")
+                lines.append(_fmt_table(
+                    rows, ["DEVICE STAGE", "COUNT", "P50", "P95", "P99"]
+                ))
+            prev = (
+                _time.monotonic(), ledger.get("compiles", 0),
+                (tr.get("h2d_bytes", 0), tr.get("d2h_bytes", 0)),
+            )
+            frames += 1
+            last = args.once or (args.n and frames >= args.n)
+            if not last and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print("\n".join(lines))
+            sys.stdout.flush()
+            if last:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_event_stream(args) -> int:
+    """Follow /v1/event/stream as NDJSON (reference api/event_stream.go
+    + `nomad event` tooling): one frame per line, payloads wire-lowered.
+    -topic Topic[:Key] filters (repeatable); -index resumes from an
+    index; interrupt to stop."""
+    import json as _json
+
+    from .. import codec
+    from ..api.client import event_stream
+
+    api = _client(args)
+    topics: dict[str, list[str]] = {}
+    for t in args.topic:
+        topic, sep, key = t.partition(":")
+        topics.setdefault(topic, []).append(key if sep else "*")
+    try:
+        for frame in event_stream(
+            api, topics=topics, index=args.index, namespace=args.namespace
+        ):
+            print(_json.dumps(
+                codec.to_wire(frame), default=codec.json_default
+            ))
+            sys.stdout.flush()
+    except KeyboardInterrupt:
+        return 0
     return 0
 
 
@@ -2446,6 +2691,20 @@ def build_parser() -> argparse.ArgumentParser:
     edel.add_argument("eval_id")
     edel.set_defaults(fn=cmd_eval_delete)
 
+    evt = sub.add_parser("event", help="event stream commands")
+    evtsub = evt.add_subparsers(dest="subcmd")
+    evst = evtsub.add_parser(
+        "stream", help="follow /v1/event/stream as NDJSON"
+    )
+    evst.add_argument(
+        "-topic", action="append", default=[],
+        help="Topic[:Key] filter, repeatable (e.g. Job:web)",
+    )
+    evst.add_argument("-index", type=int, default=0,
+                      help="resume from this index")
+    evst.add_argument("-namespace", default="")
+    evst.set_defaults(fn=cmd_event_stream)
+
     dep = sub.add_parser("deployment", help="deployment commands")
     dsub = dep.add_subparsers(dest="subcmd")
     dl = dsub.add_parser("list")
@@ -2713,6 +2972,25 @@ def build_parser() -> argparse.ArgumentParser:
     optr.add_argument("-eval-id", dest="eval_id", default="")
     optr.add_argument("-job-id", dest="job_id", default="")
     optr.set_defaults(fn=cmd_operator_trace)
+    opsol = opsub.add_parser(
+        "solver", help="solver device observability (/v1/solver/status)"
+    )
+    opsolsub = opsol.add_subparsers(dest="subsubcmd")
+    opsst = opsolsub.add_parser(
+        "status", help="compile ledger, occupancy, transfers, device memory"
+    )
+    opsst.add_argument("-json", action="store_true", dest="as_json")
+    opsst.set_defaults(fn=cmd_operator_solver_status)
+    opstp = opsolsub.add_parser(
+        "top", help="refresh-loop solver dashboard"
+    )
+    opstp.add_argument("-interval", type=float, default=2.0,
+                       help="seconds between refreshes")
+    opstp.add_argument("-n", type=int, default=0,
+                       help="frames to render (0 = until interrupted)")
+    opstp.add_argument("-once", action="store_true",
+                       help="render a single frame and exit")
+    opstp.set_defaults(fn=cmd_operator_solver_top)
     _args_operator_debug(opsub.add_parser("debug"))
     opsch = opsub.add_parser("scheduler")
     opschsub = opsch.add_subparsers(dest="subsubcmd")

@@ -48,6 +48,27 @@ def _pad_to(x: int, bucket: int) -> int:
     return ((x + bucket - 1) // bucket) * bucket
 
 
+def jit_cache_sizes() -> dict[str, int]:
+    """The jit-cache entry count of each module-level kernel, straight
+    from jax — the ground truth the compile ledger (solverobs.py) is
+    cross-checked against in /v1/solver/status. Our signature ledger
+    COUNTS events over time; this reports what jax currently CACHES, so
+    ledger compiles >= cache size always holds (evictions, restarts).
+    Entry-point factories (make_sharded_solver*) build fresh jits per
+    mesh and are observed per-instance by their callers instead."""
+    out: dict[str, int] = {}
+    for name, fn in (
+        ("solve_placement", solve_placement),
+        ("solve_placement_compact", solve_placement_compact),
+        ("solve_placement_preempt", solve_placement_preempt),
+    ):
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:  # private API seam: absent ⇒ report unknown
+            out[name] = -1
+    return out
+
+
 def pad_n(n: int) -> int:
     """Node-axis bucket: powers of two up to 2048, then multiples of 2048.
 

@@ -209,6 +209,14 @@ def build_node_table(
         cores_free=cores_free,
     )
     table._allocs_by_node = allocs_by_node
+    # observability: the lowered table's host-side tensor footprint —
+    # the upper bound of what a cold (non-resident) solve ships to the
+    # device per batch (solverobs feeds /v1/solver/status)
+    from ... import solverobs
+
+    solverobs.note_table(
+        n, cap.nbytes + used.nbytes + tier_used.nbytes + dcs.nbytes
+    )
     return table
 
 

@@ -20,7 +20,7 @@ import logging
 import time
 from typing import Optional
 
-from ... import trace
+from ... import solverobs, trace
 from ...structs import Evaluation, Plan
 from ...structs.structs import (
     DEPLOYMENT_STATUS_FAILED,
@@ -318,6 +318,12 @@ def solve_eval_batch_begin(
         t0 = time.monotonic_ns()
         plans, asks = _reconcile_eval_batch(state, planner, evals, config)
         trace.stage("reconcile", time.monotonic_ns() - t0)
+        # asks-per-batch telemetry: how much work one solver dispatch
+        # carries (occupancy's numerator lives solver-side; this is the
+        # demand side the broker drained into the batch)
+        solverobs.note_asks(
+            len(asks), sum(len(a.requests) for a in asks)
+        )
         solver = BatchSolver(
             state, config, solve_fn=solve_fn,
             solve_preempt_fn=solve_preempt_fn, resident=resident,

@@ -38,7 +38,7 @@ from ...structs import (
     generate_uuids,
     now_ns,
 )
-from ... import trace
+from ... import solverobs, trace
 from ...gctune import paused_gc
 from ..context import EvalContext, SchedulerConfig
 from ..reconcile import PlacementRequest
@@ -170,8 +170,18 @@ class ResidentClusterState:
             ).reshape(n, 3)
             cap[:n] = np.clip(cap_rows, 0, 2**31 - 1)
             used[:n] = np.clip(used_rows, 0, 2**31 - 1)
+            t_up0 = now_ns()
             self._cap_dev = jax.device_put(cap)
             self._used_dev = jax.device_put(used)
+            # block before timestamping: device_put only ENQUEUES on
+            # async backends, and an un-awaited span would read ~0 on
+            # exactly the slow-link deployments the span exists to
+            # expose (the full sync is rare — node-universe changes)
+            jax.block_until_ready(self._used_dev)
+            solverobs.record_transfer(
+                "h2d", cap.nbytes + used.nbytes,
+                dur_ns=now_ns() - t_up0, span=True,
+            )
             self._node_vers = vers
             self._np = np_
             self._usage = usage
@@ -193,6 +203,11 @@ class ResidentClusterState:
             ).astype(np.int32)
             idx = np.asarray(changed_idx, dtype=np.int32)
             self._used_dev = _scatter_rows(self._used_dev, idx, rows)
+            # bytes only, no span: the scatter call above is a jit
+            # DISPATCH (a new idx shape trace/compiles synchronously —
+            # timed_call ledgers that as solver.compile), so timing it
+            # as a transfer would attribute compile cost to the link
+            solverobs.record_transfer("h2d", rows.nbytes + idx.nbytes)
             self._usage = usage
             self.last_sync = f"delta:{len(changed_idx)}"
         else:
@@ -217,7 +232,11 @@ def _scatter_rows(used_dev, idx, rows, donate: bool = True):
         fn = _SCATTER_JITS[donate] = jax.jit(
             _scatter, donate_argnums=(0,) if donate else ()
         )
-    return fn(used_dev, idx, rows)
+    return solverobs.timed_call(
+        "scatter_rows",
+        ("scatter_rows", donate, tuple(used_dev.shape), tuple(idx.shape)),
+        fn, used_dev, idx, rows,
+    )
 
 
 _SCATTER_JITS: dict = {}
@@ -238,7 +257,11 @@ def _scatter_add_rows(used_dev, idx, rows):
             return jnp.maximum(used.at[idx].add(rows), 0)
 
         fn = _SCATTER_ADD_JIT["fn"] = jax.jit(_scatter_add)
-    return fn(used_dev, idx, rows)
+    return solverobs.timed_call(
+        "scatter_add_rows",
+        ("scatter_add_rows", tuple(used_dev.shape), tuple(idx.shape)),
+        fn, used_dev, idx, rows,
+    )
 
 
 _SCATTER_ADD_JIT: dict = {}
@@ -1084,7 +1107,24 @@ class BatchSolver:
                 cap_in = dcap
             if dused is not None and dused.shape == (np_, 3):
                 used_in = dused
-        inst, over, used_out = solve_placement_compact(
+        solverobs.record_batch(n, g, np_, gp)
+        # host->device bytes: exactly the numpy arguments this dispatch
+        # uploads (a device-resident cap/used input ships nothing)
+        solverobs.record_transfer("h2d", sum(
+            a.nbytes
+            for a in (
+                cap_in, used_in, asks_arr, counts, feas_packed, feas_idx,
+                bias_rows, bias_idx, ucap_rows, ucap_idx,
+            )
+            if isinstance(a, np.ndarray)
+        ))
+        sig = (
+            "solve_placement_compact", np_, gp, feas_packed.shape[0],
+            bias_rows.shape[0], ucap_rows.shape[0], str(ucap_rows.dtype),
+            maxc,
+        )
+        inst, over, used_out = solverobs.timed_call(
+            "solve_placement_compact", sig, solve_placement_compact,
             cap_in,
             used_in,
             asks_arr,
@@ -1125,6 +1165,13 @@ class BatchSolver:
         rb_ns = now_ns() - t_rb0
         metrics.time_ns("nomad.tpu.readback_seconds", rb_ns)
         trace.stage("readback", rb_ns)
+        # device->host bytes actually moved (used_out stays on device
+        # for the chain); plus a post-solve device-memory census
+        solverobs.record_transfer(
+            "d2h", result[0].nbytes + result[1].nbytes,
+            dur_ns=rb_ns, span=True,
+        )
+        solverobs.sample_device_memory()
         return result
 
     def _run_kernel(
@@ -1157,6 +1204,10 @@ class BatchSolver:
             table, groups
         )
         used[:n] = used_n[:n]
+        solverobs.record_batch(n, g, np_, gp)
+        solverobs.record_transfer("h2d", sum(
+            a.nbytes for a in (cap, used, asks_arr, counts, feas, bias, ucap)
+        ))
         if use_preempt:
             tl = np.zeros(gp, dtype=np.int32)
             tl[:g] = tier_limit[:g]
@@ -1175,12 +1226,21 @@ class BatchSolver:
                 # padded tail repeats the full sum so any (unused)
                 # out-of-range index still reads a valid prefix
                 prefix[t + 1 :, :n] = cum[-1].astype(np.int32)
-            assign, assign_evict, used_out = self.solve_preempt_fn(
+            solverobs.record_transfer("h2d", prefix.nbytes + tier_limit.nbytes)
+            # factory-built preempt variants (mesh-sharded) ledger under
+            # their own name so per-mesh recompiles are attributable
+            kname = getattr(
+                self.solve_preempt_fn, "__name__", "solve_placement_preempt"
+            )
+            assign, assign_evict, used_out = solverobs.timed_call(
+                kname, (kname, np_, gp, tp), self.solve_preempt_fn,
                 cap, used, prefix, asks_arr, counts, feas, bias, ucap,
                 tier_limit,
             )
             return assign, assign_evict, used_out, g, n, time.perf_counter()
-        assign, used_out = self.solve_fn(
+        kname = getattr(self.solve_fn, "__name__", "solve_placement")
+        assign, used_out = solverobs.timed_call(
+            kname, (kname, np_, gp), self.solve_fn,
             cap, used, asks_arr, counts, feas, bias, ucap
         )
         return assign, None, used_out, g, n, time.perf_counter()
@@ -1199,7 +1259,15 @@ class BatchSolver:
         )
         # dense path: blocking transfer includes the device wait, so the
         # two land as one combined stage span
-        trace.stage("device.readback", now_ns() - t_dev0)
+        rb_ns = now_ns() - t_dev0
+        trace.stage("device.readback", rb_ns)
+        solverobs.record_transfer(
+            "d2h",
+            result[0].nbytes
+            + (result[1].nbytes if result[1] is not None else 0),
+            dur_ns=rb_ns, span=True,
+        )
+        solverobs.sample_device_memory()
         return result
 
     def _inject_rtt(self, t_disp: float) -> None:

@@ -59,6 +59,7 @@ __all__ = [
     "set_enabled",
     "span",
     "stage",
+    "stage_attrs",
     "start_trace",
     "use",
 ]
@@ -290,10 +291,14 @@ class TraceContext:
         self.spans.append(s)
         return s
 
-    def add_stage(self, name: str, dur_ns: int) -> Span:
+    def add_stage(
+        self, name: str, dur_ns: int, attrs: Optional[dict] = None
+    ) -> Span:
         """A stage measured as a duration ending now."""
         end = now_ns()
-        return self.add_span(name, end - max(0, int(dur_ns)), end)
+        return self.add_span(
+            name, end - max(0, int(dur_ns)), end, attrs=attrs
+        )
 
     def set_attr(self, key: str, value) -> None:
         self.attrs[key] = value
@@ -557,6 +562,18 @@ def stage(name: str, dur_ns: int) -> None:
     ctx = getattr(_tls, "ctx", None)
     if ctx is not None:
         ctx.add_stage(name, dur_ns)
+
+
+def stage_attrs(name: str, dur_ns: int, **attrs) -> None:
+    """:func:`stage` with span attributes — the solver-observability
+    spans (solver.compile carries the kernel + shape signature,
+    solver.transfer the direction + byte count). Same no-op discipline:
+    one flag test + one getattr when tracing is off."""
+    if not _enabled:
+        return
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.add_stage(name, dur_ns, attrs=attrs)
 
 
 # -- wire helpers for the RPC envelope -----------------------------------

@@ -766,6 +766,25 @@ REFERENCE_COMMAND_FLAGS = {
     # operator top is this repo's own surface (no reference analog):
     # registered here so its flag set is droppable only deliberately
     "operator top": {"flags": {"-interval", "-n", "-once"}, "args": []},
+    # Round 10 (solver observability PR): extended 21 -> 30, covering
+    # operator debug, the operator solver subcommands, the trace
+    # viewer, and the event family.
+    "operator debug": {"flags": {"-output"}, "args": []},
+    "operator trace": {
+        "flags": {"-summary", "-n", "-top", "-name", "-eval-id", "-job-id"},
+        "args": ["trace_id"],
+    },
+    "operator solver status": {"flags": {"-json"}, "args": []},
+    "operator solver top": {
+        "flags": {"-interval", "-n", "-once"}, "args": [],
+    },
+    "event stream": {
+        "flags": {"-topic", "-index", "-namespace"}, "args": [],
+    },
+    "eval list": {"flags": set(), "args": []},
+    "eval delete": {"flags": set(), "args": ["eval_id"]},
+    "deployment promote": {"flags": {"-group"}, "args": ["deployment_id"]},
+    "deployment pause": {"flags": {"-resume"}, "args": ["deployment_id"]},
 }
 
 # top-level alias -> canonical command whose flag surface it must match
@@ -782,6 +801,7 @@ ALIAS_OF = {
     "eval-status": "eval status",
     "node-status": "node status",
     "node-drain": "node drain",
+    "debug": "operator debug",
 }
 
 
@@ -865,10 +885,10 @@ def test_cli_breadth_vs_reference_command_list():
 
 
 def test_high_traffic_command_flag_sets():
-    """The 20 highest-traffic commands expose exactly the flag surface
+    """The 30 highest-traffic commands expose exactly the flag surface
     the embedded reference registry records — catches both a dropped
     flag and an unreviewed addition (which must be registered here)."""
-    assert len(REFERENCE_COMMAND_FLAGS) >= 20
+    assert len(REFERENCE_COMMAND_FLAGS) >= 30
     for cmd, want in REFERENCE_COMMAND_FLAGS.items():
         flags, args = _command_surface(cmd)
         assert flags == want["flags"], (

@@ -1,0 +1,536 @@
+"""Solver device observability tests (nomad_tpu/solverobs.py):
+compile-ledger units, the /v1/solver/status + ACL/debug-gating
+surface, the operator-debug bundle capture, and the round-10 e2e
+acceptance gate — a 12-eval c2m-style batch through the real
+TPUBatchWorker with zero steady-state recompiles, the new
+nomad.solver.* metrics on both /v1/metrics encodings, the `operator
+solver status` rendering, and the instrumented-vs-uninstrumented
+throughput comparator (clean-subprocess, the established
+overhead-gate pattern)."""
+
+import json
+import os
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from nomad_tpu import metrics, mock, solverobs
+from nomad_tpu.metrics import Registry
+from nomad_tpu.solverobs import MAX_SIGNATURES, SolverObservatory
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Compile-ledger units
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_new_shape_is_one_compile_event():
+    obs = SolverObservatory()
+    assert obs.record_call("k", ("k", 256, 8), 1_000_000) is True
+    snap = obs.snapshot(sample=False)
+    k = snap["ledger"]["kernels"]["k"]
+    assert k["compiles"] == 1 and k["cache_hits"] == 0
+    assert k["steady_recompiles"] == 0
+    assert k["first_compile_ms"] == 1.0
+
+
+def test_ledger_repeat_shape_is_cache_hit():
+    obs = SolverObservatory()
+    obs.record_call("k", ("k", 256, 8), 1_000_000)
+    assert obs.record_call("k", ("k", 256, 8), 5_000) is False
+    assert obs.record_call("k", ("k", 256, 8), 5_000) is False
+    snap = obs.snapshot(sample=False)
+    k = snap["ledger"]["kernels"]["k"]
+    assert k["compiles"] == 1 and k["cache_hits"] == 2
+    # a second bucket is a compile again — and a STEADY-STATE recompile
+    assert obs.record_call("k", ("k", 512, 8), 2_000_000) is True
+    snap = obs.snapshot(sample=False)
+    k = snap["ledger"]["kernels"]["k"]
+    assert k["compiles"] == 2 and k["steady_recompiles"] == 1
+    assert k["steady_compile_ms"] == 2.0
+    assert obs.compiles() == 2 and obs.steady_recompiles() == 1
+
+
+def test_ledger_bounded():
+    """The per-kernel signature set is a FIFO bound: a shape storm
+    evicts oldest and re-counts an evicted signature as a compile (the
+    pessimistic direction a regression guard wants)."""
+    obs = SolverObservatory()
+    for i in range(MAX_SIGNATURES + 50):
+        obs.record_call("k", ("k", i), 1000)
+    snap = obs.snapshot(sample=False)
+    k = snap["ledger"]["kernels"]["k"]
+    assert k["signatures"] <= MAX_SIGNATURES
+    assert k["signatures_evicted"] == 50
+    assert k["compiles"] == MAX_SIGNATURES + 50
+    # signature 0 was evicted: seeing it again is a compile event
+    assert obs.record_call("k", ("k", 0), 1000) is True
+
+
+def test_ledger_disabled_records_nothing():
+    obs = SolverObservatory()
+    old = solverobs._install(obs)
+    try:
+        solverobs.set_enabled(False)
+        assert solverobs.record_call("k", ("k", 1), 1000) is False
+        solverobs.record_batch(10, 2, 256, 8)
+        solverobs.record_transfer("h2d", 4096)
+        snap = solverobs.snapshot(sample=False)
+        assert snap["ledger"]["kernels"] == {}
+        assert snap["occupancy"]["batches"] == 0
+        assert snap["transfers"]["h2d_bytes"] == 0
+    finally:
+        solverobs.set_enabled(True)
+        solverobs._install(old)
+
+
+def test_occupancy_and_transfer_accounting():
+    obs = SolverObservatory()
+    obs.record_batch(20, 12, 256, 16)
+    obs.record_batch(20, 4, 256, 16)
+    obs.record_transfer("h2d", 1000)
+    obs.record_transfer("d2h", 300)
+    obs.record_transfer("d2h", 0)  # no-op
+    snap = obs.snapshot(sample=False)
+    occ = snap["occupancy"]
+    assert occ["batches"] == 2
+    assert occ["last_batch"]["occupancy"] == round(80 / 4096, 4)
+    assert occ["last_batch"]["pad_waste"] == round(1 - 80 / 4096, 4)
+    assert snap["transfers"] == {"h2d_bytes": 1000, "d2h_bytes": 300}
+
+
+def test_compile_and_transfer_spans_on_live_trace():
+    """solver.compile / solver.transfer land as spans (with kernel /
+    direction+bytes attrs) on whatever trace is current — the solver's
+    stage timers' established path (trace.stage_attrs)."""
+    from nomad_tpu import trace
+
+    obs = SolverObservatory()
+    old = solverobs._install(obs)
+    was_enabled = trace.enabled()
+    trace.set_enabled(True)
+    try:
+        ctx = trace.start_trace("test.solve")
+        with trace.use(ctx):
+            solverobs.record_call("kern", ("kern", 256), 2_000_000)
+            solverobs.record_call("kern", ("kern", 256), 1_000)  # hit: no span
+            solverobs.record_transfer("d2h", 4096, dur_ns=500_000, span=True)
+        ctx.finish(record=False)
+        spans = {s.name: s for s in ctx.spans}
+        assert "solver.compile" in spans
+        assert spans["solver.compile"].attrs["kernel"] == "kern"
+        assert "solver.transfer" in spans
+        assert spans["solver.transfer"].attrs == {
+            "direction": "d2h", "bytes": 4096,
+        }
+        # exactly one compile span: the cache hit emitted nothing
+        assert sum(
+            1 for s in ctx.spans if s.name == "solver.compile"
+        ) == 1
+    finally:
+        trace.set_enabled(was_enabled)
+        solverobs._install(old)
+
+
+# ---------------------------------------------------------------------------
+# /v1/solver/status surface, ACL + debug gating, debug bundle
+# ---------------------------------------------------------------------------
+
+
+def test_solver_status_route_and_debug_bundle(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.agent.debug import debug_bundle
+    from nomad_tpu.api.client import NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = True
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        snap = api.agent.solver_status()
+        for key in (
+            "ledger", "occupancy", "transfers", "device_memory",
+            "live_array_bytes", "jit_cache_sizes", "worker",
+        ):
+            assert key in snap, key
+        assert snap["worker"] is None  # no TPU batch worker on this agent
+        # the operator debug bundle captures the same snapshot
+        bundle = debug_bundle(api)
+        assert "solver" in bundle
+        assert "ledger" in bundle["solver"], bundle["solver"]
+        assert "traces" in bundle
+    finally:
+        agent.shutdown()
+
+
+@pytest.fixture(scope="module")
+def acl_agent(tmp_path_factory):
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    cfg = AgentConfig.dev()
+    cfg.acl_enabled = True
+    cfg.data_dir = str(tmp_path_factory.mktemp("solver-acl"))
+    a = Agent(cfg)
+    a.start()
+    assert wait_until(lambda: a.server.is_leader(), 15)
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def root(acl_agent):
+    from nomad_tpu.api.client import NomadClient
+
+    host, port = acl_agent.http_addr
+    api = NomadClient(f"http://{host}:{port}")
+    token = api.acl.bootstrap()
+    return NomadClient(f"http://{host}:{port}", token=token.secret_id)
+
+
+class TestDebugSurfaceACL:
+    """/v1/solver/status sits behind agent:read (like /v1/metrics);
+    /v1/agent/pprof/* behind agent:write AND enable_debug — the
+    round-10 coverage for the whole debug/profiling surface."""
+
+    def _token(self, root, name, rules):
+        root.acl.policy_apply(name, rules)
+        return root.acl.token_create(name=name, policies=[name])
+
+    def test_solver_status_needs_agent_read(self, acl_agent, root):
+        from nomad_tpu.api.client import APIError, NomadClient
+
+        host, port = acl_agent.http_addr
+        anon = NomadClient(f"http://{host}:{port}")
+        with pytest.raises(APIError) as e:
+            anon.agent.solver_status()
+        assert e.value.status in (401, 403)
+        # a token with NO agent policy is denied
+        tok = self._token(
+            root, "ns-only", 'namespace "default" { policy = "read" }'
+        )
+        nsr = NomadClient(f"http://{host}:{port}", token=tok.secret_id)
+        with pytest.raises(APIError) as e:
+            nsr.agent.solver_status()
+        assert e.value.status == 403
+        # agent:read suffices (read-only surface, unlike pprof)
+        tok = self._token(root, "agent-r", 'agent { policy = "read" }')
+        reader = NomadClient(f"http://{host}:{port}", token=tok.secret_id)
+        assert "ledger" in reader.agent.solver_status()
+        # same gate as /v1/metrics
+        assert "counters" in reader.agent.metrics()
+
+    def test_pprof_needs_agent_write(self, acl_agent, root):
+        from nomad_tpu.api.client import APIError, NomadClient
+
+        host, port = acl_agent.http_addr
+        tok = self._token(root, "agent-r2", 'agent { policy = "read" }')
+        reader = NomadClient(f"http://{host}:{port}", token=tok.secret_id)
+        with pytest.raises(APIError) as e:
+            reader.get("/v1/agent/pprof/goroutine")
+        assert e.value.status == 403
+        wtok = self._token(root, "agent-w", 'agent { policy = "write" }')
+        writer = NomadClient(f"http://{host}:{port}", token=wtok.secret_id)
+        # dev-mode agent has enable_debug on: agent:write passes
+        assert "profile" in writer.get("/v1/agent/pprof/goroutine")
+        # management too
+        assert "rss_bytes" in root.get("/v1/agent/pprof/heap")
+
+
+def test_pprof_enable_gating_but_solver_status_always_on(tmp_path):
+    """enable_debug=False 404s pprof (reference agent http.go) but does
+    NOT gate /v1/solver/status — observability is not a debug mode."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import APIError, NomadClient
+
+    cfg = AgentConfig()
+    cfg.server_enabled = True
+    cfg.client_enabled = False
+    cfg.dev_mode = False
+    cfg.enable_debug = False
+    cfg.http_port = 0
+    cfg.data_dir = str(tmp_path)
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        with pytest.raises(APIError) as e:
+            api.get("/v1/agent/pprof/goroutine")
+        assert e.value.status == 404
+        with pytest.raises(APIError) as e:
+            api.get("/v1/agent/pprof/profile")
+        assert e.value.status == 404
+        with pytest.raises(APIError) as e:
+            api.get("/v1/agent/pprof/heap")
+        assert e.value.status == 404
+        assert "ledger" in api.agent.solver_status()
+    finally:
+        agent.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# E2E acceptance: 12-eval c2m-style batch through the real TPU worker
+# ---------------------------------------------------------------------------
+
+
+def _c2m_jobs(prefix: str, n_jobs: int = 12):
+    from nomad_tpu.structs import Constraint, Spread
+
+    jobs = []
+    for j in range(n_jobs):
+        job = mock.job(id=f"{prefix}-{j}")
+        job.datacenters = ["dc1", "dc2"]
+        tg = job.task_groups[0]
+        tg.count = 10
+        tg.tasks[0].resources.cpu = 100
+        tg.tasks[0].resources.memory_mb = 64
+        tg.tasks[0].resources.networks = []
+        job.constraints.append(
+            Constraint("${attr.kernel.name}", "linux", "=")
+        )
+        job.spreads = [Spread(attribute="${node.datacenter}", weight=50)]
+        jobs.append(job)
+    return jobs
+
+
+def test_e2e_solver_observability_acceptance(tmp_path, capsys):
+    """Round-10 acceptance: two 12-eval c2m-style waves through the
+    real TPUBatchWorker — the first is the warmup (compiles land
+    there), the second must trigger ZERO recompiles; the
+    nomad.solver.occupancy and transfer-bytes metrics appear in both
+    /v1/metrics encodings; the same snapshot renders via `operator
+    solver status` and the solver row via `operator top`."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import NomadClient
+    from nomad_tpu.cli.main import cmd_operator_solver_status, cmd_operator_top
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.structs.node_class import compute_node_class
+
+    old_reg = metrics._install_registry(Registry())
+    old_obs = solverobs._install(SolverObservatory())
+    cfg = AgentConfig(
+        server_enabled=True,
+        dev_mode=True,
+        use_tpu_batch_worker=True,
+        data_dir=str(tmp_path / "agent"),
+    )
+    agent = Agent(cfg)
+    try:
+        agent.start()
+        srv = agent.server.server
+        # dense-path sized batch: 12 jobs x 10 allocs = 120 requests
+        assert SchedulerConfig().small_batch_threshold < 120
+        for i in range(16):
+            n = mock.node()
+            n.datacenter = ["dc1", "dc2"][i % 2]
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+            n.computed_class = compute_node_class(n)
+            srv.node_register(n)
+
+        def drive_wave(prefix):
+            jobs = _c2m_jobs(prefix)
+            for job in jobs:
+                # register WITHOUT the auto-eval so the whole wave
+                # enqueues atomically below — one batch
+                srv.raft_apply("job_register", (job, None))
+            evals = [mock.eval_for_job(job) for job in jobs]
+            srv.eval_broker.enqueue_all(evals)
+            assert wait_until(
+                lambda: all(
+                    len(srv.state.allocs_by_job("default", j.id)) >= 10
+                    for j in jobs
+                ),
+                60,
+            ), f"wave {prefix} never placed"
+
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        drive_wave("warm")  # warmup: bucket compiles happen here
+        warm = api.agent.solver_status()
+        assert warm["ledger"]["compiles"] >= 1, warm["ledger"]
+        drive_wave("steady")  # steady state: identical padded shapes
+        snap = api.agent.solver_status()
+        # THE invariant this PR makes continuously measurable: the
+        # steady-state wave compiled nothing (shape-bucketing contract)
+        assert (
+            snap["ledger"]["compiles"] == warm["ledger"]["compiles"]
+        ), (warm["ledger"], snap["ledger"])
+        assert snap["ledger"]["cache_hits"] > warm["ledger"]["cache_hits"]
+        occ = snap["occupancy"]
+        assert occ["batches"] >= 2
+        assert 0 < occ["last_batch"]["occupancy"] <= 1
+        assert occ["last_asks"]["requests"] >= 120
+        assert snap["transfers"]["h2d_bytes"] > 0
+        assert snap["transfers"]["d2h_bytes"] > 0
+        # CPU backend: memory_stats is an explicit null, never faked
+        assert snap["device_memory"] is None
+        assert snap["live_array_highwater_bytes"] > 0
+        assert snap["worker"]["batch_size"] == 64
+        assert snap["jit_cache_sizes"]["solve_placement_compact"] >= 1
+
+        # metrics surface: JSON ...
+        msnap = api.agent.metrics()
+        occ_s = msnap["samples"]["nomad.solver.occupancy"]
+        assert occ_s["count"] >= 2 and 0 < occ_s["p50"] <= 1
+        assert msnap["counters"]["nomad.solver.transfer_bytes.h2d"] > 0
+        assert msnap["counters"]["nomad.solver.transfer_bytes.d2h"] > 0
+        h2d = msnap["samples"]["nomad.solver.h2d_mb"]
+        assert h2d["count"] >= 2
+        # MB units sit inside the shared exponential bounds, so the
+        # percentiles are real (a byte-unit value would overflow every
+        # finite bucket)
+        assert 0 < h2d["p50"] <= h2d["max"] < 1677
+        assert msnap["counters"]["nomad.solver.compiles"] >= 1
+        # ... and prometheus exposition
+        text = api.agent.metrics_prometheus()
+        assert "# TYPE nomad_solver_occupancy histogram" in text
+        assert 'nomad_solver_occupancy_bucket{le="+Inf"}' in text
+        assert "nomad_solver_transfer_bytes_h2d_total" in text
+        assert "nomad_solver_transfer_bytes_d2h_total" in text
+
+        # the same snapshot renders via `operator solver status`
+        args = SimpleNamespace(
+            address=f"http://127.0.0.1:{agent.http_addr[1]}",
+            token=None, region=None, as_json=False,
+        )
+        capsys.readouterr()
+        assert cmd_operator_solver_status(args) == 0
+        out = capsys.readouterr().out
+        assert "Compile ledger" in out
+        assert "solve_placement_compact" in out
+        assert "Occupancy" in out and "Transfers" in out
+        assert "0 steady-state recompiles" in out
+        # ... and `operator top` gained the solver panel row
+        targs = SimpleNamespace(
+            address=f"http://127.0.0.1:{agent.http_addr[1]}",
+            token=None, region=None, interval=2.0, n=0, once=True,
+        )
+        assert cmd_operator_top(targs) == 0
+        out = capsys.readouterr().out
+        assert "Solver" in out and "steady recompiles 0" in out
+    finally:
+        agent.shutdown()
+        metrics._install_registry(old_reg)
+        solverobs._install(old_obs)
+
+
+# ---------------------------------------------------------------------------
+# Overhead gate: instrumented vs uninstrumented throughput (bench smoke)
+# ---------------------------------------------------------------------------
+
+
+OBS_OVERHEAD_SCRIPT = r"""
+import json, random, sys, time
+sys.path.insert(0, %r)
+
+from bench import build_cluster
+from nomad_tpu import mock, solverobs
+from nomad_tpu.scheduler.tpu import solve_eval_batch
+
+# Two workloads, each built AND measured in isolation (a second live
+# cluster's heap during the other's bursts skews the tiny smoke
+# timings): the bench smoke config (host fast path — the acceptance
+# criterion's comparator), and a dense-path batch past
+# small_batch_threshold so the device-side instrumentation
+# (timed_call / record_batch / record_transfer / memory census) is
+# actually on the measured path.
+def once(instrumented: bool, snap, h, evals, reps: int) -> float:
+    solverobs._install(solverobs.SolverObservatory())
+    solverobs.set_enabled(instrumented)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            solve_eval_batch(snap, h, evals)
+        return time.perf_counter() - t0
+    finally:
+        solverobs.set_enabled(True)
+
+
+def measure(n_nodes, n_jobs, count, reps):
+    import gc
+    gc.collect()
+    h, jobs = build_cluster(n_nodes, n_jobs, count, False)
+    snap = h.snapshot()
+    evals = [mock.eval_for_job(j) for j in jobs]
+    solve_eval_batch(snap, h, evals)  # warm before either measured side
+    # randomized interleave, MINIMUM per side (the established
+    # overhead-gate recipe, tests/test_trace.py / test_metrics.py):
+    # load spikes can only RAISE a side's samples, never lower its min.
+    # 32 pairs: single-burst spread on this path is ~2x, so both mins
+    # need that many draws to converge to the contention-free floor.
+    order = [False, True] * 32
+    random.shuffle(order)
+    best = {False: float("inf"), True: float("inf")}
+    for on in order:
+        best[on] = min(best[on], once(on, snap, h, evals, reps))
+    return {
+        "ratio": best[False] / best[True],
+        "off_ms": best[False] * 1e3,
+        "on_ms": best[True] * 1e3,
+    }
+
+
+out = {
+    "smoke": measure(10, 1, 10, reps=10),
+    # 60 reqs > threshold 48 -> device kernel path; 10 reps per burst:
+    # a single dense solve's run-to-run spread is ~2x, so short bursts
+    # leave the per-side minimum noise-floored instead of converged
+    "dense": measure(20, 2, 30, reps=10),
+}
+print(json.dumps(out))
+"""
+
+
+def test_observability_throughput_vs_uninstrumented_smoke():
+    """Acceptance gate: scheduling throughput with the solver
+    observatory ON stays >= 0.95x the disabled path — on the bench
+    smoke config (the acceptance criterion) AND on a dense-path batch
+    that actually dispatches the device kernel (so the ledger/transfer/
+    memory instrumentation is on the measured path). Clean subprocess:
+    the suite's daemon threads make in-process timing comparisons
+    noise (same rationale as the tracing/histogram gates)."""
+    import subprocess
+    import sys
+
+    # Box-load noise is ONE-SIDED (the measured overhead is ~1% — a
+    # spike can only fake a failure), so each workload passes on its
+    # BEST attempt independently: requiring both to clear in the same
+    # attempt would square the flake rate for no extra rigor.
+    best: dict = {}
+    attempts = []
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, "-c", OBS_OVERHEAD_SCRIPT % REPO_ROOT],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        attempts.append({k: round(v["ratio"], 3) for k, v in out.items()})
+        for k, v in out.items():
+            best[k] = max(best.get(k, 0.0), v["ratio"])
+        if all(v >= 0.95 for v in best.values()):
+            return
+    pytest.fail(
+        f"instrumented throughput < 0.95x uninstrumented across all "
+        f"attempts (best per workload {best}): {attempts}"
+    )
