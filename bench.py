@@ -923,6 +923,76 @@ def run_pipeline_config():
     }
 
 
+def run_soak_config():
+    """Sustained-traffic soak: closed-loop mixed traffic (job
+    register/scale/stop, dispatch, node churn) against a live 3-server
+    durable cluster under a SEEDED FaultPlane schedule (rpc drops, lost
+    responses, slow fsync, device faults, a partition/heal cycle), with
+    the overload controls engaged — bounded broker admission,
+    per-namespace RPC rate limits, plan-queue backpressure
+    (nomad_tpu/testing/loadgen.py run_soak).
+
+    Unlike every other config, this one runs WITH faults injected by
+    design: the claim under test is graceful degradation, and its gates
+    (invariants hold, p99 bounded, admission engaged) are only
+    meaningful under fault load. The chaos tripwire still applies to
+    the PERF configs — the soak installs its plane for its own run and
+    uninstalls it before returning.
+
+    Env knobs: BENCH_SOAK_S (duration, default 30; the slow-tier run
+    uses 600), BENCH_SOAK_RATE (target offered eval arrival rate/s —
+    size it at >= 10x the capture-of-record c2m steady rate for the
+    acceptance run), BENCH_SOAK_SEED, BENCH_SOAK_P99_S (e2e p99 bound),
+    BENCH_SOAK_DEPTH (broker admission depth)."""
+    import shutil
+    import tempfile
+
+    from nomad_tpu.testing.loadgen import run_soak
+
+    duration = float(os.environ.get("BENCH_SOAK_S", "30"))
+    rate = float(os.environ.get("BENCH_SOAK_RATE", "120"))
+    seed = int(os.environ.get("BENCH_SOAK_SEED", "42"))
+    p99_bound = float(os.environ.get("BENCH_SOAK_P99_S", "15"))
+    depth = int(os.environ.get("BENCH_SOAK_DEPTH", "96"))
+    log(
+        f"[soak] {duration:.0f}s at {rate:.0f} evals/s offered, seed "
+        f"{seed}, admission depth {depth}, faults ON"
+    )
+    root = tempfile.mkdtemp(prefix="nomad-tpu-soak-")
+    try:
+        report = run_soak(
+            root,
+            duration_s=duration,
+            rate=rate,
+            seed=seed,
+            admission_depth=depth,
+            namespace_cap=max(8, depth // 2),
+            blocked_cap=depth,
+            rpc_rate=float(os.environ.get("BENCH_SOAK_RPC_RATE", "40")),
+            rpc_burst=float(os.environ.get("BENCH_SOAK_RPC_BURST", "80")),
+            use_tpu_worker=True,
+            partition_cycle=True,
+            p99_bound_s=p99_bound,
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    c = report["counters"]
+    log(
+        f"[soak] offered {report['offered']} ({report['offered_rate_per_s']}"
+        f"/s), accepted {report['accepted']}, client-throttled "
+        f"{report['throttled_client_visible']}; shed "
+        f"{c['nomad.broker.shed']}, rejected {c['nomad.broker.rejected']}, "
+        f"throttled http {c['nomad.http.throttled']} rpc "
+        f"{c['nomad.rpc.throttled']}, backpressure "
+        f"{c['nomad.worker.backpressure_throttled']}; e2e "
+        f"{report.get('e2e_seconds')}; converged {report['converged']}, "
+        f"invariants {report['invariants_ok']}"
+        + (f" ({report['invariant_error']})" if report["invariant_error"] else "")
+        + f", faults fired {report['fired_faults']}"
+    )
+    return report
+
+
 SERVICE_CONFIGS = {
     # name: (nodes, jobs, count/job, constrained, host_sample >= 20
     #        except smoke, which has a single job by definition)
@@ -995,7 +1065,8 @@ def main():
         _trace.configure(max_traces=256, enabled_=True)
     sel = os.environ.get("BENCH_CONFIG", "all")
     names = (
-        ["smoke", "c1k", "c2m", "preempt", "drain", "plan_apply", "pipeline"]
+        ["smoke", "c1k", "c2m", "preempt", "drain", "plan_apply",
+         "pipeline", "soak"]
         if sel == "all"
         else [sel]
     )
@@ -1032,6 +1103,8 @@ def main():
             results[name] = run_plan_apply_config()
         elif name == "pipeline":
             results[name] = run_pipeline_config()
+        elif name == "soak":
+            results[name] = run_soak_config()
         else:
             raise SystemExit(f"unknown BENCH_CONFIG {name}")
         results[name]["latency_percentiles"] = latency_percentiles()
@@ -1066,6 +1139,18 @@ def main():
         if cname in ("smoke", "c2m") and "recompiles_after_warmup" in so:
             gates[f"{cname}_recompile_bound"] = (
                 so["recompiles_after_warmup"] == 0
+            )
+        # soak gates: graceful degradation under the seeded fault
+        # schedule — safety invariants hold, e2e p99 stays bounded,
+        # and admission control demonstrably engaged (nonzero
+        # shed/reject/throttle counts)
+        if "invariants_ok" in r:
+            gates[f"{cname}_invariants"] = bool(
+                r["invariants_ok"] and r["converged"]
+            )
+            gates[f"{cname}_p99_bounded"] = bool(r["p99_bounded"])
+            gates[f"{cname}_admission_engaged"] = bool(
+                r["admission_engaged"]
             )
     if chaos_knobs:
         # refuse to gate: an injected-fault run can never certify

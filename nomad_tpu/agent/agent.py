@@ -195,6 +195,26 @@ class AgentConfig:
     # served at /v1/traces; reloadable via SIGHUP (Agent.reload).
     trace_enabled: bool = False
     trace_buffer: int = 256
+    # broker stanza (overload protection; SIGHUP-reloadable): the eval
+    # broker's delivery/nack knobs were constructor defaults only —
+    # first-class config now — plus the admission bounds. broker {
+    # delivery_limit nack_delay admission_depth namespace_cap
+    # blocked_cap }. admission_depth 0 = unbounded (seed behavior);
+    # namespace_cap 0 = no per-namespace fairness bound; blocked_cap 0
+    # = unbounded blocked-evals tracker.
+    broker_delivery_limit: int = 3
+    broker_nack_delay_s: float = 5.0
+    broker_admission_depth: int = 0
+    broker_namespace_cap: int = 0
+    blocked_evals_cap: int = 0
+    # limits stanza (per-namespace token buckets on the front doors;
+    # SIGHUP-reloadable): limits { http_rate http_burst rpc_rate
+    # rpc_burst } in requests/second per namespace; 0 disables. Burst
+    # defaults to the rate when unset.
+    http_rate_limit: float = 0.0
+    http_rate_burst: float = 0.0
+    rpc_rate_limit: float = 0.0
+    rpc_rate_burst: float = 0.0
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -333,6 +353,28 @@ class Agent:
                 tls_key=(
                     config.tls_key_file if config.tls_http else ""
                 ),
+            )
+        self._apply_overload_config(config)
+
+    def _apply_overload_config(self, cfg: AgentConfig) -> None:
+        """Push the broker/limits stanzas onto the live subsystems —
+        shared by construction and SIGHUP reload."""
+        if self.server is not None:
+            self.server.server.eval_broker.configure(
+                nack_delay_s=cfg.broker_nack_delay_s,
+                delivery_limit=cfg.broker_delivery_limit,
+                admission_depth=cfg.broker_admission_depth,
+                namespace_cap=cfg.broker_namespace_cap,
+            )
+            self.server.server.blocked_evals.configure(
+                cap=cfg.blocked_evals_cap
+            )
+            self.server.set_rate_limits(
+                cfg.rpc_rate_limit, cfg.rpc_rate_burst
+            )
+        if self.http is not None:
+            self.http.set_rate_limits(
+                cfg.http_rate_limit, cfg.http_rate_burst
             )
 
     def start(self) -> None:
@@ -477,6 +519,35 @@ class Agent:
             old.trace_enabled = new_config.trace_enabled
             old.trace_buffer = new_config.trace_buffer
             changed.append("trace")
+        broker_keys = (
+            "broker_delivery_limit",
+            "broker_nack_delay_s",
+            "broker_admission_depth",
+            "broker_namespace_cap",
+            "blocked_evals_cap",
+        )
+        limit_keys = (
+            "http_rate_limit",
+            "http_rate_burst",
+            "rpc_rate_limit",
+            "rpc_rate_burst",
+        )
+        broker_changed = any(
+            getattr(new_config, k) != getattr(old, k) for k in broker_keys
+        )
+        limits_changed = any(
+            getattr(new_config, k) != getattr(old, k) for k in limit_keys
+        )
+        if broker_changed or limits_changed:
+            # one apply covers both stanzas; in-flight deliveries keep
+            # their attempt counts and buckets keep their fill
+            for k in broker_keys + limit_keys:
+                setattr(old, k, getattr(new_config, k))
+            self._apply_overload_config(old)
+            if broker_changed:
+                changed.append("broker")
+            if limits_changed:
+                changed.append("limits")
         if (
             self.server is not None
             and new_config.vault_allowed_policies != old.vault_allowed_policies

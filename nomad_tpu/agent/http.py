@@ -53,9 +53,14 @@ class RawResponse:
 
 
 class HTTPError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None) -> None:
         self.status = status
         self.message = message
+        # 429/503 backoff hint: surfaced as the Retry-After header
+        # (integer ceil per RFC 9110) AND a float `retry_after_s` field
+        # in the JSON error body (sub-second precision for the SDK).
+        self.retry_after = retry_after
         super().__init__(message)
 
 
@@ -84,6 +89,11 @@ class HTTPAgentServer:
         self.client = client
         self.acl_resolver = acl_resolver
         self.enable_debug = enable_debug
+        # Per-namespace token buckets on the HTTP front door (disabled
+        # until limits{} config sets a rate; SIGHUP-reconfigurable).
+        from ..ratelimit import KeyedRateLimiter
+
+        self.limiter = KeyedRateLimiter()
         self._relay_lock = threading.Lock()
         self._relay_active = 0
         # Cap concurrent client-relay sessions: each one ties up an HTTP
@@ -153,6 +163,70 @@ class HTTPAgentServer:
         if self._thread:
             self._thread.join(timeout=5)
 
+    def set_rate_limits(self, http_rate: float, http_burst: float = 0.0) -> None:
+        """Configure (or SIGHUP-reconfigure) the per-namespace HTTP
+        front-door token buckets. rate <= 0 disables."""
+        self.limiter.configure(http_rate, http_burst)
+
+    # Routes exempt from the front-door rate limit: the observability
+    # and control surfaces an operator needs DURING overload (reading
+    # shed/throttle metrics, traces, health, reload/debug) — throttling
+    # the dashboards that diagnose a throttling event would blind the
+    # operator exactly when they need to see.
+    _THROTTLE_EXEMPT = (
+        "/v1/agent",
+        "/v1/metrics",
+        "/v1/status",
+        "/v1/operator",
+        "/v1/traces",
+        "/v1/solver",
+        "/v1/event/stream",
+        "/v1/acl",
+    )
+
+    @staticmethod
+    def _throttle_ns(query: dict, raw_body: bytes) -> str:
+        """The namespace to charge: ?namespace= when present, else the
+        payload's object namespace (job register/plan and volume
+        register carry it in the body, not the query — charging
+        'default' for those would let one tenant's register storm
+        starve everyone else's default bucket). The JSON parse runs
+        only for body-bearing requests with no query namespace."""
+        ns = query.get("namespace", [""])[0]
+        if ns:
+            return ns
+        if raw_body:
+            try:
+                body = json.loads(raw_body)
+                if isinstance(body, dict):
+                    for key in ("Job", "Volume"):
+                        obj = body.get(key)
+                        if isinstance(obj, dict) and obj.get("namespace"):
+                            return str(obj["namespace"])
+                    if body.get("Namespace"):
+                        return str(body["Namespace"])
+            except ValueError:
+                pass
+        return "default"
+
+    def _throttle_check(self, path: str, query: dict,
+                        raw_body: bytes = b"") -> None:
+        """Charge the request against its namespace's bucket; raises
+        HTTPError 429 with Retry-After when over."""
+        if not self.limiter.enabled or not path.startswith("/v1/"):
+            return
+        if path.startswith(self._THROTTLE_EXEMPT):
+            return
+        ns = self._throttle_ns(query, raw_body)
+        wait = self.limiter.check(ns)
+        if wait > 0:
+            metrics.incr("nomad.http.throttled")
+            raise HTTPError(
+                429,
+                f"rate limit exceeded for namespace {ns!r}",
+                retry_after=wait,
+            )
+
     def reload_tls(self, cert_file: str, key_file: str) -> bool:
         """Rotate the HTTPS certificate without dropping the listener:
         loading new material into the live SSLContext makes every
@@ -198,6 +272,31 @@ class HTTPAgentServer:
             for o in objs
             if acl.allow_namespace_op(getattr(o, "namespace", "default"), cap)
         ]
+
+    def _map_throttle_error(self, e: Exception) -> Optional[HTTPError]:
+        """Queue-full / rate-limited rejections -> 429 with Retry-After,
+        whether raised locally (RateLimitError / BrokerSaturatedError
+        from an in-process dispatch) or arriving as a leader-forwarded
+        RPCError string. Centralized in the handler's generic exception
+        path so EVERY route maps correctly — these used to surface as
+        500s, teaching clients to back off never."""
+        from ..ratelimit import (
+            RateLimitError,
+            is_throttle_text,
+            retry_after_from_text,
+        )
+
+        if isinstance(e, RateLimitError):
+            return HTTPError(429, str(e), retry_after=e.retry_after_s)
+        from ..rpc.client import RPCError
+
+        if isinstance(e, RPCError) and is_throttle_text(str(e)):
+            return HTTPError(
+                429,
+                str(e),
+                retry_after=retry_after_from_text(str(e)) or 1.0,
+            )
+        return None
 
     def _map_forward_error(self, e: Exception):
         """KeyError/ValueError raised on THIS server map directly; the
@@ -2061,6 +2160,11 @@ class HTTPAgentServer:
                     self.wfile.write(data)
                     return
                 try:
+                    # Front-door rate limit BEFORE token resolution and
+                    # routing: during overload, rejected requests must
+                    # cost as little as possible (observability routes
+                    # are exempt — see _THROTTLE_EXEMPT).
+                    outer._throttle_check(parsed.path, query, raw_body)
                     exec_m = re.match(
                         r"^/v1/client/allocation/(?P<id>[^/]+)/exec$",
                         parsed.path,
@@ -2138,7 +2242,12 @@ class HTTPAgentServer:
                         return
                     self._reply(404, {"error": f"no route {method} {parsed.path}"})
                 except HTTPError as e:
-                    self._reply(e.status, {"error": e.message})
+                    payload = {"error": e.message}
+                    if e.retry_after is not None:
+                        payload["retry_after_s"] = round(e.retry_after, 3)
+                    self._reply(
+                        e.status, payload, retry_after=e.retry_after
+                    )
                 except ConflictError as e:
                     # Expected operational rejections (e.g. re-running acl
                     # bootstrap): client error, not a 500.
@@ -2150,6 +2259,19 @@ class HTTPAgentServer:
                 except (BrokenPipeError, ConnectionResetError):
                     pass
                 except Exception as e:
+                    throttled = outer._map_throttle_error(e)
+                    if throttled is not None:
+                        payload = {"error": throttled.message}
+                        if throttled.retry_after is not None:
+                            payload["retry_after_s"] = round(
+                                throttled.retry_after, 3
+                            )
+                        self._reply(
+                            throttled.status,
+                            payload,
+                            retry_after=throttled.retry_after,
+                        )
+                        return
                     logger.exception("http handler failed")
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -2200,10 +2322,21 @@ class HTTPAgentServer:
                     return
                 self._reply(200, codec.to_wire(result), index)
 
-            def _reply(self, status: int, payload, index: Optional[int] = None):
+            def _reply(self, status: int, payload,
+                       index: Optional[int] = None,
+                       retry_after: Optional[float] = None):
                 data = json.dumps(payload, default=_json_default).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                if retry_after is not None:
+                    # RFC 9110 delay-seconds is integral; sub-second
+                    # precision rides in the JSON body (retry_after_s)
+                    import math as _math
+
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(_math.ceil(retry_after)))),
+                    )
                 # gzip negotiation (reference command/agent/http.go:248
                 # wraps every handler in gziphandler): list payloads at
                 # cluster scale compress ~10x; tiny replies skip the

@@ -21,8 +21,15 @@ from .. import codec
 
 
 class APIError(Exception):
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(self, status: int, message: str,
+                 retry_after: Optional[float] = None) -> None:
         self.status = status
+        # 429/503 backoff hint from the Retry-After header or the error
+        # body's retry_after_s field (sub-second precision wins).
+        # retry.py's call_with_retry honors the same attribute name as
+        # a backoff floor.
+        self.retry_after = retry_after
+        self.retry_after_s = retry_after
         super().__init__(f"HTTP {status}: {message}")
 
 
@@ -36,12 +43,20 @@ class NomadClient:
         timeout_s: float = 35.0,
         ca_cert: str = "",  # PEM bundle verifying an https:// server
         tls_skip_verify: bool = False,
+        retry_429: int = 0,  # max automatic retries of throttled requests
+        retry_429_max_wait_s: float = 30.0,
     ) -> None:
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
         self.region = region  # "" = the contacted server's own region
         self.timeout_s = timeout_s
+        # With retry_429 > 0, a 429 whose Retry-After (header or JSON
+        # retry_after_s) fits under retry_429_max_wait_s is slept out
+        # and retried, up to retry_429 times — the client half of the
+        # server's admission control (it TOLD us when to come back).
+        self.retry_429 = retry_429
+        self.retry_429_max_wait_s = retry_429_max_wait_s
         self._ssl_ctx = None
         if address.startswith("https://"):
             import ssl
@@ -96,18 +111,43 @@ class NomadClient:
         req.add_header("Content-Type", "application/json")
         if self.token:
             req.add_header("X-Nomad-Token", self.token)
-        try:
-            resp = urllib.request.urlopen(
-                req,
-                timeout=timeout_s or self.timeout_s,
-                context=self._ssl_ctx,
-            )
-        except urllib.error.HTTPError as e:
+        attempts_left = self.retry_429
+        while True:
             try:
-                msg = json.loads(e.read()).get("error", str(e))
-            except Exception:
-                msg = str(e)
-            raise APIError(e.code, msg) from None
+                resp = urllib.request.urlopen(
+                    req,
+                    timeout=timeout_s or self.timeout_s,
+                    context=self._ssl_ctx,
+                )
+                break
+            except urllib.error.HTTPError as e:
+                retry_after = None
+                hdr = e.headers.get("Retry-After") if e.headers else None
+                if hdr:
+                    try:
+                        retry_after = float(hdr)
+                    except ValueError:
+                        pass
+                try:
+                    body = json.loads(e.read())
+                    msg = body.get("error", str(e))
+                    # sub-second precision beats the integral header
+                    if body.get("retry_after_s") is not None:
+                        retry_after = float(body["retry_after_s"])
+                except Exception:
+                    msg = str(e)
+                if (
+                    e.code == 429
+                    and attempts_left > 0
+                    and retry_after is not None
+                    and retry_after <= self.retry_429_max_wait_s
+                ):
+                    attempts_left -= 1
+                    import time as _time
+
+                    _time.sleep(max(0.0, retry_after))
+                    continue
+                raise APIError(e.code, msg, retry_after=retry_after) from None
         if raw:
             return resp
         payload = json.loads(resp.read() or b"null")

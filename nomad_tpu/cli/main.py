@@ -275,6 +275,28 @@ def _load_agent_config(path: str):
         cfg.trace_enabled = bool(tea.get("trace_enabled", False))
         if "trace_buffer" in tea:
             cfg.trace_buffer = int(tea["trace_buffer"])
+    brb = body.block("broker")
+    if brb is not None:
+        from ..jobspec.hcl import parse_duration
+
+        bra = brb.body.attrs()
+        if "delivery_limit" in bra:
+            cfg.broker_delivery_limit = int(bra["delivery_limit"])
+        if "nack_delay" in bra:
+            cfg.broker_nack_delay_s = parse_duration(bra["nack_delay"])
+        if "admission_depth" in bra:
+            cfg.broker_admission_depth = int(bra["admission_depth"])
+        if "namespace_cap" in bra:
+            cfg.broker_namespace_cap = int(bra["namespace_cap"])
+        if "blocked_cap" in bra:
+            cfg.blocked_evals_cap = int(bra["blocked_cap"])
+    lmb = body.block("limits")
+    if lmb is not None:
+        lma = lmb.body.attrs()
+        cfg.http_rate_limit = float(lma.get("http_rate", 0) or 0)
+        cfg.http_rate_burst = float(lma.get("http_burst", 0) or 0)
+        cfg.rpc_rate_limit = float(lma.get("rpc_rate", 0) or 0)
+        cfg.rpc_rate_burst = float(lma.get("rpc_burst", 0) or 0)
     for plug in body.blocks("plugin"):
         name = plug.labels[0] if plug.labels else ""
         ref = plug.body.attrs().get("factory", "")
@@ -336,6 +358,24 @@ def _apply_config_dict(cfg, data: dict) -> None:
                 cfg.telemetry_interval_s = parse_duration(
                     v["collection_interval"]
                 )
+        elif k == "broker" and isinstance(v, dict):
+            from ..jobspec.hcl import parse_duration
+
+            if "delivery_limit" in v:
+                cfg.broker_delivery_limit = int(v["delivery_limit"])
+            if "nack_delay" in v:
+                cfg.broker_nack_delay_s = parse_duration(v["nack_delay"])
+            if "admission_depth" in v:
+                cfg.broker_admission_depth = int(v["admission_depth"])
+            if "namespace_cap" in v:
+                cfg.broker_namespace_cap = int(v["namespace_cap"])
+            if "blocked_cap" in v:
+                cfg.blocked_evals_cap = int(v["blocked_cap"])
+        elif k == "limits" and isinstance(v, dict):
+            cfg.http_rate_limit = float(v.get("http_rate", 0) or 0)
+            cfg.http_rate_burst = float(v.get("http_burst", 0) or 0)
+            cfg.rpc_rate_limit = float(v.get("rpc_rate", 0) or 0)
+            cfg.rpc_rate_burst = float(v.get("rpc_burst", 0) or 0)
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)
@@ -2018,6 +2058,37 @@ def _render_top(snap: dict, prev, solver=None) -> str:
             f"   processed {int(gauges.get('nomad.workers.processed', 0))}"
         ),
     ]
+    # overload panel: admission shed / front-door throttle / backpressure
+    # counters (docs/operations.md § Surviving overload). Rendered when
+    # admission control is configured or any overload signal has fired —
+    # an unconfigured quiet cluster keeps the compact layout.
+    counters = snap.get("counters") or {}
+    shed = int(counters.get("nomad.broker.shed", 0))
+    rejected = int(counters.get("nomad.broker.rejected", 0))
+    throttled = int(
+        counters.get("nomad.http.throttled", 0)
+        + counters.get("nomad.rpc.throttled", 0)
+    )
+    bp_level = gauges.get("nomad.worker.backpressure_level")
+    if (
+        shed or rejected or throttled or bp_level
+        or gauges.get("nomad.broker.admission_depth")
+    ):
+        lines.append(
+            f"Overload    shed {shed}   rejected(429) {rejected}"
+            f"   throttled http+rpc {throttled}"
+            f"   pending {int(gauges.get('nomad.broker.total_pending', 0))}"
+            + (
+                f"/{int(gauges.get('nomad.broker.admission_depth', 0))}"
+                if gauges.get("nomad.broker.admission_depth")
+                else ""
+            )
+            + (
+                f"   backpressure {bp_level * 100:.0f}%"
+                if bp_level is not None
+                else ""
+            )
+        )
     # solver panel: occupancy %, steady-state recompiles, device p95 —
     # /v1/solver/status for the ledger, /v1/metrics for the occupancy
     # histogram and the device-stage percentiles. Rendered only when a

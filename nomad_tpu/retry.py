@@ -101,6 +101,16 @@ def call_with_retry(
             if not retry_if(e):
                 raise
             delay = bo.next()
+            # Server-provided backoff hint (429 Retry-After riding a
+            # RateLimitError/APIError as `retry_after_s`): a FLOOR on
+            # the computed delay — retrying sooner than the server said
+            # guarantees another rejection.
+            hint = getattr(e, "retry_after_s", None)
+            if hint:
+                try:
+                    delay = max(delay, float(hint))
+                except (TypeError, ValueError):
+                    pass
             if time.monotonic() + delay > deadline:
                 raise
             metrics.incr(f"nomad.rpc.retry_count.{label}")

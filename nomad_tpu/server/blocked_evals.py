@@ -4,6 +4,15 @@ Reference: nomad/blocked_evals.go (781 LoC) — evals that failed placement
 wait here, keyed by the computed node classes they found ineligible; any
 capacity-changing event (node up/updated, alloc freed) unblocks the evals
 that could now succeed and re-enqueues them into the broker.
+
+Storm containment (overload protection): per-job dedup means repeated
+capacity churn can never mint unbounded duplicates for one job (newest
+blocked eval wins, mirroring the state store's cancel-on-upsert), and a
+configurable ``cap`` bounds the total tracked population — past it the
+OLDEST blocked eval is evicted back into the broker (re-enqueued, not
+silently dropped: it gets another placement attempt, and if capacity is
+still missing it re-blocks, keeping the population at the cap instead
+of growing without bound).
 """
 
 from __future__ import annotations
@@ -11,13 +20,17 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from .. import metrics
 from ..structs import Evaluation
 from ..structs.structs import EVAL_TRIGGER_MAX_PLANS
 
 
 class BlockedEvals:
-    def __init__(self, enqueue_fn: Callable[[Evaluation], None]) -> None:
+    def __init__(self, enqueue_fn: Callable[[Evaluation], None],
+                 cap: int = 0) -> None:
         self.enqueue_fn = enqueue_fn
+        # Max tracked blocked evals (captured + escaped); 0 = unbounded.
+        self.cap = cap
         self._lock = threading.Lock()
         self._enabled = False
         # eval id -> eval, for evals blocked on specific classes
@@ -26,13 +39,31 @@ class BlockedEvals:
         self._escaped: dict[str, Evaluation] = {}
         # (ns, job) -> blocked eval id (one blocked eval per job)
         self._by_job: dict[tuple[str, str], str] = {}
+        # insertion-age journal (dict = insertion-ordered): the cap's
+        # oldest-eviction order. Ids leave lazily — a key may be stale
+        # (already unblocked); eviction skips those.
+        self._ages: dict[str, None] = {}
         # computed class -> state index of the last capacity change for
         # that class (reference unblockIndexes): closes the lost-wakeup
         # race where capacity appears BETWEEN the scheduler's snapshot
         # and the eval landing here.
         self._unblock_indexes: dict[str, int] = {}
         self._global_unblock_index = 0
-        self.stats = {"total_blocked": 0, "total_escaped": 0, "unblocks": 0}
+        self.stats = {
+            "total_blocked": 0,
+            "total_escaped": 0,
+            "unblocks": 0,
+            "deduped": 0,
+            "evicted": 0,
+        }
+
+    def configure(self, cap: Optional[int] = None) -> None:
+        """Live reconfiguration (agent SIGHUP reload). Shrinking the cap
+        applies to FUTURE blocks; the population drains to the new bound
+        as churn arrives (no mass eviction storm on reload)."""
+        with self._lock:
+            if cap is not None:
+                self.cap = int(cap)
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -41,6 +72,7 @@ class BlockedEvals:
                 self._captured.clear()
                 self._escaped.clear()
                 self._by_job.clear()
+                self._ages.clear()
 
     def _missed_unblock(self, ev: Evaluation) -> bool:
         """Did a capacity change land after this eval's snapshot?
@@ -57,6 +89,7 @@ class BlockedEvals:
 
     def block(self, ev: Evaluation) -> None:
         requeued = None
+        evicted: list[Evaluation] = []
         with self._lock:
             if not self._enabled:
                 return
@@ -69,25 +102,63 @@ class BlockedEvals:
                 requeued.triggered_by = "queued-allocs"
             else:
                 self._block_locked(ev)
+                evicted = self._evict_over_cap_locked()
         # enqueue outside the lock, like unblock()/unblock_all()
         if requeued is not None:
             self.enqueue_fn(requeued)
+        for old in evicted:
+            metrics.incr("nomad.blocked_evals.evicted")
+            re = old.copy()
+            re.status = "pending"
+            re.triggered_by = "queued-allocs"
+            self.enqueue_fn(re)
 
     def _block_locked(self, ev: Evaluation) -> None:
         key = (ev.namespace, ev.job_id)
         # newest blocked eval per job wins (the state store cancels the
         # older one on upsert — mirror that here)
         old_id = self._by_job.get(key)
-        if old_id:
+        if old_id and old_id != ev.id:
             self._captured.pop(old_id, None)
             self._escaped.pop(old_id, None)
+            self._ages.pop(old_id, None)
+            self.stats["deduped"] += 1
+            metrics.incr("nomad.blocked_evals.deduped")
         self._by_job[key] = ev.id
         if ev.escaped_computed_class or not ev.class_eligibility:
             self._escaped[ev.id] = ev
-            self.stats["total_escaped"] = len(self._escaped)
         else:
             self._captured[ev.id] = ev
+        self._ages.pop(ev.id, None)
+        self._ages[ev.id] = None
+        self.stats["total_escaped"] = len(self._escaped)
         self.stats["total_blocked"] = len(self._captured) + len(self._escaped)
+
+    def _evict_over_cap_locked(self) -> list[Evaluation]:
+        """Oldest-first eviction down to the cap; returns the evals to
+        re-enqueue (caller does so outside the lock)."""
+        if self.cap <= 0:
+            return []
+        out: list[Evaluation] = []
+        while len(self._captured) + len(self._escaped) > self.cap:
+            victim = None
+            # _ages may lead with stale ids (already unblocked) — skip
+            while self._ages:
+                vid = next(iter(self._ages))
+                del self._ages[vid]
+                victim = self._captured.pop(vid, None) or self._escaped.pop(
+                    vid, None
+                )
+                if victim is not None:
+                    break
+            if victim is None:
+                break  # journal exhausted (shouldn't happen)
+            self._by_job.pop((victim.namespace, victim.job_id), None)
+            self.stats["evicted"] += 1
+            out.append(victim)
+        self.stats["total_escaped"] = len(self._escaped)
+        self.stats["total_blocked"] = len(self._captured) + len(self._escaped)
+        return out
 
     def untrack(self, namespace: str, job_id: str) -> None:
         """Job deregistered: drop its blocked eval."""
@@ -96,6 +167,11 @@ class BlockedEvals:
             if eid:
                 self._captured.pop(eid, None)
                 self._escaped.pop(eid, None)
+                self._ages.pop(eid, None)
+                self.stats["total_escaped"] = len(self._escaped)
+                self.stats["total_blocked"] = (
+                    len(self._captured) + len(self._escaped)
+                )
 
     # -- unblock triggers ---------------------------------------------
 
@@ -116,12 +192,14 @@ class BlockedEvals:
                 )
             for eid in list(self._escaped):
                 to_run.append(self._escaped.pop(eid))
+                self._ages.pop(eid, None)
             for eid, ev in list(self._captured.items()):
                 # eligible (True) => the class could place it: unblock.
                 # unknown class (not in map) => untested: unblock to retest.
                 elig = ev.class_eligibility.get(computed_class)
                 if elig is None or elig:
                     to_run.append(self._captured.pop(eid))
+                    self._ages.pop(eid, None)
             for ev in to_run:
                 self._by_job.pop((ev.namespace, ev.job_id), None)
             self.stats["unblocks"] += len(to_run)
@@ -139,6 +217,7 @@ class BlockedEvals:
             self._captured.clear()
             self._escaped.clear()
             self._by_job.clear()
+            self._ages.clear()
         for ev in evs:
             requeued = ev.copy()
             requeued.status = "pending"

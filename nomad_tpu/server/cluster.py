@@ -291,13 +291,17 @@ class JobEndpoint(_Forwarder):
         )
 
     def periodic_force(self, args):
-        return self._forward(
-            "Job.periodic_force",
-            args,
-            lambda a: self.cs.server.periodic.force_launch(
+        def local(a):
+            # front-door admission: force_launch mints a child job +
+            # eval directly (not via job_register, whose own guard
+            # covers register/scale/revert) — the periodic dispatcher's
+            # internal timer path stays unguarded on purpose
+            self.cs.server.check_eval_admission(a["namespace"])
+            return self.cs.server.periodic.force_launch(
                 a["namespace"], a["job_id"]
-            ),
-        )
+            )
+
+        return self._forward("Job.periodic_force", args, local)
 
     def scale_status(self, args):
         """Group-level desired/placed/running counts (reference
@@ -1149,6 +1153,17 @@ class ClusterServer:
         # Leaderless-window retry budget for _Forwarder (retry.py) —
         # overridable per deployment (tests shrink it).
         self.forward_retry = FORWARD_POLICY
+        # Per-namespace token buckets on the RPC front door (ratelimit
+        # .py; disabled until limits{} config sets a rate). Charged in
+        # _rpc_precheck for the eval-minting write verbs only — raft,
+        # serf, heartbeats, and reads must never be throttled (a
+        # throttled heartbeat marks live nodes down, amplifying the
+        # overload this exists to contain). A follower charges its own
+        # bucket before forwarding, the leader charges again on arrival:
+        # per-server budgets, conservative under forwarding.
+        from ..ratelimit import KeyedRateLimiter
+
+        self.rpc_limiter = KeyedRateLimiter()
         self.server = Server(
             num_workers=num_workers,
             use_tpu_batch_worker=use_tpu_batch_worker,
@@ -1670,13 +1685,60 @@ class ClusterServer:
             return self.pool.call(addr, method, args, timeout_s=30.0)
         return self.rpc.dispatch_local(method, args)
 
+    # The write verbs the per-namespace RPC rate limit covers: every
+    # eval-minting mutation a client can drive in a loop. Deliberately
+    # absent: deregister/stop (shedding a stop strands capacity),
+    # node/heartbeat traffic, raft/serf internals, and all reads.
+    _RATE_LIMITED_METHODS = frozenset({
+        "Job.register",
+        "Job.scale",
+        "Job.evaluate",
+        "Job.dispatch",
+        "Job.revert",
+        "Job.periodic_force",
+    })
+
+    def set_rate_limits(self, rpc_rate: float, rpc_burst: float = 0.0) -> None:
+        """Configure (or SIGHUP-reconfigure) the per-namespace RPC
+        front-door token buckets. rate <= 0 disables."""
+        self.rpc_limiter.configure(rpc_rate, rpc_burst)
+
+    @staticmethod
+    def _args_namespace(args) -> str:
+        if not isinstance(args, dict):
+            return "default"
+        ns = args.get("namespace")
+        if not ns:
+            job = args.get("job")
+            ns = getattr(job, "namespace", None)
+        return ns or "default"
+
     def _rpc_precheck(self, method: str, args) -> None:
         """Runs before EVERY dispatch (in-process and fabric-arriving):
         a federated request landing in its target region carries the
         caller's token — the sending region's HTTP-layer check used ITS
         acl state, so re-authorize against OURS (the reference resolves
         the forwarded token in the target region; non-replicated tokens
-        are region-local, like non-global tokens there)."""
+        are region-local, like non-global tokens there). The per-
+        namespace rate limit also charges here: one choke point covers
+        the fabric socket, in-process rpc_self, and HTTP-originated
+        writes alike."""
+        if (
+            self.rpc_limiter.enabled
+            and method in self._RATE_LIMITED_METHODS
+        ):
+            from .. import metrics
+            from ..ratelimit import RateLimitError
+
+            ns = self._args_namespace(args)
+            wait = self.rpc_limiter.check(ns)
+            if wait > 0:
+                metrics.incr("nomad.rpc.throttled")
+                raise RateLimitError(
+                    f"rpc {method} rate limit exceeded for namespace "
+                    f"{ns!r}",
+                    retry_after_s=wait,
+                )
         if (
             isinstance(args, dict)
             and args.get("__cross_region_token__") is not None

@@ -13,6 +13,37 @@ Semantics preserved:
     over-limit evals land in the failed queue;
   * delayed evals (wait_until in the future) sit in a time heap serviced by
     a timer thread.
+
+Admission control (overload protection — the reference broker is
+unbounded and relies on endpoint limits alone; a batched TPU solver
+makes a bounded backlog mandatory because one mega-batch stall backs up
+the whole pipeline):
+  * ``admission_depth`` bounds the PENDING population (ready + per-job
+    waiters + delayed; unacked in-flight evals are excluded). Past the
+    depth an arriving eval is admitted only by displacing something:
+    first an older duplicate waiting behind the same job (newest eval
+    carries the freshest trigger — the state store cancels older
+    pending evals on upsert the same way), else the lowest-priority
+    pending eval strictly below the newcomer's priority. Otherwise the
+    newcomer itself is shed.
+  * ``namespace_cap`` is a per-namespace fairness bound: one namespace
+    cannot occupy more than this many pending slots no matter how far
+    below admission_depth the broker sits.
+  * Every shed increments ``nomad.broker.shed`` (+ a per-reason
+    counter) and finishes the eval's trace as "shed". A shed eval's
+    state-store record stays pending: the next leadership restore or a
+    superseding eval for the same job re-covers the work — shedding
+    sheds BROKER load, never acked writes.
+
+Shedding engages only when the knobs are set (depth 0 = unbounded, the
+seed default), so an unconfigured broker behaves exactly as before.
+Redeliveries (nack → delay → requeue) bypass admission: an eval that
+was admitted once is never rejected at the door and never chosen as a
+priority-displacement victim (it carries a live attempt count, which
+keeps it out of the pending index). The one way a redelivery can still
+leave early is DUPLICATE displacement — a newer eval for the same job
+superseding it — which is safe by the same argument as the state
+store's cancel-on-upsert: the newest eval re-covers the job's work.
 """
 
 from __future__ import annotations
@@ -32,22 +63,48 @@ FAILED_QUEUE = "_failed"
 
 
 class _PendingHeap:
-    """Priority heap: higher priority first, then FIFO."""
+    """Priority heap: higher priority first, then FIFO. ``dropped`` is
+    the broker's shared tombstone set (admission-control evictions):
+    entries whose eval id is in it are discarded lazily at pop/peek —
+    heap surgery without O(n) re-heapify on the enqueue hot path."""
 
-    def __init__(self) -> None:
+    def __init__(self, dropped: Optional[set] = None) -> None:
         self._heap: list = []
         self._counter = itertools.count()
+        self._dropped = dropped if dropped is not None else set()
 
     def push(self, ev: Evaluation) -> None:
         heapq.heappush(self._heap, (-ev.priority, next(self._counter), ev))
 
     def pop(self) -> Optional[Evaluation]:
-        if not self._heap:
-            return None
-        return heapq.heappop(self._heap)[2]
+        while self._heap:
+            ev = heapq.heappop(self._heap)[2]
+            if ev.id in self._dropped:
+                self._dropped.discard(ev.id)
+                continue
+            return ev
+        return None
 
     def peek(self) -> Optional[Evaluation]:
-        return self._heap[0][2] if self._heap else None
+        while self._heap:
+            ev = self._heap[0][2]
+            if ev.id not in self._dropped:
+                return ev
+            heapq.heappop(self._heap)
+            self._dropped.discard(ev.id)
+        return None
+
+    def oldest_waiter_below(self, priority: int) -> Optional[Evaluation]:
+        """The oldest (smallest seq) live entry with priority <= the
+        given one — the duplicate-shed victim. O(n) over this JOB's
+        waiters only (bounded by per-job churn, not queue depth)."""
+        best = None
+        for _negp, seq, ev in self._heap:
+            if ev.id in self._dropped or ev.priority > priority:
+                continue
+            if best is None or seq < best[0]:
+                best = (seq, ev)
+        return best[1] if best else None
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -58,12 +115,35 @@ class EvalBroker:
         self,
         nack_delay_s: float = DEFAULT_NACK_DELAY_S,
         delivery_limit: int = DEFAULT_DELIVERY_LIMIT,
+        admission_depth: int = 0,
+        namespace_cap: int = 0,
     ) -> None:
         self.nack_delay_s = nack_delay_s
         self.delivery_limit = delivery_limit
+        # Admission knobs (0 = unbounded): see the module docstring.
+        self.admission_depth = admission_depth
+        self.namespace_cap = namespace_cap
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._enabled = False
+        # Tombstones for admission-control evictions: ids whose heap
+        # entries are discarded lazily at the pop sites (ready heaps,
+        # per-job waiter heaps, the delayed list).
+        self._dropped: set[str] = set()
+        # Pending-population index: eval id -> the broker's Evaluation
+        # copy, for every PENDING eval (ready / waiting behind its job /
+        # delayed; NOT unacked). The admission depth bounds len() of
+        # this dict; the priority buckets make the lowest-priority
+        # victim an O(priority-range) lookup instead of an O(depth)
+        # scan, and holding the full eval lets a shed victim release
+        # its job's in-flight slot correctly.
+        self._pending_info: dict[str, Evaluation] = {}
+        self._ns_pending: dict[str, int] = {}
+        # priority -> insertion-ordered {eval_id: None} (FIFO within a
+        # priority level, so the victim is the OLDEST at the lowest
+        # priority)
+        self._prio_buckets: dict[int, dict[str, None]] = {}
+        self.shed_total = 0
         # scheduler type -> ready heap
         self._ready: dict[str, _PendingHeap] = {}
         # eval id -> (eval, token, attempts) for unacked evals
@@ -102,6 +182,28 @@ class EvalBroker:
             "total_waiting": 0,
             "failed": 0,
         }
+
+    # -- configuration --------------------------------------------------
+
+    def configure(
+        self,
+        nack_delay_s: Optional[float] = None,
+        delivery_limit: Optional[int] = None,
+        admission_depth: Optional[int] = None,
+        namespace_cap: Optional[int] = None,
+    ) -> None:
+        """Live reconfiguration (agent SIGHUP reload): every knob applies
+        to the running broker without a flush — in-flight deliveries
+        keep their attempt counts, pending evals stay queued."""
+        with self._lock:
+            if nack_delay_s is not None:
+                self.nack_delay_s = float(nack_delay_s)
+            if delivery_limit is not None:
+                self.delivery_limit = int(delivery_limit)
+            if admission_depth is not None:
+                self.admission_depth = int(admission_depth)
+            if namespace_cap is not None:
+                self.namespace_cap = int(namespace_cap)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -144,6 +246,10 @@ class EvalBroker:
         self._traces.clear()
         self._enqueue_times.clear()
         self._wait_starts.clear()
+        self._dropped.clear()
+        self._pending_info.clear()
+        self._ns_pending.clear()
+        self._prio_buckets.clear()
 
     # -- enqueue -------------------------------------------------------
 
@@ -156,8 +262,134 @@ class EvalBroker:
             for ev in evals:
                 self._enqueue_locked(ev.copy())
 
+    # -- admission accounting -------------------------------------------
+
+    def _pending_add(self, ev: Evaluation) -> None:
+        if ev.id in self._pending_info:
+            return
+        if self._attempts.get(ev.id):
+            # A redelivery (delivered at least once, nacked, waiting or
+            # re-promoted): it was admitted when it first arrived, so it
+            # neither counts against the admission depth nor enters the
+            # displacement victim pool. Shedding a mid-retry eval would
+            # break its e2e accounting and — worse, in the delay heap —
+            # strand the job's queued waiters: its in-flight marker was
+            # already cleared at nack, so _shed_locked would have no
+            # slot to release and nothing would ever promote them.
+            return
+        self._pending_info[ev.id] = ev
+        self._ns_pending[ev.namespace] = (
+            self._ns_pending.get(ev.namespace, 0) + 1
+        )
+        self._prio_buckets.setdefault(ev.priority, {})[ev.id] = None
+
+    def _pending_remove(self, eval_id: str) -> None:
+        ev = self._pending_info.pop(eval_id, None)
+        if ev is None:
+            return
+        n = self._ns_pending.get(ev.namespace, 0) - 1
+        if n > 0:
+            self._ns_pending[ev.namespace] = n
+        else:
+            self._ns_pending.pop(ev.namespace, None)
+        bucket = self._prio_buckets.get(ev.priority)
+        if bucket is not None:
+            bucket.pop(eval_id, None)
+            if not bucket:
+                del self._prio_buckets[ev.priority]
+
+    def _shed_locked(self, ev: Evaluation, reason: str,
+                     tracked: bool) -> None:
+        """Drop one eval from the broker's books. ``tracked`` — it was
+        admitted earlier (an evicted victim) vs an arriving eval that
+        never entered."""
+        self.shed_total += 1
+        metrics.incr("nomad.broker.shed")
+        metrics.incr(f"nomad.broker.shed.{reason}")
+        if tracked:
+            self._dropped.add(ev.id)
+            self._pending_remove(ev.id)
+            self._wait_starts.pop(ev.id, None)
+            # a shed eval is no longer the job's in-flight marker: a
+            # READY victim held the slot — promote the next waiter so
+            # the job never strands behind a tombstone
+            key = (ev.namespace, ev.job_id)
+            if ev.job_id and self._in_flight.get(key) == ev.id:
+                self._release_job_locked(ev, ev.id)
+        self._enqueue_times.pop(ev.id, None)
+        tentry = self._traces.pop(ev.id, None)
+        if tentry is not None:
+            ctx, open_span = tentry
+            open_span.attrs = dict(
+                open_span.attrs or {}, outcome="shed", reason=reason
+            )
+            ctx.end_span(open_span)
+            ctx.finish("shed")
+
+    def _victim_below_locked(self, priority: int) -> Optional[Evaluation]:
+        """Oldest pending eval at the lowest priority strictly below
+        the given one (None when nothing qualifies)."""
+        for prio in sorted(self._prio_buckets):
+            if prio >= priority:
+                return None
+            bucket = self._prio_buckets[prio]
+            if bucket:
+                return self._pending_info[next(iter(bucket))]
+        return None
+
+    def _admit_locked(self, ev: Evaluation) -> bool:
+        """Admission decision for a NEW enqueue. True = admitted (a
+        duplicate or lower-priority victim may have been evicted to
+        make room); False = shed the arrival."""
+        if self.admission_depth <= 0 and self.namespace_cap <= 0:
+            return True
+        if ev.type == "_core" or ev.id in self._enqueue_times:
+            # GC/core evals are leader-internal and tiny; a re-enqueue
+            # of an id the broker already tracks (pending OR unacked)
+            # must not double-count or shed the live eval's bookkeeping
+            return True
+        pending = len(self._pending_info)
+        ns_full = (
+            self.namespace_cap > 0
+            and self._ns_pending.get(ev.namespace, 0) >= self.namespace_cap
+        )
+        depth_full = (
+            self.admission_depth > 0 and pending >= self.admission_depth
+        )
+        if not ns_full and not depth_full:
+            return True
+        # 1) duplicate displacement: the job already has waiters — the
+        # oldest duplicate at <= priority yields its slot to the newest
+        # trigger (works for both the depth and the namespace bound,
+        # since the duplicate shares the namespace)
+        key = (ev.namespace, ev.job_id)
+        waiters = self._blocked_jobs.get(key) if ev.job_id else None
+        if waiters is not None:
+            dup = waiters.oldest_waiter_below(ev.priority)
+            if dup is not None:
+                self._shed_locked(dup, "duplicate", tracked=True)
+                return True
+        if ns_full:
+            # fairness cap: no cross-namespace eviction — the newcomer's
+            # own namespace is over budget, so it is the one shed
+            self._shed_locked(ev, "namespace", tracked=False)
+            return False
+        # 2) priority displacement: evict the oldest lowest-priority
+        # pending eval strictly below the newcomer. The victim may be
+        # READY and holding its job's in-flight slot — _shed_locked
+        # releases it and promotes the next waiter, so the job never
+        # strands behind a tombstone.
+        victim = self._victim_below_locked(ev.priority)
+        if victim is not None:
+            self._shed_locked(victim, "depth", tracked=True)
+            return True
+        self._shed_locked(ev, "depth", tracked=False)
+        return False
+
     def _enqueue_locked(self, ev: Evaluation) -> None:
         if not self._enabled:
+            return
+        if not self._admit_locked(ev):
             return
         self._enqueue_times.setdefault(ev.id, time.monotonic())
         if trace.enabled() and ev.id not in self._traces:
@@ -174,6 +406,7 @@ class EvalBroker:
                     ctx.start_span("broker.wait", detached=True),
                 )
         if ev.wait_until_ns and ev.wait_until_ns > now_ns():
+            self._pending_add(ev)
             heapq.heappush(
                 self._delayed, (ev.wait_until_ns, next(self._delayed_counter), ev)
             )
@@ -181,12 +414,18 @@ class EvalBroker:
             return
         key = (ev.namespace, ev.job_id)
         if ev.job_id and key in self._in_flight:
-            self._blocked_jobs.setdefault(key, _PendingHeap()).push(ev)
+            self._pending_add(ev)
+            self._blocked_jobs.setdefault(key, self._heap()).push(ev)
             return
         self._push_ready(ev)
 
+    def _heap(self) -> _PendingHeap:
+        """A heap sharing the broker's admission tombstone set."""
+        return _PendingHeap(self._dropped)
+
     def _push_ready(self, ev: Evaluation) -> None:
-        self._ready.setdefault(ev.type, _PendingHeap()).push(ev)
+        self._pending_add(ev)
+        self._ready.setdefault(ev.type, self._heap()).push(ev)
         self._wait_starts[ev.id] = time.monotonic()
         if ev.job_id:
             self._in_flight[(ev.namespace, ev.job_id)] = ev.id
@@ -206,6 +445,9 @@ class EvalBroker:
                 if self._enabled:
                     ev = self._pop_best_locked(schedulers)
                     if ev is not None:
+                        # pending -> in-flight: the admission bound
+                        # covers the backlog, not work being processed
+                        self._pending_remove(ev.id)
                         token = generate_uuid()
                         attempts = self._attempts.get(ev.id, 0) + 1
                         self._attempts[ev.id] = attempts
@@ -302,7 +544,7 @@ class EvalBroker:
                 # evals must still be promoted or they strand forever
                 self._attempts.pop(eval_id, None)
                 self._release_job_locked(ev, eval_id)
-                self._ready.setdefault(FAILED_QUEUE, _PendingHeap()).push(ev)
+                self._ready.setdefault(FAILED_QUEUE, self._heap()).push(ev)
                 self.stats["failed"] += 1
                 self._cv.notify_all()
                 self._enqueue_times.pop(eval_id, None)
@@ -327,7 +569,10 @@ class EvalBroker:
                         "nack.wait", parent=ctx.root, detached=True
                     ),
                 )
-            # re-enqueue after the nack delay
+            # re-enqueue after the nack delay. Redeliveries bypass
+            # admission entirely — _pending_add refuses ids with a live
+            # attempt count, so a retry is never rejected at the door
+            # NOR chosen as a displacement victim while it waits.
             requeue_at = now_ns() + int(self.nack_delay_s * 1e9)
             heapq.heappush(
                 self._delayed, (requeue_at, next(self._delayed_counter), ev)
@@ -355,9 +600,14 @@ class EvalBroker:
                 now = now_ns()
                 while self._delayed and self._delayed[0][0] <= now:
                     _, _, ev = heapq.heappop(self._delayed)
+                    if ev.id in self._dropped:
+                        # admission-control eviction landed while the
+                        # eval sat in the delay heap
+                        self._dropped.discard(ev.id)
+                        continue
                     key = (ev.namespace, ev.job_id)
                     if ev.job_id and key in self._in_flight:
-                        self._blocked_jobs.setdefault(key, _PendingHeap()).push(ev)
+                        self._blocked_jobs.setdefault(key, self._heap()).push(ev)
                     else:
                         self._push_ready(ev)
                 wait = 0.2
@@ -366,6 +616,71 @@ class EvalBroker:
             self._stop.wait(wait)
 
     # -- introspection -------------------------------------------------
+
+    def pending_count(self) -> int:
+        """Admitted-but-undelivered evals (ready + per-job waiters +
+        delayed) — the population admission_depth bounds."""
+        with self._lock:
+            return len(self._pending_info)
+
+    def namespace_pending(self, namespace: str) -> int:
+        with self._lock:
+            return self._ns_pending.get(namespace, 0)
+
+    def saturation(self, namespace: str = "") -> Optional[tuple[str, float]]:
+        """Front-door admission probe: (reason, retry_after_s) when a
+        new eval for this namespace would be rejected outright — the
+        leader's eval-minting write endpoints call this BEFORE raft so
+        overload surfaces as 429 instead of a shed after commit. None
+        while there is room (or admission is unconfigured/disabled).
+
+        Saturated means even displacement cannot help an average-
+        priority arrival: pending >= depth with nothing obviously
+        evictable is approximated as pending >= depth (the per-eval
+        displacement still runs for internal producers; the front door
+        is simply told to back off first — the reference's posture of
+        rejecting at the edge before queueing in the core). The hint
+        scales with how far past the bound the backlog sits."""
+        with self._lock:
+            if not self._enabled:
+                return None
+            if (
+                self.namespace_cap > 0
+                and namespace
+                and self._ns_pending.get(namespace, 0) >= self.namespace_cap
+            ):
+                return ("namespace", self.nack_delay_s / 4)
+            if self.admission_depth > 0:
+                pending = len(self._pending_info)
+                if pending >= self.admission_depth:
+                    over = pending - self.admission_depth
+                    return (
+                        "depth",
+                        min(5.0, 0.5 + over / max(1, self.admission_depth)),
+                    )
+        return None
+
+    def stats_snapshot(self) -> dict:
+        """Live queue depths + shed counters for the metrics provider.
+        (The legacy ``stats`` dict only ever tracked dead-letters; these
+        gauges are computed from the real structures under the lock so
+        `operator top` shows true depths.)"""
+        with self._lock:
+            ready = sum(
+                len(h) for t, h in self._ready.items() if t != FAILED_QUEUE
+            )
+            waiters = sum(len(h) for h in self._blocked_jobs.values())
+            return {
+                "total_ready": ready,
+                "total_unacked": len(self._unacked),
+                "total_blocked": waiters,
+                "total_waiting": len(self._delayed),
+                "total_pending": len(self._pending_info),
+                "total_shed": self.shed_total,
+                "admission_depth": self.admission_depth,
+                "namespace_cap": self.namespace_cap,
+                "failed": self.stats["failed"],
+            }
 
     def tracks(self, eval_id: str) -> bool:
         """Is this eval currently anywhere in the broker (ready, unacked,

@@ -96,8 +96,10 @@ class Server:
         # snapshot time (reference nomad/server.go:444-450 publishes the
         # same broker/plan-queue gauges on a timer).
         self._metric_handles = [
+            # live depths + shed counters computed under the broker lock
+            # (the legacy stats dict only ever tracked dead-letters)
             ("nomad.broker", metrics.register_provider(
-                "nomad.broker", lambda: dict(self.eval_broker.stats)
+                "nomad.broker", lambda: self.eval_broker.stats_snapshot()
             )),
             ("nomad.plan_queue", metrics.register_provider(
                 "nomad.plan_queue", lambda: {"depth": self.plan_queue.depth()}
@@ -106,6 +108,11 @@ class Server:
             # total evals processed (throughput = its rate)
             ("nomad.workers", metrics.register_provider(
                 "nomad.workers", self._worker_stats
+            )),
+            # blocked-evals storm containment gauges (dedup + cap)
+            ("nomad.blocked_evals", metrics.register_provider(
+                "nomad.blocked_evals",
+                lambda: dict(self.blocked_evals.stats),
             )),
         ]
         self.plan_applier = PlanApplier(
@@ -452,8 +459,34 @@ class Server:
                     )
         return job
 
+    def check_eval_admission(self, namespace: str) -> None:
+        """Front-door overload guard for the eval-minting write
+        endpoints — called directly by job_register (which also covers
+        scale and revert, since both re-register), job_force_evaluate,
+        job_dispatch, and the Job.periodic_force endpoint: when the broker's
+        admission depth or the namespace's fairness cap is exhausted,
+        reject BEFORE raft with a retry hint — the HTTP layer maps
+        BrokerSaturatedError to 429 + Retry-After, and the RPC string
+        form round-trips through the leader-forwarding path. Reads of
+        any kind, deregisters (shedding a stop would strand capacity),
+        and internal producers are never guarded here; the broker's own
+        per-eval admission covers those."""
+        sat = self.eval_broker.saturation(namespace)
+        if sat is None:
+            return
+        reason, retry_after = sat
+        metrics.incr("nomad.broker.rejected")
+        from ..ratelimit import BrokerSaturatedError
+
+        raise BrokerSaturatedError(
+            f"eval broker saturated ({reason}: "
+            f"{self.eval_broker.pending_count()} pending)",
+            retry_after_s=retry_after,
+        )
+
     def job_register(self, job: Job) -> str:
         """Returns the created eval id (reference job_endpoint.go:80)."""
+        self.check_eval_admission(job.namespace)
         job = self.validate_job_submission(job)
         self._ensure_namespace(job.namespace)
         if job.is_periodic():
@@ -747,6 +780,7 @@ class Server:
     def job_force_evaluate(self, namespace: str, job_id: str) -> str:
         """Create a new eval for the job (reference job_endpoint.go
         Evaluate / `nomad job eval`). Returns the eval id."""
+        self.check_eval_admission(namespace)
         job = self.state.job_by_id(namespace, job_id)
         if job is None:
             raise KeyError(f"job {job_id} not found")
@@ -997,6 +1031,7 @@ class Server:
     ) -> tuple[str, str]:
         """Dispatch a parameterized job (reference Job.Dispatch). Returns
         (child_job_id, eval_id)."""
+        self.check_eval_admission(namespace)
         parent = self.state.job_by_id(namespace, job_id)
         if parent is None:
             raise KeyError(f"unknown job {job_id}")

@@ -47,11 +47,74 @@ def _retriable_device_error(e: BaseException) -> bool:
     return type(e).__name__ == "XlaRuntimeError"
 
 
+class Backpressure:
+    """Couples the TPU worker's drain/batch sizing to the plan-apply
+    side's health: plan-queue depth (the applier's backlog) and an EWMA
+    of plan-submit latency (queue wait + verify + raft apply as the
+    worker sees it). Without this, the pipelined solve stage keeps
+    inflating batches an overwhelmed applier can't drain — queue depth
+    and commit latency grow without bound while the solver reports
+    great throughput (the overload failure mode ROADMAP item 3 names).
+
+    Policy: depth <= queue_hwm runs at the configured batch size; each
+    unit past the hwm halves the batch (floor 1); depth >= stall_depth
+    pauses dequeue entirely until the applier catches up. A submit-
+    latency EWMA past latency_hwm_s halves the batch once more —
+    latency-based coupling catches a slow-but-shallow queue (fsync
+    stalls under fault injection) that depth alone misses."""
+
+    def __init__(
+        self,
+        queue_hwm: int = 2,
+        stall_depth: int = 8,
+        latency_hwm_s: float = 5.0,
+        alpha: float = 0.3,
+    ) -> None:
+        self.queue_hwm = queue_hwm
+        self.stall_depth = stall_depth
+        self.latency_hwm_s = latency_hwm_s
+        self.alpha = alpha
+        self._ewma_s = 0.0
+
+    def note_submit_latency(self, dt_s: float) -> None:
+        self._ewma_s = (
+            dt_s
+            if self._ewma_s == 0.0
+            else self.alpha * dt_s + (1 - self.alpha) * self._ewma_s
+        )
+
+    @property
+    def submit_ewma_s(self) -> float:
+        return self._ewma_s
+
+    def should_stall(self, queue_depth: int) -> bool:
+        return queue_depth >= self.stall_depth
+
+    def batch_limit(self, configured: int, queue_depth: int) -> int:
+        limit = configured
+        if queue_depth > self.queue_hwm:
+            limit = max(1, configured >> (queue_depth - self.queue_hwm))
+        if self._ewma_s > self.latency_hwm_s:
+            limit = max(1, limit // 2)
+        # level: 0 = wide open, 1 = fully stalled (for `operator top`)
+        level = min(1.0, max(
+            queue_depth / max(1, self.stall_depth),
+            0.0 if self.latency_hwm_s <= 0
+            else min(1.0, self._ewma_s / (2 * self.latency_hwm_s)),
+        ))
+        metrics.set_gauge("nomad.worker.backpressure_level", level)
+        metrics.set_gauge("nomad.worker.batch_limit", limit)
+        return limit
+
+
 class WorkerPlanner:
-    """Planner interface backed by the server's plan queue + raft apply."""
+    """Planner interface backed by the server's plan queue + raft apply.
+    ``on_submit_latency`` — optional hook (the TPU worker installs its
+    Backpressure.note_submit_latency) fed every plan-submit wall time."""
 
     def __init__(self, server) -> None:
         self.server = server
+        self.on_submit_latency = None
 
     def submit_plan(self, plan: Plan):
         ctx = trace.current()
@@ -61,9 +124,10 @@ class WorkerPlanner:
             fut = self.server.plan_queue.enqueue(plan, trace_ctx=tref)
             result: PlanResult = fut.result(timeout=30)
         # queue wait + verify + raft apply, as the worker saw it
-        metrics.observe(
-            "nomad.plan.submit_seconds", time.perf_counter() - t0
-        )
+        dt = time.perf_counter() - t0
+        metrics.observe("nomad.plan.submit_seconds", dt)
+        if self.on_submit_latency is not None:
+            self.on_submit_latency(dt)
         new_state = None
         if result.refresh_index > 0:
             with trace.span(ctx, "snapshot.refresh"):
@@ -86,9 +150,10 @@ class WorkerPlanner:
                 plans, trace_ctx=tref
             )
             results: list[PlanResult] = [f.result(timeout=60) for f in futs]
-        metrics.observe(
-            "nomad.plan.submit_seconds", time.perf_counter() - t0
-        )
+        dt = time.perf_counter() - t0
+        metrics.observe("nomad.plan.submit_seconds", dt)
+        if self.on_submit_latency is not None:
+            self.on_submit_latency(dt)
         max_refresh = max((r.refresh_index for r in results), default=0)
         if max_refresh > 0:
             with trace.span(ctx, "snapshot.refresh"):
@@ -237,6 +302,10 @@ class TPUBatchWorker:
         self.batch_size = batch_size
         self.config = config or SchedulerConfig(backend="tpu")
         self.planner = WorkerPlanner(server)
+        # plan-apply backpressure: the solve stage sizes (and stalls)
+        # its drains from the applier's queue depth + submit latency
+        self.backpressure = Backpressure()
+        self.planner.on_submit_latency = self.backpressure.note_submit_latency
         self.pipeline = pipeline
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -324,11 +393,35 @@ class TPUBatchWorker:
             # next eval arrives.
             if self._prev is not None and self._prev[1].is_set():
                 self._prev = None
+            # Backpressure gate BEFORE the blocking dequeue: while the
+            # plan queue is saturated, solving more batches only grows
+            # the backlog the applier is already failing to drain — the
+            # evals are safer waiting in the broker (sheddable,
+            # priority-ordered) than baked into solved-but-uncommitted
+            # plans.
+            stalled = False
+            while not stop.is_set() and self.backpressure.should_stall(
+                self.server.plan_queue.depth()
+            ):
+                if not stalled:
+                    stalled = True
+                    metrics.incr("nomad.worker.backpressure_throttled")
+                stop.wait(0.05)
+            if stop.is_set():
+                break
             batch: list[tuple[Evaluation, str]] = []
             ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
             if ev is None:
                 continue
             batch.append((ev, token))
+            # Effective batch size under backpressure: plan-queue depth
+            # and submit-latency EWMA shrink the drain so the solver
+            # stops inflating batches the applier can't absorb.
+            limit = self.backpressure.batch_limit(
+                self.batch_size, self.server.plan_queue.depth()
+            )
+            if limit < self.batch_size:
+                metrics.incr("nomad.worker.backpressure_throttled")
             # One trace per BATCH (the per-eval broker traces link to it
             # via the batch attr): solve/commit stage spans are shared
             # across the whole batch, so duplicating them per eval would
@@ -336,7 +429,7 @@ class TPUBatchWorker:
             bctx = trace.start_trace("tpu.batch")
             with trace.span(bctx, "broker.drain"):
                 # opportunistically drain more ready evals without waiting
-                while len(batch) < self.batch_size:
+                while len(batch) < limit:
                     ev2, token2 = broker.dequeue(
                         self.schedulers, timeout_s=0.01
                     )

@@ -1,0 +1,163 @@
+"""Sustained-traffic soak: the closed-loop load generator driving a
+live fault-injected cluster (nomad_tpu/testing/loadgen.py).
+
+Tier-1 runs the fast seeded mini-soak (~15s wall: a few seconds of
+traffic at an offered rate far above what the overload knobs admit,
+under background rpc-drop / lost-response / slow-fsync faults), gating
+on the same evidence the bench `soak` config gates on: ChaosCluster
+invariants hold, the cluster converges, admission control demonstrably
+engaged, e2e p99 bounded, and the broker drains once arrivals stop.
+
+The 10-minute acceptance-shaped soak (partition/heal cycle included) is
+slow-marked; run it with `pytest -m 'soak and slow'` or via
+`BENCH_SOAK_S=600 BENCH_CONFIG=soak python bench.py`.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from nomad_tpu import metrics
+from nomad_tpu.metrics import Registry
+from nomad_tpu.testing import chaos
+from nomad_tpu.testing.loadgen import LoadGen, LoadGenConfig, run_soak
+
+pytestmark = pytest.mark.soak
+
+
+@pytest.fixture(autouse=True)
+def _fresh_world():
+    """Plane-free and a private registry per soak (counters in the
+    report are deltas, but a clean registry keeps the e2e histogram
+    attributable)."""
+    chaos.uninstall()
+    old = metrics._install_registry(Registry())
+    yield
+    metrics._install_registry(old)
+    chaos.uninstall()
+
+
+def test_mini_soak_overload_with_faults(tmp_path):
+    """Fast seeded mini-soak, faults ON: offered rate ~10x what the
+    tight admission/rate-limit knobs accept, so every control engages
+    while the safety invariants must keep holding."""
+    report = run_soak(
+        str(tmp_path),
+        duration_s=6.0,
+        rate=120.0,
+        seed=1234,
+        admission_depth=24,
+        namespace_cap=10,
+        blocked_cap=24,
+        nack_delay_s=0.5,
+        rpc_rate=10.0,
+        rpc_burst=15.0,
+        use_tpu_worker=False,
+        faults=True,
+        partition_cycle=False,
+        node_count=8,
+        p99_bound_s=20.0,
+        loadgen_overrides={"submitters": 6},
+    )
+    # safety: nothing acked was lost, no duplicate allocs, log converged
+    assert report["invariants_ok"], report["invariant_error"]
+    assert report["converged"]
+    # liveness: traffic flowed and the backlog drained once it stopped
+    assert report["offered"] > 0
+    assert report["accepted"] > 0
+    assert report["evals_completed"] > 0
+    assert report["drained"]
+    # degradation engaged: shed / front-door 429s / throttles fired
+    assert report["admission_engaged"], report["counters"]
+    # the work that WAS admitted completed in bounded time
+    assert report["p99_bounded"], report.get("e2e_seconds")
+    # the seeded fault schedule actually fired faults during the run
+    assert report["fault_schedule"] and report["fired_faults"]
+
+
+def test_mini_soak_seed_fixes_fault_schedule(tmp_path):
+    """Same seed => the background fault schedule derives from one RNG
+    draw order (faultplane.py); the report records it for reproduction."""
+    report = run_soak(
+        str(tmp_path),
+        duration_s=2.0,
+        rate=30.0,
+        seed=77,
+        admission_depth=16,
+        namespace_cap=8,
+        nack_delay_s=0.5,
+        faults=True,
+        node_count=4,
+        loadgen_overrides={
+            "submitters": 2,
+            "dispatch": False,
+            "node_churn_period_s": 0.0,
+        },
+    )
+    assert report["seed"] == 77
+    assert report["invariants_ok"], report["invariant_error"]
+    assert report["converged"]
+
+
+@pytest.mark.slow
+def test_soak_sustained_10min(tmp_path):
+    """The acceptance-shaped soak: 10 minutes of sustained overload
+    with node churn, dispatch traffic, background faults, AND a
+    partition/heal cycle. Gates exactly like the bench `soak` config."""
+    report = run_soak(
+        str(tmp_path),
+        duration_s=600.0,
+        rate=200.0,
+        seed=42,
+        admission_depth=96,
+        namespace_cap=48,
+        blocked_cap=96,
+        nack_delay_s=1.0,
+        rpc_rate=40.0,
+        rpc_burst=80.0,
+        use_tpu_worker=True,
+        faults=True,
+        partition_cycle=True,
+        node_count=12,
+        p99_bound_s=30.0,
+        loadgen_overrides={"submitters": 8},
+    )
+    assert report["invariants_ok"], report["invariant_error"]
+    assert report["converged"]
+    assert report["admission_engaged"], report["counters"]
+    assert report["p99_bounded"], report.get("e2e_seconds")
+    assert report["drained"]
+
+
+def test_loadgen_unit_against_single_server(tmp_path):
+    """LoadGen also drives a bare ClusterServer (no ChaosCluster, no
+    faults): the closed loop, pacing, and report plumbing in isolation."""
+    from nomad_tpu.server.cluster import ClusterServer
+
+    cs = ClusterServer("solo", data_dir=str(tmp_path), num_workers=1)
+    cs.start()
+    try:
+        assert chaos.plane is None
+        cfg = LoadGenConfig(
+            rate_eval_per_s=30.0,
+            duration_s=2.0,
+            seed=5,
+            node_count=3,
+            submitters=2,
+            dispatch=True,
+            node_churn_period_s=0.0,
+        )
+        gen = LoadGen(cs, cfg)
+        report = gen.run()
+        assert report["offered"] > 0
+        assert report["accepted"] > 0
+        assert report["failed"] == 0
+        assert report["drained"]
+        # nothing configured => nothing shed or throttled
+        assert report["counters"]["nomad.broker.shed"] == 0
+        assert report["counters"]["nomad.rpc.throttled"] == 0
+        # every job the generator acked exists and is running
+        live = {j.id for j in cs.server.state.jobs() if not j.stop}
+        assert gen.acked_jobs <= live
+    finally:
+        cs.shutdown()
