@@ -244,6 +244,151 @@ def solver_breakdown() -> dict:
     return out
 
 
+def host_attribution_pass(n_nodes, n_jobs, count, constrained,
+                          wall_target_s: float = 2.0,
+                          max_passes: int = 40) -> dict:
+    """Per-config host_attribution block from the always-on profiler
+    (nomad_tpu/hostobs.py) — the SAME machinery production serves at
+    /v1/profile/status and `operator profile status` renders.
+
+    Dedicated un-measured passes (they follow the measured trials and
+    never touch the reported rates): the profiler records for the WHOLE
+    phase — cluster builds included, under span "-"; solve/submit work
+    under the bench.batch/plan.submit spans — because a statistical
+    sampler charges each sample with the full gap since its previous
+    wakeup, and gating recording around sub-windows silently drops
+    every gap that straddles a boundary (measured ~50% attribution
+    loss). Tracing is enabled so every sample carries its active span;
+    passes repeat on fresh clusters until >= wall_target_s of SOLVE
+    wall has accumulated (sampling density for the 15% span-agreement
+    check).
+
+    Publishes:
+      host_fraction     attributed busy seconds / phase wall (all on
+                        the host here; on a real device the block-wait
+                        site is named in top_sites rather than excluded)
+      coverage          fraction of phase wall covered by NAMED (span x
+                        function) sites — the >= 0.8 c2m gate: ledger
+                        overflow into "(other)", sampler starvation, or
+                        idle-misclassified work shows up as lost
+                        coverage
+      gc_share          GC pause seconds / phase wall
+      top_sites         top-10 self-time sites with pct-of-wall (span
+                        "-" = outside any trace, e.g. cluster build)
+      span_agreement    profiler per-span busy seconds vs the traces'
+                        stack-self-times over the SAME passes
+                        (trace.stack_self_times: pre-timed stage spans
+                        excluded — profiling.md § Span semantics), with
+                        agreement_ok on every span carrying >= 20% of
+                        total traced self-time and >= 0.3s absolute
+    """
+    from nomad_tpu import hostobs, trace as _trace
+    from nomad_tpu.scheduler.tpu import ResidentClusterState
+
+    if not hostobs.running():
+        hostobs.start()
+    was_traced = _trace.enabled()
+    _trace.set_enabled(True)
+    rec = _trace.recorder()
+    rec.clear()
+    prof = hostobs.profiler()
+    prev_intervals = (prof.interval_s, prof.idle_interval_s)
+    # dense sampling for the attribution window (2ms, idle backoff
+    # pinned): the spans being checked to 15% need the sample count,
+    # and a burst following a long idle build must not start at the
+    # backed-off rate. Restored to the production cadence after.
+    hostobs.configure(interval_s=0.002, idle_interval_s=0.002)
+    hostobs.reset_stats()
+    solve_wall = 0.0
+    passes = 0
+    t_phase = time.perf_counter()
+    gc.collect()  # once, before the phase: a per-pass collect would
+    # dominate the attribution window with self-inflicted gen2 scans
+    try:
+        h = jobs = None
+        while solve_wall < wall_target_s and passes < max_passes:
+            h = jobs = None  # refcount-drop the previous cluster
+            h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
+            resident = ResidentClusterState()
+            dt, _ = tpu_place(h, jobs, warm=False, resident=resident)
+            solve_wall += dt
+            passes += 1
+        wall = time.perf_counter() - t_phase
+        snap = hostobs.snapshot(top=50)
+        trace_self_ns: dict[str, int] = {}
+        for s in rec.list(name="bench.batch", limit=max_passes):
+            t = rec.get(s["id"])
+            if t is None:
+                continue
+            for span, ns in _trace.stack_self_times(t).items():
+                trace_self_ns[span] = trace_self_ns.get(span, 0) + ns
+    finally:
+        hostobs.configure(
+            interval_s=prev_intervals[0], idle_interval_s=prev_intervals[1]
+        )
+        _trace.set_enabled(was_traced)
+        rec.clear()
+    wall = max(wall, 1e-9)
+    busy = snap["busy_seconds"]
+    other_s = sum(
+        s["seconds"] for s in snap["top_sites"] if s["site"] == "(other)"
+    )
+    named_busy = max(0.0, busy - other_s)
+    prof_spans = snap["spans"]
+    trace_total_s = sum(trace_self_ns.values()) / 1e9
+    agreement = {}
+    agreement_ok = True
+    for span, ns in sorted(trace_self_ns.items(), key=lambda kv: -kv[1]):
+        trace_s = ns / 1e9
+        if trace_s < 0.05 * trace_total_s:
+            continue  # too small for sampling statistics to judge
+        prof_s = prof_spans.get(span, 0.0)
+        ratio = prof_s / max(trace_s, 1e-9)
+        entry = {
+            "trace_s": round(trace_s, 4),
+            "profiler_s": round(prof_s, 4),
+            "ratio": round(ratio, 3),
+        }
+        if trace_s >= max(0.3, 0.2 * trace_total_s):
+            entry["gated"] = True
+            if not (0.85 <= ratio <= 1.15):
+                agreement_ok = False
+        agreement[span] = entry
+    out = {
+        "passes": passes,
+        "wall_s": round(wall, 3),
+        "solve_wall_s": round(solve_wall, 3),
+        "samples": snap["samples"],
+        "host_fraction": round(min(busy / wall, 1.0), 4),
+        "coverage": round(min(named_busy / wall, 1.0), 4),
+        "gc_share": round(
+            snap["gc"]["pause_seconds_total"] / wall, 5
+        ),
+        "gc_collections": snap["gc"]["collections"],
+        "lock_waits": snap["locks"],
+        "top_sites": [
+            {
+                "span": s["span"],
+                "site": s["site"],
+                "seconds": s["seconds"],
+                "pct_of_wall": round(s["seconds"] / wall * 100, 2),
+            }
+            for s in snap["top_sites"]
+            if s["site"] != "(other)"
+        ][:10],
+        "span_agreement": agreement,
+        "span_agreement_ok": agreement_ok,
+        "profiler_overhead_duty_cycle": snap["overhead"]["duty_cycle"],
+    }
+    log(
+        f"[host_attribution] {passes} pass(es) / {wall:.1f}s wall: "
+        f"host_fraction {out['host_fraction']}, coverage "
+        f"{out['coverage']}, gc_share {out['gc_share']}, agreement_ok "
+        f"{agreement_ok} ({ {k: v['ratio'] for k, v in agreement.items()} })"
+    )
+    return out
+
+
 def host_place(h, jobs, config=None, scheduler="service"):
     from nomad_tpu import mock
 
@@ -364,6 +509,21 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample,
     tpu_place(eh, ejobs, warm=False)
     eq_placed, eq_nodes = density(eh, ejobs)
 
+    # BENCH_TRACE summary BEFORE the attribution pass: the pass drains
+    # and clears the global trace recorder for its own span-agreement
+    # bookkeeping, which would otherwise destroy this config's measured
+    # bench.batch traces (main()'s late trace_summary() would read an
+    # empty ring and silently drop the "trace" key)
+    tsum = trace_summary()
+
+    # host-attribution pass: where the host second goes, from the
+    # always-on profiler (un-measured; follows the rate trials)
+    attribution = host_attribution_pass(
+        n_nodes, n_jobs, count, constrained,
+        wall_target_s=2.0 if min_trial_s > 0 else 1.0,
+        max_passes=60,
+    )
+
     host_density = host_placed / max(1, host_nodes)
     eq_density = eq_placed / max(1, eq_nodes)
     ratio = eq_density / max(host_density, 1e-9)
@@ -399,6 +559,8 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample,
         "tpu_solver_internal_s": solve_s,
         "solve_breakdown": breakdown,
         "solver_observability": obs,
+        "host_attribution": attribution,
+        **({"trace": tsum} if tsum is not None else {}),
         "resident_sync_modes": resident_syncs,
         "host_evals_per_s": round(host_rate, 2),
         "host_sample_evals": host_sample,
@@ -1056,6 +1218,13 @@ def main():
             f"this capture CANNOT gate — results are fault-distorted"
         )
     device = _ensure_device()
+    # always-on host profiler: runs through every measured pass (the
+    # production posture — the overhead gate in tests/test_hostobs.py
+    # holds it >= 0.95x unprofiled) and feeds each config's
+    # host_attribution block
+    from nomad_tpu import hostobs as _hostobs
+
+    _hostobs.start()
     if os.environ.get("BENCH_TRACE"):
         # per-batch span emission through the production tracing
         # subsystem (trace.py); each config's critical-path summary
@@ -1083,6 +1252,8 @@ def main():
         # attributable per config (the jit cache itself stays warm —
         # cross-config cache hits are real and correctly counted)
         _solverobs._install(_solverobs.SolverObservatory())
+        # fresh host-profiler ledgers for the same reason
+        _hostobs.reset_stats()
         if name in SERVICE_CONFIGS:
             n_nodes, n_jobs, count, constrained, sample = SERVICE_CONFIGS[name]
             results[name] = run_service_config(
@@ -1140,6 +1311,15 @@ def main():
             gates[f"{cname}_recompile_bound"] = (
                 so["recompiles_after_warmup"] == 0
             )
+        # host-attribution gates (the host-profiling layer's acceptance
+        # criteria): named (span x function) sites must cover >= 80% of
+        # measured host wall on the c2m config, and the profiler's
+        # span-correlated self-times must agree with the traces'
+        # stack-self-times within 15% on every span >= 20% of wall
+        ha = r.get("host_attribution") or {}
+        if cname == "c2m" and "coverage" in ha:
+            gates["c2m_host_coverage"] = ha["coverage"] >= 0.8
+            gates["c2m_span_agreement"] = bool(ha["span_agreement_ok"])
         # soak gates: graceful degradation under the seeded fault
         # schedule — safety invariants hold, e2e p99 stays bounded,
         # and admission control demonstrably engaged (nonzero

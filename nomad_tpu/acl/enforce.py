@@ -137,6 +137,11 @@ _AGENT_READ = [
     # /v1/metrics — read-only, so agent:read, not the pprof-style
     # agent:write
     ("GET", re.compile(r"^/v1/solver/status$")),
+    # host profiler summary + collapsed stacks (hostobs.py): always-on
+    # read surface like /v1/metrics and /v1/solver/status — the raw
+    # on-demand pprof capture stays agent:write + enable_debug, but the
+    # continuous profiler's bounded aggregate is agent:read
+    ("GET", re.compile(r"^/v1/profile(/.*)?$")),
 ]
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)

@@ -195,6 +195,14 @@ class AgentConfig:
     # served at /v1/traces; reloadable via SIGHUP (Agent.reload).
     trace_enabled: bool = False
     trace_buffer: int = 256
+    # continuous host profiling (hostobs.py): ON by default — the whole
+    # point is always-on attribution (the overhead gate holds it under
+    # 5%). telemetry { host_profile = false } opts out;
+    # host_profile_interval tunes the busy sampling period (the sampler
+    # backs off ~10x on its own when the process idles). SIGHUP-
+    # reloadable (Agent.reload).
+    host_profile_enabled: bool = True
+    host_profile_interval_ms: float = 10.0
     # broker stanza (overload protection; SIGHUP-reloadable): the eval
     # broker's delivery/nack knobs were constructor defaults only —
     # first-class config now — plus the admission bounds. broker {
@@ -385,6 +393,18 @@ class Agent:
                 max_traces=self.config.trace_buffer, enabled_=True
             )
             self._trace_owner = True
+        if self.config.host_profile_enabled:
+            # before the server boots so bootstrap cost is attributable;
+            # refcounted process-global singleton (in-process test
+            # clusters share one sampler thread)
+            from .. import hostobs
+
+            hostobs.configure(
+                interval_s=self.config.host_profile_interval_ms / 1e3,
+                flush_interval_s=self.config.telemetry_interval_s or None,
+            )
+            hostobs.start()
+            self._hostobs_started = True
         # telemetry { collection_interval } is also the histogram window
         # width (metrics.py windowed ring): "last window" in /v1/metrics
         # and `operator top` means the last collection interval. Applied
@@ -519,6 +539,29 @@ class Agent:
             old.trace_enabled = new_config.trace_enabled
             old.trace_buffer = new_config.trace_buffer
             changed.append("trace")
+        if (
+            new_config.host_profile_enabled != old.host_profile_enabled
+            or new_config.host_profile_interval_ms
+            != old.host_profile_interval_ms
+        ):
+            from .. import hostobs
+
+            hostobs.configure(
+                interval_s=new_config.host_profile_interval_ms / 1e3
+            )
+            started = getattr(self, "_hostobs_started", False)
+            if new_config.host_profile_enabled and not started:
+                hostobs.start()
+                self._hostobs_started = True
+            elif not new_config.host_profile_enabled and started:
+                # drops THIS agent's refcount; the sampler thread exits
+                # when the last in-process owner lets go (no leaks
+                # across SIGHUP cycles — the racecheck battery asserts)
+                hostobs.stop()
+                self._hostobs_started = False
+            old.host_profile_enabled = new_config.host_profile_enabled
+            old.host_profile_interval_ms = new_config.host_profile_interval_ms
+            changed.append("host_profile")
         broker_keys = (
             "broker_delivery_limit",
             "broker_nack_delay_s",
@@ -562,6 +605,11 @@ class Agent:
         return changed
 
     def shutdown(self) -> None:
+        if getattr(self, "_hostobs_started", False):
+            from .. import hostobs
+
+            hostobs.stop()
+            self._hostobs_started = False
         if getattr(self, "_trace_owner", False):
             # tracing state is process-global (like the metrics registry):
             # only the agent that enabled it turns it back off

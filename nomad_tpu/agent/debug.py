@@ -141,4 +141,12 @@ def debug_bundle(api) -> dict:
     # device memory — one archive now diagnoses a slow solve offline
     grab("solver", lambda: api.agent.solver_status())
     grab("traces", lambda: api.traces.list(limit=50))
+    # host profiler: span-correlated CPU attribution + GC/lock/runtime
+    # telemetry, and the collapsed stacks a flamegraph renders from —
+    # "where does the host second go" answerable from the archive alone
+    grab("profile", lambda: api.agent.profile_status())
+    grab(
+        "profile_stacks",
+        lambda: {"collapsed": api.agent.profile_collapsed()},
+    )
     return bundle

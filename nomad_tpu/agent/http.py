@@ -100,6 +100,12 @@ class HTTPAgentServer:
         # worker thread against a possibly-slow client agent; unbounded,
         # a burst of follow-streams starves every other route.
         self._relay_max = 64
+        # Single-flight guard for /v1/agent/pprof/profile: a wall-clock
+        # capture occupies its handler thread for `seconds`; overlapping
+        # requests coalesce to 429 + Retry-After instead of each eating
+        # a thread (satellite of the host-profiling layer).
+        self._pprof_capture_lock = threading.Lock()
+        self._pprof_busy_until = 0.0
         # /v1/agent/monitor level refcounting (see _serve_monitor)
         self._monitor_lock = threading.Lock()
         self._monitor_levels: list = []
@@ -180,6 +186,7 @@ class HTTPAgentServer:
         "/v1/operator",
         "/v1/traces",
         "/v1/solver",
+        "/v1/profile",
         "/v1/event/stream",
         "/v1/acl",
     )
@@ -1240,7 +1247,30 @@ class HTTPAgentServer:
                 seconds = float(q.get("seconds", ["2"])[0])
             except ValueError:
                 raise HTTPError(400, "seconds must be a number")
-            return {"profile": _debug.cpu_profile(seconds)}
+            # Single-flight: one wall-clock capture occupies a handler
+            # thread for `seconds`; N concurrent captures would occupy N
+            # threads sampling the SAME process for no extra signal.
+            # Overlapping requests 429 with a Retry-After sized to the
+            # in-flight capture's remaining time. (The always-on sampler
+            # at /v1/profile/status never blocks and needs no guard.)
+            # mirror cpu_profile's own clamp so Retry-After is honest
+            clamped = max(0.1, min(seconds, 30.0)) if seconds == seconds else 2.0
+            if not self._pprof_capture_lock.acquire(blocking=False):
+                remaining = self._pprof_busy_until - time.monotonic()
+                raise HTTPError(
+                    429,
+                    "a profile capture is already in progress",
+                    retry_after=max(0.1, remaining),
+                )
+            try:
+                # FIRST thing under the lock: a loser arriving in the
+                # instant between our acquire and this store would read
+                # a stale (expired) deadline and hint Retry-After 0.1s
+                # against a capture that may run 30s
+                self._pprof_busy_until = time.monotonic() + clamped
+                return {"profile": _debug.cpu_profile(seconds)}
+            finally:
+                self._pprof_capture_lock.release()
 
         def pprof_heap(p, q, body, tok):
             from . import debug as _debug
@@ -1319,6 +1349,39 @@ class HTTPAgentServer:
             return out
 
         route("GET", "/v1/solver/status", solver_status)
+
+        def profile_status(p, q, body, tok):
+            # /v1/profile/status: the always-on host profiler's summary
+            # (hostobs.py) — span-correlated CPU self-time sites, GC
+            # pause/collection accounting, lock-wait ledger, runtime
+            # gauges. Same agent:read gate as /v1/metrics; available
+            # even when enable_debug 404s the raw pprof capture
+            # (observability is not a debug mode).
+            from .. import hostobs
+
+            try:
+                top = int(q.get("top", ["50"])[0])
+            except ValueError:
+                raise HTTPError(400, "top must be an integer")
+            return hostobs.snapshot(top=max(1, min(top, 500)))
+
+        def profile_collapsed(p, q, body, tok):
+            # /v1/profile/collapsed: collapsed-stack flamegraph text
+            # ("role;span;frame;...;leaf count" per line) — pipe into
+            # flamegraph.pl / speedscope verbatim (docs/profiling.md).
+            from .. import hostobs
+
+            try:
+                limit = int(q.get("limit", ["0"])[0])
+            except ValueError:
+                raise HTTPError(400, "limit must be an integer")
+            return RawResponse(
+                hostobs.collapsed(limit=max(0, limit)).encode(),
+                "text/plain; charset=utf-8",
+            )
+
+        route("GET", "/v1/profile/status", profile_status)
+        route("GET", "/v1/profile/collapsed", profile_collapsed)
 
         def agent_members(p, q, body, tok):
             return [m.to_wire() for m in self.cluster.serf.members()]

@@ -800,6 +800,21 @@ class AgentAPI(_Resource):
         `operator solver status|top`."""
         return self.c.get("/v1/solver/status")
 
+    def profile_status(self, top: int = 50):
+        """Host profiler summary (/v1/profile/status): span-correlated
+        CPU self-time sites, GC pause/collection telemetry, lock-wait
+        ledger, runtime gauges (nomad_tpu/hostobs.py); rendered by
+        `operator profile status|top`."""
+        return self.c.get("/v1/profile/status", params={"top": top})
+
+    def profile_collapsed(self, limit: int = 0) -> str:
+        """Collapsed-stack flamegraph text (/v1/profile/collapsed)
+        verbatim — feed to flamegraph.pl / speedscope."""
+        resp = self.c.get(
+            "/v1/profile/collapsed", params={"limit": limit}, raw=True
+        )
+        return resp.read().decode()
+
     def self(self):
         return self.c.get("/v1/agent/self")
 

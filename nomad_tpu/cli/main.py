@@ -275,6 +275,12 @@ def _load_agent_config(path: str):
         cfg.trace_enabled = bool(tea.get("trace_enabled", False))
         if "trace_buffer" in tea:
             cfg.trace_buffer = int(tea["trace_buffer"])
+        if "host_profile" in tea:
+            cfg.host_profile_enabled = bool(tea["host_profile"])
+        if "host_profile_interval" in tea:
+            cfg.host_profile_interval_ms = (
+                parse_duration(tea["host_profile_interval"]) * 1e3
+            )
     brb = body.block("broker")
     if brb is not None:
         from ..jobspec.hcl import parse_duration
@@ -357,6 +363,12 @@ def _apply_config_dict(cfg, data: dict) -> None:
             if "collection_interval" in v:
                 cfg.telemetry_interval_s = parse_duration(
                     v["collection_interval"]
+                )
+            if "host_profile" in v:
+                cfg.host_profile_enabled = bool(v["host_profile"])
+            if "host_profile_interval" in v:
+                cfg.host_profile_interval_ms = (
+                    parse_duration(v["host_profile_interval"]) * 1e3
                 )
         elif k == "broker" and isinstance(v, dict):
             from ..jobspec.hcl import parse_duration
@@ -2009,12 +2021,13 @@ _TOP_STAGE_ORDER = [
 ]
 
 
-def _render_top(snap: dict, prev, solver=None) -> str:
+def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
     """One `operator top` frame from a /v1/metrics snapshot. prev is
     (monotonic_time, snapshot) of the previous frame (None on the
     first) — eval throughput is the e2e-count delta between frames,
     falling back to the last window's rate. solver is the optional
-    /v1/solver/status payload feeding the solver panel row."""
+    /v1/solver/status payload feeding the solver panel row; profile the
+    optional /v1/profile/status payload feeding the host row."""
     import time as _time
 
     gauges = snap.get("gauges") or {}
@@ -2118,6 +2131,35 @@ def _render_top(snap: dict, prev, solver=None) -> str:
                 else "   device p95 -"
             )
         )
+    # host-attribution row (always-on profiler, hostobs.py): rendered
+    # only when the profiler has actually attributed something — busy
+    # samples or GC activity (the only-render-when-nonzero pattern the
+    # overload/solver rows follow); a quiet un-profiled agent keeps the
+    # compact layout.
+    if profile is not None:
+        p_busy = profile.get("busy_seconds", 0.0)
+        p_gc = (profile.get("gc") or {}).get("collections") or {}
+        gc_n = sum(p_gc.values())
+        if p_busy or gc_n:
+            window = max(profile.get("window_seconds", 0.0), 1e-9)
+            spans = profile.get("spans") or {}
+            top_span = next(
+                (s for s in spans if s != "-"), None
+            ) or (next(iter(spans), None))
+            gc_tot = (profile.get("gc") or {}).get(
+                "pause_seconds_total", 0.0
+            )
+            lines.append(
+                f"Host        busy {p_busy / window * 100:.1f}%"
+                + (f"   top span {top_span}" if top_span else "")
+                + f"   gc {gc_n} pauses"
+                + (f" ({_fmt_dur(gc_tot)})" if gc_tot else "")
+                + (
+                    f"   rss {_fmt_bytes(profile['runtime']['rss_bytes'])}"
+                    if (profile.get("runtime") or {}).get("rss_bytes")
+                    else ""
+                )
+            )
     lines += [
         "",
         "Stage latencies (cumulative | last window):",
@@ -2172,7 +2214,11 @@ def cmd_operator_top(args) -> int:
                 solver = api.agent.solver_status()
             except Exception:
                 solver = None  # older agent / route unavailable
-            frame = _render_top(snap, prev, solver=solver)
+            try:
+                profile = api.agent.profile_status(top=1)
+            except Exception:
+                profile = None  # older agent / route unavailable
+            frame = _render_top(snap, prev, solver=solver, profile=profile)
             prev = (_time.monotonic(), snap)
             frames += 1
             last = args.once or (args.n and frames >= args.n)
@@ -2427,6 +2473,182 @@ def cmd_operator_solver_top(args) -> int:
             _time.sleep(interval)
     except KeyboardInterrupt:
         return 0
+
+
+def _render_profile_status(snap: dict) -> str:
+    """One `operator profile status` frame from /v1/profile/status."""
+    lines = ["nomad-tpu host profile", ""]
+    samples = snap.get("samples", 0)
+    busy = snap.get("busy_seconds", 0.0)
+    window = max(snap.get("window_seconds", 0.0), 1e-9)
+    overhead = snap.get("overhead") or {}
+    lines.append(
+        f"Sampler     {samples} samples over {window:.0f}s"
+        f"  ({snap.get('interval_ms', 0):.0f}ms interval"
+        f"{'' if snap.get('running') else ', STOPPED'})"
+        f"   busy {busy:.1f}s ({busy / window * 100:.1f}% of window)"
+        f"   overhead {overhead.get('duty_cycle', 0) * 100:.2f}%"
+    )
+    gc_s = snap.get("gc") or {}
+    cols = gc_s.get("collections") or {}
+    lines.append(
+        "GC          "
+        + " ".join(f"{g} {n}" for g, n in sorted(cols.items()))
+        + f"   pauses {_fmt_dur(gc_s.get('pause_seconds_total', 0.0))}"
+        + f" (max {_fmt_dur(gc_s.get('pause_max_s', 0.0))})"
+        + f"   paused sections {gc_s.get('paused_sections', 0)}"
+        + f" ({_fmt_dur(gc_s.get('paused_section_seconds', 0.0))})"
+    )
+    rt = snap.get("runtime") or {}
+    lines.append(
+        f"Runtime     rss {_fmt_bytes(rt.get('rss_bytes'))}"
+        f"   threads {rt.get('threads', 0)}"
+        f"   fds {rt.get('fds', '-')}"
+    )
+    locks = snap.get("locks") or {}
+    hot = [
+        (name, s) for name, s in sorted(locks.items())
+        if s.get("contended")
+    ]
+    if hot:
+        lines.append(
+            "Locks       "
+            + "   ".join(
+                f"{name}: {s['contended']} contended, "
+                f"{_fmt_dur(s['wait_seconds_total'])} waited "
+                f"(max {_fmt_dur(s['max_wait_s'])})"
+                for name, s in hot
+            )
+        )
+    lines.append("")
+    by_role = snap.get("threads") or {}
+    if by_role:
+        busy_roles = {
+            r: s for r, s in by_role.items() if s.get("busy_seconds")
+        }
+        if busy_roles:
+            lines.append(
+                "Busy by role: "
+                + "  ".join(
+                    f"{r} {s['busy_seconds']:.2f}s"
+                    for r, s in sorted(
+                        busy_roles.items(),
+                        key=lambda kv: -kv[1]["busy_seconds"],
+                    )
+                )
+            )
+    sites = snap.get("top_sites") or []
+    rows = [
+        [
+            s["role"],
+            s["span"],
+            s["site"],
+            f"{s['seconds']:.3f}s",
+            f"{s['seconds'] / max(busy, 1e-9) * 100:.1f}%",
+            str(s["samples"]),
+        ]
+        for s in sites[:15]
+    ]
+    if rows:
+        lines.append("")
+        lines.append("Top self-time sites (role x span x function):")
+        lines.append(_fmt_table(
+            rows,
+            ["ROLE", "SPAN", "SITE", "SELF", "OF-BUSY", "SAMPLES"],
+        ))
+    else:
+        lines.append("")
+        lines.append(
+            "No busy samples yet (an idle agent profiles as idle; "
+            "span names appear once tracing is enabled)."
+        )
+    dropped = snap.get("sites_evicted", 0) + snap.get("stacks_dropped", 0)
+    if dropped:
+        lines.append(
+            f"NOTE: bounded ledgers overflowed "
+            f"({snap.get('sites_evicted', 0)} site samples -> (other), "
+            f"{snap.get('stacks_dropped', 0)} stacks dropped)"
+        )
+    return "\n".join(lines)
+
+
+def cmd_operator_profile_status(args) -> int:
+    """Render /v1/profile/status: the always-on host profiler's
+    span-correlated CPU attribution, GC/runtime telemetry, and lock-wait
+    ledger — the triage surface for "where does the host second go"
+    (docs/operations.md)."""
+    import json as _json
+
+    api = _client(args)
+    snap = api.agent.profile_status()
+    if args.as_json:
+        print(_json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    print(_render_profile_status(snap))
+    return 0
+
+
+def cmd_operator_profile_top(args) -> int:
+    """Refresh-loop host-profile dashboard: /v1/profile/status rendered
+    in place, plus busy-rate deltas between frames."""
+    import time as _time
+
+    api = _client(args)
+    interval = max(0.2, float(args.interval))
+    frames = 0
+    prev = None
+    try:
+        while True:
+            snap = api.agent.profile_status()
+            lines = [_render_profile_status(snap)]
+            if prev is not None:
+                prev_t, prev_busy, prev_gc = prev
+                dt = max(_time.monotonic() - prev_t, 1e-9)
+                busy_rate = max(
+                    0.0, snap.get("busy_seconds", 0.0) - prev_busy
+                ) / dt
+                gc_now = (snap.get("gc") or {}).get(
+                    "pause_seconds_total", 0.0
+                )
+                lines.append(
+                    f"\nRates       busy {busy_rate * 100:.1f}% of wall"
+                    f"   gc {_fmt_dur(max(0.0, gc_now - prev_gc))} paused"
+                    f" in {dt:.1f}s"
+                )
+            prev = (
+                _time.monotonic(),
+                snap.get("busy_seconds", 0.0),
+                (snap.get("gc") or {}).get("pause_seconds_total", 0.0),
+            )
+            frames += 1
+            last = args.once or (args.n and frames >= args.n)
+            if not last and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print("\n".join(lines))
+            sys.stdout.flush()
+            if last:
+                return 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_operator_profile_stacks(args) -> int:
+    """Download the collapsed-stack flamegraph text
+    (/v1/profile/collapsed): `role;span;frame;...;leaf count` per line —
+    pipe into flamegraph.pl or load into speedscope as-is."""
+    api = _client(args)
+    text = api.agent.profile_collapsed()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(
+            f"Collapsed stacks written to {args.output} "
+            f"({len(text.splitlines())} unique stacks)"
+        )
+        return 0
+    sys.stdout.write(text)
+    return 0
 
 
 def cmd_event_stream(args) -> int:
@@ -3062,6 +3284,33 @@ def build_parser() -> argparse.ArgumentParser:
     opstp.add_argument("-once", action="store_true",
                        help="render a single frame and exit")
     opstp.set_defaults(fn=cmd_operator_solver_top)
+    opprof = opsub.add_parser(
+        "profile", help="continuous host profiler (/v1/profile/status)"
+    )
+    opprofsub = opprof.add_subparsers(dest="subsubcmd")
+    oppst = opprofsub.add_parser(
+        "status",
+        help="span-correlated CPU self-time, GC/lock/runtime telemetry",
+    )
+    oppst.add_argument("-json", action="store_true", dest="as_json")
+    oppst.set_defaults(fn=cmd_operator_profile_status)
+    opptp = opprofsub.add_parser(
+        "top", help="refresh-loop host-profile dashboard"
+    )
+    opptp.add_argument("-interval", type=float, default=2.0,
+                       help="seconds between refreshes")
+    opptp.add_argument("-n", type=int, default=0,
+                       help="frames to render (0 = until interrupted)")
+    opptp.add_argument("-once", action="store_true",
+                       help="render a single frame and exit")
+    opptp.set_defaults(fn=cmd_operator_profile_top)
+    oppsk = opprofsub.add_parser(
+        "stacks",
+        help="collapsed-stack flamegraph text (/v1/profile/collapsed)",
+    )
+    oppsk.add_argument("-output", default="",
+                       help="write to a file instead of stdout")
+    oppsk.set_defaults(fn=cmd_operator_profile_stacks)
     _args_operator_debug(opsub.add_parser("debug"))
     opsch = opsub.add_parser("scheduler")
     opschsub = opsch.add_subparsers(dest="subsubcmd")

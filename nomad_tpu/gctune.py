@@ -27,11 +27,21 @@ from __future__ import annotations
 
 import gc
 import threading
+import time
 from contextlib import contextmanager
 
 _lock = threading.Lock()
 _depth = 0
 _was_enabled = False
+_section_t0 = 0
+
+# Host-observability hook (nomad_tpu/hostobs.py sets this to its
+# paused-section recorder when the profiler starts): called with the
+# OUTERMOST section's duration in ns on exit. One attribute test when
+# unset — the hot paths pay nothing until a profiler is attached. A
+# long paused section is itself a signal: the re-enable pays one
+# young-gen scan proportional to everything allocated inside it.
+on_section_end = None
 
 
 @contextmanager
@@ -43,19 +53,26 @@ def paused_gc():
     coordinate under a lock: the collector comes back when the LAST
     section exits, and never if the process had it disabled globally.
     """
-    global _depth, _was_enabled
+    global _depth, _was_enabled, _section_t0
     with _lock:
         if _depth == 0:
             _was_enabled = gc.isenabled()
             gc.disable()
+            _section_t0 = time.monotonic_ns()
         _depth += 1
     try:
         yield
     finally:
         with _lock:
             _depth -= 1
-            if _depth == 0 and _was_enabled:
+            last_out = _depth == 0
+            if last_out and _was_enabled:
                 gc.enable()
+            dur_ns = (
+                time.monotonic_ns() - _section_t0 if last_out else 0
+            )
+        if last_out and on_section_end is not None:
+            on_section_end(dur_ns)
 
 
 def freeze_startup_heap() -> None:

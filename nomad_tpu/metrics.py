@@ -242,7 +242,18 @@ class Registry:
         """histograms=False keeps the pre-histogram count/sum sample
         path — the bench comparator the 0.95x throughput gate measures
         the histogram path against (tests/test_metrics.py)."""
-        self._lock = threading.Lock()
+        # Lock-wait-attributed (hostobs.TimedLock): the registry lock is
+        # the process's hottest shared lock — every observe/incr from
+        # every subsystem serializes here. histogram=False is REQUIRED:
+        # recording a wait via metrics.observe would re-acquire this
+        # very lock (self-deadlock); the wait ledger rides the
+        # /v1/profile/status locks table instead. Deferred import:
+        # hostobs is a leaf that lazily imports metrics back.
+        from .hostobs import TimedLock
+
+        self._lock = TimedLock(
+            "metrics_registry", threading.Lock(), histogram=False
+        )
         self._bounds = tuple(bounds)
         self._interval_s = max(0.01, float(interval_s))
         self._ring_len = max(1, int(ring))

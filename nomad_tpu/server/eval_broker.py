@@ -123,7 +123,14 @@ class EvalBroker:
         # Admission knobs (0 = unbounded): see the module docstring.
         self.admission_depth = admission_depth
         self.namespace_cap = namespace_cap
-        self._lock = threading.RLock()
+        # Lock-wait-attributed (hostobs.TimedLock): every enqueue/
+        # dequeue/ack/nack from every worker serializes here — the lock
+        # the "GC-bound vs lock-bound vs materialize-bound" runbook
+        # triage reads first (docs/operations.md). Uncontended cost is
+        # one extra non-blocking try-acquire.
+        from ..hostobs import TimedLock
+
+        self._lock = TimedLock("broker", threading.RLock())
         self._cv = threading.Condition(self._lock)
         self._enabled = False
         # Tombstones for admission-control evictions: ids whose heap

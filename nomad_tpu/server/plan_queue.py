@@ -19,7 +19,13 @@ from ..structs import Plan
 
 class PlanQueue:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # Lock-wait-attributed (hostobs.TimedLock): the solve-stage
+        # enqueue and the applier's dequeue meet here; sustained waits
+        # show up in /v1/profile/status locks and the lock_wait
+        # histogram (docs/profiling.md).
+        from ..hostobs import TimedLock
+
+        self._lock = TimedLock("plan_queue", threading.Lock())
         self._cv = threading.Condition(self._lock)
         self._heap: list = []
         self._counter = itertools.count()

@@ -53,14 +53,17 @@ __all__ = [
     "critical_path",
     "current",
     "enabled",
+    "prune_thread_spans",
     "recorder",
     "self_times",
     "set_current",
     "set_enabled",
     "span",
+    "stack_self_times",
     "stage",
     "stage_attrs",
     "start_trace",
+    "thread_spans",
     "use",
 ]
 
@@ -69,6 +72,32 @@ now_ns = time.monotonic_ns
 # module flag, read without a lock (GIL-atomic; flips are rare operator
 # actions — agent config / SIGHUP reload / tests)
 _enabled = False
+
+# thread ident -> name of that thread's INNERMOST open (stack-parented)
+# span — the host profiler's span-correlation feed (nomad_tpu/hostobs.py
+# attributes each wall-clock sample to thread-role x active span). Plain
+# dict mutated with GIL-atomic single-key stores/pops from the owning
+# thread only; the sampler reads other threads' entries racily, which
+# for a statistical profiler only ever mis-attributes the one sample
+# straddling a span boundary. Detached spans (opened on one thread,
+# ended on another) never touch it — they are not stack-parented and do
+# not represent the opener's current work.
+_thread_spans: dict[int, str] = {}
+
+
+def thread_spans() -> dict[int, str]:
+    """Live thread-ident -> active-span-name map (see above). The dict
+    object is stable for the process lifetime; callers hold the
+    reference and .get() per sample."""
+    return _thread_spans
+
+
+def prune_thread_spans(live_idents) -> None:
+    """Drop entries for dead threads (a thread that exited with a span
+    still open leaks its entry; the host profiler prunes against the
+    idents it actually sampled)."""
+    for tid in [t for t in _thread_spans if t not in live_idents]:
+        _thread_spans.pop(tid, None)
 
 
 def enabled() -> bool:
@@ -259,6 +288,8 @@ class TraceContext:
         self.spans.append(s)
         if not detached:
             self._stack().append(s)
+            # host-profiler span correlation: one GIL-atomic dict store
+            _thread_spans[threading.get_ident()] = name
         return s
 
     def end_span(self, s: Span) -> None:
@@ -268,6 +299,16 @@ class TraceContext:
             st.pop()
         elif s in st:  # out-of-order end (defensive)
             st.remove(s)
+        else:
+            return  # detached span: never on the profiler registry
+        tid = threading.get_ident()
+        if st:
+            _thread_spans[tid] = st[-1].name
+        elif getattr(_tls, "ctx", None) is self:
+            # back to the root: the thread still runs under this trace
+            _thread_spans[tid] = self.name
+        else:
+            _thread_spans.pop(tid, None)
 
     def span(
         self, name: str, parent: Optional[Span] = None, **attrs
@@ -294,8 +335,13 @@ class TraceContext:
     def add_stage(
         self, name: str, dur_ns: int, attrs: Optional[dict] = None
     ) -> Span:
-        """A stage measured as a duration ending now."""
+        """A stage measured as a duration ending now. Marked pretimed:
+        the recording thread's active-span stack never held it, so the
+        host profiler attributed those samples to the ENCLOSING span —
+        :func:`stack_self_times` needs to tell the two apart."""
         end = now_ns()
+        attrs = dict(attrs) if attrs else {}
+        attrs.setdefault("pretimed", 1)
         return self.add_span(
             name, end - max(0, int(dur_ns)), end, attrs=attrs
         )
@@ -504,6 +550,16 @@ def current() -> Optional[TraceContext]:
 def set_current(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
     prev = getattr(_tls, "ctx", None)
     _tls.ctx = ctx
+    # host-profiler span correlation: with no child span open yet, the
+    # thread's work belongs to the trace ROOT (a solve running under
+    # `use(ctx)` before any stage span opens must attribute to
+    # "tpu.batch"/"bench.batch", not "-")
+    tid = threading.get_ident()
+    if ctx is None:
+        _thread_spans.pop(tid, None)
+    else:
+        st = ctx._stack()
+        _thread_spans[tid] = st[-1].name if st else ctx.name
     return prev
 
 
@@ -653,6 +709,24 @@ def self_times(trace: dict) -> dict[str, int]:
     return out
 
 
+def stack_self_times(trace: dict) -> dict[str, int]:
+    """:func:`self_times` over the STACK-PARENTED spans only: pre-timed
+    stage spans (``add_stage`` — host_prep, readback, materialize, the
+    solver.compile/transfer attributions) are dropped before the child-
+    interval subtraction. This is the trace-side quantity comparable to
+    the host profiler's span attribution: a sampler attributes the
+    wall time of a pre-timed stage to the span the recording thread had
+    OPEN (the stage never pushed the stack), so plain self_times — which
+    subtracts the stage from its parent — would disagree with the
+    profiler by exactly the stage's duration (bench span-agreement,
+    docs/profiling.md)."""
+    spans = [
+        s for s in trace.get("spans", ())
+        if not (s.get("attrs") or {}).get("pretimed")
+    ]
+    return self_times({**trace, "spans": spans})
+
+
 def coverage(trace: dict) -> float:
     """Fraction of the root span's wall time covered by the union of its
     direct children — the 'named spans account for X% of wall time'
@@ -713,9 +787,13 @@ def render_tree(trace: dict) -> str:
         self_ms = max(0, (s["end"] - s["start"]) - cover) / 1e6
         branch = "└─ " if last else "├─ "
         extra = ""
-        if s.get("attrs"):
+        shown = {
+            k: v for k, v in (s.get("attrs") or {}).items()
+            if k != "pretimed"  # bookkeeping marker, not operator signal
+        }
+        if shown:
             extra = "  " + " ".join(
-                f"{k}={v}" for k, v in sorted(s["attrs"].items())
+                f"{k}={v}" for k, v in sorted(shown.items())
             )
         lines.append(
             f"{prefix}{branch}{s['name']:<24} {dur:9.3f}ms"

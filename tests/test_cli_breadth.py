@@ -778,6 +778,13 @@ REFERENCE_COMMAND_FLAGS = {
     "operator solver top": {
         "flags": {"-interval", "-n", "-once"}, "args": [],
     },
+    # Round 12 (host-profiling PR): extended 30 -> 33 with the operator
+    # profile family (/v1/profile/status + collapsed-stack download).
+    "operator profile status": {"flags": {"-json"}, "args": []},
+    "operator profile top": {
+        "flags": {"-interval", "-n", "-once"}, "args": [],
+    },
+    "operator profile stacks": {"flags": {"-output"}, "args": []},
     "event stream": {
         "flags": {"-topic", "-index", "-namespace"}, "args": [],
     },
@@ -885,10 +892,10 @@ def test_cli_breadth_vs_reference_command_list():
 
 
 def test_high_traffic_command_flag_sets():
-    """The 30 highest-traffic commands expose exactly the flag surface
+    """The 33 highest-traffic commands expose exactly the flag surface
     the embedded reference registry records — catches both a dropped
     flag and an unreviewed addition (which must be registered here)."""
-    assert len(REFERENCE_COMMAND_FLAGS) >= 30
+    assert len(REFERENCE_COMMAND_FLAGS) >= 33
     for cmd, want in REFERENCE_COMMAND_FLAGS.items():
         flags, args = _command_surface(cmd)
         assert flags == want["flags"], (

@@ -83,6 +83,18 @@ def test_full_stack_is_inversion_free(tmp_path):
         # exactly the cross-thread shape lock-order inversions hide in
         trace.configure(max_traces=64, enabled_=True)
 
+        # host profiler ON under the detector too: the sampler thread
+        # takes its ledger lock against every reader, flushes into the
+        # registry, and the TimedLock wrappers (broker / plan queue /
+        # registry) add their contended-path edges — all of which must
+        # hold the repo's lock discipline. Also asserts clean teardown:
+        # no sampler thread may outlive its stop (the SIGHUP/stop leak
+        # guard).
+        import threading
+        from nomad_tpu import hostobs
+        hostobs.configure(interval_s=0.002)
+        hostobs.start()
+
         server = Server(num_workers=2)
         server.establish_leadership()
         client = Client(ServerRPC(server), data_dir=%r)
@@ -114,6 +126,16 @@ def test_full_stack_is_inversion_free(tmp_path):
         time.sleep(1.0)
         client.shutdown()
         server.shutdown()
+        if hostobs.snapshot()["samples"] <= 0:
+            raise SystemExit("profiler sampled nothing under the detector")
+        hostobs.stop()
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+            t.name == "host-profiler" for t in threading.enumerate()
+        ):
+            time.sleep(0.05)
+        if any(t.name == "host-profiler" for t in threading.enumerate()):
+            raise SystemExit("sampler thread leaked past stop()")
         if not trace.recorder().list(name="eval"):
             raise SystemExit("tracing produced no eval traces")
         vs = racecheck.violations()
