@@ -386,6 +386,13 @@ class Agent:
             )
 
     def start(self) -> None:
+        # Resolve the native wire codec before any lock exists to pack
+        # under (nomad-vet NV-lock-blocking: the lazy first pack() can
+        # otherwise compile the extension while holding the raft /
+        # store / RPC-write lock).
+        from .. import codec
+
+        codec.warm_native()
         if self.config.trace_enabled:
             from .. import trace
 

@@ -1,0 +1,23 @@
+"""nomad-vet: AST-level concurrency & layering analyzer.
+
+The reference keeps its Go control plane honest with ``go vet`` and
+``go test -race`` in CI; this package is the Python rebuild's analog.
+It walks the production tree with ``ast`` (stdlib-only, like
+faultplane/solverobs) and enforces the repo's real invariants as named
+rules — see rules.py for the catalogue and docs/static-analysis.md for
+how to read a finding.
+
+CI gate: zero unsuppressed findings (tests/test_analysis.py). Accepted
+findings live in analysis/baseline.toml, each with a one-line reason;
+stale entries fail the gate too. Operators run the same engine via
+``nomad-tpu operator vet [-json] [-rule ...]``.
+"""
+
+from .engine import (DEFAULT_BASELINE, REPO_ROOT, VetReport,
+                     dynamic_edges_from_json, load_baseline, run_vet)
+from .rules import GATE_RULES, Finding
+
+__all__ = [
+    "DEFAULT_BASELINE", "Finding", "GATE_RULES", "REPO_ROOT",
+    "VetReport", "dynamic_edges_from_json", "load_baseline", "run_vet",
+]

@@ -1,0 +1,291 @@
+"""nomad-vet engine: walk → rules → baseline ledger → report.
+
+The CI contract is ZERO unsuppressed findings: a true-but-accepted
+finding lives in ``analysis/baseline.toml`` with a one-line reason, and
+a suppression that no longer matches anything is itself an error (the
+code it excused was fixed or moved — the ledger must shrink with it).
+Advisories (dynamic-coverage gaps from the NV-lock-order cross-check)
+inform but never gate.
+
+Stdlib-only, like the other leaf tooling: tomllib for the ledger, ast
+for the walk. The full production tree analyzes in well under the 10s
+tier-1 budget (one parse pass + two fixpoints).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+try:
+    import tomllib as _toml
+except ImportError:  # pragma: no cover - pre-3.11 interpreters
+    _toml = None
+
+from .model import build_index
+from .rules import (Finding, GATE_RULES, Resolver, check_except,
+                    check_layering, check_literals, check_lock_blocking,
+                    check_lock_order, check_threads, static_edges)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.toml")
+
+
+@dataclass
+class Suppression:
+    rule: str
+    key: str
+    reason: str
+    matched: int = 0
+
+
+@dataclass
+class VetReport:
+    findings: list = field(default_factory=list)    # unsuppressed, gate
+    advisories: list = field(default_factory=list)  # never gate
+    suppressed: list = field(default_factory=list)  # (Finding, Suppression)
+    stale: list = field(default_factory=list)       # Suppression, gate
+    errors: list = field(default_factory=list)      # ledger/engine defects, gate
+    modules: int = 0
+    locks: int = 0
+    edges: int = 0
+
+    @property
+    def gate_count(self) -> int:
+        return len(self.findings) + len(self.stale) + len(self.errors)
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "advisories": [f.to_dict() for f in self.advisories],
+            "suppressed": [
+                {"finding": f.to_dict(), "reason": s.reason}
+                for f, s in self.suppressed
+            ],
+            "stale_suppressions": [
+                {"rule": s.rule, "key": s.key, "reason": s.reason}
+                for s in self.stale
+            ],
+            "errors": list(self.errors),
+            "summary": {
+                "modules": self.modules, "locks": self.locks,
+                "static_edges": self.edges,
+                "gate_count": self.gate_count,
+                "suppressed": len(self.suppressed),
+            },
+        }
+
+    def render(self, advisories: bool = False) -> str:
+        out = []
+        for f in self.findings:
+            out.append(f"{f.rule} {f.file}:{f.line}\n    {f.message}")
+            for hop in f.chain:
+                out.append(f"      -> {hop}")
+            out.append(f"    key: {f.key}")
+        for s in self.stale:
+            out.append(
+                f"NV-stale-suppression {s.rule} key={s.key}\n"
+                f"    suppression matches no current finding — the "
+                f"code it excused changed; delete the ledger entry "
+                f"(reason was: {s.reason})")
+        for e in self.errors:
+            out.append(f"NV-error {e}")
+        if advisories:
+            for f in self.advisories:
+                out.append(
+                    f"advisory {f.rule} {f.file}:{f.line}\n"
+                    f"    {f.message}")
+        out.append(
+            f"nomad-vet: {self.modules} modules, {self.locks} lock "
+            f"classes, {self.edges} static lock edges; "
+            f"{self.gate_count} unsuppressed finding(s), "
+            f"{len(self.suppressed)} baselined, "
+            f"{len(self.advisories)} advisory")
+        return "\n".join(out)
+
+
+def load_baseline(path: str) -> tuple:
+    """(suppressions, errors). Every entry needs rule, key and a
+    nonempty one-line reason — an unjustified suppression is a ledger
+    defect, not a suppression."""
+    sups: list = []
+    errors: list = []
+    if not os.path.exists(path):
+        return sups, errors
+    if _toml is not None:
+        with open(path, "rb") as fh:
+            data = _toml.load(fh)
+    else:
+        data = _parse_suppress_toml(
+            open(path, encoding="utf-8").read())
+    for i, entry in enumerate(data.get("suppress", [])):
+        rule = entry.get("rule", "")
+        key = entry.get("key", "")
+        reason = str(entry.get("reason", "")).strip()
+        if not rule or not key:
+            errors.append(
+                f"{os.path.basename(path)} entry #{i + 1}: rule and "
+                f"key are required")
+            continue
+        if not reason or "\n" in reason:
+            errors.append(
+                f"{os.path.basename(path)} {rule} {key}: a one-line "
+                f"reason is required")
+            continue
+        if rule not in GATE_RULES:
+            errors.append(
+                f"{os.path.basename(path)} entry #{i + 1}: unknown "
+                f"rule {rule!r}")
+            continue
+        sups.append(Suppression(rule, key, reason))
+    return sups, errors
+
+
+def _parse_suppress_toml(text: str) -> dict:
+    """Minimal reader for the ledger's TOML subset — ``[[suppress]]``
+    array-of-tables with double-quoted string pairs and # comments —
+    used only where the interpreter predates stdlib tomllib. The
+    format is enforced by load_baseline's validation either way."""
+    entries: list = []
+    cur = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            cur = {}
+            entries.append(cur)
+            continue
+        # the value group stops at the first unescaped quote — a greedy
+        # `"(.*)"` ran through quotes inside a trailing comment and
+        # corrupted the key/reason (this parser is LIVE on pre-3.11
+        # interpreters, tomllib handles these lines fine)
+        m = re.match(
+            r'^([A-Za-z0-9_-]+)\s*=\s*"((?:[^"\\]|\\.)*)"\s*(#.*)?$',
+            line)
+        if m and cur is not None:
+            cur[m.group(1)] = m.group(2).replace('\\"', '"')
+            continue
+        raise ValueError(
+            f"baseline.toml line {lineno}: unsupported syntax "
+            f"{line!r} (this reader handles [[suppress]] tables of "
+            f'key = "value" pairs)')
+    return {"suppress": entries}
+
+
+def _doc_metric_names(root: str) -> list:
+    path = os.path.join(root, "docs", "metrics.md")
+    if not os.path.exists(path):
+        return []
+    doc = open(path, encoding="utf-8").read()
+    return re.findall(r"^\| `([^`]+)` \|", doc, re.M)
+
+
+def _doc_span_names(root: str) -> set:
+    """First-column backticked table cells in docs/tracing.md — the
+    span-catalogue rows (the trace-name table's rows are valid root
+    names too). Prose backticks (attr names, env knobs, file names) do
+    NOT catalogue a span: the contract is an explicit table row, same
+    as _doc_metric_names' anchor on the metrics.md catalogue."""
+    path = os.path.join(root, "docs", "tracing.md")
+    if not os.path.exists(path):
+        return set()
+    doc = open(path, encoding="utf-8").read()
+    return set(re.findall(r"^\| `([^`]+)` \|", doc, re.M))
+
+
+def run_vet(root: str = REPO_ROOT, package: str = "nomad_tpu",
+            rules=None, baseline_path: str = None,
+            dynamic_edges=None) -> VetReport:
+    """Run the analyzer. ``rules`` narrows to a subset of GATE_RULES;
+    ``dynamic_edges`` is the racecheck ``edges()`` export (a list of
+    {"from","to"} dicts) enabling the NV-lock-order cross-check."""
+    wanted = set(rules) if rules else set(GATE_RULES)
+    unknown = wanted - set(GATE_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {sorted(unknown)}")
+    if baseline_path is None:
+        baseline_path = DEFAULT_BASELINE if root == REPO_ROOT else ""
+    elif baseline_path and not os.path.exists(baseline_path):
+        # an explicitly requested ledger that isn't there is an error,
+        # not an empty ledger: a typo'd -baseline path would otherwise
+        # surface every baselined finding as confusing gate noise
+        raise ValueError(f"baseline ledger not found: {baseline_path}")
+
+    index = build_index(root, package,
+                        testing_prefix=f"{package}/testing")
+    report = VetReport()
+    report.modules = len(index.modules)
+    report.locks = len(index.locks)
+    # the blocking/acquire fixpoint and the static edge graph are the
+    # dominant cost of the walk — only the two lock rules consume them
+    edges: dict = {}
+    resolver = None
+    if wanted & {"NV-lock-blocking", "NV-lock-order"}:
+        resolver = Resolver(index)
+        edges = static_edges(index, resolver)
+        if not resolver.converged:
+            # a capped fixpoint silently drops chains deeper than the
+            # pass bound — that would let the gate report "zero
+            # findings" over code it never finished analyzing
+            report.errors.append(
+                "call-graph fixpoint hit its pass cap before "
+                "converging — lock rules may be incomplete; raise the "
+                "bound in analysis/rules.py Resolver._fixpoint")
+    report.edges = len(edges)
+
+    found: list = []
+    if "NV-lock-blocking" in wanted:
+        found += check_lock_blocking(index, resolver)
+    if "NV-lock-order" in wanted:
+        found += check_lock_order(index, resolver, dynamic_edges,
+                                  edges=edges)
+    if "NV-layering" in wanted:
+        found += check_layering(index, package)
+    if "NV-except" in wanted:
+        found += check_except(index)
+    if "NV-thread" in wanted:
+        found += check_threads(index)
+    if "NV-literal" in wanted:
+        found += check_literals(
+            index, _doc_metric_names(root), _doc_span_names(root))
+
+    sups, errors = ([], [])
+    if baseline_path:
+        sups, errors = load_baseline(baseline_path)
+    report.errors.extend(errors)
+    by_key = {(s.rule, s.key): s for s in sups}
+    for f in sorted(found, key=lambda f: (f.file, f.line, f.rule)):
+        if f.advisory:
+            report.advisories.append(f)
+            continue
+        s = by_key.get((f.rule, f.key))
+        if s is not None:
+            s.matched += 1
+            report.suppressed.append((f, s))
+        else:
+            report.findings.append(f)
+    # stale check only makes sense against the full rule set — a
+    # narrowed -rule run must not brand the other rules' entries stale
+    if wanted == set(GATE_RULES):
+        report.stale = [s for s in sups if s.matched == 0]
+    return report
+
+
+def dynamic_edges_from_json(text: str) -> list:
+    """Parse the racecheck edges() export (either the bare edge list or
+    the full {"edges": [...], "violations": [...]} document)."""
+    data = json.loads(text)
+    if isinstance(data, dict):
+        data = data.get("edges", [])
+    out = []
+    for e in data:
+        if not isinstance(e, dict) or "from" not in e or "to" not in e:
+            raise ValueError(
+                f"dynamic-edges entries need 'from' and 'to' keys "
+                f"(racecheck edges() / export_json() format): {e!r}")
+        out.append({"from": e["from"], "to": e["to"]})
+    return out

@@ -1,0 +1,618 @@
+"""AST module index for nomad-vet (the static analyzer package).
+
+One parse pass over the production tree builds everything every rule
+needs, so the full walk stays well under the 10s CI budget:
+
+  * per-module import records (module-scope vs lazy) and an alias map
+    (local name -> dotted fullname) used to resolve call targets;
+  * per-class lock definitions — ``self._lock = threading.Lock()``,
+    ``TimedLock("broker", threading.RLock())``, ``threading.Condition``
+    over either — keyed by the ALLOCATION SITE of the underlying
+    primitive ctor (``relpath:lineno``), the same class key the dynamic
+    lock-order detector (testing/racecheck.py) derives at runtime, so
+    static and dynamic edge sets cross-check by equality;
+  * per-function call sites annotated with the lock tokens HELD at the
+    call (``with self._lock:`` regions, nested and multi-item), plus
+    direct lock acquisitions with the held-before set — the raw
+    material for NV-lock-blocking and NV-lock-order;
+  * thread/event/condition attribute tracking for NV-thread and the
+    Condition-wait exemption (waiting on a cv RELEASES its own lock,
+    so it only blocks locks held OUTSIDE it).
+
+The model is deliberately syntactic: ``self.X = PlanQueue()`` types the
+attribute for per-module (and imported-class) method resolution, and
+anything it cannot resolve falls through to a curated method-name sink
+table in rules.py. False negatives cost coverage; the rules are tuned
+so false positives stay small enough for a reviewed baseline ledger.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ImportRecord:
+    fullname: str        # resolved dotted target ("nomad_tpu.metrics")
+    lineno: int
+    module_scope: bool   # executed at import time (not under a def)
+
+
+@dataclass
+class LockDef:
+    token: str           # "relpath:lineno" of the primitive ctor call
+    kind: str            # "lock" | "rlock" | "condition" | "event"
+    owner: str           # class name, "" for module-level
+    attr: str            # attribute / global name
+    name: str            # display label, e.g. "EvalBroker._lock"
+    role: str = ""       # TimedLock("broker", ...) label when present
+    wraps: Optional[str] = None  # condition: attr of the wrapped lock
+
+
+@dataclass
+class CallSite:
+    lineno: int
+    held: tuple          # lock tokens held at the call, outermost first
+    target: tuple        # ("name", f) | ("var", root, meth) |
+    #                      ("dotted", "a.b.c") | ("self", meth) |
+    #                      ("selfattr", attr, meth) | ("expr", meth)
+
+
+@dataclass
+class FuncInfo:
+    module: "ModuleInfo"
+    cls: Optional[str]   # enclosing class name, None for module level
+    qual: str            # "Class.meth", "func", "Class.meth.<locals>.f"
+    name: str
+    lineno: int
+    node: ast.AST = None
+    calls: list = field(default_factory=list)      # [CallSite]
+    acquires: list = field(default_factory=list)   # [(token, lineno, held_before)]
+    var_types: dict = field(default_factory=dict)  # local -> class fullname
+    thread_vars: set = field(default_factory=set)  # locals = threading.Thread(...)
+
+    @property
+    def key(self) -> tuple:
+        return (self.module.relpath, self.qual)
+
+
+@dataclass
+class ClassInfo:
+    module: "ModuleInfo"
+    name: str
+    lineno: int
+    bases: list = field(default_factory=list)   # alias-resolved dotted names
+    locks: dict = field(default_factory=dict)   # attr -> LockDef
+    events: set = field(default_factory=set)
+    threads: dict = field(default_factory=dict)  # attr -> ctor ast.Call
+    attr_types: dict = field(default_factory=dict)  # attr -> class fullname
+    methods: dict = field(default_factory=dict)  # name -> FuncInfo
+
+    @property
+    def fullname(self) -> str:
+        return f"{self.module.modname}.{self.name}"
+
+
+@dataclass
+class ModuleInfo:
+    relpath: str         # posix path relative to the analysis root
+    modname: str         # dotted module name ("nomad_tpu.server.worker")
+    tree: ast.AST
+    is_testing: bool
+    path: str = ""
+    imports: list = field(default_factory=list)     # [ImportRecord]
+    aliases: dict = field(default_factory=dict)     # local -> dotted full
+    classes: dict = field(default_factory=dict)     # name -> ClassInfo
+    functions: dict = field(default_factory=dict)   # module-level name -> FuncInfo
+    all_funcs: list = field(default_factory=list)   # every FuncInfo
+    module_locks: dict = field(default_factory=dict)  # global name -> LockDef
+
+
+class Index:
+    """All parsed modules plus the cross-module resolution tables."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}          # by relpath
+        self.by_modname: dict[str, ModuleInfo] = {}
+        self.funcs: dict[tuple, FuncInfo] = {}            # (relpath, qual)
+        self.classes: dict[str, ClassInfo] = {}           # by fullname
+        self.locks: dict[str, LockDef] = {}               # by token
+
+    def repo_function(self, fullname: str) -> Optional[FuncInfo]:
+        """Resolve "pkg.mod.func" to a module-level FuncInfo."""
+        modname, _, fn = fullname.rpartition(".")
+        mod = self.by_modname.get(modname)
+        if mod is not None:
+            return mod.functions.get(fn)
+        return None
+
+    def method(self, class_fullname: str, meth: str,
+               _depth: int = 0) -> Optional[FuncInfo]:
+        """Resolve a method through the (repo-local) base-class chain."""
+        cls = self.classes.get(class_fullname)
+        if cls is None or _depth > 8:
+            return None
+        if meth in cls.methods:
+            return cls.methods[meth]
+        for base in cls.bases:
+            got = self.method(base, meth, _depth + 1)
+            if got is not None:
+                return got
+        return None
+
+
+# ---------------------------------------------------------------------------
+# parsing helpers
+# ---------------------------------------------------------------------------
+
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+def iter_scope(fnode: ast.AST):
+    """Yield every node in a function's OWN scope — unlike ast.walk,
+    nested defs/lambdas/classes are not descended into (they are
+    indexed as their own functions; descending would double-report
+    their contents under the enclosing scope)."""
+    work = list(ast.iter_child_nodes(fnode))
+    while work:
+        node = work.pop()
+        yield node
+        if not isinstance(node, _SCOPE_BARRIERS):
+            work.extend(ast.iter_child_nodes(node))
+
+
+_STMT_LIST_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+
+def iter_scope_stmts(fnode: ast.AST):
+    """iter_scope restricted to statement lists — for rules that only
+    look at statement-position nodes (except handlers)."""
+    work = [fnode]
+    while work:
+        node = work.pop()
+        yield node
+        if isinstance(node, _SCOPE_BARRIERS) and node is not fnode:
+            continue
+        for f in _STMT_LIST_FIELDS:
+            sub = getattr(node, f, None)
+            if isinstance(sub, list):
+                work.extend(sub)
+
+
+def _attr_chain(e: ast.AST) -> Optional[tuple]:
+    """(root_name, [attrs...]) for a Name-rooted attribute chain."""
+    parts: list = []
+    cur = e
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    parts.reverse()
+    if isinstance(cur, ast.Name):
+        return cur.id, parts
+    return None
+
+
+def _call_target(func_expr: ast.AST) -> tuple:
+    if isinstance(func_expr, ast.Name):
+        return ("name", func_expr.id)
+    if isinstance(func_expr, ast.Attribute):
+        chain = _attr_chain(func_expr)
+        if chain is None:
+            return ("expr", func_expr.attr)
+        root, parts = chain
+        if root == "self":
+            if len(parts) == 1:
+                return ("self", parts[0])
+            if len(parts) == 2:
+                return ("selfattr", parts[0], parts[1])
+            return ("expr", parts[-1])
+        if len(parts) == 1:
+            return ("var", root, parts[0])
+        return ("dotted", root + "." + ".".join(parts))
+    return ("expr", "")
+
+
+def resolve_name(module: ModuleInfo, dotted: str) -> str:
+    """Expand the root of a dotted name through the module's imports."""
+    root, _, rest = dotted.partition(".")
+    full = module.aliases.get(root)
+    if full is None:
+        return dotted
+    return full + ("." + rest if rest else "")
+
+
+def _callable_fullname(module: ModuleInfo, call: ast.Call) -> str:
+    t = _call_target(call.func)
+    if t[0] == "name":
+        return module.aliases.get(t[1], t[1])
+    if t[0] in ("var", "dotted"):
+        dotted = t[1] + "." + t[2] if t[0] == "var" else t[1]
+        return resolve_name(module, dotted)
+    return ""
+
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "_thread.allocate_lock": "lock",
+}
+
+
+def _lock_ctor(module: ModuleInfo, expr: ast.AST):
+    """(kind, ctor_lineno, role, wraps_attr) for lock-ish ctor exprs.
+
+    ``TimedLock(name, inner)`` (hostobs) unwraps to the inner primitive:
+    both the lineno (racecheck keys classes by the line the REAL
+    Lock()/RLock() factory ran on) and the kind come from the inner
+    ctor, while the TimedLock label becomes the lock's role.
+    """
+    if not isinstance(expr, ast.Call):
+        return None
+    full = _callable_fullname(module, expr)
+    if full in _LOCK_CTORS:
+        return (_LOCK_CTORS[full], expr.lineno, "", None)
+    if full == "threading.Event":
+        return ("event", expr.lineno, "", None)
+    if full.endswith(".TimedLock") or full == "TimedLock":
+        role = ""
+        if expr.args and isinstance(expr.args[0], ast.Constant) and \
+                isinstance(expr.args[0].value, str):
+            role = expr.args[0].value
+        if len(expr.args) > 1:
+            inner = _lock_ctor(module, expr.args[1])
+            if inner is not None:
+                return (inner[0], inner[1], role, None)
+        return ("lock", expr.lineno, role, None)
+    if full == "threading.Condition":
+        if expr.args:
+            arg = expr.args[0]
+            chain = _attr_chain(arg) if isinstance(arg, ast.Attribute) else None
+            if chain is not None and chain[0] == "self" and len(chain[1]) == 1:
+                return ("condition", expr.lineno, "", chain[1][0])
+            inner = _lock_ctor(module, arg)
+            if inner is not None:
+                return ("condition", inner[1], inner[2], None)
+        return ("condition", expr.lineno, "", None)
+    return None
+
+
+def _relative_base(modname: str, is_pkg: bool, level: int) -> str:
+    parts = modname.split(".")
+    if not is_pkg:
+        parts = parts[:-1]
+    drop = level - 1
+    if drop:
+        parts = parts[:-drop] if drop < len(parts) else []
+    return ".".join(parts)
+
+
+class _ImportScanner:
+    """Collect ImportRecords + the alias map, tracking def-nesting so
+    module-scope (eager) imports are distinguished from lazy ones.
+    Imports only occur in statement position, so expression subtrees
+    are never entered."""
+
+    def __init__(self, module: ModuleInfo, is_pkg: bool) -> None:
+        self.m = module
+        self.is_pkg = is_pkg
+
+    def scan(self) -> None:
+        work = [(n, True) for n in self.m.tree.body]
+        while work:
+            node, mscope = work.pop()
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.m.imports.append(
+                        ImportRecord(alias.name, node.lineno, mscope))
+                    if alias.asname:
+                        self.m.aliases[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        self.m.aliases.setdefault(root, root)
+                continue
+            if isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = _relative_base(
+                        self.m.modname, self.is_pkg, node.level)
+                    base = (f"{base}.{node.module}"
+                            if node.module else base)
+                else:
+                    base = node.module or ""
+                for alias in node.names:
+                    full = f"{base}.{alias.name}" if base else alias.name
+                    self.m.imports.append(
+                        ImportRecord(full, node.lineno, mscope))
+                    self.m.aliases[alias.asname or alias.name] = full
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                work.extend((n, False) for n in node.body)
+                continue
+            if isinstance(node, ast.If) and _is_type_checking(node.test):
+                # `if TYPE_CHECKING:` bodies never execute — not eager
+                work.extend((n, False) for n in node.body)
+                work.extend((n, mscope) for n in node.orelse)
+                continue
+            for f in _STMT_LIST_FIELDS:
+                sub = getattr(node, f, None)
+                if isinstance(sub, list):
+                    work.extend((n, mscope) for n in sub)
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    chain = None
+    if isinstance(test, ast.Name):
+        chain = test.id
+    elif isinstance(test, ast.Attribute) and isinstance(test.value, ast.Name):
+        chain = test.attr
+    return chain == "TYPE_CHECKING"
+
+
+# ---------------------------------------------------------------------------
+# pass A: classes, locks, functions
+# ---------------------------------------------------------------------------
+
+
+def _scan_class(module: ModuleInfo, node: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(module, node.name, node.lineno)
+    for b in node.bases:
+        chain = _attr_chain(b) if isinstance(b, ast.Attribute) else None
+        if isinstance(b, ast.Name):
+            info.bases.append(resolve_name(module, b.id))
+        elif chain is not None:
+            info.bases.append(
+                resolve_name(module, chain[0] + "." + ".".join(chain[1])))
+    # Descend into methods (self.X = Lock() lives in __init__) but NOT
+    # into nested ClassDefs: a nested handler class's `self.*` refers
+    # to ITS instances — ast.walk attributed those locks/threads/attrs
+    # to the enclosing class, giving `with self._lock:` in the outer
+    # class a wrong LockDef identity.
+    assigns = []
+    work = list(ast.iter_child_nodes(node))
+    while work:
+        n = work.pop()
+        if isinstance(n, ast.ClassDef):
+            continue
+        if isinstance(n, ast.Assign):
+            assigns.append(n)
+        work.extend(ast.iter_child_nodes(n))
+    for assign in assigns:
+        if len(assign.targets) != 1:
+            continue
+        tgt = assign.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        attr = tgt.attr
+        lock = _lock_ctor(module, assign.value)
+        if lock is not None:
+            kind, lineno, role, wraps = lock
+            token = f"{module.relpath}:{lineno}"
+            if kind == "event":
+                info.events.add(attr)
+                continue
+            info.locks[attr] = LockDef(
+                token, kind, node.name, attr,
+                f"{node.name}.{attr}", role, wraps)
+            continue
+        if isinstance(assign.value, ast.Call):
+            full = _callable_fullname(module, assign.value)
+            if full in ("threading.Thread", "threading.Timer"):
+                info.threads[attr] = assign.value
+                continue
+            if full:
+                # type the attribute by its ctor; resolution later only
+                # hits when the name indexes a repo class, so typing
+                # `self.x = dict()` costs nothing
+                info.attr_types.setdefault(
+                    attr,
+                    full if "." in full else f"{module.modname}.{full}")
+    return info
+
+
+# ---------------------------------------------------------------------------
+# pass B: per-function body walk (held-lock tracking)
+# ---------------------------------------------------------------------------
+
+
+class _BodyWalker:
+    def __init__(self, index: Index, module: ModuleInfo,
+                 cls: Optional[ClassInfo], func: FuncInfo) -> None:
+        self.index = index
+        self.m = module
+        self.cls = cls
+        self.f = func
+        self.held: list = []
+
+    def run(self) -> None:
+        self._prescan(self.f.node)
+        for stmt in self.f.node.body:
+            self._visit(stmt)
+
+    def _prescan(self, fnode) -> None:
+        """Type obvious locals: x = Ctor(...) and t = threading.Thread."""
+        for node in iter_scope(fnode):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                name = node.targets[0].id
+                full = _callable_fullname(self.m, node.value)
+                if full in ("threading.Thread", "threading.Timer"):
+                    self.f.thread_vars.add(name)
+                elif full:
+                    self.f.var_types.setdefault(
+                        name,
+                        full if "." in full
+                        else f"{self.m.modname}.{full}")
+
+    def _lock_token(self, expr: ast.AST) -> Optional[str]:
+        """Lock token for a with-item context expression, or None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and self.cls is not None:
+            ld = self.cls.locks.get(expr.attr)
+            if ld is None:
+                return None
+            if ld.kind == "condition" and ld.wraps:
+                wrapped = self.cls.locks.get(ld.wraps)
+                if wrapped is not None:
+                    return wrapped.token
+            return ld.token
+        if isinstance(expr, ast.Name):
+            ld = self.m.module_locks.get(expr.id)
+            if ld is not None:
+                return ld.token
+        return None
+
+    def _visit(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs walk as their own functions, held reset
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: list = []
+            for item in node.items:
+                self._visit(item.context_expr)
+                tok = self._lock_token(item.context_expr)
+                if tok is not None:
+                    if tok not in self.held:  # reentrant RLock: no edge
+                        self.f.acquires.append(
+                            (tok, item.context_expr.lineno,
+                             tuple(self.held)))
+                    acquired.append(tok)
+                    self.held.append(tok)
+            for stmt in node.body:
+                self._visit(stmt)
+            for _ in acquired:
+                self.held.pop()
+            return
+        if isinstance(node, ast.Call):
+            self.f.calls.append(CallSite(
+                node.lineno, tuple(self.held), _call_target(node.func)))
+            # the receiver chain itself may contain calls (a().b())
+            for child in ast.iter_child_nodes(node.func):
+                self._visit(child)
+            for arg in node.args:
+                self._visit(arg)
+            for kw in node.keywords:
+                self._visit(kw.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+
+# ---------------------------------------------------------------------------
+# tree walk
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(pkg_dir: str):
+    for dirpath, dirs, files in os.walk(pkg_dir):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                yield os.path.join(dirpath, fname)
+
+
+def build_index(root: str, package: str = "nomad_tpu",
+                testing_prefix: str = "nomad_tpu/testing") -> Index:
+    """Parse every module under ``root/package`` into an Index."""
+    index = Index()
+    pkg_dir = os.path.join(root, package)
+    for path in _iter_py_files(pkg_dir):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        parts = rel[:-3].split("/")
+        is_pkg = parts[-1] == "__init__"
+        if is_pkg:
+            parts = parts[:-1]
+        modname = ".".join(parts)
+        try:
+            tree = ast.parse(open(path, encoding="utf-8").read(),
+                             filename=path)
+        except SyntaxError as e:  # pragma: no cover - tree must parse
+            raise RuntimeError(f"nomad-vet: cannot parse {rel}: {e}")
+        m = ModuleInfo(
+            rel, modname, tree,
+            rel == testing_prefix + ".py"
+            or rel.startswith(testing_prefix + "/"),
+            path=path)
+        _ImportScanner(m, is_pkg).scan()
+        index.modules[rel] = m
+        index.by_modname[modname] = m
+
+    # pass A: classes, locks, function shells
+    for m in index.modules.values():
+        for node in m.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = _scan_class(m, node)
+                m.classes[node.name] = cls
+                index.classes[cls.fullname] = cls
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                lock = _lock_ctor(m, node.value)
+                if lock is not None and lock[0] != "event":
+                    kind, lineno, role, wraps = lock
+                    name = node.targets[0].id
+                    m.module_locks[name] = LockDef(
+                        f"{m.relpath}:{lineno}", kind, "", name,
+                        f"{m.modname.split('.')[-1]}.{name}", role, wraps)
+        _collect_funcs(index, m)
+
+    for m in index.modules.values():
+        for lock in m.module_locks.values():
+            index.locks[lock.token] = lock
+        for cls in m.classes.values():
+            for lock in cls.locks.values():
+                index.locks.setdefault(lock.token, lock)
+
+    # pass B: body walks with held-lock tracking
+    for m in index.modules.values():
+        for f in m.all_funcs:
+            cls = m.classes.get(f.cls) if f.cls else None
+            _BodyWalker(index, m, cls, f).run()
+    return index
+
+
+def _collect_funcs(index: Index, m: ModuleInfo) -> None:
+    work = [(n, "", None) for n in m.tree.body]
+    while work:
+        node, qual_prefix, cls_name = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = (f"{qual_prefix}.{node.name}"
+                    if qual_prefix else node.name)
+            f = FuncInfo(m, cls_name, qual, node.name,
+                         node.lineno, node=node)
+            m.all_funcs.append(f)
+            index.funcs[f.key] = f
+            if not qual_prefix:
+                m.functions[node.name] = f
+            elif cls_name and qual_prefix == cls_name:
+                m.classes[cls_name].methods[node.name] = f
+            work.extend(
+                (n, f"{qual}.<locals>", cls_name) for n in node.body)
+            continue
+        if isinstance(node, ast.ClassDef):
+            if not qual_prefix:
+                work.extend(
+                    (n, node.name, node.name) for n in node.body)
+            else:
+                # class defined inside a function (the HTTP handler
+                # pattern): its methods still get FuncInfos so the
+                # per-node rules see them, but `self` inside them is
+                # the NESTED class's instance — carrying the outer
+                # cls_name made `with self._lock:` resolve to the
+                # OUTER class's LockDef (phantom held tokens feeding
+                # static_edges). No ClassInfo models nested classes,
+                # so their self.* stays unresolved rather than wrong.
+                work.extend(
+                    (n, f"{qual_prefix}.{node.name}", None)
+                    for n in node.body)
+            continue
+        for fname in _STMT_LIST_FIELDS:
+            sub = getattr(node, fname, None)
+            if isinstance(sub, list):
+                work.extend((n, qual_prefix, cls_name) for n in sub)

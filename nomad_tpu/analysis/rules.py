@@ -1,0 +1,891 @@
+"""nomad-vet rules: the repo's concurrency & layering invariants, named.
+
+Every rule returns ``Finding`` objects with a STABLE suppression key
+(``relpath:qual#anchor`` — no line numbers, so the baseline ledger
+survives unrelated edits) plus file:line for humans. The rule ids:
+
+  NV-lock-blocking  no blocking call (RPC / raft apply / device
+                    dispatch / time.sleep / socket / fsync / Future
+                    .result / thread join / Event.wait) while a known
+                    lock is held, resolved through the per-module call
+                    graph. Waiting on a Condition is exempt for the
+                    cv's own lock (wait releases it) but flagged for
+                    any OTHER lock held around it.
+  NV-lock-order     static lock acquire graph (nested with-regions,
+                    propagated through calls); cycles are findings.
+                    Cross-checking against the dynamic racecheck edge
+                    set reports coverage gaps as ADVISORIES.
+  NV-layering       stdlib-leaf modules must not import jax or app
+                    packages at module scope; jax eagerly only under
+                    scheduler/tpu; production never imports
+                    nomad_tpu.testing.
+  NV-except         no bare ``except:``; a handler that names
+                    CancelledError / NotLeaderError /
+                    LeadershipLostError must nack or re-raise.
+  NV-thread         every threading.Thread has an explicit ``name=``
+                    and is daemon=True or joined by its owner.
+  NV-literal        metrics.* and trace-span name arguments are string
+                    literals present in the docs catalogues.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .model import (CallSite, FuncInfo, Index, _call_target,
+                    _callable_fullname, iter_scope, iter_scope_stmts,
+                    resolve_name)
+
+GATE_RULES = (
+    "NV-lock-blocking", "NV-lock-order", "NV-layering",
+    "NV-except", "NV-thread", "NV-literal",
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    message: str
+    key: str            # stable suppression anchor (no line numbers)
+    chain: tuple = ()   # call/lock chain, outermost first
+    advisory: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "file": self.file, "line": self.line,
+            "message": self.message, "key": self.key,
+            "chain": list(self.chain), "advisory": self.advisory,
+        }
+
+
+# ---------------------------------------------------------------------------
+# blocking-sink model
+# ---------------------------------------------------------------------------
+
+# module-level callables that block the calling thread
+BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "os.fsync": "os.fsync",
+    "os.fdatasync": "os.fdatasync",
+    "select.select": "select.select",
+    "socket.create_connection": "socket.create_connection",
+    "subprocess.run": "subprocess.run",
+    "subprocess.check_output": "subprocess.check_output",
+    "subprocess.check_call": "subprocess.check_call",
+    "jax.device_put": "jax.device_put (device dispatch)",
+    "nomad_tpu.scheduler.tpu.solve_eval_batch":
+        "solve_eval_batch (device dispatch)",
+}
+
+# method names distinctive enough to flag on ANY receiver
+BLOCKING_METHODS = {
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "recvfrom": "socket recv",
+    "accept": "socket accept",
+    "communicate": "subprocess wait",
+    "fsync": "fsync",
+    "raft_apply": "raft apply (quorum round-trip)",
+    "apply_wait": "raft apply wait",
+    "block_until_ready": "device sync",
+    "result": "Future.result",
+}
+
+# `.call(...)` is an RPC round-trip only on rpc-ish receivers
+_RPC_RECEIVER_RE = re.compile(r"pool|rpc|conn|client", re.I)
+
+
+@dataclass
+class _Blocking:
+    label: str           # sink description
+    chain: tuple         # ("qual (file:line)", ...) down to the sink
+    exempt_token: str = ""  # condition-wait: the cv's own lock token
+
+
+_UNRESOLVED = object()  # cache sentinel (None is a valid resolution)
+
+
+class Resolver:
+    """Call-target resolution + blocking/acquire fixpoints."""
+
+    MAX_PASSES = 200  # runaway backstop; run_vet gates when hit
+
+    def __init__(self, index: Index) -> None:
+        self.index = index
+        self.blocking: dict = {}        # funckey -> _Blocking
+        self.acquired: dict = {}        # funckey -> {token: chain tuple}
+        self._cache: dict = {}          # id(site) -> resolution
+        self.converged = False
+        self._fixpoint()
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve(self, f: FuncInfo, site: CallSite):
+        """("func", FuncInfo) | ("sink", label) |
+        ("cond", label, own_token) | None.
+
+        Memoized per site: resolution reads only the immutable index
+        (never the blocking/acquired fixpoint state), and the fixpoint
+        re-visits every site each pass — without the cache the walk's
+        dominant cost scales as passes x sites, and check_lock_blocking
+        / static_edges resolve everything yet again. Sites are owned by
+        FuncInfo.calls for the Resolver's whole lifetime, so id() keys
+        are stable."""
+        got = self._cache.get(id(site), _UNRESOLVED)
+        if got is not _UNRESOLVED:
+            return got
+        got = self._resolve(f, site)
+        self._cache[id(site)] = got
+        return got
+
+    def _resolve(self, f: FuncInfo, site: CallSite):
+        t = site.target
+        m = f.module
+        cls = m.classes.get(f.cls) if f.cls else None
+        if t[0] == "name":
+            if t[1] in m.functions:
+                return ("func", m.functions[t[1]])
+            full = m.aliases.get(t[1])
+            if full:
+                return self._resolve_dotted(full)
+            return None
+        if t[0] in ("var", "dotted"):
+            if t[0] == "var":
+                root, meth = t[1], t[2]
+                if root in m.aliases:
+                    return self._resolve_dotted(
+                        m.aliases[root] + "." + meth)
+                if root in f.thread_vars and meth == "join":
+                    return ("sink", "Thread.join")
+                if root in f.var_types:
+                    got = self.index.method(f.var_types[root], meth)
+                    if got is not None:
+                        return ("func", got)
+                return self._method_sink(root, meth)
+            return self._resolve_dotted(resolve_name(m, t[1]))
+        if t[0] == "self":
+            meth = t[1]
+            if cls is not None:
+                got = self.index.method(cls.fullname, meth)
+                if got is not None:
+                    return ("func", got)
+            return self._method_sink("self", meth)
+        if t[0] == "selfattr":
+            attr, meth = t[1], t[2]
+            if cls is not None:
+                ld = cls.locks.get(attr)
+                if ld is not None and ld.kind == "condition" \
+                        and meth == "wait":
+                    own = ld.token
+                    if ld.wraps and ld.wraps in cls.locks:
+                        own = cls.locks[ld.wraps].token
+                    return ("cond", f"Condition.wait ({ld.name})", own)
+                if attr in cls.events and meth == "wait":
+                    return ("sink", f"Event.wait (self.{attr})")
+                if attr in cls.threads and meth == "join":
+                    return ("sink", "Thread.join")
+                if attr in cls.attr_types:
+                    got = self.index.method(cls.attr_types[attr], meth)
+                    if got is not None:
+                        return ("func", got)
+            return self._method_sink(attr, meth)
+        if t[0] == "expr":
+            return self._method_sink("", t[1])
+        return None
+
+    def _resolve_dotted(self, full: str):
+        if full in BLOCKING_DOTTED:
+            return ("sink", BLOCKING_DOTTED[full])
+        got = self.index.repo_function(full)
+        if got is not None:
+            return ("func", got)
+        cls = self.index.classes.get(full)
+        if cls is not None and "__init__" in cls.methods:
+            return ("func", cls.methods["__init__"])
+        # mod.Class.method / alias.Class(...)
+        head, _, meth = full.rpartition(".")
+        cls = self.index.classes.get(head)
+        if cls is not None:
+            got = self.index.method(head, meth)
+            if got is not None:
+                return ("func", got)
+        if meth in BLOCKING_METHODS:
+            return ("sink", BLOCKING_METHODS[meth])
+        return None
+
+    def _method_sink(self, receiver: str, meth: str):
+        if meth == "call" and _RPC_RECEIVER_RE.search(receiver):
+            return ("sink", f"RPC call ({receiver}.call)")
+        if meth in BLOCKING_METHODS:
+            return ("sink", BLOCKING_METHODS[meth])
+        return None
+
+    # -- fixpoints ----------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        funcs = list(self.index.funcs.values())
+        for f in funcs:
+            self.acquired[f.key] = {
+                tok: (f"{f.qual} ({f.module.relpath}:{ln})",)
+                for tok, ln, _held in f.acquires
+            }
+        # MAX_PASSES is a runaway backstop, not a depth budget:
+        # information moves at least one call-graph level per pass, so
+        # a non-converged exit means chains deeper than the cap were
+        # silently dropped. run_vet surfaces that as a GATE error (the
+        # no-silent-caps contract this tool enforces on everything
+        # else) — converged stays False unless the loop exits clean.
+        for _pass in range(self.MAX_PASSES):
+            changed = False
+            for f in funcs:
+                for site in f.calls:
+                    got = self.resolve(f, site)
+                    if got is None:
+                        continue
+                    here = f"{f.qual} ({f.module.relpath}:{site.lineno})"
+                    if got[0] == "sink":
+                        changed |= self._mark_blocking(
+                            f, _Blocking(got[1], (here, got[1])))
+                    elif got[0] == "cond":
+                        changed |= self._mark_blocking(
+                            f, _Blocking(got[1], (here, got[1]), got[2]))
+                    elif got[0] == "func":
+                        callee = got[1]
+                        b = self.blocking.get(callee.key)
+                        if b is not None:
+                            changed |= self._mark_blocking(
+                                f, _Blocking(
+                                    b.label, (here,) + b.chain,
+                                    b.exempt_token))
+                        mine = self.acquired[f.key]
+                        for tok, chain in self.acquired.get(
+                                callee.key, {}).items():
+                            if tok not in mine:
+                                mine[tok] = (here,) + chain
+                                changed = True
+            if not changed:
+                self.converged = True
+                break
+
+    def _mark_blocking(self, f: FuncInfo, b: _Blocking) -> bool:
+        cur = self.blocking.get(f.key)
+        # prefer unconditional sinks over condition-wait (exemptable),
+        # then shorter chains — stable under iteration order
+        if cur is None or (cur.exempt_token and not b.exempt_token) or (
+                bool(cur.exempt_token) == bool(b.exempt_token)
+                and len(b.chain) < len(cur.chain)):
+            if cur is not None and cur.label == b.label \
+                    and len(cur.chain) <= len(b.chain):
+                return False
+            self.blocking[f.key] = b
+            return True
+        return False
+
+
+def _lock_label(index: Index, token: str) -> str:
+    ld = index.locks.get(token)
+    if ld is None:
+        return token
+    role = f" role={ld.role}" if ld.role else ""
+    return f"{ld.name} [{token}]{role}"
+
+
+# ---------------------------------------------------------------------------
+# NV-lock-blocking
+# ---------------------------------------------------------------------------
+
+
+def check_lock_blocking(index: Index, resolver: Resolver) -> list:
+    out: list = []
+    seen: set = set()
+    for f in index.funcs.values():
+        if f.module.is_testing:
+            continue
+        for site in f.calls:
+            if not site.held:
+                continue
+            got = resolver.resolve(f, site)
+            if got is None:
+                continue
+            if got[0] == "sink":
+                label, chain, exempt = got[1], (got[1],), ""
+            elif got[0] == "cond":
+                label, chain, exempt = got[1], (got[1],), got[2]
+            else:
+                b = resolver.blocking.get(got[1].key)
+                if b is None:
+                    continue
+                label, chain, exempt = b.label, b.chain, b.exempt_token
+            held = [t for t in site.held if t != exempt]
+            if not held:
+                continue  # cv.wait under only its own lock: releases it
+            # the held-lock NAMES are part of the key (stable across
+            # unrelated edits, unlike the line-numbered tokens): a
+            # baselined sleep under lock A must not mask a NEW sleep
+            # under lock B in the same function
+            held_names = "+".join(sorted(
+                _slug(index.locks[t].name if t in index.locks else t)
+                for t in held))
+            key = f"{f.module.relpath}:{f.qual}#{_slug(label)}@{held_names}"
+            if key in seen:
+                continue
+            seen.add(key)
+            locks = ", ".join(_lock_label(index, t) for t in held)
+            here = f"{f.qual} ({f.module.relpath}:{site.lineno})"
+            out.append(Finding(
+                "NV-lock-blocking", f.module.relpath, site.lineno,
+                f"blocking call [{label}] while holding {locks}",
+                key, chain=(here,) + chain))
+    return out
+
+
+def _slug(label: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.]+", "-", label).strip("-")
+
+
+# ---------------------------------------------------------------------------
+# NV-lock-order
+# ---------------------------------------------------------------------------
+
+
+def static_edges(index: Index, resolver: Resolver) -> dict:
+    """(from_token, to_token) -> witness chain tuple."""
+    edges: dict = {}
+    for f in index.funcs.values():
+        if f.module.is_testing:
+            continue
+        for tok, ln, held in f.acquires:
+            for prior in held:
+                if prior != tok:
+                    edges.setdefault(
+                        (prior, tok),
+                        (f"{f.qual} ({f.module.relpath}:{ln})",))
+        for site in f.calls:
+            if not site.held:
+                continue
+            got = resolver.resolve(f, site)
+            if got is None or got[0] != "func":
+                continue
+            here = f"{f.qual} ({f.module.relpath}:{site.lineno})"
+            for tok, chain in resolver.acquired.get(
+                    got[1].key, {}).items():
+                for prior in site.held:
+                    if prior != tok:
+                        edges.setdefault(
+                            (prior, tok), (here,) + chain)
+    return edges
+
+
+def check_lock_order(index: Index, resolver: Resolver,
+                     dynamic_edges=None, edges: dict = None) -> list:
+    if edges is None:
+        edges = static_edges(index, resolver)
+    out = _cycles(index, edges)
+    if dynamic_edges is not None:
+        dyn = {(e["from"], e["to"]) for e in dynamic_edges}
+        for (a, b), chain in sorted(edges.items()):
+            if (a, b) not in dyn:
+                out.append(Finding(
+                    "NV-lock-order", a.rsplit(":", 1)[0],
+                    int(a.rsplit(":", 1)[1]),
+                    f"static lock edge {_lock_label(index, a)} -> "
+                    f"{_lock_label(index, b)} never covered by the "
+                    f"dynamic racecheck run",
+                    f"edge-uncovered:{a}->{b}", chain=chain,
+                    advisory=True))
+        stat = set(edges)
+        for a, b in sorted(dyn):
+            if (a, b) not in stat and a in index.locks \
+                    and b in index.locks:
+                out.append(Finding(
+                    "NV-lock-order", a.rsplit(":", 1)[0],
+                    int(a.rsplit(":", 1)[1]),
+                    f"dynamic lock edge {_lock_label(index, a)} -> "
+                    f"{_lock_label(index, b)} invisible to the static "
+                    f"acquire graph (acquired outside `with` regions?)",
+                    f"edge-unseen:{a}->{b}", advisory=True))
+    return out
+
+
+def _cycles(index: Index, edges: dict) -> list:
+    """Tarjan SCCs over the acquire graph; size>1 (or a self-edge) is a
+    potential deadlock. One finding per SCC, keyed by its sorted
+    members so the baseline survives witness drift."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    idx = {}
+    low = {}
+    stack: list = []
+    on: set = set()
+    counter = [0]
+    sccs: list = []
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph[v])))]
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1 or node in graph.get(node, ()):
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in idx:
+            strongconnect(v)
+
+    out = []
+    for scc in sccs:
+        members = ", ".join(_lock_label(index, t) for t in scc)
+        witness = []
+        for (a, b), chain in sorted(edges.items()):
+            if a in scc and b in scc:
+                witness.append(f"{a} -> {b} via {chain[0]}")
+        first = scc[0]
+        out.append(Finding(
+            "NV-lock-order", first.rsplit(":", 1)[0],
+            int(first.rsplit(":", 1)[1]),
+            f"lock-order cycle: {members}",
+            "cycle:" + "|".join(scc), chain=tuple(witness)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NV-layering
+# ---------------------------------------------------------------------------
+
+LEAF_MODULES = (
+    "trace", "metrics", "hostobs", "solverobs", "faultplane",
+    "ratelimit", "retry", "gctune",
+)
+JAX_EAGER_OK_PREFIX = "scheduler/tpu"
+
+
+def check_layering(index: Index, package: str = "nomad_tpu") -> list:
+    out = []
+    leaf_full = {f"{package}.{m}" for m in LEAF_MODULES}
+    for m in index.modules.values():
+        if m.is_testing:
+            continue
+        is_leaf = m.modname in leaf_full
+        for imp in m.imports:
+            full = imp.fullname
+            if full == f"{package}.testing" or \
+                    full.startswith(f"{package}.testing."):
+                out.append(Finding(
+                    "NV-layering", m.relpath, imp.lineno,
+                    f"production module imports {full} — the testing "
+                    f"package must never be a production dependency",
+                    f"{m.relpath}:<module>#import-testing"))
+                continue
+            if not imp.module_scope:
+                continue  # lazy import: the sanctioned pattern
+            if full == "jax" or full.startswith("jax."):
+                if not m.relpath.startswith(
+                        f"{package}/{JAX_EAGER_OK_PREFIX}"):
+                    out.append(Finding(
+                        "NV-layering", m.relpath, imp.lineno,
+                        f"eager `import {full}` outside "
+                        f"{package}/{JAX_EAGER_OK_PREFIX} — the control "
+                        f"plane must serve without loading jax",
+                        f"{m.relpath}:<module>#eager-jax"))
+                continue
+            if is_leaf and full.split(".")[0] == package:
+                target = full[len(package) + 1:].split(".")[0]
+                if target and target not in LEAF_MODULES:
+                    out.append(Finding(
+                        "NV-layering", m.relpath, imp.lineno,
+                        f"stdlib-leaf module eagerly imports {full} — "
+                        f"leaves may only import stdlib or other "
+                        f"leaves at module scope",
+                        f"{m.relpath}:<module>#leaf-imports-{target}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NV-except
+# ---------------------------------------------------------------------------
+
+GUARDED_EXCEPTIONS = (
+    "CancelledError", "NotLeaderError", "LeadershipLostError",
+)
+
+
+def _handler_names(h: ast.ExceptHandler) -> list:
+    types = []
+    t = h.type
+    elts = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+    for e in elts:
+        if isinstance(e, ast.Name):
+            types.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            types.append(e.attr)
+    return types
+
+
+def check_except(index: Index) -> list:
+    out = []
+    for m in index.modules.values():
+        if m.is_testing:
+            continue
+        for f in m.all_funcs:
+            counts: dict = {}
+            for node in iter_scope_stmts(f.node):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    key = f"{m.relpath}:{f.qual}#bare-except"
+                    n = counts.setdefault(key, 0)
+                    counts[key] += 1
+                    out.append(Finding(
+                        "NV-except", m.relpath, node.lineno,
+                        "bare `except:` swallows SystemExit/"
+                        "KeyboardInterrupt and every cancellation "
+                        "signal — name the exceptions",
+                        key if n == 0 else f"{key}-{n}"))
+                    continue
+                caught = _handler_names(node)
+                guarded = [c for c in caught if c in GUARDED_EXCEPTIONS]
+                if not guarded:
+                    continue
+                if _handler_reraises_or_nacks(node):
+                    continue
+                names = "/".join(sorted(set(guarded)))
+                key = f"{m.relpath}:{f.qual}#swallows-{names}"
+                n = counts.setdefault(key, 0)
+                counts[key] += 1
+                out.append(Finding(
+                    "NV-except", m.relpath, node.lineno,
+                    f"handler catches {names} without nack or "
+                    f"re-raise — a cancellation/leadership signal "
+                    f"dies here and the eval is neither redelivered "
+                    f"nor surfaced",
+                    key if n == 0 else f"{key}-{n}"))
+    return out
+
+
+def _handler_reraises_or_nacks(h: ast.ExceptHandler) -> bool:
+    for node in iter_scope(h):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            t = _call_target(node.func)
+            name = t[-1] if t else ""
+            if "nack" in str(name):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# NV-thread
+# ---------------------------------------------------------------------------
+
+
+def check_threads(index: Index) -> list:
+    out = []
+    for m in index.modules.values():
+        if m.is_testing:
+            continue
+        for f in m.all_funcs:
+            cls = m.classes.get(f.cls) if f.cls else None
+            for node in iter_scope(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                # cheap syntactic pre-filter before alias resolution
+                if not (isinstance(fn, ast.Name) and fn.id == "Thread"
+                        or isinstance(fn, ast.Attribute)
+                        and fn.attr == "Thread"):
+                    continue
+                if _callable_fullname(m, node) != "threading.Thread":
+                    continue
+                kw = {k.arg: k.value for k in node.keywords if k.arg}
+                binding = _thread_binding(f.node, node)
+                anchor = binding or f"L{_ordinal(f.node, node)}"
+                if "name" not in kw:
+                    out.append(Finding(
+                        "NV-thread", m.relpath, node.lineno,
+                        "threading.Thread without an explicit name= — "
+                        "anonymous threads are invisible to the host "
+                        "profiler's role attribution and to shutdown "
+                        "triage",
+                        f"{m.relpath}:{f.qual}#thread-unnamed-"
+                        f"{anchor}"))
+                if not _thread_owned(m, f, cls, node, kw, binding):
+                    out.append(Finding(
+                        "NV-thread", m.relpath, node.lineno,
+                        "thread is neither daemon=True nor joined by "
+                        "its owner — it can outlive stop() and leak "
+                        "across agent reloads",
+                        f"{m.relpath}:{f.qual}#thread-leaked-"
+                        f"{anchor}"))
+    return out
+
+
+def _ordinal(fnode, call) -> int:
+    n = 0
+    for node in iter_scope(fnode):
+        if isinstance(node, ast.Call) and node is call:
+            return n
+        if isinstance(node, ast.Call):
+            n += 1
+    return n
+
+
+def _thread_binding(fnode, call):
+    """'self.X' / local name the Thread lands in, else None."""
+    for node in iter_scope(fnode):
+        if isinstance(node, ast.Assign) and node.value is call \
+                and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                return f"self.{tgt.attr}"
+            if isinstance(tgt, ast.Name):
+                return tgt.id
+    return None
+
+
+def _truthy(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _thread_owned(m, f, cls, call, kw, binding) -> bool:
+    if "daemon" in kw and _truthy(kw["daemon"]):
+        return True
+    if binding is None:
+        # fire-and-forget expression (threading.Thread(...).start()):
+        # only daemon=True can make that safe
+        return False
+    # X.daemon = True anywhere in the creating function
+    for node in iter_scope(f.node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Attribute) and tgt.attr == "daemon" \
+                    and _expr_matches(tgt.value, binding) \
+                    and _truthy(node.value):
+                return True
+    # joined: self-attr threads anywhere in the owning class (stop()
+    # conventionally, but any owner join keeps the thread accounted);
+    # local threads joined in the same function
+    scope = cls.methods.values() if (
+        binding.startswith("self.") and cls is not None) else [f]
+    attr = binding[5:] if binding.startswith("self.") else binding
+    for fn in scope:
+        for node in iter_scope(fn.node):
+            if isinstance(node, ast.Call):
+                t = _call_target(node.func)
+                if t[0] == "selfattr" and t[1] == attr \
+                        and t[2] == "join" and binding.startswith("self."):
+                    return True
+                if t[0] == "var" and t[1] == attr and t[2] == "join" \
+                        and not binding.startswith("self."):
+                    return True
+    # a local thread appended to a list that is later join()ed in the
+    # same function (for t in ts: t.join()) — the joined variable must
+    # be a loop target, or a bare str.join(...) like sep.join(parts)
+    # would silently vouch for every leaked thread in the function
+    if not binding.startswith("self."):
+        loop_vars = set()
+        for node in iter_scope(f.node):
+            if isinstance(node, ast.For) and isinstance(
+                    node.target, ast.Name):
+                loop_vars.add(node.target.id)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp)):
+                for gen in node.generators:
+                    if isinstance(gen.target, ast.Name):
+                        loop_vars.add(gen.target.id)
+        for node in iter_scope(f.node):
+            if isinstance(node, ast.Call):
+                t = _call_target(node.func)
+                if t[0] == "var" and t[2] == "join" \
+                        and t[1] in loop_vars:
+                    return True
+    return False
+
+
+def _expr_matches(expr, binding: str) -> bool:
+    if binding.startswith("self."):
+        return isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and expr.attr == binding[5:]
+    return isinstance(expr, ast.Name) and expr.id == binding
+
+
+# ---------------------------------------------------------------------------
+# NV-literal
+# ---------------------------------------------------------------------------
+
+METRICS_FNS = ("incr", "observe", "set_gauge", "time_ns",
+               "register_provider")
+SPAN_ARG_INDEX = {  # call-form -> position of the name argument
+    "span": 1,          # trace.span(ctx, "name", ...)
+    "start_span": 0,    # ctx.start_span("name", ...)
+    "stage": 0,         # trace.stage("name", dur)
+    "stage_attrs": 0,   # trace.stage_attrs("name", dur, ...)
+    "add_stage": 0,     # span.add_stage("name", ...)
+}
+# the engines themselves manipulate names dynamically by design
+LITERAL_EXEMPT = ("nomad_tpu/metrics.py", "nomad_tpu/trace.py")
+_LITERAL_ATTRS = frozenset(METRICS_FNS) | frozenset(SPAN_ARG_INDEX)
+
+
+def _canonical(name: str) -> str:
+    return re.sub(r"(\{[^}]*\}|<[^>]+>)", "※", name)
+
+
+def _fstring_head(node: ast.JoinedStr) -> str:
+    """Literal text with {…} placeholders for formatted values."""
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        else:
+            parts.append("{}")
+    return "".join(parts)
+
+
+def check_literals(index: Index, metric_names: list,
+                   span_names: set) -> list:
+    """metric_names: docs/metrics.md catalogue rows; span_names:
+    docs/tracing.md span-catalogue table rows. Empty catalogues
+    (fixture runs) skip the respective membership check but still
+    require literalness."""
+    out = []
+    raw = set(metric_names)
+    canon = [_canonical(n) for n in metric_names]
+    for m in index.modules.values():
+        if m.is_testing or m.relpath in LITERAL_EXEMPT:
+            continue
+        for f in m.all_funcs:
+            for node in iter_scope(f.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if not (isinstance(fn, ast.Attribute)
+                        and fn.attr in _LITERAL_ATTRS):
+                    continue
+                t = _call_target(node.func)
+                if t[0] == "var" and t[2] in METRICS_FNS and \
+                        resolve_name(m, t[1]).endswith("metrics"):
+                    out.extend(_check_metric_site(
+                        m, f, node, t[2], raw, canon, metric_names))
+                elif _is_span_site(m, t):
+                    out.extend(_check_span_site(
+                        m, f, node, t, span_names))
+    return out
+
+
+def _is_span_site(m, t) -> bool:
+    if t[0] == "var" and t[2] in ("span", "stage", "stage_attrs"):
+        return resolve_name(m, t[1]).endswith("trace")
+    return t[0] in ("var", "selfattr", "expr", "self") \
+        and t[-1] in ("start_span", "add_stage")
+
+
+def _name_arg(node: ast.Call, pos: int):
+    if len(node.args) > pos:
+        return node.args[pos]
+    return None
+
+
+def _check_metric_site(m, f, node, fn, raw, canon, names) -> list:
+    arg = _name_arg(node, 0)
+    where = f"{m.relpath}:{f.qual}"
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        name = arg.value
+        if not names:
+            return []
+        if fn == "register_provider":
+            if not any(r.startswith(name + ".") for r in raw):
+                return [Finding(
+                    "NV-literal", m.relpath, node.lineno,
+                    f"provider prefix {name!r} has no "
+                    f"docs/metrics.md entries",
+                    f"{where}#metric-{name}")]
+            return []
+        if name in raw or name.endswith(".error"):
+            return []
+        c = _canonical(name)
+        # a base name matches its labeled variants only at a dot
+        # boundary — bare startswith would let "nomad.raft.leader"
+        # ride on "nomad.raft.leader_changes"
+        if any(cat == c or cat.startswith(c + ".") for cat in canon):
+            return []
+        return [Finding(
+            "NV-literal", m.relpath, node.lineno,
+            f"metric name {name!r} is not in the docs/metrics.md "
+            f"catalogue",
+            f"{where}#metric-{name}")]
+    if isinstance(arg, ast.JoinedStr):
+        head = _fstring_head(arg)
+        if not names:
+            return []
+        c = _canonical(head)
+        if any(cat == c or cat.startswith(c + ".") for cat in canon):
+            return []
+        return [Finding(
+            "NV-literal", m.relpath, node.lineno,
+            f"metric name f-string {head!r} matches no "
+            f"docs/metrics.md entry",
+            f"{where}#metric-f-{_slug(head)}")]
+    return [Finding(
+        "NV-literal", m.relpath, node.lineno,
+        f"metrics.{fn} name argument is not a string literal — "
+        f"dynamic names defeat the catalogue tripwire",
+        f"{where}#metric-dynamic-{fn}")]
+
+
+def _check_span_site(m, f, node, t, span_names) -> list:
+    pos = SPAN_ARG_INDEX[t[-1]]
+    arg = _name_arg(node, pos)
+    where = f"{m.relpath}:{f.qual}"
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        if not span_names or arg.value in span_names:
+            return []
+        return [Finding(
+            "NV-literal", m.relpath, node.lineno,
+            f"span name {arg.value!r} is not catalogued in "
+            f"docs/tracing.md",
+            f"{where}#span-{arg.value}")]
+    if arg is None:
+        return []
+    return [Finding(
+        "NV-literal", m.relpath, node.lineno,
+        f"{t[-1]} name argument is not a string literal",
+        f"{where}#span-dynamic-{t[-1]}")]

@@ -824,7 +824,9 @@ def cmd_alloc_exec(args) -> int:
         except (OSError, ValueError):
             pass
 
-    t = _threading.Thread(target=pump_stdin, daemon=True)
+    t = _threading.Thread(
+        target=pump_stdin, name="exec-stdin-pump", daemon=True
+    )
     t.start()
     try:
         while True:
@@ -2651,6 +2653,39 @@ def cmd_operator_profile_stacks(args) -> int:
     return 0
 
 
+def cmd_operator_vet(args) -> int:
+    """nomad-vet: the AST-level concurrency & layering analyzer
+    (nomad_tpu/analysis; docs/static-analysis.md). Purely local — it
+    walks this checkout's production tree, no running agent needed.
+    Exit 1 on any unsuppressed finding, stale baseline entry, or
+    ledger defect: the same zero-findings contract CI enforces."""
+    import json as _json
+
+    from ..analysis import dynamic_edges_from_json, run_vet
+
+    dyn = None
+    try:
+        if args.dynamic_edges:
+            with open(args.dynamic_edges, encoding="utf-8") as f:
+                dyn = dynamic_edges_from_json(f.read())
+        report = run_vet(
+            rules=args.rules or None,
+            baseline_path=args.baseline,
+            dynamic_edges=dyn,
+        )
+    except (OSError, ValueError) as e:
+        # unknown -rule, unreadable -dynamic-edges/-baseline file, or
+        # malformed JSON: a one-line operator error, distinct from the
+        # exit-1 findings contract
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(_json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render(advisories=args.advisory))
+    return 1 if report.gate_count else 0
+
+
 def cmd_event_stream(args) -> int:
     """Follow /v1/event/stream as NDJSON (reference api/event_stream.go
     + `nomad event` tooling): one frame per line, payloads wire-lowered.
@@ -3311,6 +3346,28 @@ def build_parser() -> argparse.ArgumentParser:
     oppsk.add_argument("-output", default="",
                        help="write to a file instead of stdout")
     oppsk.set_defaults(fn=cmd_operator_profile_stacks)
+    opvet = opsub.add_parser(
+        "vet",
+        help="static concurrency & layering analyzer (nomad-vet)",
+    )
+    opvet.add_argument("-json", action="store_true", dest="as_json")
+    opvet.add_argument(
+        "-rule", action="append", dest="rules", metavar="RULE",
+        help="run only this rule id (repeatable; e.g. NV-lock-blocking)",
+    )
+    opvet.add_argument(
+        "-baseline", default=None,
+        help="suppression ledger (default: analysis/baseline.toml)",
+    )
+    opvet.add_argument(
+        "-dynamic-edges", dest="dynamic_edges", default=None,
+        help="racecheck edges() JSON for the NV-lock-order cross-check",
+    )
+    opvet.add_argument(
+        "-advisory", action="store_true",
+        help="also print advisories (dynamic-coverage gaps)",
+    )
+    opvet.set_defaults(fn=cmd_operator_vet)
     _args_operator_debug(opsub.add_parser("debug"))
     opsch = opsub.add_parser("scheduler")
     opschsub = opsch.add_subparsers(dest="subsubcmd")

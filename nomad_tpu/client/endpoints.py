@@ -445,7 +445,9 @@ class ClientEndpoints:
                 finally:
                     done.set()
 
-            t = threading.Thread(target=pump_out, daemon=True)
+            t = threading.Thread(
+                target=pump_out, name="alloc-exec-out", daemon=True
+            )
             t.start()
             while not done.is_set():
                 try:

@@ -364,6 +364,21 @@ def _fastpack_module():
     return _fastpack or None
 
 
+def warm_native() -> bool:
+    """Resolve (and if necessary compile) the fastpack extension NOW.
+
+    pack() loads it lazily, and nomad-vet's NV-lock-blocking walk
+    showed the first call can land under a hot lock — the raft lock
+    during a leader transition (_become_leader_locked packs the
+    barrier entry), the state-store lock (serialize), the RPC write
+    lock — turning a one-time C build (up to ~120s cold) into a
+    lock-held stall. Components that pack under locks call this once
+    at startup, outside any lock; afterwards _fastpack_module() is a
+    cached module lookup. Returns True when the native path is live.
+    """
+    return _fastpack_module() is not None
+
+
 def pack(obj: Any) -> bytes:
     fp = _fastpack_module()
     if fp is not None:

@@ -165,7 +165,9 @@ class MockDriver(Driver):
             finally:
                 inner.close()
 
-        threading.Thread(target=_echo, daemon=True).start()
+        threading.Thread(
+            target=_echo, name="mock-exec-echo", daemon=True
+        ).start()
         return parent
 
     def recover_task(self, handle: TaskHandle) -> None:

@@ -60,7 +60,9 @@ class _QemuTask:
         self.completed_at = 0
         self.exit_result: Optional[ExitResult] = None
         self.done = threading.Event()
-        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter = threading.Thread(
+            target=self._wait, name="qemu-waiter", daemon=True
+        )
         self._waiter.start()
 
     def _wait(self) -> None:

@@ -60,7 +60,9 @@ class _RawTask:
         self.completed_at = 0
         self.exit_result: Optional[ExitResult] = None
         self.done = threading.Event()
-        self._waiter = threading.Thread(target=self._wait, daemon=True)
+        self._waiter = threading.Thread(
+            target=self._wait, name="rawexec-waiter", daemon=True
+        )
         self._waiter.start()
 
     def _wait(self) -> None:
@@ -124,8 +126,12 @@ def _spawn_streaming(cmd: list[str], tty: bool):
                 except OSError:
                     pass
 
-        threading.Thread(target=_pump_out, daemon=True).start()
-        threading.Thread(target=_pump_in, daemon=True).start()
+        threading.Thread(
+            target=_pump_out, name="exec-pty-out", daemon=True
+        ).start()
+        threading.Thread(
+            target=_pump_in, name="exec-pty-in", daemon=True
+        ).start()
         return parent
     parent, child = _socket.socketpair()
     try:
@@ -142,7 +148,9 @@ def _spawn_streaming(cmd: list[str], tty: bool):
     finally:
         child.close()
     # reap in the background so exec children never pile up as zombies
-    threading.Thread(target=proc.wait, daemon=True).start()
+    threading.Thread(
+        target=proc.wait, name="exec-reaper", daemon=True
+    ).start()
     return parent
 
 
@@ -277,7 +285,9 @@ class RawExecDriver(Driver):
         task.completed_at = 0
         task.exit_result = None
         task.done = threading.Event()
-        task._waiter = threading.Thread(target=task._wait, daemon=True)
+        task._waiter = threading.Thread(
+            target=task._wait, name="rawexec-waiter", daemon=True
+        )
         task._waiter.start()
         with self._lock:
             self.tasks[handle.task_id] = task

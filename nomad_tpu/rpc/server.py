@@ -175,7 +175,8 @@ class RPCServer:
             with self._conns_lock:
                 self._conns.add(conn)
             threading.Thread(
-                target=self._handle_conn, args=(conn,), daemon=True
+                target=self._handle_conn, args=(conn,),
+                name="rpc-conn", daemon=True,
             ).start()
 
     def _drop_conn(self, conn: socket.socket) -> None:

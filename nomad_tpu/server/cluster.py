@@ -1530,7 +1530,10 @@ class ClusterServer:
                 finally:
                     done.set()
 
-            t = threading.Thread(target=pump_down_to_up, daemon=True)
+            t = threading.Thread(
+                target=pump_down_to_up, name="exec-stream-down",
+                daemon=True,
+            )
             t.start()
             while not done.is_set():
                 try:

@@ -21,6 +21,7 @@ import time
 from typing import Optional
 
 from ..structs import Evaluation, Job, generate_uuid, now_ns
+from .raft_replication import LeadershipLostError, NotLeaderError
 from ..structs.structs import (
     EVAL_STATUS_PENDING,
     EVAL_TRIGGER_PERIODIC_JOB,
@@ -165,10 +166,16 @@ class PeriodicDispatch:
         self._tracked: dict[tuple[str, str], Job] = {}
         self._next: dict[tuple[str, str], float] = {}
         self._lock = threading.Lock()
-        # Serializes child launches (probe + register must be atomic).
-        # Separate from _lock: raft_apply re-enters add() via the FSM
-        # job-upsert side-channel, which takes _lock.
+        # Serializes child-launch id probes. Separate from _lock:
+        # raft_apply re-enters add() via the FSM job-upsert
+        # side-channel, which takes _lock. The raft write itself
+        # happens OUTSIDE this lock (nomad-vet NV-lock-blocking): a
+        # quorum round-trip under it would stall force_launch RPCs
+        # behind the timer thread (and vice versa) for seconds during
+        # leadership churn. Ids claimed but not yet visible in the
+        # state store live in _launch_reserved.
         self._launch_lock = threading.Lock()
+        self._launch_reserved: set[tuple[str, str]] = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -274,35 +281,62 @@ class PeriodicDispatch:
         createEval/deriveJob)."""
         child = parent.copy()
         # Second-granularity launch ids can collide (force_launch racing a
-        # scheduled fire); the launch lock makes probe + register atomic,
-        # and the bump loop picks the first unused id, so a collision
-        # can't silently upsert over an existing child.
+        # scheduled fire); the probe + reservation are atomic under the
+        # launch lock, and the bump loop skips both committed children
+        # and ids another launch has claimed but not yet applied — so a
+        # collision can't silently upsert over an existing child. The
+        # raft write runs OUTSIDE the lock: a reserved id keeps racers
+        # off it without holding a lock across the quorum round-trip.
         with self._launch_lock:
             ts = launch_ts
-            while (
-                self.state.job_by_id(
-                    parent.namespace, f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{ts}"
-                )
-                is not None
-            ):
+            while True:
+                cid = f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{ts}"
+                key = (parent.namespace, cid)
+                if (
+                    key not in self._launch_reserved
+                    and self.state.job_by_id(parent.namespace, cid) is None
+                ):
+                    break
                 ts += 1
-            child.id = f"{parent.id}{PERIODIC_LAUNCH_SUFFIX}{ts}"
-            child.name = child.id
-            child.parent_id = parent.id
-            child.periodic = None
-            child.status = ""
-            ev = Evaluation(
-                id=generate_uuid(),
-                namespace=child.namespace,
-                priority=child.priority,
-                type=child.type,
-                triggered_by=EVAL_TRIGGER_PERIODIC_JOB,
-                job_id=child.id,
-                status=EVAL_STATUS_PENDING,
-                create_time=now_ns(),
-                modify_time=now_ns(),
-            )
+            self._launch_reserved.add(key)
+        child.id = cid
+        child.name = child.id
+        child.parent_id = parent.id
+        child.periodic = None
+        child.status = ""
+        ev = Evaluation(
+            id=generate_uuid(),
+            namespace=child.namespace,
+            priority=child.priority,
+            type=child.type,
+            triggered_by=EVAL_TRIGGER_PERIODIC_JOB,
+            job_id=child.id,
+            status=EVAL_STATUS_PENDING,
+            create_time=now_ns(),
+            modify_time=now_ns(),
+        )
+        try:
+            # raft_apply returns only after commit+apply, so the state
+            # store sees the child before the reservation is dropped —
+            # the probe above can never miss a committed launch.
             self.raft_apply("job_register", (child, ev))
+        except Exception as exc:
+            # Only a pre-submit leadership refusal is known NOT to have
+            # reached the log. Every other failure is outcome-unknown —
+            # LeadershipLostError and timeouts raise while the entry may
+            # still be replicating and can commit after the raise — so
+            # the reservation is kept: releasing it would let a racer
+            # probe (not reserved, not yet in state), claim the same id,
+            # and silently upsert over the late-committing child. The
+            # kept entry just steers future launches to ts+1.
+            if isinstance(exc, NotLeaderError) and not isinstance(
+                exc, LeadershipLostError
+            ):
+                with self._launch_lock:
+                    self._launch_reserved.discard(key)
+            raise
+        with self._launch_lock:
+            self._launch_reserved.discard(key)
         return child.id
 
     def _has_live_child(self, parent: Job) -> bool:

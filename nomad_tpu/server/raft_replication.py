@@ -132,6 +132,11 @@ class RaftNode:
         self.restore_fn = restore_fn
         self.on_leader_change = on_leader_change
 
+        # Warm the native codec while no lock exists yet: the first
+        # pack() otherwise happens under _lock (_become_leader_locked
+        # packs the barrier entry) and a cold fastpack build would
+        # stall the node mid-election (nomad-vet NV-lock-blocking).
+        codec.warm_native()
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
         # Persistent state. With a `store` (raft_store.RaftLogStore,
@@ -514,6 +519,7 @@ class RaftNode:
             threading.Thread(
                 target=self._solicit_vote,
                 args=(peer_id, addr, term, last_idx, last_term),
+                name=f"raft-vote-{peer_id}",
                 daemon=True,
             ).start()
 

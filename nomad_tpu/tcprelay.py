@@ -60,7 +60,8 @@ class TcpRelay:
                 time.sleep(0.05)  # transient: keep serving
                 continue
             threading.Thread(
-                target=self._relay, args=(conn,), daemon=True
+                target=self._relay, args=(conn,),
+                name="tcprelay-conn", daemon=True,
             ).start()
 
     def _relay(self, conn: socket.socket) -> None:
@@ -94,7 +95,10 @@ class TcpRelay:
                     except OSError:
                         pass
 
-        t = threading.Thread(target=pump, args=(conn, upstream), daemon=True)
+        t = threading.Thread(
+            target=pump, args=(conn, upstream),
+            name="tcprelay-pump", daemon=True,
+        )
         t.start()
         pump(upstream, conn)
         t.join(timeout=30)

@@ -23,6 +23,7 @@ never imports this module.
 
 from __future__ import annotations
 
+import os
 import threading
 import traceback
 
@@ -100,9 +101,14 @@ def _record_release(cls: str) -> None:
 
 class _TrackedLock:
     """Wraps a real lock; tracks acquisition order by allocation-site
-    class. Unknown attributes delegate to the underlying primitive so
-    Condition's _release_save/_is_owned paths keep working (those
-    bypass tracking, which only costs coverage, not correctness)."""
+    class. Condition's wait-path hooks (_release_save /
+    _acquire_restore / _is_owned) are implemented EXPLICITLY: the old
+    __getattr__ delegation handed Condition the raw RLock's hooks, so
+    a cv.wait() released the lock without the held-stack noticing —
+    every lock acquired while parked recorded a phantom held-before
+    edge from a lock nobody held, and the post-wait reacquire was
+    invisible. Now wait release/reacquire update the stack like any
+    other release/acquire (recursion count included for RLocks)."""
 
     def __init__(self, underlying) -> None:
         self._lock = underlying
@@ -127,6 +133,44 @@ class _TrackedLock:
 
     def locked(self) -> bool:
         return self._lock.locked()
+
+    # -- Condition integration (threading.Condition probes these on
+    #    construction; RLock state is (count, owner)) ---------------
+
+    def _release_save(self):
+        inner = getattr(self._lock, "_release_save", None)
+        if inner is not None:  # RLock: full release, any recursion depth
+            state = inner()
+            n = state[0] if isinstance(state, tuple) and state and \
+                isinstance(state[0], int) else 1
+        else:  # plain Lock
+            self._lock.release()
+            state, n = None, 1
+        for _ in range(n):
+            _record_release(self._cls)
+        return state
+
+    def _acquire_restore(self, state):
+        inner = getattr(self._lock, "_acquire_restore", None)
+        if inner is not None:
+            inner(state)
+            n = state[0] if isinstance(state, tuple) and state and \
+                isinstance(state[0], int) else 1
+        else:
+            self._lock.acquire()
+            n = 1
+        for _ in range(n):
+            _record_acquire(self._cls)
+
+    def _is_owned(self):
+        inner = getattr(self._lock, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        # plain Lock: the Condition default's probe, minus tracking
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
 
     def __getattr__(self, name):
         return getattr(self._lock, name)
@@ -157,6 +201,50 @@ def reset() -> None:
 def violations() -> list[dict]:
     with _state_lock:
         return list(_violations)
+
+
+_REPO_ROOT = __file__
+for _ in range(3):  # nomad_tpu/testing/racecheck.py -> repo root
+    _REPO_ROOT = os.path.dirname(_REPO_ROOT)
+
+
+def _rel(cls: str) -> str:
+    """Normalize an allocation-site class to the repo-relative form the
+    static analyzer (nomad_tpu/analysis) keys its locks by, so dynamic
+    and static edge sets cross-check with plain equality."""
+    path, _, line = cls.rpartition(":")
+    if path.startswith(_REPO_ROOT + os.sep) or path.startswith(
+        _REPO_ROOT + "/"
+    ):
+        path = path[len(_REPO_ROOT):].lstrip("/\\").replace("\\", "/")
+    return f"{path}:{line}"
+
+
+def edges() -> list[dict]:
+    """The observed held-before edge set in a stable JSON form:
+    [{"from": "<relpath:line>", "to": "<relpath:line>"}, ...], sorted,
+    repo-relative — the ground truth nomad-vet's NV-lock-order
+    cross-check consumes (`operator vet -dynamic-edges`)."""
+    with _state_lock:
+        pairs = sorted(_edges)
+    return [{"from": _rel(a), "to": _rel(b)} for a, b in pairs]
+
+
+def export_json() -> dict:
+    """{"edges": [...], "violations": [...]} with repo-relative class
+    keys and both stacks per violation — json.dump-able as-is."""
+    return {
+        "edges": edges(),
+        "violations": [
+            {
+                "from": _rel(v["classes"][0]),
+                "to": _rel(v["classes"][1]),
+                "stack": v["stack"],
+                "first_seen": v["first_seen"],
+            }
+            for v in violations()
+        ],
+    }
 
 
 def report() -> str:

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# nomad-vet, one command (docs/static-analysis.md):
+#
+#   scripts/vet.sh              # static walk + dynamic racecheck battery
+#   scripts/vet.sh -static      # the <10s static walk only
+#
+# 1. `operator vet` — the AST analyzer over the production tree,
+#    gating on zero unsuppressed findings (analysis/baseline.toml is
+#    the reviewed exception ledger).
+# 2. The dynamic lock-order battery (tests/test_racecheck.py runs the
+#    full-stack exercises in clean subprocesses under NOMAD_RACECHECK)
+#    plus tests/test_analysis.py — fixtures per rule, the baseline
+#    round-trip, and the static/dynamic edge cross-check.
+#
+# CI runs both via tier-1; this script is the pre-push shortcut.
+# Exit is nonzero on any finding or test failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${JAX_PLATFORMS:=cpu}"
+export JAX_PLATFORMS
+
+python -m nomad_tpu.cli operator vet
+
+if [[ "${1:-}" == "-static" ]]; then
+  exit 0
+fi
+
+exec python -m pytest tests/test_analysis.py tests/test_racecheck.py \
+  -q -m 'not slow' \
+  -p no:cacheprovider -p no:xdist -p no:randomly \
+  "${@}"

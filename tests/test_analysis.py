@@ -1,0 +1,842 @@
+"""nomad-vet (nomad_tpu/analysis) battery.
+
+Three layers, mirroring docs/static-analysis.md:
+
+  * per-rule fixture snippets — each positive fixture must trigger
+    EXACTLY its rule (and nothing else), each negative must be clean,
+    so a rule can neither silently die nor silently widen;
+  * the baseline ledger round-trip — a suppressed finding disappears,
+    a stale suppression (code fixed, entry kept) is itself a gate
+    failure, an unjustified entry is a ledger defect;
+  * the real-tree CI gate — zero unsuppressed findings over the
+    production tree in < 10s, plus the racecheck dynamic-edge export
+    and the NV-lock-order static/dynamic cross-check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.analysis import (GATE_RULES, dynamic_edges_from_json,
+                                run_vet)
+
+pytestmark = pytest.mark.vet
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DOCS = {
+    "metrics.md": "| `app.good` | counter | fixture |\n",
+    # a catalogued span is a first-column TABLE cell; the prose
+    # backtick must not catalogue (it is how attr names appear)
+    "tracing.md": "prose `not.a.span` attr\n| `good.span` | fixture |\n",
+}
+
+
+def _vet(tmp_path, files, rules=None, docs=DOCS, baseline="",
+         dynamic_edges=None):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    if docs:
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        for name, text in docs.items():
+            (d / name).write_text(text)
+    return run_vet(root=str(tmp_path), package="fixpkg", rules=rules,
+                   baseline_path=baseline,
+                   dynamic_edges=dynamic_edges)
+
+
+def _rules(report):
+    return sorted(f.rule for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# NV-lock-blocking
+# ---------------------------------------------------------------------------
+
+LOCK_BLOCKING_POS = """
+    import threading
+    import time
+
+    class Broker:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def bad(self):
+            with self._lock:
+                self._spin()
+
+        def _spin(self):
+            time.sleep(0.1)
+"""
+
+LOCK_BLOCKING_NEG = """
+    import threading
+    import time
+
+    class Broker:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def good(self):
+            time.sleep(0.1)
+            with self._lock:
+                x = 1
+            time.sleep(0.1)
+            return x
+"""
+
+
+def test_lock_blocking_chained_positive(tmp_path):
+    r = _vet(tmp_path, {"mod.py": LOCK_BLOCKING_POS})
+    assert _rules(r) == ["NV-lock-blocking"], r.render()
+    (f,) = r.findings
+    assert f.key == "fixpkg/mod.py:Broker.bad#time.sleep@Broker._lock"
+    # the chain walks through the per-module call graph to the sink
+    assert any("Broker._spin" in hop for hop in f.chain), f.chain
+    assert "Broker._lock" in f.message
+
+
+def test_lock_blocking_negative_clean(tmp_path):
+    r = _vet(tmp_path, {"mod.py": LOCK_BLOCKING_NEG})
+    assert r.findings == [], r.render()
+
+
+def test_lock_blocking_rpc_raft_and_event_sinks(tmp_path):
+    src = """
+    import threading
+
+    class Endpoint:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._stop = threading.Event()
+
+        def rpc_under_lock(self):
+            with self._lock:
+                return self._pool.call("a", {}, timeout_s=1.0)
+
+        def raft_under_lock(self):
+            with self._lock:
+                self.raft_apply("x", None)
+
+        def wait_under_lock(self):
+            with self._lock:
+                self._stop.wait(1.0)
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    keys = sorted(f.key for f in r.findings)
+    assert keys == [
+        "fixpkg/mod.py:Endpoint.raft_under_lock#"
+        "raft-apply-quorum-round-trip@Endpoint._lock",
+        "fixpkg/mod.py:Endpoint.rpc_under_lock#"
+        "RPC-call-_pool.call@Endpoint._lock",
+        "fixpkg/mod.py:Endpoint.wait_under_lock#"
+        "Event.wait-self._stop@Endpoint._lock",
+    ], r.render()
+    assert _rules(r) == ["NV-lock-blocking"] * 3
+
+
+def test_lock_blocking_condition_wait_exemption(tmp_path):
+    """cv.wait under ONLY the cv's own lock releases it — clean; the
+    same wait with an outer lock held blocks that outer lock."""
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+            self._other = threading.Lock()
+
+        def good_wait(self):
+            with self._cv:
+                self._cv.wait(1.0)
+
+        def bad_wait(self):
+            with self._other:
+                with self._cv:
+                    self._cv.wait(1.0)
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    assert [f.key for f in r.findings] == [
+        "fixpkg/mod.py:Q.bad_wait#Condition.wait-Q._cv@Q._other"
+    ], r.render()
+
+
+def test_lock_blocking_distinct_locks_distinct_keys(tmp_path):
+    """The held lock is part of the suppression key: a baselined sleep
+    under lock A must not mask a NEW sleep under lock B in the same
+    function."""
+    src = """
+    import threading
+    import time
+
+    class Broker:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def f(self):
+            with self._a:
+                time.sleep(1)
+            with self._b:
+                time.sleep(2)
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    assert sorted(f.key for f in r.findings) == [
+        "fixpkg/mod.py:Broker.f#time.sleep@Broker._a",
+        "fixpkg/mod.py:Broker.f#time.sleep@Broker._b",
+    ], r.render()
+
+
+def test_nested_class_attrs_stay_with_the_nested_class(tmp_path):
+    """A nested handler class's `self.*` belongs to ITS instances, in
+    BOTH passes: pass A used to attribute its lock/thread assignments
+    to the enclosing top-level class (ast.walk), and pass B resolved
+    `with self._lock:` in the nested class's methods against the OUTER
+    ClassInfo — a with-region there fed phantom outer-lock tokens into
+    static_edges and NV-lock-blocking."""
+    from nomad_tpu.analysis.model import build_index
+
+    src = """
+    import threading
+    import time
+
+    class Outer:
+        def __init__(self):
+            self._olock = threading.Lock()
+
+        def serve(self):
+            class Handler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(
+                        target=print, name="h", daemon=True)
+
+                def handle(self):
+                    with self._lock:
+                        time.sleep(1)
+            return Handler
+    """
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(src))
+    idx = build_index(str(tmp_path), "fixpkg")
+    outer = idx.classes["fixpkg.mod.Outer"]
+    assert set(outer.locks) == {"_olock"}, outer.locks
+    assert not outer.threads
+    # pass B: Handler.handle's self._lock must NOT resolve to
+    # Outer._olock — no ClassInfo models nested classes, so the sleep
+    # is simply not held-flagged (unresolved beats wrong)
+    r = run_vet(root=str(tmp_path), package="fixpkg",
+                rules=["NV-lock-blocking"], baseline_path="")
+    assert r.findings == [], r.render()
+
+
+def test_fixpoint_pass_cap_is_reported_not_silent(tmp_path, monkeypatch):
+    """A capped (non-converged) fixpoint means the lock rules never
+    finished analyzing deep call chains — that must be a GATE error,
+    not a quiet 'zero findings' over half-analyzed code."""
+    from nomad_tpu.analysis import rules as rules_mod
+
+    monkeypatch.setattr(rules_mod.Resolver, "MAX_PASSES", 0)
+    r = _vet(tmp_path, {"mod.py": LOCK_BLOCKING_POS},
+             rules=["NV-lock-blocking"])
+    assert any("fixpoint" in e for e in r.errors), r.errors
+    assert r.gate_count >= 1
+
+
+# ---------------------------------------------------------------------------
+# NV-lock-order
+# ---------------------------------------------------------------------------
+
+LOCK_ORDER_CYCLE = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._l1 = threading.Lock()
+            self._l2 = threading.Lock()
+
+        def one(self):
+            with self._l1:
+                with self._l2:
+                    pass
+
+        def two(self):
+            with self._l2:
+                self._grab_one()
+
+        def _grab_one(self):
+            with self._l1:
+                pass
+"""
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    r = _vet(tmp_path, {"mod.py": LOCK_ORDER_CYCLE})
+    assert _rules(r) == ["NV-lock-order"], r.render()
+    (f,) = r.findings
+    assert f.key.startswith("cycle:")
+    assert "S._l1" in f.message and "S._l2" in f.message
+    # witness edges name the functions that created each direction
+    assert any("S.one" in w for w in f.chain)
+    assert any("S.two" in w for w in f.chain), f.chain
+
+
+def test_lock_order_consistent_order_clean(tmp_path):
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._l1 = threading.Lock()
+            self._l2 = threading.Lock()
+
+        def one(self):
+            with self._l1:
+                with self._l2:
+                    pass
+
+        def two(self):
+            with self._l1:
+                with self._l2:
+                    pass
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    assert r.findings == [], r.render()
+
+
+def test_lock_order_dynamic_crosscheck_advisories(tmp_path):
+    """Static edges the dynamic run never covered (and dynamic edges
+    the static model can't see) are ADVISORIES: reported, never
+    gating."""
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._l1 = threading.Lock()
+            self._l2 = threading.Lock()
+
+        def one(self):
+            with self._l1:
+                with self._l2:
+                    pass
+    """
+    lines = textwrap.dedent(src).splitlines()
+    l1 = "fixpkg/mod.py:%d" % (
+        1 + next(i for i, s in enumerate(lines) if "_l1 =" in s))
+    l2 = "fixpkg/mod.py:%d" % (
+        1 + next(i for i, s in enumerate(lines) if "_l2 =" in s))
+    # dynamic run covered nothing static + saw a reversed edge
+    r = _vet(tmp_path, {"mod.py": src},
+             dynamic_edges=[{"from": l2, "to": l1}])
+    assert r.findings == [], r.render()  # advisories never gate
+    kinds = sorted(f.key.split(":")[0] for f in r.advisories)
+    assert kinds == ["edge-uncovered", "edge-unseen"], [
+        f.key for f in r.advisories
+    ]
+    # fully covered -> no uncovered advisory
+    r2 = _vet(tmp_path, {"mod.py": src},
+              dynamic_edges=[{"from": l1, "to": l2}])
+    assert r2.advisories == [], [f.key for f in r2.advisories]
+
+
+# ---------------------------------------------------------------------------
+# NV-layering
+# ---------------------------------------------------------------------------
+
+
+def test_layering_leaf_and_jax_and_testing(tmp_path):
+    files = {
+        # "metrics" is a leaf name: eager app import forbidden
+        "metrics.py": "from . import server\n",
+        "server/__init__.py": "",
+        # eager jax outside scheduler/tpu
+        "hot.py": "import jax\n",
+        # production importing the testing package — even lazily
+        "prod.py": (
+            "def f():\n"
+            "    from .testing import chaos\n"
+            "    return chaos\n"
+        ),
+        "testing/__init__.py": "",
+        "testing/chaos.py": "",
+    }
+    r = _vet(tmp_path, files)
+    keys = sorted(f.key for f in r.findings)
+    assert keys == [
+        "fixpkg/hot.py:<module>#eager-jax",
+        "fixpkg/metrics.py:<module>#leaf-imports-server",
+        "fixpkg/prod.py:<module>#import-testing",
+    ], r.render()
+    assert _rules(r) == ["NV-layering"] * 3
+
+
+def test_layering_lazy_jax_and_leaf_to_leaf_clean(tmp_path):
+    files = {
+        "metrics.py": "",
+        # leaf importing another leaf eagerly is fine
+        "solverobs.py": "from . import metrics\n",
+        # lazy jax is the sanctioned pattern
+        "hot.py": "def f():\n    import jax\n    return jax\n",
+        # testing may import production freely
+        "testing/__init__.py": "from .. import metrics\n",
+    }
+    r = _vet(tmp_path, files)
+    assert r.findings == [], r.render()
+
+
+# ---------------------------------------------------------------------------
+# NV-except
+# ---------------------------------------------------------------------------
+
+
+def test_except_bare_and_swallowed_signals(tmp_path):
+    src = """
+    class W:
+        def bad_bare(self):
+            try:
+                self.step()
+            except:
+                pass
+
+        def bad_swallow(self):
+            try:
+                self.step()
+            except NotLeaderError:
+                return None
+
+        def good_reraise(self):
+            try:
+                self.step()
+            except (Exception, CancelledError):
+                raise
+
+        def good_nack(self, broker, ev, tok):
+            try:
+                self.step()
+            except (Exception, CancelledError):
+                broker.nack(ev, tok)
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    keys = sorted(f.key for f in r.findings)
+    assert keys == [
+        "fixpkg/mod.py:W.bad_bare#bare-except",
+        "fixpkg/mod.py:W.bad_swallow#swallows-NotLeaderError",
+    ], r.render()
+    assert _rules(r) == ["NV-except"] * 2
+
+
+# ---------------------------------------------------------------------------
+# NV-thread
+# ---------------------------------------------------------------------------
+
+
+def test_thread_unnamed_and_leaked(tmp_path):
+    src = """
+    import threading
+
+    class Owner:
+        def bad_unnamed(self):
+            t = threading.Thread(target=self.run, daemon=True)
+            t.start()
+
+        def bad_leaked(self):
+            self._t = threading.Thread(target=self.run, name="w")
+            self._t.start()
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    keys = sorted(f.key for f in r.findings)
+    assert keys == [
+        "fixpkg/mod.py:Owner.bad_leaked#thread-leaked-self._t",
+        "fixpkg/mod.py:Owner.bad_unnamed#thread-unnamed-t",
+    ], r.render()
+    assert _rules(r) == ["NV-thread"] * 2
+
+
+def test_thread_daemon_or_joined_clean(tmp_path):
+    src = """
+    import threading
+
+    class Owner:
+        def start(self):
+            self._t = threading.Thread(
+                target=self.run, name="w", daemon=False
+            )
+            self._t.start()
+
+        def stop(self):
+            self._t.join(timeout=5)
+
+        def fire(self):
+            threading.Thread(
+                target=self.run, name="f", daemon=True
+            ).start()
+
+        def local_joined(self):
+            t = threading.Thread(target=self.run, name="l")
+            t.start()
+            t.join()
+
+        def pool_joined(self):
+            ws = []
+            for i in range(3):
+                w = threading.Thread(target=self.run, name="p")
+                w.start()
+                ws.append(w)
+            for t in ws:
+                t.join()
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    assert r.findings == [], r.render()
+
+
+def test_thread_str_join_does_not_vouch(tmp_path):
+    """`sep.join(parts)` in the same function must not count as joining
+    a leaked thread — only a loop-target join (for t in ts: t.join())
+    satisfies the local-pool pattern."""
+    src = """
+    import threading
+
+    def leak(parts):
+        t = threading.Thread(target=print, name="x")
+        t.start()
+        sep = ","
+        return sep.join(parts)
+    """
+    r = _vet(tmp_path, {"mod.py": src})
+    assert _rules(r) == ["NV-thread"], r.render()
+    assert "leak" in r.findings[0].key
+
+
+# ---------------------------------------------------------------------------
+# NV-literal
+# ---------------------------------------------------------------------------
+
+
+def test_literal_metric_and_span_names(tmp_path):
+    src = """
+    from . import metrics, trace
+
+    def good(ctx):
+        metrics.incr("app.good")
+        with trace.span(ctx, "good.span"):
+            pass
+
+    def bad_metric():
+        metrics.incr("app.typo")
+
+    def bad_prefix():
+        metrics.incr("app.goo")
+
+    def bad_dynamic(name):
+        metrics.observe(name, 1.0)
+
+    def bad_span(ctx):
+        with trace.span(ctx, "never.catalogued"):
+            pass
+
+    def bad_prose_span(ctx):
+        with trace.span(ctx, "not.a.span"):
+            pass
+    """
+    r = _vet(tmp_path, {"mod.py": src, "metrics.py": "",
+                        "trace.py": ""})
+    keys = sorted(f.key for f in r.findings)
+    assert keys == [
+        "fixpkg/mod.py:bad_dynamic#metric-dynamic-observe",
+        "fixpkg/mod.py:bad_metric#metric-app.typo",
+        # strict prefix of a catalogued name ("app.good") must not ride
+        # on it — only a dot boundary matches labeled variants
+        "fixpkg/mod.py:bad_prefix#metric-app.goo",
+        # a token backticked in tracing.md PROSE is not catalogued —
+        # only a first-column table row vouches for a span name
+        "fixpkg/mod.py:bad_prose_span#span-not.a.span",
+        "fixpkg/mod.py:bad_span#span-never.catalogued",
+    ], r.render()
+    assert _rules(r) == ["NV-literal"] * 5
+
+
+# ---------------------------------------------------------------------------
+# baseline ledger round-trip
+# ---------------------------------------------------------------------------
+
+BARE = """
+    def f(x):
+        try:
+            return x()
+        except:
+            return None
+"""
+
+
+def _baseline(tmp_path, body: str) -> str:
+    p = tmp_path / "baseline.toml"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_baseline_suppresses_and_records_reason(tmp_path):
+    bl = _baseline(tmp_path, """
+        [[suppress]]
+        rule = "NV-except"
+        key = "fixpkg/mod.py:f#bare-except"
+        reason = "fixture: reviewed and accepted"
+    """)
+    r = _vet(tmp_path, {"mod.py": BARE}, baseline=bl)
+    assert r.findings == [] and r.stale == [] and r.errors == []
+    assert r.gate_count == 0
+    ((f, s),) = r.suppressed
+    assert f.key == "fixpkg/mod.py:f#bare-except"
+    assert s.reason == "fixture: reviewed and accepted"
+
+
+def test_baseline_stale_entry_gates(tmp_path):
+    """A suppression for code that no longer trips is itself an error —
+    the ledger must shrink in the PR that fixes the code."""
+    bl = _baseline(tmp_path, """
+        [[suppress]]
+        rule = "NV-except"
+        key = "fixpkg/mod.py:f#bare-except"
+        reason = "fixture"
+
+        [[suppress]]
+        rule = "NV-thread"
+        key = "fixpkg/gone.py:G.f#thread-unnamed-t"
+        reason = "the code this excused was deleted"
+    """)
+    r = _vet(tmp_path, {"mod.py": BARE}, baseline=bl)
+    assert r.findings == []
+    assert [s.key for s in r.stale] == [
+        "fixpkg/gone.py:G.f#thread-unnamed-t"
+    ]
+    assert r.gate_count == 1
+    assert "stale" in r.render()
+
+
+def test_baseline_requires_one_line_reason(tmp_path):
+    bl = _baseline(tmp_path, """
+        [[suppress]]
+        rule = "NV-except"
+        key = "fixpkg/mod.py:f#bare-except"
+        reason = ""
+    """)
+    r = _vet(tmp_path, {"mod.py": BARE}, baseline=bl)
+    # the entry is a ledger defect AND does not suppress
+    assert r.errors and "reason" in r.errors[0]
+    assert [f.key for f in r.findings] == ["fixpkg/mod.py:f#bare-except"]
+    assert r.gate_count == 2
+
+
+def test_fallback_toml_parser_quotes_in_comments():
+    """The pre-3.11 fallback parser (LIVE on this box) must stop the
+    value at the first unescaped quote: a greedy `"(.*)"` ran through
+    quotes inside a trailing comment, corrupting the key so the entry
+    both failed to suppress AND read as stale."""
+    from nomad_tpu.analysis.engine import _parse_suppress_toml
+
+    data = _parse_suppress_toml(
+        '[[suppress]]\n'
+        'rule = "NV-lock-blocking"\n'
+        'key = "pkg/m.py:C.f#sendall@C._wlock" # sendall "is" the point\n'
+        'reason = "say \\"why\\" here"\n'
+    )
+    entry = data["suppress"][0]
+    assert entry["key"] == "pkg/m.py:C.f#sendall@C._wlock"
+    assert entry["reason"] == 'say "why" here'
+
+
+def test_narrowed_rule_run_skips_stale_check(tmp_path):
+    """`operator vet -rule X` must not brand other rules' ledger
+    entries stale."""
+    bl = _baseline(tmp_path, """
+        [[suppress]]
+        rule = "NV-except"
+        key = "fixpkg/mod.py:f#bare-except"
+        reason = "fixture"
+    """)
+    r = _vet(tmp_path, {"mod.py": BARE}, rules=["NV-thread"],
+             baseline=bl)
+    assert r.findings == [] and r.stale == []
+
+
+def test_missing_explicit_baseline_errors(tmp_path):
+    """A typo'd -baseline path is an error, not an empty ledger (which
+    would surface every baselined finding as confusing gate noise)."""
+    with pytest.raises(ValueError, match="baseline ledger not found"):
+        _vet(tmp_path, {"mod.py": BARE},
+             baseline=str(tmp_path / "nope.toml"))
+
+
+def test_malformed_dynamic_edges_error():
+    """Edge objects without from/to raise ValueError (the CLI maps it
+    to the exit-2 one-liner, not a traceback)."""
+    with pytest.raises(ValueError, match="from"):
+        dynamic_edges_from_json('[{"src": "a", "dst": "b"}]')
+    assert dynamic_edges_from_json(
+        '{"edges": [{"from": "a", "to": "b"}]}'
+    ) == [{"from": "a", "to": "b"}]
+
+
+def test_unknown_rule_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        _vet(tmp_path, {"mod.py": "x = 1\n"}, rules=["NV-bogus"])
+
+
+# ---------------------------------------------------------------------------
+# racecheck integration: edge export + Condition-wait tracking
+# ---------------------------------------------------------------------------
+
+
+def test_racecheck_condition_wait_updates_held_stack():
+    """The explicit _release_save/_acquire_restore hooks: a cv.wait
+    over a tracked RLock releases EVERY recursion level from the
+    held-before stack and restores them on reacquire (the old
+    __getattr__ delegation handed Condition the raw RLock's hooks, so
+    the stack kept a phantom hold through the park)."""
+    from nomad_tpu.testing import racecheck
+
+    racecheck.reset()
+    try:
+        tl = racecheck._TrackedLock(threading.RLock())
+        cls = tl._cls
+        tl.acquire()
+        tl.acquire()
+        assert racecheck._held().count(cls) == 2
+        state = tl._release_save()
+        assert racecheck._held().count(cls) == 0
+        tl._acquire_restore(state)
+        assert racecheck._held().count(cls) == 2
+        tl.release()
+        tl.release()
+        assert racecheck._held().count(cls) == 0
+        # Condition over the tracked lock: wait() round-trips the
+        # stack; notify() requires a working _is_owned
+        cv = threading.Condition(racecheck._TrackedLock(threading.RLock()))
+        with cv:
+            cv.wait(0.01)
+            cv.notify_all()
+        assert racecheck._held() == []
+    finally:
+        racecheck.reset()
+
+
+def test_racecheck_edges_export_stable_json():
+    from nomad_tpu.testing import racecheck
+
+    racecheck.reset()
+    try:
+        a = racecheck._TrackedLock(threading.Lock())
+        b = racecheck._TrackedLock(threading.Lock())
+        with a:
+            with b:
+                pass
+        exported = racecheck.edges()
+        assert {"from": racecheck._rel(a._cls),
+                "to": racecheck._rel(b._cls)} in exported
+        doc = racecheck.export_json()
+        # stable JSON: dumps round-trips and the engine parser reads
+        # both the bare list and the full document
+        parsed = dynamic_edges_from_json(json.dumps(doc))
+        assert parsed == exported
+        assert dynamic_edges_from_json(
+            json.dumps(doc["edges"])) == exported
+        # this file lives in the repo -> classes are repo-relative
+        assert all(not e["from"].startswith("/")
+                   for e in exported), exported
+    finally:
+        racecheck.reset()
+
+
+def test_real_tree_crosscheck_with_dynamic_run():
+    """End to end across the two detectors: a subprocess exercises the
+    REAL broker/plan-queue locks under racecheck, exports edges(), and
+    NV-lock-order consumes them — the cross-check classifies coverage
+    gaps as advisories and still gates at zero findings."""
+    script = r"""
+import json, sys
+sys.path.insert(0, %r)
+from nomad_tpu.testing import racecheck
+racecheck.install()
+try:
+    from nomad_tpu.server.eval_broker import EvalBroker
+    from nomad_tpu.server.plan_queue import PlanQueue
+    broker = EvalBroker()
+    broker.set_enabled(True)
+    q = PlanQueue()
+    q.set_enabled(True)
+    q.depth()
+    broker.stats_snapshot()
+finally:
+    racecheck.uninstall()
+print(json.dumps(racecheck.export_json()))
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", script % REPO_ROOT],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["violations"] == []
+    dyn = dynamic_edges_from_json(json.dumps(doc))
+    r = run_vet(rules=["NV-lock-order"], dynamic_edges=dyn)
+    assert r.findings == [], r.render()
+    # the static model sees edges this tiny dynamic run never took
+    assert any(f.key.startswith("edge-uncovered") for f in r.advisories)
+
+
+# ---------------------------------------------------------------------------
+# the CI gate over the real tree
+# ---------------------------------------------------------------------------
+
+
+def test_production_tree_zero_unsuppressed_under_10s():
+    """THE acceptance gate: the full walk over the production tree
+    reports zero unsuppressed findings — every accepted finding lives
+    in analysis/baseline.toml with a one-line reason, no entry is
+    stale — and completes inside the 10s CI budget."""
+    t0 = time.perf_counter()
+    r = run_vet()
+    elapsed = time.perf_counter() - t0
+    if elapsed >= 10.0:
+        # timing noise is one-sided (suite-tail load can only slow the
+        # walk): one retry, best-of-two — a real perf regression fails
+        # both passes
+        t0 = time.perf_counter()
+        run_vet()
+        elapsed = min(elapsed, time.perf_counter() - t0)
+    assert r.gate_count == 0, "\n" + r.render()
+    assert r.errors == []
+    assert r.stale == []
+    # the walk really covered the tree
+    assert r.modules > 100 and r.locks > 30, (r.modules, r.locks)
+    assert r.edges > 0
+    # every suppression earned its place this run
+    assert all(s.matched for _f, s in r.suppressed)
+    assert elapsed < 10.0, f"full walk took {elapsed:.1f}s"
+
+
+def test_rule_ids_documented():
+    """Every gate rule id appears in docs/static-analysis.md — the
+    catalogue can't drift from the engine."""
+    doc = open(os.path.join(REPO_ROOT, "docs",
+                            "static-analysis.md")).read()
+    for rule in GATE_RULES:
+        assert rule in doc, f"{rule} missing from docs/static-analysis.md"

@@ -785,6 +785,13 @@ REFERENCE_COMMAND_FLAGS = {
         "flags": {"-interval", "-n", "-once"}, "args": [],
     },
     "operator profile stacks": {"flags": {"-output"}, "args": []},
+    # Round 13 (static-analysis PR): extended 33 -> 34 with nomad-vet
+    # (nomad_tpu/analysis; purely local, no agent connection).
+    "operator vet": {
+        "flags": {"-json", "-rule", "-baseline", "-dynamic-edges",
+                  "-advisory"},
+        "args": [],
+    },
     "event stream": {
         "flags": {"-topic", "-index", "-namespace"}, "args": [],
     },
@@ -892,10 +899,10 @@ def test_cli_breadth_vs_reference_command_list():
 
 
 def test_high_traffic_command_flag_sets():
-    """The 33 highest-traffic commands expose exactly the flag surface
+    """The 34 highest-traffic commands expose exactly the flag surface
     the embedded reference registry records — catches both a dropped
     flag and an unreviewed addition (which must be registered here)."""
-    assert len(REFERENCE_COMMAND_FLAGS) >= 33
+    assert len(REFERENCE_COMMAND_FLAGS) >= 34
     for cmd, want in REFERENCE_COMMAND_FLAGS.items():
         flags, args = _command_surface(cmd)
         assert flags == want["flags"], (

@@ -351,6 +351,53 @@ def test_periodic_every_spec():
     assert next_launch(cfg, 1000.0) == 1030.0
 
 
+def test_periodic_ambiguous_raft_failure_keeps_reservation():
+    """An outcome-unknown raft failure (LeadershipLostError, timeout)
+    must keep the child-id reservation — the entry can still commit
+    after the raise, and releasing the id would let a racer probe
+    (not reserved, not yet in state) and silently upsert over the
+    late-committing child. A pre-submit NotLeaderError is known not to
+    have reached the log and releases the id."""
+    from nomad_tpu.server.raft_replication import (LeadershipLostError,
+                                                   NotLeaderError)
+
+    p = Pipe()
+    job = mock.job()
+    job.type = "batch"
+    job.periodic = PeriodicConfig(enabled=True, spec="*/5 * * * *")
+    p.raft_apply("job_register", (job, None))
+    pd = PeriodicDispatch(p.state, p.raft_apply)
+
+    def raising(exc):
+        def apply(op, args):
+            raise exc
+        return apply
+
+    # ambiguous: deposed mid-replication — id stays reserved, and the
+    # next launch at the same second steers to ts+1
+    pd.raft_apply = raising(LeadershipLostError("deposed"))
+    with pytest.raises(LeadershipLostError):
+        pd.create_child(job, 1000)
+    assert (job.namespace, f"{job.id}/periodic-1000") in pd._launch_reserved
+    pd.raft_apply = p.raft_apply
+    assert pd.create_child(job, 1000) == f"{job.id}/periodic-1001"
+
+    # ambiguous: commit-stall timeout — same containment
+    pd.raft_apply = raising(TimeoutError("raft apply timed out"))
+    with pytest.raises(TimeoutError):
+        pd.create_child(job, 2000)
+    assert (job.namespace, f"{job.id}/periodic-2000") in pd._launch_reserved
+
+    # definite: pre-submit not-leader refusal never reached the log —
+    # the id is free for the retry that lands on the new leader
+    pd.raft_apply = raising(NotLeaderError("not leader"))
+    with pytest.raises(NotLeaderError):
+        pd.create_child(job, 3000)
+    assert (job.namespace, f"{job.id}/periodic-3000") not in pd._launch_reserved
+    pd.raft_apply = p.raft_apply
+    assert pd.create_child(job, 3000) == f"{job.id}/periodic-3000"
+
+
 # ---------------------------------------------------------------------------
 # Core GC
 # ---------------------------------------------------------------------------
