@@ -125,6 +125,11 @@ _AGENT_WRITE = [
     # gossip-join mutates membership (reference agent:write)
     ("PUT", re.compile(r"^/v1/agent/join$")),
     ("POST", re.compile(r"^/v1/agent/join$")),
+    # keyring rotation swaps the fabric's live auth secret (reference
+    # keyring management is agent:write); status stays agent:read via
+    # the broader GET rule below
+    ("PUT", re.compile(r"^/v1/agent/keyring/rotate$")),
+    ("POST", re.compile(r"^/v1/agent/keyring/rotate$")),
 ]
 _AGENT_READ = [
     ("GET", re.compile(r"^/v1/agent/.*$")),

@@ -161,8 +161,13 @@ class AgentConfig:
     # acl stanza
     acl_enabled: bool = False
     # cluster shared secret authenticating the RPC fabric (rpc/server.py
-    # trust-boundary note); empty ⇒ dev-mode trust-the-network
+    # trust-boundary note); empty ⇒ dev-mode trust-the-network.
+    # Changing it in the config file + SIGHUP rotates the LIVE keyring
+    # (Agent.reload): the old secret stays accepted for
+    # rpc_secret_window_s while the rollout reaches every agent
+    # (rpc/keyring.py dual-accept window).
     rpc_secret: str = ""
+    rpc_secret_window_s: float = 60.0
     # dev mode: in-memory raft (the reference's -dev is ephemeral too)
     dev_mode: bool = False
     # pprof surface (reference enable_debug: off unless dev mode)
@@ -242,6 +247,16 @@ class Agent:
                 "tls { http/rpc = true } requires cert_file and key_file"
             )
         self.config = config
+        # ONE keyring for every socket this agent owns — the server's
+        # listener+dialer pool and the client's streaming listener and
+        # ad-hoc pools all share it, so a single rotate() (SIGHUP with
+        # a new rpc_secret, or POST /v1/agent/keyring/rotate) moves the
+        # whole agent atomically (rpc/keyring.py).
+        from ..rpc.keyring import Keyring
+
+        self.keyring = Keyring(
+            config.rpc_secret, window_s=config.rpc_secret_window_s
+        )
         self.fabric_tls = None
         if config.tls_rpc:
             from ..rpc.tls import fabric_contexts
@@ -296,7 +311,7 @@ class Agent:
                 enabled_schedulers=config.enabled_schedulers,
                 region=config.region,
                 bootstrap_expect=expect,
-                rpc_secret=config.rpc_secret,
+                rpc_secret=self.keyring,
                 data_dir=None if config.dev_mode else config.data_dir,
                 acl_enforce=config.acl_enabled,
                 tls=self.fabric_tls,
@@ -319,7 +334,7 @@ class Agent:
                     raise ValueError("client agent needs `servers` addresses")
                 rpc = ClusterRPC(
                     [tuple(a) for a in config.client_servers],
-                    rpc_secret=config.rpc_secret,
+                    rpc_secret=self.keyring,
                     tls_context=(
                         self.fabric_tls[1] if self.fabric_tls else None
                     ),
@@ -335,7 +350,7 @@ class Agent:
                 data_dir=config.data_dir,
                 datacenter=config.datacenter,
                 node_class=config.node_class,
-                rpc_secret=config.rpc_secret,
+                rpc_secret=self.keyring,
                 advertise_host=config.bind_addr,
                 csi_plugins=config.csi_plugins,
                 tls=self.fabric_tls,
@@ -355,6 +370,12 @@ class Agent:
                 port=config.http_port,
                 acl_resolver=resolver,
                 enable_debug=config.enable_debug or config.dev_mode,
+                # API rotation must move the in-memory config too, or
+                # the next SIGHUP would diff against the boot secret
+                # and silently rotate BACK to the config file's value
+                on_keyring_rotate=lambda s: setattr(
+                    self.config, "rpc_secret", s
+                ),
                 tls_cert=(
                     config.tls_cert_file if config.tls_http else ""
                 ),
@@ -482,6 +503,27 @@ class Agent:
         changes for operator logs."""
         changed: list[str] = []
         old = self.config
+        # rpc_secret rotation (the SIGHUP keyring push): the shared
+        # keyring swaps the new secret in as current and keeps the old
+        # one accepted for the dual-accept window; every listener and
+        # pool in this agent reads the keyring live, so nothing is
+        # restarted and established connections keep flowing. Rotating
+        # BACK within the window swaps the slots again; a re-SIGHUP
+        # with an unchanged secret is a no-op (Keyring.rotate contract).
+        if new_config.rpc_secret_window_s != old.rpc_secret_window_s:
+            self.keyring.window_s = new_config.rpc_secret_window_s
+            old.rpc_secret_window_s = new_config.rpc_secret_window_s
+        if new_config.rpc_secret != old.rpc_secret:
+            if not new_config.rpc_secret:
+                # refuse rather than silently opening the fabric — see
+                # Keyring.rotate; removing auth needs a restart
+                raise ValueError(
+                    "cannot remove rpc_secret via reload (restart "
+                    "the agent to disable fabric auth)"
+                )
+            if self.keyring.rotate(new_config.rpc_secret):
+                changed.append("rpc_secret")
+            old.rpc_secret = new_config.rpc_secret
         # Always re-read the material when TLS is on: operators rotate
         # certs IN PLACE (same path, new content) at least as often as
         # they change paths, and a path compare would silently skip

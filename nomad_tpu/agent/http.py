@@ -84,11 +84,16 @@ class HTTPAgentServer:
         enable_debug: bool = False,  # pprof off unless opted in (reference)
         tls_cert: str = "",  # PEM cert+key enable HTTPS (reference:
         tls_key: str = "",   # tls { http = true } agent stanza)
+        on_keyring_rotate=None,  # fn(secret) — the Agent syncs its
+                                 # in-memory config so a later SIGHUP
+                                 # diff is computed against the LIVE
+                                 # secret, not the boot-time one
     ) -> None:
         self.cluster = cluster
         self.client = client
         self.acl_resolver = acl_resolver
         self.enable_debug = enable_debug
+        self.on_keyring_rotate = on_keyring_rotate
         # Per-namespace token buckets on the HTTP front door (disabled
         # until limits{} config sets a rate; SIGHUP-reconfigurable).
         from ..ratelimit import KeyedRateLimiter
@@ -164,7 +169,11 @@ class HTTPAgentServer:
         self._thread.start()
 
     def shutdown(self) -> None:
-        self._httpd.shutdown()
+        # socketserver.shutdown() blocks on an event that only
+        # serve_forever() sets — on a constructed-but-never-started
+        # agent it would wait forever; just close the listener.
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
@@ -1399,7 +1408,45 @@ class HTTPAgentServer:
                     "leader": self.cluster.is_leader(),
                     "raft_last_index": self.cluster.raft.last_index,
                 },
+                # fabric-auth keyring state: generation, key age, and
+                # whether the dual-accept rotation window is open —
+                # fingerprints only, never secrets (rpc/keyring.py)
+                "keyring": self.cluster.keyring.status(),
             }
+
+        def agent_keyring(p, q, body, tok):
+            return self.cluster.keyring.status()
+
+        def agent_keyring_rotate(p, q, body, tok):
+            # Rotate THIS agent's keyring in place (the API analog of
+            # editing rpc_secret + SIGHUP): the new secret becomes
+            # current, the old stays accepted for the window. The
+            # operator runs this against each agent in turn — the
+            # window plus the ConnPool previous-secret fallback keeps
+            # the mixed cluster flowing either way.
+            secret = (body or {}).get("Secret", "")
+            if not secret:
+                raise HTTPError(400, "Secret required")
+            window = (body or {}).get("Window")
+            try:
+                rotated = self.cluster.keyring.rotate(
+                    secret,
+                    window_s=(
+                        float(window) if window is not None else None
+                    ),
+                )
+            except (TypeError, ValueError) as e:
+                raise HTTPError(400, f"invalid rotation: {e}")
+            if rotated and self.on_keyring_rotate is not None:
+                self.on_keyring_rotate(secret)
+            out = self.cluster.keyring.status()
+            out["rotated"] = rotated
+            # The keyring is process state, not persisted: the operator
+            # must also put the new secret in the config file or the
+            # next RESTART boots with the stale one (runbook step in
+            # docs/operations.md).
+            out["persisted"] = False
+            return out
 
         def agent_health(p, q, body, tok):
             return {"server": {"ok": True}, "client": {"ok": self.client is not None}}
@@ -1726,6 +1773,9 @@ class HTTPAgentServer:
         route("GET", "/v1/agent/pprof/heap", pprof_heap)
         route("GET", "/v1/agent/members", agent_members)
         route("GET", "/v1/agent/self", agent_self)
+        route("GET", "/v1/agent/keyring", agent_keyring)
+        route("PUT", "/v1/agent/keyring/rotate", agent_keyring_rotate)
+        route("POST", "/v1/agent/keyring/rotate", agent_keyring_rotate)
         route("GET", "/v1/agent/monitor", agent_monitor)
         route("GET", "/v1/agent/health", agent_health)
         route("PUT", "/v1/agent/join", agent_join)

@@ -818,6 +818,21 @@ class AgentAPI(_Resource):
     def self(self):
         return self.c.get("/v1/agent/self")
 
+    def keyring_status(self):
+        """Fabric-auth keyring state (/v1/agent/keyring): generation,
+        key age, dual-accept window — fingerprints only, never the
+        secrets. Rendered by `operator keyring status`."""
+        return self.c.get("/v1/agent/keyring")
+
+    def keyring_rotate(self, secret: str, window_s=None):
+        """Rotate this agent's fabric secret live (the API analog of
+        editing rpc_secret + SIGHUP); old secret stays accepted for the
+        dual-accept window."""
+        body = {"Secret": secret}
+        if window_s is not None:
+            body["Window"] = window_s
+        return self.c.put("/v1/agent/keyring/rotate", body)
+
     def health(self):
         return self.c.get("/v1/agent/health")
 

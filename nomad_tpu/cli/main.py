@@ -199,6 +199,10 @@ def _load_agent_config(path: str):
     ):
         if k in a:
             setattr(cfg, k, a[k])
+    if "rpc_secret_window" in a:
+        from ..jobspec.hcl import parse_duration
+
+        cfg.rpc_secret_window_s = parse_duration(a["rpc_secret_window"])
     sb = body.block("server")
     if sb is not None:
         sa = sb.body.attrs()
@@ -1625,6 +1629,53 @@ def cmd_operator_keygen(args) -> int:
     import secrets as _secrets
 
     print(base64.b64encode(_secrets.token_bytes(32)).decode())
+    return 0
+
+
+def _render_keyring_status(st: dict) -> None:
+    print(f"Enabled          = {st.get('enabled')}")
+    print(f"Generation       = {st.get('generation')}")
+    print(f"Current Key      = {st.get('current_fingerprint') or '(none)'}")
+    print(f"Key Age          = {st.get('age_s')}s")
+    if st.get("dual_accept"):
+        print(
+            f"Dual-Accept      = open (previous "
+            f"{st.get('previous_fingerprint')}, "
+            f"{st.get('window_remaining_s')}s remaining)"
+        )
+    else:
+        print("Dual-Accept      = closed")
+
+
+def cmd_operator_keyring_status(args) -> int:
+    """Reference: command/operator_keyring.go list — here the fabric
+    rpc_secret keyring (rpc/keyring.py), fingerprints only."""
+    api = _client(args)
+    st = api.agent.keyring_status()
+    if args.as_json:
+        print(json.dumps(st, indent=2))
+        return 0
+    _render_keyring_status(st)
+    return 0
+
+
+def cmd_operator_keyring_rotate(args) -> int:
+    """Rotate the TARGET AGENT's fabric secret live (the API analog of
+    editing rpc_secret + SIGHUP; run against each agent in turn — the
+    dual-accept window keeps the mixed cluster flowing)."""
+    from ..jobspec.hcl import parse_duration
+
+    api = _client(args)
+    window = parse_duration(args.window) if args.window else None
+    st = api.agent.keyring_rotate(args.secret, window_s=window)
+    if args.as_json:
+        print(json.dumps(st, indent=2))
+        return 0
+    if st.get("rotated"):
+        print("Keyring rotated!")
+    else:
+        print("Keyring unchanged (secret already current)")
+    _render_keyring_status(st)
     return 0
 
 
@@ -3272,6 +3323,29 @@ def build_parser() -> argparse.ArgumentParser:
     opas.set_defaults(fn=cmd_operator_autopilot_set)
     opkg = opsub.add_parser("keygen")
     opkg.set_defaults(fn=cmd_operator_keygen)
+    opkr = opsub.add_parser(
+        "keyring", help="fabric rpc_secret keyring (dual-accept rotation)"
+    )
+    opkrsub = opkr.add_subparsers(dest="subsubcmd")
+    opkrs = opkrsub.add_parser(
+        "status", help="keyring generation/age/window (/v1/agent/keyring)"
+    )
+    opkrs.add_argument("-json", action="store_true", dest="as_json")
+    opkrs.set_defaults(fn=cmd_operator_keyring_status)
+    opkrr = opkrsub.add_parser(
+        "rotate", help="install a new secret on the target agent, live"
+    )
+    opkrr.add_argument(
+        "-secret", required=True,
+        help="the new cluster secret (see `operator keygen`)",
+    )
+    opkrr.add_argument(
+        "-window", default="",
+        help="dual-accept window for the old secret (e.g. 60s; "
+        "default: the agent's rpc_secret_window)",
+    )
+    opkrr.add_argument("-json", action="store_true", dest="as_json")
+    opkrr.set_defaults(fn=cmd_operator_keyring_rotate)
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
     opmet.set_defaults(fn=cmd_operator_metrics)

@@ -150,7 +150,7 @@ class AllocRunner:
                 self.allocdir,
                 lambda aid: self._client.alloc_runners.get(aid),
                 rpc=self._client.rpc,
-                secret=self._client.endpoints.rpc.secret,
+                secret=self._client.endpoints.rpc.keyring,
                 tls_context=(
                     self._client.tls[1] if self._client.tls else None
                 ),

@@ -26,7 +26,7 @@ class PrevAllocMigrator:
         allocdir,
         local_runner_fn: Callable[[str], Optional[object]],
         rpc=None,
-        secret: str = "",
+        secret="",  # str | rpc.keyring.Keyring
         wait_timeout_s: float = 30.0,
         tls_context=None,
     ) -> None:

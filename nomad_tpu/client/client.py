@@ -94,7 +94,7 @@ class Client:
         node_class: str = "",
         node: Optional[Node] = None,
         drivers: Optional[dict[str, Driver]] = None,
-        rpc_secret: str = "",
+        rpc_secret="",  # str | rpc.keyring.Keyring (shared by the agent)
         advertise_host: str = "127.0.0.1",
         csi_plugins: Optional[dict] = None,
         driver_plugins: Optional[dict] = None,  # name -> "module:Class"
@@ -120,9 +120,14 @@ class Client:
         # advertise_host must be reachable FROM the servers (the agent
         # passes its bind_addr; loopback only works single-host).
         from .endpoints import ClientEndpoints
+        from ..rpc.keyring import ensure_keyring
 
+        # One keyring for the streaming listener and every dialer this
+        # client spawns (reverse-dial, prev-alloc migration): a live
+        # rpc_secret rotation moves them all together (rpc/keyring.py).
+        self.keyring = ensure_keyring(rpc_secret)
         self.endpoints = ClientEndpoints(
-            self, host=advertise_host, secret=rpc_secret,
+            self, host=advertise_host, secret=self.keyring,
             tls_context=tls[0] if tls else None,
         )
         host, port = self.endpoints.addr
@@ -233,7 +238,7 @@ class Client:
 
             self._reverse = ReverseDialer(
                 self, self.endpoints, addrs_fn,
-                secret=self.endpoints.rpc.secret,
+                secret=self.keyring,
                 tls_context=self.tls[1] if self.tls else None,
             )
             self._reverse.start()

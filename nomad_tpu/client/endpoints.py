@@ -34,7 +34,7 @@ CHUNK = 64 * 1024
 class ClientEndpoints:
     """Owns the client agent's listener and its stream handlers."""
 
-    def __init__(self, client, host: str = "127.0.0.1", secret: str = "",
+    def __init__(self, client, host: str = "127.0.0.1", secret="",
                  tls_context=None) -> None:
         self.client = client
         self.rpc = RPCServer(
@@ -495,7 +495,7 @@ class ReverseDialer:
         endpoints: ClientEndpoints,
         addrs_fn,  # () -> list[(host, port)] of server fabric addrs
         idle_target: int = 2,
-        secret: str = "",
+        secret="",  # str | rpc.keyring.Keyring
         retry_s: float = 2.0,
         tls_context=None,
     ) -> None:

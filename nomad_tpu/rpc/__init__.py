@@ -1,6 +1,14 @@
 """RPC fabric (reference: nomad/rpc.go, helper/pool/)."""
 
-from .client import ConnPool, RPCError
+from .client import AuthFailedError, ConnPool, RPCError
+from .keyring import Keyring
 from .server import RPCServer, StreamSession
 
-__all__ = ["ConnPool", "RPCError", "RPCServer", "StreamSession"]
+__all__ = [
+    "AuthFailedError",
+    "ConnPool",
+    "Keyring",
+    "RPCError",
+    "RPCServer",
+    "StreamSession",
+]

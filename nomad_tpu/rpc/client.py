@@ -17,6 +17,7 @@ from typing import Optional
 
 from .. import codec, metrics, trace
 from .. import faultplane
+from .keyring import ensure_keyring
 from .server import StreamSession
 from .wire import (
     BYTE_RPC,
@@ -32,6 +33,18 @@ logger = logging.getLogger("nomad_tpu.rpc")
 
 class RPCError(Exception):
     """A handler-side error string carried back over the wire."""
+
+
+class AuthFailedError(ConnectionError):
+    """The peer rejected our secret at the connection preamble. Nothing
+    pipelined behind the preamble was ever dispatched (the server
+    authenticates BEFORE its request loop), so `request_sent` is False:
+    callers may redial and re-send blindly — the pool does, re-reading
+    its keyring so a rotated secret takes effect without a restart."""
+
+    def __init__(self, msg: str = "permission denied: bad rpc secret"):
+        super().__init__(msg)
+        self.request_sent = False
 
 
 class _Conn:
@@ -57,6 +70,10 @@ class _Conn:
         self._pending: dict[int, dict] = {}
         self._pending_lock = threading.Lock()
         self.dead = False
+        # set by the reader when the peer answers the preamble with an
+        # auth reject (rotated secret): pending + future calls fail
+        # with AuthFailedError instead of a generic dead-conn error
+        self.auth_failed = False
         self._reader = threading.Thread(
             target=self._read_loop, name="rpc-conn-reader", daemon=True
         )
@@ -66,6 +83,11 @@ class _Conn:
         try:
             while True:
                 resp = codec.unpack(recv_frame(self.sock))
+                if isinstance(resp, dict) and "auth_error" in resp:
+                    # preamble reject (rpc/server.py _authenticate):
+                    # the server dispatched nothing on this connection
+                    self.auth_failed = True
+                    return
                 with self._pending_lock:
                     waiter = self._pending.pop(resp.get("seq"), None)
                 if waiter is not None:
@@ -86,7 +108,11 @@ class _Conn:
             with self._pending_lock:
                 pending, self._pending = self._pending, {}
             for waiter in pending.values():
-                waiter["resp"] = {"error": "connection closed"}
+                waiter["resp"] = (
+                    {"error": "auth failed", "auth_error": True}
+                    if self.auth_failed
+                    else {"error": "connection closed"}
+                )
                 waiter["event"].set()
 
     def call(self, method: str, args, timeout_s: float):
@@ -100,6 +126,8 @@ class _Conn:
         waiter = {"event": threading.Event(), "resp": None}
         with self._pending_lock:
             if self.dead:
+                if self.auth_failed:
+                    raise AuthFailedError()
                 err = ConnectionError("connection closed")
                 err.request_sent = False
                 raise err
@@ -142,6 +170,8 @@ class _Conn:
         if tctx is not None and resp.get(TRACE_SPANS_KEY):
             tctx.merge_remote(resp[TRACE_SPANS_KEY], rpc_span)
         if "error" in resp:
+            if resp.get("auth_error"):
+                raise AuthFailedError()
             if resp["error"] == "connection closed":
                 err = ConnectionError("connection closed")
                 err.request_sent = True  # delivered; the reply was lost
@@ -161,17 +191,28 @@ class _Conn:
 class ConnPool:
     """Pooled RPC connections keyed by address (reference helper/pool)."""
 
-    def __init__(self, connect_timeout_s: float = 5.0, secret: str = "",
+    def __init__(self, connect_timeout_s: float = 5.0, secret="",
                  tls_context=None) -> None:
         self._conns: dict[tuple[str, int], _Conn] = {}
         self._lock = threading.Lock()
         self._connect_timeout_s = connect_timeout_s
-        self.secret = secret
+        # Dual-accept keyring (rpc/keyring.py): the CURRENT secret is
+        # read at every dial, never cached per-connection state — a
+        # rotation pushed via SIGHUP takes effect on the next redial
+        # without restarting the process. A plain string gets a private
+        # keyring; the agent passes its shared instance.
+        self.keyring = ensure_keyring(secret)
         self.tls_context = tls_context  # ssl client ctx — fabric TLS
         # Fault-plane identity: the owning node's label (ClusterServer
         # sets its node_id) so injected partitions can match this pool's
         # outbound calls. Empty = an unlabeled client pool.
         self.owner = ""
+
+    @property
+    def secret(self) -> str:
+        """The current dial secret (legacy accessor — prefer sharing
+        the keyring itself so rotation propagates)."""
+        return self.keyring.current
 
     def call(
         self,
@@ -196,8 +237,11 @@ class ConnPool:
         # bounded.
         t0 = time.perf_counter()
         try:
-            for _ in range(retries + 1):
-                conn = self._get(addr)
+            attempts = retries + 1
+            use_previous = False
+            while attempts > 0:
+                attempts -= 1
+                conn = self._get(addr, use_previous=use_previous)
                 try:
                     # Fault plane (faultplane.py): injected drops/
                     # delays/partitions act here, inside the attempt, so
@@ -209,6 +253,21 @@ class ConnPool:
                     if faultplane.plane is not None:
                         faultplane.plane.on_rpc_call(self.owner, addr, method)
                     return conn.call(method, args, timeout_s)
+                except AuthFailedError as e:
+                    last_err = e
+                    self._drop(addr, conn)
+                    # The peer rejected the secret this dial presented
+                    # (nothing was dispatched — safe to re-send). One
+                    # extra attempt presents the PREVIOUS secret: during
+                    # a staggered rotation a not-yet-rotated server
+                    # still speaks the old one (dual-accept's mirror
+                    # image, rpc/keyring.py module docs).
+                    if not use_previous and self.keyring.previous_active():
+                        use_previous = True
+                        attempts += 1
+                        metrics.incr("nomad.keyring.dial_fallback")
+                        continue
+                    raise
                 except (ConnectionError, OSError) as e:
                     last_err = e
                     self._drop(addr, conn)
@@ -224,7 +283,23 @@ class ConnPool:
     def stream(
         self, addr: tuple[str, int], method: str, header: Optional[dict] = None
     ) -> StreamSession:
-        """Open a dedicated streaming session (reference RpcStreaming)."""
+        """Open a dedicated streaming session (reference RpcStreaming).
+        Same keyring discipline as call(): present the current secret,
+        fall back to the previous one within the rotation window."""
+        try:
+            return self._stream_dial(addr, method, header,
+                                     self.keyring.current)
+        except AuthFailedError:
+            prev = self.keyring.previous_active()
+            if not prev:
+                raise
+            metrics.incr("nomad.keyring.dial_fallback")
+            return self._stream_dial(addr, method, header, prev)
+
+    def _stream_dial(
+        self, addr: tuple[str, int], method: str,
+        header: Optional[dict], secret: str,
+    ) -> StreamSession:
         sock = socket.create_connection(addr, timeout=self._connect_timeout_s)
         if self.tls_context is not None:
             sock = self.tls_context.wrap_socket(
@@ -233,24 +308,34 @@ class ConnPool:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
         sock.sendall(bytes([BYTE_STREAMING]))
-        if self.secret:
-            send_frame(sock, self.secret.encode())
+        if secret:
+            send_frame(sock, secret.encode())
         session = StreamSession(sock)
         hdr = dict(header or {})
         hdr["method"] = method
         session.send(hdr)
         ack = session.recv(timeout_s=30)
+        if isinstance(ack, dict) and "auth_error" in ack:
+            session.close()
+            raise AuthFailedError()
         if "error" in ack:
             session.close()
             raise RPCError(ack["error"])
         return session
 
-    def _get(self, addr: tuple[str, int]) -> _Conn:
+    def _get(self, addr: tuple[str, int], use_previous: bool = False) -> _Conn:
         with self._lock:
             conn = self._conns.get(addr)
             if conn is not None and not conn.dead:
                 return conn
-            conn = _Conn(addr, self._connect_timeout_s, self.secret,
+            # dial-time secret read: rotation propagates to every
+            # redial without pool (or process) restarts
+            secret = (
+                self.keyring.previous_active()
+                if use_previous
+                else self.keyring.current
+            )
+            conn = _Conn(addr, self._connect_timeout_s, secret,
                          tls_context=self.tls_context)
             self._conns[addr] = conn
             return conn

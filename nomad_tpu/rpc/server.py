@@ -33,6 +33,7 @@ from typing import Callable, Optional
 
 from .. import codec, metrics, trace
 from .. import faultplane
+from .keyring import ensure_keyring
 from .wire import (
     BYTE_RAFT,
     BYTE_RPC,
@@ -76,10 +77,13 @@ class RPCServer:
         host: str = "127.0.0.1",
         port: int = 0,
         num_workers: int = 8,
-        secret: str = "",
+        secret="",  # str | Keyring — the agent shares ONE Keyring
         tls_context=None,  # ssl.SSLContext (server side) — fabric TLS
     ) -> None:
-        self.secret = secret
+        # Dual-accept keyring (rpc/keyring.py): a plain string gets a
+        # private keyring; the agent passes its shared instance so a
+        # live rotation moves listener + dialers together.
+        self.keyring = ensure_keyring(secret)
         self.tls_context = tls_context
         self._endpoints: dict[str, object] = {}
         self._stream_handlers: dict[str, Callable[[StreamSession, dict], None]] = {}
@@ -116,6 +120,12 @@ class RPCServer:
         # Fault-plane identity (faultplane.py): the owning node's
         # label, so injected response drops can target this server.
         self.chaos_label = ""
+
+    @property
+    def secret(self) -> str:
+        """The current cluster secret (legacy accessor — prefer passing
+        the keyring itself so rotation propagates)."""
+        return self.keyring.current
 
     # -- registration --------------------------------------------------
 
@@ -189,11 +199,11 @@ class RPCServer:
 
     def _authenticate(self, conn: socket.socket) -> bool:
         """When a cluster secret is configured, require the auth
-        preamble frame before serving any protocol."""
-        if not self.secret:
+        preamble frame before serving any protocol. The keyring accepts
+        the current secret always and the previous one during the
+        dual-accept window (live rotation, rpc/keyring.py)."""
+        if not self.keyring.enabled:
             return True
-        import hmac
-
         conn.settimeout(10.0)
         try:
             presented = recv_frame(conn)
@@ -201,8 +211,36 @@ class RPCServer:
             return False
         finally:
             conn.settimeout(None)
-        if not hmac.compare_digest(presented, self.secret.encode()):
+        if not self.keyring.accepts(presented):
             logger.warning("rpc connection rejected: bad cluster secret")
+            # Tell the dialer WHY before closing: a silent close is
+            # indistinguishable from a crash, but an auth reject means
+            # "nothing you pipelined was dispatched — redial with a
+            # fresh secret" (ConnPool re-reads its keyring and falls
+            # back to the previous secret within the window).
+            try:
+                send_frame(
+                    conn,
+                    codec.pack(
+                        {"auth_error": "permission denied: bad rpc secret"}
+                    ),
+                )
+                # The frame must SURVIVE the close: the dialer pipelines
+                # request frames right behind the preamble, and closing
+                # with them unread emits an RST that discards our reject
+                # on the peer (it would see a bare ECONNRESET and skip
+                # the previous-secret fallback). Half-close so FIN
+                # follows the frame, then drain the pipelined bytes
+                # until the client sees the reject and hangs up.
+                conn.settimeout(1.0)
+                conn.shutdown(socket.SHUT_WR)
+                # bounded BOTH ways: 1s idle gap per recv, 5s overall —
+                # a peer that keeps streaming must not pin this thread
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline and conn.recv(4096):
+                    pass
+            except (ConnectionError, OSError):
+                pass
             return False
         return True
 

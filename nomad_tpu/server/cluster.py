@@ -1121,7 +1121,7 @@ class ClusterServer:
         enabled_schedulers=None,
         region: str = "global",
         bootstrap_expect: Optional[int] = None,
-        rpc_secret: str = "",
+        rpc_secret="",  # str | rpc.keyring.Keyring (shared by the agent)
         data_dir: Optional[str] = None,
         acl_enforce: bool = False,
         authoritative_region: Optional[str] = None,
@@ -1139,12 +1139,20 @@ class ClusterServer:
         self.acl_replication_interval_s = acl_replication_interval_s
         self._acl_repl_stop: Optional[threading.Event] = None
         self.tls = tls
+        # One keyring for this server's listener AND dialer (rpc/
+        # keyring.py): a live rpc_secret rotation (Agent.reload /
+        # ChaosCluster.rotate_secret) moves both sides together. The
+        # agent passes its process-shared Keyring; a plain string gets
+        # a private one.
+        from ..rpc.keyring import ensure_keyring
+
+        self.keyring = ensure_keyring(rpc_secret)
         self.rpc = RPCServer(
-            host=host, port=port, secret=rpc_secret,
+            host=host, port=port, secret=self.keyring,
             tls_context=tls[0] if tls else None,
         )
         self.pool = ConnPool(
-            secret=rpc_secret, tls_context=tls[1] if tls else None
+            secret=self.keyring, tls_context=tls[1] if tls else None
         )
         # Fault-plane identity (faultplane.py): injected partitions
         # and response drops match on these labels. No-ops in production.
@@ -1959,7 +1967,7 @@ class ClusterRPC:
         self,
         addrs: list[tuple[str, int]],
         pool: Optional[ConnPool] = None,
-        rpc_secret: str = "",
+        rpc_secret="",  # str | rpc.keyring.Keyring (shared by the agent)
         tls_context=None,  # client-side ssl ctx (rpc.tls.fabric_contexts)
     ):
         self.addrs = [tuple(a) for a in addrs]

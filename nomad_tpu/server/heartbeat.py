@@ -16,11 +16,13 @@ MAX_HEARTBEATS_PER_SECOND = 50.0
 FAILOVER_GRACE_S = 5.0
 
 
-def rate_scaled_interval(n_nodes: int) -> float:
+def rate_scaled_interval(
+    n_nodes: int, min_ttl_s: float = MIN_HEARTBEAT_TTL_S
+) -> float:
     """TTL grows with the cluster to bound heartbeat throughput
     (reference: helper lib.RateScaledInterval, heartbeat.go:104)."""
     interval = float(n_nodes) / MAX_HEARTBEATS_PER_SECOND
-    return max(MIN_HEARTBEAT_TTL_S, interval)
+    return max(min_ttl_s, interval)
 
 
 class HeartbeatTimers:
@@ -30,6 +32,11 @@ class HeartbeatTimers:
         self._timers: dict[str, threading.Timer] = {}
         self._enabled = False
         self.node_count_fn: Callable[[], int] = lambda: 1
+        # Instance-tunable TTL floor: production keeps the reference's
+        # 10s; chaos scenarios shrink it so spot-churn cycles (node dies
+        # silently → TTL expiry → down-mark → reschedule) fit a test
+        # budget without faking the expiry path.
+        self.min_ttl_s = MIN_HEARTBEAT_TTL_S
 
     def set_enabled(self, enabled: bool) -> None:
         with self._lock:
@@ -39,10 +46,20 @@ class HeartbeatTimers:
                     t.cancel()
                 self._timers.clear()
 
+    def initialize(self, node_ids) -> None:
+        """Arm a TTL for every live node at once — the new leader's
+        establish-leadership step (reference heartbeat.go
+        initializeHeartbeatTimers). Without this, a node that dies
+        during a leadership transition is never marked down: its timer
+        lived on the OLD leader and the new one only arms timers on
+        heartbeat arrival — which a dead node never sends."""
+        for node_id in node_ids:
+            self.reset(node_id)
+
     def reset(self, node_id: str) -> float:
         """(Re)arm the node's TTL; returns the TTL granted, with splay so a
         thundering herd of re-registrations doesn't expire simultaneously."""
-        ttl = rate_scaled_interval(self.node_count_fn())
+        ttl = rate_scaled_interval(self.node_count_fn(), self.min_ttl_s)
         ttl += random.uniform(0, ttl / 2)
         with self._lock:
             if not self._enabled:

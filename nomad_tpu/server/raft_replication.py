@@ -173,6 +173,12 @@ class RaftNode:
         self.leader_id: Optional[str] = None
         self._last_heartbeat = time.monotonic()
         self._votes: set[str] = set()
+        # How many times THIS node won an election (the process-global
+        # nomad.raft.leader_changes counter mixes every in-process node
+        # and counts step-downs too; per-node won-election counts let a
+        # chaos scenario bound leadership churn exactly: sum of deltas
+        # across a cluster == elections that happened).
+        self.leadership_transitions = 0
         # Leader volatile state
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
@@ -563,6 +569,7 @@ class RaftNode:
         logger.info("%s: leader for term %d", self.node_id, self.current_term)
         self.state = LEADER
         self.leader_id = self.node_id
+        self.leadership_transitions += 1
         # Churn observability: every local leadership transition counts
         # (step-downs increment in _become_follower_locked). A climbing
         # rate on `operator top` is the signature of election storms.

@@ -255,6 +255,21 @@ class Server:
         self._leader = True
         if caught_up:
             self._restore_evals()
+            # Arm a liveness TTL for every node we believe is alive
+            # (reference heartbeat.go initializeHeartbeatTimers): node
+            # TTL timers are leader-local state and died with the old
+            # leader — without re-arming, a client that crashed during
+            # the leadership transition would NEVER be marked down and
+            # its allocations would stay stranded on a dead node. Live
+            # nodes simply re-arm on their next heartbeat.
+            try:
+                self.heartbeaters.initialize(
+                    n.id
+                    for n in self.state.nodes()
+                    if n.status != NODE_STATUS_DOWN
+                )
+            except Exception:
+                logger.exception("heartbeat timer initialization failed")
         # Bootstrap the default namespace (reference leader.go
         # establishLeadership creates it so it always lists).
         try:
@@ -898,6 +913,9 @@ class Server:
     def _invalidate_heartbeat(self, node_id: str) -> None:
         """TTL expired: node is presumed dead (reference heartbeat.go:128)."""
         logger.warning("node %s missed heartbeat; marking down", node_id)
+        # churn observability: spot-node loss rate and the spot-churn
+        # scenario's "no alloc stranded past the TTL" evidence
+        metrics.incr("nomad.heartbeat.expired")
         try:
             self.node_update_status(node_id, NODE_STATUS_DOWN)
         except KeyError:

@@ -82,6 +82,10 @@ class ChaosCluster:
         # acked-write journal for the no-acked-write-lost invariant:
         # scenarios record ids here only after the RPC returned success
         self.acked_jobs: set[str] = set()
+        # Election accounting that survives kills: a killed server's
+        # in-memory raft counter dies with it, so harvest it at kill
+        # time and add the live counters on read (total_elections).
+        self._elections_harvested = 0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -128,6 +132,7 @@ class ChaosCluster:
         dir survives for restart)."""
         cs = self.servers.pop(nid, None)
         if cs is not None:
+            self._elections_harvested += cs.raft.leadership_transitions
             cs.shutdown()
 
     def restart(self, nid: str):
@@ -161,6 +166,108 @@ class ChaosCluster:
         end a partition while keeping disk/device faults live)."""
         if self.plane is not None:
             self.plane.heal(kind)
+
+    # -- production-ops scenarios --------------------------------------
+
+    def rotate_secret_on(self, nid: str, new_secret: str,
+                         window_s=None) -> bool:
+        """Rotate ONE server's keyring in place — the per-agent step of
+        a staggered rpc_secret rollout (what `Agent.reload` does on
+        SIGHUP; rpc/keyring.py dual-accept window)."""
+        cs = self.servers[nid]
+        return cs.keyring.rotate(new_secret, window_s=window_s)
+
+    def rotate_secret(self, new_secret: str, window_s=None,
+                      stagger_s: float = 0.0) -> int:
+        """Rotate every live server, optionally pausing between agents
+        (a real rollout is never simultaneous — the dual-accept window
+        plus the pool's previous-secret dial fallback is what keeps the
+        mixed cluster flowing). Future restarts boot with the new
+        secret. Returns how many keyrings actually rotated."""
+        rotated = 0
+        for nid in sorted(self.servers):
+            if self.rotate_secret_on(nid, new_secret, window_s=window_s):
+                rotated += 1
+            if stagger_s > 0:
+                time.sleep(stagger_s)
+        self.server_kw["rpc_secret"] = new_secret
+        return rotated
+
+    def total_elections(self) -> int:
+        """Elections won across the cluster's whole history, dead
+        incarnations included — the rolling-upgrade churn bound."""
+        return self._elections_harvested + sum(
+            cs.raft.leadership_transitions for cs in self.servers.values()
+        )
+
+    def wait_caught_up(self, nid: str, timeout_s: float = 45.0) -> bool:
+        """The restarted-server barrier of a rolling upgrade: wait until
+        `nid` has applied everything the CURRENT leader had committed
+        when we started waiting — i.e. its replay finished AND it is
+        accepting AppendEntries from the live leader again (so it
+        counts toward quorum for the next kill)."""
+        deadline = time.monotonic() + timeout_s
+        target = None
+        while time.monotonic() < deadline:
+            cs = self.servers.get(nid)
+            if cs is None:
+                return False
+            lead = self.leader()
+            if lead is not None and target is None:
+                target = lead.raft.commit_index
+            if target is not None and cs.raft.last_applied >= target:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def rolling_restart(
+        self,
+        order=None,
+        settle_timeout_s: float = 60.0,
+        pause_s: float = 0.0,  # dwell after each step (traffic flows
+                               # against the n-1 quorum and then the
+                               # freshly-rejoined server)
+        pre_kill=None,   # optional hook(nid) before each kill
+        post_step=None,  # optional hook(nid) after each re-join settles
+    ) -> dict:
+        """Restart every server one at a time — the rolling-upgrade
+        scenario. Between steps the roll WAITS for a stable quorum and
+        for the restarted server's replay barrier, exactly like an
+        operator following the upgrade runbook (docs/operations.md).
+        Returns evidence: servers rolled, elections across the roll,
+        and per-step timings. Raises AssertionError if any step never
+        re-converged — a roll must not proceed on a degraded quorum."""
+        elections_before = self.total_elections()
+        steps = []
+        for nid in (list(order) if order else list(self.ids)):
+            t0 = time.monotonic()
+            if pre_kill is not None:
+                pre_kill(nid)
+            if pause_s > 0:
+                time.sleep(pause_s)
+            self.kill(nid)
+            # survivors must hold (or re-establish) a working quorum
+            # before the node comes back
+            lead = self.wait_for_stable_leader(settle_timeout_s)
+            assert lead is not None, (
+                f"rolling restart: no stable leader after killing {nid}"
+            )
+            self.restart(nid)
+            assert self.wait_caught_up(nid, settle_timeout_s), (
+                f"rolling restart: {nid} never caught up after restart"
+            )
+            if post_step is not None:
+                post_step(nid)
+            if pause_s > 0:
+                time.sleep(pause_s)
+            steps.append(
+                {"node": nid, "seconds": round(time.monotonic() - t0, 2)}
+            )
+        return {
+            "restarted": len(steps),
+            "elections": self.total_elections() - elections_before,
+            "steps": steps,
+        }
 
     # -- observation ---------------------------------------------------
 

@@ -108,6 +108,17 @@ class LoadGen:
         self._nodes_down: set[str] = set()
         self._ns_backoff: dict[str, float] = {}
         self._seq = 0
+        # scenario hooks: set once run() finished registering its
+        # namespaces/nodes (scripted operations start against a warmed
+        # cluster), and stop() for drivers whose scripted operation
+        # finishes before duration_s elapses
+        self.setup_done = threading.Event()
+
+    def stop(self) -> None:
+        """End the traffic phase now (drain + report still run): the
+        production-ops scenarios call this once their scripted
+        operation — a secret rotation, a completed roll — is done."""
+        self._traffic_deadline = time.monotonic()
 
     # -- cluster access -------------------------------------------------
 
@@ -348,8 +359,15 @@ class LoadGen:
                         )
                     elif (
                         "NotLeaderError" in text
+                        or "LeadershipLostError" in text
                         or "no cluster leader" in text
                     ):
+                        # LeadershipLostError is outcome-UNKNOWN (the
+                        # write may still commit), so it is never acked
+                        # here — but it is leadership churn, not a
+                        # dropped request: the rolling-upgrade scenario
+                        # gates `failed` at zero while kills are
+                        # in-flight, and only churn may say otherwise
                         self.counts.churn_errors += 1
                     else:
                         # includes KeyError-not-found: a scale/evaluate
@@ -373,11 +391,17 @@ class LoadGen:
             .get("nomad.eval.e2e_seconds", {})
             .get("count", 0)
         )
-        self.setup()
         self._interval = 1.0 / max(0.01, cfg.rate_eval_per_s)
+        # a scenario's stop() between setup and the loop must stick: only
+        # push the deadline out, never overwrite an earlier one
+        self._traffic_deadline = float("inf")
+        self.setup()
         start = time.monotonic()
         self._next_send = start
-        self._traffic_deadline = start + cfg.duration_s
+        self._traffic_deadline = min(
+            self._traffic_deadline, start + cfg.duration_s
+        )
+        self.setup_done.set()
         threads = [
             threading.Thread(
                 target=self._submit_loop,

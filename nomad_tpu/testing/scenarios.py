@@ -1,0 +1,692 @@
+"""Production-ops resilience scenarios: the orchestrator must stay
+correct *while the operators operate on it*.
+
+Three seeded, invariant-checked scenarios over the ChaosCluster +
+LoadGen substrate (testing/chaos.py, testing/loadgen.py):
+
+- :func:`run_secret_rotation` — rotate the fabric ``rpc_secret``
+  agent-by-agent under live scheduling traffic (the SIGHUP keyring
+  push, rpc/keyring.py): zero dropped RPCs, zero auth failures during
+  the dual-accept window, and old-secret dials rejected once it closes.
+- :func:`run_rolling_upgrade` — restart every server one at a time
+  under traffic, waiting for quorum + the restarted server's replay
+  barrier between steps: no acked write lost, no duplicate alloc, and
+  leadership churn bounded by restarts + 1.
+- :func:`run_spot_churn` — a slice of the client-node fleet dies
+  (silently or via a drain notice) and is replaced every cycle while
+  jobs keep arriving: the drainer, blocked-evals containment, and the
+  scheduler keep converging, the blocked set stays bounded, and no
+  allocation is left live on a dead node past the heartbeat TTL.
+
+Each returns an evidence dict (counters, timings, invariant verdicts);
+the tests in tests/test_scenarios.py gate on it. Seeded: the fault
+plane, LoadGen op mix, and churn victim choices all draw from seeded
+RNGs, so a failing run reproduces by seed.
+
+Runbooks for the human versions of these operations:
+docs/operations.md §"Rotating the cluster secret" and §"Rolling a
+server upgrade".
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+import threading
+import time
+from typing import Optional
+
+from .. import metrics
+from ..rpc import AuthFailedError, ConnPool, Keyring
+from ..structs.structs import DrainStrategy
+from .. import mock
+from .chaos import ChaosCluster
+from .loadgen import LoadGen, LoadGenConfig
+
+logger = logging.getLogger("nomad_tpu.scenarios")
+
+_KEYRING_COUNTERS = (
+    "nomad.keyring.rotations",
+    "nomad.keyring.accept_previous",
+    "nomad.keyring.dial_fallback",
+    "nomad.keyring.auth_fail",
+)
+
+
+def _counter_snapshot(names) -> dict:
+    counters = metrics.snapshot()["counters"]
+    return {n: counters.get(n, 0) for n in names}
+
+
+def _counter_delta(names, base: dict) -> dict:
+    counters = metrics.snapshot()["counters"]
+    return {n: counters.get(n, 0) - base[n] for n in names}
+
+
+def _loadgen_thread(gen: LoadGen) -> tuple[threading.Thread, dict]:
+    box: dict = {}
+
+    def run():
+        try:
+            box["report"] = gen.run()
+        except Exception as e:  # surfaced by the caller's join
+            logger.exception("scenario loadgen failed")
+            box["error"] = e
+
+    t = threading.Thread(target=run, name="scenario-loadgen", daemon=True)
+    t.start()
+    return t, box
+
+
+def _join_loadgen(t: threading.Thread, box: dict, timeout_s: float) -> dict:
+    t.join(timeout=timeout_s)
+    if t.is_alive():
+        raise RuntimeError("scenario loadgen never finished")
+    if "error" in box:
+        raise RuntimeError(f"scenario loadgen failed: {box['error']}")
+    return box["report"]
+
+
+# ---------------------------------------------------------------------------
+# 1. Live secret rotation
+# ---------------------------------------------------------------------------
+
+
+class _FabricProber:
+    """A client that dials the fabric SOCKET fresh every probe (pooled
+    connections outlive a rotation by design — authentication is
+    per-connection — so only fresh dials exercise the keyring; this is
+    the 'new client agent joins mid-rotation' path). Counts dial
+    outcomes; its own keyring is rotated mid-rollout by the scenario,
+    so probes cover both mixed-cluster directions: old-secret dial at a
+    rotated server (dual-accept) and new-secret dial at a not-yet-
+    rotated server (previous-secret fallback)."""
+
+    def __init__(self, cluster: ChaosCluster, secret: str,
+                 period_s: float = 0.1) -> None:
+        self.cluster = cluster
+        self.keyring = Keyring(secret)
+        self.period_s = period_s
+        self.ok = 0
+        self.auth_failures = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._run, name="scenario-fabric-probe", daemon=True
+        )
+
+    def start(self) -> None:
+        self._t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            lead = self.cluster.leader()
+            if lead is None:
+                continue
+            pool = ConnPool(secret=self.keyring)
+            try:
+                pool.call(lead.addr, "Status.ping", {}, timeout_s=5)
+                self.ok += 1
+            except AuthFailedError:
+                self.auth_failures += 1
+            except Exception:
+                self.errors += 1
+            finally:
+                pool.shutdown()
+
+
+def run_secret_rotation(
+    data_root: str,
+    *,
+    seed: int = 0,
+    n_servers: int = 3,
+    rate: float = 30.0,
+    duration_s: float = 12.0,
+    window_s: float = 6.0,
+    stagger_s: float = 0.25,
+    old_secret: str = "rotation-secret-v1",
+    new_secret: str = "rotation-secret-v2",
+    node_count: int = 6,
+) -> dict:
+    """Rotate the cluster secret under live scheduling traffic and
+    return the evidence: keyring counter deltas across the rollout
+    (auth_fail must be 0), fabric-probe outcomes, the loadgen report,
+    and the post-window probes (old secret rejected, new accepted)."""
+    cluster = ChaosCluster(
+        n_servers, data_root, seed=seed, num_workers=1,
+        rpc_secret=old_secret,
+    )
+    prober = None
+    try:
+        cluster.start()
+        lead = cluster.wait_for_stable_leader(timeout_s=60)
+        if lead is None:
+            raise RuntimeError("rotation cluster never elected a leader")
+        cfg = LoadGenConfig(
+            rate_eval_per_s=rate,
+            duration_s=duration_s,
+            seed=seed,
+            node_count=node_count,
+            node_churn_period_s=0.0,  # isolate: rotation is the event
+            submitters=2,
+        )
+        gen = LoadGen(cluster, cfg)
+        t, box = _loadgen_thread(gen)
+        if not gen.setup_done.wait(timeout=60):
+            raise RuntimeError("loadgen setup never finished")
+        prober = _FabricProber(cluster, old_secret)
+        prober.start()
+        time.sleep(max(0.5, duration_s * 0.15))  # traffic before the push
+
+        base = _counter_snapshot(_KEYRING_COUNTERS)
+        # the staggered rollout: servers one at a time, the fabric
+        # client midway — every mixed-cluster direction occurs
+        ids = sorted(cluster.servers)
+        half = len(ids) // 2
+        for i, nid in enumerate(ids):
+            if i == half:
+                prober.keyring.rotate(new_secret, window_s=window_s)
+            cluster.rotate_secret_on(nid, new_secret, window_s=window_s)
+            time.sleep(stagger_s)
+        cluster.server_kw["rpc_secret"] = new_secret
+
+        # Deterministic dual-accept probes while the window is open:
+        # EVERY server must accept a fresh dial presenting the old
+        # secret (previous slot) AND the new one (current slot). The
+        # background prober's timing depends on load; these do not.
+        window_probe_failures = []
+        for nid, cs in sorted(cluster.servers.items()):
+            for label, sec in (("old", old_secret), ("new", new_secret)):
+                pool = ConnPool(secret=sec)
+                try:
+                    if (
+                        pool.call(cs.addr, "Status.ping", {}, timeout_s=10)
+                        != "pong"
+                    ):
+                        window_probe_failures.append((nid, label))
+                except Exception as e:
+                    window_probe_failures.append((nid, label, str(e)))
+                finally:
+                    pool.shutdown()
+
+        report = _join_loadgen(t, box, timeout_s=duration_s + 120)
+        prober.stop()
+        deltas = _counter_delta(_KEYRING_COUNTERS, base)
+
+        converged = cluster.converged(timeout_s=60)
+        cluster.acked_jobs = set(gen.acked_jobs)
+        invariants_ok, invariant_error = True, ""
+        try:
+            cluster.check_invariants()
+        except AssertionError as e:
+            invariants_ok, invariant_error = False, str(e)
+
+        # window close: an old-secret dial must now be REJECTED and a
+        # new-secret dial accepted (probed on a fresh pool each)
+        remaining = max(
+            (cs.keyring.status()["window_remaining_s"]
+             for cs in cluster.servers.values()),
+            default=0.0,
+        )
+        time.sleep(remaining + 0.2)
+        lead = cluster.wait_for_stable_leader(timeout_s=60)
+        if lead is None:
+            raise RuntimeError(
+                "no stable leader after the rotation window closed"
+            )
+        old_rejected = False
+        pool = ConnPool(secret=old_secret)
+        try:
+            pool.call(lead.addr, "Status.ping", {}, timeout_s=5)
+        except AuthFailedError:
+            old_rejected = True
+        except Exception:
+            pass  # counted as not-cleanly-rejected
+        finally:
+            pool.shutdown()
+        pool = ConnPool(secret=new_secret)
+        try:
+            new_accepted = (
+                pool.call(lead.addr, "Status.ping", {}, timeout_s=5)
+                == "pong"
+            )
+        finally:
+            pool.shutdown()
+
+        return {
+            "seed": seed,
+            "loadgen": report,
+            "keyring_counters": deltas,
+            "rotated_servers": len(ids),
+            "probe_ok": prober.ok,
+            # CLIENT-VISIBLE auth failures are the gate: a probe call
+            # that ultimately failed AuthFailedError, over the probe's
+            # whole life (rollout, window, and after). The acceptor-
+            # side nomad.keyring.auth_fail counter is evidence, not a
+            # gate — it counts first-attempt rejects a staggered
+            # rollout EXPECTS (rotated dialer → unrotated server),
+            # each recovered by the previous-secret dial fallback
+            # (docs/operations.md explains how to read it).
+            "probe_auth_failures": prober.auth_failures,
+            "probe_errors": prober.errors,
+            "dropped_rpcs": report["failed"] + prober.errors,
+            "acceptor_rejects": deltas["nomad.keyring.auth_fail"],
+            "window_exercised": (
+                deltas["nomad.keyring.accept_previous"]
+                + deltas["nomad.keyring.dial_fallback"]
+            ) > 0,
+            "window_probe_failures": window_probe_failures,
+            "old_secret_rejected_after_window": old_rejected,
+            "new_secret_accepted": new_accepted,
+            "converged": converged,
+            "invariants_ok": invariants_ok,
+            "invariant_error": invariant_error,
+        }
+    finally:
+        if prober is not None and prober._t.is_alive():
+            prober.stop()
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 2. Rolling server upgrade
+# ---------------------------------------------------------------------------
+
+
+def run_rolling_upgrade(
+    data_root: str,
+    *,
+    seed: int = 0,
+    n_servers: int = 3,
+    rate: float = 30.0,
+    settle_timeout_s: float = 60.0,
+    max_duration_s: float = 180.0,
+    step_pause_s: float = 0.75,
+    node_count: int = 6,
+    rpc_secret: str = "",
+) -> dict:
+    """Restart every server one at a time under LoadGen traffic (the
+    upgrade runbook, docs/operations.md): evidence is the roll report
+    (elections across the roll must be ≤ restarts + 1), the loadgen
+    report, and the standard invariants (no acked write lost, no
+    duplicate alloc, convergence)."""
+    from ..retry import RetryPolicy
+
+    cluster = ChaosCluster(
+        n_servers, data_root, seed=seed, num_workers=1,
+        rpc_secret=rpc_secret,
+    )
+    try:
+        cluster.start()
+        lead = cluster.wait_for_stable_leader(timeout_s=60)
+        if lead is None:
+            raise RuntimeError("upgrade cluster never elected a leader")
+        for cs in cluster.servers.values():
+            # bounded leaderless-retry budget (the soak posture): a
+            # submitter must measure the roll, not a 10s retry policy
+            cs.forward_retry = RetryPolicy(
+                base_s=0.05, max_s=0.5, deadline_s=5.0
+            )
+        cfg = LoadGenConfig(
+            rate_eval_per_s=rate,
+            duration_s=max_duration_s,
+            seed=seed,
+            node_count=node_count,
+            node_churn_period_s=0.0,
+            submitters=2,
+        )
+        gen = LoadGen(cluster, cfg)
+        t, box = _loadgen_thread(gen)
+        if not gen.setup_done.wait(timeout=60):
+            raise RuntimeError("loadgen setup never finished")
+        time.sleep(1.0)  # traffic in flight before the first kill
+
+        def fix_retry(nid):
+            cluster.servers[nid].forward_retry = RetryPolicy(
+                base_s=0.05, max_s=0.5, deadline_s=5.0
+            )
+
+        roll = cluster.rolling_restart(
+            settle_timeout_s=settle_timeout_s,
+            pause_s=step_pause_s,
+            post_step=fix_retry,
+        )
+        time.sleep(1.0)  # post-roll traffic against the rolled cluster
+        gen.stop()
+        report = _join_loadgen(t, box, timeout_s=120)
+
+        converged = cluster.converged(timeout_s=60)
+        cluster.acked_jobs = set(gen.acked_jobs)
+        invariants_ok, invariant_error = True, ""
+        try:
+            cluster.check_invariants()
+        except AssertionError as e:
+            invariants_ok, invariant_error = False, str(e)
+        return {
+            "seed": seed,
+            "roll": roll,
+            "loadgen": report,
+            "no_failed_writes": report["failed"] == 0,
+            "elections_bound": roll["restarted"] + 1,
+            "elections_bounded": (
+                roll["elections"] <= roll["restarted"] + 1
+            ),
+            "converged": converged,
+            "invariants_ok": invariants_ok,
+            "invariant_error": invariant_error,
+        }
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. Spot-node churn
+# ---------------------------------------------------------------------------
+
+
+class _SpotFleet:
+    """A fleet of mock client nodes with one shared heartbeat thread.
+    Churn kills nodes two ways: ``hard`` (silent death — heartbeats
+    just stop; the leader's TTL timer must notice) and ``graceful`` (a
+    spot-termination notice: drain first, then die). Replacements
+    register to keep the fleet at size."""
+
+    def __init__(self, cluster, size: int, seed: int,
+                 hb_period_s: float = 0.5) -> None:
+        self.cluster = cluster
+        self.rng = random.Random(seed ^ 0x5F0F)
+        self.hb_period_s = hb_period_s
+        self._lock = threading.Lock()
+        self.live: dict[str, object] = {}
+        # node_id -> monotonic death time (hard kills only: the
+        # stranded-alloc clock starts when heartbeats STOP)
+        self.dead_at: dict[str, float] = {}
+        self.draining: set[str] = set()
+        self.hb_errors = 0
+        # alternates across ALL victims (not per-cycle) so small fleets
+        # with one victim per cycle still exercise both death modes
+        self._kill_toggle = 0
+        self.reaped = 0
+        self._stop = threading.Event()
+        self._t = threading.Thread(
+            target=self._hb_loop, name="spot-fleet-hb", daemon=True
+        )
+        self.size = size
+
+    def _rpc(self, method: str, args):
+        last: Optional[Exception] = None
+        for nid in sorted(self.cluster.servers):
+            cs = self.cluster.servers.get(nid)
+            if cs is None:  # raced a kill
+                continue
+            try:
+                return cs.rpc_self(method, args)
+            except Exception as e:  # leaderless window: try a peer
+                last = e
+        if last is not None:
+            raise last
+        raise RuntimeError("no live servers")
+
+    def populate(self) -> None:
+        for _ in range(self.size):
+            self.add_node()
+
+    def add_node(self):
+        node = mock.node()
+        self._rpc("Node.register", {"node": node})
+        with self._lock:
+            self.live[node.id] = node
+        return node
+
+    def start(self) -> None:
+        self._t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=10)
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.hb_period_s):
+            with self._lock:
+                ids = list(self.live)
+            for node_id in ids:
+                try:
+                    self._rpc("Node.heartbeat", {"node_id": node_id})
+                except Exception:
+                    # leaderless window / raced kill: next beat retries
+                    self.hb_errors += 1
+
+    # -- churn ---------------------------------------------------------
+
+    def kill_hard(self, node_id: str) -> None:
+        """Silent spot reclaim: the node just stops heartbeating."""
+        with self._lock:
+            self.live.pop(node_id, None)
+            self.dead_at[node_id] = time.monotonic()
+
+    def drain_then_kill(self, node_id: str,
+                        deadline_s: float = 30.0) -> None:
+        """The 2-minute-notice path: mark the node draining (the
+        drainer migrates its allocs) — the churn loop later reaps it
+        once the drain completes (or its own next cycles do)."""
+        self._rpc(
+            "Node.update_drain",
+            {
+                "node_id": node_id,
+                "drain": DrainStrategy(deadline_s=deadline_s),
+            },
+        )
+        with self._lock:
+            self.draining.add(node_id)
+
+    def reap_drained(self) -> list:
+        """Hard-kill any draining node whose drain finished (drain
+        strategy cleared by the drainer's batch_node_drain_update)."""
+        lead = self.cluster.leader()
+        if lead is None:
+            return []
+        reaped = []
+        with self._lock:
+            draining = list(self.draining)
+        for node_id in draining:
+            node = lead.server.state.node_by_id(node_id)
+            if node is not None and not node.drain:
+                with self._lock:
+                    self.draining.discard(node_id)
+                self.kill_hard(node_id)
+                reaped.append(node_id)
+        self.reaped += len(reaped)
+        return reaped
+
+    def churn_once(self, fraction: float = 0.1) -> dict:
+        """Kill ~``fraction`` of the live fleet (alternating hard and
+        graceful) and register replacements."""
+        with self._lock:
+            candidates = [
+                nid for nid in self.live if nid not in self.draining
+            ]
+        n = max(1, math.ceil(len(candidates) * fraction))
+        victims = self.rng.sample(candidates, min(n, len(candidates)))
+        hard, graceful = 0, 0
+        for node_id in victims:
+            self._kill_toggle += 1
+            if self._kill_toggle % 2 == 1:
+                self.kill_hard(node_id)
+                hard += 1
+            else:
+                self.drain_then_kill(node_id)
+                graceful += 1
+        joins = 0
+        with self._lock:
+            deficit = self.size - len(self.live)
+        for _ in range(max(0, deficit)):
+            self.add_node()
+            joins += 1
+        return {"hard": hard, "graceful": graceful, "joins": joins}
+
+
+def run_spot_churn(
+    data_root: str,
+    *,
+    seed: int = 0,
+    n_servers: int = 1,
+    fleet_size: int = 10,
+    churn_fraction: float = 0.1,
+    cycle_s: float = 3.0,
+    cycles: int = 4,
+    rate: float = 25.0,
+    heartbeat_ttl_s: float = 2.0,
+    blocked_cap: int = 32,
+    use_tpu_worker: bool = False,
+    strand_grace_factor: float = 6.0,
+) -> dict:
+    """Spot-instance churn: every cycle ~``churn_fraction`` of the
+    client fleet dies (half silently, half behind a drain notice) and
+    replacements join, while LoadGen keeps submitting jobs. Gates:
+    every silently-dead node is marked down and cleared of live
+    allocations within ``heartbeat_ttl_s * strand_grace_factor`` of
+    its death (TTL detection + one scheduling pass), the blocked-evals
+    set stays bounded, and the cluster converges with the standard
+    invariants once churn stops."""
+    cluster = ChaosCluster(
+        n_servers, data_root, seed=seed, num_workers=1,
+        use_tpu_batch_worker=use_tpu_worker,
+    )
+    fleet: Optional[_SpotFleet] = None
+    try:
+        cluster.start()
+        lead = cluster.wait_for_stable_leader(timeout_s=60)
+        if lead is None:
+            raise RuntimeError("churn cluster never elected a leader")
+        for cs in cluster.servers.values():
+            # shrink the TTL floor so death→down-mark→reschedule cycles
+            # fit the budget; the mechanism (leader TTL timers, armed at
+            # establish-leadership AND per heartbeat) is the production
+            # one
+            cs.server.heartbeaters.min_ttl_s = heartbeat_ttl_s
+            cs.server.blocked_evals.configure(cap=blocked_cap)
+
+        fleet = _SpotFleet(cluster, fleet_size, seed)
+        fleet.populate()
+        fleet.start()
+
+        duration_s = cycle_s * (cycles + 2)
+        cfg = LoadGenConfig(
+            rate_eval_per_s=rate,
+            duration_s=duration_s + 60,  # stopped explicitly below
+            seed=seed,
+            node_count=0,  # jobs land on the fleet's nodes
+            node_churn_period_s=0.0,  # the fleet IS the churn
+            heartbeat_period_s=3600.0,
+            submitters=2,
+        )
+        gen = LoadGen(cluster, cfg)
+        t, box = _loadgen_thread(gen)
+        if not gen.setup_done.wait(timeout=60):
+            raise RuntimeError("loadgen setup never finished")
+
+        # stranded-alloc gate, enforced LIVE: a silently-dead node must
+        # be marked down and cleared of live allocations within
+        # strand_bound_s of its death (TTL detection + one scheduling
+        # pass) — checked every monitor tick, so a violation is a real
+        # bound miss, not observation lag.
+        strand_bound_s = heartbeat_ttl_s * strand_grace_factor
+        stranded: list[str] = []
+        detect_latency: dict[str, float] = {}
+
+        def check_dead_nodes() -> None:
+            lead = cluster.leader()
+            if lead is None:
+                return
+            state = lead.server.state
+            for node_id, died in list(fleet.dead_at.items()):
+                if node_id in detect_latency or node_id in stranded:
+                    continue
+                node = state.node_by_id(node_id)
+                cleared = (
+                    node is not None
+                    and node.status == "down"
+                    and not any(
+                        not a.terminal_status()
+                        for a in state.allocs_by_node(node_id)
+                    )
+                )
+                if cleared:
+                    detect_latency[node_id] = round(
+                        time.monotonic() - died, 2
+                    )
+                elif time.monotonic() - died > strand_bound_s:
+                    stranded.append(node_id)
+
+        churn_log = []
+        max_blocked = 0
+        traffic_deadline = time.monotonic() + duration_s
+        next_churn = time.monotonic() + cycle_s
+        while time.monotonic() < traffic_deadline:
+            time.sleep(0.1)
+            fleet.reap_drained()
+            check_dead_nodes()
+            lead = cluster.leader()
+            if lead is not None:
+                st = lead.server.blocked_evals.stats
+                max_blocked = max(
+                    max_blocked,
+                    st["total_blocked"] + st["total_escaped"],
+                )
+            if time.monotonic() >= next_churn and cycles > len(churn_log):
+                churn_log.append(fleet.churn_once(churn_fraction))
+                next_churn += cycle_s
+        gen.stop()
+        report = _join_loadgen(t, box, timeout_s=120)
+        fleet.stop()
+
+        # settle: keep enforcing each remaining dead node's own bound
+        # until every one resolves (cleared or definitively stranded);
+        # the outer deadline only guards a leaderless wedge — every
+        # node resolves by its own bound otherwise
+        settle_deadline = time.monotonic() + strand_bound_s + 30.0
+        while len(detect_latency) + len(stranded) < len(fleet.dead_at):
+            check_dead_nodes()
+            if time.monotonic() > settle_deadline:
+                stranded.extend(
+                    nid for nid in fleet.dead_at
+                    if nid not in detect_latency and nid not in stranded
+                )
+                break
+            time.sleep(0.1)
+
+        converged = cluster.converged(timeout_s=60)
+        cluster.acked_jobs = set(gen.acked_jobs)
+        invariants_ok, invariant_error = True, ""
+        try:
+            cluster.check_invariants()
+        except AssertionError as e:
+            invariants_ok, invariant_error = False, str(e)
+        return {
+            "seed": seed,
+            "loadgen": report,
+            "churn_cycles": churn_log,
+            "hard_kills": len(fleet.dead_at),
+            "graceful_drains": sum(c["graceful"] for c in churn_log),
+            "drains_completed": fleet.reaped,
+            "joins": sum(c["joins"] for c in churn_log),
+            "max_blocked": max_blocked,
+            "blocked_cap": blocked_cap,
+            "blocked_bounded": max_blocked <= blocked_cap,
+            "strand_bound_s": strand_bound_s,
+            "stranded_nodes": stranded,
+            "down_detect_latency_s": detect_latency,
+            "fleet_hb_errors": fleet.hb_errors,
+            "converged": converged,
+            "invariants_ok": invariants_ok,
+            "invariant_error": invariant_error,
+        }
+    finally:
+        if fleet is not None:
+            fleet.stop()
+        cluster.shutdown()

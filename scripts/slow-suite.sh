@@ -6,10 +6,13 @@
 #
 # Covers the slow-marked soak (10-minute sustained traffic with faults,
 # tests/test_soak.py), the long chaos scenarios (fsync churn etc.,
-# tests/test_chaos.py), and the profiler/observability overhead
-# batteries at full length — plus anything else that grows a `slow`
-# mark. Runs on the CPU backend (the tier-1 posture); point
-# JAX_PLATFORMS elsewhere to exercise a real device.
+# tests/test_chaos.py), the production-ops resilience acceptance
+# batteries (tests/test_scenarios.py: 25-seed secret rotation, 25-seed
+# rolling upgrade, long spot-node churn — narrow with `-m scenario`),
+# and the profiler/observability overhead batteries at full length —
+# plus anything else that grows a `slow` mark. Runs on the CPU backend
+# (the tier-1 posture); point JAX_PLATFORMS elsewhere to exercise a
+# real device.
 #
 # Exit code is pytest's: nonzero on any failure. Budget ~30+ minutes.
 set -euo pipefail

@@ -584,6 +584,43 @@ class _Heartbeater:
         self._pool.shutdown()
 
 
+def _register_through_churn(cluster, pool, job, deadline_s=120.0):
+    """Register ``job`` no matter what the leadership weather is doing,
+    and record it acked only once an RPC definitively succeeded.
+
+    A raw ``pool.call(lead.addr, "Job.register", ...)`` rides the
+    forwarder's 10s FORWARD_POLICY deadline: under suite-tail load plus
+    seeded fsync faults a leaderless window can outlast it, and
+    LeadershipLostError (deposed mid-replication, outcome unknown) is
+    never retried by design. Both made test_repeated_churn_with_
+    fsync_faults flip on the RPC *surface* rather than the convergence
+    invariants it gates. Registering the same job again is an
+    idempotent upsert (worst case an extra eval the broker dedups), so
+    the scenario-side answer is to retry through churn with its own,
+    scenario-sized deadline — exactly what wait_for_stable_leader's
+    docstring prescribes."""
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        lead = cluster.wait_for_stable_leader(
+            timeout_s=max(1.0, deadline - time.monotonic())
+        )
+        if lead is None:
+            break
+        try:
+            pool.call(lead.addr, "Job.register", {"job": job},
+                      timeout_s=30)
+            cluster.acked_jobs.add(job.id)
+            return
+        except Exception as e:  # leaderless / deposed / injected fault
+            last = e
+            time.sleep(0.2)
+    raise AssertionError(
+        f"job {job.id} never registered within {deadline_s}s "
+        f"(last error: {last})"
+    )
+
+
 def _register_workload(cluster, pool, n_jobs=3, count=2):
     """Register a node and n_jobs service jobs through the fabric,
     recording each job as acked only after its RPC succeeded; wait for
@@ -597,8 +634,7 @@ def _register_workload(cluster, pool, n_jobs=3, count=2):
     for i in range(n_jobs):
         job = mock.job(id=f"chaos-j{i}")
         job.task_groups[0].count = count
-        pool.call(lead.addr, "Job.register", {"job": job})
-        cluster.acked_jobs.add(job.id)
+        _register_through_churn(cluster, pool, job)
         jobs.append(job)
 
     def placed():
@@ -809,12 +845,9 @@ def test_repeated_churn_with_fsync_faults(tmp_path):
             assert cluster.wait_for_stable_leader(60) is not None, (
                 f"round {round_no}: survivors never elected"
             )
-            lead2 = cluster.wait_for_stable_leader(60)
             job = mock.job(id=f"chaos-churn-{round_no}")
             job.task_groups[0].count = 1
-            pool.call(lead2.addr, "Job.register", {"job": job},
-                      timeout_s=30)
-            cluster.acked_jobs.add(job.id)
+            _register_through_churn(cluster, pool, job)
             cluster.restart(nid)
         cluster.heal()
         assert cluster.converged(90), "no convergence after churn rounds"

@@ -702,9 +702,10 @@ JUSTIFIED_UNPORTED = {
     "deployment unblock": "multiregion deployment gate — enterprise-"
     "only in the reference (OSS build returns an error)",
     "keyring": "serf gossip symmetric-key rotation; this fabric "
-    "authenticates with the rpc_secret + mTLS instead of serf "
-    "encryption keys (rpc/tls.py), so there is no keyring to rotate",
-    "operator keyring": "same as keyring",
+    "authenticates with the rpc_secret instead of serf encryption "
+    "keys — its live rotation surface is `operator keyring "
+    "status|rotate` (rpc/keyring.py dual-accept window), ported as "
+    "of round 14",
     "license": "enterprise licensing surface",
     "license get": "enterprise licensing surface",
     "quota": "resource quotas are enterprise-only in the reference",
@@ -791,6 +792,13 @@ REFERENCE_COMMAND_FLAGS = {
         "flags": {"-json", "-rule", "-baseline", "-dynamic-edges",
                   "-advisory"},
         "args": [],
+    },
+    # Round 14 (production-ops resilience PR): extended 34 -> 36 with
+    # the fabric keyring surface (live rpc_secret rotation,
+    # rpc/keyring.py + /v1/agent/keyring).
+    "operator keyring status": {"flags": {"-json"}, "args": []},
+    "operator keyring rotate": {
+        "flags": {"-secret", "-window", "-json"}, "args": [],
     },
     "event stream": {
         "flags": {"-topic", "-index", "-namespace"}, "args": [],

@@ -1,0 +1,409 @@
+"""Dual-accept keyring on the RPC fabric (rpc/keyring.py): rotation
+windows, the ConnPool dial-time secret read + auth-failure recovery,
+Agent.reload keyring transitions (the SIGHUP push), and the operator
+surfaces (/v1/agent/keyring, `operator keyring status|rotate`).
+"""
+
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.rpc import AuthFailedError, ConnPool, Keyring, RPCServer
+from nomad_tpu.rpc.keyring import ensure_keyring, key_fingerprint
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class Echo:
+    def ping(self, args):
+        return args
+
+
+@pytest.fixture
+def fabric():
+    """(make_server, make_pool) factories with shutdown bookkeeping."""
+    servers, pools = [], []
+
+    def make_server(secret):
+        s = RPCServer(secret=secret)
+        s.register("Echo", Echo())
+        s.start()
+        servers.append(s)
+        return s
+
+    def make_pool(secret):
+        p = ConnPool(secret=secret)
+        pools.append(p)
+        return p
+
+    yield make_server, make_pool
+    for p in pools:
+        p.shutdown()
+    for s in servers:
+        s.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Keyring units
+# ---------------------------------------------------------------------------
+
+
+class TestKeyring:
+    def test_rotate_opens_window_then_expires(self):
+        kr = Keyring("a", window_s=0.2)
+        assert kr.rotate("b") is True
+        assert kr.accepts(b"b")
+        assert kr.accepts(b"a"), "previous must pass inside the window"
+        assert kr.previous_active() == "a"
+        time.sleep(0.3)
+        assert not kr.accepts(b"a"), "window closed: previous rejected"
+        assert kr.previous_active() == ""
+        assert kr.accepts(b"b")
+
+    def test_rotate_same_secret_is_noop(self):
+        kr = Keyring("a")
+        gen = kr.generation
+        assert kr.rotate("a") is False
+        assert kr.generation == gen
+        assert not kr.status()["dual_accept"], (
+            "a no-op rotation must not open a window"
+        )
+
+    def test_rotate_back_within_window_swaps_slots(self):
+        kr = Keyring("a", window_s=5.0)
+        kr.rotate("b")
+        assert kr.rotate("a") is True  # the rollout was aborted
+        assert kr.current == "a"
+        assert kr.accepts(b"a")
+        assert kr.accepts(b"b"), (
+            "the aborted secret drains out through its own window"
+        )
+
+    def test_rotate_to_empty_refused(self):
+        kr = Keyring("a")
+        with pytest.raises(ValueError):
+            kr.rotate("")
+        assert kr.current == "a"
+
+    def test_enable_from_empty_has_no_window(self):
+        kr = Keyring("")
+        assert not kr.enabled
+        assert kr.rotate("s") is True
+        assert kr.enabled
+        assert not kr.status()["dual_accept"]
+        assert not kr.accepts(b"")
+
+    def test_status_never_leaks_secrets(self):
+        kr = Keyring("super-secret-value", window_s=5.0)
+        kr.rotate("next-secret-value")
+        st = kr.status()
+        assert "super-secret-value" not in str(st)
+        assert "next-secret-value" not in str(st)
+        assert st["current_fingerprint"] == key_fingerprint(
+            "next-secret-value"
+        )
+        assert st["previous_fingerprint"] == key_fingerprint(
+            "super-secret-value"
+        )
+        assert st["dual_accept"] and st["generation"] == 1
+
+    def test_ensure_keyring_passthrough(self):
+        kr = Keyring("x")
+        assert ensure_keyring(kr) is kr
+        assert ensure_keyring("x").current == "x"
+        assert not ensure_keyring(None).enabled
+
+
+# ---------------------------------------------------------------------------
+# Fabric: accept/reject/fallback/redial
+# ---------------------------------------------------------------------------
+
+
+class TestFabricAuth:
+    def test_wrong_secret_fails_fast_and_unsent(self, fabric):
+        make_server, make_pool = fabric
+        srv = make_server("right")
+        pool = make_pool("wrong")
+        t0 = time.monotonic()
+        with pytest.raises(AuthFailedError) as exc:
+            pool.call(srv.addr, "Echo.ping", 1, timeout_s=10)
+        assert time.monotonic() - t0 < 5, (
+            "auth reject must be an explicit error, not a timeout"
+        )
+        assert exc.value.request_sent is False, (
+            "nothing was dispatched: safe to re-send after a rotation"
+        )
+
+    def test_server_dual_accept_during_window(self, fabric):
+        make_server, make_pool = fabric
+        kr = Keyring("v1", window_s=5.0)
+        srv = make_server(kr)
+        old_pool = make_pool("v1")
+        assert old_pool.call(srv.addr, "Echo.ping", 1) == 1
+        kr.rotate("v2")
+        # fresh dial with the OLD secret: accepted via the window
+        fresh = make_pool("v1")
+        assert fresh.call(srv.addr, "Echo.ping", 2) == 2
+
+    def test_pool_previous_fallback_against_unrotated_server(self, fabric):
+        """The mirror image: the DIALER rotated first; the server still
+        only knows the old secret. The pool's auth-failure fallback
+        presents the previous secret and the call succeeds."""
+        make_server, make_pool = fabric
+        srv = make_server("v1")
+        ckr = Keyring("v1")
+        ckr.rotate("v2", window_s=5.0)
+        pool = make_pool(ckr)
+        assert pool.call(srv.addr, "Echo.ping", 3) == 3
+
+    def test_window_expiry_rejects_old_secret_dials(self, fabric):
+        make_server, make_pool = fabric
+        kr = Keyring("v1", window_s=0.2)
+        srv = make_server(kr)
+        kr.rotate("v2")
+        assert make_pool("v1").call(srv.addr, "Echo.ping", 1) == 1
+        time.sleep(0.3)
+        with pytest.raises(AuthFailedError):
+            make_pool("v1").call(srv.addr, "Echo.ping", 2, timeout_s=10)
+        assert make_pool("v2").call(srv.addr, "Echo.ping", 3) == 3
+
+    def test_redial_rereads_current_secret_after_rotation(self, fabric):
+        """REGRESSION (the rotated-client-recovers-without-restart
+        satellite): the pool must read its keyring at every dial, not
+        cache the secret it first dialed with. A client whose keyring
+        rotated recovers on the very next call once its stale
+        connection dies."""
+        make_server, make_pool = fabric
+        skr = Keyring("v1", window_s=0.0)  # hard cutover on the server
+        srv = make_server(skr)
+        ckr = Keyring("v1")
+        pool = make_pool(ckr)
+        assert pool.call(srv.addr, "Echo.ping", 1) == 1  # conn est. w/ v1
+        skr.rotate("v2")  # window 0: v1 now rejected outright
+        # established connection keeps working (auth is per-connection)
+        assert pool.call(srv.addr, "Echo.ping", 2) == 2
+        # the connection dies (server restart analog: kill the conn)
+        with pool._lock:
+            conn = pool._conns[(srv.addr[0], srv.addr[1])]
+        conn.close()
+        # un-rotated client: redial presents v1, rejected
+        with pytest.raises(AuthFailedError):
+            pool.call(srv.addr, "Echo.ping", 3, timeout_s=10)
+        # rotate the CLIENT keyring (the SIGHUP push): the next call
+        # redials with the new secret — no pool or process restart
+        ckr.rotate("v2")
+        assert pool.call(srv.addr, "Echo.ping", 4) == 4
+
+    def test_stream_dials_fall_back_within_window(self, fabric):
+        """Streaming sessions ride the same keyring discipline."""
+        make_server, make_pool = fabric
+        srv = make_server("v1")
+        srv.register_stream(
+            "Echo.stream", lambda session, header: session.send({"ok": 2})
+        )
+        ckr = Keyring("v1")
+        ckr.rotate("v2", window_s=5.0)
+        pool = make_pool(ckr)
+        session = pool.stream(srv.addr, "Echo.stream", {})
+        try:
+            assert session.recv(timeout_s=5)["ok"] == 2
+        finally:
+            session.close()
+
+
+# ---------------------------------------------------------------------------
+# Agent.reload keyring transitions (the SIGHUP path)
+# ---------------------------------------------------------------------------
+
+
+def _agent_cfg(tmp_path, secret, window_s=5.0, **kw):
+    return AgentConfig(
+        server_enabled=True,
+        dev_mode=True,
+        data_dir=str(tmp_path / "data"),
+        rpc_secret=secret,
+        rpc_secret_window_s=window_s,
+        **kw,
+    )
+
+
+@pytest.fixture
+def secret_agent(tmp_path):
+    a = Agent(_agent_cfg(tmp_path, "gen1-secret"))
+    a.start()
+    assert wait_until(lambda: a.server.is_leader(), 15)
+    yield a, tmp_path
+    a.shutdown()
+
+
+class TestAgentReloadKeyring:
+    def test_rotate_then_idempotent_resighup(self, secret_agent):
+        a, tmp_path = secret_agent
+        changed = a.reload(_agent_cfg(tmp_path, "gen2-secret"))
+        assert "rpc_secret" in changed
+        assert a.keyring.current == "gen2-secret"
+        assert a.keyring.status()["dual_accept"]
+        # the same config re-applied (a second SIGHUP) is a no-op: no
+        # new window, no reported change
+        gen = a.keyring.generation
+        assert a.reload(_agent_cfg(tmp_path, "gen2-secret")) == []
+        assert a.keyring.generation == gen
+
+    def test_rotate_back_within_window(self, secret_agent):
+        a, tmp_path = secret_agent
+        a.reload(_agent_cfg(tmp_path, "gen2-secret"))
+        changed = a.reload(_agent_cfg(tmp_path, "gen1-secret"))
+        assert "rpc_secret" in changed
+        assert a.keyring.current == "gen1-secret"
+        # the aborted secret still drains through its window
+        assert a.keyring.accepts(b"gen2-secret")
+
+    def test_window_expiry_rejects_old_secret_on_fabric(self, secret_agent):
+        a, tmp_path = secret_agent
+        a.reload(
+            _agent_cfg(tmp_path, "gen2-secret", window_s=0.2)
+        )
+        addr = tuple(a.server.rpc.addr)
+        pool = ConnPool(secret="gen1-secret")
+        try:
+            assert pool.call(addr, "Status.ping", {}) == "pong"
+        finally:
+            pool.shutdown()
+        time.sleep(0.4)
+        pool = ConnPool(secret="gen1-secret")
+        try:
+            with pytest.raises(AuthFailedError):
+                pool.call(addr, "Status.ping", {}, timeout_s=10)
+        finally:
+            pool.shutdown()
+        pool = ConnPool(secret="gen2-secret")
+        try:
+            assert pool.call(addr, "Status.ping", {}) == "pong"
+        finally:
+            pool.shutdown()
+
+    def test_reload_refuses_secret_removal(self, secret_agent):
+        a, tmp_path = secret_agent
+        with pytest.raises(ValueError):
+            a.reload(_agent_cfg(tmp_path, ""))
+        assert a.keyring.current == "gen1-secret"
+
+    def test_window_width_reload_applies_to_next_rotation(self, secret_agent):
+        a, tmp_path = secret_agent
+        a.reload(_agent_cfg(tmp_path, "gen1-secret", window_s=0.05))
+        assert a.keyring.window_s == 0.05
+        a.reload(_agent_cfg(tmp_path, "gen2-secret", window_s=0.05))
+        time.sleep(0.1)
+        assert not a.keyring.accepts(b"gen1-secret")
+
+    def test_server_and_client_share_the_agent_keyring(self, tmp_path):
+        cfg = _agent_cfg(tmp_path, "shared-secret", client_enabled=True)
+        a = Agent(cfg)
+        try:
+            assert a.server.keyring is a.keyring
+            assert a.client.keyring is a.keyring
+            assert a.server.pool.keyring is a.keyring
+            assert a.server.rpc.keyring is a.keyring
+            assert a.client.endpoints.rpc.keyring is a.keyring
+        finally:
+            a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Operator surfaces: /v1/agent/self + /v1/agent/keyring + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestOperatorSurfaces:
+    def test_agent_self_and_keyring_route(self, secret_agent):
+        from nomad_tpu.api.client import NomadClient
+
+        a, _ = secret_agent
+        api = NomadClient(f"http://127.0.0.1:{a.http_addr[1]}")
+        info = api.agent.self()
+        assert info["keyring"]["enabled"] is True
+        assert info["keyring"]["generation"] == 0
+        st = api.agent.keyring_status()
+        assert st == info["keyring"] or st["enabled"]
+        assert "gen1-secret" not in str(st)
+
+    def test_http_rotate_then_status(self, secret_agent):
+        from nomad_tpu.api.client import NomadClient
+
+        a, _ = secret_agent
+        api = NomadClient(f"http://127.0.0.1:{a.http_addr[1]}")
+        out = api.agent.keyring_rotate("gen2-secret", window_s=30)
+        assert out["rotated"] is True
+        assert out["dual_accept"] is True
+        assert out["persisted"] is False  # process state only
+        assert a.keyring.current == "gen2-secret"
+        # the in-memory config moved with it, so a later SIGHUP diffs
+        # against the LIVE secret (the config FILE stays the operator's
+        # problem — runbook: persist it or the next restart reverts)
+        assert a.config.rpc_secret == "gen2-secret"
+        # idempotent re-post
+        out = api.agent.keyring_rotate("gen2-secret")
+        assert out["rotated"] is False
+
+    def test_cli_keyring_status_and_rotate(self, secret_agent, capsys):
+        from nomad_tpu.cli.main import main
+
+        a, _ = secret_agent
+        addr = f"http://127.0.0.1:{a.http_addr[1]}"
+        assert main(["-address", addr, "operator", "keyring", "status"]) == 0
+        out = capsys.readouterr().out
+        assert "Generation" in out and "Dual-Accept" in out
+        assert "gen1-secret" not in out
+        assert (
+            main([
+                "-address", addr, "operator", "keyring", "rotate",
+                "-secret", "gen2-secret", "-window", "45s",
+            ])
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "Keyring rotated!" in out
+        assert a.keyring.current == "gen2-secret"
+        assert a.keyring.previous_active() == "gen1-secret"
+
+    def test_keyring_rotate_requires_agent_write_acl(self, tmp_path):
+        """ACL battery: anon 401; node-scoped token 403; management
+        200 — keyring rotation is agent:write like pprof/join."""
+        from nomad_tpu.api.client import APIError, NomadClient
+
+        cfg = _agent_cfg(tmp_path, "acl-secret", acl_enabled=True)
+        a = Agent(cfg)
+        a.start()
+        try:
+            assert wait_until(lambda: a.server.is_leader(), 15)
+            base = f"http://127.0.0.1:{a.http_addr[1]}"
+            boot = NomadClient(base).acl.bootstrap()
+            mgmt = NomadClient(base, token=boot.secret_id)
+            with pytest.raises(APIError) as e:
+                NomadClient(base).agent.keyring_rotate("x-secret")
+            assert e.value.status == 401
+            mgmt.acl.policy_apply(
+                "ns-only", 'namespace "default" { policy = "read" }'
+            )
+            ns_tok = mgmt.acl.token_create(
+                name="t", policies=["ns-only"]
+            )
+            limited = NomadClient(base, token=ns_tok.secret_id)
+            with pytest.raises(APIError) as e:
+                limited.agent.keyring_rotate("x-secret")
+            assert e.value.status == 403
+            # status needs agent:read — the limited token lacks it too
+            with pytest.raises(APIError):
+                limited.agent.keyring_status()
+            out = mgmt.agent.keyring_rotate("x2-secret")
+            assert out["rotated"] is True
+        finally:
+            a.shutdown()

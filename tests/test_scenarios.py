@@ -1,0 +1,164 @@
+"""Production-ops resilience battery (nomad_tpu/testing/scenarios.py):
+live rpc_secret rotation, rolling server upgrades, and spot-node churn
+— each a seeded, invariant-checked scenario over the ChaosCluster +
+LoadGen substrate.
+
+Fast seeded subsets run in tier-1; the 25-seed acceptance batteries
+carry the `slow` marker (scripts/slow-suite.sh).
+"""
+
+import pytest
+
+from nomad_tpu.testing import chaos, scenarios
+
+pytestmark = [pytest.mark.chaos, pytest.mark.scenario]
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plane():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Scenario gates (shared by the fast subset and the slow batteries)
+# ---------------------------------------------------------------------------
+
+
+def assert_rotation_ok(r: dict) -> None:
+    why = {k: v for k, v in r.items() if k != "loadgen"}
+    assert r["invariants_ok"], r["invariant_error"]
+    assert r["converged"], why
+    # THE gate: nothing dropped, no client-visible auth failure across
+    # the whole rollout (NotLeaderError-class churn is counted
+    # separately and is not a drop — no kills happen in this scenario)
+    assert r["probe_auth_failures"] == 0, why
+    assert r["dropped_rpcs"] == 0, why
+    assert r["loadgen"]["failed"] == 0, r["loadgen"]
+    # the window must have actually been exercised (a rotation no dial
+    # ever crossed proves nothing): the deterministic in-window probes
+    # dialed every server with BOTH secrets
+    assert r["window_probe_failures"] == [], why
+    assert r["window_exercised"], why
+    # and it must CLOSE: old secret rejected, new secret serving
+    assert r["old_secret_rejected_after_window"], why
+    assert r["new_secret_accepted"], why
+    assert r["loadgen"]["accepted"] > 0, r["loadgen"]
+    assert r["probe_ok"] > 0, why
+
+
+def assert_upgrade_ok(r: dict) -> None:
+    why = {k: v for k, v in r.items() if k != "loadgen"}
+    assert r["invariants_ok"], r["invariant_error"]  # no acked write
+    # lost, no duplicate alloc (ChaosCluster.check_invariants)
+    assert r["converged"], why
+    assert r["roll"]["restarted"] == 3, why
+    assert r["elections_bounded"], (
+        f"leadership churn {r['roll']['elections']} exceeds bound "
+        f"{r['elections_bound']}: {why}"
+    )
+    assert r["no_failed_writes"], r["loadgen"]
+    assert r["loadgen"]["accepted"] > 0, r["loadgen"]
+
+
+def assert_churn_ok(r: dict) -> None:
+    why = {k: v for k, v in r.items() if k != "loadgen"}
+    assert r["invariants_ok"], r["invariant_error"]
+    assert r["converged"], why
+    assert r["stranded_nodes"] == [], (
+        f"allocs stranded on dead nodes past the "
+        f"{r['strand_bound_s']}s bound: {why}"
+    )
+    assert r["blocked_bounded"], why
+    assert r["hard_kills"] > 0 and r["graceful_drains"] > 0, (
+        f"both death modes must fire: {why}"
+    )
+    assert r["joins"] > 0, why
+    # every hard death was detected and cleared inside its bound
+    assert len(r["down_detect_latency_s"]) == r["hard_kills"], why
+    assert r["loadgen"]["accepted"] > 0, r["loadgen"]
+
+
+# ---------------------------------------------------------------------------
+# Fast seeded subset (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_secret_rotation_under_live_traffic(tmp_path):
+    r = scenarios.run_secret_rotation(
+        str(tmp_path), seed=11, duration_s=8.0, window_s=4.0, rate=25
+    )
+    assert_rotation_ok(r)
+    # rollout bookkeeping: every server rotated exactly once, and the
+    # keyring counters carry the evidence
+    assert r["rotated_servers"] == 3
+    assert r["keyring_counters"]["nomad.keyring.rotations"] >= 3
+
+
+def test_rolling_upgrade_under_live_traffic(tmp_path):
+    r = scenarios.run_rolling_upgrade(str(tmp_path), seed=23, rate=25)
+    assert_upgrade_ok(r)
+
+
+def test_spot_node_churn_converges(tmp_path):
+    r = scenarios.run_spot_churn(str(tmp_path), seed=31, cycles=4)
+    assert_churn_ok(r)
+
+
+def test_rolling_upgrade_with_secret_enabled(tmp_path):
+    """The two tentpole mechanisms compose: a full roll on a cluster
+    whose fabric requires the shared secret — every restarted server
+    re-authenticates its pools against the survivors."""
+    r = scenarios.run_rolling_upgrade(
+        str(tmp_path), seed=37, rate=20, rpc_secret="roll-secret",
+    )
+    assert_upgrade_ok(r)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance batteries (slow; scripts/slow-suite.sh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_secret_rotation_acceptance_25_seeds(tmp_path):
+    """25/25 seeded runs with zero dropped/auth-failed RPCs during the
+    window and old-secret dials rejected after it closes."""
+    for seed in range(25):
+        r = scenarios.run_secret_rotation(
+            str(tmp_path / f"s{seed}"), seed=seed,
+            duration_s=8.0, window_s=4.0, rate=25,
+        )
+        try:
+            assert_rotation_ok(r)
+        except AssertionError as e:
+            raise AssertionError(f"seed {seed}: {e}") from None
+
+
+@pytest.mark.slow
+def test_rolling_upgrade_acceptance_25_seeds(tmp_path):
+    """25/25 seeded rolls under LoadGen traffic: no acked write lost,
+    no duplicate alloc, leadership changes ≤ servers restarted + 1."""
+    for seed in range(25):
+        r = scenarios.run_rolling_upgrade(
+            str(tmp_path / f"s{seed}"), seed=seed, rate=25,
+        )
+        try:
+            assert_upgrade_ok(r)
+        except AssertionError as e:
+            raise AssertionError(f"seed {seed}: {e}") from None
+
+
+@pytest.mark.slow
+def test_spot_churn_acceptance_long(tmp_path):
+    """The long churn: 12 cycles (~10% of the fleet per cycle) against
+    a 3-server control plane with the real TPU batch worker — every
+    cycle converges, the blocked set stays bounded, drains complete,
+    and no alloc outlives its node past the TTL bound."""
+    r = scenarios.run_spot_churn(
+        str(tmp_path), seed=5, n_servers=3, fleet_size=14,
+        cycles=12, cycle_s=4.0, rate=30, use_tpu_worker=True,
+    )
+    assert_churn_ok(r)
+    assert r["drains_completed"] > 0, "no graceful drain ever completed"
