@@ -1152,6 +1152,15 @@ def run_soak_config():
         + (f" ({report['invariant_error']})" if report["invariant_error"] else "")
         + f", faults fired {report['fired_faults']}"
     )
+    cpu = report.get("server_cpu") or {}
+    src = report.get("source_attribution") or {}
+    log(
+        f"[soak] server cpu {cpu.get('cpu_seconds')}s "
+        f"({cpu.get('per_node_cpu_fraction')} cores/node over "
+        f"{cpu.get('node_count')} nodes); source attribution "
+        f"coverage {src.get('coverage')} over {src.get('total_calls')} "
+        f"calls, top {src.get('top')}"
+    )
     return report
 
 
@@ -1331,6 +1340,22 @@ def main():
             gates[f"{cname}_p99_bounded"] = bool(r["p99_bounded"])
             gates[f"{cname}_admission_engaged"] = bool(
                 r["admission_engaged"]
+            )
+        # cluster-observability gates (clusterobs.py): server CPU per
+        # simulated node stays bounded (the ROADMAP fleet-scale gate,
+        # measurable per-run now) and per-source attribution covers
+        # the served handler seconds — fan-out cost is ATTRIBUTABLE,
+        # not just bounded
+        if "server_cpu" in r:
+            bound = float(
+                os.environ.get("BENCH_SOAK_CPU_PER_NODE", "0.5")
+            )
+            gates[f"{cname}_cpu_per_node_bounded"] = (
+                r["server_cpu"]["per_node_cpu_fraction"] <= bound
+            )
+        if "source_attribution" in r:
+            gates[f"{cname}_source_coverage"] = (
+                r["source_attribution"]["coverage"] >= 0.8
             )
     if chaos_knobs:
         # refuse to gate: an injected-fault run can never certify

@@ -147,6 +147,11 @@ _AGENT_READ = [
     # on-demand pprof capture stays agent:write + enable_debug, but the
     # continuous profiler's bounded aggregate is agent:read
     ("GET", re.compile(r"^/v1/profile(/.*)?$")),
+    # cluster health federation (cluster.py cluster_health): the
+    # observability surface family's gate — agent:read like /v1/metrics
+    # and /v1/profile, NOT operator:read (checked before the broader
+    # operator rule below; the payload is telemetry, not raft control)
+    ("GET", re.compile(r"^/v1/operator/cluster/health$")),
 ]
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)

@@ -149,4 +149,9 @@ def debug_bundle(api) -> dict:
         "profile_stacks",
         lambda: {"collapsed": api.agent.profile_collapsed()},
     )
+    # cluster-scope capture: every member's health/telemetry (raft
+    # indices, depths, host CPU/RSS, per-source cost top-K) with
+    # degraded members flagged — the `operator debug` analog of the
+    # reference's autopilot-health grab
+    grab("cluster_health", lambda: api.operator.cluster_health())
     return bundle

@@ -1728,6 +1728,30 @@ class HTTPAgentServer:
         route("GET", "/v1/operator/snapshot", operator_snapshot_save)
         route("PUT", "/v1/operator/snapshot", operator_snapshot_restore)
         route("POST", "/v1/operator/snapshot", operator_snapshot_restore)
+        def operator_cluster_health(p, q, body, tok):
+            # /v1/operator/cluster/health: leader-side telemetry
+            # federation (cluster.py cluster_health) — every member's
+            # raft indices / broker + plan-queue depths / host CPU+RSS /
+            # per-source cost top-K, with partitioned members flagged
+            # `degraded` under a bounded per-peer deadline. agent:read
+            # like the other observability surfaces (acl/enforce.py),
+            # throttle-exempt so the dashboard stays readable during
+            # the incident it diagnoses.
+            try:
+                timeout_s = float(q.get("timeout", ["2.0"])[0])
+            except ValueError:
+                raise HTTPError(400, "timeout must be a number")
+            try:
+                top = int(q.get("top", ["5"])[0])
+            except ValueError:
+                raise HTTPError(400, "top must be an integer")
+            return self.cluster.cluster_health(
+                per_peer_timeout_s=timeout_s, top=top
+            )
+
+        route(
+            "GET", "/v1/operator/cluster/health", operator_cluster_health
+        )
         route("GET", "/v1/operator/raft/configuration", operator_raft_config)
         route(
             "DELETE", "/v1/operator/raft/peer", operator_raft_remove_peer

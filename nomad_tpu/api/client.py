@@ -771,6 +771,21 @@ class Operator(_Resource):
     def raft_configuration(self):
         return self.c.get("/v1/operator/raft/configuration")
 
+    def cluster_health(self, timeout_s=None, top=None):
+        """Leader-side telemetry federation (GET
+        /v1/operator/cluster/health): every member's raft indices,
+        broker/plan-queue depths, host CPU/RSS, and per-source cost
+        top-K; partitioned members flagged `degraded` under a bounded
+        per-peer deadline."""
+        params = {}
+        if timeout_s is not None:
+            params["timeout"] = str(timeout_s)
+        if top is not None:
+            params["top"] = str(top)
+        return self.c.get(
+            "/v1/operator/cluster/health", params=params or None
+        )
+
 
 class AgentAPI(_Resource):
     def force_leave(self, node: str):

@@ -2249,6 +2249,119 @@ def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
     return "\n".join(lines)
 
 
+def _render_cluster_health(h: dict, prev=None) -> str:
+    """Render one /v1/operator/cluster/health payload: per-server rows
+    (raft indices, depths, host CPU/RSS, top source) + fleet totals.
+    prev is (monotonic_time, health) of the previous frame — per-server
+    CPU% is the cpu_seconds delta between frames (operator top
+    -cluster); '-' on the first frame or for degraded members."""
+    import time as _time
+
+    servers = h.get("servers") or []
+    n = len(servers)
+    lines = [
+        f"Cluster health — region {h.get('region', '-')}"
+        f"   leader {h.get('leader') or '-'}"
+        f"   {h.get('healthy', 0)}/{n} healthy"
+        f"   queried via {h.get('queried_by', '-')}"
+        f" in {h.get('elapsed_s', 0)}s",
+        "",
+    ]
+    prev_cpu: dict = {}
+    dt = None
+    if prev is not None:
+        prev_t, prev_h = prev
+        dt = max(_time.monotonic() - prev_t, 1e-9)
+        for s in prev_h.get("servers") or []:
+            host = s.get("host") or {}
+            if s.get("status") == "ok" and "cpu_seconds" in host:
+                prev_cpu[s["id"]] = host["cpu_seconds"]
+    rows = []
+    for s in servers:
+        if s.get("status") != "ok":
+            rows.append([
+                s.get("id", "?"), "degraded", "-", "-", "-", "-",
+                "-", "-", (s.get("error") or "")[:40],
+            ])
+            continue
+        raft = s.get("raft") or {}
+        broker = s.get("broker") or {}
+        host = s.get("host") or {}
+        top_src = next(
+            (r["source"] for r in (s.get("sources") or {}).get(
+                "top", []
+            )),
+            "-",
+        )
+        cpu = host.get("cpu_seconds")
+        cpu_txt = "-"
+        if cpu is not None and s["id"] in prev_cpu and dt:
+            cpu_txt = f"{(cpu - prev_cpu[s['id']]) / dt * 100:.0f}%"
+        elif cpu is not None:
+            cpu_txt = f"{cpu:.1f}s"
+        rows.append([
+            s["id"] + ("*" if s.get("leader") else ""),
+            "ok",
+            f"{raft.get('commit_index', 0)}/"
+            f"{raft.get('applied_index', 0)}",
+            str(int(broker.get("total_ready", 0))),
+            str(int(broker.get("total_unacked", 0))),
+            str(int(s.get("plan_queue_depth", 0))),
+            cpu_txt,
+            _fmt_bytes(host.get("rss_bytes", 0)),
+            top_src,
+        ])
+    lines.append(_fmt_table(
+        rows,
+        ["SERVER", "STATUS", "RAFT C/A", "READY", "UNACKED",
+         "PLANQ", "CPU", "RSS", "TOP SOURCE"],
+    ))
+    fleet = h.get("fleet") or {}
+    lines += [
+        "",
+        (
+            "Fleet totals"
+            f"   broker ready {fleet.get('broker_ready', 0)}"
+            f"  unacked {fleet.get('broker_unacked', 0)}"
+            f"   plan queue {fleet.get('plan_queue_depth', 0)}"
+            f"   cpu {fleet.get('cpu_seconds', 0.0):.1f}s"
+            f"   rss {_fmt_bytes(fleet.get('rss_bytes', 0))}"
+        ),
+    ]
+    src_rows = [
+        [r["source"], str(r["calls"]), f"{r['seconds']:.3f}s"]
+        for r in fleet.get("sources_top") or []
+    ]
+    if src_rows:
+        lines += [
+            "",
+            "Top sources by handler seconds (fleet-wide):",
+            _fmt_table(src_rows, ["SOURCE", "CALLS", "SECONDS"]),
+        ]
+    if h.get("degraded"):
+        lines += ["", f"DEGRADED members: {', '.join(h['degraded'])}"]
+    return "\n".join(lines)
+
+
+def cmd_operator_cluster_health(args) -> int:
+    """`operator cluster health` — the federated health surface
+    (/v1/operator/cluster/health): every member's raft indices, queue
+    depths, host CPU/RSS, and per-source cost top-K; partitioned
+    members flagged degraded without blocking the response."""
+    import json as _json
+
+    api = _client(args)
+    h = api.operator.cluster_health(
+        timeout_s=args.timeout, top=args.top
+    )
+    if args.as_json:
+        print(_json.dumps(h, indent=2, sort_keys=True))
+    else:
+        print(_render_cluster_health(h))
+    # exit 1 when any member is degraded: scriptable like `check`
+    return 1 if h.get("degraded") else 0
+
+
 def cmd_operator_top(args) -> int:
     """Live telemetry dashboard: throughput, queue depths, worker
     utilization, and per-stage p50/p95/p99 (cumulative + last window)
@@ -2262,6 +2375,25 @@ def cmd_operator_top(args) -> int:
     prev = None
     try:
         while True:
+            if getattr(args, "cluster", False):
+                # -cluster: the federated per-server view — one health
+                # pull renders every member's columns + fleet totals
+                # (CPU% from the cpu_seconds delta between frames)
+                health = api.operator.cluster_health(
+                    timeout_s=max(0.5, interval / 2)
+                )
+                frame = _render_cluster_health(health, prev)
+                prev = (_time.monotonic(), health)
+                frames += 1
+                last = args.once or (args.n and frames >= args.n)
+                if not last and sys.stdout.isatty():
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(frame)
+                sys.stdout.flush()
+                if last:
+                    return 0
+                _time.sleep(interval)
+                continue
             snap = api.agent.metrics()
             try:
                 solver = api.agent.solver_status()
@@ -2953,6 +3085,21 @@ def _args_operator_debug(p):
     p.set_defaults(fn=cmd_operator_debug)
 
 
+def _args_conn(sp) -> None:
+    """Accept -address/-token AFTER the subcommand too (the natural
+    spelling when pointing a dashboard at a specific server: `operator
+    top -address http://s2:4646`). The top-level flags keep working:
+    SUPPRESS means an absent subcommand flag never clobbers a value the
+    top-level parse already set, while a present one wins."""
+    sp.add_argument(
+        "-address", default=argparse.SUPPRESS,
+        help="HTTP API address of the target agent",
+    )
+    sp.add_argument(
+        "-token", default=argparse.SUPPRESS, help="ACL token"
+    )
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="nomad-tpu")
     p.add_argument("-address", default=None, help="HTTP API address")
@@ -3348,6 +3495,7 @@ def build_parser() -> argparse.ArgumentParser:
     opkrr.set_defaults(fn=cmd_operator_keyring_rotate)
     opmet = opsub.add_parser("metrics")
     opmet.add_argument("-json", action="store_true", dest="as_json")
+    _args_conn(opmet)
     opmet.set_defaults(fn=cmd_operator_metrics)
     optop = opsub.add_parser(
         "top", help="live telemetry dashboard (/v1/metrics)"
@@ -3358,7 +3506,31 @@ def build_parser() -> argparse.ArgumentParser:
                        help="frames to render (0 = until interrupted)")
     optop.add_argument("-once", action="store_true",
                        help="render a single frame and exit")
+    optop.add_argument(
+        "-cluster", action="store_true",
+        help="federated per-server columns + fleet totals "
+        "(/v1/operator/cluster/health)",
+    )
+    _args_conn(optop)
     optop.set_defaults(fn=cmd_operator_top)
+    opcl = opsub.add_parser(
+        "cluster", help="cluster-scope observability"
+    )
+    opclsub = opcl.add_subparsers(dest="subsubcmd")
+    opclh = opclsub.add_parser(
+        "health",
+        help="federated member health: raft indices, depths, host "
+        "CPU/RSS, per-source cost (/v1/operator/cluster/health)",
+    )
+    opclh.add_argument("-json", action="store_true", dest="as_json")
+    opclh.add_argument(
+        "-timeout", type=float, default=2.0,
+        help="per-peer deadline in seconds (slow members go degraded)",
+    )
+    opclh.add_argument("-top", type=int, default=5,
+                       help="per-source top-K rows per member")
+    _args_conn(opclh)
+    opclh.set_defaults(fn=cmd_operator_cluster_health)
     optr = opsub.add_parser(
         "trace", help="render eval-lifecycle traces (/v1/traces)"
     )

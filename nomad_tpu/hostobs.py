@@ -312,6 +312,11 @@ class HostProfiler:
         # collapsed "role;span;f0;f1;...;leaf" -> samples
         self._stacks: dict[str, int] = {}
         self._span_ns: dict[str, int] = {}
+        # source -> busy ns: the clusterobs thread->source registry's
+        # dimension ("handler CPU x source node") — bounded, overflow
+        # folds into "(other)" like the site ledger
+        self._source_ns: dict[str, int] = {}
+        self.max_sources = 512
         self._role_stats: dict[str, list] = {}  # role -> [samples, ns]
         self.samples = 0
         self.idle_samples = 0
@@ -342,6 +347,7 @@ class HostProfiler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._provider_handle = None
+        self._prev_section_hook = None
 
     # -- lifecycle -------------------------------------------------------
 
@@ -361,6 +367,10 @@ class HostProfiler:
             gc.callbacks.append(self._gc_cb)
             from . import gctune
 
+            # save the previous owner: a PRIVATE instance (run_soak's
+            # measurement apparatus) must hand the hook back to a
+            # co-resident global profiler on stop, not null it out
+            self._prev_section_hook = gctune.on_section_end
             gctune.on_section_end = self.note_gc_section
             if self._provider_handle is None:
                 from . import metrics
@@ -387,7 +397,8 @@ class HostProfiler:
         from . import gctune
 
         if gctune.on_section_end == self.note_gc_section:
-            gctune.on_section_end = None
+            gctune.on_section_end = self._prev_section_hook
+        self._prev_section_hook = None
 
     def running(self) -> bool:
         t = self._thread
@@ -421,6 +432,7 @@ class HostProfiler:
             self._sites.clear()
             self._stacks.clear()
             self._span_ns.clear()
+            self._source_ns.clear()
             self._role_stats.clear()
             self.samples = 0
             self.idle_samples = 0
@@ -516,10 +528,11 @@ class HostProfiler:
     def _sample(self, dt_ns: int) -> bool:
         """One pass over every live thread's current frame. Returns
         whether any thread was busy (drives the adaptive interval)."""
-        from . import trace as _trace
+        from . import clusterobs as _clusterobs, trace as _trace
 
         me = threading.get_ident()
         spans = _trace.thread_spans()
+        sources = _clusterobs.thread_sources()
         frames = sys._current_frames()
         busy_any = False
         code_cache = self._code_cache
@@ -555,6 +568,19 @@ class HostProfiler:
                 ent[1] += dt_ns
                 self.busy_ns += dt_ns
                 self._span_ns[span] = self._span_ns.get(span, 0) + dt_ns
+                # source dimension (clusterobs thread registry): only
+                # threads currently serving an attributed request carry
+                # one — handler CPU lands on its source node/namespace
+                src = sources.get(tid)
+                if src is not None:
+                    if (
+                        src not in self._source_ns
+                        and len(self._source_ns) >= self.max_sources
+                    ):
+                        src = OTHER_SITE
+                    self._source_ns[src] = (
+                        self._source_ns.get(src, 0) + dt_ns
+                    )
                 rs = self._role_stats.get(role)
                 if rs is None:
                     rs = self._role_stats[role] = [0, 0]
@@ -671,11 +697,15 @@ class HostProfiler:
         fds = _count_fds()
         if fds is not None:
             metrics.set_gauge("nomad.runtime.fds", float(fds))
-        # prune role cache + the trace-side span registry for dead tids
+        # prune role cache + the trace-side span registry + the
+        # clusterobs source registry for dead tids
         live = {t.ident for t in threading.enumerate()}
         for tid in [t for t in self._roles if t not in live]:
             self._roles.pop(tid, None)
         _trace.prune_thread_spans(live)
+        from . import clusterobs as _clusterobs
+
+        _clusterobs.prune_thread_sources(live)
 
     def _provider(self) -> dict:
         wall = max(1, now_ns() - self._started_ns)
@@ -709,6 +739,12 @@ class HostProfiler:
                     self._span_ns.items(), key=lambda kv: -kv[1]
                 )
             }
+            sources = {
+                k: round(v / 1e9, 4)
+                for k, v in sorted(
+                    self._source_ns.items(), key=lambda kv: -kv[1]
+                )[: max(1, top)]
+            }
             roles = {
                 r: {"samples": s[0], "busy_seconds": round(s[1] / 1e9, 4)}
                 for r, s in sorted(self._role_stats.items())
@@ -737,6 +773,10 @@ class HostProfiler:
                     for (role, span, site), ent in sites
                 ],
                 "spans": spans,
+                # handler CPU x source (clusterobs dimension): seconds
+                # of busy samples taken while the thread was serving an
+                # attributed request for that source
+                "sources": sources,
                 "threads": roles,
                 "sites": len(self._sites),
                 "sites_evicted": self.sites_evicted,

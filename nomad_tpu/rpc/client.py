@@ -22,6 +22,7 @@ from .server import StreamSession
 from .wire import (
     BYTE_RPC,
     BYTE_STREAMING,
+    SRC_KEY,
     TRACE_KEY,
     TRACE_SPANS_KEY,
     recv_frame,
@@ -53,8 +54,12 @@ class _Conn:
 
     def __init__(
         self, addr: tuple[str, int], connect_timeout_s: float,
-        secret: str = "", tls_context=None,
+        secret: str = "", tls_context=None, src: str = "",
     ) -> None:
+        # source-identity stamp for every request on this connection
+        # (wire.SRC_KEY): the dialing pool's owner label, so the peer
+        # can attribute served seconds to us (clusterobs.py)
+        self._src = src
         self.sock = socket.create_connection(addr, timeout=connect_timeout_s)
         if tls_context is not None:
             self.sock = tls_context.wrap_socket(
@@ -145,6 +150,8 @@ class _Conn:
         try:
             try:
                 req = {"seq": seq, "method": method, "args": args}
+                if self._src:
+                    req[SRC_KEY] = self._src
                 if tctx is not None:
                     req[TRACE_KEY] = trace.wire_ref(tctx, rpc_span)
                 payload = codec.pack(req)
@@ -336,7 +343,7 @@ class ConnPool:
                 else self.keyring.current
             )
             conn = _Conn(addr, self._connect_timeout_s, secret,
-                         tls_context=self.tls_context)
+                         tls_context=self.tls_context, src=self.owner)
             self._conns[addr] = conn
             return conn
 

@@ -31,13 +31,14 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
-from .. import codec, metrics, trace
+from .. import clusterobs, codec, metrics, trace
 from .. import faultplane
 from .keyring import ensure_keyring
 from .wire import (
     BYTE_RAFT,
     BYTE_RPC,
     BYTE_STREAMING,
+    SRC_KEY,
     TRACE_KEY,
     TRACE_SPANS_KEY,
     recv_frame,
@@ -120,6 +121,12 @@ class RPCServer:
         # Fault-plane identity (faultplane.py): the owning node's
         # label, so injected response drops can target this server.
         self.chaos_label = ""
+        # Per-source cost ledger (clusterobs.py): every dispatched
+        # request's handler seconds are attributed to its source node /
+        # peer / namespace. ClusterServer installs its own instance so
+        # in-process test clusters attribute per member; a bare
+        # RPCServer shares the process-global default.
+        self.source_ledger = clusterobs.ledger()
 
     @property
     def secret(self) -> str:
@@ -325,19 +332,28 @@ class RPCServer:
         ref = req.get(TRACE_KEY)
         if isinstance(ref, dict) and ref.get("id"):
             segment = trace.open_segment(f"rpc.{method}", ref)
+        # Source attribution (clusterobs.py): derive who this request is
+        # FOR, publish it on the thread->source registry so the hostobs
+        # sampler can attribute handler CPU to the source, and record
+        # the handler seconds in the bounded per-source ledger.
+        args = req.get("args")
+        source = clusterobs.source_of(req.get(SRC_KEY) or "", args)
+        clusterobs.set_thread_source(source)
         t0 = time.perf_counter()
         try:
             with trace.use(segment):
-                result = self.dispatch_local(method, req.get("args"))
+                result = self.dispatch_local(method, args)
             resp = {"seq": seq, "result": result}
         except Exception as e:  # handler errors travel as strings
             logger.debug("rpc %s failed: %s", method, e)
             resp = {"seq": seq, "error": f"{type(e).__name__}: {e}"}
+        finally:
+            clusterobs.clear_thread_source()
+        dt = time.perf_counter() - t0
+        self.source_ledger.record(source, method, dt)
         # handler-side latency (the client-side nomad.rpc.call_seconds
         # minus this is wire + queueing time)
-        metrics.observe(
-            f"nomad.rpc.served_seconds.{method}", time.perf_counter() - t0
-        )
+        metrics.observe(f"nomad.rpc.served_seconds.{method}", dt)
         if segment is not None:
             segment.finish(record=False)
             resp[TRACE_SPANS_KEY] = [s.to_wire() for s in segment.spans]

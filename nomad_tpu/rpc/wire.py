@@ -28,6 +28,14 @@ BYTE_STREAMING = 0x03
 TRACE_KEY = "trace"
 TRACE_SPANS_KEY = "trace_spans"
 
+# Source-identity propagation field (clusterobs.py): a dialing pool
+# whose owner has a node label stamps SRC_KEY on every request so the
+# handler side can attribute served seconds to the PEER (server-to-
+# server forwards, raft, serf). Requests about a node (heartbeats)
+# attribute to that node from the args instead — see
+# clusterobs.source_of. Absent costs nothing, like TRACE_KEY.
+SRC_KEY = "src"
+
 MAX_FRAME = 256 * 1024 * 1024
 
 _LEN = struct.Struct("!I")

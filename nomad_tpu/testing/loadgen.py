@@ -28,13 +28,14 @@ evidence.
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from .. import metrics
+from .. import clusterobs, metrics
 from ..ratelimit import RateLimitError, is_throttle_text, retry_after_from_text
 from ..rpc.client import RPCError
 from ..server.raft_replication import NotLeaderError
@@ -550,8 +551,28 @@ def run_soak(
         if use_tpu_worker:
             cluster.plane.fail_device(prob=0.02, retriable=True)
 
-    cluster.start()
+    # Server-CPU-per-node measurement (ROADMAP "bounded server-CPU-per-
+    # node" gate): a PRIVATE host profiler instance samples every thread
+    # for the traffic window and the per-role busy split separates
+    # server-side roles (rpc/raft/worker/applier/...) from the
+    # generator's own loadgen/main threads. Fresh instance — never the
+    # process-global one a co-resident Agent may be running.
+    from .. import hostobs
+
+    # NOMAD_TPU_SOAK_PROFILE=0 turns the measurement apparatus off
+    # (role attribution degrades to empty; the gated CPU stat is
+    # process_time and survives) — also the A/B knob for isolating
+    # profiler-load effects on race-timing-sensitive soaks.
+    profile_on = os.environ.get("NOMAD_TPU_SOAK_PROFILE", "1") != "0"
+    prof = hostobs.HostProfiler(interval_s=0.01, idle_interval_s=0.02)
     try:
+        # both starts INSIDE the try: a boot failure (port bind, raft
+        # store) must still tear the sampler thread + its gc hooks and
+        # provider down in the finally (prof.stop is a safe no-op when
+        # start never ran)
+        if profile_on:
+            prof.start()
+        cluster.start()
         lead = cluster.wait_for_stable_leader(timeout_s=60)
         if lead is None:
             raise RuntimeError("soak cluster never elected a leader")
@@ -603,7 +624,27 @@ def run_soak(
         for k, v in (loadgen_overrides or {}).items():
             setattr(cfg, k, v)
         gen = LoadGen(cluster, cfg)
+        prof.reset_stats()  # exclude cluster boot from the CPU window
+        cpu_t0 = time.process_time()
         report = gen.run()
+        cpu_delta = time.process_time() - cpu_t0
+        prof_snap = prof.snapshot(top=1)
+
+        # per-source attribution coverage across every member's ledger
+        # (clusterobs.py): how much of the served handler seconds were
+        # billed to a KNOWN node/peer/namespace
+        src_total_calls = 0
+        src_total_s = 0.0
+        src_unattr_s = 0.0
+        src_evicted = 0
+        src_rows: list[dict] = []
+        for cs in cluster.servers.values():
+            snap = cs.source_ledger.snapshot(top=10)
+            src_total_calls += snap["total_calls"]
+            src_total_s += snap["total_seconds"]
+            src_unattr_s += snap["unattributed_seconds"]
+            src_evicted += snap["evicted"]
+            src_rows.extend(snap["top"])
 
         # quiesce: stop injecting, let the cluster converge, then hold
         # it to the standard invariants
@@ -618,6 +659,61 @@ def run_soak(
             invariants_ok = False
             invariant_error = str(e)
 
+        # Server CPU x node attribution. The GATED stat is real process
+        # CPU time over the traffic window (time.process_time sums every
+        # thread's actual CPU): one process hosts the whole control
+        # plane here, so this is the fleet's server cost — an UPPER
+        # bound, since the in-process generator's own threads count too.
+        # The profiler role table rides along as the attribution view;
+        # its numbers are busy WALL (a thread parked in a C call —
+        # time.sleep, a device wait — samples busy at the calling
+        # frame, the documented hostobs conflation), so they apportion
+        # cost by role but must never be summed as CPU.
+        roles = prof_snap.get("threads") or {}
+        client_roles = {"loadgen", "main"}
+        server_busy_s = sum(
+            r["busy_seconds"]
+            for name, r in roles.items()
+            if name not in client_roles
+        )
+        client_busy_s = sum(
+            r["busy_seconds"]
+            for name, r in roles.items()
+            if name in client_roles
+        )
+        wall = max(report.get("duration_s") or 0.0, 1e-9)
+        nodes = max(int(cfg.node_count), 1)
+        report["server_cpu"] = {
+            "cpu_seconds": round(cpu_delta, 3),
+            "per_node_cpu_seconds": round(cpu_delta / nodes, 4),
+            # cores-per-node over the traffic window: the number the
+            # fleet-scale gate bounds (ROADMAP item 4)
+            "per_node_cpu_fraction": round(
+                cpu_delta / wall / nodes, 5
+            ),
+            "node_count": nodes,
+            "server_busy_wall_seconds": round(server_busy_s, 3),
+            "client_busy_wall_seconds": round(client_busy_s, 3),
+            "busy_wall_by_role": {
+                name: round(r["busy_seconds"], 3)
+                for name, r in sorted(roles.items())
+            },
+        }
+        report["server_cpu_per_node"] = report["server_cpu"][
+            "per_node_cpu_seconds"
+        ]
+        report["source_attribution"] = {
+            "total_calls": src_total_calls,
+            "total_seconds": round(src_total_s, 4),
+            "unattributed_seconds": round(src_unattr_s, 4),
+            "evicted": src_evicted,
+            "coverage": round(
+                1.0 - src_unattr_s / max(src_total_s, 1e-12), 4
+            )
+            if src_total_calls
+            else 1.0,
+            "top": clusterobs.merge_top_sources(src_rows, top=5),
+        }
         counters = report["counters"]
         admission_engaged = (
             counters["nomad.broker.shed"]
@@ -644,3 +740,12 @@ def run_soak(
         return report
     finally:
         cluster.shutdown()
+        if profile_on:
+            prof.stop()
+        # the private profiler's provider must not outlive the run (it
+        # would shadow a co-resident Agent's global profiler under the
+        # same "nomad.host" name — provider stacks are newest-wins)
+        if prof._provider_handle is not None:
+            metrics.unregister_provider(
+                "nomad.host", prof._provider_handle
+            )

@@ -763,10 +763,20 @@ REFERENCE_COMMAND_FLAGS = {
     "alloc stop": {"flags": set(), "args": ["alloc_id"]},
     "deployment status": {"flags": set(), "args": ["deployment_id"]},
     "namespace apply": {"flags": {"-description"}, "args": ["name"]},
-    "operator metrics": {"flags": {"-json"}, "args": []},
+    # Round 15 (cluster-observability PR): operator metrics/top accept
+    # -address/-token AFTER the subcommand too, so the per-server
+    # cluster columns are reachable individually (`operator top
+    # -address http://s2:4646`); top grows -cluster (federated view).
+    "operator metrics": {
+        "flags": {"-json", "-address", "-token"}, "args": [],
+    },
     # operator top is this repo's own surface (no reference analog):
     # registered here so its flag set is droppable only deliberately
-    "operator top": {"flags": {"-interval", "-n", "-once"}, "args": []},
+    "operator top": {
+        "flags": {"-interval", "-n", "-once", "-cluster",
+                  "-address", "-token"},
+        "args": [],
+    },
     # Round 10 (solver observability PR): extended 21 -> 30, covering
     # operator debug, the operator solver subcommands, the trace
     # viewer, and the event family.
@@ -799,6 +809,12 @@ REFERENCE_COMMAND_FLAGS = {
     "operator keyring status": {"flags": {"-json"}, "args": []},
     "operator keyring rotate": {
         "flags": {"-secret", "-window", "-json"}, "args": [],
+    },
+    # Round 15 (cluster-observability PR): extended 36 -> 37 with the
+    # federated cluster health surface (/v1/operator/cluster/health).
+    "operator cluster health": {
+        "flags": {"-json", "-timeout", "-top", "-address", "-token"},
+        "args": [],
     },
     "event stream": {
         "flags": {"-topic", "-index", "-namespace"}, "args": [],

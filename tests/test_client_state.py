@@ -218,12 +218,20 @@ class TestRestartReattach:
         task.config = {"command": "/bin/sleep", "args": ["120"]}
         job.datacenters = [c1.node.datacenter]
         server.job_register(job)
-        assert wait_until(
+        # Event-driven (testing/waits.py): the transitions waited on
+        # here are store writes, so the broker wakes the check the
+        # moment they land — a fixed-cadence poll on a loaded 2-CPU box
+        # burns the very CPU the exec task needs to start (the
+        # repeat-offender load flake in this test).
+        from nomad_tpu.testing.waits import wait_for_state
+
+        assert wait_for_state(
+            [server],
             lambda: any(
                 a.client_status == "running"
                 for a in server.state.allocs_by_job(job.namespace, job.id)
             ),
-            20,
+            timeout_s=30,
         )
         alloc = server.state.allocs_by_job(job.namespace, job.id)[0]
         handle = c1.state_db.get_task_handle(alloc.id, task.name)
@@ -235,10 +243,16 @@ class TestRestartReattach:
         c2 = Client(ServerRPC(server), data_dir=data_dir)
         assert c2.node.id == c1.node.id, "node identity must persist"
         c2.start()
-        assert wait_until(
+        # the restore publishes alloc updates through the same store;
+        # the fallback re-check covers the client-local runner state
+        # that writes no event
+        assert wait_for_state(
+            [server],
             lambda: alloc.id in c2.alloc_runners
-            and c2.alloc_runners[alloc.id].alloc.client_status == "running",
-            15,
+            and c2.alloc_runners[alloc.id].alloc.client_status
+            == "running",
+            timeout_s=30,
+            fallback_interval_s=0.3,
         ), "restored alloc should be running again via reattach"
         tr = c2.alloc_runners[alloc.id].task_runners[task.name]
         assert any(

@@ -1,0 +1,558 @@
+"""Cluster-scope observability plane (nomad_tpu/clusterobs.py +
+cluster.py peer_telemetry/cluster_health).
+
+Covers: the bounded top-K source ledger (LRU overflow into "(other)",
+identity loss counted), source derivation (node args beat the envelope
+peer label beat the namespace), fabric + in-process attribution, the
+hostobs handler-CPU x source dimension, leader-side telemetry
+federation on a live 3-server cluster (partitioned member degraded
+within the per-peer deadline, healthy members still aggregated), the
+/v1/operator/cluster/health ACL battery (anon 401 / ns-token 403 /
+agent:read 200), and the instrumented-vs-uninstrumented front-door
+throughput gate (clean-subprocess paired-burst median, the round-13
+recipe).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from nomad_tpu import clusterobs, mock
+from nomad_tpu.clusterobs import (
+    OTHER_SOURCE,
+    UNKNOWN_SOURCE,
+    SourceLedger,
+    source_of,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_until(fn, timeout_s=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# SourceLedger units
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_records_and_snapshots():
+    lg = SourceLedger(top_k=8)
+    lg.record("node:n1", "Node.heartbeat", 0.010)
+    lg.record("node:n1", "Node.heartbeat", 0.020)
+    lg.record("ns:tenant-a", "Job.register", 0.050)
+    snap = lg.snapshot(top=5)
+    assert snap["total_calls"] == 3
+    assert snap["tracked"] == 2
+    assert snap["evicted"] == 0
+    assert snap["coverage"] == 1.0
+    top = snap["top"]
+    # sorted by seconds: the tenant's one big register first
+    assert top[0]["source"] == "ns:tenant-a"
+    assert top[1]["source"] == "node:n1"
+    assert top[1]["calls"] == 2
+    assert top[1]["methods"]["Node.heartbeat"]["calls"] == 2
+
+
+def test_ledger_topk_overflow_lru_into_other():
+    """Past the bound, the LEAST-recently-active source folds into
+    "(other)": totals conserved, eviction counted — never a silent
+    drop, and never an unbounded per-node dict."""
+    lg = SourceLedger(top_k=4)
+    for i in range(4):
+        lg.record(f"node:n{i}", "Node.heartbeat", 0.01)
+    # refresh n0 so n1 is the LRU victim when n9 arrives
+    lg.record("node:n0", "Node.heartbeat", 0.01)
+    lg.record("node:n9", "Node.heartbeat", 0.01)
+    snap = lg.snapshot(top=10)
+    sources = {row["source"] for row in snap["top"]}
+    assert "node:n9" in sources
+    assert "node:n0" in sources
+    assert "node:n1" not in sources, "LRU victim must fold away"
+    assert OTHER_SOURCE in sources
+    assert snap["evicted"] == 1
+    # totals conserved across the fold
+    assert snap["total_calls"] == 6
+    total_from_rows = sum(row["calls"] for row in snap["top"])
+    assert total_from_rows == 6
+    # repeated overflow keeps the ledger at its bound: at most top_k
+    # exact sources plus the explicit "(other)" bucket
+    for i in range(50):
+        lg.record(f"node:m{i}", "Node.heartbeat", 0.001)
+    snap = lg.snapshot(top=100)
+    assert snap["tracked"] <= 4 + 1
+    assert snap["evicted"] > 1
+    assert sum(r["calls"] for r in snap["top"]) == snap["total_calls"]
+
+
+def test_ledger_unattributed_and_disabled():
+    lg = SourceLedger()
+    lg.record(UNKNOWN_SOURCE, "Status.ping", 0.001)
+    snap = lg.snapshot()
+    assert snap["unattributed_calls"] == 1
+    assert snap["coverage"] < 1.0
+    clusterobs.set_enabled(False)
+    try:
+        lg.record("node:n1", "Node.heartbeat", 0.01)
+    finally:
+        clusterobs.set_enabled(True)
+    assert lg.snapshot()["total_calls"] == 1, "disabled must record nothing"
+
+
+def test_source_of_derivation():
+    # node identity wins even when an envelope peer label is present
+    # (a forwarded heartbeat bills the node, not the forwarding server)
+    assert source_of("s1", {"node_id": "abc"}) == "node:abc"
+    node = mock.node()
+    assert source_of("", {"node": node}) == f"node:{node.id}"
+    # peer label beats the namespace (raft/forward chatter)
+    assert source_of("s1", {"namespace": "default"}) == "srv:s1"
+    # tenant-attributable writes fall to the object namespace
+    assert source_of("", {"namespace": "tenant-a"}) == "ns:tenant-a"
+    job = mock.job()
+    job.namespace = "tenant-b"
+    assert source_of("", {"job": job}) == "ns:tenant-b"
+    assert source_of("", {}) == UNKNOWN_SOURCE
+    assert source_of("", None) == UNKNOWN_SOURCE
+
+
+# ---------------------------------------------------------------------------
+# Fabric + in-process attribution
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_dispatch_attributes_envelope_and_args():
+    """A pool whose owner is labeled stamps SRC_KEY on every request;
+    the serving RPCServer's ledger attributes handler seconds to it —
+    unless the args name a node, which wins."""
+    from nomad_tpu.rpc import ConnPool, RPCServer
+
+    class Echo:
+        def ping(self, args):
+            return "pong"
+
+        def heartbeat(self, args):
+            return args.get("node_id")
+
+    server = RPCServer()
+    server.source_ledger = SourceLedger()
+    server.register("Echo", Echo())
+    server.start()
+    pool = ConnPool()
+    pool.owner = "peer-7"
+    try:
+        addr = server.addr
+        assert pool.call(addr, "Echo.ping", {}) == "pong"
+        assert (
+            pool.call(addr, "Echo.heartbeat", {"node_id": "n42"}) == "n42"
+        )
+        assert wait_until(
+            lambda: server.source_ledger.snapshot()["total_calls"] == 2,
+            5,
+        )
+        rows = {
+            r["source"]: r
+            for r in server.source_ledger.snapshot(top=10)["top"]
+        }
+        assert "srv:peer-7" in rows, rows
+        assert rows["srv:peer-7"]["methods"]["Echo.ping"]["calls"] == 1
+        assert "node:n42" in rows, rows
+    finally:
+        pool.shutdown()
+        server.shutdown()
+
+
+def test_hostobs_source_dimension():
+    """Busy profiler samples taken while a thread is serving an
+    attributed request land on that source — handler CPU x source."""
+    import threading
+
+    from nomad_tpu import hostobs
+
+    prof = hostobs.HostProfiler(interval_s=0.002, idle_interval_s=0.004)
+    prof.start()
+    stop = threading.Event()
+
+    def busy():
+        clusterobs.set_thread_source("node:hot-client")
+        try:
+            x = 0
+            while not stop.is_set():
+                x += sum(range(200))
+        finally:
+            clusterobs.clear_thread_source()
+
+    t = threading.Thread(target=busy, name="rpc-test-busy", daemon=True)
+    t.start()
+    try:
+        assert wait_until(
+            lambda: prof.snapshot(top=5)
+            .get("sources", {})
+            .get("node:hot-client", 0)
+            > 0,
+            10,
+        ), prof.snapshot(top=5).get("sources")
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        prof.stop()
+    snap = prof.snapshot(top=5)
+    assert snap["sources"]["node:hot-client"] > 0
+    # the registry entry is cleaned up with the thread
+    assert (
+        threading.get_ident() in clusterobs.thread_sources()
+    ) is False
+
+
+# ---------------------------------------------------------------------------
+# Federation: live 3-server cluster, partition -> degraded
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    from nomad_tpu.testing import chaos
+    from nomad_tpu.testing.chaos import ChaosCluster
+
+    chaos.uninstall()
+    c = ChaosCluster(3, str(tmp_path), seed=11, num_workers=1).start()
+    lead = c.wait_for_stable_leader(timeout_s=60)
+    assert lead is not None
+    yield c
+    c.shutdown()
+    chaos.uninstall()
+
+
+def test_cluster_health_live_three_servers(cluster3):
+    """Acceptance shape: every member reported with raft indices,
+    broker/plan-queue depths, host CPU/RSS, and a per-source top-K
+    that attributes the driven traffic."""
+    lead = cluster3.leader()
+    follower = next(
+        cs for cs in cluster3.servers.values() if not cs.is_leader()
+    )
+    node = mock.node()
+    follower.rpc_self("Node.register", {"node": node})
+    for _ in range(3):
+        follower.rpc_self("Node.heartbeat", {"node_id": node.id})
+    follower.rpc_self("Job.register", {"job": mock.job(id="health-probe")})
+
+    h = lead.cluster_health(per_peer_timeout_s=3.0)
+    assert h["degraded"] == []
+    assert len(h["servers"]) == 3
+    assert h["leader"] == lead.node_id
+    leader_rows = [s for s in h["servers"] if s.get("leader")]
+    assert [s["id"] for s in leader_rows] == [lead.node_id]
+    for s in h["servers"]:
+        assert s["status"] == "ok"
+        assert s["raft"]["commit_index"] >= 1
+        assert s["raft"]["applied_index"] >= 1
+        assert "total_ready" in s["broker"]
+        assert "plan_queue_depth" in s
+        assert s["host"]["rss_bytes"] > 0
+        assert s["host"]["cpu_seconds"] > 0
+        assert "top" in s["sources"]
+    # the driven traffic is attributed: the node's heartbeats on the
+    # follower, the leader-forward (srv:) on the leader
+    fsrc = {
+        r["source"]
+        for s in h["servers"]
+        if s["id"] == follower.node_id
+        for r in s["sources"]["top"]
+    }
+    assert f"node:{node.id}" in fsrc, fsrc
+    lsrc = {
+        r["source"]
+        for s in h["servers"]
+        if s["id"] == lead.node_id
+        for r in s["sources"]["top"]
+    }
+    assert any(src.startswith("srv:") for src in lsrc), lsrc
+    # fleet totals aggregate the healthy members
+    assert h["fleet"]["rss_bytes"] > 0
+    assert h["fleet"]["sources_top"]
+    # any member may serve the federation, not just the leader
+    h2 = follower.cluster_health(per_peer_timeout_s=3.0)
+    assert len(h2["servers"]) == 3 and h2["degraded"] == []
+
+
+def test_cluster_health_partition_degraded(cluster3):
+    """A partitioned member is reported degraded WITHIN the per-peer
+    deadline — never a hang — and the healthy members still aggregate."""
+    lead = cluster3.leader()
+    ids = sorted(cluster3.addrs)
+    minority = [i for i in ids if i != lead.node_id][-1]
+    majority = [i for i in ids if i != minority]
+    cluster3.plane.partition([minority], majority)
+    deadline_s = 1.0
+    t0 = time.monotonic()
+    h = lead.cluster_health(per_peer_timeout_s=deadline_s)
+    elapsed = time.monotonic() - t0
+    assert elapsed < deadline_s + 1.0, (
+        f"federation must never outwait the per-peer deadline: {elapsed}"
+    )
+    assert h["degraded"] == [minority], h["degraded"]
+    bad = next(s for s in h["servers"] if s["id"] == minority)
+    assert bad["status"] == "degraded" and bad["error"]
+    healthy = [s["id"] for s in h["servers"] if s["status"] == "ok"]
+    assert sorted(healthy) == sorted(majority)
+    assert h["healthy"] == 2
+    # healthy members still carried full telemetry
+    for s in h["servers"]:
+        if s["status"] == "ok":
+            assert s["host"]["rss_bytes"] > 0
+    # heal: the degraded member recovers on the next pass
+    cluster3.heal()
+    h2 = lead.cluster_health(per_peer_timeout_s=3.0)
+    assert h2["degraded"] == []
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + ACL battery
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_health_http_route_and_debug_bundle(tmp_path):
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.agent.debug import debug_bundle
+    from nomad_tpu.api.client import APIError, NomadClient
+
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path / "agent")
+    a = Agent(cfg)
+    a.start()
+    try:
+        api = NomadClient(f"http://127.0.0.1:{a.http_addr[1]}")
+        h = api.operator.cluster_health(timeout_s=1.0, top=3)
+        assert len(h["servers"]) == 1
+        assert h["servers"][0]["status"] == "ok"
+        assert h["leader"] == h["servers"][0]["id"]
+        # parameter validation
+        with pytest.raises(APIError) as e:
+            api.get(
+                "/v1/operator/cluster/health",
+                params={"timeout": "nope"},
+            )
+        assert e.value.status == 400
+        # the operator debug bundle grows the cluster capture
+        bundle = debug_bundle(api)
+        assert "cluster_health" in bundle
+        assert "servers" in bundle["cluster_health"], bundle[
+            "cluster_health"
+        ]
+    finally:
+        a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def acl_agent(tmp_path_factory):
+    from nomad_tpu.agent import Agent, AgentConfig
+
+    cfg = AgentConfig.dev()
+    cfg.acl_enabled = True
+    cfg.data_dir = str(tmp_path_factory.mktemp("clusterobs-acl"))
+    a = Agent(cfg)
+    a.start()
+    assert wait_until(lambda: a.server.is_leader(), 15)
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def root(acl_agent):
+    from nomad_tpu.api.client import NomadClient
+
+    host, port = acl_agent.http_addr
+    api = NomadClient(f"http://{host}:{port}")
+    token = api.acl.bootstrap()
+    return NomadClient(f"http://{host}:{port}", token=token.secret_id)
+
+
+class TestClusterHealthACL:
+    """/v1/operator/cluster/health sits behind agent:read, the
+    observability-surface family gate (NOT operator:read): anon 401 /
+    ns-token 403 / agent:read 200."""
+
+    def _token(self, root, name, rules):
+        root.acl.policy_apply(name, rules)
+        return root.acl.token_create(name=name, policies=[name])
+
+    def test_acl_battery(self, acl_agent, root):
+        from nomad_tpu.api.client import APIError, NomadClient
+
+        host, port = acl_agent.http_addr
+        anon = NomadClient(f"http://{host}:{port}")
+        with pytest.raises(APIError) as e:
+            anon.operator.cluster_health()
+        assert e.value.status == 401
+        ns = self._token(
+            root, "ch-ns-only",
+            'namespace "default" { policy = "read" }',
+        )
+        nsr = NomadClient(f"http://{host}:{port}", token=ns.secret_id)
+        with pytest.raises(APIError) as e:
+            nsr.operator.cluster_health()
+        assert e.value.status == 403
+        ar = self._token(
+            root, "ch-agent-r", 'agent { policy = "read" }'
+        )
+        reader = NomadClient(f"http://{host}:{port}", token=ar.secret_id)
+        h = reader.operator.cluster_health()
+        assert h["servers"] and h["servers"][0]["status"] == "ok"
+        # management passes too
+        assert root.operator.cluster_health()["servers"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: -address after the subcommand + cluster renders
+# ---------------------------------------------------------------------------
+
+
+def test_cli_address_after_subcommand(tmp_path, capsys):
+    """`operator top|metrics|cluster health` accept -address/-token
+    AFTER the subcommand (pointing a dashboard at a specific server);
+    the top-level spelling keeps working and a post-subcommand flag
+    wins over a pre-subcommand one."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.cli.main import build_parser, main
+
+    p = build_parser()
+    a = p.parse_args(["operator", "top", "-address", "http://x:1"])
+    assert a.address == "http://x:1"
+    a = p.parse_args(
+        ["-address", "http://pre:1", "operator", "metrics", "-json"]
+    )
+    assert a.address == "http://pre:1"
+    a = p.parse_args(
+        ["-address", "http://pre:1", "operator", "metrics",
+         "-address", "http://post:2"]
+    )
+    assert a.address == "http://post:2"
+
+    cfg = AgentConfig.dev()
+    cfg.data_dir = str(tmp_path / "agent")
+    agent = Agent(cfg)
+    agent.start()
+    try:
+        addr = f"http://127.0.0.1:{agent.http_addr[1]}"
+        assert main(["operator", "metrics", "-address", addr]) == 0
+        out = capsys.readouterr().out
+        assert "Counters" in out or "Uptime" in out
+        assert main(
+            ["operator", "cluster", "health", "-address", addr]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "Cluster health" in out and "TOP SOURCE" in out
+        assert "Fleet totals" in out
+        assert main(
+            ["operator", "top", "-cluster", "-once", "-address", addr]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "SERVER" in out and "RAFT C/A" in out
+        # -json emits machine-readable output
+        assert main(
+            ["operator", "cluster", "health", "-json", "-address", addr]
+        ) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["servers"][0]["status"] == "ok"
+    finally:
+        agent.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Throughput gate: instrumented vs uninstrumented front door
+# ---------------------------------------------------------------------------
+
+OVERHEAD_SCRIPT = r"""
+import json, random, statistics, sys, tempfile, time
+sys.path.insert(0, %r)
+
+from nomad_tpu import clusterobs
+from nomad_tpu.server.cluster import ClusterServer
+
+# One dev-mode server; the measured op is the instrumented path itself:
+# an in-process front-door dispatch (rpc_self) plus a fabric round-trip
+# (ConnPool -> RPCServer._dispatch) per iteration — source derivation,
+# thread-source registry, and the ledger are ALL on this path.
+cs = ClusterServer("bench-s0", num_workers=1)
+cs.start()
+deadline = time.monotonic() + 15
+while cs.raft.leader_id is None and time.monotonic() < deadline:
+    time.sleep(0.01)
+addr = cs.rpc.addr
+
+
+def once(instrumented: bool, reps: int) -> float:
+    clusterobs.set_enabled(instrumented)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            cs.rpc_self("Status.ping", {})
+            cs.pool.call(addr, "Status.ping", {})
+        return time.perf_counter() - t0
+    finally:
+        clusterobs.set_enabled(True)
+
+
+# warm sockets + code paths, then size bursts to ~60ms of wall
+t1 = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    once(True, 20)
+    t1 = min(t1, (time.perf_counter() - t0) / 20)
+reps = max(20, int(0.06 / max(t1, 1e-6)))
+pairs = 24
+ratios = []
+for _ in range(pairs):
+    order = [False, True]
+    random.shuffle(order)
+    t = {}
+    for on in order:
+        t[on] = once(on, reps)
+    ratios.append(t[False] / t[True])
+cs.shutdown()
+out = {"median": statistics.median(ratios), "reps": reps,
+       "burst_ms": t1 * reps * 1e3}
+print(json.dumps(out))
+"""
+
+
+def test_attribution_throughput_vs_uninstrumented():
+    """Front-door throughput with source attribution ON stays >= 0.95x
+    the disabled path. Statistic per the round-13 recipe: the median of
+    temporally-adjacent off/on burst-pair ratios judged WITHIN one
+    clean subprocess, best across attempts (paired bursts cancel the
+    between-subprocess floor drift on this shared 2-CPU box; a load
+    spike lands in one pair and dies at the median; a real regression
+    shifts every pair alike). Never a 'box looks quiet' branch —
+    loadavg is pinned at 0.00 here."""
+    import subprocess
+    import sys
+
+    medians = []
+    for _attempt in range(5):
+        proc = subprocess.run(
+            [sys.executable, "-c", OVERHEAD_SCRIPT % REPO_ROOT],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+        medians.append(round(out["median"], 3))
+        if out["median"] >= 0.95:
+            return
+    pytest.fail(
+        f"attributed front-door throughput < 0.95x uninstrumented in "
+        f"5 attempts; per-attempt paired-burst medians: {medians}"
+    )

@@ -809,3 +809,28 @@ def test_profiled_throughput_vs_unprofiled_gate():
         f"profiled throughput < 0.95x unprofiled across all attempts "
         f"(best per workload {best}): {attempts}"
     )
+
+
+def test_private_profiler_restores_gctune_hook():
+    """A PRIVATE HostProfiler (run_soak's measurement apparatus) must
+    hand gctune.on_section_end back to its previous owner on stop —
+    nulling it would permanently blind a co-resident global profiler's
+    paused-section accounting."""
+    from nomad_tpu import gctune, hostobs
+
+    before = gctune.on_section_end
+    outer = hostobs.HostProfiler(interval_s=0.05)
+    outer.start()
+    try:
+        assert gctune.on_section_end == outer.note_gc_section
+        inner = hostobs.HostProfiler(interval_s=0.05)
+        inner.start()
+        try:
+            assert gctune.on_section_end == inner.note_gc_section
+        finally:
+            inner.stop()
+        # the inner (soak-private) instance restored the outer owner
+        assert gctune.on_section_end == outer.note_gc_section
+    finally:
+        outer.stop()
+    assert gctune.on_section_end == before

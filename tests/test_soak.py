@@ -15,6 +15,8 @@ slow-marked; run it with `pytest -m 'soak and slow'` or via
 
 from __future__ import annotations
 
+import os
+
 import pytest
 
 from nomad_tpu import metrics
@@ -73,6 +75,29 @@ def test_mini_soak_overload_with_faults(tmp_path):
     assert report["p99_bounded"], report.get("e2e_seconds")
     # the seeded fault schedule actually fired faults during the run
     assert report["fault_schedule"] and report["fired_faults"]
+    # cluster observability (clusterobs.py): server CPU was measured
+    # and attributed per simulated node, and the per-source ledger
+    # covered the served handler seconds — the bench `soak` config
+    # gates on exactly these stats (server_cpu_per_node bounded,
+    # coverage >= 0.8)
+    cpu = report["server_cpu"]
+    assert cpu["cpu_seconds"] > 0, cpu
+    assert report["server_cpu_per_node"] == cpu["per_node_cpu_seconds"]
+    assert cpu["per_node_cpu_fraction"] > 0
+    # process CPU over the window is physically bounded by cores x wall
+    # (the profiler's busy-WALL role table is not — C-call parking)
+    assert cpu["cpu_seconds"] <= (os.cpu_count() or 1) * (
+        report["duration_s"] + 30.0
+    )
+    assert cpu["busy_wall_by_role"], cpu
+    src = report["source_attribution"]
+    assert src["total_calls"] > 0
+    assert src["coverage"] >= 0.8, src
+    # traffic is node- and tenant-attributed, never all "(unknown)"
+    assert any(
+        r["source"].startswith(("node:", "ns:", "srv:"))
+        for r in src["top"]
+    ), src["top"]
 
 
 def test_mini_soak_seed_fixes_fault_schedule(tmp_path):
