@@ -69,21 +69,28 @@ def build_cluster(n_nodes: int, n_jobs: int, count: int, constrained: bool,
                   priority: int = 50, job_prefix: str = "bench",
                   cpu: int = 250, mem: int = 128):
     from nomad_tpu import mock
+    from nomad_tpu.gctune import paused_gc
     from nomad_tpu.structs import Constraint, Spread
     from nomad_tpu.structs.node_class import compute_node_class
     from nomad_tpu.testing import Harness
 
-    h = Harness()
-    dcs = ["dc1", "dc2", "dc3", "dc4"]
-    for i in range(n_nodes):
-        n = mock.node()
-        n.datacenter = dcs[i % len(dcs)]
-        n.resources.cpu = 4000
-        n.resources.memory_mb = 8192
-        n.computed_class = compute_node_class(n)
-        h.state.upsert_node(h.next_index(), n)
-    jobs = add_jobs(h, n_jobs, count, constrained, priority, job_prefix,
-                    cpu, mem)
+    # One bounded allocation burst (10k nodes + the job set), frozen on
+    # exit: the built cluster IS resident heap, so it goes straight to
+    # the permanent generation instead of being young-gen-scanned (with
+    # every gc callback, jax's included) at the first post-build
+    # collection (gctune.paused_gc).
+    with paused_gc(freeze_on_exit=True):
+        h = Harness()
+        dcs = ["dc1", "dc2", "dc3", "dc4"]
+        for i in range(n_nodes):
+            n = mock.node()
+            n.datacenter = dcs[i % len(dcs)]
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+            n.computed_class = compute_node_class(n)
+            h.state.upsert_node(h.next_index(), n)
+        jobs = add_jobs(h, n_jobs, count, constrained, priority, job_prefix,
+                        cpu, mem)
     return h, jobs
 
 
@@ -133,8 +140,14 @@ def tpu_place(h, jobs, config=None, warm=True, resident=None):
     span machinery production serves at /v1/traces — not a parallel set
     of hand-wired timers. The trace rides the global recorder; the
     configs' summaries are published under each result's "trace" key."""
-    from nomad_tpu import mock, trace
+    from nomad_tpu import codec, mock, trace
     from nomad_tpu.scheduler.tpu import solve_eval_batch
+
+    # the bulk id-minting/plan-row fast paths ride the fastpack
+    # extension; resolve it here, outside any lock (codec.warm_native)
+    codec.warm_native()
+
+    from nomad_tpu.gctune import paused_gc
 
     snap = h.snapshot()
     if warm:
@@ -148,7 +161,12 @@ def tpu_place(h, jobs, config=None, warm=True, resident=None):
     evals = [mock.eval_for_job(job) for job in jobs]
     ctx = trace.start_trace("bench.batch", evals=len(evals))
     t0 = time.perf_counter()
-    with trace.use(ctx):
+    # the whole solve->commit pipeline is one paused-GC section (the
+    # inner solver/store sections nest): the gaps between per-eval plan
+    # submissions were paying young-gen scans + the jax gc callback.
+    # freeze_on_exit: the survivors are committed store rows — resident
+    # heap by definition — so they skip the deferred scan entirely
+    with trace.use(ctx), paused_gc(freeze_on_exit=True):
         plans = solve_eval_batch(snap, h, evals, config, resident=resident)
         with trace.span(ctx, "plan.submit"):
             for ev in evals:
@@ -298,12 +316,21 @@ def host_attribution_pass(n_nodes, n_jobs, count, constrained,
     # and a burst following a long idle build must not start at the
     # backed-off rate. Restored to the production cadence after.
     hostobs.configure(interval_s=0.002, idle_interval_s=0.002)
+    # One collect + resident freeze BEFORE reset_stats and the phase
+    # timer: a per-pass collect would dominate the attribution window
+    # with self-inflicted gen2 scans, and the freeze
+    # (gctune.freeze_resident_heap — the post-warmup mitigation
+    # production runs) must not appear as a measured site or pause.
+    # Per-pass cluster builds freeze their own survivors on section
+    # exit (build_cluster), so the phase measures gc_share with the
+    # full mitigation active.
+    from nomad_tpu.gctune import freeze_resident_heap
+
+    freeze_resident_heap()
     hostobs.reset_stats()
     solve_wall = 0.0
     passes = 0
     t_phase = time.perf_counter()
-    gc.collect()  # once, before the phase: a per-pass collect would
-    # dominate the attribution window with self-inflicted gen2 scans
     try:
         h = jobs = None
         while solve_wall < wall_target_s and passes < max_passes:
@@ -454,9 +481,16 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample,
     resident_syncs = []
     h = jobs = None
     rounds = 1
+    from nomad_tpu.gctune import freeze_resident_heap
+
     if min_trial_s > 0:
         gc.collect()
         h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
+        # post-warmup freeze: the first cluster's heap (and everything
+        # resident beneath it — jax, the store machinery) leaves the
+        # collector's sight, so measured-pass collections walk only
+        # young objects (ISSUE gc tax; gctune.freeze_resident_heap)
+        freeze_resident_heap()
         warm_dt, _ = tpu_place(h, jobs, resident=ResidentClusterState())
         rounds = max(1, int(-(-min_trial_s // max(warm_dt, 1e-9))))
         log(
@@ -470,6 +504,7 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample,
         # warm=False: one solve populates the ledger, no double pass
         gc.collect()
         h, jobs = build_cluster(n_nodes, n_jobs, count, constrained)
+        freeze_resident_heap()
         tpu_place(h, jobs, warm=False, resident=ResidentClusterState())
     # everything compiled from here on is a steady-state recompile
     compiles_at_warmup = solverobs.compiles()
@@ -515,6 +550,15 @@ def run_service_config(name, n_nodes, n_jobs, count, constrained, host_sample,
     # bench.batch traces (main()'s late trace_summary() would read an
     # empty ring and silently drop the "trace" key)
     tsum = trace_summary()
+
+    # Drop every cluster built above BEFORE the attribution pass: with
+    # the trial, host-sample, AND equal-load heaps still alive, every
+    # gen2 collection during attribution scanned millions of dead-weight
+    # objects (and ran the jax gc callback against them) — measured as
+    # the dominant share of the r6 capture's 30% gc_share. Only the
+    # density/rate SCALARS are needed past this point.
+    h = jobs = hh = hjobs = eh = ejobs = None
+    gc.collect()
 
     # host-attribution pass: where the host second goes, from the
     # always-on profiler (un-measured; follows the rate trials)
@@ -1329,6 +1373,14 @@ def main():
         if cname == "c2m" and "coverage" in ha:
             gates["c2m_host_coverage"] = ha["coverage"] >= 0.8
             gates["c2m_span_agreement"] = bool(ha["span_agreement_ok"])
+            # GC-tax ceiling (ISSUE 12): with the post-warmup resident
+            # freeze + pipeline-wide paused sections, GC pauses must
+            # stay a rounding error of c2m wall. BENCH_GC_SHARE tunes
+            # the ceiling; 5% default (pre-fix captures measured the
+            # jax gc callback alone at 16.5-17%).
+            gates["c2m_gc_share"] = ha["gc_share"] <= float(
+                os.environ.get("BENCH_GC_SHARE", "0.05")
+            )
         # soak gates: graceful degradation under the seeded fault
         # schedule — safety invariants hold, e2e p99 stays bounded,
         # and admission control demonstrably engaged (nonzero

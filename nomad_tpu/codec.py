@@ -63,6 +63,13 @@ def _register_builtin_structs() -> None:
 
     for name in ("Fingerprint", "TaskConfig", "ExitResult", "TaskStatus"):
         register_type(getattr(driver_base, name))
+    # The SoA placement container (structs/placement_batch.py) — a plain
+    # dataclass on the wire (columns as lists + one bytes blob), so Plans
+    # carrying batches round-trip with full fidelity.
+    from .structs.placement_batch import PlacementBatch
+
+    register_type(PlacementBatch)
+    _install_plan_result_encoder()
 
 
 # Per-class encode/decode plans. A raft apply of a c2m-scale plan packs
@@ -94,6 +101,68 @@ def _field_plan(cls: type) -> list:
                 plan.append((f.name, None, None, False))
         _FIELD_PLANS[cls] = plan
     return plan
+
+
+def _install_plan_result_encoder() -> None:
+    """Custom elide-encoder for PlanResult: alloc_batches (the SoA
+    placement columns) FOLD into node_allocation as per-row wire maps
+    minted from one shared template, so a raft entry carrying batches is
+    BYTE-IDENTICAL to the entry the eager-object path would have
+    produced (the differential identity battery pins this). The fold
+    happens encoder-side precisely so the bulk of a c2m plan never
+    exists as 10^5 Python Allocation objects on the leader.
+
+    PlanResult is deliberately NOT registered with the native encoder
+    (_fastpack_module skips it): the C path would emit alloc_batches as
+    a structural field and break the identity. The surrounding payload
+    still C-encodes until it reaches the PlanResult, then falls back —
+    and the fold's per-row work is one dict fan-out per row
+    (fastpack.wire_rows in C when present)."""
+    from .structs import PlanResult
+
+    def _enc(r):
+        out: dict[str, Any] = {_TYPE_KEY: "PlanResult"}
+        # mirror _gen_encoder's elision: factory defaults elide on
+        # (exact-class and ==), None defaults on is-not-None, int
+        # defaults on != 0
+        v = r.node_update
+        if not (v.__class__ is dict and not v):
+            out["node_update"] = to_wire(v, True)
+        na = r.node_allocation
+        batches = r.alloc_batches
+        if batches:
+            m: dict[str, Any] = {
+                nid: [to_wire(a, True) for a in allocs]
+                for nid, allocs in na.items()
+            }
+            for b in batches:
+                b.extend_wire_rows(m)
+            out["node_allocation"] = m
+        elif not (na.__class__ is dict and not na):
+            out["node_allocation"] = to_wire(na, True)
+        v = r.node_preemptions
+        if not (v.__class__ is dict and not v):
+            out["node_preemptions"] = to_wire(v, True)
+        if r.job is not None:
+            out["job"] = to_wire(r.job, True)
+        if r.deployment is not None:
+            out["deployment"] = to_wire(r.deployment, True)
+        v = r.deployment_updates
+        if not (v.__class__ is list and not v):
+            out["deployment_updates"] = to_wire(v, True)
+        v = r.preemption_evals
+        if not (v.__class__ is list and not v):
+            out["preemption_evals"] = to_wire(v, True)
+        v = r.refresh_index
+        if not (v.__class__ is int and v == 0):
+            out["refresh_index"] = v if v.__class__ is int else to_wire(v, True)
+        v = r.alloc_index
+        if not (v.__class__ is int and v == 0):
+            out["alloc_index"] = v if v.__class__ is int else to_wire(v, True)
+        # alloc_batches itself is never emitted — it is the fold above
+        return out
+
+    _ENCODERS[PlanResult] = _enc
 
 
 def to_wire(obj: Any, _elide: bool = False) -> Any:
@@ -160,6 +229,11 @@ def to_wire(obj: Any, _elide: bool = False) -> Any:
         for k, v in vars(obj).items():
             out[k] = to_wire(v, _elide)
         return out
+    if cls.__name__ == "AllocRow":
+        # a lazy store-table handle (structs/placement_batch.py) that
+        # escaped to a wire boundary: materialize — the cached row is
+        # the value the eager path would have stored
+        return to_wire(obj.get(), _elide)
     raise TypeError(f"cannot encode {cls.__name__!r} for the wire")
 
 
@@ -247,6 +321,10 @@ def json_default(o):
         return {_BYTES_KEY: base64.b64encode(o).decode()}
     if dataclasses.is_dataclass(o) and not isinstance(o, type):
         return to_wire(o)
+    if type(o).__name__ == "AllocRow":
+        # lazy alloc handle at the HTTP/event boundary: materialize the
+        # cached row view (docs/pipeline.md § lazy materialization)
+        return to_wire(o.get())
     raise TypeError(f"not JSON serializable: {type(o).__name__}")
 
 
@@ -352,6 +430,12 @@ def _fastpack_module():
         _fastpack = load_fastpack() or False
     if _fastpack and _fastpack_synced != len(_REGISTRY):
         for cls in _REGISTRY.values():
+            if cls.__name__ == "PlanResult":
+                # never C-registered: PlanResult's Python encoder FOLDS
+                # alloc_batches into node_allocation for raft-entry byte
+                # identity; the C field-plan encoder would emit the
+                # batches structurally (see _install_plan_result_encoder)
+                continue
             if dataclasses.is_dataclass(cls):
                 enc_plan = tuple(
                     (fname, default, has)
@@ -361,6 +445,13 @@ def _fastpack_module():
             else:
                 _fastpack.register_class(cls, None)
         _fastpack_synced = len(_REGISTRY)
+    return _fastpack or None
+
+
+def native_module():
+    """The fastpack extension if it is already resolved, else None —
+    never triggers the C build (warm_native is the sanctioned build
+    point, outside any lock; NV-lock-blocking pins that rule)."""
     return _fastpack or None
 
 

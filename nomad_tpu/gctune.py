@@ -45,13 +45,29 @@ on_section_end = None
 
 
 @contextmanager
-def paused_gc():
+def paused_gc(freeze_on_exit: bool = False):
     """Pause the cyclic collector for a bounded batch of allocations.
 
     The depth counter is process-wide (the collector is), so sections
     entered concurrently from scheduler workers and the plan applier
     coordinate under a lock: the collector comes back when the LAST
     section exits, and never if the process had it disabled globally.
+
+    freeze_on_exit: when this section is the LAST one out (the flag is
+    honored only at the outermost exit; a concurrent section still open
+    elsewhere wins and the freeze is skipped), gc.freeze() right before
+    re-enabling. A paused section only DEFERS the young-gen scan — the
+    first collection after re-enable still walks everything the section
+    allocated (a c2m cluster build is ~10^6 objects, and every
+    registered gc callback — jax's included — runs against it).
+    Freezing instead moves the section's survivors straight to the
+    permanent generation: no scan ever happens, which is exactly right
+    when the survivors ARE resident state (a built cluster, committed
+    store rows). Dead temporaries still free by refcount, but CYCLES
+    allocated inside the section are frozen forever — so this is for
+    bounded-lifetime resident-heap bursts (the bench process), never
+    for arbitrary scratch work in a long-lived server (production
+    agents use freeze_resident_heap at warmup instead).
     """
     global _depth, _was_enabled, _section_t0
     with _lock:
@@ -66,8 +82,11 @@ def paused_gc():
         with _lock:
             _depth -= 1
             last_out = _depth == 0
-            if last_out and _was_enabled:
-                gc.enable()
+            if last_out:
+                if freeze_on_exit:
+                    gc.freeze()
+                if _was_enabled:
+                    gc.enable()
             dur_ns = (
                 time.monotonic_ns() - _section_t0 if last_out else 0
             )
@@ -84,3 +103,22 @@ def freeze_startup_heap() -> None:
     """
     gc.collect()
     gc.freeze()
+
+
+def freeze_resident_heap() -> int:
+    """Re-freeze the CURRENT live heap (post-warmup form of
+    freeze_startup_heap): after a server replays its log or a bench
+    config builds its cluster, the resident store/log heap is orders of
+    magnitude bigger than at bootstrap, and every collection that walks
+    it also runs every registered gc callback — jax's _xla_gc_callback
+    measured 16.5-17% of c2m wall before this. One collect + freeze
+    moves the whole resident set into the permanent generation; later
+    collections see only genuinely young objects. Safe to call
+    repeatedly (freeze is additive); frozen objects still free by
+    refcount — only CYCLES frozen here would outlive their heap, so
+    callers freeze long-lived resident state, not per-batch scratch.
+    Returns the frozen-object count for telemetry.
+    """
+    gc.collect()
+    gc.freeze()
+    return gc.get_freeze_count()

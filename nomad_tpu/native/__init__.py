@@ -23,6 +23,21 @@ _LOCK = threading.Lock()
 _module = None
 _load_failed = False
 
+# The extension's public surface. codec.warm_native() resolving the
+# module resolves EVERY entry point at once (one .so, one build) — no
+# caller can trigger a lock-held C compile by touching a "new" function
+# later (the NV-lock-blocking rule warm_native exists for). Each entry
+# has a behavior-identical Python/numpy fallback; tests/test_native.py
+# pins this list against the C PyMethodDef table and the fallbacks.
+FASTPACK_ENTRY_POINTS = (
+    "pack",          # elide-defaults msgpack encoder (codec.pack)
+    "register_class",  # class-plan registry sync (codec._fastpack_module)
+    "clear_registry",
+    "uuid_hex",      # bulk id formatting (structs.generate_uuids)
+    "wire_rows",     # SoA plan-row wire assembly (placement_batch)
+    "pick_ports",    # bulk dynamic-port picking (structs.network)
+)
+
 
 def load_fastpack():
     """Compile (once) and import the fastpack extension; None when the

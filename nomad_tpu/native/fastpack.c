@@ -365,6 +365,166 @@ static int encode(Out *o, PyObject *obj, int depth) {
 }
 
 /* ------------------------------------------------------------------ */
+/* bulk helpers for the array-native data plane. Each has a pure-
+ * Python / numpy fallback with identical behavior (structs/structs.py
+ * _uuid_hex_py, placement_batch._wire_rows_py, network.py
+ * _pick_ports_py) — the extension buys only speed, never semantics.  */
+
+/* uuid_hex(raw: bytes) -> list[str]: one uuid4-shaped "8-4-4-4-12"
+ * string per 16 input bytes (the bulk id-minting formatter).          */
+static PyObject *py_uuid_hex(PyObject *self, PyObject *arg) {
+  static const char hexd[] = "0123456789abcdef";
+  const char *raw;
+  Py_ssize_t n;
+  if (PyBytes_Check(arg)) {
+    raw = PyBytes_AS_STRING(arg);
+    n = PyBytes_GET_SIZE(arg);
+  } else {
+    PyErr_SetString(PyExc_TypeError, "uuid_hex expects bytes");
+    return NULL;
+  }
+  if (n % 16 != 0) {
+    PyErr_SetString(PyExc_ValueError, "length must be a multiple of 16");
+    return NULL;
+  }
+  Py_ssize_t k = n / 16;
+  PyObject *out = PyList_New(k);
+  if (!out) return NULL;
+  for (Py_ssize_t i = 0; i < k; i++) {
+    PyObject *s = PyUnicode_New(36, 127);
+    if (!s) {
+      Py_DECREF(out);
+      return NULL;
+    }
+    Py_UCS1 *d = PyUnicode_1BYTE_DATA(s);
+    const unsigned char *b = (const unsigned char *)raw + i * 16;
+    int w = 0;
+    for (int j = 0; j < 16; j++) {
+      if (j == 4 || j == 6 || j == 8 || j == 10) d[w++] = '-';
+      d[w++] = hexd[b[j] >> 4];
+      d[w++] = hexd[b[j] & 0xf];
+    }
+    PyList_SET_ITEM(out, i, s);
+  }
+  return out;
+}
+
+/* wire_rows(template: dict, ids, names, node_ids, node_names) ->
+ * list[dict]: the SoA plan-row assembly — one template copy + the four
+ * per-row field stores per row, in C (placement_batch.extend_wire_rows).*/
+static PyObject *py_wire_rows(PyObject *self, PyObject *args) {
+  PyObject *template, *ids, *names, *node_ids, *node_names;
+  if (!PyArg_ParseTuple(args, "O!O!O!O!O!", &PyDict_Type, &template,
+                        &PyList_Type, &ids, &PyList_Type, &names,
+                        &PyList_Type, &node_ids, &PyList_Type, &node_names))
+    return NULL;
+  Py_ssize_t k = PyList_GET_SIZE(ids);
+  if (PyList_GET_SIZE(names) != k || PyList_GET_SIZE(node_ids) != k ||
+      PyList_GET_SIZE(node_names) != k) {
+    PyErr_SetString(PyExc_ValueError, "column length mismatch");
+    return NULL;
+  }
+  /* guard on the LAST key assigned: a partial init failure must retry
+   * the whole set next call, never skip to NULL PyDict_SetItem keys   */
+  static PyObject *k_id, *k_name, *k_node_id, *k_node_name;
+  if (!k_node_name) {
+    k_id = PyUnicode_InternFromString("id");
+    k_name = PyUnicode_InternFromString("name");
+    k_node_id = PyUnicode_InternFromString("node_id");
+    k_node_name = PyUnicode_InternFromString("node_name");
+    if (!k_id || !k_name || !k_node_id || !k_node_name) {
+      k_node_name = NULL;
+      return NULL;
+    }
+  }
+  PyObject *out = PyList_New(k);
+  if (!out) return NULL;
+  for (Py_ssize_t i = 0; i < k; i++) {
+    PyObject *d = PyDict_Copy(template);
+    if (!d) goto fail;
+    if (PyDict_SetItem(d, k_id, PyList_GET_ITEM(ids, i)) < 0 ||
+        PyDict_SetItem(d, k_name, PyList_GET_ITEM(names, i)) < 0 ||
+        PyDict_SetItem(d, k_node_id, PyList_GET_ITEM(node_ids, i)) < 0 ||
+        PyDict_SetItem(d, k_node_name, PyList_GET_ITEM(node_names, i)) < 0) {
+      Py_DECREF(d);
+      goto fail;
+    }
+    PyList_SET_ITEM(out, i, d);
+  }
+  return out;
+fail:
+  Py_DECREF(out);
+  return NULL;
+}
+
+/* pick_ports(taken: bytes bitmap over [min, max], k, min, max, seed)
+ * -> list[int] | None. Deterministic given seed: per port, up to 20
+ * LCG draws, then a linear scan from the range floor — the numpy/
+ * Python fallback (network.py _pick_ports_py) runs the SAME LCG so the
+ * two paths pick identical ports for one seed.                        */
+static PyObject *py_pick_ports(PyObject *self, PyObject *args) {
+  Py_buffer taken;
+  long k, lo, hi;
+  unsigned long long seed;
+  if (!PyArg_ParseTuple(args, "y*lllK", &taken, &k, &lo, &hi, &seed))
+    return NULL;
+  long span = hi - lo + 1;
+  if (span <= 0 || taken.len * 8 < span) {
+    PyBuffer_Release(&taken);
+    PyErr_SetString(PyExc_ValueError, "bitmap smaller than port range");
+    return NULL;
+  }
+  unsigned char *bits = (unsigned char *)PyMem_Malloc(taken.len);
+  if (!bits) {
+    PyBuffer_Release(&taken);
+    return PyErr_NoMemory();
+  }
+  memcpy(bits, taken.buf, taken.len);
+  PyBuffer_Release(&taken);
+  PyObject *out = PyList_New(0);
+  if (!out) {
+    PyMem_Free(bits);
+    return NULL;
+  }
+  uint64_t x = (uint64_t)seed;
+  for (long i = 0; i < k; i++) {
+    long got = -1;
+    for (int attempt = 0; attempt < 20; attempt++) {
+      x = x * 6364136223846793005ULL + 1442695040888963407ULL;
+      long off = (long)((x >> 33) % (uint64_t)span);
+      if (!(bits[off >> 3] & (1 << (off & 7)))) {
+        got = off;
+        break;
+      }
+    }
+    if (got < 0) {
+      for (long off = 0; off < span; off++) {
+        if (!(bits[off >> 3] & (1 << (off & 7)))) {
+          got = off;
+          break;
+        }
+      }
+    }
+    if (got < 0) {
+      Py_DECREF(out);
+      PyMem_Free(bits);
+      Py_RETURN_NONE; /* range exhausted */
+    }
+    bits[got >> 3] |= (unsigned char)(1 << (got & 7));
+    PyObject *port = PyLong_FromLong(lo + got);
+    if (!port || PyList_Append(out, port) < 0) {
+      Py_XDECREF(port);
+      Py_DECREF(out);
+      PyMem_Free(bits);
+      return NULL;
+    }
+    Py_DECREF(port);
+  }
+  PyMem_Free(bits);
+  return out;
+}
+
+/* ------------------------------------------------------------------ */
 /* module API                                                          */
 
 static PyObject *py_pack(PyObject *self, PyObject *obj) {
@@ -405,6 +565,14 @@ static PyMethodDef methods[] = {
      "register_class(cls, plan): plan = ((name, default, has_default), "
      "...) for dataclasses, None for __dict__ round-trip types."},
     {"clear_registry", py_clear_registry, METH_NOARGS, "Forget classes."},
+    {"uuid_hex", py_uuid_hex, METH_O,
+     "uuid_hex(raw): one uuid4-shaped string per 16 bytes of entropy."},
+    {"wire_rows", py_wire_rows, METH_VARARGS,
+     "wire_rows(template, ids, names, node_ids, node_names): bulk "
+     "plan-row wire maps from SoA columns."},
+    {"pick_ports", py_pick_ports, METH_VARARGS,
+     "pick_ports(taken_bitmap, k, min, max, seed): k distinct free "
+     "ports, deterministic per seed (LCG + linear-scan fallback)."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -39,8 +39,19 @@ class SchedulerConfig:
         backend: str = "host",  # host | tpu — which placement backend to use
         small_batch_threshold: int = 48,
         inject_device_latency_s: Optional[float] = None,
+        soa_placements: Optional[bool] = None,
     ) -> None:
         import os
+
+        # Struct-of-arrays placements (structs/placement_batch.py): the
+        # solver's fast-mint path emits PlacementBatch columns instead of
+        # per-row Allocation objects, materialized lazily at API/client
+        # boundaries. Default ON; NOMAD_TPU_SOA=0 (or soa_placements=
+        # False) keeps the eager-object path — the differential identity
+        # battery's comparator.
+        if soa_placements is None:
+            soa_placements = os.environ.get("NOMAD_TPU_SOA", "1") != "0"
+        self.soa_placements = soa_placements
 
         self.algorithm = algorithm
         self.preemption_service = preemption_service

@@ -477,13 +477,20 @@ class AllocReconciler:
                 )
             )
         if existing < desired:
+            # the bulk fill (a fresh c2m job mints its whole count here):
+            # group-constant values hoisted out of the loop, name indexes
+            # claimed in one pass — this loop feeds the SoA fast-mint
+            # columns downstream, so its per-row cost IS the reconcile
+            # share of the per-alloc budget
             ov = _downgrade_for(None)
-            for _ in range(desired - existing):
-                idx = name_index.next()
-                place.append(
+            tg_ov = _tg_for(ov)
+            prefix = f"{self.job_id}.{name}["
+            ap = place.append
+            for idx in name_index.next_n(desired - existing):
+                ap(
                     PlacementRequest(
-                        name=alloc_name(self.job_id, name, idx),
-                        task_group=_tg_for(ov),
+                        name=f"{prefix}{idx}]",
+                        task_group=tg_ov,
                         job_override=ov,
                     )
                 )
@@ -812,6 +819,23 @@ class _NameIndex:
         self.used_idx.add(i)
         self._cursor = i + 1
         return i
+
+    def next_n(self, n: int) -> list[int]:
+        """n lowest unused indexes in one pass — identical to n
+        successive next() calls, without the per-call overhead."""
+        out: list[int] = []
+        used = self.used_idx
+        add = used.add
+        ap = out.append
+        i = self._cursor
+        for _ in range(n):
+            while i in used:
+                i += 1
+            add(i)
+            ap(i)
+            i += 1
+        self._cursor = i
+        return out
 
     def next_canaries(
         self, n: int, existing: list, destructive: list

@@ -44,6 +44,7 @@ from ..context import EvalContext, SchedulerConfig
 from ..reconcile import PlacementRequest
 from ..util import ready_nodes_in_dcs
 from ...structs.structs import AllocDeploymentStatus
+from ...structs.placement_batch import PlacementBatch
 from ..preemption import PRIORITY_DELTA
 from .lower import LoweredGroup, build_node_table, lower_group
 from .kernels import (
@@ -73,6 +74,10 @@ class GroupAsk:
 class SolveOutcome:
     # eval_id -> plan additions
     placements: dict[str, list[Allocation]] = field(default_factory=dict)
+    # eval_id -> SoA PlacementBatches (the fast-mint path's columns;
+    # structs/placement_batch.py) — plan assembly appends these whole,
+    # never as per-row Allocations
+    batch_placements: dict[str, list] = field(default_factory=dict)
     # eval_id -> {tg_name: AllocMetric} for failed asks
     failures: dict[str, dict[str, AllocMetric]] = field(default_factory=dict)
     # eval_id -> [(victim alloc, preempting alloc id)] — the caller turns
@@ -96,6 +101,8 @@ def _merge_outcomes(a: SolveOutcome, b: SolveOutcome) -> SolveOutcome:
     for src in (a, b):
         for ev, allocs in src.placements.items():
             out.placements.setdefault(ev, []).extend(allocs)
+        for ev, batches in src.batch_placements.items():
+            out.batch_placements.setdefault(ev, []).extend(batches)
         for ev, fails in src.failures.items():
             out.failures.setdefault(ev, {}).update(fails)
         for ev, pre in src.preemptions.items():
@@ -280,11 +287,17 @@ class _MintTemplate:
     containers — ride the state store's copy-on-write discipline: every
     writer copies an alloc (Allocation.copy deep-copies the mutable
     fields) before mutating, the same rule the shared AllocatedResources
-    fast-mint has always relied on."""
+    fast-mint has always relied on.
 
-    __slots__ = ("items",)
+    With soa_placements the same template seeds whole PlacementBatches
+    (shared resources/metrics objects across a group's sub-batches, the
+    identical sharing the eager mint had); per-row mint survives as the
+    eager comparator and the overflow-repair/cores paths."""
+
+    __slots__ = ("items", "proto")
 
     def __init__(self, proto: Allocation) -> None:
+        self.proto = proto
         self.items = [(n, getattr(proto, n)) for n in _ALLOC_FIELD_NAMES]
 
     def mint(self, uid: str, name: str, node) -> Allocation:
@@ -1347,6 +1360,22 @@ class BatchSolver:
             )
         return out
 
+    @staticmethod
+    def _node_id_col(table) -> list:
+        """Node-id column for PlacementBatches, built once per table and
+        shared by every batch of the solve (string references, no copies)."""
+        col = getattr(table, "_node_id_col", None)
+        if col is None:
+            col = table._node_id_col = [n.id for n in table.nodes]
+        return col
+
+    @staticmethod
+    def _node_name_col(table) -> list:
+        col = getattr(table, "_node_name_col", None)
+        if col is None:
+            col = table._node_name_col = [n.name for n in table.nodes]
+        return col
+
     def _materialize_compact(
         self,
         table,
@@ -1388,7 +1417,8 @@ class BatchSolver:
             placed = int((row != -1).sum())
             reqs = grp.requests
             placed = min(placed, len(reqs))
-            node_idx = row[:placed].tolist()
+            row_placed = row[:placed]
+            node_idx = None  # listified lazily — the SoA path never does
             unplaced: list = []
             tg = grp.tg
             a0, a1, a2 = (int(grp.ask[0]), int(grp.ask[1]), int(grp.ask[2]))
@@ -1415,6 +1445,7 @@ class BatchSolver:
                 or any(r.previous_alloc is not None or r.canary for r in reqs)
             )
             if slow:
+                node_idx = row_placed.tolist()
                 for i, ni in enumerate(node_idx):
                     req = reqs[i]
                     if over_set is not None and ni in over_set:
@@ -1461,11 +1492,45 @@ class BatchSolver:
                 ap = placements.append
                 mint = tmpl.mint
                 if over_set is None and not self._batch_has_cores:
-                    # the clean bulk case (no overflow repair, no cores
-                    # ledger): one tight mint loop, ~100k iterations/solve
-                    for uid, ni, req in zip(uuids, node_idx, reqs):
-                        ap(mint(uid, req.name, nodes[ni]))
+                    if self.config.soa_placements and placed:
+                        # the array-native case: the kernel's node-index
+                        # readback BECOMES the placement column — no
+                        # per-row Python objects exist until an API/
+                        # client boundary materializes them lazily
+                        # (structs/placement_batch.py)
+                        proto = tmpl.proto
+                        batch = PlacementBatch(
+                            namespace=proto.namespace,
+                            eval_id=eval_id,
+                            job_id=proto.job_id,
+                            job=proto.job,
+                            task_group=proto.task_group,
+                            resources=proto.resources,
+                            metrics=proto.metrics,
+                            ids=uuids,
+                            names=(
+                                grp.names[:placed]
+                                if len(grp.names) == len(reqs)
+                                else [r.name for r in reqs[:placed]]
+                            ),
+                            node_idx_raw=np.ascontiguousarray(
+                                row_placed, dtype=np.int32
+                            ).tobytes(),
+                            node_ids=self._node_id_col(table),
+                            node_names=self._node_name_col(table),
+                        )
+                        out.batch_placements.setdefault(
+                            eval_id, []
+                        ).append(batch)
+                    else:
+                        # the eager bulk case (the SoA comparator): one
+                        # tight mint loop, ~100k iterations/solve
+                        node_idx = row_placed.tolist()
+                        for uid, ni, req in zip(uuids, node_idx, reqs):
+                            ap(mint(uid, req.name, nodes[ni]))
                     node_idx = ()
+                elif node_idx is None:
+                    node_idx = row_placed.tolist()
                 for i, ni in enumerate(node_idx):
                     if over_set is not None and ni in over_set:
                         if not _check_over(ni):

@@ -455,6 +455,10 @@ class EvalBroker:
                         # pending -> in-flight: the admission bound
                         # covers the backlog, not work being processed
                         self._pending_remove(ev.id)
+                        # per-dequeue token: generate_uuid serves from
+                        # the bulk-minted pool (one generate_uuids(256)
+                        # pass per 256 ids — no per-dequeue entropy
+                        # syscall or format work)
                         token = generate_uuid()
                         attempts = self._attempts.get(ev.id, 0) + 1
                         self._attempts[ev.id] = attempts

@@ -65,6 +65,7 @@ class _RecordingPlanner:
             deployment=plan.deployment,
             deployment_updates=plan.deployment_updates,
             alloc_index=self._snap.index,
+            alloc_batches=plan.alloc_batches,
         )
         return result, None
 

@@ -37,15 +37,32 @@ from .. import trace
 from ..gctune import paused_gc
 from ..state.store import usage_contribution
 from ..structs import Plan, PlanResult, allocs_fit
+from ..structs.placement_batch import AllocRow as _row_handle
 from ..structs.structs import NODE_STATUS_READY
 from .plan_queue import PlanQueue
 
 logger = logging.getLogger("nomad_tpu.plan_apply")
 
 
+def _batch_rows_for_node(plan: Plan, node_id: str) -> list:
+    """Materialize just one node's rows from the plan's SoA batches —
+    the exact-verification path needs real Allocation views, but only
+    for the (rare) nodes that fall off the vectorized fast path."""
+    rows: list = []
+    for b in plan.alloc_batches:
+        for nid, ti, _cnt in b.touched_nodes():
+            if nid == node_id:
+                idx = np.nonzero(b.node_idx == ti)[0]
+                rows.extend(b.row(int(i)) for i in idx)
+                break
+    return rows
+
+
 def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
     """Would this plan's changes to one node fit? (reference :631)."""
-    proposed = plan.node_allocation.get(node_id, [])
+    proposed = list(plan.node_allocation.get(node_id, []))
+    if plan.alloc_batches:
+        proposed.extend(_batch_rows_for_node(plan, node_id))
     if not proposed:
         return True, ""  # stops/preemptions alone always apply
     node = snapshot.node_by_id(node_id)
@@ -63,6 +80,18 @@ def evaluate_node_plan(snapshot, plan: Plan, node_id: str) -> tuple[bool, str]:
     if not fit:
         return False, dim
     return True, ""
+
+
+class _VolRow:
+    """A batch row's volume-claim identity (namespace, job, task group)
+    — all the overcommit walk reads."""
+
+    __slots__ = ("namespace", "job", "task_group")
+
+    def __init__(self, namespace: str, job, task_group: str) -> None:
+        self.namespace = namespace
+        self.job = job
+        self.task_group = task_group
 
 
 def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
@@ -84,8 +113,32 @@ def _volume_overcommitted_nodes(snapshot, plan: Plan) -> set[str]:
         removed.update(a.id for a in allocs)  # in-place updates of selves
     writers: dict[tuple[str, str], int] = {}  # (ns, vol_id) -> new writers
     bad: set[str] = set()
-    for node_id in sorted(plan.node_allocation):
-        for alloc in plan.node_allocation[node_id]:
+    # SoA batch rows participate as (namespace, job, tg) x count per
+    # node — a whole batch shares one volume-bearing task group, so no
+    # rows materialize here. Batch-free plans walk node_allocation
+    # directly (no per-node list copies on the eager path).
+    per_node_rows: dict[str, list] = plan.node_allocation
+    if plan.alloc_batches:
+        merged = None
+        for b in plan.alloc_batches:
+            job = b.job or plan.job
+            if job is None:
+                continue
+            tg = job.lookup_task_group(b.task_group)
+            if tg is None or not tg.volumes:
+                continue
+            if merged is None:
+                merged = per_node_rows = {
+                    nid: list(allocs)
+                    for nid, allocs in plan.node_allocation.items()
+                }
+            for nid, ti, cnt in b.touched_nodes():
+                merged.setdefault(nid, []).extend(
+                    _VolRow(b.namespace, job, b.task_group)
+                    for _ in range(cnt)
+                )
+    for node_id in sorted(per_node_rows):
+        for alloc in per_node_rows[node_id]:
             job = alloc.job or plan.job
             if job is None:
                 continue
@@ -195,57 +248,113 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
     # serializes this per volume; our claim point is plan apply).
     vol_rejected = _volume_overcommitted_nodes(snapshot, plan)
     rejected = False
+    rejected_nodes: set[str] = set()
 
     def reject(node_id: str, reason: str) -> None:
         nonlocal rejected
         rejected = True
+        rejected_nodes.add(node_id)
         # A rejected placement must not still evict its victims:
         # preemptions free capacity FOR that node's placements and
         # are meaningless without them.
         result.node_preemptions.pop(node_id, None)
         logger.debug("plan for node %s rejected: %s", node_id, reason)
 
+    # SoA batches: per-node proposed additions come straight from the
+    # columns — bincount-style (count x shared row contribution), no
+    # row materialization. batch rows are fast-mint by construction
+    # (complex=0), so they never force a node onto the exact path by
+    # themselves.
+    batches = plan.alloc_batches
+    batch_add: dict[str, tuple[int, int, int]] = {}
+    if batches:
+        for b in batches:
+            c = b.row_contribution()
+            for nid, _ti, cnt in b.touched_nodes():
+                cur = batch_add.get(nid)
+                if cur is None:
+                    batch_add[nid] = (c[0] * cnt, c[1] * cnt, c[2] * cnt)
+                else:
+                    batch_add[nid] = (
+                        cur[0] + c[0] * cnt,
+                        cur[1] + c[1] * cnt,
+                        cur[2] + c[2] * cnt,
+                    )
+
     fast_ids: list[str] = []
     fast_rows: list[tuple[int, int, int, int, int, int]] = []
     slow_ids: list[str] = []
     contrib: dict = {}  # per-plan shared-resources contribution memo
-    for node_id, proposed in plan.node_allocation.items():
+
+    def verify_node(node_id: str, proposed) -> None:
         if node_id in vol_rejected:
             reject(node_id, "volume write-claim conflict")
-            continue
-        if not proposed:
+            return
+        add = batch_add.get(node_id)
+        if not proposed and add is None:
             result.node_allocation[node_id] = proposed
-            continue
+            return
         node = snapshot.node_by_id(node_id)
         if node is None:
             reject(node_id, "node does not exist")
-            continue
+            return
         if node.status != NODE_STATUS_READY:
             reject(node_id, f"node is {node.status}")
-            continue
+            return
         usage = _fast_path_usage(snapshot, plan, node_id, node, contrib)
         if usage is None:
             slow_ids.append(node_id)
-            continue
+            return
+        if add is not None:
+            usage = (usage[0] + add[0], usage[1] + add[1], usage[2] + add[2])
         avail = node.available_resources()
         fast_ids.append(node_id)
         fast_rows.append(
             (usage[0], usage[1], usage[2], avail.cpu, avail.memory_mb, avail.disk_mb)
         )
+
+    for node_id, proposed in plan.node_allocation.items():
+        verify_node(node_id, proposed)
+    for node_id in batch_add:
+        if node_id not in plan.node_allocation:
+            verify_node(node_id, [])
     if fast_rows:
         rows = np.asarray(fast_rows, dtype=np.int64)
         fits = (rows[:, :3] <= rows[:, 3:]).all(axis=1)
         for node_id, ok in zip(fast_ids, fits):
             if ok:
-                result.node_allocation[node_id] = plan.node_allocation[node_id]
+                if node_id in plan.node_allocation:
+                    result.node_allocation[node_id] = plan.node_allocation[
+                        node_id
+                    ]
             else:
                 reject(node_id, "resources exhausted")
     for node_id in slow_ids:
         ok, reason = evaluate_node_plan(snapshot, plan, node_id)
         if ok:
-            result.node_allocation[node_id] = plan.node_allocation[node_id]
+            if node_id in plan.node_allocation:
+                result.node_allocation[node_id] = plan.node_allocation[node_id]
         else:
             reject(node_id, reason)
+
+    # Batch verdicts: a rejected node drops ONLY its rows from each
+    # batch (a boolean-mask view of the columns); untouched batches ride
+    # through whole.
+    if batches:
+        committed_batches = []
+        for b in batches:
+            bad_tis = [
+                ti
+                for nid, ti, _cnt in b.touched_nodes()
+                if nid in rejected_nodes
+            ]
+            if not bad_tis:
+                committed_batches.append(b)
+                continue
+            keep = ~np.isin(b.node_idx, np.asarray(bad_tis, dtype=np.int32))
+            if keep.any():
+                committed_batches.append(b.take(keep))
+        result.alloc_batches = committed_batches
 
     if rejected:
         if plan.all_at_once:
@@ -257,6 +366,7 @@ def evaluate_plan(snapshot, plan: Plan) -> PlanResult:
             result.node_preemptions = {}
             result.deployment = None
             result.deployment_updates = []
+            result.alloc_batches = []
         result.refresh_index = snapshot.index
     return result
 
@@ -325,6 +435,26 @@ class OverlaySnapshot:
                     d = delta.setdefault(node_id, [0, 0, 0, 0])
                     for i in range(4):
                         d[i] += c[i]
+        for b in result.alloc_batches:
+            # SoA rows overlay as lazy handles: the usage delta comes
+            # from the columns (count x shared contribution); a later
+            # plan's verification materializes a row only if it actually
+            # dereferences it (alloc_by_id / the exact per-node path)
+            c = b.row_contribution()
+            touched = b.touched_nodes()
+            for nid, _ti, cnt in touched:
+                d = delta.setdefault(nid, [0, 0, 0, 0])
+                d[0] += c[0] * cnt
+                d[1] += c[1] * cnt
+                d[2] += c[2] * cnt
+            ti_to_nid = {ti: nid for nid, ti, _cnt in touched}
+            idx = b.node_idx
+            for i, uid in enumerate(b.ids):
+                h = _row_handle(b, i)
+                self._placed[uid] = h
+                self._placed_by_node.setdefault(
+                    ti_to_nid[int(idx[i])], []
+                ).append(h)
         self._usage_delta = delta
 
     def __getattr__(self, name):
@@ -373,6 +503,8 @@ def _plan_partition_key(plan: Plan) -> tuple[set[str], bool, Optional[tuple]]:
         | set(plan.node_update)
         | set(plan.node_preemptions)
     )
+    for b in plan.alloc_batches:
+        nodes.update(nid for nid, _ti, _cnt in b.touched_nodes())
     job_key = (
         (plan.job.namespace, plan.job.id) if plan.job is not None else None
     )
@@ -444,6 +576,14 @@ def _plan_touches_volumes(plan: Plan) -> bool:
             tg = job.lookup_task_group(a.task_group)
             if tg is not None and tg.volumes:
                 return True
+    for b in plan.alloc_batches:
+        # one (job, task group) per batch — no row walk
+        job = b.job or plan.job
+        if job is None:
+            continue
+        tg = job.lookup_task_group(b.task_group)
+        if tg is not None and tg.volumes:
+            return True
     return False
 
 
@@ -813,6 +953,10 @@ class PlanApplier:
                 for a in allocs:
                     if a.job is result.job:
                         a.job = None
+            for b in result.alloc_batches:
+                # one shared job slot per batch, not one per row
+                if b.job is result.job:
+                    b.job = None
 
     def apply_one(self, plan: Plan) -> PlanResult:
         """Serial verify+commit of one plan (direct callers and tests;
@@ -830,7 +974,7 @@ class PlanApplier:
     def _preemption_evals(self, result: PlanResult):
         """One follow-up eval per job losing allocs to preemption, so the
         preempted work reschedules elsewhere (reference plan_apply.go:278)."""
-        from ..structs import Evaluation, generate_uuid
+        from ..structs import Evaluation, generate_uuids
         from ..structs.structs import (
             EVAL_STATUS_PENDING,
             EVAL_TRIGGER_PREEMPTION,
@@ -841,13 +985,18 @@ class PlanApplier:
         for allocs in result.node_preemptions.values():
             for a in allocs:
                 seen.add((a.namespace, a.job_id))
+        if not seen:
+            return []
         evals = []
-        for ns, job_id in seen:
+        # bulk id minting: one entropy draw + one format pass for the
+        # whole preemption wave (generate_uuids, ISSUE 12 satellite)
+        ids = generate_uuids(len(seen))
+        for uid, (ns, job_id) in zip(ids, seen):
             # preempted plan rows carry job=None; resolve from state
             job = self.state.job_by_id(ns, job_id)
             evals.append(
                 Evaluation(
-                    id=generate_uuid(),
+                    id=uid,
                     namespace=ns,
                     priority=job.priority if job else 50,
                     type=job.type if job else "service",

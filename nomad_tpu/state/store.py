@@ -34,6 +34,7 @@ from ..structs import (
     Node,
     PlanResult,
 )
+from ..structs.placement_batch import AllocRow, PlacementBatch
 from ..structs.structs import (
     ALLOC_CLIENT_STATUS_COMPLETE,
     ALLOC_CLIENT_STATUS_FAILED,
@@ -344,14 +345,30 @@ class _ReadMixin:
         ]
 
     # allocs -----------------------------------------------------------
+    #
+    # Alloc tables may hold lazy AllocRow handles (SoA placements,
+    # structs/placement_batch.py): the read mixin is THE materialization
+    # boundary — readers always receive Allocation objects, minted on
+    # first access and cached in the owning batch, so repeated reads
+    # don't re-pay. Handles never escape the store/event layer.
+
     def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
-        return self._tables[TABLE_ALLOCS].get(alloc_id)
+        a = self._tables[TABLE_ALLOCS].get(alloc_id)
+        return a.get() if a.__class__ is AllocRow else a
 
     def allocs(self) -> list[Allocation]:
-        return list(self._tables[TABLE_ALLOCS].values())
+        return [
+            a.get() if a.__class__ is AllocRow else a
+            for a in list(self._tables[TABLE_ALLOCS].values())
+        ]
 
     def allocs_by_node(self, node_id: str) -> list[Allocation]:
-        return list(self._tables[IDX_ALLOCS_NODE].get(node_id, {}).values())
+        return [
+            a.get() if a.__class__ is AllocRow else a
+            for a in list(
+                self._tables[IDX_ALLOCS_NODE].get(node_id, {}).values()
+            )
+        ]
 
     def node_usage(self, node_id: str) -> tuple[int, int, int, int]:
         """Committed non-terminal usage on one node: (cpu, memory_mb,
@@ -371,24 +388,37 @@ class _ReadMixin:
     def allocs_by_node_terminal(
         self, node_id: str, terminal: bool
     ) -> list[Allocation]:
+        # the terminal predicate answers from the handle's columns (a
+        # fresh SoA row is non-terminal by construction); only returned
+        # rows materialize
         return [
-            a
+            a.get() if a.__class__ is AllocRow else a
             for a in self._tables[IDX_ALLOCS_NODE].get(node_id, {}).values()
             if a.terminal_status() == terminal
         ]
 
     def allocs_by_job(self, namespace: str, job_id: str) -> list[Allocation]:
-        return list(
-            self._tables[IDX_ALLOCS_JOB].get((namespace, job_id), {}).values()
-        )
+        return [
+            a.get() if a.__class__ is AllocRow else a
+            for a in list(
+                self._tables[IDX_ALLOCS_JOB]
+                .get((namespace, job_id), {})
+                .values()
+            )
+        ]
 
     def allocs_by_eval(self, eval_id: str) -> list[Allocation]:
-        return list(self._tables[IDX_ALLOCS_EVAL].get(eval_id, {}).values())
+        return [
+            a.get() if a.__class__ is AllocRow else a
+            for a in list(
+                self._tables[IDX_ALLOCS_EVAL].get(eval_id, {}).values()
+            )
+        ]
 
     @_locked_on_live
     def allocs_by_deployment(self, deployment_id: str) -> list[Allocation]:
         return [
-            a
+            a.get() if a.__class__ is AllocRow else a
             for a in self._tables[TABLE_ALLOCS].values()
             if a.deployment_id == deployment_id
         ]
@@ -743,6 +773,7 @@ class StateStore(_ReadMixin):
         from .. import codec
 
         with self._lock:
+            self._materialize_rows_locked()
             return codec.pack(
                 {
                     "tables": self._tables,
@@ -750,6 +781,27 @@ class StateStore(_ReadMixin):
                     "latest": self._latest_index,
                 }
             )
+
+    def _materialize_rows_locked(self) -> None:
+        """Swap any lazy AllocRow handles for their materialized rows in
+        place, so the native encoder sees only registered structs. A
+        handle and its cached row are the same logical value (snapshot
+        readers holding either see identical state), so the in-place
+        swap is COW-safe — it is a representation change, not a write."""
+        t = self._tables[TABLE_ALLOCS]
+        lazy = [
+            (k, v) for k, v in t.items() if v.__class__ is AllocRow
+        ]
+        if not lazy:
+            return
+        for k, v in lazy:
+            t[k] = v.get()
+        for table in (IDX_ALLOCS_NODE, IDX_ALLOCS_JOB, IDX_ALLOCS_EVAL):
+            for inner in self._tables[table].values():
+                for k in list(inner):
+                    v = inner[k]
+                    if v.__class__ is AllocRow:
+                        inner[k] = v.get()
 
     def restore_from(self, raw: bytes) -> None:
         """Replace all state from snapshot bytes (reference fsm.go:1381
@@ -1307,23 +1359,15 @@ class StateStore(_ReadMixin):
         # Per-txn cache of owned inner index dicts: one ownership check per
         # distinct key instead of three per alloc (bulk plans insert ~10³-10⁵
         # allocs that share one job/eval key and a few thousand node keys).
+        # The COW/ownership protocol itself lives in _owned_inner — ONE
+        # implementation shared with the batch txn.
         inner_cache: dict[tuple[str, object], dict] = {}
 
         def _inner(table: str, key) -> dict:
             ck = (table, key)
             inner = inner_cache.get(ck)
             if inner is None:
-                tbl = self._wtable(table)
-                inner = tbl.get(key)
-                if inner is None:
-                    inner = {}
-                    tbl[key] = inner
-                    self._idx_owned.add(ck)
-                elif ck not in self._idx_owned:
-                    inner = dict(inner)
-                    tbl[key] = inner
-                    self._idx_owned.add(ck)
-                inner_cache[ck] = inner
+                inner = inner_cache[ck] = self._owned_inner(table, key)
             return inner
 
         ut = self._wtable(IDX_NODE_USED)
@@ -1444,6 +1488,116 @@ class StateStore(_ReadMixin):
                     c["starting"] += delta
                 summary.modify_index = index
                 st[key] = summary
+        for ns, job_id in jobs_touched:
+            self._update_job_status_txn(index, ns, job_id)
+        return stored
+
+    def _owned_inner(self, table: str, key) -> dict:
+        """Writable (ownership-checked) inner index dict — the method
+        form of _upsert_allocs_txn's per-txn _inner resolver."""
+        tbl = self._wtable(table)
+        inner = tbl.get(key)
+        if inner is None:
+            inner = {}
+            tbl[key] = inner
+            self._idx_owned.add((table, key))
+        elif (table, key) not in self._idx_owned:
+            inner = dict(inner)
+            tbl[key] = inner
+            self._idx_owned.add((table, key))
+        return inner
+
+    def _upsert_batches_txn(
+        self,
+        index: int,
+        batches: list[PlacementBatch],
+        default_jobs: Optional[dict] = None,
+    ) -> list:
+        """Insert SoA placement batches: lazy AllocRow handles into the
+        main/secondary tables, per-NODE (not per-row) usage-aggregate
+        updates from the columns, one priority-count bump and one
+        summary increment per batch. Per-row work is exactly the four
+        table inserts the id-keyed indexes require — everything the
+        eager path did per row beyond that (defensive copy, stamps,
+        contribution walk, terminal checks) happens once per batch.
+
+        Rows are all fresh by construction (new uuids; the applier's
+        verification preserved that), so the existing-row merge paths
+        never apply."""
+        t = self._wtable(TABLE_ALLOCS)
+        ut = self._wtable(IDX_NODE_USED)
+        pt = self._wtable(IDX_PRIO_COUNT)
+        st = None
+        now = now_ns()
+        stored: list = []
+        jobs_touched: set[tuple[str, str]] = set()
+        for b in batches:
+            if not len(b):
+                continue
+            if b.job is None:
+                if default_jobs:
+                    b.job = default_jobs.get((b.namespace, b.job_id))
+                if b.job is None:
+                    b.job = self._tables[TABLE_JOBS].get(
+                        (b.namespace, b.job_id)
+                    )
+            b.stamp(index, now)
+            key = (b.namespace, b.job_id)
+            job_inner = self._owned_inner(IDX_ALLOCS_JOB, key)
+            eval_inner = self._owned_inner(IDX_ALLOCS_EVAL, b.eval_id)
+            node_inners: dict[int, dict] = {}
+            touched = b.touched_nodes()
+            for nid, ti, _cnt in touched:
+                node_inners[ti] = self._owned_inner(IDX_ALLOCS_NODE, nid)
+            # group rows per node, preserving row order within a node and
+            # first-touch node order — the exact insertion sequence the
+            # eager txn produces from a node_allocation dict, so the two
+            # paths build byte-identical tables (the identity battery
+            # serializes and compares)
+            idx_list = b.node_idx.tolist()
+            hs = b.handles()
+            per_node: dict[int, list] = {}
+            for uid, h, ti in zip(b.ids, hs, idx_list):
+                bucket = per_node.get(ti)
+                if bucket is None:
+                    bucket = per_node[ti] = []
+                bucket.append((uid, h))
+            for ti, bucket in per_node.items():
+                node_inner = node_inners[ti]
+                for uid, h in bucket:
+                    t[uid] = h
+                    job_inner[uid] = h
+                    eval_inner[uid] = h
+                    node_inner[uid] = h
+            # aggregates: one update per touched node / one per batch
+            c = b.row_contribution()
+            for nid, _ti, cnt in touched:
+                _usage_add(ut, nid, (c[0] * cnt, c[1] * cnt, c[2] * cnt, 0))
+            prio = b.job.priority if b.job is not None else 50
+            pt[prio] = pt.get(prio, 0) + len(b)
+            # summaries: every row is a fresh non-terminal insert, so the
+            # O(1) starting-count increment always applies (the eager
+            # txn's fresh-counts fast path)
+            if st is None:
+                st = self._wtable(TABLE_JOB_SUMMARIES)
+            summary = st.get(key)
+            summary = summary.copy() if summary else JobSummary(key[1], key[0])
+            counts = summary.summary.setdefault(
+                b.task_group,
+                {
+                    "queued": 0,
+                    "complete": 0,
+                    "failed": 0,
+                    "running": 0,
+                    "starting": 0,
+                    "lost": 0,
+                },
+            )
+            counts["starting"] += len(b)
+            summary.modify_index = index
+            st[key] = summary
+            jobs_touched.add(key)
+            stored.extend(hs)
         for ns, job_id in jobs_touched:
             self._update_job_status_txn(index, ns, job_id)
         return stored
@@ -1848,6 +2002,7 @@ class StateStore(_ReadMixin):
         """
         with self._lock, paused_gc():
             allocs_to_upsert: list[Allocation] = []
+            batches: list[PlacementBatch] = []
             stopped: list[Allocation] = []
             preempted: list[Allocation] = []
             deployment_events: list = []
@@ -1856,6 +2011,7 @@ class StateStore(_ReadMixin):
             for result in results:
                 for allocs in result.node_allocation.values():
                     allocs_to_upsert.extend(allocs)
+                batches.extend(result.alloc_batches)
                 for allocs in result.node_update.values():
                     stopped.extend(allocs)
                 for allocs in result.node_preemptions.values():
@@ -1921,6 +2077,27 @@ class StateStore(_ReadMixin):
                     default_jobs=default_jobs,
                 )
             )
+            # SoA batches: one bulk column transaction per batch — lazy
+            # row handles into the tables, vectorized aggregate updates,
+            # incremental summaries. The store takes ownership (stamps
+            # the batch in place), the same owned-payload contract the
+            # eager path has.
+            if batches:
+                committed.extend(
+                    self._upsert_batches_txn(index, batches, default_jobs)
+                )
+                # volume-bearing batches materialize for the claim walk
+                # (rare: volumes gate the plan onto the serial path)
+                if self._tables[TABLE_VOLUMES]:
+                    for b in batches:
+                        job = b.job
+                        tg = (
+                            job.lookup_task_group(b.task_group)
+                            if job is not None
+                            else None
+                        )
+                        if tg is not None and tg.volumes:
+                            fresh_allocs.extend(b.materialize())
             # Volume claims attach atomically with the placements that
             # need them (reference: the CSI claim RPC; here the plan
             # apply IS the claim point for registered volumes).
@@ -2247,8 +2424,15 @@ class StateStore(_ReadMixin):
         if job.stop:
             new_status = JOB_STATUS_DEAD
         else:
-            job_allocs = self.allocs_by_job(namespace, job_id)
-            has_live_alloc = any(not a.terminal_status() for a in job_allocs)
+            # raw index rows, not the materializing reader: the only
+            # question is "any live alloc?", which lazy AllocRow handles
+            # answer straight from their batch columns
+            job_allocs = self._tables[IDX_ALLOCS_JOB].get(
+                (namespace, job_id), {}
+            )
+            has_live_alloc = any(
+                not a.terminal_status() for a in job_allocs.values()
+            )
             has_open_eval = False
             for e in self._tables[TABLE_EVALS].values():
                 if (

@@ -126,25 +126,76 @@ class NetworkIndex:
                 ],
             )
             taken = set(used) | {p.value for p in ask.reserved_ports}
-            ok = True
-            for p in ask.dynamic_ports:
-                got = self._pick_dynamic_port(taken)
-                if got is None:
-                    ok = False
-                    break
-                taken.add(got)
-                offer.dynamic_ports.append(Port(p.label, got, p.to, p.host_network))
-            if ok:
+            got = pick_dynamic_ports(taken, len(ask.dynamic_ports))
+            if got is not None:
+                for p, port in zip(ask.dynamic_ports, got):
+                    offer.dynamic_ports.append(
+                        Port(p.label, port, p.to, p.host_network)
+                    )
                 return offer
         return None
 
-    def _pick_dynamic_port(self, taken: set[int]) -> Optional[int]:
-        for _ in range(MAX_RAND_PORT_ATTEMPTS):
-            port = random.randint(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT)
-            if port not in taken:
-                return port
-        # Linear fallback scan
-        for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1):
-            if port not in taken:
-                return port
-        return None
+
+_MASK64 = (1 << 64) - 1
+_LCG_MUL = 6364136223846793005
+_LCG_ADD = 1442695040888963407
+
+
+def _pick_ports_py(taken: set[int], k: int, seed: int) -> Optional[list[int]]:
+    """Pure-Python twin of fastpack.pick_ports: the SAME LCG draw
+    sequence and linear-scan fallback, so native and fallback pick
+    identical ports for one seed (behavior can never diverge — only
+    speed, the fastpack contract)."""
+    span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+    bits = {
+        p - MIN_DYNAMIC_PORT
+        for p in taken
+        if MIN_DYNAMIC_PORT <= p <= MAX_DYNAMIC_PORT
+    }
+    x = seed & _MASK64
+    out: list[int] = []
+    for _ in range(k):
+        got = -1
+        for _attempt in range(MAX_RAND_PORT_ATTEMPTS):
+            x = (x * _LCG_MUL + _LCG_ADD) & _MASK64
+            off = (x >> 33) % span
+            if off not in bits:
+                got = off
+                break
+        if got < 0:
+            for off in range(span):
+                if off not in bits:
+                    got = off
+                    break
+        if got < 0:
+            return None  # range exhausted
+        bits.add(got)
+        out.append(MIN_DYNAMIC_PORT + got)
+    return out
+
+
+def pick_dynamic_ports(taken: set[int], k: int) -> Optional[list[int]]:
+    """k distinct free dynamic ports in one draw (bulk port-picking for
+    the data plane): native fastpack.pick_ports over a free-port bitmap
+    when the extension is resolved, the identical-LCG Python fallback
+    otherwise. One entropy draw seeds the whole batch."""
+    if k == 0:
+        return []
+    seed = random.getrandbits(64)
+    from .. import codec
+
+    fp = codec.native_module()
+    if fp is not None and hasattr(fp, "pick_ports"):
+        span = MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT + 1
+        bitmap = bytearray((span + 7) // 8)
+        for p in taken:
+            if MIN_DYNAMIC_PORT <= p <= MAX_DYNAMIC_PORT:
+                off = p - MIN_DYNAMIC_PORT
+                bitmap[off >> 3] |= 1 << (off & 7)
+        try:
+            return fp.pick_ports(
+                bytes(bitmap), k, MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT, seed
+            )
+        except Exception:
+            pass
+    return _pick_ports_py(taken, k, seed)

@@ -118,44 +118,81 @@ RESTART_POLICY_MODE_FAIL = "fail"
 DEFAULT_NAMESPACE = "default"
 
 
-# Per-thread entropy pool behind generate_uuid: the urandom syscall is
-# the cost (the round-7 smoke trace/profile measured it at ~14% of a
-# whole single-eval solve — one syscall per alloc id, per eval id, per
-# dequeue token). One 4KiB urandom read now serves 256 ids; thread-local
-# so no lock rides the hot path. NOT fork-safe by design: this codebase
-# spawns subprocesses (fresh interpreter), never forks a live server.
+# Per-thread id pool behind generate_uuid: the urandom syscall AND the
+# per-id hex/dash formatting are the cost (the round-12 profiler put
+# generate_uuid + generate_uuids together at ~20% of c2m wall). The pool
+# now holds PRE-FORMATTED ids minted in bulk — one urandom syscall and
+# one formatting pass (native fastpack.uuid_hex when present) serve 256
+# ids — so every per-id call site is bulk minting under the hood.
+# Thread-local so no lock rides the hot path. NOT fork-safe by design:
+# this codebase spawns subprocesses (fresh interpreter), never forks a
+# live server.
 _UUID_POOL_IDS = 256
 
 
 class _UuidPool(threading.local):
     def __init__(self) -> None:
-        self.buf = ""
+        self.ids: list[str] = []
         self.off = 0
+        # raw entropy pool for bulk minting: one 64KiB urandom read
+        # serves ~16 c2m-sized generate_uuids calls (the per-call
+        # syscall was ~0.2s of a c2m pass)
+        self.raw = b""
+        self.raw_off = 0
 
 
 _uuid_pool = _UuidPool()
 
+_RAW_POOL_BYTES = 1 << 16
+
+
+def _pool_entropy(n: int) -> bytes:
+    pool = _uuid_pool
+    off = pool.raw_off
+    if off + n > len(pool.raw):
+        pool.raw = os.urandom(max(_RAW_POOL_BYTES, n))
+        off = 0
+    pool.raw_off = off + n
+    return pool.raw[off : off + n]
+
 
 def generate_uuid() -> str:
-    # uuid4-shaped from a pooled urandom read: same entropy per id as
-    # uuid.uuid4(), one syscall per _UUID_POOL_IDS ids
-    off = _uuid_pool.off
-    if off >= len(_uuid_pool.buf):
-        _uuid_pool.buf = os.urandom(16 * _UUID_POOL_IDS).hex()
+    # uuid4-shaped from the bulk-minted pool: same entropy per id as
+    # uuid.uuid4(), one syscall + one format pass per _UUID_POOL_IDS ids
+    pool = _uuid_pool
+    off = pool.off
+    if off >= len(pool.ids):
+        pool.ids = generate_uuids(_UUID_POOL_IDS)
         off = 0
-    b = _uuid_pool.buf[off : off + 32]
-    _uuid_pool.off = off + 32
-    return f"{b[:8]}-{b[8:12]}-{b[12:16]}-{b[16:20]}-{b[20:]}"
+    pool.off = off + 1
+    return pool.ids[off]
+
+
+def _uuid_hex_py(raw: bytes) -> list[str]:
+    h = raw.hex()
+    return [
+        f"{b[:8]}-{b[8:12]}-{b[12:16]}-{b[16:20]}-{b[20:]}"
+        for b in (h[i : i + 32] for i in range(0, len(h), 32))
+    ]
 
 
 def generate_uuids(k: int) -> list[str]:
-    """Bulk uuid4-shaped ids: one urandom syscall + one hex pass for the
-    whole batch (the batched solver mints 100k+ allocation ids per solve)."""
-    h = os.urandom(16 * k).hex()
-    return [
-        f"{b[:8]}-{b[8:12]}-{b[12:16]}-{b[16:20]}-{b[20:]}"
-        for b in (h[i : i + 32] for i in range(0, 32 * k, 32))
-    ]
+    """Bulk uuid4-shaped ids: one urandom syscall + one formatting pass
+    for the whole batch (the batched solver mints 100k+ allocation ids
+    per solve). Formatting runs in the fastpack extension when it is
+    already resolved (codec.warm_native — this function must never
+    trigger the C build itself), with the pure-Python hex pass as the
+    behavior-identical fallback."""
+    raw = _pool_entropy(16 * k)
+    from .. import codec
+
+    fp = codec.native_module()
+    if fp is not None:
+        try:
+            return fp.uuid_hex(raw)
+        except Exception:
+            pass
+    return _uuid_hex_py(raw)
 
 
 def now_ns() -> int:
@@ -1979,6 +2016,29 @@ class Plan:
     deployment: Optional["Deployment"] = None
     deployment_updates: list[DeploymentStatusUpdate] = field(default_factory=list)
     snapshot_index: int = 0
+    # struct-of-arrays fresh placements (structs/placement_batch.py):
+    # the solver's fast-mint path appends whole PlacementBatches here
+    # instead of per-row Allocations in node_allocation — the applier,
+    # codec, and store consume the columns directly.
+    alloc_batches: list = field(default_factory=list)
+
+    def append_placement_batch(self, batch) -> None:
+        """Attach a SoA batch of fresh placements (already job-stamped
+        by the solver; no per-row copy — batch rows are solver-minted
+        and referenced nowhere else, the append_fresh_alloc contract)."""
+        if batch.job is None:
+            batch.job = self.job
+        self.alloc_batches.append(batch)
+
+    def materialize_batches(self) -> None:
+        """Fold SoA batches into node_allocation as eager per-row
+        Allocations — the eager-object equivalent of this plan. Boundary
+        escape hatch (and the differential identity battery's
+        comparator); the hot paths never call it."""
+        for b in self.alloc_batches:
+            for a in b.materialize():
+                self.node_allocation.setdefault(a.node_id, []).append(a)
+        self.alloc_batches = []
 
     def append_stopped_alloc(
         self, alloc: Allocation, desired_desc: str, client_status: str = ""
@@ -2027,6 +2087,7 @@ class Plan:
         return (
             not self.node_update
             and not self.node_allocation
+            and not self.alloc_batches
             and self.deployment is None
             and not self.deployment_updates
         )
@@ -2050,16 +2111,26 @@ class PlanResult:
     preemption_evals: list["Evaluation"] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
+    # committed SoA placement batches (possibly per-node-trimmed views of
+    # the plan's batches). NEVER on the wire as a field: the codec folds
+    # these into node_allocation row maps so the raft entry is
+    # byte-identical to the eager form (codec._install_plan_result_encoder).
+    alloc_batches: list = field(default_factory=list)
 
     def full_commit(self, plan: Plan) -> tuple[bool, int, int]:
-        expected = sum(len(v) for v in plan.node_allocation.values())
-        actual = sum(len(v) for v in self.node_allocation.values())
+        expected = sum(len(v) for v in plan.node_allocation.values()) + sum(
+            len(b) for b in plan.alloc_batches
+        )
+        actual = sum(len(v) for v in self.node_allocation.values()) + sum(
+            len(b) for b in self.alloc_batches
+        )
         return expected == actual, expected, actual
 
     def is_no_op(self) -> bool:
         return (
             not self.node_update
             and not self.node_allocation
+            and not self.alloc_batches
             and not self.deployment_updates
             and self.deployment is None
         )

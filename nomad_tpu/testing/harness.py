@@ -44,6 +44,7 @@ class Harness:
             deployment=plan.deployment,
             deployment_updates=plan.deployment_updates,
             alloc_index=index,
+            alloc_batches=plan.alloc_batches,
         )
         self.state.upsert_plan_results(index, result)
         return result, None

@@ -308,3 +308,183 @@ def test_merged_batch_with_stops_and_disjoint_updates():
     applier_b.apply_batch(copy_plans([stop_plan, place_plan]))
 
     assert state_fingerprint(serial_state) == state_fingerprint(batch_state)
+
+
+# ---------------------------------------------------------------------------
+# SoA/lazy vs eager-object differential identity battery (ISSUE 12): the
+# array-native data plane (Plan.alloc_batches -> codec fold -> lazy store
+# rows) must be INDISTINGUISHABLE from the eager per-row path. One solve
+# produces the plans; codec copies feed each path its own object graph
+# (ids included), so identity is exact. The eager comparator is
+# Plan.materialize_batches() — the same rows, minted per-object.
+# ---------------------------------------------------------------------------
+
+
+def make_applier_with_log(state):
+    log = InmemLog(FSM(state), start_index=state.latest_index())
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    return PlanApplier(queue, state, log.apply, log.apply_async), queue, log
+
+
+def _soa_plans(h, jobs):
+    plans = solve_plans(h, jobs, "tpu")
+    assert any(p.alloc_batches for p in plans), (
+        "precondition: the tpu fast-mint path must emit PlacementBatches"
+    )
+    return plans
+
+
+def _eager_copy(plans):
+    out = copy_plans(plans)
+    for p in out:
+        p.materialize_batches()
+        assert not p.alloc_batches
+    return out
+
+
+def _alloc_bytes(state):
+    """Per-row wire bytes keyed by id: every stored alloc byte-identical,
+    independent of table iteration order."""
+    return {a.id: codec.pack(a) for a in state.allocs()}
+
+
+@pytest.mark.parametrize("mode", ["serial", "batch", "queue"])
+def test_soa_vs_eager_identity(mode, monkeypatch):
+    """Raft entries and store state are byte-identical between the SoA
+    and eager paths, across the merged-plan-apply matrix (serial
+    apply_one, merged apply_batch, and the queue's enqueue_batch
+    routing). Wall-clock stamps are pinned so the two runs are
+    bit-comparable."""
+    import nomad_tpu.state.store as store_mod
+
+    monkeypatch.setattr(store_mod, "now_ns", lambda: 1_234_567_890)
+
+    h, jobs = build_state(n_nodes=8, n_jobs=4, count=6)
+    plans = _soa_plans(h, jobs)
+    soa = copy_plans(plans)
+    eager = _eager_copy(plans)
+
+    def run(batch_plans):
+        state = clone_store(h.state)
+        applier, queue, log = make_applier_with_log(state)
+        if mode == "serial":
+            results = [applier.apply_one(p) for p in batch_plans]
+        elif mode == "batch":
+            results = applier.apply_batch(batch_plans)
+        else:
+            applier.start()
+            try:
+                futs = queue.enqueue_batch(batch_plans)
+                results = [f.result(timeout=30) for f in futs]
+            finally:
+                applier.stop()
+        return state, results, list(log._entries)
+
+    s_state, s_results, s_entries = run(soa)
+    e_state, e_results, e_entries = run(eager)
+
+    # every plan fully committed through both paths
+    for p, rs, re_ in zip(plans, s_results, e_results):
+        assert rs.full_commit(p)[0] and re_.full_commit(p)[0]
+
+    # raft entries: same count, same message types, BYTE-identical
+    # payloads — the codec's PlanResult encoder folds batches into the
+    # eager wire form exactly
+    assert len(s_entries) == len(e_entries)
+    for (si, st, sraw), (ei, et, eraw) in zip(s_entries, e_entries):
+        assert (si, st) == (ei, et)
+        assert sraw == eraw, f"raft entry {si} ({st}) diverged"
+
+    # store state: semantic fingerprint AND per-row wire bytes
+    assert state_fingerprint(s_state) == state_fingerprint(e_state)
+    assert _alloc_bytes(s_state) == _alloc_bytes(e_state)
+    # fast-mint-only plans insert in identical table order too: the
+    # whole-store serialization is bit-equal
+    assert s_state.serialize() == e_state.serialize()
+
+
+def test_soa_rows_materialize_lazily_and_cache(monkeypatch):
+    """The store holds AllocRow handles for batch rows until a reader
+    crosses the materialization boundary; materialized views are cached
+    (repeated reads return the same objects)."""
+    from nomad_tpu.state.store import TABLE_ALLOCS
+    from nomad_tpu.structs.placement_batch import AllocRow
+
+    h, jobs = build_state(n_nodes=6, n_jobs=2, count=5)
+    plans = _soa_plans(h, jobs)
+    state = clone_store(h.state)
+    applier, _, _ = make_applier_with_log(state)
+    applier.apply_batch(copy_plans(plans))
+
+    rows = [
+        v
+        for v in state._tables[TABLE_ALLOCS].values()
+        if v.__class__ is AllocRow
+    ]
+    assert rows, "batch rows should land as lazy handles"
+    # handles answer the hot fields from columns without materializing
+    r = rows[0]
+
+    def _cached(row):
+        cache = getattr(row.b, "_rows", None)
+        return cache is not None and cache[row.i] is not None
+
+    assert not _cached(r)
+    assert r.id and r.node_id and not r.terminal_status()
+    assert not _cached(r)
+
+    # the read mixin materializes; repeated reads share the cached view
+    a1 = state.alloc_by_id(r.id)
+    a2 = state.alloc_by_id(r.id)
+    assert type(a1).__name__ == "Allocation"
+    assert a1 is a2
+    by_job = state.allocs_by_job(a1.namespace, a1.job_id)
+    assert any(x is a1 for x in by_job)
+
+
+def test_soa_partial_rejection_trims_batch_rows():
+    """A node-level rejection drops exactly that node's batch rows (the
+    take() mask) and sets refresh, mirroring the eager path's per-node
+    drop."""
+    import numpy as np
+
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.server.plan_apply import evaluate_plan
+
+    h, jobs = build_state(n_nodes=3, n_jobs=1, count=9, cpu=1200, mem=256)
+    plans = _soa_plans(h, jobs)
+    plan = copy_plans(plans)[0]
+    assert plan.alloc_batches
+    # consume one target node almost fully so the plan's rows there no
+    # longer fit at verification time (the stale-snapshot race)
+    b = plan.alloc_batches[0]
+    victim_nid, _ti, cnt = b.touched_nodes()[0]
+    node = h.state.node_by_id(victim_nid)
+    filler = _manual_plan(mock.job(id="filler"), [(node, 3600, 7000)])
+    state = clone_store(h.state)
+    state.upsert_job(state.latest_index() + 1, filler.job)
+    applier, _, _ = make_applier_with_log(state)
+    assert applier.apply_one(filler).full_commit(filler)[0]
+
+    result = evaluate_plan(state.snapshot(), plan)
+    assert result.refresh_index > 0
+    kept = sum(len(bb) for bb in result.alloc_batches) + sum(
+        len(v) for v in result.node_allocation.values()
+    )
+    total = sum(len(bb) for bb in plan.alloc_batches)
+    assert kept == total - cnt
+    for bb in result.alloc_batches:
+        assert victim_nid not in {nid for nid, _t, _c in bb.touched_nodes()}
+
+
+@pytest.mark.parametrize("soa", ["1", "0"])
+def test_soa_chaos_kill_leader_during_replay(soa, tmp_path, monkeypatch):
+    """The identity battery's chaos leg: the kill-leader-during-replay
+    scenario (the harness's hardest replay race) holds its invariants —
+    no acked write lost, no duplicate alloc — with SoA placements ON
+    and OFF; the lazy data plane changes no durability semantics."""
+    monkeypatch.setenv("NOMAD_TPU_SOA", soa)
+    from tests.test_chaos import test_leader_kill_during_log_replay
+
+    test_leader_kill_during_log_replay(tmp_path)
