@@ -445,6 +445,9 @@ def solver_observability(compiles_at_warmup=None) -> dict:
         "last_occupancy": (occ["last_batch"] or {}).get("occupancy"),
         "h2d_bytes": snap["transfers"]["h2d_bytes"],
         "d2h_bytes": snap["transfers"]["d2h_bytes"],
+        "allgather_bytes": snap["transfers"]["allgather_bytes"],
+        "scatter_bytes": snap["transfers"]["scatter_bytes"],
+        "sharding": snap["sharding"],
         "device_memory": snap["device_memory"],
         "live_array_highwater_bytes": snap["live_array_highwater_bytes"],
     }
@@ -1104,16 +1107,32 @@ def run_pipeline_config():
         piped.append(run_once(pipeline=True))
         serial.append(run_once(pipeline=False))
     piped_rate, serial_rate = median(piped), median(serial)
-    ratio = piped_rate / max(serial_rate, 1e-9)
-    # an incomplete run means a deadline-floored rate somewhere in the
-    # ratio — the gate must not pass on a run where placement silently
-    # failed (in either mode: a hung comparator inflates the ratio)
-    ok = ratio >= 1.5 and incomplete[0] == 0
+    # the verdict is the MEDIAN OF TEMPORALLY-ADJACENT PAIR RATIOS, not
+    # a ratio of medians: both comparator sides drift together over a
+    # full-capture run (shared-host co-tenancy — the round-13 overhead
+    # gate's measured finding), and pairing cancels exactly the drift
+    # that cross-run medians pair badly against
+    pair_ratios = [p / max(s, 1e-9) for p, s in zip(piped, serial)]
+    ratio = median(pair_ratios)
+    # Gate re-based 1.5 -> 1.3 with the round-16 device-model fix: the
+    # injected RTT is now a SERIALLY-BUSY queue (one modeled chip —
+    # solver._inject_rtt), where the old model let two in-flight
+    # batches' windows overlap like a second device and the measured
+    # ratio rode that to 1.74-1.82. Under the honest model the ideal
+    # ratio is (host + rtt) / max(host, rtt); for this config's shape
+    # (host ~0.09s, rtt 0.15s) that ceiling is ~1.6, and the gate holds
+    # the measured overlap at >= ~80% of it. ideal_overlap_ratio is
+    # published per run so the gate's headroom is always visible.
+    host_s = max(n_jobs / max(serial_rate, 1e-9) / (n_jobs / batch_size)
+                 - latency, 1e-9)
+    ideal = (host_s + latency) / max(host_s, latency)
+    ok = ratio >= 1.3 and incomplete[0] == 0
     log(
         f"[pipeline] pipelined {piped_rate:.2f} evals/s (spread "
         f"{spread_pct(piped)}%) vs non-overlapped {serial_rate:.2f} "
         f"(spread {spread_pct(serial)}%) -> overlap ratio {ratio:.2f} "
-        f"(pass={ok})"
+        f"(pairs {[round(r, 2) for r in pair_ratios]}, ideal "
+        f"{ideal:.2f} under the serialized device model, pass={ok})"
     )
     return {
         "pipelined_evals_per_s": round(piped_rate, 2),
@@ -1125,7 +1144,9 @@ def run_pipeline_config():
         "injected_device_latency_s": latency,
         "incomplete_runs": incomplete[0],
         "overlap_ratio": round(ratio, 3),
-        "overlap_ge_1_5x": ok,
+        "overlap_pair_ratios": [round(r, 3) for r in pair_ratios],
+        "ideal_overlap_ratio": round(ideal, 3),
+        "overlap_ge_1_3x": ok,
     }
 
 
@@ -1216,6 +1237,234 @@ SERVICE_CONFIGS = {
     "c2m": (10000, 100, 1000, True, 20),
 }
 
+SHARDED_CAVEAT_TEXT = (
+    "c2m_sharded's device phase uses the injected-latency model (the "
+    "pipeline config's precedent): per-mesh device time is "
+    "BENCH_SHARDED_RTT_S x (shard rows / total rows), the scaling a "
+    "real mesh's LOCAL phase has by construction. The 8 'devices' here "
+    "are XLA virtual CPU devices sharing this box's cores, so raw "
+    "fallback wall cannot strong-scale; the gate is still a real "
+    "regression bound — the CPU-fallback kernel compute and host "
+    "phases run inside the modeled budget, so a sharded kernel whose "
+    "per-device work stops shrinking (e.g. a replicated full-sort "
+    "waterfill) blows the D=8 budget and fails the gate"
+)
+
+
+def run_c2m_sharded_config():
+    """c2m-scale solve with the node axis sharded over a device mesh:
+    100k+ nodes split over 8 virtual devices, solved end-to-end through
+    the production mesh path (SchedulerConfig.mesh_devices → SolverMesh
+    top-k kernels + NamedSharding resident tensors + delta syncs).
+
+    Measures eval throughput at mesh sizes 1 and 8 on the SAME sharded
+    code and problem. The device phase rides the injected-latency model
+    (SHARDED_CAVEAT_TEXT): latency = BENCH_SHARDED_RTT_S x (1/D), the
+    linear local-phase scaling real hardware provides; the CPU-fallback
+    kernel's real compute is the FLOOR under the model (solver._inject_rtt
+    sleeps from dispatch, compute proceeds async), so the published
+    sharded_scaling only reaches the gate when per-device work + host
+    overhead genuinely fit the shrinking budget.
+
+    sharded_scaling = (rate_D8 / rate_D1) / 8, where rate is the
+    PIPELINED end-to-end eval throughput: rounds run through the same
+    two-phase overlap as the production TPUBatchWorker
+    (solve_eval_batch_begin of batch N+1 overlaps batch N's device
+    wait; consecutive batches chain on the in-flight used' tensor, with
+    the chain composing with the resident shards), so throughput is
+    bounded by max(host phase, device phase) — and scales with the mesh
+    exactly while the device phase dominates.
+    """
+    from nomad_tpu import solverobs
+    from nomad_tpu.gctune import freeze_resident_heap, paused_gc
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.scheduler.tpu import (
+        ResidentClusterState,
+        solve_eval_batch_begin,
+    )
+    from nomad_tpu.scheduler.tpu.sharding import solver_mesh
+
+    n_nodes = int(os.environ.get("BENCH_SHARDED_NODES", "100000"))
+    n_jobs = int(os.environ.get("BENCH_SHARDED_JOBS", "16"))
+    count = int(os.environ.get("BENCH_SHARDED_COUNT", "500"))
+    base_rtt = float(os.environ.get("BENCH_SHARDED_RTT_S", "10.0"))
+    rounds = int(os.environ.get("BENCH_SHARDED_ROUNDS", "6"))
+    settle = int(os.environ.get("BENCH_SHARDED_SETTLE", "2"))
+    device_counts = (1, 8)
+    log(
+        f"[c2m_sharded] {n_nodes} nodes, {n_jobs} jobs x {count}, mesh "
+        f"sizes {device_counts}, device model base {base_rtt}s x 1/D, "
+        f"{rounds} pipelined rounds"
+    )
+
+    def run_rounds(h, cfg, resident, rounds_jobs, syncs=None):
+        """Pipelined steady-state rounds (the TPUBatchWorker overlap,
+        inline): begin(N+1) runs while batch N's device work is in
+        flight; N+1 chains on N's used' so the batches place
+        conflict-free; finish(N) + submit then completes N. Returns the
+        per-round completion walls (batch N's submit to batch N+1's) —
+        the medianable steady-state cadence."""
+        prev = None
+        walls = []
+        t0 = t_last = time.perf_counter()
+        with paused_gc(freeze_on_exit=True):
+            for jobs in rounds_jobs:
+                snap = h.snapshot()
+                evals = [mock.eval_for_job(j) for j in jobs]
+                chain = prev[0].chain if prev is not None else None
+                pend = solve_eval_batch_begin(
+                    snap, h, evals, cfg, resident=resident,
+                    used_chain=chain,
+                )
+                if syncs is not None:
+                    syncs.append(
+                        f"{resident.last_sync}"
+                        + ("+chain" if pend.chain_accepted else "")
+                    )
+                if prev is not None:
+                    p_pend, p_evals = prev
+                    plans = p_pend.finish()
+                    for ev in p_evals:
+                        h.submit_plan(plans[ev.id])
+                    now = time.perf_counter()
+                    walls.append(now - t_last)
+                    t_last = now
+                prev = (pend, evals)
+            p_pend, p_evals = prev
+            plans = p_pend.finish()
+            for ev in p_evals:
+                h.submit_plan(plans[ev.id])
+            now = time.perf_counter()
+            walls.append(now - t_last)
+        return time.perf_counter() - t0, walls
+
+    per_mesh = {}
+    recompiles_after_warmup = 0
+    for d in device_counts:
+        mesh = solver_mesh(d)
+        cfg = SchedulerConfig(
+            small_batch_threshold=0,
+            mesh_devices=d,
+            inject_device_latency_s=base_rtt / d,
+        )
+        gc.collect()
+        h, warm_jobs = build_cluster(
+            n_nodes, n_jobs, count, False, job_prefix=f"shard{d}-warm"
+        )
+        freeze_resident_heap()
+        resident = ResidentClusterState(mesh=mesh)
+        # warm rounds WITHOUT the latency model (compiles don't sleep):
+        # THREE rounds so the steady-state machinery compiles too before
+        # anything is measured — round 2 consumes the chain, round 3
+        # ships the first delta-sync scatter (the full sync happens at
+        # round 1, and round 2's diff is clean because round 1 is still
+        # in flight at its begin)
+        import copy as _copy
+
+        warm_cfg = _copy.copy(cfg)
+        warm_cfg.inject_device_latency_s = 0.0
+        warm_s, _ = run_rounds(h, warm_cfg, resident, [
+            warm_jobs,
+            add_jobs(h, n_jobs, count, False, job_prefix=f"shard{d}-w2"),
+            add_jobs(h, n_jobs, count, False, job_prefix=f"shard{d}-w3"),
+        ])
+        compiles0 = solverobs.compiles()
+        syncs: list = []
+        rounds_jobs = [
+            add_jobs(h, n_jobs, count, False, job_prefix=f"shard{d}-r{r}")
+            for r in range(settle + rounds)
+        ]
+        wall, walls = run_rounds(h, cfg, resident, rounds_jobs, syncs=syncs)
+        recompiles_after_warmup += solverobs.compiles() - compiles0
+        # steady-state cadence: the settle rounds absorb pipeline fill
+        # and the executable's first-runs transient; the median of the
+        # rest is the per-round completion interval one load spike
+        # cannot own
+        steady = walls[settle:] if len(walls) > settle + 1 else walls
+        round_s = median(steady)
+        rate = n_jobs / round_s
+        per_mesh[d] = {
+            "devices": d,
+            "injected_device_s": round(base_rtt / d, 4),
+            "warm_s": round(warm_s, 2),
+            "rounds": rounds,
+            "wall_s": round(wall, 3),
+            "round_walls_s": [round(w, 3) for w in walls],
+            "steady_round_s": round(round_s, 3),
+            "evals_per_s": round(rate, 3),
+            "spread_pct": spread_pct(steady),
+            "resident_sync_modes": syncs,
+        }
+        log(
+            f"[c2m_sharded] D={d}: {rate:.3f} evals/s (steady round "
+            f"{round_s:.2f}s, walls {[round(w, 2) for w in walls]}), "
+            f"syncs {syncs}, injected {base_rtt / d:.3f}s"
+        )
+        h = warm_jobs = rounds_jobs = None
+    obs = solver_observability()
+    obs["recompiles_after_warmup"] = recompiles_after_warmup
+    d1, d8 = device_counts[0], device_counts[-1]
+    scaling = (
+        per_mesh[d8]["evals_per_s"]
+        / max(per_mesh[d1]["evals_per_s"], 1e-9)
+    ) / (d8 / d1)
+    shards = (obs.get("sharding") or {}).get("last_shards") or []
+    mean_shard_occ = (
+        round(
+            sum(s["occupancy"] for s in shards) / len(shards), 4
+        )
+        if shards else None
+    )
+    log(
+        f"[c2m_sharded] scaling {scaling:.3f} x linear (gate >= 0.7); "
+        f"mean shard occupancy {mean_shard_occ}; allgather "
+        f"{obs['allgather_bytes']}B, scatter {obs['scatter_bytes']}B, "
+        f"recompiles after warmup {recompiles_after_warmup}"
+    )
+    return {
+        "tpu_evals_per_s": per_mesh[d8]["evals_per_s"],
+        "per_mesh": {str(k): v for k, v in per_mesh.items()},
+        "sharded_scaling": round(scaling, 4),
+        "sharded_scaling_linear_gate": 0.7,
+        "device_model_base_rtt_s": base_rtt,
+        "mean_shard_occupancy": mean_shard_occ,
+        "solver_observability": obs,
+        "caveat": SHARDED_CAVEAT_TEXT,
+    }
+
+
+def _run_sharded_subprocess() -> dict:
+    """Run the c2m_sharded config in a child process so ITS backend can
+    be forced to 8 virtual devices without the parent paying for it:
+    `xla_force_host_platform_device_count` partitions the CPU client
+    across the virtual devices and slows every single-chip config
+    (measured: the c2m device phase 0.19s -> 2.1s per batch with the
+    flag process-wide). The child is this same script with
+    BENCH_CONFIG=c2m_sharded; its JSON line carries the config block
+    (latency_percentiles and solver_observability included) and is
+    spliced into the parent's results verbatim."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["BENCH_CONFIG"] = "c2m_sharded"
+    env.setdefault("BENCH_SKIP_TPU_PROBE", "1")  # parent probed already
+    env.pop("BENCH_STRICT", None)  # parent owns the exit-code policy
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, capture_output=True, text=True, timeout=2400,
+    )
+    for raw in proc.stderr.splitlines():
+        log(raw)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if proc.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"c2m_sharded subprocess failed rc={proc.returncode}: "
+            f"{proc.stderr[-2000:]}"
+        )
+    payload = json.loads(lines[-1])
+    return payload["configs"]["c2m_sharded"]
+
 
 def _ensure_device() -> dict:
     """Guard against an unreachable TPU wedging the whole bench run.
@@ -1270,6 +1519,20 @@ def main():
             f"CHAOS INJECTION ACTIVE ({', '.join(chaos_knobs)}): "
             f"this capture CANNOT gate — results are fault-distorted"
         )
+    sel = os.environ.get("BENCH_CONFIG", "all")
+    if sel == "c2m_sharded":
+        # the sharded config needs 8 (virtual) devices; must be set
+        # before the jax backend initializes. ONLY for the solo run:
+        # the full run executes this config in a subprocess instead
+        # (_run_sharded_subprocess) because the flag costs the
+        # single-chip configs ~40% — XLA partitions the CPU client
+        # across the virtual devices (measured: c2m 122 -> 70 evals/s
+        # with the flag process-wide).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     device = _ensure_device()
     # always-on host profiler: runs through every measured pass (the
     # production posture — the overhead gate in tests/test_hostobs.py
@@ -1285,10 +1548,9 @@ def main():
         from nomad_tpu import trace as _trace
 
         _trace.configure(max_traces=256, enabled_=True)
-    sel = os.environ.get("BENCH_CONFIG", "all")
     names = (
-        ["smoke", "c1k", "c2m", "preempt", "drain", "plan_apply",
-         "pipeline", "soak"]
+        ["smoke", "c1k", "c2m", "c2m_sharded", "preempt", "drain",
+         "plan_apply", "pipeline", "soak"]
         if sel == "all"
         else [sel]
     )
@@ -1319,6 +1581,14 @@ def main():
                 ),
                 trials=5 if name == "c2m" else 3,
             )
+        elif name == "c2m_sharded":
+            if sel == "all":
+                # subprocess: its own 8-virtual-device backend, already
+                # carrying latency_percentiles/solver_observability —
+                # the parent's registry never saw its passes
+                results[name] = _run_sharded_subprocess()
+                continue
+            results[name] = run_c2m_sharded_config()
         elif name == "preempt":
             results[name] = run_preempt_config()
         elif name == "drain":
@@ -1354,15 +1624,33 @@ def main():
             gates[f"{cname}_apply_vs_solve_0_6"] = bool(
                 r["apply_vs_solve_ge_0_6"]
             )
-        if "overlap_ge_1_5x" in r:
-            gates[f"{cname}_overlap_1_5x"] = bool(r["overlap_ge_1_5x"])
+        if "overlap_ge_1_3x" in r:
+            gates[f"{cname}_overlap_1_3x"] = bool(r["overlap_ge_1_3x"])
         # recompile-bound regression guard (shape-bucketing contract,
         # kernels.py): after the warmup pass, steady-state batches in
         # the smoke and c2m configs must trigger ZERO compiles
         so = r.get("solver_observability") or {}
-        if cname in ("smoke", "c2m") and "recompiles_after_warmup" in so:
+        if (
+            cname in ("smoke", "c2m", "c2m_sharded")
+            and "recompiles_after_warmup" in so
+        ):
             gates[f"{cname}_recompile_bound"] = (
                 so["recompiles_after_warmup"] == 0
+            )
+        # sharded-solver linear-scaling gate (docs/sharding.md): the
+        # mesh path's throughput from 1 -> 8 devices must hold >= 0.7x
+        # linear under the per-shard device model
+        if "sharded_scaling" in r:
+            gates["sharded_scaling"] = (
+                r["sharded_scaling"] >= r["sharded_scaling_linear_gate"]
+            )
+            # resident tensors upload once: after each mesh's first
+            # ("full") sync, steady rounds must ship delta scatters or
+            # nothing — a mid-run "full" is a resident re-upload
+            gates[f"{cname}_delta_only"] = not any(
+                mode.startswith("full")
+                for mesh in r["per_mesh"].values()
+                for mode in mesh["resident_sync_modes"][1:]
             )
         # host-attribution gates (the host-profiling layer's acceptance
         # criteria): named (span x function) sites must cover >= 80% of
@@ -1432,7 +1720,11 @@ def main():
                 "platform": device["platform"],
                 "tpu_available": device["tpu_available"],
                 "caveats": CAVEATS
-                + ([NATIVE_CAVEAT_TEXT] if _NATIVE_CAVEAT[0] else []),
+                + ([NATIVE_CAVEAT_TEXT] if _NATIVE_CAVEAT[0] else [])
+                + (
+                    [SHARDED_CAVEAT_TEXT]
+                    if "c2m_sharded" in results else []
+                ),
             }
         )
     )

@@ -2524,7 +2524,14 @@ def _render_solver_status(snap: dict) -> str:
     tr = snap.get("transfers") or {}
     lines.append(
         f"Transfers   h2d {_fmt_bytes(tr.get('h2d_bytes'))}"
-        f"   d2h {_fmt_bytes(tr.get('d2h_bytes'))} (cumulative)"
+        f"   d2h {_fmt_bytes(tr.get('d2h_bytes'))}"
+        + (
+            f"   allgather {_fmt_bytes(tr.get('allgather_bytes'))}"
+            f"   scatter {_fmt_bytes(tr.get('scatter_bytes'))}"
+            if tr.get("allgather_bytes") or tr.get("scatter_bytes")
+            else ""
+        )
+        + " (cumulative)"
     )
     mem = snap.get("device_memory")
     lines.append(
@@ -2542,6 +2549,26 @@ def _render_solver_status(snap: dict) -> str:
         + f"   live arrays {_fmt_bytes(snap.get('live_array_bytes'))}"
         + f" (highwater {_fmt_bytes(snap.get('live_array_highwater_bytes'))})"
     )
+    sharding = snap.get("sharding") or {}
+    shards = sharding.get("last_shards")
+    if shards:
+        lines.append("")
+        lines.append(
+            f"Mesh        {sharding.get('devices', len(shards))} devices, "
+            "node axis sharded (docs/sharding.md)"
+        )
+        lines.append(_fmt_table(
+            [
+                [
+                    str(s.get("shard")),
+                    str(s.get("rows")),
+                    str(s.get("real_rows")),
+                    f"{(s.get('occupancy') or 0) * 100:.1f}%",
+                ]
+                for s in shards
+            ],
+            ["SHARD", "ROWS", "REAL", "OCCUPANCY"],
+        ))
     ledger = snap.get("ledger") or {}
     lines.append("")
     lines.append(

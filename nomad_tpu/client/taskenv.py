@@ -59,6 +59,15 @@ def build_env(
         env["NOMAD_SECRETS_DIR"] = secrets_dir
     if node is not None:
         env["NOMAD_DC"] = node.datacenter
+        # the node's advertised IP (same selection as service
+        # registration): lets netns'd tasks — the connect sidecar —
+        # recognize "this host's own address", which is invisible from
+        # inside the namespace
+        host_ip = node.attributes.get("unique.network.ip-address", "")
+        if not host_ip and node.http_addr:
+            host_ip = node.http_addr.rsplit(":", 1)[0]
+        if host_ip:
+            env["NOMAD_HOST_IP"] = host_ip
         env["node.unique.id"] = node.id
         env["node.datacenter"] = node.datacenter
         env["node.unique.name"] = node.name

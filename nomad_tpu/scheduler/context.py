@@ -40,8 +40,19 @@ class SchedulerConfig:
         small_batch_threshold: int = 48,
         inject_device_latency_s: Optional[float] = None,
         soa_placements: Optional[bool] = None,
+        mesh_devices: Optional[int] = None,
     ) -> None:
         import os
+
+        # Multi-chip: shard the solve's node axis over this many devices
+        # (scheduler/tpu/sharding.py). 0 = single chip. The sharded
+        # kernels are bit-identical to the single-chip solver, so every
+        # other knob composes unchanged.
+        if mesh_devices is None:
+            mesh_devices = int(
+                os.environ.get("NOMAD_TPU_MESH_DEVICES", "0") or 0
+            )
+        self.mesh_devices = mesh_devices
 
         # Struct-of-arrays placements (structs/placement_batch.py): the
         # solver's fast-mint path emits PlacementBatch columns instead of

@@ -6,6 +6,7 @@ from .kernels import (
     solve_placement,
 )
 from .lower import build_node_table, lower_group
+from .sharding import SolverMesh, solver_mesh
 from .scheduler import (
     PendingEvalBatch,
     TPUBatchScheduler,

@@ -48,6 +48,25 @@ def _pad_to(x: int, bucket: int) -> int:
     return ((x + bucket - 1) // bucket) * bucket
 
 
+def _shard_map(body, mesh: Mesh, in_specs, out_specs):
+    """Version-portable shard_map: jax >= 0.6 exports `jax.shard_map`
+    (replication check kwarg `check_vma`); the 0.4.x line this box runs
+    ships it as `jax.experimental.shard_map.shard_map` (kwarg
+    `check_rep`). The replication check is disabled either way: the
+    waterfill decision is computed replicated from all-gathered vectors,
+    which the checker cannot prove."""
+    try:
+        from jax import shard_map as sm  # jax >= 0.6
+
+        kwargs = {"check_vma": False}
+    except ImportError:  # pragma: no cover - exercised on jax 0.4.x boxes
+        from jax.experimental.shard_map import shard_map as sm
+
+        kwargs = {"check_rep": False}
+    return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **kwargs)
+
+
 def jit_cache_sizes() -> dict[str, int]:
     """The jit-cache entry count of each module-level kernel, straight
     from jax — the ground truth the compile ledger (solverobs.py) is
@@ -382,6 +401,77 @@ def _sharded_waterfill(score_loc, units_loc, count, axis, my, n_local):
     return lax.dynamic_slice(take, (my * n_local,), (n_local,))
 
 
+def _topk_fill(score_loc, units_loc, count, axis, my, n_local, k: int):
+    """Distributed waterfill whose per-device cost shrinks with the mesh.
+
+    The replicated variant above all-gathers the FULL [N] vectors and
+    argsorts them on EVERY device — O(N log N) per device no matter how
+    many devices share the work, which is exactly the term that stops a
+    node-sharded solve from scaling. This variant keeps per-device work
+    ∝ the shard:
+
+      1. each device top-k's its LOCAL [N/D] score slice — O(N/D log k);
+      2. the D×k candidate (score, units, global-index) triples are
+         all-gathered — O(D·k) bytes over ICI, independent of N;
+      3. the waterfill runs replicated over the tiny candidate set —
+         O(D·k log D·k), independent of N;
+      4. each device keeps its own slice of the take vector.
+
+    Exact vs the full sort whenever k >= min(count, n_local) for every
+    group (the caller guarantees it — solver-side the readback-width
+    bound already upper-bounds any group's receiving set): the full
+    waterfill's receiving set is a prefix of the global score order with
+    at most `count` members (each receives >= 1 instance), so every
+    receiving node — and every node ranked above one — survives its
+    shard's local top-k, and the candidate cumsum reproduces the full
+    sort's priors bit for bit. Tie order matches argsort's
+    lower-global-index-first: candidates are pre-sorted by global index,
+    then stably argsorted by -score.
+
+    Returns (take [n_local], candidate global indices [D*k] in
+    waterfill order, candidate takes [D*k]); the candidate arrays are
+    replicated on every device — the compact emission
+    (_candidates_to_inst) reads them directly."""
+    sv, si = lax.top_k(score_loc, k)  # local best-k (ties: lower idx)
+    su = units_loc[si]
+    gidx = (si + my * n_local).astype(jnp.int32)
+    vs = lax.all_gather(sv, axis, tiled=True)  # [D*k]
+    us = lax.all_gather(su, axis, tiled=True)
+    gs = lax.all_gather(gidx, axis, tiled=True)
+    o0 = jnp.argsort(gs)  # global-index order first ...
+    order = jnp.argsort(-vs[o0])  # ... so stable -score sort ties by it
+    su_s = us[o0][order]
+    prior = jnp.cumsum(su_s) - su_s
+    take_sorted = jnp.clip(count - prior, 0, su_s)
+    gs_s = gs[o0][order]
+    loc = gs_s - my * n_local
+    mine = (loc >= 0) & (loc < n_local)
+    take = (
+        jnp.zeros((n_local + 1,), units_loc.dtype)
+        .at[jnp.where(mine, loc, n_local)]
+        .add(jnp.where(mine, take_sorted, 0))
+    )
+    return take[:n_local], gs_s, take_sorted
+
+
+def _candidates_to_inst(gs_s, take_sorted, maxc: int):
+    """Compact per-instance node list from the replicated candidate set:
+    exactly solve_placement_compact's readback (instances enumerated in
+    node-index order, -1 past the placed total) — but computed over the
+    D*k candidates instead of the full [N] take vector, so the compact
+    emission costs O(D*k log D*k) replicated, independent of N.
+    Non-candidate nodes all have take 0, and searchsorted(side=right)
+    skips zero-take entries, so the candidate-compressed cumsum yields
+    the identical instance sequence."""
+    o2 = jnp.argsort(gs_s)  # node-index order, matching compact_one
+    gs2 = gs_s[o2]
+    cum = jnp.cumsum(take_sorted[o2])
+    idxv = jnp.arange(maxc, dtype=jnp.int32)
+    pos = jnp.searchsorted(cum, idxv, side="right")
+    node = gs2[jnp.clip(pos, 0, gs2.shape[0] - 1)]
+    return jnp.where(idxv < cum[-1], node, -1).astype(jnp.int32)
+
+
 def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
     """Node-sharded variant of solve_placement_preempt.
 
@@ -394,7 +484,6 @@ def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
     solves are equivalence-tested against each other
     (tests/test_tpu_solver.py).
     """
-    from jax import shard_map
 
     def sharded_solve(
         cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap,
@@ -469,9 +558,9 @@ def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
             )
             return takes, takes_evict, usede_l - freed + used_new
 
-        return shard_map(
+        return _shard_map(
             body,
-            mesh=mesh,
+            mesh,
             in_specs=(
                 P(axis, None),        # cap
                 P(axis, None),        # used_exist
@@ -484,24 +573,44 @@ def make_sharded_solver_preempt(mesh: Mesh, axis: str = "nodes"):
                 P(),                  # tier_limit
             ),
             out_specs=(P(None, axis), P(None, axis), P(axis, None)),
-            check_vma=False,
         )(cap, used_exist, prefix_used, asks, counts, feas, bias, units_cap,
           tier_limit)
 
+    sharded_solve.__name__ = f"sharded_solver_preempt_d{mesh.shape[axis]}"
     return jax.jit(sharded_solve)
 
 
-def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
+def make_sharded_solver(mesh: Mesh, axis: str = "nodes",
+                        max_count: int | None = None,
+                        compact: bool = False):
     """Build a pjit'd solver with the node axis sharded over `mesh`.
 
-    Scoring/feasibility/unit math runs on each device's node shard; only the
-    [N] score and unit vectors are all-gathered per scan step to make the
-    (deterministic, replicated) waterfill decision, then each device applies
-    its slice. Communication: O(G * N * 8 bytes) over ICI.
-    """
-    from jax import shard_map
+    Scoring/feasibility/unit math runs on each device's node shard. The
+    waterfill decision depends on max_count:
 
+      * None (default, the always-exact reference form): the full [N]
+        score and unit vectors are all-gathered per scan step and the
+        replicated decision argsorts them — O(G * N * 8 bytes) over ICI
+        but O(N log N) compute on EVERY device.
+      * an int bounding every group's count: the distributed top-k
+        waterfill (_sharded_waterfill_topk) — per-device compute shrinks
+        with the mesh (O(N/D) local + O(D*k) replicated) and only the
+        D*k candidate triples ride ICI. The production path
+        (scheduler/tpu/sharding.py SolverMesh) derives the bound from
+        the batch's group counts, bucketed for jit-signature stability.
+
+    compact=True (requires max_count): instead of the dense [G, N]
+    assignment, returns (inst_node [G, max_count] i32 replicated,
+    over [N] bool, used' [N, R]) — the same readback contract as
+    solve_placement_compact, emitted from the replicated candidate set
+    so the device->host transfer is [G, maxC], never [G, N]. Bit-equal
+    to the single-chip compact kernel (same waterfill, same node-order
+    instance enumeration, `over` all-False by the same integer-capacity
+    argument).
+    """
     n_dev = mesh.shape[axis]
+    if compact and max_count is None:
+        raise ValueError("compact sharded solver requires max_count")
 
     def sharded_solve(cap, used, asks, counts, feas, bias, units_cap):
         def body(cap_l, used_l, asks_l, counts_l, feas_l, bias_l, ucap_l):
@@ -522,20 +631,45 @@ def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
                     bias_g,
                 )
                 score_loc = jnp.where(units_loc > 0, score_loc, NEG_INF)
-                take_loc = _sharded_waterfill(
-                    score_loc, units_loc, count, axis, my, n_local
+                if max_count is None:
+                    take_loc = _sharded_waterfill(
+                        score_loc, units_loc, count, axis, my, n_local
+                    )
+                    return (
+                        used_loc + take_loc[:, None] * ask[None, :],
+                        take_loc,
+                    )
+                take_loc, gs_s, take_sorted = _topk_fill(
+                    score_loc, units_loc, count, axis, my, n_local,
+                    min(max_count, n_local),
                 )
                 used_loc = used_loc + take_loc[:, None] * ask[None, :]
-                return used_loc, take_loc
+                if not compact:
+                    return used_loc, take_loc
+                inst = _candidates_to_inst(gs_s, take_sorted, max_count)
+                return used_loc, inst
 
-            used_out, takes_loc = lax.scan(
+            used_out, per_group = lax.scan(
                 step, used_l, (asks_l, counts_l, feas_l, bias_l, ucap_l)
             )
-            return takes_loc, used_out
+            if not compact:
+                return per_group, used_out
+            placed_res = used_out - used_l
+            over_loc = jnp.any(
+                placed_res > jnp.maximum(cap_l - used_l, 0), axis=1
+            )
+            return per_group, over_loc, used_out
 
-        return shard_map(
+        out_specs = (
+            # inst is computed replicated (candidate math), over and
+            # used' stay node-sharded
+            (P(None, None), P(axis), P(axis, None))
+            if compact
+            else (P(None, axis), P(axis, None))
+        )
+        return _shard_map(
             body,
-            mesh=mesh,
+            mesh,
             in_specs=(
                 P(axis, None),  # cap
                 P(axis, None),  # used
@@ -545,8 +679,13 @@ def make_sharded_solver(mesh: Mesh, axis: str = "nodes"):
                 P(None, axis),  # bias
                 P(None, axis),  # units_cap
             ),
-            out_specs=(P(None, axis), P(axis, None)),
-            check_vma=False,
+            out_specs=out_specs,
         )(cap, used, asks, counts, feas, bias, units_cap)
 
+    # ledger identity: per-mesh compile entries are attributable to their
+    # device count (the k bucket rides in the caller's signature tuple)
+    sharded_solve.__name__ = (
+        f"sharded_solver_compact_d{n_dev}" if compact
+        else f"sharded_solver_d{n_dev}"
+    )
     return jax.jit(sharded_solve)

@@ -42,6 +42,35 @@ from .solver import BatchSolver, GroupAsk
 logger = logging.getLogger("nomad_tpu.scheduler.tpu")
 
 
+def _mesh_for(config: SchedulerConfig, solve_fn):
+    """The configured SolverMesh, or None. Only the default kernel path
+    shards (an explicit solve_fn brings its own topology); meshes are
+    process-cached so every solver shares the compiled kernels.
+    mesh_devices=1 is honored as a real 1-device mesh — the sharded
+    bench's scaling baseline runs the SAME kernel at every mesh size.
+
+    A misconfigured mesh (NOMAD_TPU_MESH_DEVICES beyond the backend's
+    device count) must not raise: every scheduler process() would fail
+    and redeliver its eval forever. Degrade loudly to single-chip and
+    clear mesh_devices on the config so the error logs once per config,
+    not once per solve (TPUBatchWorker._ensure_resident applies the
+    same policy for its resident tensors)."""
+    n = getattr(config, "mesh_devices", 0) or 0
+    if solve_fn is None and n >= 1:
+        from .sharding import solver_mesh
+
+        try:
+            return solver_mesh(n)
+        except RuntimeError as exc:
+            logger.error(
+                "mesh_devices=%d unusable (%s); falling back to the "
+                "single-chip solver — fix NOMAD_TPU_MESH_DEVICES or "
+                "the backend's device count", n, exc,
+            )
+            config.mesh_devices = 0
+    return None
+
+
 def _bucket_requests(job, place_requests):
     """Group placement requests into solver asks by (group, job version):
     requests carrying a job_override (canary-state downgrades) lower with
@@ -159,6 +188,7 @@ class TPUGenericScheduler(GenericScheduler):
         solver = BatchSolver(
             self.state, self.config, solve_fn=self.solve_fn,
             solve_preempt_fn=self.solve_preempt_fn,
+            mesh=_mesh_for(self.config, self.solve_fn),
         )
         asks = [
             GroupAsk(eval_obj, pjob, tg_name, reqs, plan=self.plan)
@@ -365,7 +395,7 @@ def solve_eval_batch_begin(
         solver = BatchSolver(
             state, config, solve_fn=solve_fn,
             solve_preempt_fn=solve_preempt_fn, resident=resident,
-            used_chain=used_chain,
+            used_chain=used_chain, mesh=_mesh_for(config, solve_fn),
         )
         pending = solver.solve_begin(asks)
     return PendingEvalBatch(

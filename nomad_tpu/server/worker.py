@@ -321,9 +321,46 @@ class TPUBatchWorker:
         # oldest chained ancestor's snapshot index).
         self._prev: Optional[tuple] = None
         self.processed = 0
+        # Multi-chip (config.mesh_devices > 1): one ResidentClusterState
+        # per worker, sharded over the mesh — resident tensors are
+        # placed per-shard once and steady-state solves ship only usage
+        # deltas into the owning shard. Built lazily at the first solve
+        # (jax stays unloaded until the TPU path actually runs).
+        self._resident = None
         # Shared NotLeaderError backoff across the commit stage (see
         # Worker._run): a revoke window must throttle, not hot-loop.
         self._nl_backoff = WORKER_POLICY.backoff()
+
+    def _ensure_resident(self) -> None:
+        """Build the (possibly mesh-sharded) ResidentClusterState at the
+        first solve — jax stays unloaded until the TPU path actually
+        runs. A misconfigured mesh (NOMAD_TPU_MESH_DEVICES beyond what
+        the backend exposes) must NOT raise here: the exception would
+        nack and redeliver every eval forever — the cluster accepts
+        jobs but never places. Degrade loudly to single-chip instead,
+        and clear mesh_devices so the scheduler's _mesh_for doesn't
+        re-raise the same error per solve."""
+        if (
+            self._resident is not None
+            or (getattr(self.config, "mesh_devices", 0) or 0) <= 1
+        ):
+            return
+        from ..scheduler.tpu import ResidentClusterState
+        from ..scheduler.tpu.sharding import solver_mesh
+
+        try:
+            self._resident = ResidentClusterState(
+                mesh=solver_mesh(self.config.mesh_devices)
+            )
+        except RuntimeError as exc:
+            logger.error(
+                "mesh_devices=%d unusable (%s); falling back to the "
+                "single-chip solver — fix NOMAD_TPU_MESH_DEVICES or "
+                "the backend's device count",
+                self.config.mesh_devices, exc,
+            )
+            self.config.mesh_devices = 0
+            self._resident = ResidentClusterState()
 
     def start(self) -> None:
         # Fresh Event + queue per incarnation (see Worker.start).
@@ -541,8 +578,10 @@ class TPUBatchWorker:
             # injected dispatch-stage fault: surfaces through the solve
             # stage's existing failure path (nack + redeliver)
             faultplane.plane.on_device("dispatch")
+        self._ensure_resident()
         pending = solve_eval_batch_begin(
-            snapshot, self.planner, evals, self.config, used_chain=chain
+            snapshot, self.planner, evals, self.config, used_chain=chain,
+            resident=self._resident,
         )
         if chained_on is not None and not pending.chain_accepted:
             # the solver took a path that never consumed the chain (host

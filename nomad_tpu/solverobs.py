@@ -116,9 +116,17 @@ class SolverObservatory:
         self.last_asks: Optional[dict] = None
         # lowered node-table shape (lower.py build_node_table)
         self.last_table: Optional[dict] = None
-        # transfer totals (bytes)
+        # transfer totals (bytes); allgather = modeled ICI traffic of
+        # node-sharded solves, scatter = delta-sync rows landing in
+        # their owning resident shard (scheduler/tpu/sharding.py)
         self.h2d_bytes = 0
         self.d2h_bytes = 0
+        self.allgather_bytes = 0
+        self.scatter_bytes = 0
+        # sharding: device count + per-shard occupancy of the last
+        # node-sharded dispatch (bounded: a mesh is <= 64 devices here)
+        self.mesh_devices = 0
+        self.last_shards: Optional[list] = None
         # device memory
         self.device_memory: Optional[dict] = None
         self.live_array_bytes = 0
@@ -226,18 +234,38 @@ class SolverObservatory:
         with self._lock:
             self.last_table = {"nodes": n, "host_bytes": int(nbytes)}
 
+    def record_shards(self, n_dev: int, shards: list) -> None:
+        """Per-shard occupancy of one node-sharded dispatch
+        (sharding.SolverMesh.shard_occupancy rows). Bounded: a mesh
+        larger than 64 devices keeps its first 64 rows plus the count —
+        enough to read an imbalance, never an unbounded payload."""
+        if not _enabled:
+            return
+        shards = list(shards[:64])
+        with self._lock:
+            self.mesh_devices = int(n_dev)
+            self.last_shards = shards
+        for s in shards:
+            metrics.observe(
+                "nomad.solver.shard_occupancy", float(s.get("occupancy", 0.0))
+            )
+
     # -- transfers ------------------------------------------------------
 
     def record_transfer(
         self, direction: str, nbytes: int, dur_ns: int = 0, span: bool = False
     ) -> None:
-        """direction: 'h2d' | 'd2h'. span=True also lands a
-        solver.transfer span of dur_ns on the live trace."""
+        """direction: 'h2d' | 'd2h' | 'allgather' | 'scatter'. span=True
+        also lands a solver.transfer span of dur_ns on the live trace."""
         if not _enabled or nbytes <= 0:
             return
         with self._lock:
             if direction == "h2d":
                 self.h2d_bytes += nbytes
+            elif direction == "allgather":
+                self.allgather_bytes += nbytes
+            elif direction == "scatter":
+                self.scatter_bytes += nbytes
             else:
                 self.d2h_bytes += nbytes
         metrics.incr(f"nomad.solver.transfer_bytes.{direction}", nbytes)
@@ -336,6 +364,15 @@ class SolverObservatory:
                 "transfers": {
                     "h2d_bytes": self.h2d_bytes,
                     "d2h_bytes": self.d2h_bytes,
+                    "allgather_bytes": self.allgather_bytes,
+                    "scatter_bytes": self.scatter_bytes,
+                },
+                "sharding": {
+                    "devices": self.mesh_devices,
+                    "last_shards": (
+                        [dict(s) for s in self.last_shards]
+                        if self.last_shards else None
+                    ),
                 },
                 "device_memory": dict(self.device_memory)
                 if self.device_memory else None,
@@ -355,7 +392,7 @@ def _install(obs: SolverObservatory) -> SolverObservatory:
     """Swap the process-global observatory (returns the previous one) —
     the test/bench isolation hook, mirroring metrics._install_registry."""
     global _global, record_call, record_batch, note_asks, note_table
-    global record_transfer, sample_device_memory, snapshot
+    global record_transfer, record_shards, sample_device_memory, snapshot
     global compiles, steady_recompiles
     old = _global
     _global = obs
@@ -364,6 +401,7 @@ def _install(obs: SolverObservatory) -> SolverObservatory:
     note_asks = obs.note_asks
     note_table = obs.note_table
     record_transfer = obs.record_transfer
+    record_shards = obs.record_shards
     sample_device_memory = obs.sample_device_memory
     snapshot = obs.snapshot
     compiles = obs.compiles
@@ -378,6 +416,7 @@ record_batch = _global.record_batch
 note_asks = _global.note_asks
 note_table = _global.note_table
 record_transfer = _global.record_transfer
+record_shards = _global.record_shards
 sample_device_memory = _global.sample_device_memory
 snapshot = _global.snapshot
 compiles = _global.compiles

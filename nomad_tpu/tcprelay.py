@@ -14,6 +14,7 @@ Semantics:
 
 from __future__ import annotations
 
+import errno
 import socket
 import threading
 import time
@@ -21,7 +22,20 @@ from typing import Callable, Optional
 
 
 class TcpRelay:
-    """Listener forwarding each connection to pick_target()'s choice."""
+    """Listener forwarding each connection to pick_target()'s choice.
+
+    pick_target may return one ``(host, port)`` target or an ordered
+    list of candidate targets; a later candidate is dialed ONLY when
+    the earlier one fails with a no-route error (ENETUNREACH /
+    EHOSTUNREACH) — a refused or timed-out dial means the primary was
+    routable and falling through could deliver the stream to an
+    unrelated service listening on the same port at the fallback
+    address. The list form exists for the connect sidecar's gateway
+    fallback (connect/sidecar.py): a netns'd dialer on a NAT-less host
+    has NO ROUTE to a same-host advertised address and reaches the
+    same listener through the bridge gateway; the fallback happens
+    per-connection, inside the relay, so the unroutable primary never
+    turns into a client-visible connection reset."""
 
     def __init__(
         self,
@@ -69,9 +83,19 @@ class TcpRelay:
         if target is None:
             conn.close()
             return
-        try:
-            upstream = socket.create_connection(target, timeout=10)
-        except OSError:
+        candidates = [target] if isinstance(target, tuple) else list(target)
+        upstream = None
+        for cand in candidates:
+            try:
+                upstream = socket.create_connection(cand, timeout=10)
+                break
+            except OSError as e:
+                # fall through ONLY when there was no route at all;
+                # refused/timeout mean the primary was the right place
+                # and merely unhealthy — never reroute those
+                if e.errno not in (errno.ENETUNREACH, errno.EHOSTUNREACH):
+                    break
+        if upstream is None:
             conn.close()
             return
 

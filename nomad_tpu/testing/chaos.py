@@ -338,21 +338,94 @@ class ChaosCluster:
             assert not missing, (
                 f"acked writes lost on {nid}: jobs {sorted(missing)}"
             )
-            assert_no_duplicate_allocs(st, label=nid)
+            assert_no_duplicate_allocs(st, label=nid, cluster_server=cs)
 
 
-def assert_no_duplicate_allocs(state, label: str = "") -> None:
+def duplicate_alloc_forensics(state, key, id_a, id_b,
+                              cluster_server=None) -> dict:
+    """Evidence bundle for a duplicate-alloc invariant failure (the
+    known ~1/7 bench-soak flake, CHANGES round 15): the two allocs'
+    store rows, their minting evals' PLAN-APPLY SNAPSHOT INDEX vs the
+    server's raft commit/applied indexes, and the raft log entries that
+    carry each alloc id — everything the stale-snapshot-re-placement
+    theory needs to be confirmed or killed on evidence. Failure-path
+    only; the raft-log scan is a raw substring search over the encoded
+    entries (alloc ids are uuid strings, msgpack stores them verbatim).
+    """
+    out: dict = {"key": list(key)}
+    for aid in (id_a, id_b):
+        a = state.alloc_by_id(aid)
+        row: dict = {"id": aid}
+        if a is not None:
+            row.update(
+                create_index=a.create_index,
+                modify_index=a.modify_index,
+                desired_status=a.desired_status,
+                client_status=a.client_status,
+                eval_id=a.eval_id,
+            )
+            ev = state.eval_by_id(a.eval_id) if a.eval_id else None
+            if ev is not None:
+                row["eval"] = {
+                    "snapshot_index": ev.snapshot_index,
+                    "status": ev.status,
+                    "triggered_by": ev.triggered_by,
+                    "create_index": ev.create_index,
+                    "modify_index": ev.modify_index,
+                }
+        out.setdefault("allocs", []).append(row)
+    raft = getattr(cluster_server, "raft", None)
+    if raft is not None:
+        out["raft"] = {
+            "commit_index": getattr(raft, "commit_index", None),
+            "last_applied": getattr(raft, "last_applied", None),
+            # entries at or below this index are compacted into the
+            # snapshot — a mint below it is unscannable (noted, not
+            # silently absent)
+            "snapshot_last_index": getattr(
+                raft, "_snap_last_index", None
+            ),
+        }
+        try:
+            log = list(getattr(raft, "_log", ()) or ())
+        except Exception:
+            log = []
+        mints: dict[str, list] = {id_a: [], id_b: []}
+        for e in log:
+            raw = getattr(e, "payload", b"")
+            if not isinstance(raw, (bytes, bytearray)):
+                continue
+            for aid in (id_a, id_b):
+                if aid.encode() in raw:
+                    mints[aid].append(
+                        {"index": e.index, "type": e.msg_type}
+                    )
+        out["mint_entries"] = mints
+    return out
+
+
+def assert_no_duplicate_allocs(state, label: str = "",
+                               cluster_server=None) -> None:
     """No two live allocations may share (namespace, job, alloc name) —
     a duplicate means one placement request was minted twice (e.g. an
-    eval restored from a stale mid-replay snapshot re-placed a job)."""
+    eval restored from a stale mid-replay snapshot re-placed a job).
+    On failure the message carries the store/raft forensics
+    (duplicate_alloc_forensics) so a flaky reproduction is evidence,
+    not just a flag."""
+    import json as _json
+
     seen: dict[tuple, str] = {}
     for a in state.allocs():
         if a.terminal_status():
             continue
         key = (a.namespace, a.job_id, a.name)
         if key in seen:
+            detail = duplicate_alloc_forensics(
+                state, key, seen[key], a.id, cluster_server=cluster_server
+            )
             raise AssertionError(
                 f"duplicate alloc minted{' on ' + label if label else ''}: "
-                f"{key} -> {seen[key]} and {a.id}"
+                f"{key} -> {seen[key]} and {a.id}; forensics: "
+                + _json.dumps(detail, default=str, sort_keys=True)
             )
         seen[key] = a.id

@@ -14,14 +14,59 @@
 # (the tier-1 posture); point JAX_PLATFORMS elsewhere to exercise a
 # real device.
 #
-# Exit code is pytest's: nonzero on any failure. Budget ~30+ minutes.
+# After the pytest battery, runs the c2m_sharded bench sweep (100k+
+# nodes over mesh sizes 1 and 8 through the production mesh path) and
+# fails if its sharded_scaling gate (>= 0.7x linear) or the
+# zero-full-reupload/recompile-bound gates regress. Skip it with
+# SLOW_SUITE_NO_SHARDED=1 (e.g. on a box mid-perf-capture, where a
+# concurrent sweep would skew BENCH_r0N numbers).
+#
+# Exit code: nonzero on any pytest failure or sharded-gate failure.
+# Budget ~30+ minutes.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 : "${JAX_PLATFORMS:=cpu}"
 export JAX_PLATFORMS
 
-exec python -m pytest tests/ -q -m slow \
+python -m pytest tests/ -q -m slow \
   --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly \
   "$@"
+
+if [ "${SLOW_SUITE_NO_SHARDED:-0}" != "1" ]; then
+  echo "[slow-suite] c2m_sharded device-count sweep (BENCH_CONFIG=c2m_sharded)"
+  BENCH_CONFIG=c2m_sharded python - <<'PY'
+import json, os, subprocess, sys
+
+env = dict(os.environ, BENCH_CONFIG="c2m_sharded")
+proc = subprocess.run(
+    [sys.executable, "bench.py"], env=env, capture_output=True, text=True
+)
+sys.stderr.write(proc.stderr[-2000:])
+if proc.returncode != 0:
+    sys.exit(f"c2m_sharded sweep failed rc={proc.returncode}")
+cfg = json.loads(proc.stdout.strip().splitlines()[-1])["configs"]["c2m_sharded"]
+# After the warmup sync ("full"), every steady-round resident sync must
+# be a delta scatter or clean — a "full" mid-run means the resident
+# shards re-uploaded (docs/sharding.md § re-upload vs delta-sync triage).
+steady_fulls = sum(
+    1
+    for mesh in cfg["per_mesh"].values()
+    for mode in mesh["resident_sync_modes"][1:]
+    if mode.startswith("full")
+)
+recompiles = cfg["solver_observability"]["recompiles_after_warmup"]
+print(
+    "[slow-suite] sharded_scaling=%.3f (gate >= 0.7), "
+    "steady_full_reuploads=%d, recompiles_after_warmup=%d"
+    % (cfg["sharded_scaling"], steady_fulls, recompiles)
+)
+ok = (
+    cfg["sharded_scaling"] >= cfg["sharded_scaling_linear_gate"]
+    and steady_fulls == 0
+    and recompiles == 0
+)
+sys.exit(0 if ok else "c2m_sharded gates failed")
+PY
+fi

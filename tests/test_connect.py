@@ -82,6 +82,49 @@ def test_injection_adds_sidecar_task_port_and_mesh_service():
     assert cfg["inbound"]["local_port"] == 8080
 
 
+def test_sidecar_gateway_fallback_scoped_to_own_host(monkeypatch):
+    """The bridge-gateway dial fallback exists for the NAT-less hairpin
+    (a netns'd dialer reaching THIS host's advertised IP); a cross-host
+    target must never grow a gateway candidate — EHOSTUNREACH to a dead
+    remote peer would otherwise reroute the stream to whatever occupies
+    the same port at the gateway."""
+    from nomad_tpu.connect import sidecar as sc
+
+    monkeypatch.setattr(sc, "_default_gateway", lambda: "172.26.64.1")
+    monkeypatch.setenv("NOMAD_HOST_IP", "10.0.0.5")
+    relay = sc._Relay.__new__(sc._Relay)
+    relay._rr = __import__("itertools").count()
+    relay._gateway = "172.26.64.1"
+    relay._host_ip = "10.0.0.5"
+    # own advertised IP: hairpin — gateway fallback offered
+    relay._targets = ["10.0.0.5:21000"]
+    assert relay._pick() == [("10.0.0.5", 21000), ("172.26.64.1", 21000)]
+    # cross-host target: no fallback, a dead peer must fail
+    relay._targets = ["10.0.0.7:21000"]
+    assert relay._pick() == [("10.0.0.7", 21000)]
+    # unknown host ip (pre-upgrade client): errno-guarded legacy shape
+    relay._host_ip = ""
+    relay._targets = ["10.0.0.7:21000"]
+    assert relay._pick() == [("10.0.0.7", 21000), ("172.26.64.1", 21000)]
+    relay._targets = ["127.0.0.1:9000"]
+    assert relay._pick() == [("127.0.0.1", 9000)]
+
+
+def test_task_env_carries_host_ip():
+    """build_env must expose the node's advertised IP (the service-
+    registration address selection) as NOMAD_HOST_IP so netns'd tasks
+    can recognize their own host."""
+    from nomad_tpu import mock
+    from nomad_tpu.client.taskenv import build_env
+
+    node = mock.node()
+    node.attributes["unique.network.ip-address"] = "10.0.0.5"
+    job = connect_job("api")
+    alloc = mock.alloc(node_=node, job=job)
+    env = build_env(alloc, job.task_groups[0].tasks[0], node=node)
+    assert env["NOMAD_HOST_IP"] == "10.0.0.5"
+
+
 def test_injection_is_idempotent():
     job = connect_job("api")
     inject_connect_sidecars(job)

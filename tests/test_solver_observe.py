@@ -108,7 +108,61 @@ def test_occupancy_and_transfer_accounting():
     assert occ["batches"] == 2
     assert occ["last_batch"]["occupancy"] == round(80 / 4096, 4)
     assert occ["last_batch"]["pad_waste"] == round(1 - 80 / 4096, 4)
-    assert snap["transfers"] == {"h2d_bytes": 1000, "d2h_bytes": 300}
+    assert snap["transfers"] == {
+        "h2d_bytes": 1000,
+        "d2h_bytes": 300,
+        "allgather_bytes": 0,
+        "scatter_bytes": 0,
+    }
+    obs.record_transfer("allgather", 512)
+    obs.record_transfer("scatter", 64)
+    snap = obs.snapshot(sample=False)
+    assert snap["transfers"]["allgather_bytes"] == 512
+    assert snap["transfers"]["scatter_bytes"] == 64
+
+
+def test_solver_status_renders_shard_table():
+    """A node-sharded dispatch's per-shard occupancy renders as a table
+    in `operator solver status`, with the allgather/scatter columns on
+    the transfer line (docs/sharding.md reading guide)."""
+    from nomad_tpu.cli.main import _render_solver_status
+
+    obs = SolverObservatory()
+    obs.record_shards(8, [
+        {
+            "shard": i, "rows": 32,
+            "real_rows": 32 if i < 7 else 10,
+            "occupancy": 1.0 if i < 7 else 0.3125,
+        }
+        for i in range(8)
+    ])
+    obs.record_transfer("allgather", 4096)
+    obs.record_transfer("scatter", 64)
+    out = _render_solver_status(obs.snapshot(sample=False))
+    assert "Mesh" in out and "8 devices" in out
+    assert "SHARD" in out and "OCCUPANCY" in out
+    assert "31.2%" in out  # the imbalanced tail shard is readable
+    assert "allgather" in out and "scatter" in out
+
+
+def test_record_shards_bounded_and_disabled_noop():
+    obs = SolverObservatory()
+    obs.record_shards(128, [{"shard": i, "occupancy": 1.0}
+                           for i in range(128)])
+    snap = obs.snapshot(sample=False)
+    assert snap["sharding"]["devices"] == 128
+    assert len(snap["sharding"]["last_shards"]) == 64  # bounded
+    fresh = SolverObservatory()
+    old = solverobs._install(fresh)
+    try:
+        solverobs.set_enabled(False)
+        solverobs.record_shards(8, [{"shard": 0, "occupancy": 1.0}])
+        assert (
+            solverobs.snapshot(sample=False)["sharding"]["devices"] == 0
+        )
+    finally:
+        solverobs.set_enabled(True)
+        solverobs._install(old)
 
 
 def test_compile_and_transfer_spans_on_live_trace():
@@ -311,6 +365,98 @@ def _c2m_jobs(prefix: str, n_jobs: int = 12):
         job.spreads = [Spread(attribute="${node.datacenter}", weight=50)]
         jobs.append(job)
     return jobs
+
+
+@pytest.mark.multichip
+def test_e2e_worker_mesh_path_sharded_observability(tmp_path, monkeypatch):
+    """The production wiring end to end: NOMAD_TPU_MESH_DEVICES=8 makes
+    the agent's TPU batch worker build the SolverMesh and a sharded
+    ResidentClusterState lazily at its first solve; two waves through
+    the REAL worker must place, ledger the sharded compact kernel, and
+    expose per-shard occupancy + allgather bytes at /v1/solver/status —
+    the 'diagnosable from operator solver status' contract."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api.client import NomadClient
+    from nomad_tpu.structs.node_class import compute_node_class
+
+    monkeypatch.setenv("NOMAD_TPU_MESH_DEVICES", "8")
+    old_reg = metrics._install_registry(Registry())
+    old_obs = solverobs._install(SolverObservatory())
+    cfg = AgentConfig(
+        server_enabled=True,
+        dev_mode=True,
+        use_tpu_batch_worker=True,
+        data_dir=str(tmp_path / "agent"),
+    )
+    agent = Agent(cfg)
+    try:
+        agent.start()
+        srv = agent.server.server
+        for i in range(16):
+            n = mock.node()
+            n.datacenter = ["dc1", "dc2"][i % 2]
+            n.resources.cpu = 4000
+            n.resources.memory_mb = 8192
+            n.computed_class = compute_node_class(n)
+            srv.node_register(n)
+
+        def drive_wave(prefix):
+            jobs = _c2m_jobs(prefix)
+            for job in jobs:
+                srv.raft_apply("job_register", (job, None))
+            evals = [mock.eval_for_job(job) for job in jobs]
+            srv.eval_broker.enqueue_all(evals)
+            assert wait_until(
+                lambda: all(
+                    len(srv.state.allocs_by_job("default", j.id)) >= 10
+                    for j in jobs
+                ),
+                60,
+            ), f"wave {prefix} never placed"
+
+        api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
+        drive_wave("mesh-warm")
+        drive_wave("mesh-steady")
+        snap = api.agent.solver_status()
+        # the sharded compact kernel served the waves...
+        kernels = snap["ledger"]["kernels"]
+        assert any(k.startswith("sharded_solver_compact_d8")
+                   for k in kernels), kernels
+        # ...with the resident tensors placed per-shard (the worker's
+        # lazily-built sharded ResidentClusterState)
+        worker = srv.tpu_worker
+        assert worker._resident is not None
+        assert worker._resident.mesh is not None
+        assert worker._resident.mesh.n_dev == 8
+        # per-shard occupancy + mesh transfer directions on the surface
+        assert snap["sharding"]["devices"] == 8
+        assert len(snap["sharding"]["last_shards"]) == 8
+        assert snap["transfers"]["allgather_bytes"] > 0
+    finally:
+        agent.shutdown()
+        metrics._install_registry(old_reg)
+        solverobs._install(old_obs)
+
+
+@pytest.mark.multichip
+def test_worker_mesh_misconfig_degrades_to_single_chip():
+    """NOMAD_TPU_MESH_DEVICES beyond the backend's device count must
+    not wedge the solve loop (raise -> nack -> redeliver forever): the
+    worker logs the misconfig, clears mesh_devices, and builds a
+    single-chip resident so placement proceeds."""
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.server.worker import TPUBatchWorker
+
+    cfg = SchedulerConfig(backend="tpu", mesh_devices=1024)
+    worker = TPUBatchWorker(server=None, config=cfg)
+    worker._ensure_resident()
+    assert worker._resident is not None
+    assert worker._resident.mesh is None  # degraded, not sharded
+    assert cfg.mesh_devices == 0  # scheduler _mesh_for won't re-raise
+    # idempotent: a second solve keeps the built resident
+    resident = worker._resident
+    worker._ensure_resident()
+    assert worker._resident is resident
 
 
 def test_e2e_solver_observability_acceptance(tmp_path, capsys):
