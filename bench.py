@@ -983,6 +983,50 @@ def run_plan_apply_config():
     }
 
 
+class _MiniServer:
+    """Just enough server for a TPUBatchWorker: broker + queue + applier
+    + raft-backed state (the real Server wires identically). Shared by
+    the pipeline and smoke_interactive configs."""
+
+    def __init__(self, state):
+        from nomad_tpu.server.eval_broker import EvalBroker
+        from nomad_tpu.server.plan_apply import PlanApplier
+        from nomad_tpu.server.plan_queue import PlanQueue
+        from nomad_tpu.server.raft import FSM, InmemLog
+
+        self.state = state
+        self.fsm = FSM(state)
+        self.log = InmemLog(self.fsm, start_index=state.latest_index())
+        self.eval_broker = EvalBroker()
+        self.eval_broker.set_enabled(True)
+        self.plan_queue = PlanQueue()
+        self.plan_queue.set_enabled(True)
+        self.plan_applier = PlanApplier(
+            self.plan_queue, state, self.raft_apply, self.raft_apply_async
+        )
+        self.plan_applier.start()
+        # partial-commit retry evals must re-enqueue (the real Server's
+        # FSM side channel) or a worker could silently drop conflicted
+        # work and look faster than it is
+        self.fsm.on_eval_update = self._on_eval_update
+
+    def _on_eval_update(self, evals):
+        for ev in evals:
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+
+    def raft_apply(self, msg_type, payload):
+        return self.log.apply(msg_type, payload)
+
+    def raft_apply_async(self, msg_type, payload):
+        return self.log.apply_async(msg_type, payload)
+
+    def shutdown(self):
+        self.plan_applier.stop()
+        self.plan_queue.set_enabled(False)
+        self.eval_broker.set_enabled(False)
+
+
 def run_pipeline_config():
     """Solve/commit overlap proof (round-6 tentpole acceptance): with a
     simulated 0.15s device round-trip injected into every dense solve
@@ -995,10 +1039,6 @@ def run_pipeline_config():
     from nomad_tpu import mock
     from nomad_tpu.scheduler.context import SchedulerConfig
     from nomad_tpu.scheduler.tpu import solve_eval_batch
-    from nomad_tpu.server.eval_broker import EvalBroker
-    from nomad_tpu.server.plan_apply import PlanApplier
-    from nomad_tpu.server.plan_queue import PlanQueue
-    from nomad_tpu.server.raft import FSM, InmemLog
     from nomad_tpu.server.worker import TPUBatchWorker
 
     n_nodes = int(os.environ.get("BENCH_PIPE_NODES", "2000"))
@@ -1014,43 +1054,6 @@ def run_pipeline_config():
         f"[pipeline] {n_nodes} nodes, {n_jobs} jobs x {count} allocs, "
         f"batches of {batch_size}, injected device RTT {latency}s"
     )
-
-    class _MiniServer:
-        """Just enough server for the worker: broker + queue + applier +
-        raft-backed state (the real Server wires identically)."""
-
-        def __init__(self, state):
-            self.state = state
-            self.fsm = FSM(state)
-            self.log = InmemLog(self.fsm, start_index=state.latest_index())
-            self.eval_broker = EvalBroker()
-            self.eval_broker.set_enabled(True)
-            self.plan_queue = PlanQueue()
-            self.plan_queue.set_enabled(True)
-            self.plan_applier = PlanApplier(
-                self.plan_queue, state, self.raft_apply, self.raft_apply_async
-            )
-            self.plan_applier.start()
-            # partial-commit retry evals must re-enqueue (the real
-            # Server's FSM side channel) or the pipelined mode could
-            # silently drop conflicted work and look faster than it is
-            self.fsm.on_eval_update = self._on_eval_update
-
-        def _on_eval_update(self, evals):
-            for ev in evals:
-                if ev.should_enqueue():
-                    self.eval_broker.enqueue(ev)
-
-        def raft_apply(self, msg_type, payload):
-            return self.log.apply(msg_type, payload)
-
-        def raft_apply_async(self, msg_type, payload):
-            return self.log.apply_async(msg_type, payload)
-
-        def shutdown(self):
-            self.plan_applier.stop()
-            self.plan_queue.set_enabled(False)
-            self.eval_broker.set_enabled(False)
 
     def run_once(pipeline: bool) -> float:
         gc.collect()
@@ -1147,6 +1150,200 @@ def run_pipeline_config():
         "overlap_pair_ratios": [round(r, 3) for r in pair_ratios],
         "ideal_overlap_ratio": round(ideal, 3),
         "overlap_ge_1_3x": ok,
+    }
+
+
+# The r08 capture of record's smoke single-eval wall (1 / 220.38
+# evals/s, BENCH_r08.json): the basis of the smoke_interactive_p50 gate
+# — the interactive fast path must land a single eval in at most HALF
+# this, measured with the same solve+submit methodology.
+R08_SMOKE_EVAL_S = 1.0 / 220.38
+
+
+def run_smoke_interactive_config():
+    """Interactive single-eval latency, three views (ISSUE 15 tentpole
+    yardstick):
+
+      direct — N fresh-cluster single-eval passes (solve via the host
+        microsolve + plan submit), the SAME methodology the r08 smoke
+        capture used. Gate: p50 <= R08_SMOKE_EVAL_S / 2 — the "2x
+        single-eval latency" acceptance, apples to apples.
+      lane (unloaded) — the full worker stack (broker -> priority lane
+        -> microsolve -> plan applier -> raft), one eval at a time:
+        what a quiet cluster's `job register` actually pays end to end.
+      lane (loaded) — the same stack while a mega-batch stream (with
+        the modeled 0.15s device RTT) saturates the worker: the
+        priority lane must keep interactive p50 far below the batch
+        cadence. Gate: loaded interactive p50 <= 1/4 of the batch
+        lane's p50 — without the lane an interactive eval rides a mega
+        batch and pays exactly that batch p50.
+
+    The per-stage milliseconds (dispatch / micro / submit / commit
+    p50s) are published in remaining_ms_p50 — the round-12 profiler's
+    naming of where the interactive millisecond goes — and every
+    nomad.worker.lane.* counter lands in the payload."""
+    from nomad_tpu import metrics as _metrics
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.scheduler.tpu import ResidentClusterState, solve_eval_batch
+    from nomad_tpu.server.worker import TPUBatchWorker
+
+    direct_passes = int(os.environ.get("BENCH_IA_DIRECT", "30"))
+    lane_evals = int(os.environ.get("BENCH_IA_LANE", "30"))
+    loaded_probes = int(os.environ.get("BENCH_IA_LOADED", "16"))
+    latency = float(os.environ.get("BENCH_INJECT_LATENCY_S", "0.15"))
+    log(
+        f"[smoke_interactive] {direct_passes} direct passes, "
+        f"{lane_evals} unloaded + {loaded_probes} loaded lane evals, "
+        f"mega-batch RTT {latency}s"
+    )
+
+    def wait_live(h, job, want, deadline_s=30.0):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < deadline_s:
+            live = sum(
+                1
+                for a in h.state.allocs_by_job(job.namespace, job.id)
+                if not a.terminal_status()
+            )
+            if live >= want:
+                return True
+            time.sleep(0.0002)
+        return False
+
+    # -- direct: the r08-methodology single-eval wall -------------------
+    gc.collect()
+    direct = []
+    h, jobs = build_cluster(10, 1, 10, False)
+    resident = ResidentClusterState()
+    tpu_place(h, jobs, warm=False, resident=resident)  # warm caches
+    for i in range(direct_passes):
+        h = jobs = None
+        h, jobs = build_cluster(10, 1, 10, False)
+        resident = ResidentClusterState()
+        dt, _ = tpu_place(h, jobs, resident=resident)
+        direct.append(dt)
+    direct_p50 = median(direct)
+    micro_s = _metrics.snapshot()["samples"].get("nomad.tpu.micro_seconds")
+    direct_used_micro = bool(micro_s and micro_s.get("count"))
+
+    # -- lane, unloaded: full worker stack, one eval at a time ----------
+    h, jobs = build_cluster(10, 1, 10, False)
+    srv = _MiniServer(h.state)
+    worker = TPUBatchWorker(
+        srv, batch_size=8, config=SchedulerConfig(backend="tpu")
+    )
+    worker.start()
+    unloaded = []
+    ia_jobs = add_jobs(h, lane_evals, 1, False, priority=70,
+                       job_prefix="ia-quiet")
+    for job in ia_jobs:
+        t0 = time.perf_counter()
+        srv.eval_broker.enqueue(mock.eval_for_job(job))
+        ok = wait_live(h, job, 1)
+        unloaded.append(time.perf_counter() - t0)
+        if not ok:
+            log(f"[smoke_interactive] WARNING: {job.id} never placed")
+    worker.stop()
+    srv.shutdown()
+    unloaded_p50 = median(unloaded[2:] or unloaded)
+
+    # -- lane, loaded: interactive probes against a mega-batch stream --
+    gc.collect()
+    h, mega = build_cluster(400, 24, 100, False)
+    cfg = SchedulerConfig(backend="tpu", inject_device_latency_s=latency)
+    # warm the jit cache at the mega-batch shapes, un-measured
+    solve_eval_batch(
+        h.snapshot(), h,
+        [mock.eval_for_job(j) for j in mega[:8]],
+        SchedulerConfig(backend="tpu"),
+    )
+    srv = _MiniServer(h.state)
+    worker = TPUBatchWorker(srv, batch_size=8, config=cfg)
+    worker.start()
+    for job in mega:
+        srv.eval_broker.enqueue(mock.eval_for_job(job))
+    loaded = []
+    ia2 = add_jobs(h, loaded_probes, 2, False, priority=70,
+                   job_prefix="ia-loaded")
+    time.sleep(0.3)  # let the mega stream occupy the pipeline first
+    for job in ia2:
+        t0 = time.perf_counter()
+        srv.eval_broker.enqueue(mock.eval_for_job(job))
+        ok = wait_live(h, job, 2)
+        loaded.append(time.perf_counter() - t0)
+        if not ok:
+            log(f"[smoke_interactive] WARNING: {job.id} never placed")
+        time.sleep(0.05)
+    # drain the mega stream so the batch-lane histogram is complete
+    deadline = time.perf_counter() + 300
+    while time.perf_counter() < deadline:
+        done = all(
+            sum(
+                1
+                for a in h.state.allocs_by_job(j.namespace, j.id)
+                if not a.terminal_status()
+            ) >= 100
+            for j in mega
+        )
+        if done:
+            break
+        time.sleep(0.05)
+    worker.stop()
+    srv.shutdown()
+    loaded_p50 = median(loaded)
+
+    snap = _metrics.snapshot()
+    samples = snap["samples"]
+    counters = snap["counters"]
+    batch_s = samples.get("nomad.worker.lane.batch_seconds") or {}
+    batch_p50 = batch_s.get("p50")
+    # where the interactive millisecond goes (profiler/stage naming)
+    remaining = {}
+    for key, name in (
+        ("nomad.tpu.batch_dispatch_seconds", "dispatch"),
+        ("nomad.tpu.micro_seconds", "micro_solve"),
+        ("nomad.plan.submit_seconds", "plan_submit"),
+        ("nomad.tpu.commit_seconds", "commit"),
+        ("nomad.broker.wait_seconds", "broker_wait"),
+    ):
+        s = samples.get(key)
+        if s and s.get("count"):
+            remaining[name] = round(s["p50"] * 1e3, 3)
+    lanes = {
+        k.rsplit(".", 1)[1]: int(v)
+        for k, v in counters.items()
+        if k.startswith("nomad.worker.lane.")
+    }
+    p50_gate = direct_p50 <= R08_SMOKE_EVAL_S / 2
+    lane_gate = (
+        batch_p50 is not None and loaded_p50 <= 0.25 * batch_p50
+    )
+    log(
+        f"[smoke_interactive] direct p50 {direct_p50 * 1e3:.2f}ms (gate "
+        f"<= {R08_SMOKE_EVAL_S / 2 * 1e3:.2f}ms, pass={p50_gate}); lane "
+        f"unloaded p50 {unloaded_p50 * 1e3:.2f}ms; loaded p50 "
+        f"{loaded_p50 * 1e3:.2f}ms vs batch p50 "
+        f"{(batch_p50 or 0) * 1e3:.0f}ms (pass={lane_gate}); lanes "
+        f"{lanes}; remaining ms {remaining}"
+    )
+    return {
+        # headline: single evals per second at the direct p50
+        "tpu_evals_per_s": round(1.0 / max(direct_p50, 1e-9), 2),
+        "single_eval_p50_s": round(direct_p50, 6),
+        "single_eval_runs_ms": [round(d * 1e3, 3) for d in direct],
+        "single_eval_spread_pct": spread_pct(direct),
+        "r08_single_eval_s": round(R08_SMOKE_EVAL_S, 6),
+        "direct_used_micro": direct_used_micro,
+        "lane_unloaded_p50_s": round(unloaded_p50, 6),
+        "lane_loaded_p50_s": round(loaded_p50, 6),
+        "lane_loaded_runs_ms": [round(d * 1e3, 3) for d in loaded],
+        "batch_lane_p50_s": round(batch_p50, 6) if batch_p50 else None,
+        "lane_counters": lanes,
+        "remaining_ms_p50": remaining,
+        "injected_device_latency_s": latency,
+        "smoke_interactive_p50_ok": bool(p50_gate),
+        "smoke_interactive_lane_ok": bool(lane_gate),
     }
 
 
@@ -1549,8 +1746,8 @@ def main():
 
         _trace.configure(max_traces=256, enabled_=True)
     names = (
-        ["smoke", "c1k", "c2m", "c2m_sharded", "preempt", "drain",
-         "plan_apply", "pipeline", "soak"]
+        ["smoke", "smoke_interactive", "c1k", "c2m", "c2m_sharded",
+         "preempt", "drain", "plan_apply", "pipeline", "soak"]
         if sel == "all"
         else [sel]
     )
@@ -1589,6 +1786,8 @@ def main():
                 results[name] = _run_sharded_subprocess()
                 continue
             results[name] = run_c2m_sharded_config()
+        elif name == "smoke_interactive":
+            results[name] = run_smoke_interactive_config()
         elif name == "preempt":
             results[name] = run_preempt_config()
         elif name == "drain":
@@ -1626,6 +1825,16 @@ def main():
             )
         if "overlap_ge_1_3x" in r:
             gates[f"{cname}_overlap_1_3x"] = bool(r["overlap_ge_1_3x"])
+        # interactive fast-path gates (ISSUE 15): single-eval p50 at
+        # most half the r08 capture's, and the priority lane keeping
+        # loaded interactive latency far under the mega-batch cadence
+        if "smoke_interactive_p50_ok" in r:
+            gates["smoke_interactive_p50"] = bool(
+                r["smoke_interactive_p50_ok"]
+            )
+            gates["smoke_interactive_lane"] = bool(
+                r["smoke_interactive_lane_ok"]
+            )
         # recompile-bound regression guard (shape-bucketing contract,
         # kernels.py): after the warmup pass, steady-state batches in
         # the smoke and c2m configs must trigger ZERO compiles

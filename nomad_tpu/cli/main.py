@@ -2061,7 +2061,10 @@ _TOP_STAGE_ORDER = [
     "nomad.broker.wait_seconds",
     "nomad.worker.invoke_seconds.service",
     "nomad.worker.invoke_seconds.batch",
+    "nomad.worker.lane.interactive_seconds",
+    "nomad.worker.lane.batch_seconds",
     "nomad.tpu.batch_dispatch_seconds",
+    "nomad.tpu.micro_seconds",
     "nomad.tpu.host_prep_seconds",
     "nomad.tpu.device_seconds",
     "nomad.tpu.readback_seconds",
@@ -2152,6 +2155,35 @@ def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
             + (
                 f"   backpressure {bp_level * 100:.0f}%"
                 if bp_level is not None
+                else ""
+            )
+        )
+    # priority-lane panel (the interactive fast path, docs/pipeline.md):
+    # rendered once the TPU worker has classified anything — lane
+    # counters plus the two lanes' p50s side by side, so lane starvation
+    # (interactive p50 drifting toward the batch cadence) reads straight
+    # off the dashboard (docs/operations.md § Diagnosing a slow
+    # interactive eval).
+    ia_n = int(counters.get("nomad.worker.lane.interactive", 0))
+    if ia_n:
+        ia_s = samples.get("nomad.worker.lane.interactive_seconds") or {}
+        b_s = samples.get("nomad.worker.lane.batch_seconds") or {}
+        micro_n = int(counters.get("nomad.worker.lane.micro", 0))
+        preempted = int(
+            counters.get("nomad.worker.lane.drain_preempted", 0)
+        )
+        lines.append(
+            f"Lanes       interactive {ia_n}"
+            + (
+                f" (p50 {_fmt_dur(ia_s['p50'])})"
+                if ia_s.get("count") and "p50" in ia_s
+                else ""
+            )
+            + f"   micro {micro_n}"
+            + f"   drain preempted {preempted}"
+            + (
+                f"   batch p50 {_fmt_dur(b_s['p50'])}"
+                if b_s.get("count") and "p50" in b_s
                 else ""
             )
         )

@@ -41,8 +41,21 @@ class SchedulerConfig:
         inject_device_latency_s: Optional[float] = None,
         soa_placements: Optional[bool] = None,
         mesh_devices: Optional[int] = None,
+        micro_solve_threshold: Optional[int] = None,
     ) -> None:
         import os
+
+        # Host microsolve bound (the interactive fast path): a small
+        # batch whose node-count x group-count product is at or below
+        # this solves with the numpy compact kernel (scheduler/tpu/
+        # microsolve.py) — dense-path semantics, zero device round-trip.
+        # 0 disables (every small batch keeps the host iterator stack);
+        # NOMAD_TPU_MICRO_NG overrides.
+        if micro_solve_threshold is None:
+            micro_solve_threshold = int(
+                os.environ.get("NOMAD_TPU_MICRO_NG", "8192") or 0
+            )
+        self.micro_solve_threshold = micro_solve_threshold
 
         # Multi-chip: shard the solve's node axis over this many devices
         # (scheduler/tpu/sharding.py). 0 = single chip. The sharded

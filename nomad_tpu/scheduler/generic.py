@@ -261,7 +261,11 @@ class GenericScheduler:
                 old, "alloc not needed due to job update", ""
             )
             place_requests.append(req)
-        place_requests.extend(results.place)
+        # results.place may carry PlacementRun blocks (the reconcile
+        # minting fast path); the host loop below wants per-row requests
+        from .reconcile import iter_place_requests
+
+        place_requests.extend(iter_place_requests(results.place))
 
         if job is None or job.stopped():
             return True

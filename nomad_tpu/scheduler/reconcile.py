@@ -85,6 +85,62 @@ class PlacementRequest:
     job_override: Optional[Job] = None
 
 
+class PlacementRun:
+    """A contiguous run of identical placement requests sharing ONE
+    proto (the reconcile minting fast path): a fresh c2m fill is 10^5
+    requests differing only in `name`, and minting 10^5 dataclass
+    objects per eval was a named top-10 reconcile cost. The run stores
+    the shared proto plus the names column; the TPU path reads exactly
+    (count, names) — `_bucket_requests` passes a pure run through whole
+    and the lowered group / SoA fast-mint consume the names column
+    directly, so per-row request objects never exist on the fast path.
+    Row access (`run[i]`, iteration, slicing) mints rows lazily for the
+    paths that genuinely need them (the host stack, slow materialize,
+    unplaced leftovers) at the same per-row cost as before."""
+
+    __slots__ = ("proto", "names")
+
+    def __init__(self, proto: PlacementRequest, names: list[str]) -> None:
+        self.proto = proto
+        self.names = names
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def _row(self, name: str) -> PlacementRequest:
+        import dataclasses
+
+        return dataclasses.replace(self.proto, name=name)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            # slices stay runs: spread sub-group splits slice the fill
+            # and must not materialize rows to do it
+            return PlacementRun(self.proto, self.names[i])
+        return self._row(self.names[i])
+
+    def __iter__(self):
+        for nm in self.names:
+            yield self._row(nm)
+
+
+def iter_place_requests(seq):
+    """Flatten a results.place list whose elements may be PlacementRun
+    blocks into per-row requests (the host scheduler's shape)."""
+    for item in seq:
+        if isinstance(item, PlacementRun):
+            yield from item
+        else:
+            yield item
+
+
+def placement_rows(seq) -> int:
+    """Total request rows in a list that may hold PlacementRun blocks."""
+    return sum(
+        len(item) if isinstance(item, PlacementRun) else 1 for item in seq
+    )
+
+
 @dataclass
 class GroupSummary:
     place: int = 0
@@ -477,25 +533,28 @@ class AllocReconciler:
                 )
             )
         if existing < desired:
-            # the bulk fill (a fresh c2m job mints its whole count here):
-            # group-constant values hoisted out of the loop, name indexes
-            # claimed in one pass — this loop feeds the SoA fast-mint
-            # columns downstream, so its per-row cost IS the reconcile
-            # share of the per-alloc budget
+            # the bulk fill (a fresh c2m job mints its whole count
+            # here): ONE shared-proto PlacementRun instead of 10^5
+            # per-row request objects — the TPU path reads only
+            # (count, names) and the SoA fast-mint consumes the names
+            # column directly; rows materialize lazily on the host /
+            # leftover paths only
             ov = _downgrade_for(None)
             tg_ov = _tg_for(ov)
             prefix = f"{self.job_id}.{name}["
-            ap = place.append
-            for idx in name_index.next_n(desired - existing):
-                ap(
+            place.append(
+                PlacementRun(
                     PlacementRequest(
-                        name=f"{prefix}{idx}]",
-                        task_group=tg_ov,
-                        job_override=ov,
-                    )
+                        name="", task_group=tg_ov, job_override=ov
+                    ),
+                    [
+                        f"{prefix}{idx}]"
+                        for idx in name_index.next_n(desired - existing)
+                    ],
                 )
+            )
         if not existing_deployment and dstate is not None:
-            dstate.desired_total += len(place)
+            dstate.desired_total += placement_rows(place)
 
         deployment_place_ready = (
             not self.deployment_paused
@@ -504,16 +563,20 @@ class AllocReconciler:
         )
         if deployment_place_ready:
             self.results.place.extend(place)
-            summary.place += len(place)
+            n_place = placement_rows(place)
+            summary.place += n_place
             for a in resched_now:
                 self.results.stop.append((a, ALLOC_RESCHEDULED, ""))
                 summary.stop += 1
-            limit -= min(len(place), limit)
+            limit -= min(n_place, limit)
         else:
             # Paused/failed/canarying deployments still replace lost
             # allocs and reschedule failures (reference :477-505), except
             # failures belonging to the failed deployment itself.
             for req in place:
+                if isinstance(req, PlacementRun):
+                    # fresh-fill runs are never lost/reschedule rows
+                    continue
                 if req.lost:
                     self.results.place.append(req)
                     summary.place += 1

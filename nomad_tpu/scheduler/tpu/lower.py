@@ -454,9 +454,52 @@ def lower_group(
         bias=bias,
         units_cap=np.minimum(units_cap, BIG_UNITS).astype(np.int64),
         priority=job.priority,
-        names=[r.name for r in requests],
-        requests=list(requests),
+        names=request_names(requests),
+        requests=requests,
     )
+
+
+def request_names(requests) -> list[str]:
+    """The per-row names column without materializing rows: a
+    PlacementRun already holds it; plain lists walk their rows."""
+    names = getattr(requests, "names", None)
+    if names is not None:
+        return names
+    return [r.name for r in requests]
+
+
+def group_lower_cacheable(job: Job, tg: TaskGroup) -> bool:
+    """May this group's lowered tensors be cached across solves on the
+    (job version, node-universe fingerprint) key alone?
+
+    False whenever lowering reads state BEYOND the node fingerprint:
+    distinct_hosts / distinct_property (proposed-alloc and per-value
+    counts), spreads (existing-alloc counts feed the bias), volumes
+    (claim state), static ports (live port occupancy), and cores (the
+    free-core column is rebuilt per solve). Everything else — dc
+    membership, drivers, attribute constraints, affinities, bandwidth,
+    devices — is a pure function of (job spec, node objects), which the
+    fingerprint pins."""
+    constraints = list(job.constraints) + list(tg.constraints)
+    for task in tg.tasks:
+        constraints.extend(task.constraints)
+    if any(
+        c.operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY)
+        for c in constraints
+    ):
+        return False
+    if tg.spreads or job.spreads:
+        return False
+    if tg.volumes:
+        return False
+    if any(t.resources.cores > 0 for t in tg.tasks):
+        return False
+    net_asks = list(tg.networks) + [
+        a for t in tg.tasks for a in t.resources.networks
+    ]
+    if any(p.value for a in net_asks for p in a.reserved_ports):
+        return False
+    return True
 
 
 def _job_free_mask(ctx: EvalContext, table: NodeTable, job_id: str) -> np.ndarray:

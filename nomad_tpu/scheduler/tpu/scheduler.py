@@ -79,13 +79,30 @@ def _bucket_requests(job, place_requests):
     Requests arrive in contiguous per-group runs (the reconciler emits
     each group's fill as one block), so grouping walks RUNS, not rows —
     one key computation per run instead of 10^5 dict ops per c2m eval.
-    Output order (first-seen keys, original order within a key) is
-    identical to the old per-row setdefault walk."""
+    A reconcile-minted PlacementRun element (the shared-proto bulk fill)
+    is a run BY CONSTRUCTION: when it is a bucket's only content it
+    passes through whole, so the lowered group and the SoA fast-mint
+    read its (count, names) without per-row request objects ever
+    existing; a bucket mixing a run with plain rows (reschedules of the
+    same group) materializes the run's rows, the pre-run shape. Output
+    order (first-seen keys, original order within a key) is identical
+    to the old per-row setdefault walk."""
+    from ..reconcile import PlacementRun
+
     by_group: dict[tuple, list] = {}
     jobs: dict[tuple, object] = {}
     i, n = 0, len(place_requests)
     while i < n:
         req = place_requests[i]
+        if isinstance(req, PlacementRun):
+            proto = req.proto
+            pjob = proto.job_override if proto.job_override is not None \
+                else job
+            key = (proto.task_group.name, pjob.version)
+            by_group.setdefault(key, []).append(req)
+            jobs[key] = pjob
+            i += 1
+            continue
         pjob = req.job_override if req.job_override is not None else job
         key = (req.task_group.name, pjob.version)
         j = i + 1
@@ -95,15 +112,29 @@ def _bucket_requests(job, place_requests):
             nxt = place_requests[j]
             # identity continuation: a run shares its TaskGroup and
             # override objects; equal-key runs split here re-merge below
-            if nxt.task_group is not tg0 or nxt.job_override is not ov0:
+            if (
+                isinstance(nxt, PlacementRun)
+                or nxt.task_group is not tg0
+                or nxt.job_override is not ov0
+            ):
                 break
             j += 1
         by_group.setdefault(key, []).extend(place_requests[i:j])
         jobs[key] = pjob
         i = j
-    return [
-        (jobs[key], key[0], reqs) for key, reqs in by_group.items()
-    ]
+    out = []
+    for key, pieces in by_group.items():
+        if len(pieces) == 1 and isinstance(pieces[0], PlacementRun):
+            reqs = pieces[0]  # pure run: pass the block through whole
+        else:
+            reqs = []
+            for p in pieces:
+                if isinstance(p, PlacementRun):
+                    reqs.extend(p)  # mixed bucket: rows materialize
+                else:
+                    reqs.append(p)
+        out.append((jobs[key], key[0], reqs))
+    return out
 
 
 class TPUGenericScheduler(GenericScheduler):
@@ -307,6 +338,12 @@ class PendingEvalBatch:
         return self._solver.chain_out
 
     @property
+    def used_micro(self) -> bool:
+        """Did this solve run the host microsolve kernel? (zero device
+        round-trip; the worker's lane telemetry reads it)."""
+        return self._solver.used_micro
+
+    @property
     def chain_accepted(self) -> bool:
         """Did this solve actually consume the used_chain it was given?
         False when the host path ran, resident tensors won, or the chain
@@ -376,11 +413,16 @@ def solve_eval_batch_begin(
     solve_preempt_fn=None,
     resident=None,
     used_chain=None,
+    extra_usage=None,
 ) -> PendingEvalBatch:
     """Phase A of solve_eval_batch: reconcile + lower + async device
     dispatch. Returns a PendingEvalBatch; call finish() for the plans.
     used_chain — the previous (still-uncommitted) batch's
-    PendingEvalBatch.chain, so this solve sees its placements."""
+    PendingEvalBatch.chain, so this solve sees its placements.
+    extra_usage — per-node (cpu, mem, disk) usage deltas beyond the
+    snapshot (the worker's interactive-lane ledger), counted by the
+    aggregate fast path so a chained solve stays conflict-free with
+    lane placements the chain tensor never saw."""
     config = config or SchedulerConfig()
     with paused_gc():
         t0 = time.monotonic_ns()
@@ -396,6 +438,7 @@ def solve_eval_batch_begin(
             state, config, solve_fn=solve_fn,
             solve_preempt_fn=solve_preempt_fn, resident=resident,
             used_chain=used_chain, mesh=_mesh_for(config, solve_fn),
+            extra_usage=extra_usage,
         )
         pending = solver.solve_begin(asks)
     return PendingEvalBatch(

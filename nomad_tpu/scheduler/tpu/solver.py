@@ -153,8 +153,57 @@ class ResidentClusterState:
         # the previous solve's returned table, held one solve gap so
         # the skeleton can harvest its lazily-built SoA columns
         self._last_table = None
+        # warm eval-context caches (the interactive fast path): ready
+        # node lists per dc set, keyed by the nodes-table index, and
+        # lowered-group skeletons (feasibility/bias/unit-cap tensors)
+        # keyed by (job identity, tg) against the host-table fingerprint
+        # — a repeat-shaped eval skips both the node scan and the
+        # lowering entirely.
+        self._node_cache: dict[tuple, tuple] = {}
+        self._lowered: dict[tuple, tuple] = {}
         # telemetry: how the last sync was satisfied
         self.last_sync = "cold"
+
+    def ready_nodes(self, state, datacenters: tuple):
+        """Cached ready_nodes_in_dcs keyed by (dc glob set, nodes-table
+        index). The nodes-table index moves on any node register /
+        status / drain write — exactly the events that change ready-node
+        membership — and alloc/usage writes leave it alone, so a warm
+        entry survives steady-state scheduling traffic untouched."""
+        from ..util import ready_nodes_in_dcs
+
+        idx_fn = getattr(state, "nodes_table_index", None)
+        if idx_fn is None:
+            return ready_nodes_in_dcs(state, list(datacenters))
+        idx = idx_fn()
+        entry = self._node_cache.get(datacenters)
+        if entry is not None and entry[0] == idx:
+            return entry[1], entry[2]
+        nodes, counts = ready_nodes_in_dcs(state, list(datacenters))
+        if len(self._node_cache) > 64:
+            self._node_cache.clear()
+        self._node_cache[datacenters] = (idx, nodes, counts)
+        return nodes, counts
+
+    def lowered_skeleton(self, vers, job, tg_name: str):
+        """Cached (ask, feasible, bias, units_cap) for one task group
+        against the host-table fingerprint `vers` (identity compare:
+        host_table interns one tuple per node-universe generation).
+        Arrays are shared read-only — every consumer (dedupe, spread
+        splits, the micro kernel) copies before mutating."""
+        key = (job.namespace, job.id, job.version, job.modify_index,
+               tg_name)
+        entry = self._lowered.get(key)
+        if entry is not None and entry[0] is vers:
+            return entry[1]
+        return None
+
+    def store_lowered(self, vers, job, tg_name: str, tensors) -> None:
+        if len(self._lowered) > 256:
+            self._lowered.clear()
+        self._lowered[
+            (job.namespace, job.id, job.version, job.modify_index, tg_name)
+        ] = (vers, tensors)
 
     def host_table(self, nodes: list, allocs_by_node, usage_of):
         """Cached build_node_table for the usage-aggregate path.
@@ -502,7 +551,7 @@ class BatchSolver:
                  solve_fn=None, solve_preempt_fn=None,
                  resident: Optional[ResidentClusterState] = None,
                  used_chain: Optional[tuple] = None,
-                 mesh=None) -> None:
+                 mesh=None, extra_usage: Optional[dict] = None) -> None:
         self.state = state
         self.config = config or SchedulerConfig()
         # Multi-chip: a sharding.SolverMesh routes the dense solve
@@ -517,6 +566,21 @@ class BatchSolver:
         # Device-resident cap/used tensors shared across solves (the
         # server's TPU worker owns one instance); None = upload per solve.
         self.resident = resident
+        # Per-node (cpu, mem, disk) usage DELTAS external to this
+        # snapshot that the aggregate fast path must still count — the
+        # worker's interactive-lane ledger: placements a priority-lane
+        # eval committed after the chain basis, which neither the
+        # chained used' tensor nor (for in-flight ones) the committed
+        # aggregate carries. Applied only on the usage-aggregate path
+        # (the host stack path coordinates through plans instead).
+        self.extra_usage = extra_usage
+        # set when the solve ran the host microsolve kernel: zero device
+        # involvement, chain neither consumed nor produced
+        self.used_micro = False
+        # host-table fingerprint token for the lowered-skeleton cache
+        # (set when the resident host-table path produced this solve's
+        # table; None disables the cache for the solve)
+        self._lower_vers = None
         # (node_ids tuple, used_dev) — the PREVIOUS batch's post-solve
         # usage tensor, still on device. While that batch's commit is in
         # flight, the committed aggregate hasn't caught up, so a
@@ -636,8 +700,16 @@ class BatchSolver:
         # topology logic must not be bypassed; preference degrades to
         # none there).
         if self.solve_fn is solve_placement:
+            from ..reconcile import PlacementRun
+
             sticky_idx = set()
             for i, ask in enumerate(asks):
+                if isinstance(ask.requests, PlacementRun):
+                    # shared-proto fresh fills carry no previous alloc
+                    # or penalty node by construction — and iterating
+                    # the run here would mint every row it exists to
+                    # avoid
+                    continue
                 tg = ask.job.lookup_task_group(ask.tg_name)
                 sticky = (
                     tg is not None
@@ -673,19 +745,24 @@ class BatchSolver:
         # A custom solve_fn (e.g. the mesh-sharded solver) must never be
         # silently bypassed — the fast path exists for the default kernel's
         # device round-trip only (same precedent as the compact path).
-        if (
+        small = (
             total_requests <= self.config.small_batch_threshold
             and self.solve_fn is solve_placement
-        ):
-            from ... import metrics
-
-            t0 = now_ns()
-            out = self._solve_host(asks)
-            out.solve_ns = now_ns() - t0
-            metrics.time_ns("nomad.tpu.solve_seconds", out.solve_ns)
-            metrics.observe("nomad.tpu.small_batch_requests", total_requests)
-            trace.stage("host_solve", out.solve_ns)
-            return out
+        )
+        # Small batches prefer the MICROSOLVE: the dense pipeline with
+        # the numpy kernel (microsolve.py) — zero device round-trip,
+        # shared lowering/materialization semantics. Ineligible shapes
+        # (cores asks, a preemption-capable batch, a sharded mesh, or a
+        # node universe past the n·g threshold) fall back to the host
+        # iterator stack exactly as before.
+        micro_wanted = (
+            small
+            and self.mesh is None
+            and self.config.micro_solve_threshold > 0
+            and not self._batch_has_cores
+        )
+        if small and not micro_wanted:
+            return self._solve_host_timed(asks, total_requests)
         # Priority order: higher-priority jobs consume capacity first
         # (mirrors the eval broker's priority dequeue).
         asks = sorted(asks, key=lambda a: -a.job.priority)
@@ -698,9 +775,16 @@ class BatchSolver:
         for ask in asks:
             key = tuple(ask.job.datacenters)
             if key not in dc_cache:
-                dc_cache[key] = ready_nodes_in_dcs(
-                    self.state, ask.job.datacenters
-                )[0]
+                if self.resident is not None:
+                    # warm node-list cache keyed by the nodes-table
+                    # index (ResidentClusterState.ready_nodes)
+                    dc_cache[key] = self.resident.ready_nodes(
+                        self.state, key
+                    )[0]
+                else:
+                    dc_cache[key] = ready_nodes_in_dcs(
+                        self.state, ask.job.datacenters
+                    )[0]
         if len(dc_cache) == 1:
             nodes = next(iter(dc_cache.values()))
         else:
@@ -768,6 +852,10 @@ class BatchSolver:
             preempt_possible = any(
                 maxprio - p >= PRIORITY_DELTA for p in tiers
             )
+        if micro_wanted and preempt_possible:
+            # preemption needs the tier kernel (or the host stack's
+            # per-request evict pass) — keep the host path for it
+            return self._solve_host_timed(asks, total_requests)
         usage_of = None
         if (
             not self._batch_has_cores
@@ -792,6 +880,18 @@ class BatchSolver:
                     )
             for a in self._partition_placed:
                 _adjust(a.node_id, a.comparable_resources(), +1)
+            if self.extra_usage:
+                # interactive-lane ledger (worker.py): placements the
+                # priority lane committed past the chain basis — deltas,
+                # so they compose with both the set-scatter and the
+                # chained-add paths below
+                for nid, vec in self.extra_usage.items():
+                    d = adj.get(nid)
+                    if d is None:
+                        d = adj[nid] = [0, 0, 0]
+                    d[0] += vec[0]
+                    d[1] += vec[1]
+                    d[2] += vec[2]
             state_usage = self.state.node_usage
             if adj:
 
@@ -809,6 +909,9 @@ class BatchSolver:
             # cross-solve host-table cache: same fingerprint discipline
             # as the resident device tensors (ResidentClusterState)
             table = self.resident.host_table(nodes, live_allocs, usage_of)
+            # lowered-skeleton cache rides the same fingerprint: valid
+            # only for tables produced by this generation's skeleton
+            self._lower_vers = self.resident._host_vers
         else:
             table = build_node_table(nodes, live_allocs, usage_of=usage_of)
 
@@ -819,9 +922,7 @@ class BatchSolver:
             if tg is None or not ask.requests:
                 continue
             self.ctx.plan = ask.plan  # plan-aware distinct/property masks
-            grp = lower_group(
-                self.ctx, table, ask.job, tg, ask.requests, ask.eval_obj.id
-            )
+            grp = self._lower_group_cached(table, ask, tg)
             for sub in self._split_for_spread(table, ask.job, tg, grp):
                 base_of[len(groups)] = grp
                 groups.append(sub)
@@ -845,6 +946,18 @@ class BatchSolver:
         # same [G, maxC] instance list); only the preemption kernels and
         # custom solve_fns return the dense [G, N] assignment.
         compact = not use_preempt and self.solve_fn is solve_placement
+        # Microsolve verdict (the interactive fast path): the numpy
+        # kernel replaces the device dispatch when the problem is tiny.
+        # Past the n·g bound the batch keeps its historical host-stack
+        # route — the lowering work above is wasted once, on the rare
+        # small-requests-huge-cluster shape.
+        micro = (
+            micro_wanted
+            and compact
+            and n * len(groups) <= self.config.micro_solve_threshold
+        )
+        if micro_wanted and not micro:
+            return self._solve_host_timed(asks, total_requests)
 
         t0 = now_ns()
         # Resident device tensors: valid only when the usage-aggregate
@@ -852,9 +965,10 @@ class BatchSolver:
         # aggregate) — the batch adjustments are scattered onto a
         # non-donated copy so the resident buffer stays committed-state.
         # On a mesh the resident tensors are placed per-shard
-        # (ResidentClusterState.mesh).
+        # (ResidentClusterState.mesh). A micro solve skips all of it:
+        # the table's host arrays already carry the aggregate + adj.
         dev_state = None
-        if compact and usage_of is not None:
+        if compact and usage_of is not None and not micro:
             shard_tag = self.mesh.n_dev if self.mesh is not None else 0
             chain_used = None
             if self.used_chain is not None:
@@ -917,7 +1031,14 @@ class BatchSolver:
                     )
                 dev_state = (None, used_dev)
                 self.chain_accepted = True
-        if compact:
+        if micro:
+            inst, over, used_out = self._run_micro(
+                table, groups, used, total_requests
+            )
+            # no chain_out: the micro result is host-known and commits
+            # ahead of any in-flight mega-batch; conflict-freedom for
+            # followers rides the worker's interactive ledger instead
+        elif compact:
             pending = self._run_compact_async(
                 table, groups, used, dev_state=dev_state
             )
@@ -939,11 +1060,17 @@ class BatchSolver:
         # it back. The pipelined worker parks here and resumes on its
         # commit stage, so the device round-trip (and everything below)
         # overlaps the NEXT batch's dequeue/reconcile/lower/dispatch.
+        # A MICRO solve never parks: the result is already on the host,
+        # so the whole solve completes in phase A and the worker's
+        # commit stage has nothing to wait on (PendingSolve finishes
+        # without a generator hop).
         phase_a_ns = now_ns() - t0
-        yield
+        if not micro:
+            yield
         t0 = now_ns()
         if compact:
-            inst, over, used_out = self._run_compact_finish(pending)
+            if not micro:
+                inst, over, used_out = self._run_compact_finish(pending)
             free_base = table.cap - table.used
             t_mat0 = now_ns()
             leftovers = self._materialize_compact(
@@ -984,7 +1111,16 @@ class BatchSolver:
             # prefix tensors describe pre-solve usage and a second
             # preemption pass could double-claim the same victims.
             used2 = np.asarray(used_out)[:n]
-            if compact:
+            if micro:
+                inst2, over2, used_retry = self._run_micro(
+                    table, retry, used2, sum(g.count for g in retry)
+                )
+                t_mat0 = now_ns()
+                leftovers2 = self._materialize_compact(
+                    table, retry, inst2, over2, table.cap - used2
+                )
+                mat_ns += now_ns() - t_mat0
+            elif compact:
                 inst2, over2, used_retry = self._run_compact(
                     table, retry, used2
                 )
@@ -1032,6 +1168,100 @@ class BatchSolver:
         trace.stage("materialize", mat_ns)
         metrics.observe("nomad.tpu.solve_groups", out.groups)
         return out
+
+    def _solve_host_timed(self, asks: list[GroupAsk],
+                          total_requests: int) -> SolveOutcome:
+        """The host-stack fast path with its historical telemetry."""
+        from ... import metrics
+
+        t0 = now_ns()
+        out = self._solve_host(asks)
+        out.solve_ns = now_ns() - t0
+        metrics.time_ns("nomad.tpu.solve_seconds", out.solve_ns)
+        metrics.observe("nomad.tpu.small_batch_requests", total_requests)
+        trace.stage("host_solve", out.solve_ns)
+        return out
+
+    def _run_micro(self, table, groups: list[LoweredGroup], used_n,
+                   total_requests: int):
+        """Host microsolve dispatch: the numpy compact kernel over the
+        UNPADDED table arrays — same readback contract as
+        _run_compact_finish ((inst [G, maxC], over [N], used' [N, 3])),
+        zero device involvement, zero jit signatures. The instance width
+        is the groups' raw count bound (no pad_c bucketing: nothing is
+        transferred, so width stability buys nothing)."""
+        from ... import metrics
+        from .microsolve import solve_placement_compact_micro
+
+        t0 = now_ns()
+        self.used_micro = True
+        n = table.n
+        maxc = max(1, max(int(grp.count) for grp in groups)) if groups \
+            else 1
+        inst, over, used_out = solve_placement_compact_micro(
+            table.cap,
+            np.asarray(used_n)[:n],
+            [
+                (
+                    np.asarray(grp.ask, dtype=np.int64),
+                    int(grp.count),
+                    grp.feasible,
+                    grp.bias,
+                    np.asarray(grp.units_cap, dtype=np.int64),
+                )
+                for grp in groups
+            ],
+            maxc,
+        )
+        micro_ns = now_ns() - t0
+        metrics.time_ns("nomad.tpu.micro_seconds", micro_ns)
+        metrics.observe("nomad.tpu.micro_batch_requests", total_requests)
+        trace.stage("micro_solve", micro_ns)
+        return inst, over, used_out
+
+    def _lower_group_cached(self, table, ask: GroupAsk, tg) -> LoweredGroup:
+        """lower_group through the warm lowered-skeleton cache: a
+        repeat-shaped eval (same job version, same node universe) reuses
+        the feasibility/bias/unit-cap tensors instead of re-lowering.
+        Only state-independent groups cache (lower.group_lower_cacheable
+        — no distinct_* constraints, spreads, volumes, static ports, or
+        cores, whose masks read live state beyond the fingerprint)."""
+        from .lower import group_lower_cacheable
+
+        res = self.resident
+        vers = self._lower_vers
+        if res is None or vers is None:
+            return lower_group(
+                self.ctx, table, ask.job, tg, ask.requests, ask.eval_obj.id
+            )
+        cached = res.lowered_skeleton(vers, ask.job, tg.name)
+        if cached is not None:
+            from .lower import request_names
+
+            ask_vec, feas, bias, ucap = cached
+            reqs = ask.requests
+            return LoweredGroup(
+                key=(ask.eval_obj.id, tg.name),
+                job=ask.job,
+                tg=tg,
+                count=len(reqs),
+                ask=ask_vec,
+                feasible=feas,
+                bias=bias,
+                units_cap=ucap,
+                priority=ask.job.priority,
+                names=request_names(reqs),
+                requests=reqs,
+            )
+        grp = lower_group(
+            self.ctx, table, ask.job, tg, ask.requests, ask.eval_obj.id
+        )
+        if group_lower_cacheable(ask.job, tg):
+            res.store_lowered(
+                vers, ask.job, tg.name,
+                (grp.ask, grp.feasible, grp.bias, grp.units_cap),
+            )
+        return grp
 
     def _solve_host(self, asks: list[GroupAsk]) -> SolveOutcome:
         """Small-batch fast path (VERDICT r3 #3): below the threshold the
@@ -1630,15 +1860,19 @@ class BatchSolver:
         if not spreads:
             return [grp]
         s = max(spreads, key=lambda x: x.weight)
+        from .lower import request_names
+
         codes, values, exists = table.attr_codes(s.attribute)
         counts_v = _property_counts(self.ctx, table, job, s.attribute, tg.name)
         desired = _spread_desired(s, values, tg.count)
         quotas = np.maximum(0, desired - counts_v).astype(np.int64)
-        reqs = list(grp.requests)
+        # slicing (not list()-ing) keeps PlacementRun fills as runs —
+        # the sub-groups' rows never materialize on the fast path
+        reqs = grp.requests
         out: list[LoweredGroup] = []
         order = np.argsort(-(quotas / np.maximum(desired, 1)))
         for vi in order:
-            if not reqs:
+            if not len(reqs):
                 break
             take = min(int(quotas[vi]), len(reqs))
             if take <= 0:
@@ -1649,17 +1883,17 @@ class BatchSolver:
                     grp,
                     count=take,
                     feasible=grp.feasible & (codes == vi) & exists,
-                    names=[r.name for r in sub_reqs],
+                    names=request_names(sub_reqs),
                     requests=sub_reqs,
                     restricted=True,
                 )
             )
-        if reqs:
+        if len(reqs):
             out.append(
                 dataclasses.replace(
                     grp,
                     count=len(reqs),
-                    names=[r.name for r in reqs],
+                    names=request_names(reqs),
                     requests=reqs,
                 )
             )

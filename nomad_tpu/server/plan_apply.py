@@ -740,15 +740,93 @@ class PlanApplier:
 
     # -- merged batch path ----------------------------------------------
 
+    @staticmethod
+    def _trim_duplicate_mints(
+        results: list[PlanResult], seen: set, snapshot
+    ) -> int:
+        """Same-eval/same-alloc-name dedup across one merged commit.
+
+        The r15/r17 soak duplicate-alloc forensics proved both duplicate
+        ids are minted by the SAME eval inside ONE merged plan-apply
+        raft entry (apply_plan_results_batch — same create_index): an
+        eval solved twice with both outcomes landing in one round, or
+        one plan carrying a name twice across its eager rows and SoA
+        batches. Per-node capacity verification cannot catch it — two
+        ids for one (eval, name) are not a capacity violation — so the
+        merge round guards the identity invariant itself: the FIRST
+        entrant in commit order keeps the name, every later entrant is
+        trimmed before the raft apply. A trimmed result gets a
+        refresh_index, so its worker sees a partial commit and requeues
+        the eval, which then re-reconciles against state that already
+        holds the first entrant. ``seen`` spans the whole batch (all
+        rounds), so a round-2 re-mint of a round-1 name trims too."""
+        trimmed = 0
+        for result in results:
+            hit = False
+            for nid, allocs in list(result.node_allocation.items()):
+                keep = []
+                for a in allocs:
+                    if a.create_index:
+                        # an UPDATE of an existing alloc (inplace,
+                        # attr annotation) keeps its original minting
+                        # eval_id/name — it is not a mint and two plans
+                        # touching it in one batch are last-writer-wins,
+                        # not duplicates
+                        keep.append(a)
+                        continue
+                    key = (a.eval_id, a.name)
+                    if key in seen:
+                        trimmed += 1
+                        hit = True
+                        continue
+                    seen.add(key)
+                    keep.append(a)
+                if len(keep) != len(allocs):
+                    if keep:
+                        result.node_allocation[nid] = keep
+                    else:
+                        del result.node_allocation[nid]
+            if result.alloc_batches:
+                new_batches = []
+                for b in result.alloc_batches:
+                    mask = np.ones(len(b), dtype=bool)
+                    for ri, name in enumerate(b.names):
+                        key = (b.eval_id, name)
+                        if key in seen:
+                            mask[ri] = False
+                            trimmed += 1
+                            hit = True
+                        else:
+                            seen.add(key)
+                    if mask.all():
+                        new_batches.append(b)
+                    elif mask.any():
+                        new_batches.append(b.take(mask))
+                result.alloc_batches = new_batches
+            if hit:
+                result.refresh_index = max(
+                    result.refresh_index, snapshot.index
+                )
+        if trimmed:
+            from .. import metrics
+
+            metrics.incr("nomad.plan_apply.dup_mint_trimmed", trimmed)
+            logger.warning(
+                "merged plan round minted %d duplicate (eval, name) "
+                "alloc(s); trimmed the later entrant(s)", trimmed,
+            )
+        return trimmed
+
     def _commit_merged(
         self, plans: list[Plan], merged_idx: list[int], snapshot,
-        tref=None, round_no: int = 0,
+        tref=None, round_no: int = 0, seen_mints: Optional[set] = None,
     ) -> dict[int, PlanResult]:
         """Verify the merged (node-disjoint) subset against one snapshot
         and commit every non-no-op result as ONE raft entry backed by one
         bulk store transaction."""
         tctx, tparent = tref if tref is not None else (None, None)
         results: dict[int, PlanResult] = {}
+        verified: list[tuple[int, PlanResult]] = []
         to_commit: list[tuple[int, PlanResult]] = []
         with paused_gc():
             with trace.span(
@@ -760,9 +838,22 @@ class PlanApplier:
                     if result.is_no_op():
                         results[i] = result
                         continue
-                    result.preemption_evals = self._preemption_evals(result)
-                    self._normalize(plans[i], result)
-                    to_commit.append((i, result))
+                    verified.append((i, result))
+            # identity guard BEFORE preemption evals / normalization: a
+            # trimmed row must not leave its preemption or job wiring
+            # behind (satellite: the r15/r17 duplicate-alloc race)
+            self._trim_duplicate_mints(
+                [r for _, r in verified],
+                seen_mints if seen_mints is not None else set(),
+                snapshot,
+            )
+            for i, result in verified:
+                if result.is_no_op():
+                    results[i] = result
+                    continue
+                result.preemption_evals = self._preemption_evals(result)
+                self._normalize(plans[i], result)
+                to_commit.append((i, result))
         if to_commit:
             with trace.span(
                 tctx, "plan.raft_apply", parent=tparent,
@@ -797,6 +888,9 @@ class PlanApplier:
         keys = [_plan_partition_key(p) for p in plans]
         merged_total = 0
         rounds = 0
+        # (eval_id, alloc name) minted anywhere in this batch — the
+        # duplicate-mint guard's memory across rounds
+        seen_mints: set = set()
         while remaining:
             rel_merged, rel_rest = partition_plan_batch(
                 [plans[i] for i in remaining],
@@ -809,7 +903,8 @@ class PlanApplier:
             round_idx = [remaining[r] for r in rel_merged]
             results.update(
                 self._commit_merged(
-                    plans, round_idx, snapshot, tref=tref, round_no=rounds
+                    plans, round_idx, snapshot, tref=tref,
+                    round_no=rounds, seen_mints=seen_mints,
                 )
             )
             merged_total += len(round_idx)

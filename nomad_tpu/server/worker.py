@@ -296,12 +296,39 @@ class TPUBatchWorker:
         batch_size: int = 64,
         config: Optional[SchedulerConfig] = None,
         pipeline: bool = True,
+        lane_priority: Optional[int] = None,
     ) -> None:
+        import os
+
         self.server = server
         self.schedulers = list(schedulers)
         self.batch_size = batch_size
         self.config = config or SchedulerConfig(backend="tpu")
         self.planner = WorkerPlanner(server)
+        # Interactive priority lane (docs/pipeline.md § Priority lanes):
+        # evals at or above this priority never wait for — or ride in —
+        # a mega-batch. They preempt the drain stage, solve alone
+        # (usually via the host microsolve), and commit inline on the
+        # solve thread, jumping ahead of the in-flight batch's commit.
+        # Mirrors the round-11 admission classification: the broker
+        # displaces strictly-below-priority work; the lane fast-paths
+        # strictly-above-default work. 0 disables the lane.
+        if lane_priority is None:
+            lane_priority = int(
+                os.environ.get("NOMAD_TPU_LANE_PRIORITY", "60") or 0
+            )
+        self.lane_priority = lane_priority
+        # an interactive eval pulled mid-drain, solved FIRST next
+        # cycle: (eval, token, hold time — its running lane clock)
+        self._held: Optional[tuple[Evaluation, str, float]] = None
+        # Interactive-placement ledger: (raft index, {node_id: (cpu,
+        # mem, disk)}) per lane commit that landed while a mega-batch
+        # chain was in flight. A chained solve supersedes the committed
+        # aggregate with the parent's used' tensor, which never saw
+        # these placements — the ledger feeds them back as usage deltas
+        # (solver extra_usage) so a jumped eval still places
+        # conflict-free with its chained followers.
+        self._lane_ledger: list[tuple[int, dict]] = []
         # plan-apply backpressure: the solve stage sizes (and stalls)
         # its drains from the applier's queue depth + submit latency
         self.backpressure = Backpressure()
@@ -339,13 +366,21 @@ class TPUBatchWorker:
         nack and redeliver every eval forever — the cluster accepts
         jobs but never places. Degrade loudly to single-chip instead,
         and clear mesh_devices so the scheduler's _mesh_for doesn't
-        re-raise the same error per solve."""
-        if (
-            self._resident is not None
-            or (getattr(self.config, "mesh_devices", 0) or 0) <= 1
-        ):
+        re-raise the same error per solve.
+
+        Single-chip workers get a plain ResidentClusterState too (new
+        with the interactive fast path): beyond the resident device
+        tensors it carries the WARM EVAL CONTEXT — the cached ready-node
+        lists, host-table skeleton, and lowered-group skeletons that let
+        a repeat-shaped interactive eval skip the node scan and lowering
+        entirely (solver.py)."""
+        if self._resident is not None:
             return
         from ..scheduler.tpu import ResidentClusterState
+
+        if (getattr(self.config, "mesh_devices", 0) or 0) <= 1:
+            self._resident = ResidentClusterState()
+            return
         from ..scheduler.tpu.sharding import solver_mesh
 
         try:
@@ -367,6 +402,8 @@ class TPUBatchWorker:
         self._stop = threading.Event()
         self._commit_q = queue_mod.Queue(maxsize=1)
         self._prev = None
+        self._held = None
+        self._lane_ledger = []
         self._thread = threading.Thread(
             target=self._run, args=(self._stop,), daemon=True,
             name="tpu-batch-solve"
@@ -409,17 +446,30 @@ class TPUBatchWorker:
                 break
             if item is not None:
                 (batch, _pending, _snapshot, committed, outcome,
-                 _chain, bctx) = item
+                 _chain, bctx, _t_deq) = item
                 self._nack_batch(batch)
                 outcome["ok"] = False
                 committed.set()
                 if bctx is not None:
                     bctx.finish("stopped")
+        # a held interactive eval never reached a solve: nack it so its
+        # job's broker lock releases instead of leaking
+        if self._held is not None:
+            held, self._held = self._held, None
+            self._nack_batch([held[:2]])
         # a stopped worker object stays referenced by the server; don't
         # let it pin the last batch's device tensors and snapshot
         self._prev = None
 
     # -- solve stage ----------------------------------------------------
+
+    def _interactive(self, ev: Evaluation) -> bool:
+        """Priority-lane classification: at or above the lane priority
+        an eval is interactive — it never waits for, or rides in, a
+        mega-batch (the round-11 admission classification's mirror:
+        admission displaces strictly-below work; the lane fast-paths
+        above-default work)."""
+        return self.lane_priority > 0 and ev.priority >= self.lane_priority
 
     def _run(self, stop: threading.Event) -> None:
         broker = self.server.eval_broker
@@ -447,8 +497,24 @@ class TPUBatchWorker:
             if stop.is_set():
                 break
             batch: list[tuple[Evaluation, str]] = []
-            ev, token = broker.dequeue(self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S)
+            t_deq = None
+            if self._held is not None:
+                # the interactive eval that preempted the last drain —
+                # its lane clock started when it was HELD, so the time
+                # it waited through the preempting batch's phase A
+                # counts (lane starvation must read off the histogram)
+                ev, token, t_deq = self._held
+                self._held = None
+            else:
+                ev, token = broker.dequeue(
+                    self.schedulers, timeout_s=DEQUEUE_TIMEOUT_S
+                )
             if ev is None:
+                continue
+            if t_deq is None:
+                t_deq = time.perf_counter()
+            if self._interactive(ev):
+                self._run_interactive(ev, token, t_deq)
                 continue
             batch.append((ev, token))
             # Effective batch size under backpressure: plan-queue depth
@@ -471,6 +537,14 @@ class TPUBatchWorker:
                         self.schedulers, timeout_s=0.01
                     )
                     if ev2 is None:
+                        break
+                    if self._interactive(ev2):
+                        # lane preempts the drain: the interactive eval
+                        # is never baked into this mega-batch — it jumps
+                        # the line as its own solve next cycle (held
+                        # with its lane clock already running)
+                        self._held = (ev2, token2, time.perf_counter())
+                        metrics.incr("nomad.worker.lane.drain_preempted")
                         break
                     batch.append((ev2, token2))
             if bctx is not None:
@@ -502,7 +576,7 @@ class TPUBatchWorker:
             if not self.pipeline:
                 self._commit(
                     batch, pending, snapshot, threading.Event(),
-                    outcome, chained_on, bctx,
+                    outcome, chained_on, bctx, t_deq=t_deq,
                 )
                 continue
             committed = threading.Event()
@@ -513,7 +587,7 @@ class TPUBatchWorker:
                 try:
                     self._commit_q.put(
                         (batch, pending, snapshot, committed,
-                         outcome, chained_on, bctx),
+                         outcome, chained_on, bctx, t_deq),
                         timeout=0.2,
                     )
                     handed_off = True
@@ -538,10 +612,135 @@ class TPUBatchWorker:
                 basis = chained_on[1] if chained_on else snapshot.index
                 self._prev = (pending, committed, outcome, basis)
 
-    def _solve_batch(self, evals: list[Evaluation]):
+    def _run_interactive(self, ev: Evaluation, token: str,
+                         t_deq: float) -> None:
+        """The interactive lane: solve one eval alone — no drain, no
+        mega-batch — and commit INLINE on the solve thread, jumping
+        ahead of the in-flight batch sitting in the commit queue. Small
+        evals resolve via the host microsolve (zero device round-trip);
+        big high-priority evals still skip the drain wait. The used'
+        chain composes through the lane ledger: a committed lane
+        placement that the live chain tensor never saw is fed back to
+        the next chained solve as usage deltas (_solve_batch)."""
+        metrics.incr("nomad.worker.lane.interactive")
+        batch = [(ev, token)]
+        bctx = trace.start_trace("tpu.interactive")
+        if bctx is not None:
+            bctx.set_attr("eval_id", ev.id)
+            bctx.set_attr("job_id", ev.job_id)
+            self.server.eval_broker.annotate_trace(
+                ev.id, batch=bctx.trace_id
+            )
+        try:
+            with trace.use(bctx):
+                with trace.span(bctx, "solve.dispatch"):
+                    # allow_chain=False: the lane commits INLINE, ahead
+                    # of the in-flight parent — a chained solve here
+                    # would break the FIFO guarantee that a parent's
+                    # commit verdict is decided before its child's. The
+                    # solve sees committed state (+ the lane ledger);
+                    # the applier's verification trims any conflict
+                    # with the still-uncommitted mega batch.
+                    pending, snapshot, chained_on = self._solve_batch(
+                        [ev], allow_chain=False
+                    )
+        except Exception:
+            logger.exception("interactive solve of %s failed", ev.id)
+            metrics.incr("nomad.worker.invoke.failed")
+            self._nack_batch(batch)
+            if bctx is not None:
+                bctx.finish("solve-failed")
+            return
+        if pending.used_micro:
+            metrics.incr("nomad.worker.lane.micro")
+        outcome: dict = {"ok": None}
+        try:
+            self._commit(
+                batch, pending, snapshot, threading.Event(), outcome,
+                chained_on, bctx, lane="interactive", t_deq=t_deq,
+            )
+        except (Exception, CancelledError):
+            # same backstop as _commit_loop: an escape past _commit's
+            # own guards (e.g. in the post-commit lane bookkeeping)
+            # must nack, not kill the solve thread — a dead solve
+            # thread silently stops ALL scheduling until restart
+            logger.exception("interactive commit stage hard failure")
+            self._nack_batch(batch)
+            outcome["ok"] = False
+            if bctx is not None:
+                bctx.finish("commit-failed")
+
+    def _lane_extra_usage(self, snapshot, chained_on) -> Optional[dict]:
+        """Merge lane-ledger placements this solve's capacity view would
+        otherwise miss: everything newer than the chain basis (a chained
+        solve reads the parent's used' tensor, frozen at the basis) or —
+        unchained — newer than the snapshot. Entries old enough for
+        every future view are pruned; over-inclusion in the race windows
+        is deliberate (counting a visible placement twice under-fills,
+        which the applier's verification never has to repair)."""
+        cutoff = (
+            chained_on[1] if chained_on is not None else snapshot.index
+        )
+        if not self._lane_ledger:
+            return None
+        keep = min(cutoff, snapshot.index)
+        if self._prev is not None and not self._prev[1].is_set():
+            # a LIVE chain pins the prune horizon: this solve may not
+            # need an entry, but the next chained solve reads from the
+            # in-flight parent's (older) basis and still does
+            keep = min(keep, self._prev[3])
+        if keep > 0:
+            self._lane_ledger = [
+                e for e in self._lane_ledger if e[0] > keep
+            ]
+        merged: dict[str, tuple] = {}
+        for idx, deltas in self._lane_ledger:
+            if idx <= cutoff:
+                continue
+            for nid, v in deltas.items():
+                cur = merged.get(nid)
+                merged[nid] = (
+                    v
+                    if cur is None
+                    else (cur[0] + v[0], cur[1] + v[1], cur[2] + v[2])
+                )
+        return merged or None
+
+    @staticmethod
+    def _plan_usage_deltas(plans: dict) -> dict:
+        """Per-node (cpu, mem, disk) usage added by a set of plans —
+        eager rows and SoA batch columns alike (stops are ignored:
+        under-counting freed capacity only under-fills)."""
+        out: dict[str, list] = {}
+        for plan in plans.values():
+            for nid, allocs in plan.node_allocation.items():
+                for a in allocs:
+                    r = a.comparable_resources()
+                    d = out.get(nid)
+                    if d is None:
+                        d = out[nid] = [0, 0, 0]
+                    d[0] += r.cpu
+                    d[1] += r.memory_mb
+                    d[2] += r.disk_mb
+            for b in plan.alloc_batches:
+                c = b.row_contribution()
+                for nid, _ti, cnt in b.touched_nodes():
+                    d = out.get(nid)
+                    if d is None:
+                        d = out[nid] = [0, 0, 0]
+                    d[0] += c[0] * cnt
+                    d[1] += c[1] * cnt
+                    d[2] += c[2] * cnt
+        return {k: tuple(v) for k, v in out.items()}
+
+    def _solve_batch(self, evals: list[Evaluation],
+                     allow_chain: bool = True):
         """Phase A: snapshot + reconcile + lower + async device dispatch.
         Returns the PendingEvalBatch whose finish() (run on the commit
-        stage) blocks on the device and materializes the plans."""
+        stage) blocks on the device and materializes the plans.
+        allow_chain=False (the interactive lane) never consumes the
+        in-flight parent's used' tensor — lane solves commit ahead of
+        the parent, outside the FIFO the chain verdict relies on."""
         from ..scheduler.tpu import solve_eval_batch_begin
 
         wait_index = max(
@@ -560,7 +759,12 @@ class TPUBatchWorker:
         chained_on = None
         if self._prev is not None:
             prev_pending, committed, prev_outcome, prev_basis = self._prev
-            if not committed.is_set():
+            if committed.is_set():
+                # drop a committed parent regardless of lane: a stream
+                # of interactive solves must not keep the last mega
+                # batch's device tensors and snapshot pinned
+                self._prev = None
+            elif allow_chain:
                 chain = prev_pending.chain
                 # (parent's commit-verdict holder, the chain's BASIS
                 # index). The basis is the parent's own basis — NOT its
@@ -571,8 +775,6 @@ class TPUBatchWorker:
                 # for unblocks from that index or a capacity event in the
                 # gap is treated as already seen and the eval strands.
                 chained_on = (prev_outcome, prev_basis)
-            else:
-                self._prev = None
         t0 = time.perf_counter()
         if faultplane.plane is not None:
             # injected dispatch-stage fault: surfaces through the solve
@@ -582,6 +784,7 @@ class TPUBatchWorker:
         pending = solve_eval_batch_begin(
             snapshot, self.planner, evals, self.config, used_chain=chain,
             resident=self._resident,
+            extra_usage=self._lane_extra_usage(snapshot, chained_on),
         )
         if chained_on is not None and not pending.chain_accepted:
             # the solver took a path that never consumed the chain (host
@@ -615,11 +818,11 @@ class TPUBatchWorker:
             if item is None:
                 return
             (batch, pending, snapshot, committed, outcome,
-             chained_on, bctx) = item
+             chained_on, bctx, t_deq) = item
             try:
                 self._commit(
                     batch, pending, snapshot, committed, outcome,
-                    chained_on, bctx,
+                    chained_on, bctx, t_deq=t_deq,
                 )
             except (Exception, CancelledError):
                 # _commit has its own guards; this is the backstop that
@@ -643,7 +846,7 @@ class TPUBatchWorker:
 
     def _commit(
         self, batch, pending, snapshot, committed, outcome, chained_on,
-        bctx=None,
+        bctx=None, lane: str = "batch", t_deq: Optional[float] = None,
     ) -> None:
         broker = self.server.eval_broker
         if chained_on is not None and chained_on[0].get("ok") is False:
@@ -728,6 +931,28 @@ class TPUBatchWorker:
         metrics.observe(
             "nomad.tpu.commit_seconds", time.perf_counter() - t0
         )
+        if lane == "interactive":
+            # lane-ledger record: an interactive commit that landed
+            # while a mega-batch chain is in flight is invisible to the
+            # chained used' tensor — remember its per-node deltas so the
+            # next chained solve counts them (committed.is_set() is the
+            # chain cutoff the solve stage branches on; runs on the
+            # solve thread, so the ledger stays single-threaded)
+            if self._prev is not None and not self._prev[1].is_set():
+                deltas = self._plan_usage_deltas(plans)
+                if deltas:
+                    self._lane_ledger.append(
+                        (self.server.state.latest_index(), deltas)
+                    )
+                    del self._lane_ledger[:-64]
+        if t_deq is not None:
+            lane_dt = time.perf_counter() - t_deq
+            if lane == "interactive":
+                metrics.observe(
+                    "nomad.worker.lane.interactive_seconds", lane_dt
+                )
+            else:
+                metrics.observe("nomad.worker.lane.batch_seconds", lane_dt)
         with trace.span(bctx, "eval.ack"):
             for ev_, tok in batch:
                 try:

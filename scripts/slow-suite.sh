@@ -14,12 +14,15 @@
 # (the tier-1 posture); point JAX_PLATFORMS elsewhere to exercise a
 # real device.
 #
-# After the pytest battery, runs the c2m_sharded bench sweep (100k+
-# nodes over mesh sizes 1 and 8 through the production mesh path) and
-# fails if its sharded_scaling gate (>= 0.7x linear) or the
-# zero-full-reupload/recompile-bound gates regress. Skip it with
-# SLOW_SUITE_NO_SHARDED=1 (e.g. on a box mid-perf-capture, where a
-# concurrent sweep would skew BENCH_r0N numbers).
+# After the pytest battery, runs the smoke_interactive bench config
+# (interactive fast path: direct single-eval p50 vs the r08 basis +
+# the loaded priority-lane ratio; skip with SLOW_SUITE_NO_INTERACTIVE=1)
+# and the c2m_sharded bench sweep (100k+ nodes over mesh sizes 1 and 8
+# through the production mesh path), failing if the sharded_scaling
+# gate (>= 0.7x linear) or the zero-full-reupload/recompile-bound
+# gates regress. Skip the sweep with SLOW_SUITE_NO_SHARDED=1 (e.g. on
+# a box mid-perf-capture, where a concurrent sweep would skew
+# BENCH_r0N numbers).
 #
 # Exit code: nonzero on any pytest failure or sharded-gate failure.
 # Budget ~30+ minutes.
@@ -33,6 +36,37 @@ python -m pytest tests/ -q -m slow \
   --continue-on-collection-errors \
   -p no:cacheprovider -p no:xdist -p no:randomly \
   "$@"
+
+if [ "${SLOW_SUITE_NO_INTERACTIVE:-0}" != "1" ]; then
+  echo "[slow-suite] interactive fast-path gates (BENCH_CONFIG=smoke_interactive)"
+  python - <<'PY'
+import json, os, subprocess, sys
+
+env = dict(os.environ, BENCH_CONFIG="smoke_interactive")
+env.setdefault("BENCH_SKIP_TPU_PROBE", "1")
+proc = subprocess.run(
+    [sys.executable, "bench.py"], env=env, capture_output=True, text=True
+)
+sys.stderr.write(proc.stderr[-2000:])
+if proc.returncode != 0:
+    sys.exit(f"smoke_interactive run failed rc={proc.returncode}")
+payload = json.loads(proc.stdout.strip().splitlines()[-1])
+cfg = payload["configs"]["smoke_interactive"]
+print(
+    "[slow-suite] smoke_interactive: direct p50 %.2fms (gate %s), "
+    "loaded lane p50 %.1fms vs batch p50 %sms (gate %s)"
+    % (
+        cfg["single_eval_p50_s"] * 1e3,
+        cfg["smoke_interactive_p50_ok"],
+        cfg["lane_loaded_p50_s"] * 1e3,
+        (cfg["batch_lane_p50_s"] or 0) * 1e3,
+        cfg["smoke_interactive_lane_ok"],
+    )
+)
+ok = cfg["smoke_interactive_p50_ok"] and cfg["smoke_interactive_lane_ok"]
+sys.exit(0 if ok else "smoke_interactive gates failed")
+PY
+fi
 
 if [ "${SLOW_SUITE_NO_SHARDED:-0}" != "1" ]; then
   echo "[slow-suite] c2m_sharded device-count sweep (BENCH_CONFIG=c2m_sharded)"

@@ -771,7 +771,10 @@ REFERENCE_COMMAND_FLAGS = {
         "flags": {"-json", "-address", "-token"}, "args": [],
     },
     # operator top is this repo's own surface (no reference analog):
-    # registered here so its flag set is droppable only deliberately
+    # registered here so its flag set is droppable only deliberately.
+    # Round 19 (interactive fast-path PR): the new `Lanes` panel is a
+    # render-only row (tests/test_overload.py TestOperatorTopLanePanel)
+    # — the flag set is deliberately unchanged.
     "operator top": {
         "flags": {"-interval", "-n", "-once", "-cluster",
                   "-address", "-token"},

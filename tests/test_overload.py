@@ -702,3 +702,43 @@ class TestOperatorTopOverloadPanel:
             "samples": {},
         }
         assert "Overload" not in _render_top(snap, None)
+
+
+class TestOperatorTopLanePanel:
+    def test_lane_panel_renders_when_lane_active(self):
+        from nomad_tpu.cli.main import _render_top
+
+        snap = {
+            "uptime_seconds": 10,
+            "counters": {
+                "nomad.worker.lane.interactive": 7,
+                "nomad.worker.lane.micro": 6,
+                "nomad.worker.lane.drain_preempted": 2,
+            },
+            "gauges": {},
+            "samples": {
+                "nomad.worker.lane.interactive_seconds": {
+                    "count": 7, "p50": 0.004, "p95": 0.01, "p99": 0.02,
+                },
+                "nomad.worker.lane.batch_seconds": {
+                    "count": 3, "p50": 0.35, "p95": 0.5, "p99": 0.5,
+                },
+            },
+        }
+        out = _render_top(snap, None)
+        assert "Lanes" in out
+        assert "interactive 7" in out
+        assert "micro 6" in out
+        assert "drain preempted 2" in out
+        assert "batch p50" in out
+
+    def test_lane_panel_hidden_without_lane_traffic(self):
+        from nomad_tpu.cli.main import _render_top
+
+        snap = {
+            "uptime_seconds": 10,
+            "counters": {},
+            "gauges": {},
+            "samples": {},
+        }
+        assert "Lanes" not in _render_top(snap, None)

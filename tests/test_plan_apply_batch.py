@@ -214,6 +214,116 @@ def test_same_job_plans_never_merge():
     assert merged2 == [0, 1] and serial2 == []
 
 
+def test_merged_round_trims_duplicate_eval_name_mint():
+    """The r15/r17 soak duplicate-alloc race, pinned: one plan carrying
+    the same (eval, name) twice — or two merge-eligible plans minting it
+    — must commit exactly ONE alloc per (eval, name). The later entrant
+    is trimmed before the raft apply, the result reads as a partial
+    commit (refresh set), and the trim counter fires."""
+    from nomad_tpu import metrics
+    from nomad_tpu.metrics import Registry
+
+    old = metrics._install_registry(Registry())
+    try:
+        h, jobs = build_state(n_nodes=4, n_jobs=1, count=1)
+        nodes = h.state.nodes()
+        # one plan, TWO fresh allocs with the same name on different
+        # nodes (the "one plan carrying the name twice" shape)
+        plan = _manual_plan(
+            jobs[0], [(nodes[0], 400, 128), (nodes[1], 400, 128)]
+        )
+        applier, _ = make_applier(h.state)
+        (res,) = applier.apply_batch([plan])
+        committed = [
+            a for allocs in res.node_allocation.values() for a in allocs
+        ]
+        assert len(committed) == 1, committed
+        assert not res.full_commit(plan)[0]
+        assert res.refresh_index > 0
+        stored = [
+            a
+            for a in h.state.allocs_by_job(jobs[0].namespace, jobs[0].id)
+            if not a.terminal_status()
+        ]
+        assert len(stored) == 1
+        c = metrics.snapshot()["counters"]
+        assert c.get("nomad.plan_apply.dup_mint_trimmed") == 1
+    finally:
+        metrics._install_registry(old)
+
+
+def test_merged_round_trims_duplicate_across_plans():
+    """Two plans for the same eval (the second job-detached, so the
+    same-job merge exclusion cannot catch it) minting the same name in
+    one batch: the second entrant's row is trimmed even when it lands
+    in a later merge round."""
+    h, jobs = build_state(n_nodes=4, n_jobs=1, count=1)
+    nodes = h.state.nodes()
+    plan_a = _manual_plan(jobs[0], [(nodes[0], 400, 128)])
+    plan_b = _manual_plan(jobs[0], [(nodes[1], 400, 128)])
+    # same eval, same alloc name, different ids — the forensics shape
+    for allocs in plan_b.node_allocation.values():
+        for a in allocs:
+            a.eval_id = plan_a.eval_id
+    plan_b.eval_id = plan_a.eval_id
+    plan_b.job = None  # job-detached: merges despite the same job id
+    applier, _ = make_applier(h.state)
+    res_a, res_b = applier.apply_batch([plan_a, plan_b])
+    assert res_a.full_commit(plan_a)[0]
+    assert not res_b.full_commit(plan_b)[0]
+    names = [
+        (a.eval_id, a.name)
+        for a in h.state.allocs_by_job(jobs[0].namespace, jobs[0].id)
+        if not a.terminal_status()
+    ]
+    assert len(names) == len(set(names)) == 1
+
+
+def test_merged_round_never_trims_existing_alloc_updates():
+    """Updates of EXISTING allocs (inplace updates, followup-eval
+    annotations) keep their original minting eval_id/name — two plans
+    in one batch carrying the same stored alloc are last-writer-wins,
+    never 'duplicate mints': the guard must not trim them."""
+    from nomad_tpu import metrics
+    from nomad_tpu.metrics import Registry
+
+    h, jobs = build_state(n_nodes=2, n_jobs=1, count=1)
+    nodes = h.state.nodes()
+    # commit one real alloc first
+    seed = _manual_plan(jobs[0], [(nodes[0], 400, 128)])
+    applier, _ = make_applier(h.state)
+    (res0,) = applier.apply_batch([seed])
+    assert res0.full_commit(seed)[0]
+    stored = next(
+        a
+        for a in h.state.allocs_by_job(jobs[0].namespace, jobs[0].id)
+        if not a.terminal_status()
+    )
+    assert stored.create_index > 0
+
+    def update_plan():
+        p = Plan(eval_id=stored.eval_id, priority=50, job=None)
+        annotated = stored.copy()
+        annotated.followup_eval_id = "follow-" + annotated.id[:8]
+        p.append_alloc(annotated, annotated.job)
+        return p
+
+    old = metrics._install_registry(Registry())
+    try:
+        res_a, res_b = applier.apply_batch([update_plan(), update_plan()])
+        committed = [
+            a
+            for r in (res_a, res_b)
+            for allocs in r.node_allocation.values()
+            for a in allocs
+        ]
+        assert len(committed) == 2, "an existing-alloc update was trimmed"
+        c = metrics.snapshot()["counters"]
+        assert not c.get("nomad.plan_apply.dup_mint_trimmed")
+    finally:
+        metrics._install_registry(old)
+
+
 def test_forced_node_conflict_partitions_and_matches_serial():
     """Two plans fighting over one node: the partition must route the
     second to the serial path, and the final state (including the

@@ -534,3 +534,98 @@ def test_enabled_schedulers_shards_worker_pool():
 def test_enabled_schedulers_rejects_unknown_type():
     with pytest.raises(ValueError, match="unknown types"):
         Server(num_workers=1, enabled_schedulers=["servise"])
+
+
+def test_tpu_worker_interactive_lane_jumps_mega_batches():
+    """ISSUE 15 priority lanes: an interactive (>= lane priority) eval
+    arriving while mega-batches with a modeled device RTT stream
+    through the TPU worker must be classified into the lane, solved
+    alone via the host microsolve (zero device round-trip), and
+    committed without riding any mega-batch — its wall time stays far
+    under the batch cadence the RTT imposes."""
+    import time
+
+    from nomad_tpu import metrics
+    from nomad_tpu.metrics import Registry
+    from nomad_tpu.scheduler.context import SchedulerConfig
+
+    from nomad_tpu.scheduler.tpu import solve_eval_batch
+    from nomad_tpu.testing import Harness
+
+    # warm the jit cache at the mega-batch shapes OUTSIDE the measured
+    # window (the first dense solve otherwise compiles ~1s mid-test)
+    wh = Harness()
+    for _ in range(30):
+        wh.state.upsert_node(wh.next_index(), mock.node())
+    wjob = mock.job(id="warm")
+    wjob.task_groups[0].count = 60
+    wjob.task_groups[0].tasks[0].resources.networks = []
+    wh.state.upsert_job(wh.next_index(), wjob)
+    solve_eval_batch(
+        wh.snapshot(), wh, [mock.eval_for_job(wjob)],
+        SchedulerConfig(backend="tpu", small_batch_threshold=0),
+    )
+
+    old = metrics._install_registry(Registry())
+    s = Server(
+        use_tpu_batch_worker=True,
+        scheduler_config=SchedulerConfig(
+            backend="tpu", inject_device_latency_s=0.3
+        ),
+    )
+    s.establish_leadership()
+    try:
+        for _ in range(30):
+            s.node_register(mock.node())
+        # mega stream: each job's 60 requests exceed the small-batch
+        # threshold, so every batch runs the dense path and pays the
+        # 0.3s modeled RTT
+        for i in range(4):
+            job = mock.job(id=f"mega-{i}")
+            job.task_groups[0].count = 60
+            job.task_groups[0].tasks[0].resources.cpu = 100
+            job.task_groups[0].tasks[0].resources.memory_mb = 32
+            job.task_groups[0].tasks[0].resources.networks = []
+            s.job_register(job)
+        time.sleep(0.1)  # let the first mega batch occupy the worker
+        ia = mock.job(id="interactive-1")
+        ia.priority = 70
+        ia.task_groups[0].count = 1
+        ia.task_groups[0].tasks[0].resources.networks = []
+        t0 = time.perf_counter()
+        s.job_register(ia)
+        deadline = t0 + 20
+        while time.perf_counter() < deadline:
+            if any(
+                not a.terminal_status()
+                for a in s.state.allocs_by_job(ia.namespace, ia.id)
+            ):
+                break
+            time.sleep(0.002)
+        ia_wall = time.perf_counter() - t0
+        assert any(
+            not a.terminal_status()
+            for a in s.state.allocs_by_job(ia.namespace, ia.id)
+        ), "interactive eval never placed"
+        # the lane histogram lands a beat after the plan commit that
+        # made the alloc visible — settle before reading the registry
+        deadline = time.perf_counter() + 10
+        while time.perf_counter() < deadline:
+            if "nomad.worker.lane.interactive_seconds" in (
+                metrics.snapshot()["samples"]
+            ):
+                break
+            time.sleep(0.01)
+        snap = metrics.snapshot()
+        counters = snap["counters"]
+        assert counters.get("nomad.worker.lane.interactive", 0) >= 1
+        assert counters.get("nomad.worker.lane.micro", 0) >= 1
+        assert "nomad.worker.lane.interactive_seconds" in snap["samples"]
+        # generous bound for a loaded 2-cpu box: still far under the
+        # ~0.3s-per-batch cadence the mega stream pays (4 batches
+        # would be >= 1.2s if the eval had to ride the stream's tail)
+        assert ia_wall < 1.2, f"interactive eval took {ia_wall:.2f}s"
+        assert s.wait_for_evals(60)
+    finally:
+        s.shutdown()
+        metrics._install_registry(old)

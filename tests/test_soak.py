@@ -263,14 +263,14 @@ def test_duplicate_alloc_failure_carries_store_forensics(tmp_path):
 
 @pytest.mark.slow
 def test_soak_duplicate_alloc_repro_seed42(tmp_path):
-    """Seeded repro harness for the bench-soak duplicate-alloc flake
-    (30s, partition_cycle, TPU worker, seed 42 — flips ~1/7 on the base
-    commit). Runs the known-flaky configuration repeatedly; when the
-    race fires, the invariant's new forensics (snapshot-vs-commit
-    indexes, minting log entries) are the test output — xfail with the
-    evidence so a reproduction reads as captured, not as noise. A full
-    clean battery passes: the race is a pre-existing known issue this
-    harness EXPOSES for the next fix, it is not fixed here."""
+    """Regression harness for the r15/r17 bench-soak duplicate-alloc
+    race (30s, partition_cycle, TPU worker, seed 42 — flipped ~1/7 on
+    the pre-fix commit). The r17 forensics proved both duplicate ids
+    were minted by the SAME eval in ONE merged plan-apply raft entry;
+    the merge round now trims the later (eval, name) entrant
+    (plan_apply._trim_duplicate_mints), so the known-flaky
+    configuration must hold its invariants on EVERY attempt — the
+    xfail-with-evidence posture is retired with the fix."""
     attempts = int(os.environ.get("NOMAD_TPU_DUP_REPRO_ATTEMPTS", "6"))
     for i in range(attempts):
         report = run_soak(
@@ -283,14 +283,8 @@ def test_soak_duplicate_alloc_repro_seed42(tmp_path):
             partition_cycle=True,
             node_count=10,
         )
-        if not report["invariants_ok"]:
-            err = report.get("invariant_error", "")
-            assert "duplicate alloc" in err, err
-            assert "forensics:" in err, (
-                "reproduced WITHOUT forensics — evidence path broken: "
-                + err
-            )
-            pytest.xfail(
-                f"duplicate-alloc race reproduced on attempt {i + 1}/"
-                f"{attempts} with forensics captured: {err[:3000]}"
-            )
+        assert report["invariants_ok"], (
+            f"attempt {i + 1}/{attempts}: "
+            + report.get("invariant_error", "")[:3000]
+        )
+        assert report["converged"], f"attempt {i + 1}/{attempts}"

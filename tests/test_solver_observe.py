@@ -511,7 +511,13 @@ def test_e2e_solver_observability_acceptance(tmp_path, capsys):
             ), f"wave {prefix} never placed"
 
         api = NomadClient(f"http://127.0.0.1:{agent.http_addr[1]}")
-        drive_wave("warm")  # warmup: bucket compiles happen here
+        # TWO warm waves (the sharded bench's warm-round precedent):
+        # wave 1 compiles the solve kernels and does the resident
+        # tensors' first full sync; wave 2 ships the first delta-sync
+        # scatter, compiling the scatter jits — the worker's warm eval
+        # context (ResidentClusterState) is only steady after both
+        drive_wave("warm")
+        drive_wave("warm2")
         warm = api.agent.solver_status()
         assert warm["ledger"]["compiles"] >= 1, warm["ledger"]
         drive_wave("steady")  # steady state: identical padded shapes
@@ -665,12 +671,35 @@ print(json.dumps(out))
 
 def test_observability_throughput_vs_uninstrumented_smoke():
     """Acceptance gate: scheduling throughput with the solver
-    observatory ON stays >= 0.95x the disabled path — on the bench
-    smoke config (the acceptance criterion) AND on a dense-path batch
-    that actually dispatches the device kernel (so the ledger/transfer/
-    memory instrumentation is on the measured path). Clean subprocess:
-    the suite's daemon threads make in-process timing comparisons
-    noise (same rationale as the tracing/histogram gates)."""
+    observatory ON stays >= 0.95x the disabled path, on a dense-path
+    batch that actually dispatches the device kernel (so the ledger/
+    transfer/memory instrumentation is on the measured path). Clean
+    subprocess: the suite's daemon threads make in-process timing
+    comparisons noise (same rationale as the tracing/histogram gates).
+
+    TIER-1 SCOPE DECISION (ISSUE 15 satellite — the ~1-in-3 under-load
+    tail flip): this test now runs the DENSE workload only. The smoke
+    workload's solves are sub-millisecond (and the microsolve fast path
+    made them ~3x shorter still), so its paired bursts sit at the
+    timing floor where a suite-tail load spike flips the median about
+    one full run in three — while it passes standalone every time
+    (r13 onward). The smoke side moved to the slow suite
+    (test_observability_overhead_smoke_slow below) with a widened
+    attempt budget, where it is not racing the tier-1 tail; the dense
+    side keeps the production-path regression coverage in tier-1."""
+    _overhead_gate({"dense"}, attempts=5)
+
+
+@pytest.mark.slow
+def test_observability_overhead_smoke_slow():
+    """The smoke (microsolve fast-path) side of the observability
+    overhead gate, slow-tier: sub-millisecond bursts need a quiet box
+    and a wider attempt budget (8) — see the tier-1 test's docstring
+    for the split decision."""
+    _overhead_gate({"smoke"}, attempts=8)
+
+
+def _overhead_gate(workloads: set, attempts: int):
     import subprocess
     import sys
     import time
@@ -698,9 +727,9 @@ def test_observability_throughput_vs_uninstrumented_smoke():
     # attempt's median below the bar. Passed workloads drop out of
     # later attempts. Resolution is honestly ~5%: a true 0.93x could
     # sneak past on a noisy attempt; a true >= 2x regression cannot.
-    remaining = {"smoke", "dense"}
-    attempts: list = []
-    for attempt in range(5):
+    remaining = set(workloads)
+    history: list = []
+    for attempt in range(attempts):
         proc = subprocess.run(
             [
                 sys.executable,
@@ -717,7 +746,7 @@ def test_observability_throughput_vs_uninstrumented_smoke():
         assert proc.returncode == 0, proc.stderr[-2000:]
         out = json.loads(proc.stdout.strip().splitlines()[-1])
         child_contention = out.pop("_contention", 1.0)
-        attempts.append(
+        history.append(
             {k: round(v["median"], 3) for k, v in out.items()}
         )
         remaining -= {
@@ -731,11 +760,11 @@ def test_observability_throughput_vs_uninstrumented_smoke():
             load_per_cpu = 0.0
         # Busy only sizes the settle sleep (a busy suite tail reads
         # 1.4+; quiet ~1.0). No sleep after the final attempt.
-        if attempt < 4:
+        if attempt < attempts - 1:
             busy = max(load_per_cpu, child_contention, 0.5)
             time.sleep(min(5.0, 2.0 * busy))
     pytest.fail(
         f"instrumented throughput < 0.95x uninstrumented: workloads "
         f"{sorted(remaining)} never cleared the paired-burst median "
-        f"in 5 attempts; per-attempt medians: {attempts}"
+        f"in {attempts} attempts; per-attempt medians: {history}"
     )
