@@ -417,6 +417,7 @@ def _object_hook(data: dict) -> Any:
 
 _fastpack = None
 _fastpack_synced = 0
+_native_reported = False
 
 
 def _fastpack_module():
@@ -466,8 +467,30 @@ def warm_native() -> bool:
     lock-held stall. Components that pack under locks call this once
     at startup, outside any lock; afterwards _fastpack_module() is a
     cached module lookup. Returns True when the native path is live.
+
+    Also the observability point for the build: logs availability once
+    and publishes nomad.native.{available,build_seconds} so an
+    operator can tell from a capture whether the C path was live and
+    whether this process paid a cold compile.
     """
-    return _fastpack_module() is not None
+    global _native_reported
+    live = _fastpack_module() is not None
+    if not _native_reported:
+        _native_reported = True
+        import logging
+
+        from . import metrics, native
+
+        build_s = max(native.last_build_seconds, 0.0)
+        metrics.set_gauge("nomad.native.available", 1.0 if live else 0.0)
+        metrics.set_gauge("nomad.native.build_seconds", build_s)
+        logging.getLogger("nomad_tpu.native").info(
+            "fastpack %s (resolved in %.3fs; entry points: %s)",
+            "live" if live else "unavailable - pure-Python fallbacks",
+            build_s,
+            ", ".join(native.FASTPACK_ENTRY_POINTS) if live else "none",
+        )
+    return live
 
 
 def pack(obj: Any) -> bytes:

@@ -105,6 +105,25 @@ def freeze_startup_heap() -> None:
     gc.freeze()
 
 
+def release_frozen_garbage() -> int:
+    """Unfreeze, collect, re-freeze: reclaim CYCLES stranded in the
+    permanent generation.
+
+    Loops that rebuild a frozen resident heap (the bench's
+    fresh-cluster passes: build, freeze, measure, drop, repeat) leak
+    each dropped heap's cyclic residue — refcounting frees the acyclic
+    bulk, but cycles sit frozen where no collection ever looks
+    (measured: ~64MB/pass at c2m scale, unbounded). One
+    unfreeze + full collect walks everything ONCE and re-freezes the
+    true survivors; call it in the untimed gap between passes, never
+    inside a measured section (the walk is proportional to the whole
+    live heap). Returns the collected-object count."""
+    gc.unfreeze()
+    n = gc.collect()
+    gc.freeze()
+    return n
+
+
 def freeze_resident_heap() -> int:
     """Re-freeze the CURRENT live heap (post-warmup form of
     freeze_startup_heap): after a server replays its log or a bench

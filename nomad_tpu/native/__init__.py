@@ -36,18 +36,28 @@ FASTPACK_ENTRY_POINTS = (
     "uuid_hex",      # bulk id formatting (structs.generate_uuids)
     "wire_rows",     # SoA plan-row wire assembly (placement_batch)
     "pick_ports",    # bulk dynamic-port picking (structs.network)
+    "store_rows",    # bulk store id-index inserts (state.store)
 )
+
+# Wall seconds load_fastpack spent making the extension importable in
+# this process (compile on a cold cache, dlopen on a warm one); -1.0
+# until attempted. codec.warm_native publishes it as
+# nomad.native.build_seconds so an operator can see cold builds.
+last_build_seconds: float = -1.0
 
 
 def load_fastpack():
     """Compile (once) and import the fastpack extension; None when the
     toolchain is unavailable — callers fall back to pure Python."""
-    global _module, _load_failed
+    global _module, _load_failed, last_build_seconds
     if _module is not None or _load_failed:
         return _module
     with _LOCK:
         if _module is not None or _load_failed:
             return _module
+        import time
+
+        t0 = time.monotonic()
         try:
             _module = _build_and_load()
         except Exception:
@@ -57,6 +67,7 @@ def load_fastpack():
                 "fastpack build failed; using the pure-Python encoder"
             )
             _load_failed = True
+        last_build_seconds = time.monotonic() - t0
     return _module
 
 

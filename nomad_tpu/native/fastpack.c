@@ -524,6 +524,114 @@ static PyObject *py_pick_ports(PyObject *self, PyObject *args) {
   return out;
 }
 
+/* store_rows(ids, handles, idx_raw, main, job_inner, eval_inner,
+ * node_inners) -> None: the bulk id-index insert for one SoA placement
+ * batch. Rows are grouped per node — FIRST-TOUCH node order, row order
+ * within a node, the exact insertion sequence the eager per-row txn
+ * produces from a node_allocation dict — and each row gets the four
+ * dict inserts (main table + job/eval/node inners) in C under the GIL.
+ * idx_raw is the batch's int32 node-index column as raw bytes
+ * (PlacementBatch.node_idx_raw); node_inners maps int node-table index
+ * -> writable inner dict. Fallback: store._store_rows_py (identical
+ * loop; the byte-identity battery compares serialized state).         */
+static PyObject *py_store_rows(PyObject *self, PyObject *args) {
+  PyObject *ids, *handles, *main_t, *job_t, *eval_t, *node_inners;
+  Py_buffer idx;
+  if (!PyArg_ParseTuple(args, "O!O!y*O!O!O!O!", &PyList_Type, &ids,
+                        &PyList_Type, &handles, &idx, &PyDict_Type, &main_t,
+                        &PyDict_Type, &job_t, &PyDict_Type, &eval_t,
+                        &PyDict_Type, &node_inners))
+    return NULL;
+  Py_ssize_t n = PyList_GET_SIZE(ids);
+  if (PyList_GET_SIZE(handles) != n ||
+      idx.len != n * (Py_ssize_t)sizeof(int32_t)) {
+    PyBuffer_Release(&idx);
+    PyErr_SetString(PyExc_ValueError, "column length mismatch");
+    return NULL;
+  }
+  if (n == 0) {
+    PyBuffer_Release(&idx);
+    Py_RETURN_NONE;
+  }
+  const int32_t *ti = (const int32_t *)idx.buf;
+  int32_t max_ti = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (ti[i] < 0) {
+      PyBuffer_Release(&idx);
+      PyErr_SetString(PyExc_ValueError, "negative node index");
+      return NULL;
+    }
+    if (ti[i] > max_ti) max_ti = ti[i];
+  }
+  /* first-touch grouping: per-node linked list of row indices (head/
+   * tail per node index, next per row, distinct nodes in touch order) */
+  size_t m = (size_t)max_ti + 1;
+  Py_ssize_t *head = (Py_ssize_t *)PyMem_Malloc(m * 2 * sizeof(Py_ssize_t));
+  Py_ssize_t *next = (Py_ssize_t *)PyMem_Malloc((size_t)n * sizeof(Py_ssize_t));
+  int32_t *order = (int32_t *)PyMem_Malloc((size_t)n * sizeof(int32_t));
+  if (!head || !next || !order) {
+    PyMem_Free(head);
+    PyMem_Free(next);
+    PyMem_Free(order);
+    PyBuffer_Release(&idx);
+    return PyErr_NoMemory();
+  }
+  Py_ssize_t *tail = head + m;
+  for (size_t j = 0; j < m; j++) head[j] = -1;
+  Py_ssize_t norder = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int32_t t = ti[i];
+    if (head[t] < 0) {
+      head[t] = i;
+      order[norder++] = t;
+    } else {
+      next[tail[t]] = i;
+    }
+    tail[t] = i;
+    next[i] = -1;
+  }
+  int ok = 1;
+  for (Py_ssize_t g = 0; g < norder && ok; g++) {
+    int32_t t = order[g];
+    PyObject *key = PyLong_FromLong((long)t);
+    if (!key) {
+      ok = 0;
+      break;
+    }
+    PyObject *node_t = PyDict_GetItemWithError(node_inners, key);
+    Py_DECREF(key);
+    if (!node_t) {
+      if (!PyErr_Occurred())
+        PyErr_Format(PyExc_KeyError, "missing node inner for index %d",
+                     (int)t);
+      ok = 0;
+      break;
+    }
+    if (!PyDict_Check(node_t)) {
+      PyErr_SetString(PyExc_TypeError, "node inner must be a dict");
+      ok = 0;
+      break;
+    }
+    for (Py_ssize_t i = head[t]; i >= 0; i = next[i]) {
+      PyObject *uid = PyList_GET_ITEM(ids, i);
+      PyObject *h = PyList_GET_ITEM(handles, i);
+      if (PyDict_SetItem(main_t, uid, h) < 0 ||
+          PyDict_SetItem(job_t, uid, h) < 0 ||
+          PyDict_SetItem(eval_t, uid, h) < 0 ||
+          PyDict_SetItem(node_t, uid, h) < 0) {
+        ok = 0;
+        break;
+      }
+    }
+  }
+  PyMem_Free(head);
+  PyMem_Free(next);
+  PyMem_Free(order);
+  PyBuffer_Release(&idx);
+  if (!ok) return NULL;
+  Py_RETURN_NONE;
+}
+
 /* ------------------------------------------------------------------ */
 /* module API                                                          */
 
@@ -573,6 +681,10 @@ static PyMethodDef methods[] = {
     {"pick_ports", py_pick_ports, METH_VARARGS,
      "pick_ports(taken_bitmap, k, min, max, seed): k distinct free "
      "ports, deterministic per seed (LCG + linear-scan fallback)."},
+    {"store_rows", py_store_rows, METH_VARARGS,
+     "store_rows(ids, handles, idx_raw, main, job_inner, eval_inner, "
+     "node_inners): bulk node-grouped id-index inserts for one SoA "
+     "placement batch (state.store._upsert_batches_txn)."},
     {NULL, NULL, 0, NULL},
 };
 

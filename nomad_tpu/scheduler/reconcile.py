@@ -886,11 +886,19 @@ class _NameIndex:
     def next_n(self, n: int) -> list[int]:
         """n lowest unused indexes in one pass — identical to n
         successive next() calls, without the per-call overhead."""
-        out: list[int] = []
         used = self.used_idx
+        i = self._cursor
+        if not used:
+            # fresh mint (nothing claimed anywhere): the run is one
+            # contiguous block — range() beats 10^5 set probes on the
+            # bulk-fill hot path
+            out = list(range(i, i + n))
+            used.update(out)
+            self._cursor = i + n
+            return out
+        out: list[int] = []
         add = used.add
         ap = out.append
-        i = self._cursor
         for _ in range(n):
             while i in used:
                 i += 1

@@ -237,6 +237,9 @@ class LoweredGroup:
     names: list[str] = field(default_factory=list)  # instance names to assign
     requests: list = field(default_factory=list)  # original PlacementRequests
     restricted: bool = False  # spread-value-restricted sub-group (retryable)
+    # bias WITHOUT the per-solve spread addend — what the lowered-skeleton
+    # cache stores (aliases `bias` when the group has no spreads)
+    bias_static: Optional[np.ndarray] = None
 
 
 def lower_group(
@@ -415,22 +418,10 @@ def lower_group(
             match = value_ok[codes] & exists
             bias += np.where(match, a.weight / total_weight, 0.0).astype(np.float32)
 
-    spreads = list(tg.spreads) + [
-        s for s in job.spreads if s.attribute not in {t.attribute for t in tg.spreads}
-    ]
-    if spreads:
-        sum_w = sum(abs(s.weight) for s in spreads) or 1
-        for s in spreads:
-            codes, values, exists = table.attr_codes(s.attribute)
-            counts = _property_counts(ctx, table, job, s.attribute, tg.name)
-            desired = _spread_desired(s, values, tg.count)
-            # boost = (desired - used)/desired per value (targeted spread);
-            # implicit even spread when no explicit targets.
-            with np.errstate(divide="ignore", invalid="ignore"):
-                boost = np.where(
-                    desired > 0, (desired - counts) / np.maximum(desired, 1), -1.0
-                )
-            bias += (boost[codes] * (s.weight / sum_w)).astype(np.float32)
+    bias_static = bias
+    sb = spread_bias(ctx, table, job, tg)
+    if sb is not None:
+        bias = bias + sb
 
     cores_ask = sum(t.resources.cores for t in tg.tasks)
     if cores_ask > 0 and table.cores_free is not None:
@@ -456,7 +447,37 @@ def lower_group(
         priority=job.priority,
         names=request_names(requests),
         requests=requests,
+        bias_static=bias_static,
     )
+
+
+def spread_bias(
+    ctx: EvalContext, table: NodeTable, job: Job, tg: TaskGroup
+) -> Optional[np.ndarray]:
+    """The spread boost addend [N] f32, or None when the group has no
+    spreads. Split out of lower_group because it is the ONLY part of a
+    spread-carrying group's lowering that reads live state (per-value
+    alloc counts): the solver caches the static tensors across solves
+    and re-adds this per solve."""
+    spreads = list(tg.spreads) + [
+        s for s in job.spreads if s.attribute not in {t.attribute for t in tg.spreads}
+    ]
+    if not spreads:
+        return None
+    bias = np.zeros(table.n, dtype=np.float32)
+    sum_w = sum(abs(s.weight) for s in spreads) or 1
+    for s in spreads:
+        codes, values, exists = table.attr_codes(s.attribute)
+        counts = _property_counts(ctx, table, job, s.attribute, tg.name)
+        desired = _spread_desired(s, values, tg.count)
+        # boost = (desired - used)/desired per value (targeted spread);
+        # implicit even spread when no explicit targets.
+        with np.errstate(divide="ignore", invalid="ignore"):
+            boost = np.where(
+                desired > 0, (desired - counts) / np.maximum(desired, 1), -1.0
+            )
+        bias += (boost[codes] * (s.weight / sum_w)).astype(np.float32)
+    return bias
 
 
 def request_names(requests) -> list[str]:
@@ -469,17 +490,30 @@ def request_names(requests) -> list[str]:
 
 
 def group_lower_cacheable(job: Job, tg: TaskGroup) -> bool:
-    """May this group's lowered tensors be cached across solves on the
-    (job version, node-universe fingerprint) key alone?
+    """May this group's FULL lowered tensors (spread bias included) be
+    reused across solves on the (job version, node-universe fingerprint)
+    key alone? Only when the static part is cacheable AND there are no
+    spreads (existing-alloc counts feed the spread bias per solve)."""
+    if tg.spreads or job.spreads:
+        return False
+    return group_lower_static_cacheable(job, tg)
 
-    False whenever lowering reads state BEYOND the node fingerprint:
-    distinct_hosts / distinct_property (proposed-alloc and per-value
-    counts), spreads (existing-alloc counts feed the bias), volumes
-    (claim state), static ports (live port occupancy), and cores (the
-    free-core column is rebuilt per solve). Everything else — dc
-    membership, drivers, attribute constraints, affinities, bandwidth,
-    devices — is a pure function of (job spec, node objects), which the
-    fingerprint pins."""
+
+def group_lower_static_cacheable(job: Job, tg: TaskGroup) -> bool:
+    """May this group's STATIC lowered tensors (feasibility, affinity
+    bias, unit caps — everything except the spread addend) be cached
+    across solves on the (job version, node-universe fingerprint) key
+    alone?
+
+    False whenever the static lowering reads state BEYOND the node
+    fingerprint: distinct_hosts / distinct_property (proposed-alloc and
+    per-value counts), volumes (claim state), static ports (live port
+    occupancy), and cores (the free-core column is rebuilt per solve).
+    Everything else — dc membership, drivers, attribute constraints,
+    affinities, bandwidth, devices — is a pure function of (job spec,
+    node objects), which the fingerprint pins. Spreads do NOT disqualify
+    the static part: lower.spread_bias recomputes their addend per
+    solve on top of the cached tensors."""
     constraints = list(job.constraints) + list(tg.constraints)
     for task in tg.tasks:
         constraints.extend(task.constraints)
@@ -487,8 +521,6 @@ def group_lower_cacheable(job: Job, tg: TaskGroup) -> bool:
         c.operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY)
         for c in constraints
     ):
-        return False
-    if tg.spreads or job.spreads:
         return False
     if tg.volumes:
         return False

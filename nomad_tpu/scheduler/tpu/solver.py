@@ -1223,10 +1223,12 @@ class BatchSolver:
         """lower_group through the warm lowered-skeleton cache: a
         repeat-shaped eval (same job version, same node universe) reuses
         the feasibility/bias/unit-cap tensors instead of re-lowering.
-        Only state-independent groups cache (lower.group_lower_cacheable
-        — no distinct_* constraints, spreads, volumes, static ports, or
-        cores, whose masks read live state beyond the fingerprint)."""
-        from .lower import group_lower_cacheable
+        The cache holds the STATIC part only (no spread addend) — groups
+        qualify via lower.group_lower_static_cacheable (no distinct_*
+        constraints, volumes, static ports, or cores, whose masks read
+        live state beyond the fingerprint); spread-carrying groups reuse
+        the static tensors and re-add lower.spread_bias per solve."""
+        from .lower import group_lower_static_cacheable, spread_bias
 
         res = self.resident
         vers = self._lower_vers
@@ -1239,6 +1241,9 @@ class BatchSolver:
             from .lower import request_names
 
             ask_vec, feas, bias, ucap = cached
+            sb = spread_bias(self.ctx, table, ask.job, tg)
+            if sb is not None:
+                bias = bias + sb  # new array: the cached one is shared
             reqs = ask.requests
             return LoweredGroup(
                 key=(ask.eval_obj.id, tg.name),
@@ -1256,10 +1261,10 @@ class BatchSolver:
         grp = lower_group(
             self.ctx, table, ask.job, tg, ask.requests, ask.eval_obj.id
         )
-        if group_lower_cacheable(ask.job, tg):
+        if group_lower_static_cacheable(ask.job, tg):
             res.store_lowered(
                 vers, ask.job, tg.name,
-                (grp.ask, grp.feasible, grp.bias, grp.units_cap),
+                (grp.ask, grp.feasible, grp.bias_static, grp.units_cap),
             )
         return grp
 
@@ -1974,6 +1979,12 @@ class BatchSolver:
                 fr[2] -= a2
                 return True
 
+            # A PlacementRun answers the per-request checks from its
+            # shared proto: iterating the run here would mint ~10^5
+            # request rows (dataclasses.replace each) per c2m solve —
+            # the exact cost the run exists to avoid, and the single
+            # hottest host site of the r10 profile when it regressed.
+            run_proto = getattr(reqs, "proto", None)
             slow = (
                 bool(tg.networks)
                 or any(t.resources.networks for t in tg.tasks)
@@ -1981,7 +1992,13 @@ class BatchSolver:
                 # dedicated cores need per-placement id assignment
                 or any(t.resources.cores > 0 for t in tg.tasks)
                 # canaries carry a per-alloc deployment status
-                or any(r.previous_alloc is not None or r.canary for r in reqs)
+                or (
+                    (run_proto.previous_alloc is not None or run_proto.canary)
+                    if run_proto is not None
+                    else any(
+                        r.previous_alloc is not None or r.canary for r in reqs
+                    )
+                )
             )
             if slow:
                 node_idx = row_placed.tolist()

@@ -62,23 +62,67 @@ DEFAULT_DELIVERY_LIMIT = 3
 FAILED_QUEUE = "_failed"
 
 
+# Shared free-list cap for pooled 3-slot heap/unacked entries. At
+# steady state every enqueue->dequeue->ack cycle recycles its entry
+# instead of minting a tuple per hop; the cap bounds the pool after a
+# backlog drains.
+_ENTRY_POOL_CAP = 4096
+
+
 class _PendingHeap:
     """Priority heap: higher priority first, then FIFO. ``dropped`` is
     the broker's shared tombstone set (admission-control evictions):
     entries whose eval id is in it are discarded lazily at pop/peek —
-    heap surgery without O(n) re-heapify on the enqueue hot path."""
+    heap surgery without O(n) re-heapify on the enqueue hot path.
 
-    def __init__(self, dropped: Optional[set] = None) -> None:
+    Entries are POOLED 3-slot lists ([-priority, seq, eval]) drawn from
+    the broker's shared free list (``pool``): lists compare elementwise
+    exactly like the tuples they replace, and recycling them at pop
+    kills the per-eval entry allocation on the enqueue->dequeue path."""
+
+    def __init__(
+        self,
+        dropped: Optional[set] = None,
+        pool: Optional[list] = None,
+    ) -> None:
         self._heap: list = []
         self._counter = itertools.count()
         self._dropped = dropped if dropped is not None else set()
+        self._pool = pool if pool is not None else []
+
+    def _entry(self, ev: Evaluation) -> list:
+        pool = self._pool
+        if pool:
+            e = pool.pop()
+            e[0] = -ev.priority
+            e[1] = next(self._counter)
+            e[2] = ev
+            return e
+        return [-ev.priority, next(self._counter), ev]
+
+    def _recycle(self, entry: list) -> None:
+        if len(self._pool) < _ENTRY_POOL_CAP:
+            entry[2] = None
+            self._pool.append(entry)
 
     def push(self, ev: Evaluation) -> None:
-        heapq.heappush(self._heap, (-ev.priority, next(self._counter), ev))
+        heapq.heappush(self._heap, self._entry(ev))
+
+    def push_all(self, evs: list) -> None:
+        """Bulk admission: append pooled entries for the whole batch and
+        heapify ONCE (O(n)) instead of sifting per push — the
+        enqueue_all fast path."""
+        heap = self._heap
+        for ev in evs:
+            heap.append(self._entry(ev))
+        if len(heap) > 1:
+            heapq.heapify(heap)
 
     def pop(self) -> Optional[Evaluation]:
         while self._heap:
-            ev = heapq.heappop(self._heap)[2]
+            entry = heapq.heappop(self._heap)
+            ev = entry[2]
+            self._recycle(entry)
             if ev.id in self._dropped:
                 self._dropped.discard(ev.id)
                 continue
@@ -90,7 +134,7 @@ class _PendingHeap:
             ev = self._heap[0][2]
             if ev.id not in self._dropped:
                 return ev
-            heapq.heappop(self._heap)
+            self._recycle(heapq.heappop(self._heap))
             self._dropped.discard(ev.id)
         return None
 
@@ -151,10 +195,17 @@ class EvalBroker:
         # priority)
         self._prio_buckets: dict[int, dict[str, None]] = {}
         self.shed_total = 0
+        # Shared free list of pooled 3-slot entries, recycled across
+        # every ready/waiter heap AND the unacked records: the
+        # enqueue->dequeue->ack cycle reuses one list instead of
+        # allocating a heap tuple at enqueue plus an unacked tuple at
+        # dequeue per eval.
+        self._entry_pool: list = []
         # scheduler type -> ready heap
         self._ready: dict[str, _PendingHeap] = {}
-        # eval id -> (eval, token, attempts) for unacked evals
-        self._unacked: dict[str, tuple[Evaluation, str, int]] = {}
+        # eval id -> [eval, token, attempts] for unacked evals (pooled
+        # 3-slot lists from _entry_pool, returned at ack/nack)
+        self._unacked: dict[str, list] = {}
         # (ns, job) -> in-flight eval id
         self._in_flight: dict[tuple[str, str], str] = {}
         # (ns, job) -> heap of evals waiting behind the in-flight one
@@ -247,8 +298,16 @@ class EvalBroker:
         # then loop forever instead of dead-lettering. Entries still
         # clear at ack/dead-letter; the cap guards pathological churn
         # where evals are acked on OTHER nodes and never clear here.
+        # The eviction keeps counts for ids the broker still TRACKS
+        # (_enqueue_times, cleared below, is exactly that set at this
+        # point): a blanket clear() zeroed live in-flight evals'
+        # delivery counts too, letting a poison eval dodge the
+        # delivery_limit across every leadership bounce.
         if len(self._attempts) > 8192:
-            self._attempts.clear()
+            tracked = self._enqueue_times
+            self._attempts = {
+                k: v for k, v in self._attempts.items() if k in tracked
+            }
         # leadership loss: in-flight traces are abandoned, not recorded
         self._traces.clear()
         self._enqueue_times.clear()
@@ -265,9 +324,26 @@ class EvalBroker:
             self._enqueue_locked(ev.copy())
 
     def enqueue_all(self, evals: list[Evaluation]) -> None:
+        """Batch enqueue: one lock acquisition for the whole batch, one
+        timestamp read, one condition broadcast, and bulk per-type heap
+        admission (append + single heapify) instead of a per-eval
+        sift — the TPU batch producer's hot path. Admission control,
+        per-job serialization, delayed evals, and traces run the exact
+        per-eval logic `enqueue` does; only the ready-heap insertion
+        and the wakeup are batched."""
+        if not evals:
+            return
         with self._lock:
+            if not self._enabled:
+                return
+            bulk: dict[str, list] = {}
+            now_mono = time.monotonic()
             for ev in evals:
-                self._enqueue_locked(ev.copy())
+                self._enqueue_locked(ev.copy(), bulk=bulk, now_mono=now_mono)
+            for stype, ready in bulk.items():
+                self._ready.setdefault(stype, self._heap()).push_all(ready)
+            if bulk:
+                self._cv.notify_all()
 
     # -- admission accounting -------------------------------------------
 
@@ -393,12 +469,19 @@ class EvalBroker:
         self._shed_locked(ev, "depth", tracked=False)
         return False
 
-    def _enqueue_locked(self, ev: Evaluation) -> None:
+    def _enqueue_locked(
+        self,
+        ev: Evaluation,
+        bulk: Optional[dict] = None,
+        now_mono: Optional[float] = None,
+    ) -> None:
         if not self._enabled:
             return
         if not self._admit_locked(ev):
             return
-        self._enqueue_times.setdefault(ev.id, time.monotonic())
+        if now_mono is None:
+            now_mono = time.monotonic()
+        self._enqueue_times.setdefault(ev.id, now_mono)
         if trace.enabled() and ev.id not in self._traces:
             ctx = trace.start_trace(
                 "eval",
@@ -424,18 +507,31 @@ class EvalBroker:
             self._pending_add(ev)
             self._blocked_jobs.setdefault(key, self._heap()).push(ev)
             return
-        self._push_ready(ev)
+        self._push_ready(ev, bulk=bulk, now_mono=now_mono)
 
     def _heap(self) -> _PendingHeap:
-        """A heap sharing the broker's admission tombstone set."""
-        return _PendingHeap(self._dropped)
+        """A heap sharing the broker's admission tombstone set and
+        pooled-entry free list."""
+        return _PendingHeap(self._dropped, self._entry_pool)
 
-    def _push_ready(self, ev: Evaluation) -> None:
+    def _push_ready(
+        self,
+        ev: Evaluation,
+        bulk: Optional[dict] = None,
+        now_mono: Optional[float] = None,
+    ) -> None:
         self._pending_add(ev)
-        self._ready.setdefault(ev.type, self._heap()).push(ev)
-        self._wait_starts[ev.id] = time.monotonic()
+        self._wait_starts[ev.id] = (
+            now_mono if now_mono is not None else time.monotonic()
+        )
         if ev.job_id:
             self._in_flight[(ev.namespace, ev.job_id)] = ev.id
+        if bulk is not None:
+            # enqueue_all collects per-type lists; the caller bulk-pushes
+            # each heap once and broadcasts once after the loop
+            bulk.setdefault(ev.type, []).append(ev)
+            return
+        self._ready.setdefault(ev.type, self._heap()).push(ev)
         self._cv.notify_all()
 
     # -- dequeue / ack / nack -----------------------------------------
@@ -462,7 +558,12 @@ class EvalBroker:
                         token = generate_uuid()
                         attempts = self._attempts.get(ev.id, 0) + 1
                         self._attempts[ev.id] = attempts
-                        self._unacked[ev.id] = (ev, token, attempts)
+                        # pooled unacked record: reuse a free 3-slot
+                        # entry instead of minting a tuple per delivery
+                        pool = self._entry_pool
+                        rec = pool.pop() if pool else [None, None, None]
+                        rec[0], rec[1], rec[2] = ev, token, attempts
+                        self._unacked[ev.id] = rec
                         ready_at = self._wait_starts.pop(ev.id, None)
                         if ready_at is not None:
                             wait_s = time.monotonic() - ready_at
@@ -520,6 +621,9 @@ class EvalBroker:
                 raise ValueError(f"token mismatch or unknown eval {eval_id}")
             del self._unacked[eval_id]
             ev = entry[0]
+            if len(self._entry_pool) < _ENTRY_POOL_CAP:
+                entry[0] = entry[1] = entry[2] = None
+                self._entry_pool.append(entry)
             self._attempts.pop(eval_id, None)
             self._release_job_locked(ev, eval_id)
             tentry = self._traces.pop(eval_id, None)
@@ -549,6 +653,9 @@ class EvalBroker:
                 raise ValueError(f"token mismatch or unknown eval {eval_id}")
             del self._unacked[eval_id]
             ev, _, attempts = entry
+            if len(self._entry_pool) < _ENTRY_POOL_CAP:
+                entry[0] = entry[1] = entry[2] = None
+                self._entry_pool.append(entry)
             key = (ev.namespace, ev.job_id)
             if attempts >= self.delivery_limit:
                 # dead-letter: failed queue for the reaper; the job's waiting

@@ -1499,6 +1499,36 @@ class StateStore(_ReadMixin):
             self._update_job_status_txn(index, ns, job_id)
         return stored
 
+    @staticmethod
+    def _store_rows_py(
+        ids: list,
+        handles: list,
+        idx_list: list,
+        main_t: dict,
+        job_inner: dict,
+        eval_inner: dict,
+        node_inners: dict,
+    ) -> None:
+        """Pure-Python fallback for fastpack.store_rows: group rows per
+        node, preserving row order within a node and first-touch node
+        order — the exact insertion sequence the eager txn produces
+        from a node_allocation dict, so the two paths build
+        byte-identical tables (the identity battery serializes and
+        compares)."""
+        per_node: dict[int, list] = {}
+        for uid, h, ti in zip(ids, handles, idx_list):
+            bucket = per_node.get(ti)
+            if bucket is None:
+                bucket = per_node[ti] = []
+            bucket.append((uid, h))
+        for ti, bucket in per_node.items():
+            node_inner = node_inners[ti]
+            for uid, h in bucket:
+                main_t[uid] = h
+                job_inner[uid] = h
+                eval_inner[uid] = h
+                node_inner[uid] = h
+
     def _owned_inner(self, table: str, key) -> dict:
         """Writable (ownership-checked) inner index dict — the method
         form of _upsert_allocs_txn's per-txn _inner resolver."""
@@ -1531,6 +1561,13 @@ class StateStore(_ReadMixin):
         Rows are all fresh by construction (new uuids; the applier's
         verification preserved that), so the existing-row merge paths
         never apply."""
+        from .. import codec
+
+        # native_module never compiles (codec.warm_native is the one
+        # sanctioned build point, outside any lock — NV-lock-blocking),
+        # so resolving it under the store lock is a cached attribute
+        # read, not a C build.
+        fp = codec.native_module()
         t = self._wtable(TABLE_ALLOCS)
         ut = self._wtable(IDX_NODE_USED)
         pt = self._wtable(IDX_PRIO_COUNT)
@@ -1556,26 +1593,21 @@ class StateStore(_ReadMixin):
             touched = b.touched_nodes()
             for nid, ti, _cnt in touched:
                 node_inners[ti] = self._owned_inner(IDX_ALLOCS_NODE, nid)
-            # group rows per node, preserving row order within a node and
-            # first-touch node order — the exact insertion sequence the
-            # eager txn produces from a node_allocation dict, so the two
-            # paths build byte-identical tables (the identity battery
-            # serializes and compares)
-            idx_list = b.node_idx.tolist()
+            # the four dict inserts per row, node-grouped (first-touch
+            # node order, row order within a node): one C call per
+            # batch when the extension is live, the identical Python
+            # loop when it isn't
             hs = b.handles()
-            per_node: dict[int, list] = {}
-            for uid, h, ti in zip(b.ids, hs, idx_list):
-                bucket = per_node.get(ti)
-                if bucket is None:
-                    bucket = per_node[ti] = []
-                bucket.append((uid, h))
-            for ti, bucket in per_node.items():
-                node_inner = node_inners[ti]
-                for uid, h in bucket:
-                    t[uid] = h
-                    job_inner[uid] = h
-                    eval_inner[uid] = h
-                    node_inner[uid] = h
+            if fp is not None:
+                fp.store_rows(
+                    b.ids, hs, b.node_idx_raw,
+                    t, job_inner, eval_inner, node_inners,
+                )
+            else:
+                self._store_rows_py(
+                    b.ids, hs, b.node_idx.tolist(),
+                    t, job_inner, eval_inner, node_inners,
+                )
             # aggregates: one update per touched node / one per batch
             c = b.row_contribution()
             for nid, _ti, cnt in touched:

@@ -230,8 +230,15 @@ class PlacementBatch:
         return [self.row(i) for i in range(len(self.ids))]
 
     def handles(self) -> list["AllocRow"]:
-        """One lazy store-table handle per row."""
-        return [AllocRow(self, i) for i in range(len(self.ids))]
+        """One lazy store-table handle per row. Cached: the columns are
+        immutable once built, and both the plan applier and the store
+        txn ask for the same handle list."""
+        cached = getattr(self, "_handles", None)
+        if cached is not None:
+            return cached
+        out = [AllocRow(self, i) for i in range(len(self.ids))]
+        self._handles = out
+        return out
 
     # -- wire fold (codec._enc_plan_result) -----------------------------
 

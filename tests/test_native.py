@@ -103,6 +103,62 @@ def test_native_fallback_parity_pick_ports():
         ) == _pick_ports_py(taken, 6, seed)
 
 
+def test_native_fallback_parity_store_rows():
+    """The C bulk id-index insert and the pure-Python loop produce the
+    same four tables with the same INSERTION ORDER (first-touch node
+    order, row order within a node — dict order is what the store
+    serializes)."""
+    fp = _warmed()
+    import numpy as np
+
+    from nomad_tpu.state.store import StateStore
+
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, 5, size=64, dtype=np.int32)
+    ids = [f"id-{i:03d}" for i in range(64)]
+    handles = [object() for _ in range(64)]
+
+    c_tabs = ({}, {}, {}, {t: {} for t in range(5)})
+    fp.store_rows(ids, handles, idx.tobytes(), *c_tabs)
+    py_tabs = ({}, {}, {}, {t: {} for t in range(5)})
+    StateStore._store_rows_py(ids, handles, idx.tolist(), *py_tabs)
+
+    assert c_tabs == py_tabs
+    assert list(c_tabs[0]) == list(py_tabs[0])  # main-table order
+    for t in range(5):
+        assert list(c_tabs[3][t]) == list(py_tabs[3][t])
+
+
+def test_native_store_rows_rejects_bad_input():
+    fp = _warmed()
+    with pytest.raises(ValueError):  # column length mismatch
+        fp.store_rows(["a"], [], b"\0\0\0\0", {}, {}, {}, {})
+    with pytest.raises(ValueError):  # negative node index
+        fp.store_rows(["a"], [1], b"\xff\xff\xff\xff", {}, {}, {}, {0: {}})
+    with pytest.raises(KeyError):  # missing node inner
+        fp.store_rows(["a"], [1], b"\x02\0\0\0", {}, {}, {}, {0: {}})
+
+
+def test_compile_smoke_script_fresh_build(tmp_path):
+    """scripts/fastpack_smoke.py: cold-cache gcc build + import +
+    identity spot-checks. Wired into tier-1 so a broken C toolchain
+    fails loudly instead of silently demoting every hot path to the
+    fallbacks."""
+    _warmed()  # skip (not fail) on boxes with no toolchain at all
+    env = dict(os.environ, NOMAD_TPU_BIN_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    env.pop("NOMAD_TPU_NO_FASTPACK", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "fastpack_smoke.py")],
+        capture_output=True, text=True, cwd=str(REPO), timeout=240,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fastpack smoke OK" in proc.stdout
+    # the build really happened in the fresh dir (cold cache)
+    assert list(tmp_path.glob("fastpack-*.so"))
+
+
 _FALLBACK_SCRIPT = r"""
 import os
 os.environ["NOMAD_TPU_NO_FASTPACK"] = "1"

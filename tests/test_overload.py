@@ -239,6 +239,132 @@ class TestBrokerAdmission:
         assert b.pending_count() == 2
 
 
+class TestBatchEnqueueChurn:
+    """The batched enqueue_all path (one lock acquisition, bulk
+    heapify, pooled heap entries) preserves the round-11 admission
+    semantics per-eval enqueue established; and the `_attempts`
+    overflow eviction keeps live delivery counts instead of the old
+    blanket clear()."""
+
+    def _mixed(self):
+        return [
+            mock.evaluation(job_id="low-old", priority=10),
+            mock.evaluation(job_id="low-new", priority=10),
+            mock.evaluation(job_id="mid", priority=50),
+            mock.evaluation(job_id="hi", priority=90),
+        ]
+
+    def test_enqueue_all_matches_serial_admission(self):
+        serial = EvalBroker(admission_depth=3)
+        serial.set_enabled(True)
+        batch = EvalBroker(admission_depth=3)
+        batch.set_enabled(True)
+        evs = self._mixed()
+        for ev in evs:
+            serial.enqueue(ev)
+        batch.enqueue_all([ev.copy() for ev in evs])
+        assert batch.pending_count() == serial.pending_count() == 3
+        assert batch.shed_total == serial.shed_total == 1
+        assert [e.job_id for e in drain(batch)] == [
+            e.job_id for e in drain(serial)
+        ]
+
+    def test_enqueue_all_displacement_within_one_batch(self):
+        """A high-priority eval later in the SAME batch displaces the
+        oldest lowest-priority eval admitted earlier in it."""
+        b = EvalBroker(admission_depth=3)
+        b.set_enabled(True)
+        b.enqueue_all(self._mixed())
+        assert b.pending_count() == 3
+        served = [e.job_id for e in drain(b)]
+        assert "low-old" not in served
+        assert set(served) == {"low-new", "mid", "hi"}
+
+    def test_enqueue_all_namespace_fairness(self, fresh_registry):
+        b = EvalBroker(namespace_cap=2)
+        b.set_enabled(True)
+        b.enqueue_all(
+            [
+                mock.evaluation(job_id=f"greedy{i}", namespace="big")
+                for i in range(5)
+            ]
+            + [mock.evaluation(job_id="small0", namespace="small")]
+        )
+        assert b.namespace_pending("big") == 2
+        assert b.namespace_pending("small") == 1
+        counters = fresh_registry.snapshot()["counters"]
+        assert counters["nomad.broker.shed.namespace"] == 3
+
+    def test_enqueue_all_per_job_serialization(self):
+        """Duplicate-job evals inside one batch wait behind the first
+        (the per-job in-flight slot), exactly as with serial enqueue."""
+        b = EvalBroker()
+        b.set_enabled(True)
+        first = mock.evaluation(job_id="A")
+        waiter = mock.evaluation(job_id="A")
+        b.enqueue_all([first, waiter, mock.evaluation(job_id="B")])
+        got = drain(b)
+        assert [e.id for e in got] == [first.id, mock_id(got, "B"), waiter.id]
+
+    def test_enqueue_all_priority_order_preserved(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        evs = [
+            mock.evaluation(job_id=f"j{i}", priority=p)
+            for i, p in enumerate([10, 90, 50, 90, 20])
+        ]
+        b.enqueue_all(evs)
+        served = [e.priority for e in drain(b)]
+        assert served == sorted(served, reverse=True)
+        # equal priorities keep FIFO arrival order
+        b.enqueue_all(
+            [mock.evaluation(job_id=f"f{i}", priority=50) for i in range(4)]
+        )
+        assert [e.job_id for e in drain(b)] == ["f0", "f1", "f2", "f3"]
+
+    def test_attempts_eviction_keeps_live_counts(self):
+        """The `_attempts` overflow path evicts only ids the broker no
+        longer tracks; a live in-flight eval keeps its delivery count
+        across the flush so the delivery_limit cannot be bypassed."""
+        b = EvalBroker(delivery_limit=2, nack_delay_s=0.0)
+        b.set_enabled(True)
+        ev = mock.evaluation(job_id="poison")
+        b.enqueue(ev)
+        got, tok = b.dequeue(["service"], timeout_s=1)
+        assert got.id == ev.id and b._attempts[ev.id] == 1
+        # pathological churn: >8192 stale ids from evals acked elsewhere
+        for i in range(8300):
+            b._attempts[f"stale-{i}"] = 1
+        b.set_enabled(False)  # flush hits the overflow eviction
+        b.set_enabled(True)
+        assert len(b._attempts) == 1, "stale ids must be evicted"
+        assert b._attempts[ev.id] == 1, "live delivery count must survive"
+        # redelivery now crosses the limit -> dead-letter, not a loop
+        b.enqueue(ev.copy())
+        got2, tok2 = b.dequeue(["service"], timeout_s=1)
+        assert got2.id == ev.id and b._attempts[ev.id] == 2
+        b.nack(ev.id, tok2)
+        assert b.stats["failed"] == 1
+
+    def test_pooled_entries_never_leak_between_evals(self):
+        """Heap-entry/unacked-record pooling must not let one eval's
+        identity bleed into another's delivery."""
+        b = EvalBroker(nack_delay_s=0.0)
+        b.set_enabled(True)
+        for round_ in range(3):
+            evs = [
+                mock.evaluation(job_id=f"r{round_}-j{i}") for i in range(50)
+            ]
+            b.enqueue_all(evs)
+            served = drain(b)
+            assert sorted(e.id for e in served) == sorted(e.id for e in evs)
+        assert b.pending_count() == 0
+
+
+def mock_id(served, job_id):
+    return next(e.id for e in served if e.job_id == job_id)
+
+
 def fresh_or_zero(name: str) -> int:
     return metrics.registry().snapshot()["counters"].get(name, 0)
 
@@ -540,6 +666,15 @@ class TestFrontDoor429:
         from nomad_tpu.api.client import APIError, NomadClient
 
         srv = overload_agent.server.server
+        # leadership establishment starts the workers ASYNCHRONOUSLY
+        # (server._establish_leadership): stopping them before it runs
+        # just resurrects them mid-test, and the zombies drain the eval
+        # meant to saturate the broker. _leader flips True only after
+        # the workers started, so wait for it before stopping them.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not srv._leader:
+            time.sleep(0.01)
+        assert srv._leader, "dev-mode agent never became leader"
         # stop the workers so pending grows, then saturate
         for w in srv.workers:
             w.stop()

@@ -459,14 +459,27 @@ def _alloc_bytes(state):
     return {a.id: codec.pack(a) for a in state.allocs()}
 
 
+@pytest.mark.parametrize("native", ["c", "fallback"])
 @pytest.mark.parametrize("mode", ["serial", "batch", "queue"])
-def test_soa_vs_eager_identity(mode, monkeypatch):
+def test_soa_vs_eager_identity(mode, native, monkeypatch):
     """Raft entries and store state are byte-identical between the SoA
     and eager paths, across the merged-plan-apply matrix (serial
     apply_one, merged apply_batch, and the queue's enqueue_batch
-    routing). Wall-clock stamps are pinned so the two runs are
+    routing) — with the store's bulk id-index insert running through
+    the fastpack C entry point AND force-disabled onto the pure-Python
+    loop. Wall-clock stamps are pinned so the two runs are
     bit-comparable."""
     import nomad_tpu.state.store as store_mod
+
+    if native == "c":
+        if not codec.warm_native():
+            pytest.skip("no C toolchain on this box")
+        assert codec.native_module() is not None
+    else:
+        # force the fallback: native_module() -> None, so
+        # _upsert_batches_txn takes _store_rows_py
+        monkeypatch.setattr(codec, "_fastpack", False)
+        assert codec.native_module() is None
 
     monkeypatch.setattr(store_mod, "now_ns", lambda: 1_234_567_890)
 
