@@ -1766,6 +1766,200 @@ def run_c2m_sharded_config():
     }
 
 
+POOL_CAVEAT_TEXT = (
+    "c2m_pool models each solver-pool member as a RemoteSolver with its "
+    "OWN SchedulerConfig under the injected-latency device model "
+    "(docs/solver-pool.md): the serially-busy `_device_free_at` queue is "
+    "per-config, so every member is an independent chip exactly as a "
+    "real pool member's device is. Members share one state store (the "
+    "perfectly-synced-replica limit — production replicas trail by a "
+    "raft beat, which the warm loop's delta sync bounds), so the ratio "
+    "isolates PLACEMENT-PLANE capacity: it proves the dispatch fan-out "
+    "and per-member resident state scale, not the replication fabric."
+)
+
+
+def run_c2m_pool_config():
+    """Solver-pool horizontal-scaling bench (docs/solver-pool.md): the
+    same c2m-shaped eval stream dispatched to a pool of 1 vs 2 warm
+    RemoteSolver members, each an independent serially-busy chip under
+    the injected-latency model. Gates committed-eval throughput at
+    >= 1.5x from one member to two.
+
+    The drive loop mirrors the leader's TPUBatchWorker dispatch: each
+    mega-batch goes to a pool member on its own thread (the SolverPool
+    dispatch-thread idiom), the 'leader' submits plan columns as batches
+    land, and up to pool-size batches stay in flight. A single member
+    serializes batches on its solve lock + device window; two members
+    overlap two batches — the ratio IS the placement-plane scaling.
+
+    Drift-normalized (the c2m verdict discipline): pool sizes interleave
+    ABBA within one process, so this box's co-tenancy drift hits both
+    sides equally and the RATIO is trustworthy even when raw rates are
+    not. Each trial rebuilds cluster state fresh so trial N's accumulated
+    allocs never tax trial N+1's snapshots asymmetrically."""
+    import queue as _queue
+    import threading as _threading
+
+    from nomad_tpu.gctune import freeze_resident_heap, paused_gc
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.context import SchedulerConfig
+    from nomad_tpu.scheduler.tpu.remote_solve import RemoteSolver
+
+    n_nodes = int(os.environ.get("BENCH_POOL_NODES", "2000"))
+    n_jobs = int(os.environ.get("BENCH_POOL_JOBS", "8"))
+    count = int(os.environ.get("BENCH_POOL_COUNT", "100"))
+    rtt = float(os.environ.get("BENCH_POOL_RTT_S", "0.8"))
+    n_batches = int(os.environ.get("BENCH_POOL_BATCHES", "6"))
+    pairs = int(os.environ.get("BENCH_POOL_PAIRS", "2"))
+    pool_sizes = (1, 2)
+    gate = float(os.environ.get("BENCH_POOL_SCALING_GATE", "1.5"))
+    log(
+        f"[c2m_pool] {n_nodes} nodes, {n_batches} batches of {n_jobs} "
+        f"jobs x {count}, pool sizes {pool_sizes}, device model "
+        f"{rtt}s/batch per member, {pairs} interleaved trial pairs"
+    )
+
+    class _Host:
+        """RemoteSolver host duck-type: the bench's shared store stands
+        in for every member's raft replica (POOL_CAVEAT_TEXT)."""
+
+        def __init__(self, state):
+            self.state = state
+
+    def run_trial(pool_size: int) -> float:
+        """One trial: fresh cluster, fresh members, one unmeasured warm
+        batch per member (compile + full resident sync), then n_batches
+        dispatched round-robin with pool_size in flight. Returns
+        committed evals/s over the measured window."""
+        gc.collect()
+        h, _ = build_cluster(
+            n_nodes, n_jobs, count, False, job_prefix=f"pool{pool_size}-warm"
+        )
+        freeze_resident_heap()
+        host = _Host(h.state)
+        members = [
+            RemoteSolver(
+                host,
+                config=SchedulerConfig(
+                    backend="tpu",
+                    small_batch_threshold=0,
+                    inject_device_latency_s=rtt,
+                ),
+                node_id=f"bench-m{i}",
+            )
+            for i in range(pool_size)
+        ]
+        # warm OUTSIDE the injected-latency model: one batch per member
+        # compiles the kernels (first trial only — the jit cache is
+        # process-wide) and takes the full resident upload, so every
+        # measured batch rides the delta-sync path on a warm replica
+        for i, m in enumerate(members):
+            m.config.inject_device_latency_s = 0.0
+            warm_jobs = add_jobs(
+                h, n_jobs, count, False, job_prefix=f"pool{pool_size}-w{i}"
+            )
+            warm_evals = [mock.eval_for_job(j) for j in warm_jobs]
+            out = m.solve(warm_evals, h.snapshot().index, timeout_s=60.0)
+            for ev in warm_evals:
+                h.submit_plan(out["plans"][ev.id])
+            m.config.inject_device_latency_s = rtt
+        batches = [
+            [
+                mock.eval_for_job(j)
+                for j in add_jobs(
+                    h, n_jobs, count, False,
+                    job_prefix=f"pool{pool_size}-b{b}",
+                )
+            ]
+            for b in range(n_batches)
+        ]
+        min_index = h.snapshot().index
+        done_q: _queue.Queue = _queue.Queue()
+
+        def dispatch(i: int, member, evals) -> None:
+            try:
+                done_q.put((i, member.solve(
+                    evals, min_index, timeout_s=rtt * n_batches + 60.0
+                ), None))
+            except Exception as e:  # noqa: BLE001 - surfaced on the drive loop
+                done_q.put((i, None, e))
+
+        t0 = time.perf_counter()
+        with paused_gc(freeze_on_exit=True):
+            next_b = 0
+            in_flight = 0
+            completed = 0
+            while completed < n_batches:
+                # keep pool_size batches in flight, round-robin — the
+                # least-in-flight pick SolverPool makes degenerates to
+                # round-robin under uniform batch cost
+                while next_b < n_batches and in_flight < pool_size:
+                    _threading.Thread(
+                        target=dispatch,
+                        args=(next_b, members[next_b % pool_size],
+                              batches[next_b]),
+                        name=f"bench-pool-dispatch-{next_b}",
+                        daemon=True,
+                    ).start()
+                    next_b += 1
+                    in_flight += 1
+                i, out, err = done_q.get()
+                if err is not None:
+                    raise err
+                # the 'leader' commits: plan columns apply on the
+                # authoritative store, exactly RemotePendingBatch.finish
+                for ev in batches[i]:
+                    h.submit_plan(out["plans"][ev.id])
+                in_flight -= 1
+                completed += 1
+        wall = time.perf_counter() - t0
+        rate = (n_batches * n_jobs) / wall
+        assert all(m.warmups == 1 for m in members), (
+            "pool members must warm exactly once, before measurement"
+        )
+        log(
+            f"[c2m_pool] pool={pool_size}: {rate:.3f} evals/s "
+            f"({n_batches} batches in {wall:.2f}s, member solves "
+            f"{[m.solves for m in members]}, syncs "
+            f"{[m.last_sync for m in members]})"
+        )
+        return rate
+
+    # ABBA interleave: linear host drift cancels between the sides
+    order: list = []
+    for p in range(pairs):
+        order.extend(pool_sizes if p % 2 == 0 else pool_sizes[::-1])
+    rates: dict = {s: [] for s in pool_sizes}
+    for size in order:
+        rates[size].append(run_trial(size))
+    per_pool = {
+        str(s): {
+            "members": s,
+            "trial_evals_per_s": [round(r, 3) for r in rates[s]],
+            "evals_per_s": round(median(rates[s]), 3),
+            "spread_pct": spread_pct(rates[s]),
+        }
+        for s in pool_sizes
+    }
+    s1, s2 = pool_sizes
+    scaling = per_pool[str(s2)]["evals_per_s"] / max(
+        per_pool[str(s1)]["evals_per_s"], 1e-9
+    )
+    log(
+        f"[c2m_pool] scaling {scaling:.3f}x from {s1} -> {s2} members "
+        f"(gate >= {gate})"
+    )
+    return {
+        "tpu_evals_per_s": per_pool[str(s2)]["evals_per_s"],
+        "per_pool": per_pool,
+        "pool_scaling": round(scaling, 4),
+        "pool_scaling_gate": gate,
+        "device_model_rtt_s": rtt,
+        "caveat": POOL_CAVEAT_TEXT,
+    }
+
+
 def _run_sharded_subprocess() -> dict:
     """Run the c2m_sharded config in a child process so ITS backend can
     be forced to 8 virtual devices without the parent paying for it:
@@ -1882,7 +2076,8 @@ def main():
         _trace.configure(max_traces=256, enabled_=True)
     names = (
         ["smoke", "smoke_interactive", "c1k", "c2m", "c2m_sharded",
-         "preempt", "drain", "plan_apply", "pipeline", "soak"]
+         "c2m_pool", "preempt", "drain", "plan_apply", "pipeline",
+         "soak"]
         if sel == "all"
         else [sel]
     )
@@ -1921,6 +2116,8 @@ def main():
                 results[name] = _run_sharded_subprocess()
                 continue
             results[name] = run_c2m_sharded_config()
+        elif name == "c2m_pool":
+            results[name] = run_c2m_pool_config()
         elif name == "smoke_interactive":
             results[name] = run_smoke_interactive_config()
         elif name == "preempt":
@@ -1995,6 +2192,14 @@ def main():
                 mode.startswith("full")
                 for mesh in r["per_mesh"].values()
                 for mode in mesh["resident_sync_modes"][1:]
+            )
+        # solver-pool horizontal-scaling gate (docs/solver-pool.md):
+        # committed-eval throughput from 1 -> 2 warm pool members must
+        # hold >= 1.5x under the per-member serially-busy device model;
+        # drift-normalized by the config's ABBA trial interleave
+        if "pool_scaling" in r:
+            gates["pool_scaling"] = (
+                r["pool_scaling"] >= r["pool_scaling_gate"]
             )
         # drift-immune throughput gates (ISSUE 16): both gate on the
         # PAIRED control-normalized statistic, never the raw rate —

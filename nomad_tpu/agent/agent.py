@@ -228,6 +228,15 @@ class AgentConfig:
     http_rate_burst: float = 0.0
     rpc_rate_limit: float = 0.0
     rpc_rate_burst: float = 0.0
+    # solver_pool stanza (the warm placement tier, docs/solver-pool.md;
+    # SIGHUP-reloadable): solver_pool { role members sync_interval }.
+    # role "solver" advertises this server as a pool member (serf tag
+    # solver=1) and runs the periodic resident-state warm loop; members
+    # is an optional static allowlist of node names; sync_interval is
+    # the member-side delta-sync period.
+    solver_pool_role: str = ""
+    solver_pool_members: tuple = ()
+    solver_pool_sync_interval_s: float = 2.0
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -315,6 +324,9 @@ class Agent:
                 data_dir=None if config.dev_mode else config.data_dir,
                 acl_enforce=config.acl_enabled,
                 tls=self.fabric_tls,
+                solver_pool_role=config.solver_pool_role,
+                solver_pool_members=config.solver_pool_members,
+                solver_pool_sync_interval_s=config.solver_pool_sync_interval_s,
             )
             self.server.server.vault_allowed_policies = (
                 list(config.vault_allowed_policies)
@@ -640,6 +652,22 @@ class Agent:
                 changed.append("broker")
             if limits_changed:
                 changed.append("limits")
+        pool_keys = (
+            "solver_pool_role",
+            "solver_pool_members",
+            "solver_pool_sync_interval_s",
+        )
+        if self.server is not None and any(
+            getattr(new_config, k) != getattr(old, k) for k in pool_keys
+        ):
+            self.server.solver_pool.configure(
+                new_config.solver_pool_role,
+                members=new_config.solver_pool_members,
+                sync_interval_s=new_config.solver_pool_sync_interval_s,
+            )
+            for k in pool_keys:
+                setattr(old, k, getattr(new_config, k))
+            changed.append("solver_pool")
         if (
             self.server is not None
             and new_config.vault_allowed_policies != old.vault_allowed_policies

@@ -1345,19 +1345,27 @@ class HTTPAgentServer:
                 kmod.jit_cache_sizes() if kmod is not None else None
             )
             w = getattr(srv, "tpu_worker", None)
-            out["worker"] = (
-                {
-                    "pipeline": w.pipeline,
-                    "batch_size": w.batch_size,
-                    "processed": w.processed,
-                    "schedulers": list(w.schedulers),
-                }
-                if w is not None
-                else None
-            )
+            out["worker"] = w.stats_snapshot() if w is not None else None
+            # solver-pool tier: membership + per-member in-flight for
+            # the operator-top panel (cheap local snapshot; the fan-out
+            # aggregation lives on /v1/solver/pool)
+            pool = getattr(self.cluster, "solver_pool", None)
+            out["pool"] = pool.stats_snapshot() if pool is not None else None
             return out
 
         route("GET", "/v1/solver/status", solver_status)
+
+        def solver_pool_status(p, q, body, tok):
+            # /v1/solver/pool: the pool tracker's snapshot plus each
+            # member's own SolverPool.Status, pulled with a bounded
+            # per-member deadline (docs/solver-pool.md). Same agent:read
+            # gate as /v1/solver/status via the /v1/solver ACL prefix.
+            pool = getattr(self.cluster, "solver_pool", None)
+            if pool is None:
+                raise HTTPError(404, "no solver pool on this agent")
+            return pool.pool_status()
+
+        route("GET", "/v1/solver/pool", solver_pool_status)
 
         def profile_status(p, q, body, tok):
             # /v1/profile/status: the always-on host profiler's summary

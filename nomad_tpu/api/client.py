@@ -815,6 +815,13 @@ class AgentAPI(_Resource):
         `operator solver status|top`."""
         return self.c.get("/v1/solver/status")
 
+    def solver_pool(self):
+        """Solver-pool tier snapshot (/v1/solver/pool): membership +
+        health, leader-side dispatch stats, and each member's own warm
+        solver counters (nomad_tpu/server/solver_pool.py); rendered by
+        `operator solver pool status`."""
+        return self.c.get("/v1/solver/pool")
+
     def profile_status(self, top: int = 50):
         """Host profiler summary (/v1/profile/status): span-correlated
         CPU self-time sites, GC pause/collection telemetry, lock-wait

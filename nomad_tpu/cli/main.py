@@ -307,6 +307,21 @@ def _load_agent_config(path: str):
         cfg.http_rate_burst = float(lma.get("http_burst", 0) or 0)
         cfg.rpc_rate_limit = float(lma.get("rpc_rate", 0) or 0)
         cfg.rpc_rate_burst = float(lma.get("rpc_burst", 0) or 0)
+    spb = body.block("solver_pool")
+    if spb is not None:
+        from ..jobspec.hcl import parse_duration
+
+        spa = spb.body.attrs()
+        if "role" in spa:
+            cfg.solver_pool_role = str(spa["role"])
+        if "members" in spa:
+            cfg.solver_pool_members = tuple(
+                str(m) for m in (spa["members"] or [])
+            )
+        if "sync_interval" in spa:
+            cfg.solver_pool_sync_interval_s = parse_duration(
+                spa["sync_interval"]
+            )
     for plug in body.blocks("plugin"):
         name = plug.labels[0] if plug.labels else ""
         ref = plug.body.attrs().get("factory", "")
@@ -392,6 +407,19 @@ def _apply_config_dict(cfg, data: dict) -> None:
             cfg.http_rate_burst = float(v.get("http_burst", 0) or 0)
             cfg.rpc_rate_limit = float(v.get("rpc_rate", 0) or 0)
             cfg.rpc_rate_burst = float(v.get("rpc_burst", 0) or 0)
+        elif k == "solver_pool" and isinstance(v, dict):
+            from ..jobspec.hcl import parse_duration
+
+            if "role" in v:
+                cfg.solver_pool_role = str(v["role"])
+            if "members" in v:
+                cfg.solver_pool_members = tuple(
+                    str(m) for m in (v["members"] or [])
+                )
+            if "sync_interval" in v:
+                cfg.solver_pool_sync_interval_s = parse_duration(
+                    v["sync_interval"]
+                )
         elif k == "ports" and isinstance(v, dict):
             cfg.http_port = v.get("http", 0)
             cfg.rpc_port = v.get("rpc", 0)
@@ -2216,6 +2244,34 @@ def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
                 else "   device p95 -"
             )
         )
+        # solver-pool row (only-when-nonzero, like the overload rows):
+        # membership with per-member in-flight counts shown only for
+        # members that actually hold a dispatched batch right now
+        pool = (solver or {}).get("pool") or {}
+        pmembers = [
+            m for m in pool.get("members") or [] if not m.get("self")
+        ]
+        if pmembers or pool.get("dispatched"):
+            mem_txt = " ".join(
+                f"{m['id']}:{m['in_flight']}"
+                if m.get("in_flight")
+                else str(m["id"])
+                for m in pmembers
+            ) or "-"
+            lines.append(
+                f"SolverPool  members {len(pmembers)} [{mem_txt}]"
+                f"   dispatched {pool.get('dispatched', 0)}"
+                + (
+                    f"   in-flight {pool['in_flight']}"
+                    if pool.get("in_flight")
+                    else ""
+                )
+                + (
+                    f"   faults {pool['faults']}"
+                    if pool.get("faults")
+                    else ""
+                )
+            )
     # host-attribution row (always-on profiler, hostobs.py): rendered
     # only when the profiler has actually attributed something — busy
     # samples or GC activity (the only-render-when-nonzero pattern the
@@ -2631,7 +2687,77 @@ def _render_solver_status(snap: dict) -> str:
             "jit cache (jax ground truth): "
             + "  ".join(f"{k}={v}" for k, v in sorted(jit.items()))
         )
+    pool = snap.get("pool") or {}
+    if (pool.get("members") or pool.get("dispatched")
+            or pool.get("role")):
+        lines.append("")
+        lines.append(_render_solver_pool(pool))
     return "\n".join(lines)
+
+
+def _render_solver_pool(pool: dict) -> str:
+    """The solver-pool section shared by `operator solver status` and
+    `operator solver pool status` (docs/solver-pool.md)."""
+    lines = [
+        f"Solver pool role {pool.get('role') or '-'}"
+        f"   dispatched {pool.get('dispatched', 0)}"
+        f"   completed {pool.get('completed', 0)}"
+        f"   fallback-local {pool.get('fallback_local', 0)}"
+        + (
+            f"   faults {pool['faults']}" if pool.get("faults") else ""
+        )
+        + (
+            f"   aborted {pool['aborted']}" if pool.get("aborted") else ""
+        )
+    ]
+    rows = []
+    for m in pool.get("members") or []:
+        remote = m.get("remote") or {}
+        rows.append([
+            str(m["id"]) + (" (self)" if m.get("self") else ""),
+            m.get("status", "-"),
+            str(m.get("in_flight", 0)),
+            str(m.get("dispatched", 0)),
+            str(m.get("faults", 0)),
+            str(remote.get("warmups", "-")),
+            str(remote.get("solves", "-")),
+            str(remote.get("last_sync", "-")),
+        ])
+    if rows:
+        lines.append(_fmt_table(
+            rows,
+            ["MEMBER", "STATUS", "IN-FLIGHT", "DISPATCHED", "FAULTS",
+             "WARMUPS", "SOLVES", "LAST-SYNC"],
+        ))
+    else:
+        lines.append("no pool members advertised (serf tag solver=1)")
+    local = pool.get("local")
+    if local:
+        lines.append(
+            f"local solver: warmups {local.get('warmups', 0)}"
+            f"  solves {local.get('solves', 0)}"
+            f"  syncs {local.get('syncs', 0)}"
+            f"  last sync {local.get('last_sync', 'cold')}"
+        )
+    return "\n".join(lines)
+
+
+def cmd_operator_solver_pool_status(args) -> int:
+    """Render /v1/solver/pool: pool membership + health, leader-side
+    dispatch stats, and each member's own warm-solver counters
+    (docs/solver-pool.md; runbook operations.md § Scaling the placement
+    plane)."""
+    import json as _json
+
+    api = _client(args)
+    snap = api.agent.solver_pool()
+    if args.as_json:
+        print(_json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    print("nomad-tpu solver pool")
+    print("")
+    print(_render_solver_pool(snap))
+    return 0
 
 
 def cmd_operator_solver_status(args) -> int:
@@ -3624,6 +3750,16 @@ def build_parser() -> argparse.ArgumentParser:
     opstp.add_argument("-once", action="store_true",
                        help="render a single frame and exit")
     opstp.set_defaults(fn=cmd_operator_solver_top)
+    oppool = opsolsub.add_parser(
+        "pool", help="solver-pool tier (/v1/solver/pool)"
+    )
+    oppoolsub = oppool.add_subparsers(dest="subsubsubcmd")
+    opplst = oppoolsub.add_parser(
+        "status",
+        help="pool membership, dispatch stats, per-member warm solvers",
+    )
+    opplst.add_argument("-json", action="store_true", dest="as_json")
+    opplst.set_defaults(fn=cmd_operator_solver_pool_status)
     opprof = opsub.add_parser(
         "profile", help="continuous host profiler (/v1/profile/status)"
     )

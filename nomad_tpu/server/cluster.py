@@ -1138,6 +1138,9 @@ class ClusterServer:
         authoritative_region: Optional[str] = None,
         acl_replication_interval_s: float = 0.5,
         tls=None,  # (server_ctx, client_ctx) from rpc.tls.fabric_contexts
+        solver_pool_role: str = "",
+        solver_pool_members=(),
+        solver_pool_sync_interval_s: float = 2.0,
         **raft_kw,
     ) -> None:
         self.node_id = node_id
@@ -1289,6 +1292,22 @@ class ClusterServer:
             on_event=self._on_member_event,
         )
         self.rpc.register("Serf", self.serf.endpoint)
+        # Solver-pool tier (server/solver_pool.py): membership hangs off
+        # the serf ring above (tag solver=1); the endpoint serves warm
+        # remote solves; the leader's TPU worker dispatches through the
+        # tracker. Constructed AFTER serf so role="solver" can advertise
+        # on the local member record before gossip starts.
+        from .solver_pool import SolverPool
+
+        self.solver_pool = SolverPool(
+            self,
+            role=solver_pool_role,
+            members=solver_pool_members,
+            sync_interval_s=solver_pool_sync_interval_s,
+        )
+        self.rpc.register("SolverPool", self.solver_pool.endpoint)
+        if getattr(self.server, "tpu_worker", None) is not None:
+            self.server.tpu_worker.solver_pool = self.solver_pool
         # Member events are handled on a dedicated reconciler thread:
         # add_peer/remove_peer block on raft commit (up to 10s with no
         # quorum), which must never stall the gossip probe loop.
@@ -1803,6 +1822,12 @@ class ClusterServer:
             if self._acl_repl_stop is not None:
                 self._acl_repl_stop.set()
                 self._acl_repl_stop = None
+            # Abort in-flight pool dispatches BEFORE stopping the worker:
+            # revoke_leadership joins the commit stage, whose finish()
+            # may be blocked on a remote solve — the abort resolves it
+            # promptly and the batch NACKS (redelivers on the new
+            # leader) instead of dropping or stalling the revoke.
+            self.solver_pool.abort_inflight()
             self.server.revoke_leadership()
 
     def _acl_replication_loop(self, stop: threading.Event) -> None:
@@ -2093,6 +2118,7 @@ class ClusterServer:
         self.rpc.start()
         self.raft.start()
         self.serf.start()
+        self.solver_pool.start()
 
     def join(self, seeds: list[tuple[str, int]]) -> int:
         """Gossip-join an existing cluster (reference `nomad server join` /
@@ -2108,6 +2134,10 @@ class ClusterServer:
         # per region; regions meet only at RPC forwarding).
         if (member.tags.get("region") or "global") != self.region:
             return
+        # Pool health rides the same gossip events: a confirmed-dead
+        # solver member fails its in-flight dispatches immediately
+        # (solver_pool.py) instead of waiting out the RPC timeout.
+        self.solver_pool.on_member_event(kind, member)
         # Initial bootstrap: once bootstrap_expect servers see each other,
         # every one of them derives the SAME peer map from gossip and raft
         # elections begin (reference serf.go maybeBootstrap). Cheap — runs
@@ -2163,6 +2193,7 @@ class ClusterServer:
     def shutdown(self) -> None:
         was_leader = self.raft.is_leader()
         self._close_reverse_sessions()
+        self.solver_pool.stop()
         self.serf.stop()
         self._reconcile_q.put(None)
         self.raft.stop()

@@ -354,6 +354,12 @@ class TPUBatchWorker:
         # deltas into the owning shard. Built lazily at the first solve
         # (jax stays unloaded until the TPU path actually runs).
         self._resident = None
+        # Solver-pool tier (server/solver_pool.py): when the cluster
+        # attaches a tracker here, mega-batch drains dispatch to warm
+        # remote members instead of the local device; the interactive
+        # lane and the empty-pool case keep the local path. None on a
+        # standalone Server (no cluster/pool).
+        self.solver_pool = None
         # Shared NotLeaderError backoff across the commit stage (see
         # Worker._run): a revoke window must throttle, not hot-loop.
         self._nl_backoff = WORKER_POLICY.backoff()
@@ -460,6 +466,25 @@ class TPUBatchWorker:
         # a stopped worker object stays referenced by the server; don't
         # let it pin the last batch's device tensors and snapshot
         self._prev = None
+
+    def stats_snapshot(self) -> dict:
+        """Live pipeline depth for /v1/solver/status and the operator-top
+        solver panel (same idiom as the broker/plan-queue stats
+        surfaces): reads live structures only, no locks beyond the
+        queue's own."""
+        prev = self._prev
+        return {
+            "pipeline": self.pipeline,
+            "batch_size": self.batch_size,
+            "processed": self.processed,
+            "schedulers": list(self.schedulers),
+            "commit_queue_depth": self._commit_q.qsize(),
+            "chain_in_flight": bool(prev is not None and not prev[1].is_set()),
+            "held_interactive": self._held is not None,
+            "lane_ledger_len": len(self._lane_ledger),
+            "submit_ewma_s": round(self.backpressure.submit_ewma_s, 6),
+            "lane_priority": self.lane_priority,
+        }
 
     # -- solve stage ----------------------------------------------------
 
@@ -780,6 +805,29 @@ class TPUBatchWorker:
             # injected dispatch-stage fault: surfaces through the solve
             # stage's existing failure path (nack + redeliver)
             faultplane.plane.on_device("dispatch")
+        # Dispatch policy (docs/solver-pool.md): mega-batch drains route
+        # to the solver pool when a healthy member exists; the
+        # interactive lane (allow_chain=False — the host-microsolve
+        # path) always solves locally. A remote batch never consumes
+        # the local used' chain: overlapping remote solves serialize
+        # through the applier's plan verification instead, so
+        # chained_on is dropped (the parent's verdict must not nack a
+        # batch that never saw its tensor).
+        if allow_chain and self.solver_pool is not None:
+            with trace.span(
+                trace.current(), "solver.pool.dispatch", evals=len(evals)
+            ):
+                remote = self.solver_pool.dispatch_batch(
+                    evals, snapshot, self.planner, self.config,
+                    extra_usage=self._lane_extra_usage(snapshot, None),
+                )
+            if remote is not None:
+                metrics.observe("nomad.tpu.batch_evals", len(evals))
+                metrics.observe(
+                    "nomad.tpu.batch_dispatch_seconds",
+                    time.perf_counter() - t0,
+                )
+                return remote, snapshot, None
         self._ensure_resident()
         pending = solve_eval_batch_begin(
             snapshot, self.planner, evals, self.config, used_chain=chain,

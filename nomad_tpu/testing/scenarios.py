@@ -1,7 +1,7 @@
 """Production-ops resilience scenarios: the orchestrator must stay
 correct *while the operators operate on it*.
 
-Three seeded, invariant-checked scenarios over the ChaosCluster +
+Four seeded, invariant-checked scenarios over the ChaosCluster +
 LoadGen substrate (testing/chaos.py, testing/loadgen.py):
 
 - :func:`run_secret_rotation` — rotate the fabric ``rpc_secret``
@@ -17,6 +17,12 @@ LoadGen substrate (testing/chaos.py, testing/loadgen.py):
   jobs keep arriving: the drainer, blocked-evals containment, and the
   scheduler keep converging, the blocked set stays bounded, and no
   allocation is left live on a dead node past the heartbeat TTL.
+- :func:`run_pool_member_death` — a solver-pool member is killed
+  mid-remote-solve (the leader must fall back local off a retriable
+  DeviceFault), then the leader itself is killed with a warm pool: the
+  new leader re-points dispatch at the survivors' warm replicas with
+  ZERO resident-state cold starts, no acked write lost, no duplicate
+  alloc.
 
 Each returns an evidence dict (counters, timings, invariant verdicts);
 the tests in tests/test_scenarios.py gate on it. Seeded: the fault
@@ -689,4 +695,205 @@ def run_spot_churn(
     finally:
         if fleet is not None:
             fleet.stop()
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. Solver-pool member death + leader failover with a warm pool
+# ---------------------------------------------------------------------------
+
+
+_POOL_COUNTERS = (
+    "nomad.solver.pool.dispatched",
+    "nomad.solver.pool.member_fault",
+    "nomad.solver.pool.fallback_local",
+    "nomad.solver.pool.aborted",
+    "nomad.solver.pool.warmups",
+)
+
+
+def _join_pool_ring(cluster: ChaosCluster) -> None:
+    """Gossip-join every live server to every other. ChaosCluster boots
+    with STATIC raft peers (no server_join), so the serf ring — which
+    pool membership rides — starts empty on every node; production
+    agents join via server_join and never need this."""
+    for nid, cs in cluster.servers.items():
+        seeds = [
+            c.rpc.addr for n2, c in cluster.servers.items() if n2 != nid
+        ]
+        if seeds:
+            cs.join(seeds)
+
+
+def _pool_member_stats(cluster: ChaosCluster) -> dict:
+    """Each live server's own SolverPool.Status view (warmups counts
+    COLD STARTS of the resident replica — the zero-warmup gate reads
+    its delta across the leader kill)."""
+    out = {}
+    for nid, cs in cluster.servers.items():
+        out[nid] = dict(cs.solver_pool.endpoint.status(None))
+    return out
+
+
+def run_pool_member_death(
+    data_root: str,
+    *,
+    seed: int = 0,
+    n_servers: int = 3,
+    rate: float = 20.0,
+    node_count: int = 6,
+    max_duration_s: float = 120.0,
+    member_solve_delay_s: float = 0.4,
+) -> dict:
+    """The solver-pool tier's two failure drills (docs/solver-pool.md),
+    run back to back under live LoadGen traffic:
+
+    1. **Pool member dies mid-solve.** The victim's ``SolverPool.Solve``
+       is slowed so the kill provably lands while a dispatch is in
+       flight on it; the leader must convert the dead RPC into a
+       retriable DeviceFault and re-solve the SAME evals on the host
+       fallback path — no acked write lost, no duplicate alloc.
+    2. **Leader dies with a warm pool.** The victim is restarted and
+       re-warmed first, then the leader is killed. The new leader's
+       dispatch stream re-points at the surviving members' ALREADY-WARM
+       replicas: the gate is zero resident-state cold starts (warmups
+       delta == 0) on the survivors across the failover, while remote
+       dispatches keep completing.
+
+    Evidence dict gates: tests/test_scenarios.py assert_pool_death_ok.
+    """
+    base = _counter_snapshot(_POOL_COUNTERS)
+    cluster = ChaosCluster(
+        n_servers, data_root, seed=seed, num_workers=1,
+        use_tpu_batch_worker=True, solver_pool_role="solver",
+    )
+    try:
+        cluster.start()
+        lead = cluster.wait_for_stable_leader(timeout_s=60)
+        if lead is None:
+            raise RuntimeError("pool cluster never elected a leader")
+        _join_pool_ring(cluster)
+        cfg = LoadGenConfig(
+            rate_eval_per_s=rate,
+            duration_s=max_duration_s,
+            seed=seed,
+            node_count=node_count,
+            node_churn_period_s=0.0,
+            submitters=2,
+        )
+        gen = LoadGen(cluster, cfg)
+        t, box = _loadgen_thread(gen)
+        if not gen.setup_done.wait(timeout=60):
+            raise RuntimeError("loadgen setup never finished")
+
+        # traffic must actually be flowing through the pool before any
+        # fault: wait for the first completed remote dispatch
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            lead = cluster.leader() or lead
+            if getattr(lead, "solver_pool", None) is not None and \
+                    lead.solver_pool.completed > 0:
+                break
+            time.sleep(0.05)
+        if lead.solver_pool.completed == 0:
+            raise RuntimeError("pool never completed a remote dispatch")
+
+        # -- drill 1: kill a pool member mid-solve ----------------------
+        victim_id = next(
+            nid for nid, cs in cluster.servers.items() if cs is not lead
+        )
+        victim = cluster.servers[victim_id]
+        # widen the in-flight window so the kill provably lands mid-
+        # solve (instance attr shadows the class-body Solve alias)
+        orig_solve = victim.solver_pool.endpoint.solve
+
+        def slow_solve(args):
+            time.sleep(member_solve_delay_s)
+            return orig_solve(args)
+
+        victim.solver_pool.endpoint.Solve = slow_solve
+        pool = lead.solver_pool
+        killed_mid_solve = cluster.kill_when(
+            victim_id,
+            lambda cs: pool._member_stats.get(victim_id, {})
+            .get("in_flight", 0) > 0,
+            timeout_s=30.0,
+        )
+        # the dead member's dispatch must resolve as a member fault and
+        # the batch must re-solve locally (DeviceFault -> host fallback)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and pool.faults == 0:
+            time.sleep(0.05)
+        member_faults = pool.faults
+
+        # -- drill 2: leader dies, pool stays warm ----------------------
+        cluster.restart(victim_id)
+        _join_pool_ring(cluster)  # fresh serf ring on the restart
+        if not cluster.wait_caught_up(victim_id, timeout_s=45):
+            raise RuntimeError("restarted pool member never caught up")
+        # wait for the restarted member's warm loop to rebuild its
+        # replica (its ONE cold start; later deltas must be zero)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            st = cluster.servers[victim_id].solver_pool.endpoint.status(None)
+            if st.get("resident"):
+                break
+            time.sleep(0.1)
+        lead = cluster.leader() or lead
+        old_leader_id = lead.node_id
+        pre_kill = _pool_member_stats(cluster)
+        pre_dispatched = {
+            nid: cs.solver_pool.completed
+            for nid, cs in cluster.servers.items()
+        }
+        cluster.kill(old_leader_id)
+        new_lead = cluster.wait_for_stable_leader(timeout_s=60)
+        if new_lead is None:
+            raise RuntimeError("no leader after pool leader kill")
+        # the new leader must drive remote dispatches to completion on
+        # the surviving warm members
+        deadline = time.monotonic() + 30
+        post_failover_completed = 0
+        while time.monotonic() < deadline:
+            post_failover_completed = (
+                new_lead.solver_pool.completed
+                - pre_dispatched.get(new_lead.node_id, 0)
+            )
+            if post_failover_completed > 0:
+                break
+            time.sleep(0.05)
+        post_kill = _pool_member_stats(cluster)
+        warmup_deltas = {
+            nid: post_kill[nid].get("warmups", 0)
+            - pre_kill.get(nid, {}).get("warmups", 0)
+            for nid in post_kill
+        }
+
+        gen.stop()
+        report = _join_loadgen(t, box, timeout_s=120)
+        converged = cluster.converged(timeout_s=60)
+        cluster.acked_jobs = set(gen.acked_jobs)
+        invariants_ok, invariant_error = True, ""
+        try:
+            cluster.check_invariants()
+        except AssertionError as e:
+            invariants_ok, invariant_error = False, str(e)
+        return {
+            "seed": seed,
+            "loadgen": report,
+            "killed_mid_solve": killed_mid_solve,
+            "member_faults": member_faults,
+            "old_leader": old_leader_id,
+            "new_leader": new_lead.node_id,
+            "post_failover_completed": post_failover_completed,
+            "warmup_deltas": warmup_deltas,
+            "zero_warmup_failover": all(
+                v == 0 for v in warmup_deltas.values()
+            ),
+            "pool_counters": _counter_delta(_POOL_COUNTERS, base),
+            "converged": converged,
+            "invariants_ok": invariants_ok,
+            "invariant_error": invariant_error,
+        }
+    finally:
         cluster.shutdown()

@@ -792,6 +792,9 @@ REFERENCE_COMMAND_FLAGS = {
     "operator solver top": {
         "flags": {"-interval", "-n", "-once"}, "args": [],
     },
+    # Round 20 (solver-pool tier PR): extended with the pool membership
+    # surface (/v1/solver/pool, server/solver_pool.py).
+    "operator solver pool status": {"flags": {"-json"}, "args": []},
     # Round 12 (host-profiling PR): extended 30 -> 33 with the operator
     # profile family (/v1/profile/status + collapsed-stack download).
     "operator profile status": {"flags": {"-json"}, "args": []},

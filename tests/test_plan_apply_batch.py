@@ -611,3 +611,64 @@ def test_soa_chaos_kill_leader_during_replay(soa, tmp_path, monkeypatch):
     from tests.test_chaos import test_leader_kill_during_log_replay
 
     test_leader_kill_during_log_replay(tmp_path)
+
+
+def test_leadership_transfer_mid_remote_solve_nacks_not_drops():
+    """Solver-pool regression (docs/solver-pool.md): a leadership
+    transfer aborts in-flight pool dispatches, and the commit stage must
+    NACK the aborted batch — its evals redeliver on the new leader —
+    never ack it or drop it on the floor. The abort path raises
+    CancelledError (not a retriable DeviceFault), so it must NOT trip
+    the host-fallback re-solve either: the new leader owns the re-solve."""
+    import threading
+
+    from nomad_tpu.server.solver_pool import (
+        RemotePendingBatch, SolverPool, _Dispatch,
+    )
+    from nomad_tpu.server.worker import TPUBatchWorker
+
+    class _Broker:
+        def __init__(self):
+            self.nacked, self.acked = [], []
+
+        def nack(self, eid, tok):
+            self.nacked.append(eid)
+
+        def ack(self, eid, tok):
+            self.acked.append(eid)
+
+    class _Srv:
+        plan_queue = None
+
+        def __init__(self):
+            self.eval_broker = _Broker()
+
+    class _Cluster:
+        node_id = "s0"
+
+    srv = _Srv()
+    w = TPUBatchWorker(srv, batch_size=4)
+    pool = SolverPool(_Cluster())
+    try:
+        ev = mock.evaluation()
+        d = _Dispatch("s1", ("127.0.0.1", 1))
+        pool._inflight.add(d)
+        pending = RemotePendingBatch(pool, d, None, [ev], None, w.config)
+
+        # the leader-change hook (_on_leader_change) aborts in-flight
+        # dispatches before revoking leadership
+        assert pool.abort_inflight() == 1
+        assert pool.aborted == 1
+
+        committed = threading.Event()
+        outcome = {}
+        w._commit([(ev, "tok")], pending, None, committed, outcome, None)
+
+        assert srv.eval_broker.nacked == [ev.id], "aborted eval not nacked"
+        assert srv.eval_broker.acked == []
+        assert outcome["ok"] is False
+        assert committed.is_set(), "chain cutoff must fire on abort"
+        # no host fallback ran: the batch has no plans, only a nack
+        assert pending._finished is False
+    finally:
+        pool.stop()

@@ -80,6 +80,25 @@ def assert_churn_ok(r: dict) -> None:
     assert r["loadgen"]["accepted"] > 0, r["loadgen"]
 
 
+def assert_pool_death_ok(r: dict) -> None:
+    why = {k: v for k, v in r.items() if k != "loadgen"}
+    assert r["invariants_ok"], r["invariant_error"]  # no acked write
+    # lost, no duplicate alloc across BOTH kills
+    assert r["converged"], why
+    # drill 1: the dead member's in-flight dispatch became a retriable
+    # member fault (which the worker re-solves on the host fallback)
+    assert r["member_faults"] > 0, why
+    # drill 2: failover re-pointed dispatch at already-warm replicas —
+    # zero resident-state cold starts on the survivors, and the new
+    # leader actually completed remote solves
+    assert r["zero_warmup_failover"], (
+        f"solver cold-started across failover: {r['warmup_deltas']}: {why}"
+    )
+    assert r["post_failover_completed"] > 0, why
+    assert r["pool_counters"]["nomad.solver.pool.dispatched"] > 0, why
+    assert r["loadgen"]["accepted"] > 0, r["loadgen"]
+
+
 # ---------------------------------------------------------------------------
 # Fast seeded subset (tier-1)
 # ---------------------------------------------------------------------------
@@ -114,6 +133,11 @@ def test_rolling_upgrade_with_secret_enabled(tmp_path):
         str(tmp_path), seed=37, rate=20, rpc_secret="roll-secret",
     )
     assert_upgrade_ok(r)
+
+
+def test_pool_member_death_and_warm_failover(tmp_path):
+    r = scenarios.run_pool_member_death(str(tmp_path), seed=43)
+    assert_pool_death_ok(r)
 
 
 # ---------------------------------------------------------------------------
@@ -162,3 +186,17 @@ def test_spot_churn_acceptance_long(tmp_path):
     )
     assert_churn_ok(r)
     assert r["drains_completed"] > 0, "no graceful drain ever completed"
+
+
+@pytest.mark.slow
+def test_pool_member_death_acceptance_10_seeds(tmp_path):
+    """10/10 seeded member-death + warm-failover drills: member faults
+    always fall back local, failover never cold-starts a survivor."""
+    for seed in range(10):
+        r = scenarios.run_pool_member_death(
+            str(tmp_path / f"s{seed}"), seed=seed,
+        )
+        try:
+            assert_pool_death_ok(r)
+        except AssertionError as e:
+            raise AssertionError(f"seed {seed}: {e}") from None

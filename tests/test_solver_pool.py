@@ -1,0 +1,418 @@
+"""Solver-pool tier unit battery (server/solver_pool.py +
+scheduler/tpu/remote_solve.py, docs/solver-pool.md): gossip-tag
+membership, least-loaded dispatch with cooldowns, the three fault
+surfaces (member death → retriable DeviceFault, empty pool → local
+fallback, leadership transfer → abort/nack), the warm member engine,
+config plumbing (HCL stanza + SIGHUP reload), and the observability
+surfaces (stats_snapshot, /v1/solver/pool, operator top panel data).
+
+The end-to-end drills (kill a member mid-solve, kill the leader with a
+warm pool) live in tests/test_scenarios.py::run_pool_member_death.
+"""
+
+import threading
+import types
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.faultplane import DeviceFault
+from nomad_tpu.server.membership import Member
+from nomad_tpu.server.solver_pool import (
+    FAULT_COOLDOWN_S,
+    RemotePendingBatch,
+    SolverPool,
+    SolverPoolEndpoint,
+    _Dispatch,
+)
+from nomad_tpu.testing import Harness
+
+
+# ---------------------------------------------------------------------------
+# Fakes: just enough ClusterServer surface for the pool tracker
+# ---------------------------------------------------------------------------
+
+
+def _member(nid, solver="1", role="server", status="alive", port=None):
+    tags = {"role": role}
+    if solver:
+        tags["solver"] = solver
+    return Member(
+        nid, ("127.0.0.1", port or (9000 + hash(nid) % 100)),
+        status, 0, tags,
+    )
+
+
+class _Serf:
+    def __init__(self, members):
+        self._m = list(members)
+        self.local = self._m[0]
+
+    def members(self):
+        return list(self._m)
+
+
+class _ConnPool:
+    """Scriptable fabric: fn(addr, method, args) or raise."""
+
+    def __init__(self, fn=None):
+        self.calls = []
+        self.fn = fn
+
+    def call(self, addr, method, args, timeout_s=None):
+        self.calls.append((tuple(addr), method))
+        if self.fn is None:
+            raise ConnectionError("fabric down")
+        return self.fn(tuple(addr), method, args)
+
+
+class _Cluster:
+    def __init__(self, node_id="s0", members=None, fn=None):
+        self.node_id = node_id
+        self.serf = _Serf(members or [_member(node_id)])
+        self.pool = _ConnPool(fn)
+
+
+def _make_pool(members=None, fn=None, **kw):
+    cluster = _Cluster(members=members, fn=fn)
+    return SolverPool(cluster, **kw), cluster
+
+
+# ---------------------------------------------------------------------------
+# Membership + pick
+# ---------------------------------------------------------------------------
+
+
+def test_membership_rides_gossip_tags():
+    pool, _ = _make_pool(members=[
+        _member("s0"),                      # self
+        _member("s1"),                      # eligible
+        _member("s2", solver=""),           # server, not advertising
+        _member("c1", role="client"),       # solver tag on a client: no
+        _member("s3", status="failed"),     # dead
+    ])
+    try:
+        rows = {m["id"]: m for m in pool.members()}
+        assert set(rows) == {"s0", "s1", "s3"}
+        assert rows["s0"]["self"] is True
+        assert rows["s1"]["self"] is False
+        # pick: healthy, non-self only
+        picked = pool._pick()
+        assert picked == ("s1", tuple(rows["s1"]["addr"]))
+    finally:
+        pool.stop()
+
+
+def test_static_member_allowlist_filters():
+    pool, _ = _make_pool(
+        members=[_member("s0"), _member("s1"), _member("s2")],
+    )
+    try:
+        assert {m["id"] for m in pool.members()} == {"s0", "s1", "s2"}
+        pool.configure(pool.role, members=("s2",))
+        assert {m["id"] for m in pool.members()} == {"s2"}
+    finally:
+        pool.stop()
+
+
+def test_pick_least_loaded_skips_cooling():
+    pool, _ = _make_pool(members=[
+        _member("s0"), _member("s1"), _member("s2"),
+    ])
+    try:
+        pool._member_stats["s1"] = {
+            "in_flight": 3, "dispatched": 3, "faults": 0,
+        }
+        pool._member_stats["s2"] = {
+            "in_flight": 1, "dispatched": 1, "faults": 0,
+        }
+        assert pool._pick()[0] == "s2"
+        # a faulted member sits out the cooldown window
+        import time
+
+        pool._fault_until["s2"] = time.monotonic() + FAULT_COOLDOWN_S
+        assert pool._pick()[0] == "s1"
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Dispatch: success, fault, empty pool, abort
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_roundtrip_applies_followups_on_leader():
+    fe = mock.evaluation()
+
+    def serve(addr, method, args):
+        assert method == "SolverPool.Solve"
+        assert args["min_index"] == 7
+        return {"plans": {"e1": "PLAN"}, "followups": [fe]}
+
+    pool, _ = _make_pool(
+        members=[_member("s0"), _member("s1")], fn=serve,
+    )
+    try:
+        planner = Harness()
+        snap = types.SimpleNamespace(index=7)
+        remote = pool.dispatch_batch([mock.evaluation()], snap, planner, None)
+        assert isinstance(remote, RemotePendingBatch)
+        # the chain surface is inert: remote batches neither consume nor
+        # produce a local used' tensor
+        assert remote.chain is None and remote.chain_accepted is False
+        assert remote.finish() == {"e1": "PLAN"}
+        assert [e.id for e in planner.evals] == [fe.id]
+        assert pool.dispatched == 1 and pool.completed == 1
+        assert pool.stats_snapshot()["in_flight"] == 0
+    finally:
+        pool.stop()
+
+
+def test_member_fault_is_retriable_devicefault_and_cools_down():
+    pool, _ = _make_pool(members=[_member("s0"), _member("s1")])  # fn=None
+    try:
+        snap = types.SimpleNamespace(index=1)
+        remote = pool.dispatch_batch([mock.evaluation()], snap, Harness(), None)
+        with pytest.raises(DeviceFault) as ei:
+            remote.finish()
+        assert ei.value.retriable, "member death must ride the existing " \
+            "device-failover (host re-solve) path"
+        assert pool.faults == 1
+        # the faulted member is cooling: the next batch falls back local
+        assert pool.dispatch_batch([], snap, Harness(), None) is None
+        assert pool.fallback_local == 1
+    finally:
+        pool.stop()
+
+
+def test_empty_pool_falls_back_local():
+    pool, _ = _make_pool()  # only self
+    try:
+        snap = types.SimpleNamespace(index=1)
+        assert pool.dispatch_batch([], snap, Harness(), None) is None
+        assert pool.fallback_local == 1
+    finally:
+        pool.stop()
+
+
+def test_gossip_death_fails_inflight_immediately():
+    hang = threading.Event()
+
+    def serve(addr, method, args):
+        hang.wait(10)  # RPC never returns while the member is "dead"
+        return {"plans": {}}
+
+    pool, _ = _make_pool(
+        members=[_member("s0"), _member("s1")], fn=serve,
+    )
+    try:
+        snap = types.SimpleNamespace(index=1)
+        remote = pool.dispatch_batch([mock.evaluation()], snap, Harness(), None)
+        pool.on_member_event("member-failed", _member("s1"))
+        with pytest.raises(DeviceFault):
+            remote.finish()  # resolves NOW, not at the RPC timeout
+    finally:
+        hang.set()
+        pool.stop()
+
+
+def test_abort_inflight_raises_cancelled_for_nack():
+    pool, _ = _make_pool()
+    try:
+        d = _Dispatch("s1", ("127.0.0.1", 1))
+        pool._inflight.add(d)
+        pending = RemotePendingBatch(pool, d, None, [], Harness(), None)
+        assert pool.abort_inflight() == 1
+        # CancelledError, NOT DeviceFault: the commit stage must nack
+        # (evals redeliver on the new leader), never host-fallback-solve
+        # on a leader that just lost leadership
+        from concurrent.futures import CancelledError
+
+        with pytest.raises(CancelledError):
+            pending.finish()
+        assert pool.aborted == 1
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Member engine (RemoteSolver) + endpoint verbs
+# ---------------------------------------------------------------------------
+
+
+def _warm_cluster_state():
+    h = Harness()
+    for _ in range(4):
+        n = mock.node()
+        n.resources.cpu = 4000
+        n.resources.memory_mb = 8192
+        h.state.upsert_node(h.next_index(), n)
+    job = mock.job(id="pool-j1")
+    job.task_groups[0].count = 3
+    h.state.upsert_job(h.next_index(), job)
+    return h, job
+
+
+def test_endpoint_solves_on_warm_replica():
+    h, job = _warm_cluster_state()
+    cluster = _Cluster()
+    cluster.server = types.SimpleNamespace(state=h.state)
+    ep = SolverPoolEndpoint(cluster, None)
+
+    # Status before any solve: cold stub, no jax load
+    assert ep.status(None)["resident"] is False
+
+    ev = mock.eval_for_job(job)
+    out = ep.solve({"evals": [ev], "min_index": h.state.latest_index()})
+    assert ev.id in out["plans"]
+    assert out["telemetry"]["member"] == "s0"
+    st = ep.status(None)
+    assert st["solves"] == 1 and st["warmups"] == 1
+
+    # warm() syncs the replica; a second solve must NOT cold-start
+    synced = ep.sync({"min_index": h.state.latest_index()})
+    assert synced["last_sync"] != "cold"
+    ep.solve({"evals": [mock.eval_for_job(job)],
+              "min_index": h.state.latest_index()})
+    st = ep.status(None)
+    assert st["solves"] == 2
+    assert st["warmups"] == 1, "re-solve must reuse the warm replica"
+
+
+def test_endpoint_wire_verbs_are_capitalized():
+    # rpc dispatch resolves the literal method name after the dot:
+    # SolverPool.Solve must hit the same handler as .solve
+    assert SolverPoolEndpoint.Solve is SolverPoolEndpoint.solve
+    assert SolverPoolEndpoint.Sync is SolverPoolEndpoint.sync
+    assert SolverPoolEndpoint.Status is SolverPoolEndpoint.status
+
+
+def test_remote_solver_followups_collected_not_applied():
+    """A member must never raft-apply followup evals (it would bounce
+    NotLeaderError); they ship back for the leader to apply."""
+    from nomad_tpu.scheduler.tpu.remote_solve import CollectingPlanner
+
+    p = CollectingPlanner()
+    ev = mock.evaluation()
+    p.create_eval(ev)
+    p.update_eval(ev)
+    assert p.followups == [ev, ev]
+
+
+# ---------------------------------------------------------------------------
+# Config: HCL stanza, SIGHUP reload, advertising
+# ---------------------------------------------------------------------------
+
+
+def test_hcl_solver_pool_stanza(tmp_path):
+    from nomad_tpu.cli.main import _load_agent_config
+
+    cfgfile = tmp_path / "agent.hcl"
+    cfgfile.write_text(
+        'server {\n  enabled = true\n}\n'
+        'solver_pool {\n'
+        '  role          = "solver"\n'
+        '  members       = ["s1", "s2"]\n'
+        '  sync_interval = "500ms"\n'
+        '}\n'
+    )
+    cfg = _load_agent_config(str(cfgfile))
+    assert cfg.solver_pool_role == "solver"
+    assert cfg.solver_pool_members == ("s1", "s2")
+    assert cfg.solver_pool_sync_interval_s == pytest.approx(0.5)
+
+
+def test_json_solver_pool_stanza(tmp_path):
+    from nomad_tpu.cli.main import _load_agent_config
+
+    cfgfile = tmp_path / "agent.json"
+    cfgfile.write_text(
+        '{"solver_pool": {"role": "solver", "members": ["s9"],'
+        ' "sync_interval": "2s"}}'
+    )
+    cfg = _load_agent_config(str(cfgfile))
+    assert cfg.solver_pool_role == "solver"
+    assert cfg.solver_pool_members == ("s9",)
+    assert cfg.solver_pool_sync_interval_s == pytest.approx(2.0)
+
+
+def test_configure_advertises_and_is_idempotent():
+    pool, cluster = _make_pool(members=[_member("s0", solver="")])
+    try:
+        local = cluster.serf.local
+        inc0 = local.incarnation
+        assert "solver" not in local.tags
+
+        assert pool.configure("solver") is True
+        assert local.tags.get("solver") == "1"
+        assert local.incarnation == inc0 + 1
+
+        # idempotent: same config changes nothing, no incarnation churn
+        assert pool.configure("solver") is False
+        assert local.incarnation == inc0 + 1
+
+        # demotion withdraws the advertisement
+        assert pool.configure("") is True
+        assert "solver" not in local.tags
+        assert local.incarnation == inc0 + 2
+    finally:
+        pool.stop()
+
+
+def test_configure_updates_sync_interval_and_members():
+    pool, _ = _make_pool()
+    try:
+        assert pool.configure("", members=("a",), sync_interval_s=9.0)
+        assert pool.static_members == ("a",)
+        assert pool.sync_interval_s == 9.0
+        assert not pool.configure("", members=("a",), sync_interval_s=9.0)
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_stats_snapshot_shape():
+    pool, _ = _make_pool(members=[_member("s0"), _member("s1")])
+    try:
+        s = pool.stats_snapshot()
+        for k in ("role", "dispatched", "completed", "faults", "aborted",
+                  "fallback_local", "in_flight", "members", "local"):
+            assert k in s, k
+        assert s["local"] is None  # no jax load for a cold tracker
+        assert {m["id"] for m in s["members"]} == {"s0", "s1"}
+    finally:
+        pool.stop()
+
+
+def test_worker_stats_snapshot_live_depths():
+    from nomad_tpu.server.worker import TPUBatchWorker
+
+    class _Srv:
+        eval_broker = None
+        plan_queue = None
+
+    w = TPUBatchWorker(_Srv(), batch_size=8)
+    s = w.stats_snapshot()
+    for k in ("pipeline", "batch_size", "processed", "commit_queue_depth",
+              "chain_in_flight", "held_interactive", "lane_ledger_len",
+              "submit_ewma_s", "lane_priority"):
+        assert k in s, k
+    assert s["batch_size"] == 8
+    assert s["commit_queue_depth"] == 0
+
+
+def test_pool_gauges_registered():
+    from nomad_tpu import metrics
+
+    pool, _ = _make_pool(members=[_member("s0"), _member("s1")])
+    try:
+        gauges = metrics.snapshot().get("gauges", {})
+        # provider-backed: healthy non-self members and total in-flight
+        assert gauges.get("nomad.solver.pool.members") == 1
+        assert gauges.get("nomad.solver.pool.in_flight") == 0
+    finally:
+        pool.stop()
