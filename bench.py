@@ -1561,6 +1561,86 @@ def run_soak_config():
     return report
 
 
+def run_fleet_config():
+    """Fleet-scale survival (ROADMAP fleet-scale item): a simulated
+    client fleet — real registration/heartbeat/alloc-watch RPCs
+    multiplexed over a cooperative driver pool
+    (nomad_tpu/testing/fleet.py) — held against a live cluster through
+    a registration storm, steady state, a mass partition (heartbeat
+    wheel expiry storm → batched down-marks), and a mass reconnect
+    (node door admission + register batcher).
+
+    Gates: the whole fleet registers through the admission door; every
+    silent victim is down-marked within its TTL bound; the reconnect
+    storm recovers; BOTH storms commit node-status raft entries in
+    coalesced batches (entries <= victims / min_avg_batch); heartbeat
+    RPC p99 stays bounded THROUGH the storms; server CPU per node per
+    second stays under the soak gate; chaos invariants hold.
+
+    Env knobs: BENCH_FLEET_NODES (default 5000 — the acceptance run's
+    floor), BENCH_FLEET_S (steady-state seconds, default 600 for the
+    acceptance run's 10-minute hold), BENCH_FLEET_SEED,
+    BENCH_FLEET_SERVERS, BENCH_FLEET_TTL_S, BENCH_FLEET_P99_S,
+    BENCH_FLEET_CPU_PER_NODE, BENCH_FLEET_DRIVERS,
+    BENCH_FLEET_FRACTION (partition fraction)."""
+    import shutil
+    import tempfile
+
+    from nomad_tpu.testing.fleet import run_fleet_scale
+
+    n_nodes = int(os.environ.get("BENCH_FLEET_NODES", "5000"))
+    steady = float(os.environ.get("BENCH_FLEET_S", "600"))
+    seed = int(os.environ.get("BENCH_FLEET_SEED", "42"))
+    n_servers = int(os.environ.get("BENCH_FLEET_SERVERS", "1"))
+    ttl = float(os.environ.get("BENCH_FLEET_TTL_S", "10"))
+    log(
+        f"[fleet] {n_nodes} nodes on {n_servers} server(s), "
+        f"{steady:.0f}s steady, ttl {ttl:.0f}s, seed {seed}"
+    )
+    root = tempfile.mkdtemp(prefix="nomad-tpu-fleet-")
+    try:
+        report = run_fleet_scale(
+            root,
+            seed=seed,
+            n_servers=n_servers,
+            n_nodes=n_nodes,
+            steady_s=steady,
+            heartbeat_ttl_s=ttl,
+            driver_threads=int(os.environ.get("BENCH_FLEET_DRIVERS", "8")),
+            real_watchers=8,
+            partition_fraction=float(
+                os.environ.get("BENCH_FLEET_FRACTION", "0.2")
+            ),
+            register_deadline_s=max(60.0, n_nodes / 50.0),
+            rate=float(os.environ.get("BENCH_FLEET_RATE", "10")),
+            p99_bound_s=float(os.environ.get("BENCH_FLEET_P99_S", "1.0")),
+            cpu_per_node_bound=float(
+                os.environ.get("BENCH_FLEET_CPU_PER_NODE", "0.002")
+            ),
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    cpu = report["server_cpu"]
+    log(
+        f"[fleet] registered {report['fleet']['registered']}/{n_nodes} "
+        f"in {report['populate_s']}s ({report['register_throttled']:.0f} "
+        f"throttles); victims {report['victims']}: down in "
+        f"{report['expiry_detect_s']}s over {report['expire_batches']:.0f} "
+        f"batches (avg {report['avg_expiry_batch']}), reconnect in "
+        f"{report['reconnect_s']}s over {report['reconnect_batches']:.0f} "
+        f"entries (avg {report['avg_reconnect_batch']}); hb p99 "
+        f"{report['hb_p99_s']}s; cpu/node "
+        f"{cpu['per_node_cpu_fraction']} cores; converged "
+        f"{report['converged']}, invariants {report['invariants_ok']}"
+        + (
+            f" ({report['invariant_error']})"
+            if report["invariant_error"]
+            else ""
+        )
+    )
+    return report
+
+
 SERVICE_CONFIGS = {
     # name: (nodes, jobs, count/job, constrained, host_sample >= 20
     #        except smoke, which has a single job by definition)
@@ -2130,6 +2210,8 @@ def main():
             results[name] = run_pipeline_config()
         elif name == "soak":
             results[name] = run_soak_config()
+        elif name == "fleet":
+            results[name] = run_fleet_config()
         else:
             raise SystemExit(f"unknown BENCH_CONFIG {name}")
         results[name]["latency_percentiles"] = latency_percentiles()
@@ -2259,6 +2341,20 @@ def main():
             gates[f"{cname}_source_coverage"] = (
                 r["source_attribution"]["coverage"] >= 0.8
             )
+        # fleet-scale survival gates (nomad_tpu/testing/fleet.py): the
+        # storm phases complete inside their bounds, and both mass
+        # transitions commit node-status raft writes in coalesced
+        # batches — the "entries <= constant x batches" claim
+        if "reconnect_batched" in r:
+            gates[f"{cname}_survival"] = bool(
+                r["registered_all"]
+                and r["expiry_detected"]
+                and r["reconnect_recovered"]
+            )
+            gates[f"{cname}_raft_batched"] = bool(
+                r["expiry_batched"] and r["reconnect_batched"]
+            )
+            gates[f"{cname}_cpu_per_node"] = bool(r["cpu_bounded"])
     if chaos_knobs:
         # refuse to gate: an injected-fault run can never certify
         gates["no_chaos_injection"] = False

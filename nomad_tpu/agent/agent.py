@@ -228,6 +228,11 @@ class AgentConfig:
     http_rate_burst: float = 0.0
     rpc_rate_limit: float = 0.0
     rpc_rate_burst: float = 0.0
+    # limits { node_register_rate node_register_burst }: the server-wide
+    # Node.register admission door (reconnect-storm pacing; 429 +
+    # Retry-After). 0 disables; heartbeats are never limited.
+    node_register_rate: float = 0.0
+    node_register_burst: float = 0.0
     # solver_pool stanza (the warm placement tier, docs/solver-pool.md;
     # SIGHUP-reloadable): solver_pool { role members sync_interval }.
     # role "solver" advertises this server as a pool member (serf tag
@@ -412,6 +417,9 @@ class Agent:
             )
             self.server.set_rate_limits(
                 cfg.rpc_rate_limit, cfg.rpc_rate_burst
+            )
+            self.server.set_node_register_limit(
+                cfg.node_register_rate, cfg.node_register_burst
             )
         if self.http is not None:
             self.http.set_rate_limits(
@@ -635,6 +643,8 @@ class Agent:
             "http_rate_burst",
             "rpc_rate_limit",
             "rpc_rate_burst",
+            "node_register_rate",
+            "node_register_burst",
         )
         broker_changed = any(
             getattr(new_config, k) != getattr(old, k) for k in broker_keys

@@ -18,6 +18,8 @@ import contextvars
 import json
 import logging
 import re
+import select
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -2023,14 +2025,38 @@ class HTTPAgentServer:
             handler.wfile.write(data + b"\r\n")
             handler.wfile.flush()
 
+        def conn_alive() -> bool:
+            # A quiet stream only touches the socket at heartbeat time,
+            # so a streamer whose connection died parks its thread (and
+            # its broker subscription) until the next write. Probe
+            # between events: readable + empty MSG_PEEK = peer closed
+            # (a streaming GET never pipelines more request bytes).
+            try:
+                readable, _w, _x = select.select(
+                    [handler.connection], [], [], 0
+                )
+                if not readable:
+                    return True
+                return handler.connection.recv(1, socket.MSG_PEEK) != b""
+            except (OSError, ValueError):
+                return False
+
+        last_write = time.monotonic()
         try:
             while True:
                 try:
-                    events = sub.next(timeout_s=10.0)
+                    # short hold: bounds how long a dead connection can
+                    # pin a subscription between liveness probes
+                    events = sub.next(timeout_s=2.0)
                 except SubscriptionClosedError:
                     return
                 if not events:
-                    write_chunk(b"{}\n")  # heartbeat (reference sends {})
+                    if not conn_alive():
+                        metrics.incr("nomad.stream.reaped")
+                        return
+                    if time.monotonic() - last_write >= 10.0:
+                        write_chunk(b"{}\n")  # heartbeat (reference sends {})
+                        last_write = time.monotonic()
                     continue
                 payload = {
                     "Index": events[-1].index,
@@ -2047,6 +2073,7 @@ class HTTPAgentServer:
                     ],
                 }
                 write_chunk(json.dumps(payload, default=_json_default).encode() + b"\n")
+                last_write = time.monotonic()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:

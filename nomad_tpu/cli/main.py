@@ -307,6 +307,8 @@ def _load_agent_config(path: str):
         cfg.http_rate_burst = float(lma.get("http_burst", 0) or 0)
         cfg.rpc_rate_limit = float(lma.get("rpc_rate", 0) or 0)
         cfg.rpc_rate_burst = float(lma.get("rpc_burst", 0) or 0)
+        cfg.node_register_rate = float(lma.get("node_register_rate", 0) or 0)
+        cfg.node_register_burst = float(lma.get("node_register_burst", 0) or 0)
     spb = body.block("solver_pool")
     if spb is not None:
         from ..jobspec.hcl import parse_duration
@@ -407,6 +409,8 @@ def _apply_config_dict(cfg, data: dict) -> None:
             cfg.http_rate_burst = float(v.get("http_burst", 0) or 0)
             cfg.rpc_rate_limit = float(v.get("rpc_rate", 0) or 0)
             cfg.rpc_rate_burst = float(v.get("rpc_burst", 0) or 0)
+            cfg.node_register_rate = float(v.get("node_register_rate", 0) or 0)
+            cfg.node_register_burst = float(v.get("node_register_burst", 0) or 0)
         elif k == "solver_pool" and isinstance(v, dict):
             from ..jobspec.hcl import parse_duration
 
@@ -2301,6 +2305,31 @@ def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
                     else ""
                 )
             )
+    # fleet panel (heartbeat wheel + alloc-watch hub + node door,
+    # docs/operations.md § Surviving a reconnect storm): rendered once
+    # any node TTL is armed or a fleet signal has fired — a cluster
+    # with no client nodes keeps the compact layout.
+    armed = int(gauges.get("nomad.heartbeat.armed", 0))
+    nodes_down = int(gauges.get("nomad.fleet.nodes_down", 0))
+    expired = int(counters.get("nomad.heartbeat.expired", 0))
+    node_throttled = int(counters.get("nomad.rpc.node_throttled", 0))
+    if armed or nodes_down or expired or node_throttled:
+        lines.append(
+            f"Fleet       nodes ready "
+            f"{int(gauges.get('nomad.fleet.nodes_ready', 0))}"
+            f"  down {nodes_down}"
+            f"   ttl armed {armed}"
+            f" ({int(gauges.get('nomad.heartbeat.wheel_buckets', 0))}"
+            " buckets)"
+            f"   expired {expired}"
+            f"   watchers "
+            f"{int(gauges.get('nomad.fleet.watch_subscribers', 0))}"
+            + (
+                f"   node throttled(429) {node_throttled}"
+                if node_throttled
+                else ""
+            )
+        )
     lines += [
         "",
         "Stage latencies (cumulative | last window):",

@@ -428,9 +428,27 @@ class Client:
                 # node sit `initializing` until the first TTL/2 beat.
                 self.heartbeat_ttl = self.rpc.heartbeat(self.node.id)
                 self._registered.set()
-            except Exception:
-                logger.debug("registration failed; retrying")
-                self._shutdown.wait(0.2)
+            except Exception as e:
+                # Honor the node door's Retry-After pacing (429-class,
+                # server/cluster.py node_limiter): during a reconnect
+                # storm the server admits at a fixed rate and each
+                # rejected client backs off exactly as told — with
+                # jitter, so a cohort throttled together doesn't return
+                # together.
+                import random
+
+                from ..ratelimit import retry_after_from_text
+
+                hint = retry_after_from_text(str(e))
+                if hint:
+                    delay = hint + random.uniform(0, hint / 2)
+                    logger.debug(
+                        "registration throttled; retrying in %.2fs", delay
+                    )
+                else:
+                    delay = 0.2
+                    logger.debug("registration failed; retrying")
+                self._shutdown.wait(delay)
         while not self._shutdown.is_set():
             # heartbeat at half the granted TTL (reference client.go:1606)
             self._shutdown.wait(max(self.heartbeat_ttl / 2, 0.5))
@@ -442,12 +460,18 @@ class Client:
                 logger.exception("heartbeat failed")
 
     def _watch_allocs(self) -> None:
-        """Blocking-query loop on our alloc set (reference :2003)."""
+        """Blocking-query loop on our alloc set (reference :2003).
+
+        The 10s hold matters at fleet scale: the server wakes this
+        query through its per-node watch hub the moment OUR alloc set
+        changes, so a long hold costs nothing in reaction latency and
+        divides the idle re-poll RPC rate by ten versus the old 1s
+        spin (10k clients at 1s = 10k RPCs/s of pure no-change churn)."""
         index = 0
         while not self._shutdown.is_set():
             try:
                 allocs, index = self.rpc.get_client_allocs(
-                    self.node.id, index + 1, timeout_s=1.0
+                    self.node.id, index + 1, timeout_s=10.0
                 )
             except Exception:
                 if self._shutdown.is_set():

@@ -203,6 +203,12 @@ class ConnPool:
         self._conns: dict[tuple[str, int], _Conn] = {}
         self._lock = threading.Lock()
         self._connect_timeout_s = connect_timeout_s
+        # Single-flight dial tracking: addr -> Event set when the
+        # in-flight dial to that peer resolves. Callers that find a
+        # flight in progress queue behind it instead of stacking their
+        # own TCP/TLS handshakes against a peer that is likely down.
+        self._dials: dict[tuple[str, int], threading.Event] = {}
+        self._dial_waiters = 0
         # Dual-accept keyring (rpc/keyring.py): the CURRENT secret is
         # read at every dial, never cached per-connection state — a
         # rotation pushed via SIGHUP takes effect on the next redial
@@ -331,20 +337,69 @@ class ConnPool:
         return session
 
     def _get(self, addr: tuple[str, int], use_previous: bool = False) -> _Conn:
-        with self._lock:
-            conn = self._conns.get(addr)
-            if conn is not None and not conn.dead:
-                return conn
-            # dial-time secret read: rotation propagates to every
-            # redial without pool (or process) restarts
-            secret = (
-                self.keyring.previous_active()
-                if use_previous
-                else self.keyring.current
-            )
-            conn = _Conn(addr, self._connect_timeout_s, secret,
-                         tls_context=self.tls_context, src=self.owner)
-            self._conns[addr] = conn
+        """Pooled conn for addr, dialing at most ONCE per peer at a time.
+
+        The seed dialed inside the pool-wide lock: during a reconnect
+        storm every RPC thread whose pooled conn died lined up on the
+        lock while ONE of them sat in a 5s connect timeout — to ANY
+        peer. Dials now run outside the lock (other peers' traffic is
+        unaffected) and are single-flight per addr: concurrent callers
+        queue behind the in-flight dial (``nomad.rpc.dial_queue_depth``)
+        and adopt its result instead of stacking handshakes against a
+        peer that is likely down.
+        """
+        while True:
+            dial_flight: Optional[threading.Event] = None
+            waiting = False
+            with self._lock:
+                conn = self._conns.get(addr)
+                if conn is not None and not conn.dead:
+                    return conn
+                # rotation-window fallback dials present the PREVIOUS
+                # secret — never share a flight keyed to the current one
+                if not use_previous:
+                    flight = self._dials.get(addr)
+                    if flight is not None:
+                        waiting = True
+                        self._dial_waiters += 1
+                        depth = self._dial_waiters
+                    else:
+                        dial_flight = threading.Event()
+                        self._dials[addr] = dial_flight
+                # dial-time secret read: rotation propagates to every
+                # redial without pool (or process) restarts
+                secret = (
+                    self.keyring.previous_active()
+                    if use_previous
+                    else self.keyring.current
+                )
+            if waiting:
+                metrics.set_gauge("nomad.rpc.dial_queue_depth", depth)
+                flight.wait(self._connect_timeout_s + 1.0)
+                with self._lock:
+                    self._dial_waiters -= 1
+                    depth = self._dial_waiters
+                metrics.set_gauge("nomad.rpc.dial_queue_depth", depth)
+                continue  # adopt the dialed conn, or take over the flight
+            try:
+                conn = _Conn(addr, self._connect_timeout_s, secret,
+                             tls_context=self.tls_context, src=self.owner)
+            except BaseException:
+                if dial_flight is not None:
+                    with self._lock:
+                        if self._dials.get(addr) is dial_flight:
+                            del self._dials[addr]
+                    dial_flight.set()  # waiters retry (and fail) promptly
+                raise
+            with self._lock:
+                stale = self._conns.get(addr)
+                self._conns[addr] = conn
+                if dial_flight is not None and self._dials.get(addr) is dial_flight:
+                    del self._dials[addr]
+            if dial_flight is not None:
+                dial_flight.set()
+            if stale is not None and stale is not conn:
+                stale.close()
             return conn
 
     def _drop(self, addr: tuple[str, int], conn: _Conn) -> None:

@@ -1199,6 +1199,15 @@ class ClusterServer:
         from ..ratelimit import KeyedRateLimiter
 
         self.rpc_limiter = KeyedRateLimiter()
+        # The node door (fleet-scale survival): Node.register is the ONE
+        # node-originated verb that gets admission control. A reconnect
+        # storm (partition heals, mass agent restart) is survivable if
+        # registrations are paced — clients back off on 429/Retry-After
+        # and re-register within their TTL — whereas an unpaced storm
+        # stacks raft writes behind every live heartbeat. Heartbeats
+        # themselves stay unthrottled (throttling them manufactures the
+        # very down-marks the door exists to prevent).
+        self.node_limiter = KeyedRateLimiter()
         self.server = Server(
             num_workers=num_workers,
             use_tpu_batch_worker=use_tpu_batch_worker,
@@ -1960,6 +1969,14 @@ class ClusterServer:
         front-door token buckets. rate <= 0 disables."""
         self.rpc_limiter.configure(rpc_rate, rpc_burst)
 
+    def set_node_register_limit(
+        self, rate: float, burst: float = 0.0
+    ) -> None:
+        """Configure (or SIGHUP-reconfigure) the Node.register admission
+        door — one server-wide bucket, not per-namespace: a reconnect
+        storm is a cluster-level event. rate <= 0 disables."""
+        self.node_limiter.configure(rate, burst)
+
     @staticmethod
     def _args_namespace(args) -> str:
         if not isinstance(args, dict):
@@ -1980,6 +1997,18 @@ class ClusterServer:
         namespace rate limit also charges here: one choke point covers
         the fabric socket, in-process rpc_self, and HTTP-originated
         writes alike."""
+        if self.node_limiter.enabled and method == "Node.register":
+            from .. import metrics
+            from ..ratelimit import RateLimitError
+
+            wait = self.node_limiter.check("node")
+            if wait > 0:
+                metrics.incr("nomad.rpc.node_throttled")
+                raise RateLimitError(
+                    "node registration rate limit exceeded "
+                    "(reconnect-storm admission door)",
+                    retry_after_s=wait,
+                )
         if (
             self.rpc_limiter.enabled
             and method in self._RATE_LIMITED_METHODS

@@ -1,52 +1,163 @@
-"""Node-liveness heartbeats (leader-only TTL timers).
+"""Node-liveness heartbeats: a sharded hierarchical timer wheel.
 
 Reference: nomad/heartbeat.go — per-node TTL timers scaled by cluster size
 (lib.RateScaledInterval: max 50 heartbeats/sec cluster-wide, min 10s TTL);
 a missed TTL marks the node down and creates evals for its jobs.
+
+The reference (and PR 10's port) kept one timer object per node. At
+fleet scale that design collapses: 10k armed ``threading.Timer``s are
+10k pending thread starts, every expiry spawns a thread, and a mass
+expiry (partition, leader-side stall) fires thousands of concurrent
+down-mark raft writes. The wheel replaces all of it with ONE ticker
+thread over sharded tick-indexed buckets:
+
+  * ``reset`` is O(1): write the node's authoritative deadline and drop
+    its id into the bucket for that tick (shard chosen by hash, so
+    10k concurrent heartbeats don't serialize on one lock);
+  * re-arm is lazy: the old bucket entry is left in place and
+    invalidated by the deadline check at expiry time — a heartbeat
+    racing its own expiry wins iff its deadline write lands first;
+  * the ticker processes EVERY bucket that is due, not just the
+    current tick, so a late wake (GC pause, scheduler stall, paused-GC
+    bench section) expires overdue nodes in one catch-up sweep instead
+    of skipping them;
+  * all nodes expiring in one sweep are delivered as ONE
+    ``on_expire_batch`` call — the server turns a mass expiry into a
+    bounded number of batched raft writes instead of N.
 """
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
-from typing import Callable, Optional
+from typing import Callable, Iterable, Optional
+
+logger = logging.getLogger("nomad_tpu.server")
 
 MIN_HEARTBEAT_TTL_S = 10.0
 MAX_HEARTBEATS_PER_SECOND = 50.0
 FAILOVER_GRACE_S = 5.0
 
+DEFAULT_WHEEL_TICK_S = 0.1
+DEFAULT_WHEEL_SHARDS = 8
+
 
 def rate_scaled_interval(
-    n_nodes: int, min_ttl_s: float = MIN_HEARTBEAT_TTL_S
+    n_nodes: int,
+    min_ttl_s: float = MIN_HEARTBEAT_TTL_S,
+    rate_hz: float = MAX_HEARTBEATS_PER_SECOND,
 ) -> float:
     """TTL grows with the cluster to bound heartbeat throughput
     (reference: helper lib.RateScaledInterval, heartbeat.go:104)."""
-    interval = float(n_nodes) / MAX_HEARTBEATS_PER_SECOND
+    interval = float(n_nodes) / max(rate_hz, 1e-9)
     return max(min_ttl_s, interval)
 
 
-class HeartbeatTimers:
-    def __init__(self, on_expire: Callable[[str], None]) -> None:
+class _WheelShard:
+    """One shard: an authoritative deadline map plus tick-indexed
+    buckets of node ids. Buckets are HINTS — a bucket entry whose
+    deadline moved (re-arm) or vanished (clear) is dropped when its
+    bucket is processed; the deadline map alone decides expiry."""
+
+    __slots__ = ("lock", "deadlines", "buckets")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.deadlines: dict[str, float] = {}
+        self.buckets: dict[int, set[str]] = {}
+
+
+class HeartbeatWheel:
+    """Leader-local node TTL tracking on a sharded timer wheel.
+
+    API-compatible with the flat-dict ``HeartbeatTimers`` it replaces
+    (``set_enabled`` / ``initialize`` / ``reset`` / ``clear`` /
+    ``active_count`` / ``min_ttl_s`` / ``node_count_fn``), plus:
+
+      * ``on_expire_batch`` — preferred delivery: one call per ticker
+        sweep with EVERY node that expired in it (storm coalescing);
+        ``on_expire`` remains the per-node fallback;
+      * ``tick_s`` — wheel resolution, instance-tunable like
+        ``min_ttl_s`` (scenarios shrink both to fit a test budget
+        without faking the expiry path).
+    """
+
+    def __init__(
+        self,
+        on_expire: Callable[[str], None],
+        on_expire_batch: Optional[Callable[[list], None]] = None,
+        shards: int = DEFAULT_WHEEL_SHARDS,
+        tick_s: float = DEFAULT_WHEEL_TICK_S,
+    ) -> None:
         self.on_expire = on_expire
-        self._lock = threading.Lock()
-        self._timers: dict[str, threading.Timer] = {}
+        self.on_expire_batch = on_expire_batch
+        self.tick_s = tick_s
+        self._shards = [_WheelShard() for _ in range(max(1, shards))]
+        # lifecycle lock: guards enabled flag + ticker thread handle
+        # only — never held while arming timers or delivering expiries
+        self._lifecycle = threading.Lock()
         self._enabled = False
+        self._stop: Optional[threading.Event] = None
+        self._ticker: Optional[threading.Thread] = None
         self.node_count_fn: Callable[[], int] = lambda: 1
         # Instance-tunable TTL floor: production keeps the reference's
         # 10s; chaos scenarios shrink it so spot-churn cycles (node dies
         # silently → TTL expiry → down-mark → reschedule) fit a test
         # budget without faking the expiry path.
         self.min_ttl_s = MIN_HEARTBEAT_TTL_S
+        # Instance-tunable cluster-wide heartbeat rate cap (the n/rate
+        # term of rate_scaled_interval). Fleet scenarios raise it so a
+        # multi-thousand-node fleet's death→down-mark cycle fits a test
+        # budget; production keeps the reference's 50/s.
+        self.rate_hz = MAX_HEARTBEATS_PER_SECOND
+        # monotonic clock, overridable by drift tests
+        import time as _time
+
+        self._now = _time.monotonic
+
+    # -- lifecycle -----------------------------------------------------
 
     def set_enabled(self, enabled: bool) -> None:
-        with self._lock:
+        """Leadership edge. Disable clears every armed TTL and stops the
+        ticker (timers are leader-local state and die with the leader);
+        enable starts a fresh ticker — the new leader re-arms via
+        ``initialize`` at establish-leadership."""
+        with self._lifecycle:
+            if enabled == self._enabled:
+                return
             self._enabled = enabled
-            if not enabled:
-                for t in self._timers.values():
-                    t.cancel()
-                self._timers.clear()
+            if enabled:
+                # drop anything armed by a reset() that raced the last
+                # disable — this incarnation's TTLs come exclusively
+                # from initialize() + live heartbeats
+                for shard in self._shards:
+                    with shard.lock:
+                        shard.deadlines.clear()
+                        shard.buckets.clear()
+                self._stop = threading.Event()
+                self._ticker = threading.Thread(
+                    target=self._tick_loop,
+                    args=(self._stop,),
+                    name="heartbeat-wheel",
+                    daemon=True,
+                )
+                self._ticker.start()
+                return
+            stop, ticker = self._stop, self._ticker
+            self._stop, self._ticker = None, None
+        # outside the lifecycle lock: the ticker may be mid-sweep
+        # waiting for a shard lock; never join while holding ours
+        if stop is not None:
+            stop.set()
+        if ticker is not None:
+            ticker.join(timeout=5)
+        for shard in self._shards:
+            with shard.lock:
+                shard.deadlines.clear()
+                shard.buckets.clear()
 
-    def initialize(self, node_ids) -> None:
+    def initialize(self, node_ids: Iterable[str]) -> None:
         """Arm a TTL for every live node at once — the new leader's
         establish-leadership step (reference heartbeat.go
         initializeHeartbeatTimers). Without this, a node that dies
@@ -56,36 +167,103 @@ class HeartbeatTimers:
         for node_id in node_ids:
             self.reset(node_id)
 
+    # -- arming --------------------------------------------------------
+
     def reset(self, node_id: str) -> float:
-        """(Re)arm the node's TTL; returns the TTL granted, with splay so a
-        thundering herd of re-registrations doesn't expire simultaneously."""
-        ttl = rate_scaled_interval(self.node_count_fn(), self.min_ttl_s)
+        """(Re)arm the node's TTL; returns the TTL granted, with splay so
+        a thundering herd of re-registrations doesn't expire
+        simultaneously. O(1): deadline write + bucket insert; the stale
+        bucket entry from the previous arm is invalidated lazily."""
+        ttl = rate_scaled_interval(
+            self.node_count_fn(), self.min_ttl_s, self.rate_hz
+        )
         ttl += random.uniform(0, ttl / 2)
-        with self._lock:
-            if not self._enabled:
-                return ttl
-            old = self._timers.pop(node_id, None)
-            if old:
-                old.cancel()
-            timer = threading.Timer(ttl, self._expire, args=(node_id,))
-            timer.daemon = True
-            self._timers[node_id] = timer
-            timer.start()
+        if not self._enabled:
+            return ttl
+        deadline = self._now() + ttl
+        shard = self._shard(node_id)
+        tick = int(deadline // self.tick_s) + 1
+        with shard.lock:
+            shard.deadlines[node_id] = deadline
+            shard.buckets.setdefault(tick, set()).add(node_id)
         return ttl
 
     def clear(self, node_id: str) -> None:
-        with self._lock:
-            old = self._timers.pop(node_id, None)
-            if old:
-                old.cancel()
-
-    def _expire(self, node_id: str) -> None:
-        with self._lock:
-            self._timers.pop(node_id, None)
-            if not self._enabled:
-                return
-        self.on_expire(node_id)
+        shard = self._shard(node_id)
+        with shard.lock:
+            shard.deadlines.pop(node_id, None)
 
     def active_count(self) -> int:
-        with self._lock:
-            return len(self._timers)
+        total = 0
+        for shard in self._shards:
+            with shard.lock:
+                total += len(shard.deadlines)
+        return total
+
+    def stats(self) -> dict[str, float]:
+        """Provider gauges (``nomad.heartbeat.*``): armed TTL count and
+        live bucket count across shards (wheel depth)."""
+        armed = 0
+        buckets = 0
+        for shard in self._shards:
+            with shard.lock:
+                armed += len(shard.deadlines)
+                buckets += len(shard.buckets)
+        return {"armed": armed, "wheel_buckets": buckets}
+
+    # -- expiry --------------------------------------------------------
+
+    def _shard(self, node_id: str) -> _WheelShard:
+        return self._shards[hash(node_id) % len(self._shards)]
+
+    def _tick_loop(self, stop: threading.Event) -> None:
+        while not stop.wait(self.tick_s):
+            try:
+                self._advance(self._now())
+            except Exception:
+                logger.exception("heartbeat wheel sweep failed")
+
+    def _advance(self, now: float) -> list[str]:
+        """One sweep: process every due bucket in every shard, expire
+        nodes whose authoritative deadline passed, re-file entries whose
+        deadline moved. Processes ALL overdue ticks (drift catch-up: a
+        ticker delayed by a GC pause expires the backlog in one sweep).
+        Expiry delivery happens with NO shard lock held."""
+        now_tick = int(now // self.tick_s)
+        expired: list[str] = []
+        for shard in self._shards:
+            with shard.lock:
+                if not shard.buckets:
+                    continue
+                due = [t for t in shard.buckets if t <= now_tick]
+                for t in due:
+                    for node_id in shard.buckets.pop(t):
+                        deadline = shard.deadlines.get(node_id)
+                        if deadline is None:
+                            continue  # cleared since it was filed
+                        if deadline <= now:
+                            del shard.deadlines[node_id]
+                            expired.append(node_id)
+                        else:
+                            # re-armed since it was filed: the live
+                            # heartbeat won the race — re-file under
+                            # the new deadline's tick
+                            nt = int(deadline // self.tick_s) + 1
+                            shard.buckets.setdefault(nt, set()).add(
+                                node_id
+                            )
+        if not expired:
+            return expired
+        if not self._enabled:
+            return []
+        if self.on_expire_batch is not None:
+            self.on_expire_batch(expired)
+        else:
+            for node_id in expired:
+                self.on_expire(node_id)
+        return expired
+
+
+# The flat-dict implementation's name, kept as an alias: server wiring,
+# scenarios, and older tests refer to HeartbeatTimers.
+HeartbeatTimers = HeartbeatWheel

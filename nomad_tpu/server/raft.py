@@ -54,6 +54,12 @@ class FSM:
             # holds regardless of where the snapshot came from
             "snapshot_restore": self._apply_snapshot_restore,
             "node_register": self._apply_node_register,
+            # fleet-scale batch forms: one raft entry covers N nodes
+            # (mass-reconnect registration storms, heartbeat-wheel
+            # expiry storms — server.py NodeRegisterBatcher /
+            # _invalidate_heartbeat_batch)
+            "node_register_batch": self._apply_node_register_batch,
+            "node_batch_update_status": self._apply_node_status_batch,
             "node_deregister": self._apply_node_deregister,
             "node_update_status": self._apply_node_status,
             "node_update_drain": self._apply_node_drain,
@@ -122,6 +128,19 @@ class FSM:
         self.state.upsert_node(index, node)
         if self.on_node_update:
             self.on_node_update(node)
+
+    def _apply_node_register_batch(self, index: int, nodes: list) -> None:
+        self.state.upsert_nodes(index, nodes)
+        if self.on_node_update:
+            for node in nodes:
+                self.on_node_update(node)
+
+    def _apply_node_status_batch(self, index: int, payload) -> None:
+        node_ids, status = payload
+        self.state.update_node_statuses(index, node_ids, status)
+        if self.on_node_update:
+            for node_id in node_ids:
+                self.on_node_update(self.state.node_by_id(node_id))
 
     def _apply_node_deregister(self, index: int, node_id: str) -> None:
         self.state.delete_node(index, node_id)

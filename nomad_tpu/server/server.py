@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 from .. import metrics, trace
@@ -49,12 +50,13 @@ from .core_sched import core_eval
 from .deployment_watcher import DeploymentsWatcher
 from .drainer import NodeDrainer
 from .eval_broker import EvalBroker
-from .heartbeat import HeartbeatTimers
+from .heartbeat import HeartbeatWheel
 from .periodic import PeriodicDispatch
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .raft import FSM, InmemLog
 from .volume_watcher import VolumeWatcher
+from .watch_hub import AllocWatchHub
 from .worker import TPUBatchWorker, Worker
 
 logger = logging.getLogger("nomad_tpu.server")
@@ -64,6 +66,122 @@ class ConflictError(Exception):
     """An expected operational rejection (HTTP 400-class), e.g. re-running
     ACL bootstrap. Distinct from PermissionError so filesystem EACCES
     never masquerades as a client error."""
+
+
+class _RegisterBox:
+    """One submitted registration's completion slot."""
+
+    __slots__ = ("event", "error", "fallback")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.fallback = False
+
+
+class NodeRegisterBatcher:
+    """Coalesces concurrent Node.register writes into shared
+    ``node_register_batch`` raft entries.
+
+    A mass reconnect (partition heals, fleet restart) lands thousands of
+    registrations in a few seconds; committing each as its own raft
+    entry serializes the storm through the log at one fsync-equivalent
+    apiece. The batcher holds each registration for a ~5ms coalescing
+    window and commits everything that arrived as ONE entry (bounded at
+    ``max_batch``), so the log cost of a reconnect storm is
+    O(storm / batch) instead of O(storm). Callers still block until
+    their batch commits — acknowledgement semantics are unchanged.
+
+    Leader-only lifecycle: started at establish-leadership, stopped at
+    revoke. ``submit`` returns False when not running (caller falls back
+    to a direct ``node_register`` apply) so followers applying forwarded
+    writes and pre-leadership tests never deadlock on a dead worker.
+    """
+
+    def __init__(
+        self, raft_apply, window_s: float = 0.005, max_batch: int = 256
+    ) -> None:
+        self.raft_apply = raft_apply
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._cv = threading.Condition(threading.Lock())
+        self._queue: list[tuple[object, _RegisterBox]] = []
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._run, name="node-register-batcher", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            if not self._running:
+                return
+            self._running = False
+            drained, self._queue = self._queue, []
+            thread, self._thread = self._thread, None
+            self._cv.notify_all()
+        # anything still queued at revoke-leadership falls back to the
+        # caller's direct apply path (which will fail NotLeader exactly
+        # as an unbatched write would have)
+        for _node, box in drained:
+            box.fallback = True
+            box.event.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def submit(self, node) -> bool:
+        """Queue a registration and block until its batch commits.
+        True = committed via a batch entry; False = batcher not running,
+        caller must apply directly. Re-raises the batch's raft error."""
+        with self._cv:
+            if not self._running:
+                return False
+            box = _RegisterBox()
+            self._queue.append((node, box))
+            self._cv.notify()
+        box.event.wait()
+        if box.fallback:
+            return False
+        if box.error is not None:
+            raise box.error
+        return True
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait()
+                if not self._running:
+                    return
+            # coalescing window: let the rest of a concurrent burst
+            # arrive before cutting the batch (no locks held)
+            time.sleep(self.window_s)
+            with self._cv:
+                batch = self._queue[: self.max_batch]
+                del self._queue[: self.max_batch]
+            if not batch:
+                continue
+            nodes = [node for node, _box in batch]
+            err: Optional[BaseException] = None
+            try:
+                self.raft_apply("node_register_batch", nodes)
+            except BaseException as exc:  # propagate to every waiter
+                err = exc
+            else:
+                metrics.incr("nomad.fleet.node_raft_batches")
+                metrics.incr(
+                    "nomad.fleet.node_raft_coalesced", len(nodes)
+                )
+            for _node, box in batch:
+                box.error = err
+                box.event.set()
 
 
 class Server:
@@ -114,13 +232,41 @@ class Server:
                 "nomad.blocked_evals",
                 lambda: dict(self.blocked_evals.stats),
             )),
+            # heartbeat wheel depth (armed TTLs + live buckets)
+            ("nomad.heartbeat", metrics.register_provider(
+                "nomad.heartbeat", lambda: self.heartbeaters.stats()
+            )),
+            # fleet panel: watch fan-out + node liveness census
+            ("nomad.fleet", metrics.register_provider(
+                "nomad.fleet", self._fleet_stats
+            )),
+            # event-stream subscriber census (bounded-queue discipline)
+            ("nomad.stream", metrics.register_provider(
+                "nomad.stream", lambda: self.event_broker.stats()
+            )),
         ]
         self.plan_applier = PlanApplier(
             self.plan_queue, self.state, self.raft_apply, self.raft_apply_async
         )
         self.blocked_evals = BlockedEvals(self._requeue_unblocked)
-        self.heartbeaters = HeartbeatTimers(self._invalidate_heartbeat)
+        # Sharded heartbeat timer wheel (heartbeat.py): one ticker
+        # thread, O(1) re-arm, and expiry storms delivered as ONE batch
+        # per sweep so a mass expiry commits a bounded number of raft
+        # entries instead of one per node.
+        self.heartbeaters = HeartbeatWheel(
+            self._invalidate_heartbeat,
+            on_expire_batch=self._invalidate_heartbeat_batch,
+        )
         self.heartbeaters.node_count_fn = lambda: len(self.state.nodes())
+        # Event-driven alloc-watch fan-out (watch_hub.py): blocking
+        # client alloc watches wake per-node instead of per-write.
+        # Constructed here (not at establish-leadership) because
+        # followers serve Node.get_client_allocs from their replicas.
+        self.watch_hub = AllocWatchHub(self.state)
+        # Mass-reconnect registration coalescer: concurrent
+        # Node.register writes share node_register_batch raft entries
+        # (leader-only; started at establish-leadership).
+        self.register_batcher = NodeRegisterBatcher(self.raft_apply)
         self.deployment_watcher = DeploymentsWatcher(self.state, self.raft_apply)
         self.drainer = NodeDrainer(self.state, self.raft_apply)
         self.volume_watcher = VolumeWatcher(self.state, self.raft_apply)
@@ -235,6 +381,7 @@ class Server:
         self.plan_queue.set_enabled(True)
         self.blocked_evals.set_enabled(True)
         self.heartbeaters.set_enabled(True)
+        self.register_batcher.start()
         self.plan_applier.start()
         for w in self.workers:
             w.start()
@@ -294,6 +441,7 @@ class Server:
         if self.tpu_worker:
             self.tpu_worker.stop()
         self.plan_applier.stop()
+        self.register_batcher.stop()
         self.eval_broker.set_enabled(False)
         self.plan_queue.set_enabled(False)
         self.blocked_evals.set_enabled(False)
@@ -303,7 +451,22 @@ class Server:
         for name, handle in self._metric_handles:
             metrics.unregister_provider(name, handle)
         self.revoke_leadership()
+        self.watch_hub.stop()
         self._unblock_q.put(None)
+
+    def _fleet_stats(self) -> dict[str, float]:
+        """`nomad.fleet.*` provider gauges: watch fan-out census plus a
+        node-liveness breakdown (the `operator top` Fleet row)."""
+        stats = self.watch_hub.stats()
+        ready = down = 0
+        for node in self.state.nodes():
+            if node.status == NODE_STATUS_READY:
+                ready += 1
+            elif node.status == NODE_STATUS_DOWN:
+                down += 1
+        stats["nodes_ready"] = ready
+        stats["nodes_down"] = down
+        return stats
 
     def _worker_stats(self) -> dict[str, float]:
         workers = list(self.workers)
@@ -863,11 +1026,20 @@ class Server:
         node = node.copy()
         if not node.status:
             node.status = NODE_STATUS_READY
-        self.raft_apply("node_register", node)
-        # A ready node may unblock system jobs / blocked evals
-        # (reference node_endpoint.go Register -> createNodeEvals).
+        prev = self.state.node_by_id(node.id)
+        was_ready = prev is not None and prev.ready()
+        # Registration storms share node_register_batch raft entries;
+        # the direct path serves followers applying forwarded writes and
+        # anything running before leadership is established.
+        if not self.register_batcher.submit(node):
+            self.raft_apply("node_register", node)
+        # A node that BECAME ready may unblock system jobs / blocked
+        # evals (reference node_endpoint.go Register -> createNodeEvals).
+        # A re-registration that didn't change readiness mints no evals:
+        # a 10k-node reconnect storm must not multiply eval_update raft
+        # entries for placements that already exist.
         stored = self.state.node_by_id(node.id)
-        if stored is not None and stored.ready():
+        if stored is not None and stored.ready() and not was_ready:
             self._create_node_evals(node.id)
         return self.heartbeaters.reset(node.id)
 
@@ -927,11 +1099,65 @@ class Server:
             # the raft error escape into the Timer thread.
             logger.exception("node %s down-mark failed", node_id)
 
+    def _invalidate_heartbeat_batch(self, node_ids: list[str]) -> None:
+        """A wheel sweep's whole expiry crop, committed as ONE
+        node_batch_update_status raft entry plus ONE eval_update — a
+        mass expiry (partition, leader stall) costs a bounded number of
+        log entries instead of two per node."""
+        known = [
+            nid for nid in node_ids if self.state.node_by_id(nid) is not None
+        ]
+        if not known:
+            return
+        metrics.incr("nomad.heartbeat.expired", len(known))
+        metrics.incr("nomad.heartbeat.expire_batches")
+        logger.warning(
+            "%d node(s) missed heartbeats; marking down in one batch",
+            len(known),
+        )
+        try:
+            self.raft_apply(
+                "node_batch_update_status", (known, NODE_STATUS_DOWN)
+            )
+        except KeyError:
+            return
+        except Exception:
+            # same discipline as the single-node path: a deposed or
+            # quorumless leader drops the down-mark; the next leader's
+            # wheel re-derives liveness
+            logger.exception(
+                "batched down-mark failed for %d node(s)", len(known)
+            )
+            return
+        metrics.incr("nomad.fleet.node_raft_batches")
+        metrics.incr("nomad.fleet.node_raft_coalesced", len(known))
+        evals: list[Evaluation] = []
+        for nid in known:
+            self.heartbeaters.clear(nid)
+            evals.extend(self._build_node_evals(nid))
+        if evals:
+            try:
+                self.raft_apply("eval_update", evals)
+            except Exception:
+                logger.exception(
+                    "eval_update for batched expiry failed (%d evals)",
+                    len(evals),
+                )
+
     def _create_node_evals(
         self, node_id: str, trigger: str = EVAL_TRIGGER_NODE_UPDATE
     ) -> list[str]:
+        evals = self._build_node_evals(node_id, trigger)
+        if evals:
+            self.raft_apply("eval_update", evals)
+        return [e.id for e in evals]
+
+    def _build_node_evals(
+        self, node_id: str, trigger: str = EVAL_TRIGGER_NODE_UPDATE
+    ) -> list[Evaluation]:
         """One eval per job with allocs on the node (reference
-        node_endpoint.go:495 createNodeEvals)."""
+        node_endpoint.go:495 createNodeEvals). Build-only so batch
+        callers can merge many nodes' evals into one raft entry."""
         node = self.state.node_by_id(node_id)
         evals: list[Evaluation] = []
         seen: set[tuple[str, str]] = set()
@@ -976,9 +1202,7 @@ class Server:
                             modify_time=now_ns(),
                         )
                     )
-        if evals:
-            self.raft_apply("eval_update", evals)
-        return [e.id for e in evals]
+        return evals
 
     # -- deployment endpoint (reference nomad/deployment_endpoint.go) --
 
@@ -1221,10 +1445,19 @@ class Server:
     def get_client_allocs(
         self, node_id: str, min_index: int = 0, timeout_s: float = 5.0
     ) -> tuple[list[Allocation], int]:
-        """Node.GetClientAllocs: blocking query on the alloc table."""
+        """Node.GetClientAllocs: blocking query on the node's allocs.
+
+        The seed implementation parked every watcher on the alloc
+        TABLE's condition — each plan apply woke all of them
+        (``notify_all``) and each re-scanned its node's allocs. The
+        watch hub wakes only the nodes a write actually touched; a
+        timeout still falls through to a fetch, so the returned
+        (allocs, index) contract is unchanged."""
         from ..state.store import TABLE_ALLOCS
 
-        index = self.state.wait_for_index([TABLE_ALLOCS], min_index, timeout_s)
+        if min_index > 0:
+            self.watch_hub.wait_for_node(node_id, min_index, timeout_s)
+        index = self.state.wait_for_index([TABLE_ALLOCS], 0, 0.0)
         return self.state.allocs_by_node(node_id), index
 
     # -- draining helpers ---------------------------------------------

@@ -649,6 +649,13 @@ class StateStore(_ReadMixin):
         # the reference's raft-message-derived stream event types
         # (nomad/state/events.go eventFromChange).
         self._subscribers: list[Callable[[int, str, list, str], None]] = []
+        # Restore hooks: called under lock AFTER a snapshot restore (or
+        # index rebase) replaces the tables, with (index, alloc node-ids).
+        # Separate from _subscribers so internal watch routers can
+        # re-prime without emitting synthetic stream events — external
+        # stream consumers re-subscribe after a restore, as in the
+        # reference.
+        self._restore_subs: list[Callable[[int, set], None]] = []
 
     # -- snapshot / watch ----------------------------------------------
 
@@ -705,6 +712,22 @@ class StateStore(_ReadMixin):
 
     def subscribe(self, fn: Callable[[int, str, list, str], None]) -> None:
         self._subscribers.append(fn)
+
+    def subscribe_restore(self, fn: Callable[[int, set], None]) -> None:
+        self._restore_subs.append(fn)
+
+    def _notify_restore(self) -> None:
+        """Caller holds the lock: hand restore hooks the rebased index
+        plus every node that owns allocs in the restored world."""
+        if not self._restore_subs:
+            return
+        node_ids = {
+            getattr(a, "node_id", "")
+            for a in self._tables[TABLE_ALLOCS].values()
+        }
+        node_ids.discard("")
+        for fn in self._restore_subs:
+            fn(self._latest_index, node_ids)
 
     # -- ACL -----------------------------------------------------------
 
@@ -837,6 +860,7 @@ class StateStore(_ReadMixin):
             self._latest_index = data["latest"]
             self._shared = set()
             self._idx_owned.clear()
+            self._notify_restore()
             self._cv.notify_all()
 
     def rebase_indexes(self, index: int) -> None:
@@ -854,6 +878,7 @@ class StateStore(_ReadMixin):
             for t in self._indexes:
                 self._indexes[t] = index
             self._latest_index = index
+            self._notify_restore()
             self._cv.notify_all()
 
     # -- write plumbing ------------------------------------------------
@@ -965,6 +990,63 @@ class StateStore(_ReadMixin):
             t[node.id] = node
             self._stamp(index, TABLE_NODES)
             self._publish(index, TABLE_NODES, [node], "NodeRegistration")
+
+    def upsert_nodes(self, index: int, nodes: list) -> None:
+        """Bulk ``upsert_node``: one lock hold, one index stamp, one
+        published event block for the whole batch — the store half of
+        the batched node-register raft entry (a 10k-node reconnect
+        storm commits as a bounded number of entries, each landing
+        here once)."""
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            upserted = []
+            for node in nodes:
+                existing = t.get(node.id)
+                node = node.copy()
+                if existing is not None:
+                    node.create_index = existing.create_index
+                    node.drain_strategy = existing.drain_strategy
+                    node.scheduling_eligibility = (
+                        existing.scheduling_eligibility
+                    )
+                    if existing.status:
+                        node.status = existing.status
+                        node.status_updated_at = existing.status_updated_at
+                else:
+                    node.create_index = index
+                node.modify_index = index
+                node.canonicalize()
+                t[node.id] = node
+                upserted.append(node)
+            self._stamp(index, TABLE_NODES)
+            self._publish(index, TABLE_NODES, upserted, "NodeRegistration")
+
+    def update_node_statuses(
+        self, index: int, node_ids: list, status: str
+    ) -> None:
+        """Bulk ``update_node_status``: the store half of the batched
+        down-mark raft entry a heartbeat-wheel expiry storm commits.
+        Unknown ids are skipped (a node purged between expiry and
+        apply), not an error — the batch must land for the rest."""
+        with self._lock:
+            t = self._wtable(TABLE_NODES)
+            updated = []
+            stamp = now_ns()
+            for node_id in node_ids:
+                existing = t.get(node_id)
+                if existing is None:
+                    continue
+                node = existing.copy()
+                node.status = status
+                node.status_updated_at = stamp
+                node.modify_index = index
+                t[node_id] = node
+                updated.append(node)
+            if updated:
+                self._stamp(index, TABLE_NODES)
+                self._publish(
+                    index, TABLE_NODES, updated, "NodeStatusUpdate"
+                )
 
     def delete_node(self, index: int, node_id: str) -> None:
         with self._lock:

@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from .. import metrics
+
 TOPIC_ALL = "*"
 KEY_ALL = "*"
 
@@ -103,6 +105,7 @@ class Subscription:
     def close(self) -> None:
         self._closed = True
         with self._broker._cv:
+            self._broker._subs.discard(self)
             self._broker._cv.notify_all()
 
 
@@ -124,6 +127,12 @@ class EventBroker:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._closed = False
+        # live Subscription registry: every subscriber is accounted for
+        # from subscribe() until close()/eviction, so a fleet of
+        # streamers shows up in `operator top` and a leak is visible as
+        # a gauge, not an OOM
+        self._subs: set[Subscription] = set()
+        self._evicted = 0
 
     # -- publishing ----------------------------------------------------
 
@@ -168,7 +177,23 @@ class EventBroker:
                     if index > from_index:
                         start_seq = seq
                         break
-            return Subscription(self, topics, start_seq, namespace)
+            sub = Subscription(self, topics, start_seq, namespace)
+            self._subs.add(sub)
+            return sub
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def stats(self) -> dict[str, float]:
+        """Provider gauges (``nomad.stream.*``): live subscriber count,
+        ring depth, and cumulative slow-consumer evictions."""
+        with self._lock:
+            return {
+                "subscribers": len(self._subs),
+                "buffered_blocks": len(self._blocks),
+                "evicted": self._evicted,
+            }
 
     def _next_block(
         self, sub: Subscription, timeout_s: Optional[float]
@@ -183,6 +208,14 @@ class EventBroker:
                     return block[1]
                 if sub._seq < self._next_seq:
                     # Evicted from the ring before we read it: too slow.
-                    raise SubscriptionClosedError("subscriber fell behind")
+                    # The ring IS the bounded queue — a consumer that
+                    # can't keep up is cut loose, never buffered for.
+                    sub._closed = True
+                    self._subs.discard(sub)
+                    self._evicted += 1
+                    break
                 if not self._cv.wait(timeout_s):
                     return None
+        # counter bumped outside the broker lock (lock discipline)
+        metrics.incr("nomad.stream.evicted_total")
+        raise SubscriptionClosedError("subscriber fell behind")

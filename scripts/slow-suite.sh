@@ -9,8 +9,11 @@
 # tests/test_chaos.py), the production-ops resilience acceptance
 # batteries (tests/test_scenarios.py: 25-seed secret rotation, 25-seed
 # rolling upgrade, long spot-node churn — narrow with `-m scenario`),
-# and the profiler/observability overhead batteries at full length —
-# plus anything else that grows a `slow` mark. Runs on the CPU backend
+# the fleet-scale survival soak (>=5k simulated nodes held 10 minutes
+# through a mass-expiry + mass-reconnect storm, tests/test_fleet.py —
+# narrow with `-m fleet`), and the profiler/observability overhead
+# batteries at full length — plus anything else that grows a `slow`
+# mark. Runs on the CPU backend
 # (the tier-1 posture); point JAX_PLATFORMS elsewhere to exercise a
 # real device.
 #

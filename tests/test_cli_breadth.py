@@ -775,6 +775,9 @@ REFERENCE_COMMAND_FLAGS = {
     # Round 19 (interactive fast-path PR): the new `Lanes` panel is a
     # render-only row (tests/test_overload.py TestOperatorTopLanePanel)
     # — the flag set is deliberately unchanged.
+    # Round 21 (fleet-scale survival PR): same for the `Fleet` panel
+    # (heartbeat wheel / watch hub / node door, tests/test_fleet.py
+    # TestOperatorTopFleetPanel) — render-only, flags unchanged.
     "operator top": {
         "flags": {"-interval", "-n", "-once", "-cluster",
                   "-address", "-token"},

@@ -67,6 +67,42 @@ class TestEventBroker:
         with pytest.raises(SubscriptionClosedError):
             sub.next(timeout_s=1)
 
+    def test_fell_behind_subscriber_evicted_from_accounting(self):
+        """Round 21: a fell-behind subscriber doesn't just get the
+        closed error — it leaves the broker's subscriber accounting
+        immediately (stats()/subscriber_count feed the nomad.stream.*
+        gauges) and bumps the eviction counters."""
+        from nomad_tpu import metrics
+
+        before = metrics.registry().snapshot()["counters"].get(
+            "nomad.stream.evicted_total", 0
+        )
+        b = EventBroker(size=4)
+        sub = b.subscribe()
+        assert b.subscriber_count() == 1
+        for i in range(1, 10):
+            b.publish([_ev(i)])
+        with pytest.raises(SubscriptionClosedError):
+            sub.next(timeout_s=1)
+        assert b.subscriber_count() == 0
+        stats = b.stats()
+        assert stats["subscribers"] == 0
+        assert stats["evicted"] == 1
+        assert (
+            metrics.registry().snapshot()["counters"].get(
+                "nomad.stream.evicted_total", 0
+            )
+            == before + 1
+        )
+
+    def test_explicit_close_deregisters_subscriber(self):
+        b = EventBroker()
+        sub = b.subscribe()
+        assert b.subscriber_count() == 1
+        sub.close()
+        assert b.subscriber_count() == 0
+        assert b.stats()["evicted"] == 0
+
     def test_close_wakes_blocked_subscriber(self):
         b = EventBroker()
         sub = b.subscribe()

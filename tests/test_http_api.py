@@ -170,6 +170,42 @@ class TestEventStream:
         assert evs[0]["Topic"] == "Job"
         assert type(evs[0]["Payload"]).__name__ == "Job"
 
+    def test_killed_streamer_reaped_promptly(self, agent):
+        """Regression (round 21): a streaming client that dies without
+        closing cleanly must not pin its broker subscription until the
+        next event happens to flush — the streamer probes the socket
+        between 2s holds and reaps the subscription within seconds."""
+        import socket as socket_mod
+
+        from nomad_tpu import metrics
+
+        broker = agent.server.server.event_broker
+        base = broker.subscriber_count()
+        host, port = agent.http_addr
+        sock = socket_mod.create_connection((host, port))
+        try:
+            sock.sendall(
+                b"GET /v1/event/stream?topic=Job HTTP/1.1\r\n"
+                b"Host: test\r\n\r\n"
+            )
+            assert wait_until(
+                lambda: broker.subscriber_count() == base + 1, 10
+            ), "stream subscription never registered"
+        finally:
+            before = metrics.registry().snapshot()["counters"].get(
+                "nomad.stream.reaped", 0
+            )
+            sock.close()  # the client dies; no FIN-wait niceties
+        assert wait_until(
+            lambda: broker.subscriber_count() <= base, 10
+        ), "dead streamer's subscription never reaped"
+        assert (
+            metrics.registry().snapshot()["counters"].get(
+                "nomad.stream.reaped", 0
+            )
+            >= before + 1
+        )
+
 
 # small helpers on the client for the blocking test
 def _get_raw_jobs(self, index=None, wait=None):

@@ -205,6 +205,118 @@ class TestStreaming:
             server.shutdown()
 
 
+class TestDialSingleFlight:
+    """Reconnect-storm dial discipline (round 21): concurrent callers
+    whose pooled conn died queue behind ONE in-flight dial per peer
+    instead of stacking TCP handshakes against a likely-dead address."""
+
+    def test_dial_storm_never_stacks_handshakes(self, monkeypatch):
+        from nomad_tpu.rpc import client as rpc_client
+
+        server = RPCServer()
+        server.register("Echo", Echo())
+        server.start()
+        pool = ConnPool()
+        # The guaranteed property is CONCURRENCY, not total count: a
+        # pooled conn that dies (the host can RST loopback conns under
+        # fd/TIME_WAIT pressure) is legitimately redialed — but never
+        # while another dial to the same peer is already in flight.
+        state = {"cur": 0, "peak": 0, "total": 0}
+        state_lock = threading.Lock()
+        real_conn = rpc_client._Conn
+
+        class CountingConn(real_conn):
+            def __init__(self, *a, **kw):
+                # the patch is module-global: stray background dials to
+                # OTHER peers (leaked retry loops from earlier test
+                # modules) must not count against OUR peer's flight
+                addr = a[0] if a else kw.get("addr")
+                ours = addr == server.addr
+                if ours:
+                    with state_lock:
+                        state["cur"] += 1
+                        state["total"] += 1
+                        state["peak"] = max(state["peak"], state["cur"])
+                    time.sleep(0.2)  # a slow handshake the storm piles on
+                try:
+                    super().__init__(*a, **kw)
+                finally:
+                    if ours:
+                        with state_lock:
+                            state["cur"] -= 1
+
+        monkeypatch.setattr(rpc_client, "_Conn", CountingConn)
+        try:
+            conns = []
+            errs = []
+
+            def caller():
+                # _get is the single-flight unit under test; pool.call's
+                # dead-conn retry layer above it may legitimately redial
+                try:
+                    conns.append(pool._get(server.addr))
+                except Exception as e:
+                    errs.append(e)
+
+            threads = [threading.Thread(target=caller) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            assert not errs
+            assert state["peak"] == 1, (
+                f"{state['peak']} concurrent handshakes for one peer — "
+                "waiters must adopt the in-flight dial"
+            )
+            assert state["total"] < 8, (
+                f"{state['total']} dials for 8 callers — the storm "
+                "never coalesced"
+            )
+            assert len(conns) == 8
+            # and the pooled conn actually works
+            assert pool.call(server.addr, "Echo.echo", 7) == 7
+        finally:
+            pool.shutdown()
+            server.shutdown()
+
+    def test_failed_dial_wakes_waiters_promptly(self, monkeypatch):
+        from nomad_tpu.rpc import client as rpc_client
+
+        pool = ConnPool(connect_timeout_s=1.0)
+        addr = ("127.0.0.1", 1)  # never dialed — _Conn is patched
+        real_conn = rpc_client._Conn
+
+        def boom(a, *rest, **kw):
+            # global patch: fail only OUR peer, pass strays through
+            if a == addr:
+                raise ConnectionRefusedError("peer down")
+            return real_conn(a, *rest, **kw)
+
+        monkeypatch.setattr(rpc_client, "_Conn", boom)
+        try:
+            errs = []
+
+            def caller():
+                try:
+                    pool.call(addr, "Echo.echo", 1, timeout_s=2)
+                except Exception as e:
+                    errs.append(e)
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=caller) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            elapsed = time.monotonic() - t0
+            assert len(errs) == 6
+            # waiters retried or failed right behind the flight — nobody
+            # sat out a full connect timeout queue serially
+            assert elapsed < 5.0, f"dial-failure fan-out took {elapsed:.1f}s"
+        finally:
+            pool.shutdown()
+
+
 class TestCodecEscaping:
     def test_dollar_key_user_dict_roundtrips(self):
         """Reserved-tag collision: user data with $-keys must survive."""
