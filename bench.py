@@ -1558,6 +1558,29 @@ def run_soak_config():
         f"coverage {src.get('coverage')} over {src.get('total_calls')} "
         f"calls, top {src.get('top')}"
     )
+    # flight-recorder verdict (docs/incidents.md): the soak runs with
+    # faults ON, so captured incidents are signal, not failure — the
+    # capture line makes "did the blackbox see what the fault plane
+    # did" auditable from the bench JSON alone
+    from nomad_tpu import blackbox as _bb
+
+    rec = _bb.recorder()
+    report["blackbox"] = rec.stats()
+    report["incidents"] = [
+        {"id": r["id"], "reason": r["reason"]} for r in rec.incidents()
+    ]
+    bstats = report["blackbox"]
+    log(
+        f"[soak] blackbox: {int(bstats['journal_recorded'])} journal "
+        f"rows ({int(bstats['journal_evicted'])} evicted), triggers "
+        f"fired {int(bstats['triggers_fired'])} (deduped "
+        f"{int(bstats['triggers_deduped'])}), incidents captured "
+        f"{int(bstats['incidents_captured'])}"
+        + (
+            " " + ",".join(r["reason"] for r in report["incidents"])
+            if report["incidents"] else ""
+        )
+    )
     return report
 
 

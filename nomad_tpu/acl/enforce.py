@@ -152,6 +152,13 @@ _AGENT_READ = [
     # and /v1/profile, NOT operator:read (checked before the broader
     # operator rule below; the payload is telemetry, not raft control)
     ("GET", re.compile(r"^/v1/operator/cluster/health$")),
+    # blackbox flight recorder (blackbox.py): status, incident index,
+    # and causal timelines — the same always-on observability family as
+    # /v1/metrics and /v1/profile (incident bundles carry the same
+    # internals traces do, so the same agent:read gate)
+    ("GET", re.compile(r"^/v1/blackbox(/.*)?$")),
+    ("GET", re.compile(r"^/v1/incidents(/.*)?$")),
+    ("GET", re.compile(r"^/v1/timeline(/.*)?$")),
 ]
 # reference: raft list-peers / snapshot save need operator:read; snapshot
 # restore needs operator:write (nomad/operator_endpoint.go)

@@ -242,6 +242,16 @@ class AgentConfig:
     solver_pool_role: str = ""
     solver_pool_members: tuple = ()
     solver_pool_sync_interval_s: float = 2.0
+    # blackbox flight recorder (blackbox.py): ON by default — always-on
+    # incident capture is the point (the throughput gate holds the
+    # journal under 5%). telemetry { blackbox_enabled = false } opts
+    # out; incident_dir overrides the data_dir/incidents default (dev
+    # mode has no data_dir, so captures stay in-memory unless set);
+    # incident_max bounds the capture index. SIGHUP-reloadable
+    # (Agent.reload).
+    blackbox_enabled: bool = True
+    incident_dir: str = ""
+    incident_max: int = 16
 
     @staticmethod
     def dev() -> "AgentConfig":
@@ -332,6 +342,12 @@ class Agent:
                 solver_pool_role=config.solver_pool_role,
                 solver_pool_members=config.solver_pool_members,
                 solver_pool_sync_interval_s=config.solver_pool_sync_interval_s,
+                blackbox_enabled=config.blackbox_enabled,
+                # dev mode passes data_dir=None to ClusterServer, so an
+                # explicitly configured incident_dir is the only way a
+                # dev agent writes bundles to disk
+                incident_dir=config.incident_dir or None,
+                incident_max=config.incident_max,
             )
             self.server.server.vault_allowed_policies = (
                 list(config.vault_allowed_policies)
@@ -662,6 +678,18 @@ class Agent:
                 changed.append("broker")
             if limits_changed:
                 changed.append("limits")
+        blackbox_keys = ("blackbox_enabled", "incident_dir", "incident_max")
+        if self.server is not None and any(
+            getattr(new_config, k) != getattr(old, k) for k in blackbox_keys
+        ):
+            self.server.blackbox.reload(
+                enabled=new_config.blackbox_enabled,
+                incident_dir=new_config.incident_dir or None,
+                incident_max=new_config.incident_max,
+            )
+            for k in blackbox_keys:
+                setattr(old, k, getattr(new_config, k))
+            changed.append("blackbox")
         pool_keys = (
             "solver_pool_role",
             "solver_pool_members",

@@ -154,4 +154,9 @@ def debug_bundle(api) -> dict:
     # degraded members flagged — the `operator debug` analog of the
     # reference's autopilot-health grab
     grab("cluster_health", lambda: api.operator.cluster_health())
+    # flight recorder: the incident index plus the journal tail — the
+    # minutes-before-the-crash context (docs/incidents.md) travels in
+    # the same archive as the point-in-time snapshots above
+    grab("incidents", lambda: api.agent.incidents())
+    grab("blackbox", lambda: api.agent.blackbox_status(journal=500))
     return bundle

@@ -200,6 +200,9 @@ class HTTPAgentServer:
         "/v1/profile",
         "/v1/event/stream",
         "/v1/acl",
+        "/v1/blackbox",
+        "/v1/incidents",
+        "/v1/timeline",
     )
 
     @staticmethod
@@ -1401,6 +1404,113 @@ class HTTPAgentServer:
 
         route("GET", "/v1/profile/status", profile_status)
         route("GET", "/v1/profile/collapsed", profile_collapsed)
+
+        def blackbox_status(p, q, body, tok):
+            # /v1/blackbox/status: the flight recorder's summary —
+            # journal occupancy, per-kind counts, trigger catalogue with
+            # last-fired ages, recent incidents. Same agent:read gate as
+            # /v1/metrics; ?journal=N appends the newest N journal rows.
+            from .. import blackbox as _bb
+
+            rec = _bb.recorder()
+            wiring = getattr(self.cluster, "blackbox", None)
+            try:
+                tail = int(q.get("journal", ["0"])[0])
+            except ValueError:
+                raise HTTPError(400, "journal must be an integer")
+            out = {
+                "enabled": _bb.enabled()
+                and bool(wiring and wiring.enabled),
+                "stats": rec.stats(),
+                "kinds": rec.kind_counts(),
+                "triggers": rec.triggers.status(),
+                "incident_dir": wiring.incident_dir if wiring else "",
+                "incidents": rec.incidents()[:5],
+            }
+            if tail:
+                out["journal"] = rec.snapshot(
+                    limit=max(1, min(tail, 1000))
+                )
+            return out
+
+        def incidents_list(p, q, body, tok):
+            # /v1/incidents: the capture index, newest first (the
+            # on-disk bundles live under each record's `path`).
+            from .. import blackbox as _bb
+
+            return _bb.recorder().incidents()
+
+        def incident_get(p, q, body, tok):
+            from .. import blackbox as _bb
+
+            import os as _os
+
+            rec = _bb.recorder().incident(p["id"])
+            if rec is None:
+                raise HTTPError(404, f"incident {p['id']} not found")
+            files = []
+            if rec.get("path"):
+                try:
+                    files = sorted(_os.listdir(rec["path"]))
+                except OSError:
+                    pass
+            rec["files"] = files
+            return rec
+
+        def timeline_get(p, q, body, tok):
+            # /v1/timeline/<kind>/<id>: the causal cross-object view —
+            # journal rows (broker events with extracted rel links,
+            # leadership edges, sheds, trims, expiries) merged with
+            # finished traces, expanded through the link graph so an
+            # eval's timeline reaches its plan, allocs, and nodes.
+            from .. import blackbox as _bb
+            from .. import trace as _trace
+
+            kind = p["kind"]
+            if kind not in _bb.TIMELINE_KINDS:
+                raise HTTPError(
+                    400,
+                    "kind must be one of "
+                    + ", ".join(_bb.TIMELINE_KINDS),
+                )
+            rows = _bb.recorder().snapshot()
+            # traces keep monotonic clocks (trace.py); re-base onto wall
+            # time so the merged view sorts on one axis (same-process
+            # alignment only, which is what the journal is too)
+            off = time.time() - time.monotonic()
+            for t in _trace.recorder().list(limit=200):
+                attrs = t.get("attrs") or {}
+                rel = []
+                for a, k in (("eval_id", "eval"), ("job_id", "job"),
+                             ("node_id", "node")):
+                    v = attrs.get(a)
+                    if v:
+                        rel.append(f"{k}:{v}")
+                for e in attrs.get("eval_ids") or ():
+                    rel.append(f"eval:{e}")
+                if not rel:
+                    continue
+                rows.append({
+                    "ts": t["start"] / 1e9 + off,
+                    "kind": "trace",
+                    "key": t["id"],
+                    "detail": {
+                        "name": t["name"],
+                        "duration_ms": t.get("duration_ms"),
+                        "spans": t.get("num_spans"),
+                        "rel": rel,
+                    },
+                })
+            return _bb.build_timeline(kind, p["id"], rows)
+
+        route("GET", "/v1/blackbox/status", blackbox_status)
+        route("GET", "/v1/incidents", incidents_list)
+        route("GET", "/v1/incidents/(?P<id>[^/]+)", incident_get)
+        route(
+            "GET",
+            "/v1/timeline/(?P<kind>[^/]+)/(?P<id>[^/]+)",
+            timeline_get,
+        )
 
         def agent_members(p, q, body, tok):
             return [m.to_wire() for m in self.cluster.serf.members()]

@@ -489,7 +489,7 @@ def _cycles(index: Index, edges: dict) -> list:
 
 LEAF_MODULES = (
     "trace", "metrics", "hostobs", "solverobs", "faultplane",
-    "ratelimit", "retry", "gctune", "clusterobs",
+    "ratelimit", "retry", "gctune", "clusterobs", "blackbox",
 )
 JAX_EAGER_OK_PREFIX = "scheduler/tpu"
 

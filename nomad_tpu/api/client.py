@@ -837,6 +837,31 @@ class AgentAPI(_Resource):
         )
         return resp.read().decode()
 
+    def blackbox_status(self, journal: int = 0):
+        """Flight-recorder summary (/v1/blackbox/status): journal
+        occupancy and per-kind counts, the trigger catalogue with
+        last-fired ages, and recent incidents (nomad_tpu/blackbox.py);
+        journal=N appends the newest N journal rows. Rendered by
+        `operator incidents list` and the `operator top` panel."""
+        params = {"journal": journal} if journal else None
+        return self.c.get("/v1/blackbox/status", params=params)
+
+    def incidents(self):
+        """Captured-incident index (/v1/incidents), newest first; each
+        record's `path` is the on-disk bundle directory."""
+        return self.c.get("/v1/incidents")
+
+    def incident(self, incident_id: str):
+        """One incident's record + its bundle file inventory."""
+        return self.c.get(f"/v1/incidents/{incident_id}")
+
+    def timeline(self, kind: str, obj_id: str):
+        """Causal cross-object timeline (/v1/timeline/<kind>/<id>):
+        journal rows + finished traces merged and expanded through
+        their cross-object links (eval -> plan -> alloc -> node).
+        Rendered by `operator timeline <kind> <id>`."""
+        return self.c.get(f"/v1/timeline/{kind}/{obj_id}")
+
     def self(self):
         return self.c.get("/v1/agent/self")
 

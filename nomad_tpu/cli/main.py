@@ -285,6 +285,12 @@ def _load_agent_config(path: str):
             cfg.host_profile_interval_ms = (
                 parse_duration(tea["host_profile_interval"]) * 1e3
             )
+        if "blackbox_enabled" in tea:
+            cfg.blackbox_enabled = bool(tea["blackbox_enabled"])
+        if "incident_dir" in tea:
+            cfg.incident_dir = str(tea["incident_dir"])
+        if "incident_max" in tea:
+            cfg.incident_max = int(tea["incident_max"])
     brb = body.block("broker")
     if brb is not None:
         from ..jobspec.hcl import parse_duration
@@ -391,6 +397,12 @@ def _apply_config_dict(cfg, data: dict) -> None:
                 cfg.host_profile_interval_ms = (
                     parse_duration(v["host_profile_interval"]) * 1e3
                 )
+            if "blackbox_enabled" in v:
+                cfg.blackbox_enabled = bool(v["blackbox_enabled"])
+            if "incident_dir" in v:
+                cfg.incident_dir = str(v["incident_dir"])
+            if "incident_max" in v:
+                cfg.incident_max = int(v["incident_max"])
         elif k == "broker" and isinstance(v, dict):
             from ..jobspec.hcl import parse_duration
 
@@ -2109,13 +2121,17 @@ _TOP_STAGE_ORDER = [
 ]
 
 
-def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
+def _render_top(
+    snap: dict, prev, solver=None, profile=None, blackbox=None
+) -> str:
     """One `operator top` frame from a /v1/metrics snapshot. prev is
     (monotonic_time, snapshot) of the previous frame (None on the
     first) — eval throughput is the e2e-count delta between frames,
     falling back to the last window's rate. solver is the optional
     /v1/solver/status payload feeding the solver panel row; profile the
-    optional /v1/profile/status payload feeding the host row."""
+    optional /v1/profile/status payload feeding the host row; blackbox
+    the optional /v1/blackbox/status payload feeding the incidents
+    row."""
     import time as _time
 
     gauges = snap.get("gauges") or {}
@@ -2330,6 +2346,29 @@ def _render_top(snap: dict, prev, solver=None, profile=None) -> str:
                 else ""
             )
         )
+    # incidents row (flight recorder, blackbox.py): rendered only when
+    # the recorder has fired a trigger or captured/suppressed an
+    # incident — a healthy cluster keeps the compact layout, and the
+    # row appearing at all is itself the signal (docs/incidents.md).
+    if blackbox is not None:
+        bstats = blackbox.get("stats") or {}
+        fired = int(bstats.get("triggers_fired", 0))
+        captured = int(bstats.get("incidents_captured", 0))
+        suppressed = int(bstats.get("incidents_suppressed", 0))
+        if fired or captured or suppressed:
+            last = next(iter(blackbox.get("incidents") or []), None)
+            lines.append(
+                f"Incidents   captured {captured}"
+                f" (stored {int(bstats.get('incidents_stored', 0))})"
+                f"   triggers fired {fired}"
+                f"  deduped {int(bstats.get('triggers_deduped', 0))}"
+                + (
+                    f"   suppressed {suppressed}" if suppressed else ""
+                )
+                + (
+                    f"   last {last['id']}" if last else ""
+                )
+            )
     lines += [
         "",
         "Stage latencies (cumulative | last window):",
@@ -2520,7 +2559,13 @@ def cmd_operator_top(args) -> int:
                 profile = api.agent.profile_status(top=1)
             except Exception:
                 profile = None  # older agent / route unavailable
-            frame = _render_top(snap, prev, solver=solver, profile=profile)
+            try:
+                bb = api.agent.blackbox_status()
+            except Exception:
+                bb = None  # older agent / route unavailable
+            frame = _render_top(
+                snap, prev, solver=solver, profile=profile, blackbox=bb
+            )
             prev = (_time.monotonic(), snap)
             frames += 1
             last = args.once or (args.n and frames >= args.n)
@@ -2593,6 +2638,115 @@ def cmd_operator_trace(args) -> int:
     print(_fmt_table(
         rows, ["ID", "Name", "Duration", "Spans", "Status", "Evals"]
     ))
+    return 0
+
+
+def _fmt_wallclock(ts: float) -> str:
+    """Wall-clock timestamp for incident/timeline rows (local time)."""
+    import time as _time
+
+    return _time.strftime("%Y-%m-%d %H:%M:%S", _time.localtime(ts))
+
+
+def cmd_operator_incidents_list(args) -> int:
+    """`operator incidents list` — the flight recorder's incident index
+    (/v1/incidents): every anomaly-triggered capture with its trigger
+    rule, observed value, and on-disk bundle path (docs/incidents.md)."""
+    import json as _json
+
+    api = _client(args)
+    incidents = api.agent.incidents()
+    if args.as_json:
+        print(_json.dumps(incidents, indent=2, sort_keys=True))
+        return 0
+    if not incidents:
+        print("No incidents captured (the blackbox is quiet).")
+        return 0
+    rows = []
+    for rec in incidents:
+        d = rec.get("detail") or {}
+        rows.append([
+            rec["id"],
+            _fmt_wallclock(rec.get("ts", 0)),
+            d.get("rule", rec.get("reason", "")),
+            str(d.get("value", "-")),
+            str(d.get("threshold", "-")),
+            rec.get("path") or "(memory only)",
+        ])
+    print(_fmt_table(
+        rows,
+        ["ID", "CAPTURED", "RULE", "VALUE", "THRESHOLD", "BUNDLE"],
+    ))
+    return 0
+
+
+def cmd_operator_incidents_show(args) -> int:
+    """`operator incidents show <id>` — one incident's capture record:
+    trigger detail, bundle path, and the files the capture wrote."""
+    import json as _json
+
+    api = _client(args)
+    rec = api.agent.incident(args.incident_id)
+    if args.as_json:
+        print(_json.dumps(rec, indent=2, sort_keys=True))
+        return 0
+    d = rec.get("detail") or {}
+    print(f"Incident  {rec['id']}")
+    print(f"Captured  {_fmt_wallclock(rec.get('ts', 0))}")
+    print(f"Rule      {d.get('rule', rec.get('reason', '-'))}")
+    if d.get("reason"):
+        print(f"Reason    {d['reason']}")
+    if "value" in d:
+        print(
+            f"Observed  {d.get('value')}"
+            f" (threshold {d.get('threshold', '-')},"
+            f" source {d.get('source', '-')})"
+        )
+    print(f"Bundle    {rec.get('path') or '(memory only)'}")
+    files = rec.get("files") or []
+    if files:
+        print("Files:")
+        for name in files:
+            print(f"  {name}")
+    return 0
+
+
+def cmd_operator_timeline(args) -> int:
+    """`operator timeline <kind> <id>` — the causal timeline for one
+    object (/v1/timeline): flight-recorder journal rows + finished
+    traces that touch the object or anything reachable from it within
+    two relation hops, merged onto one wall-clock axis."""
+    import json as _json
+
+    api = _client(args)
+    tl = api.agent.timeline(args.kind, args.object_id)
+    if args.as_json:
+        print(_json.dumps(tl, indent=2, sort_keys=True))
+        return 0
+    related = tl.get("related") or []
+    print(
+        f"Timeline for {tl.get('kind')}:{tl.get('id')}"
+        f" — {len(tl.get('rows') or [])} row(s),"
+        f" {len(related)} related object(s)"
+    )
+    if related:
+        print("Related: " + " ".join(sorted(related)))
+    rows = []
+    for row in tl.get("rows") or []:
+        d = row.get("detail") or {}
+        extra = " ".join(
+            f"{k}={d[k]}" for k in sorted(d)
+            if k != "rel" and not isinstance(d[k], (dict, list))
+        )
+        rows.append([
+            _fmt_wallclock(row.get("ts", 0)),
+            row.get("kind", ""),
+            row.get("key", ""),
+            extra[:60],
+        ])
+    print(_fmt_table(rows, ["TIME", "KIND", "KEY", "DETAIL"]))
+    if tl.get("truncated"):
+        print("(truncated — raise the journal capacity for more)")
     return 0
 
 
@@ -3760,6 +3914,35 @@ def build_parser() -> argparse.ArgumentParser:
     optr.add_argument("-eval-id", dest="eval_id", default="")
     optr.add_argument("-job-id", dest="job_id", default="")
     optr.set_defaults(fn=cmd_operator_trace)
+    opinc = opsub.add_parser(
+        "incidents",
+        help="flight-recorder incident captures (/v1/incidents)",
+    )
+    opincsub = opinc.add_subparsers(dest="subsubcmd")
+    opincl = opincsub.add_parser(
+        "list", help="anomaly-triggered capture index"
+    )
+    opincl.add_argument("-json", action="store_true", dest="as_json")
+    _args_conn(opincl)
+    opincl.set_defaults(fn=cmd_operator_incidents_list)
+    opincs = opincsub.add_parser(
+        "show", help="one incident's trigger detail + bundle files"
+    )
+    opincs.add_argument("incident_id")
+    opincs.add_argument("-json", action="store_true", dest="as_json")
+    _args_conn(opincs)
+    opincs.set_defaults(fn=cmd_operator_incidents_show)
+    optl = opsub.add_parser(
+        "timeline",
+        help="causal timeline for one object (/v1/timeline)",
+    )
+    optl.add_argument(
+        "kind", help="eval | alloc | node | job | deployment | plan"
+    )
+    optl.add_argument("object_id")
+    optl.add_argument("-json", action="store_true", dest="as_json")
+    _args_conn(optl)
+    optl.set_defaults(fn=cmd_operator_timeline)
     opsol = opsub.add_parser(
         "solver", help="solver device observability (/v1/solver/status)"
     )

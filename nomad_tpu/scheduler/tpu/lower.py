@@ -240,6 +240,13 @@ class LoweredGroup:
     # bias WITHOUT the per-solve spread addend — what the lowered-skeleton
     # cache stores (aliases `bias` when the group has no spreads)
     bias_static: Optional[np.ndarray] = None
+    # per-dimension feasibility attrition: screen name → nodes that
+    # screen newly eliminated. The dense path's answer to the host
+    # stack's per-checker counts — AllocMetric.constraint_filtered /
+    # dimension_exhausted on the fast-mint path read from here, so
+    # `alloc status` explains a dense-path failure the same way it
+    # explains a host-path one.
+    filtered_dims: dict = field(default_factory=dict)
 
 
 def lower_group(
@@ -253,6 +260,17 @@ def lower_group(
     """Build the group's feasibility mask, score bias, and unit caps."""
     n = table.n
     feas = np.ones(n, dtype=bool)
+    filtered_dims: dict[str, int] = {}
+
+    def screen(dim: str, mask: np.ndarray) -> None:
+        """AND `mask` into the running feasibility and attribute the
+        nodes it newly eliminated to `dim` (AllocMetric attrition)."""
+        nonlocal feas
+        before = int(np.sum(feas))
+        feas = feas & mask
+        dropped = before - int(np.sum(feas))
+        if dropped:
+            filtered_dims[dim] = filtered_dims.get(dim, 0) + dropped
 
     # Datacenter membership (the GenericStack's node source filter).
     import fnmatch
@@ -260,11 +278,11 @@ def lower_group(
     dc_ok = np.zeros(len(table.dc_values), dtype=bool)
     for vi, dc in enumerate(table.dc_values):
         dc_ok[vi] = any(fnmatch.fnmatchcase(dc, pat) for pat in job.datacenters)
-    feas &= dc_ok[table.datacenters]
+    screen("datacenters", dc_ok[table.datacenters])
 
     # Drivers.
     for task in tg.tasks:
-        feas &= table.driver_mask(task.driver)
+        screen(f"driver.{task.driver}", table.driver_mask(task.driver))
 
     # Constraints: job + group + task level, via per-distinct-value masks.
     constraints = list(job.constraints) + list(tg.constraints)
@@ -275,7 +293,10 @@ def lower_group(
         if c.operand == CONSTRAINT_DISTINCT_HOSTS:
             units_cap = np.minimum(units_cap, 1)
             # exclude nodes already carrying this job's allocs
-            feas &= _job_free_mask(ctx, table, job.id)
+            screen(
+                CONSTRAINT_DISTINCT_HOSTS,
+                _job_free_mask(ctx, table, job.id),
+            )
             continue
         if c.operand == CONSTRAINT_DISTINCT_PROPERTY:
             cap_per_value = int(c.rtarget) if c.rtarget else 1
@@ -285,7 +306,7 @@ def lower_group(
                 0, cap_per_value - counts
             )  # per distinct value
             units_cap = np.minimum(units_cap, remaining[codes])
-            feas &= exists
+            screen(f"{CONSTRAINT_DISTINCT_PROPERTY}.{c.ltarget}", exists)
             continue
         codes, values, exists = table.attr_codes(c.ltarget)
         rval, r_found = c.rtarget, True  # rtargets are literals for node feas
@@ -300,7 +321,7 @@ def lower_group(
             mask = mask | ~exists
         else:
             mask = mask & exists
-        feas &= mask
+        screen(f"constraint.{c.ltarget} {c.operand}".rstrip(), mask)
 
     # Host volumes (mirrors feasible.py HostVolumeChecker): per-node
     # membership/writability, plus the registered-volume access screen
@@ -329,7 +350,7 @@ def lower_group(
                 ):
                     continue  # claimed single-writer: node unusable
                 vol_ok[i] = True
-            feas &= vol_ok
+            screen(f"host_volume.{ask.source}", vol_ok)
 
     # CSI volumes (mirrors feasible.py CSIVolumeChecker): node must run a
     # healthy node-capable instance of some registered, claimable volume's
@@ -361,7 +382,7 @@ def lower_group(
                 ],
                 dtype=bool,
             )
-            feas &= csi_ok
+            screen(f"csi_volume.{ask.source}", csi_ok)
 
     # Network: static-port / bandwidth screens stay host-side but cheap —
     # mbits capacity folds into feasibility; a static-port ask caps the
@@ -380,12 +401,12 @@ def lower_group(
             ],
             dtype=bool,
         )
-        feas &= net_ok
+        screen("network.mbits", net_ok)
     static_ports = [p.value for a in net_asks for p in a.reserved_ports if p.value]
     if static_ports:
         units_cap = np.minimum(units_cap, 1)
         for port in static_ports:
-            feas &= ~table.used_port_mask(port)
+            screen(f"network.port.{port}", ~table.used_port_mask(port))
 
     # Devices.
     dev_asks = [d for t in tg.tasks for d in t.resources.devices]
@@ -400,7 +421,7 @@ def lower_group(
                 ):
                     dev_ok[i] = False
                     break
-        feas &= dev_ok
+        screen("devices", dev_ok)
 
     # Score bias: affinities (normalized like the host oracle) + static
     # spread boosts; the solver adds this to the binpack score for ordering.
@@ -425,7 +446,7 @@ def lower_group(
 
     cores_ask = sum(t.resources.cores for t in tg.tasks)
     if cores_ask > 0 and table.cores_free is not None:
-        feas = feas & (table.cores_free >= cores_ask)
+        screen("cores", table.cores_free >= cores_ask)
         # dedicated ids are NOT in the dense resource columns, so cap
         # the per-node unit count here or the solver would stack more
         # instances than a node has cores and the materializer would
@@ -448,6 +469,7 @@ def lower_group(
         names=request_names(requests),
         requests=requests,
         bias_static=bias_static,
+        filtered_dims=filtered_dims,
     )
 
 

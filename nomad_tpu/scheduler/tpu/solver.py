@@ -113,6 +113,26 @@ def _merge_outcomes(a: SolveOutcome, b: SolveOutcome) -> SolveOutcome:
     return out
 
 
+def group_alloc_metric(grp: LoweredGroup, n: int) -> AllocMetric:
+    """AllocMetric for a dense-path group: the lowered feasibility mask
+    IS the evaluation record, so nodes_evaluated/nodes_filtered fall out
+    of it directly and the per-screen attrition (lower_group's
+    filtered_dims) maps onto the reference's per-checker counts —
+    resource-shaped screens (cores, network capacity/ports) read as
+    dimension_exhausted, membership screens (datacenter, driver,
+    constraints, volumes) as constraint_filtered. `alloc status` and the
+    blackbox timeline explain a fast-mint placement the same way the
+    host GenericStack explains an iterator-path one."""
+    metric = AllocMetric(nodes_evaluated=n)
+    metric.nodes_filtered = n - int(np.sum(grp.feasible))
+    for dim, dropped in grp.filtered_dims.items():
+        if dim == "cores" or dim.startswith("network."):
+            metric.dimension_exhausted[dim] = dropped
+        else:
+            metric.constraint_filtered[dim] = dropped
+    return metric
+
+
 class ResidentClusterState:
     """Device-resident capacity/usage tensors reused across solves.
 
@@ -186,7 +206,8 @@ class ResidentClusterState:
         return nodes, counts
 
     def lowered_skeleton(self, vers, job, tg_name: str):
-        """Cached (ask, feasible, bias, units_cap) for one task group
+        """Cached (ask, feasible, bias, units_cap, filtered_dims) for
+        one task group
         against the host-table fingerprint `vers` (identity compare:
         host_table interns one tuple per node-universe generation).
         Arrays are shared read-only — every consumer (dedupe, spread
@@ -1153,8 +1174,7 @@ class BatchSolver:
 
         # Failure metrics from the FINAL unplaced set (both passes).
         for (eval_id, tg_name), (grp, reqs) in final_unplaced.items():
-            metric = AllocMetric(nodes_evaluated=n)
-            metric.nodes_filtered = n - int(np.sum(grp.feasible))
+            metric = group_alloc_metric(grp, n)
             metric.coalesced_failures = len(reqs) - 1
             out.failures.setdefault(eval_id, {})[tg_name] = metric
         # solve_ns excludes any pipeline gap between the two phases
@@ -1240,7 +1260,7 @@ class BatchSolver:
         if cached is not None:
             from .lower import request_names
 
-            ask_vec, feas, bias, ucap = cached
+            ask_vec, feas, bias, ucap, fdims = cached
             sb = spread_bias(self.ctx, table, ask.job, tg)
             if sb is not None:
                 bias = bias + sb  # new array: the cached one is shared
@@ -1257,6 +1277,7 @@ class BatchSolver:
                 priority=ask.job.priority,
                 names=request_names(reqs),
                 requests=reqs,
+                filtered_dims=dict(fdims),
             )
         grp = lower_group(
             self.ctx, table, ask.job, tg, ask.requests, ask.eval_obj.id
@@ -1264,7 +1285,8 @@ class BatchSolver:
         if group_lower_static_cacheable(ask.job, tg):
             res.store_lowered(
                 vers, ask.job, tg.name,
-                (grp.ask, grp.feasible, grp.bias_static, grp.units_cap),
+                (grp.ask, grp.feasible, grp.bias_static, grp.units_cap,
+                 grp.filtered_dims),
             )
         return grp
 
@@ -2040,7 +2062,7 @@ class BatchSolver:
                             job=grp.job,
                             task_group=tg.name,
                             resources=shared_res,
-                            metrics=AllocMetric(nodes_evaluated=n),
+                            metrics=group_alloc_metric(grp, n),
                         )
                     )
                 uuids = generate_uuids(placed) if placed else []
@@ -2393,7 +2415,7 @@ class BatchSolver:
                 shared_disk_mb=tg.ephemeral_disk.size_mb,
                 shared_networks=shared_networks,
             ),
-            metrics=AllocMetric(nodes_evaluated=table.n),
+            metrics=group_alloc_metric(grp, table.n),
         )
         if req.canary:
             alloc.deployment_status = AllocDeploymentStatus(canary=True)

@@ -34,7 +34,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import clusterobs, metrics
+from .. import blackbox, clusterobs, metrics
 from ..retry import FORWARD_POLICY, call_with_retry
 from ..rpc import ConnPool, RPCError, RPCServer
 from .. import faultplane
@@ -1141,6 +1141,9 @@ class ClusterServer:
         solver_pool_role: str = "",
         solver_pool_members=(),
         solver_pool_sync_interval_s: float = 2.0,
+        blackbox_enabled: bool = True,
+        incident_dir: Optional[str] = None,
+        incident_max: int = 16,
         **raft_kw,
     ) -> None:
         self.node_id = node_id
@@ -1317,6 +1320,24 @@ class ClusterServer:
         self.rpc.register("SolverPool", self.solver_pool.endpoint)
         if getattr(self.server, "tpu_worker", None) is not None:
             self.server.tpu_worker.solver_pool = self.solver_pool
+        # Blackbox flight recorder (blackbox.py + blackbox_wire.py):
+        # always-on journal pump + anomaly triggers + incident capture.
+        # Owned here (not by the Agent) so bare ClusterServers — chaos
+        # clusters included — are self-forensic. Incident bundles land
+        # under data_dir/incidents unless a dir is configured; with
+        # neither (dev mode), captures stay in the in-memory ledger.
+        from .blackbox_wire import BlackboxWiring
+
+        if incident_dir is None and data_dir:
+            import os
+
+            incident_dir = os.path.join(data_dir, "incidents")
+        self.blackbox = BlackboxWiring(
+            self,
+            incident_dir=incident_dir or "",
+            incident_max=incident_max,
+            enabled=blackbox_enabled,
+        )
         # Member events are handled on a dedicated reconciler thread:
         # add_peer/remove_peer block on raft commit (up to 10s with no
         # quorum), which must never stall the gossip probe loop.
@@ -1810,6 +1831,16 @@ class ClusterServer:
         return index, (lambda: self.raft.apply_wait(index, term))
 
     def _on_leader_change(self, is_leader: bool) -> None:
+        # journal the edge BEFORE acting on it: a revoke that hangs in
+        # establish/revoke teardown still leaves its flight-recorder
+        # trace, and the leader-churn trigger counts these rows
+        blackbox.record(
+            blackbox.KIND_LEADERSHIP,
+            f"node:{self.node_id}",
+            transition="establish" if is_leader else "revoke",
+            term=self.raft.current_term,
+            rel=[f"node:{self.node_id}"],
+        )
         if is_leader:
             logger.info("%s: establishing leadership", self.node_id)
             self.server.establish_leadership()
@@ -2148,6 +2179,7 @@ class ClusterServer:
         self.raft.start()
         self.serf.start()
         self.solver_pool.start()
+        self.blackbox.start()
 
     def join(self, seeds: list[tuple[str, int]]) -> int:
         """Gossip-join an existing cluster (reference `nomad server join` /
@@ -2222,6 +2254,7 @@ class ClusterServer:
     def shutdown(self) -> None:
         was_leader = self.raft.is_leader()
         self._close_reverse_sessions()
+        self.blackbox.stop()
         self.solver_pool.stop()
         self.serf.stop()
         self._reconcile_q.put(None)

@@ -54,7 +54,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import metrics, trace
+from .. import blackbox, metrics, trace
 from ..structs import Evaluation, generate_uuid, now_ns
 
 DEFAULT_NACK_DELAY_S = 5.0
@@ -389,6 +389,13 @@ class EvalBroker:
         self.shed_total += 1
         metrics.incr("nomad.broker.shed")
         metrics.incr(f"nomad.broker.shed.{reason}")
+        blackbox.record(
+            blackbox.KIND_SHED, f"eval:{ev.id}", reason=reason,
+            tracked=tracked,
+            rel=[f"eval:{ev.id}"] + (
+                [f"job:{ev.job_id}"] if ev.job_id else []
+            ),
+        )
         if tracked:
             self._dropped.add(ev.id)
             self._pending_remove(ev.id)

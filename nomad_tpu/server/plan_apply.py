@@ -808,9 +808,20 @@ class PlanApplier:
                     result.refresh_index, snapshot.index
                 )
         if trimmed:
-            from .. import metrics
+            from .. import blackbox, metrics
 
             metrics.incr("nomad.plan_apply.dup_mint_trimmed", trimmed)
+            # flight-recorder journal: the dup-mint-invariant trigger
+            # captures an incident off this counter, and the journal row
+            # ties the trim to its minting evals for the timeline
+            blackbox.record(
+                blackbox.KIND_DUP_MINT, "plan_apply", trimmed=trimmed,
+                rel=[
+                    f"eval:{e}" for e in sorted(
+                        {ev for ev, _ in seen}
+                    )[:8]
+                ],
+            )
             logger.warning(
                 "merged plan round minted %d duplicate (eval, name) "
                 "alloc(s); trimmed the later entrant(s)", trimmed,

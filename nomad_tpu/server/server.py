@@ -17,7 +17,7 @@ import threading
 import time
 from typing import Optional
 
-from .. import metrics, trace
+from .. import blackbox, metrics, trace
 from ..scheduler.context import SchedulerConfig
 from ..state import StateStore
 from ..state.events import wire_events
@@ -1111,6 +1111,10 @@ class Server:
             return
         metrics.incr("nomad.heartbeat.expired", len(known))
         metrics.incr("nomad.heartbeat.expire_batches")
+        blackbox.record(
+            blackbox.KIND_EXPIRY, "heartbeat_wheel", expired=len(known),
+            rel=[f"node:{nid}" for nid in known[:16]],
+        )
         logger.warning(
             "%d node(s) missed heartbeats; marking down in one batch",
             len(known),

@@ -37,7 +37,7 @@ import time
 from concurrent.futures import CancelledError
 from typing import Optional
 
-from .. import faultplane, metrics, trace
+from .. import blackbox, faultplane, metrics, trace
 
 logger = logging.getLogger("nomad_tpu.solver_pool")
 
@@ -433,6 +433,10 @@ class SolverPool:
                 time.monotonic() + FAULT_COOLDOWN_S
             )
         metrics.incr("nomad.solver.pool.member_fault")
+        blackbox.record(
+            blackbox.KIND_POOL_FAULT, d.member_id,
+            error=f"{type(exc).__name__}: {exc}",
+        )
         logger.warning(
             "solver pool member %s failed: %s: %s",
             d.member_id, type(exc).__name__, exc,

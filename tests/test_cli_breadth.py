@@ -825,6 +825,22 @@ REFERENCE_COMMAND_FLAGS = {
         "flags": {"-json", "-timeout", "-top", "-address", "-token"},
         "args": [],
     },
+    # Round 22 (flight-recorder PR): extended with the blackbox incident
+    # surface — the capture index, one incident's bundle detail, and the
+    # cross-object causal timeline (/v1/incidents, /v1/timeline,
+    # docs/incidents.md). `operator top` grows a render-only Incidents
+    # row — its flag set is deliberately unchanged.
+    "operator incidents list": {
+        "flags": {"-json", "-address", "-token"}, "args": [],
+    },
+    "operator incidents show": {
+        "flags": {"-json", "-address", "-token"},
+        "args": ["incident_id"],
+    },
+    "operator timeline": {
+        "flags": {"-json", "-address", "-token"},
+        "args": ["kind", "object_id"],
+    },
     "event stream": {
         "flags": {"-topic", "-index", "-namespace"}, "args": [],
     },

@@ -29,12 +29,17 @@ pytestmark = pytest.mark.soak
 
 @pytest.fixture(autouse=True)
 def _fresh_world():
-    """Plane-free and a private registry per soak (counters in the
-    report are deltas, but a clean registry keeps the e2e histogram
-    attributable)."""
+    """Plane-free, a private registry, and a private flight recorder
+    per soak (counters in the report are deltas, but a clean registry
+    keeps the e2e histogram attributable; a fresh recorder keeps the
+    zero-incidents gate honest across test order)."""
+    from nomad_tpu import blackbox
+
     chaos.uninstall()
     old = metrics._install_registry(Registry())
+    old_rec = blackbox._install(blackbox.FlightRecorder())
     yield
+    blackbox._install(old_rec)
     metrics._install_registry(old)
     chaos.uninstall()
 
@@ -184,6 +189,16 @@ def test_loadgen_unit_against_single_server(tmp_path):
         # every job the generator acked exists and is running
         live = {j.id for j in cs.server.state.jobs() if not j.stop}
         assert gen.acked_jobs <= live
+        # flight-recorder false-positive gate (docs/incidents.md): the
+        # blackbox journaled this clean run (leadership + broker
+        # events) but every default trigger threshold stayed out of
+        # reach — a healthy cluster captures ZERO incidents
+        from nomad_tpu import blackbox
+
+        rec = blackbox.recorder()
+        assert rec.recorded > 0, "blackbox journaled nothing"
+        assert rec.incidents() == [], rec.incidents()
+        assert rec.stats()["triggers_fired"] == 0
     finally:
         cs.shutdown()
 
